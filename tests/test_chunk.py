@@ -1,0 +1,69 @@
+"""Chunk/tile tests (ref: util/chunk/chunk_test.go)."""
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.chunk.tile import build_tileset
+from tidb_tpu.mysqltypes import Datum, Dec, ft_long, ft_double, ft_decimal, ft_varchar
+
+
+def sample_chunk(n=10):
+    fts = [ft_long(), ft_double(), ft_decimal(10, 2), ft_varchar(20)]
+    rows = []
+    for i in range(n):
+        rows.append(
+            [
+                Datum.i(i) if i % 3 else Datum.null(),
+                Datum.f(i * 1.5),
+                Datum.d(Dec(i * 100 + 25, 2)),
+                Datum.s(f"s{i % 4}"),
+            ]
+        )
+    return Chunk.from_datum_rows(fts, rows)
+
+
+class TestChunk:
+    def test_build_and_read(self):
+        chk = sample_chunk(10)
+        assert chk.num_rows == 10 and chk.num_cols == 4
+        row = chk.get_row(4)
+        assert row[0].val == 4
+        assert row[2].val == Dec(425, 2)
+        assert chk.get_row(0)[0].is_null
+
+    def test_filter_take_concat(self):
+        chk = sample_chunk(10)
+        mask = np.array([i % 2 == 0 for i in range(10)])
+        half = chk.filter(mask)
+        assert half.num_rows == 5
+        assert half.get_row(1)[1].val == 3.0
+        both = half.concat(half)
+        assert both.num_rows == 10
+
+    def test_pylist_render(self):
+        chk = sample_chunk(3)
+        rows = chk.to_pylist()
+        assert rows[1] == ("1", "1.5", "1.25", "s1")
+        assert rows[0][0] is None
+
+
+class TestTiles:
+    def test_tileset_padding_and_dict(self):
+        chk = sample_chunk(10)
+        ts = build_tileset(chk, tile_rows=4)
+        assert ts.total_rows == 10
+        assert len(ts.tiles) == 3
+        assert ts.tiles[-1].n_rows == 2
+        # padded lanes are fixed shape
+        for t in ts.tiles:
+            assert all(len(d) == 4 for d in t.data)
+        # dict column: codes in sorted-vocab order
+        assert ts.dicts[3] == ["s0", "s1", "s2", "s3"]
+        t0 = ts.tiles[0]
+        assert [ts.dict_lookup(3, c) for c in t0.data[3][: t0.n_rows]] == ["s0", "s1", "s2", "s3"]
+
+    def test_decimal_lane_is_scaled_int(self):
+        chk = sample_chunk(5)
+        ts = build_tileset(chk, tile_rows=8)
+        assert ts.tiles[0].data[2].dtype == np.int64
+        assert ts.tiles[0].data[2][3] == 325

@@ -1,0 +1,99 @@
+"""Foundation tests: decimal, time packing, datum (ref: types/*_test.go)."""
+
+import pytest
+
+from tidb_tpu.mysqltypes import (
+    Dec,
+    dec_from_string,
+    dec_round,
+    pack_time,
+    unpack_time,
+    parse_datetime,
+    format_time,
+    time_year,
+    time_month,
+    time_day,
+    Datum,
+    parse_type_name,
+    TypeCode,
+)
+from tidb_tpu.mysqltypes.datum import compare_datum
+
+
+class TestDec:
+    def test_parse_and_str(self):
+        assert str(dec_from_string("123.45")) == "123.45"
+        assert str(dec_from_string("-0.001")) == "-0.001"
+        assert str(dec_from_string("42")) == "42"
+        assert str(dec_from_string("1.5e2")) == "150"
+        assert str(dec_from_string("1.5e-2")) == "0.015"
+
+    def test_arith(self):
+        a, b = dec_from_string("1.25"), dec_from_string("2.5")
+        assert str(a + b) == "3.75"
+        assert str(b - a) == "1.25"
+        assert str(a * b) == "3.125"
+        assert str(Dec(1, 0).div(Dec(3, 0))) == "0.3333"
+        assert Dec(1, 0).div(Dec(0, 0)) is None
+
+    def test_rescale_rounds_half_away(self):
+        assert str(dec_from_string("2.345").rescale(2)) == "2.35"  # half up
+        assert str(dec_from_string("-2.345").rescale(2)) == "-2.35"
+        assert str(dec_from_string("2.344").rescale(2)) == "2.34"
+
+    def test_round(self):
+        assert str(dec_round(dec_from_string("123.456"), 1)) == "123.5"
+        assert str(dec_round(dec_from_string("155"), -1)) == "160"
+
+    def test_cmp(self):
+        assert dec_from_string("1.5").cmp(dec_from_string("1.50")) == 0
+        assert dec_from_string("1.5").cmp(dec_from_string("1.49")) == 1
+
+
+class TestTime:
+    def test_pack_roundtrip(self):
+        p = pack_time(1998, 9, 2, 11, 30, 45, 123456)
+        assert unpack_time(p) == (1998, 9, 2, 11, 30, 45, 123456)
+
+    def test_order_is_chronological(self):
+        assert pack_time(1998, 9, 2) < pack_time(1998, 9, 3) < pack_time(1998, 10, 1) < pack_time(1999, 1, 1)
+
+    def test_parse_format(self):
+        p = parse_datetime("1998-09-02")
+        assert format_time(p, is_date=True) == "1998-09-02"
+        p2 = parse_datetime("2021-08-01 12:34:56.789")
+        assert format_time(p2, fsp=3) == "2021-08-01 12:34:56.789"
+        assert parse_datetime("not a date") is None
+        assert parse_datetime("1998-13-02") is None
+
+    def test_extract(self):
+        p = pack_time(1998, 9, 2, 1, 2, 3)
+        assert time_year(p) == 1998
+        assert time_month(p) == 9
+        assert time_day(p) == 2
+
+
+class TestDatum:
+    def test_compare_mixed(self):
+        assert compare_datum(Datum.i(1), Datum.f(1.5)) == -1
+        assert compare_datum(Datum.d(dec_from_string("1.5")), Datum.f(1.5)) == 0
+        assert compare_datum(Datum.null(), Datum.i(0)) == -1
+
+    def test_string_to_number(self):
+        assert Datum.s("12.5abc").to_float() == 12.5
+        assert Datum.s("abc").to_float() == 0.0
+
+    def test_render(self):
+        assert Datum.null().render() is None
+        assert Datum.i(42).render() == "42"
+
+
+class TestFieldType:
+    def test_parse_type_name(self):
+        ft = parse_type_name("decimal", (12, 2))
+        assert ft.tp == TypeCode.NewDecimal and ft.flen == 12 and ft.decimal == 2
+        ft = parse_type_name("bigint", (), unsigned=True)
+        assert ft.tp == TypeCode.Longlong and ft.is_unsigned
+        assert parse_type_name("varchar", (64,)).flen == 64
+        with pytest.raises(ValueError):
+            parse_type_name("frobnicate")

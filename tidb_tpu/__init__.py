@@ -1,0 +1,28 @@
+"""tidb_tpu — a TPU-native distributed SQL framework.
+
+A brand-new framework with the capabilities of the reference (TiDB, a
+MySQL-compatible distributed HTAP database — see SURVEY.md): SQL parser,
+cost-based planner, chunk-vectorized volcano executor, MVCC/2PC storage,
+and a coprocessor-pushdown boundary — where pushed-down query fragments
+(scan/filter/aggregate/TopN/limit and MPP exchange) execute as fused
+JAX/XLA programs on TPU meshes instead of a Go/Rust coprocessor.
+
+Layering (top to bottom), mirroring reference SURVEY.md §1:
+  session/   — session lifecycle, SQL driver        (ref: session/)
+  parser/    — SQL text → AST                       (ref: pingcap/parser)
+  planner/   — logical rules + physical cop/root    (ref: planner/core)
+  executor/  — chunk volcano executors              (ref: executor/)
+  copr/      — coprocessor client + TPU/host engine (ref: store/copr + unistore/cophandler)
+  storage/   — MVCC KV, TSO, 2PC                    (ref: kv/ + unistore/tikv)
+  chunk/     — columnar batches + device tiles      (ref: util/chunk)
+  expr/      — vectorized expressions, JAX lowering (ref: expression/)
+  mysqltypes — value domain                         (ref: types/)
+  codec/     — key/row encodings                    (ref: util/codec, tablecodec)
+  parallel/  — mesh sharding, collectives, MPP      (ref: store/copr/mpp.go, TiFlash)
+
+Importing the top-level package is cheap and jax-free; device-facing
+modules (copr.tpu_engine, parallel, expr lowering) import
+`tidb_tpu.jaxenv` which configures JAX on first use.
+"""
+
+__version__ = "0.1.0"

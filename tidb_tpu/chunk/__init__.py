@@ -1,0 +1,2 @@
+from .chunk import Chunk, Column, col_numpy_dtype, VARLEN
+from .tile import DeviceTile, HostTileSet, TILE_ROWS

@@ -1,0 +1,100 @@
+"""Device tiles — the fixed-shape device twin of a Chunk.
+
+TPU/XLA wants static shapes; SQL produces data-dependent cardinalities.
+The contract (SURVEY §7 "hard parts"):
+  * a tile is TILE_ROWS rows of each referenced column, zero-padded
+  * `row_valid` marks real rows; per-column `valid` marks non-NULLs
+  * selection produces masks, never compaction, until the host boundary
+
+Lane dtypes: int64 (ints/decimals-scaled/times), float64/float32, int32
+dictionary codes for strings. Dictionary vocabularies live host-side; only
+codes go to device (GPU-compressed-scan papers' pattern; also how TiFlash
+ships packed columns).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..mysqltypes.field_type import FieldType
+from .chunk import Chunk, Column, col_numpy_dtype, VARLEN
+
+TILE_ROWS = 1 << 16  # 65536 — big enough to amortize dispatch, fits VMEM-tiled pipelines
+
+
+@dataclass
+class DeviceTile:
+    """Host-side staging of one tile; arrays are numpy, shipped via jnp.asarray."""
+
+    n_rows: int  # real rows (<= TILE_ROWS)
+    data: list[np.ndarray]  # per column, padded to TILE_ROWS
+    valid: list[np.ndarray]  # per column bool, padded (False in padding)
+
+
+@dataclass
+class HostTileSet:
+    """Columnar snapshot of a table region, pre-split into tiles.
+
+    Built once per (table, data-version) by the cop engine's tile cache
+    (the TiFlash-columnar-replica analog) and reused across queries.
+    `dicts[i]` is the string vocabulary for dictionary-coded column i
+    (None for numeric lanes).
+    """
+
+    fts: list[FieldType]
+    tiles: list[DeviceTile]
+    dicts: list[list | None]
+    total_rows: int
+
+    def dict_lookup(self, col: int, code: int):
+        return self.dicts[col][code]
+
+
+def _dict_encode(objs: np.ndarray, valid: np.ndarray):
+    """Dictionary-encode an object column → (int32 codes, vocab list).
+
+    Codes are assigned in *sorted* vocab order so that integer code order
+    == binary collation order; device-side min/max/sort/group-by on codes
+    is then semantically exact for the column (per-tileset vocab).
+    """
+    vals = objs[valid]
+    vocab = sorted(set(vals.tolist()))
+    codes = np.zeros(len(objs), dtype=np.int32)
+    if vocab:
+        vocab_arr = np.array(vocab, dtype=object)
+        codes[valid] = np.searchsorted(vocab_arr, vals).astype(np.int32)
+    return codes, vocab
+
+
+def build_tileset(chunk: Chunk, tile_rows: int = TILE_ROWS) -> HostTileSet:
+    """Split a (possibly huge) chunk into padded device-ready tiles."""
+    n = chunk.num_rows
+    fts = chunk.field_types()
+    cols_data: list[np.ndarray] = []
+    dicts: list[list | None] = []
+    for c in chunk.columns:
+        if c.is_varlen():
+            codes, vocab = _dict_encode(c.data, c.valid)
+            cols_data.append(codes)
+            dicts.append(vocab)
+        else:
+            cols_data.append(c.data)
+            dicts.append(None)
+    tiles = []
+    for lo in range(0, max(n, 1), tile_rows):
+        hi = min(lo + tile_rows, n)
+        cnt = hi - lo
+        tdata, tvalid = [], []
+        for data, col in zip(cols_data, chunk.columns):
+            pad = tile_rows - cnt
+            d = data[lo:hi]
+            v = col.valid[lo:hi]
+            if pad:
+                d = np.concatenate([d, np.zeros(pad, dtype=d.dtype)])
+                v = np.concatenate([v, np.zeros(pad, dtype=bool)])
+            tdata.append(np.ascontiguousarray(d))
+            tvalid.append(np.ascontiguousarray(v))
+        tiles.append(DeviceTile(n_rows=cnt, data=tdata, valid=tvalid))
+    return HostTileSet(fts=fts, tiles=tiles, dicts=dicts, total_rows=n)

@@ -1,0 +1,82 @@
+"""MySQL-compatible error space (ref: errno/errno.go, util/dbterror)."""
+
+
+class TiDBError(Exception):
+    code = 1105  # ER_UNKNOWN_ERROR
+
+    def __init__(self, msg: str = ""):
+        super().__init__(msg)
+        self.msg = msg
+
+
+class ParseError(TiDBError):
+    code = 1064
+
+
+class UnknownDatabase(TiDBError):
+    code = 1049
+
+
+class UnknownTable(TiDBError):
+    code = 1146
+
+
+class TableExists(TiDBError):
+    code = 1050
+
+
+class UnknownColumn(TiDBError):
+    code = 1054
+
+
+class AmbiguousColumn(TiDBError):
+    code = 1052
+
+
+class DuplicateEntry(TiDBError):
+    code = 1062
+
+
+class WriteConflict(TiDBError):
+    """Optimistic transaction write-write conflict (ref: kv/error.go ErrWriteConflict)."""
+
+    code = 9007
+
+
+class LockedError(TiDBError):
+    """Key is locked by another in-flight transaction (percolator lock)."""
+
+    code = 9008
+
+    def __init__(self, msg="", key=None, lock=None):
+        super().__init__(msg)
+        self.key = key
+        self.lock = lock
+
+
+class RetryableError(TiDBError):
+    code = 9009
+
+
+class TxnAborted(TiDBError):
+    code = 9010
+
+
+class DivisionByZero(TiDBError):
+    code = 1365
+
+
+class DataOutOfRange(TiDBError):
+    code = 1690
+
+
+class TruncatedWrongValue(TiDBError):
+    code = 1292
+
+
+class QueryInterrupted(TiDBError):
+    code = 1317
+
+
+class MemoryQuotaExceeded(TiDBError):
+    code = 8175

@@ -1,0 +1,16 @@
+"""JAX environment setup — imported by every device-facing module.
+
+Device aggregation of scaled-int decimals and packed datetimes requires
+64-bit lanes; XLA:TPU lowers s64 via 32-bit pairs, which is acceptable for
+the reduction tails (the hot loops are f32/i32). Centralizing the config
+here keeps `import tidb_tpu` (and the pure-host modules: mysqltypes, codec,
+chunk, parser, planner) jax-free.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from jax import numpy as jnp  # noqa: E402  (re-export for device modules)
+
+__all__ = ["jax", "jnp"]
