@@ -1,13 +1,21 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import (ref test strategy: SURVEY §4 — the
-reference tests multi-node behavior in-process via unistore; we test
-multi-chip sharding on a virtual CPU mesh the same way).
+The ambient environment pins JAX_PLATFORMS=axon (the real-TPU tunnel) and
+imports jax at interpreter start via sitecustomize, so env vars set here
+are too late — the config flags are updated programmatically instead.
+Tests must never compile through the tunnel; multi-chip behavior is
+verified on a virtual CPU mesh (the unistore-style in-process pattern,
+SURVEY §4.2).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any late readers
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

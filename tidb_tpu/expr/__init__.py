@@ -1,0 +1,3 @@
+from .expression import Expression, Column, Constant, ScalarFunc, make_func, eval_expr_np, FUNCS
+from . import builtins  # populate the registry
+from .aggregation import AggDesc, AGG_FUNCS

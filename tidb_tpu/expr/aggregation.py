@@ -1,0 +1,87 @@
+"""Aggregate function descriptors (ref: expression/aggregation/descriptor.go).
+
+The partial/final mode split is the heart of distributed aggregation
+(SURVEY §2.13.3): cop/TPU side computes partials per shard, root side
+merges. On device, partials are exact integer/float segment reductions
+and the cross-device merge is a `psum` — which is why SUM over decimals
+uses scaled int64 lanes.
+
+    func   | partial state         | final merge
+    -------|-----------------------|---------------------
+    count  | count:int64           | sum of counts
+    sum    | sum (+has flag)       | sum of sums
+    avg    | (sum, count)          | sum/ count  (exact decimal div)
+    min    | min (+has flag)       | min of mins
+    max    | max                   | max of maxs
+    first_row | first value        | first of firsts
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..mysqltypes.field_type import FieldType, ft_longlong, ft_double, ft_decimal
+from ..mysqltypes.mydecimal import MAX_SCALE, DIV_FRAC_INCR
+from .expression import Expression
+
+MODE_COMPLETE = "complete"
+MODE_PARTIAL = "partial"
+MODE_FINAL = "final"
+
+AGG_FUNCS = ("count", "sum", "avg", "min", "max", "first_row")
+
+
+def _scale(ft: FieldType) -> int:
+    return max(ft.decimal, 0) if ft.is_decimal() else 0
+
+
+def agg_ret_type(name: str, arg_ft: FieldType | None) -> FieldType:
+    if name == "count":
+        return ft_longlong()
+    if name == "sum":
+        if arg_ft.is_float() or arg_ft.is_string():
+            return ft_double()
+        # SUM of int/decimal is decimal in MySQL
+        return ft_decimal(38, _scale(arg_ft))
+    if name == "avg":
+        if arg_ft.is_float() or arg_ft.is_string():
+            return ft_double()
+        return ft_decimal(38, min(_scale(arg_ft) + DIV_FRAC_INCR, MAX_SCALE))
+    # min/max/first_row keep the arg type
+    return arg_ft.clone()
+
+
+@dataclass
+class AggDesc:
+    name: str
+    args: list[Expression]
+    distinct: bool = False
+    mode: str = MODE_COMPLETE
+    ret_type: FieldType = field(default_factory=ft_longlong)
+
+    @staticmethod
+    def make(name: str, args: list[Expression], distinct: bool = False) -> "AggDesc":
+        name = name.lower()
+        if name not in AGG_FUNCS:
+            raise ValueError(f"unknown aggregate {name}")
+        arg_ft = args[0].ret_type if args else None
+        return AggDesc(name, args, distinct, MODE_COMPLETE, agg_ret_type(name, arg_ft))
+
+    def pushable(self) -> bool:
+        """May this aggregate run as a cop/TPU partial? (ref: agg_to_pb.go)"""
+        return not self.distinct and all(a.pushable() for a in self.args)
+
+    def partial_final_types(self) -> list[tuple[str, FieldType]]:
+        """The partial-state columns this agg ships back from the cop side."""
+        if self.name == "count":
+            return [("count", ft_longlong())]
+        if self.name == "sum":
+            return [("sum", self.ret_type)]
+        if self.name == "avg":
+            arg = self.args[0].ret_type
+            return [("sum", agg_ret_type("sum", arg)), ("count", ft_longlong())]
+        return [(self.name, self.ret_type)]
+
+    def __repr__(self):
+        d = "distinct " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(repr, self.args))})"

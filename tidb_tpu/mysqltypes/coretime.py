@@ -17,6 +17,21 @@ import re
 
 _US = 1_000_000
 
+# Extraction divisors/moduli derived from the packing radices — the single
+# source of truth shared with expr/builtins date functions.
+DIV_SECOND = _US
+DIV_MINUTE = DIV_SECOND * 60
+DIV_HOUR = DIV_MINUTE * 60
+DIV_DAY = DIV_HOUR * 24
+DIV_MONTH = DIV_DAY * 32
+DIV_YEAR = DIV_MONTH * 13
+MOD_MICRO = _US
+MOD_SECOND = 60
+MOD_MINUTE = 60
+MOD_HOUR = 24
+MOD_DAY = 32
+MOD_MONTH = 13
+
 
 def pack_time(year: int, month: int, day: int, hour: int = 0, minute: int = 0, second: int = 0, micro: int = 0) -> int:
     ymd = (year * 13 + month) * 32 + day
