@@ -1,0 +1,257 @@
+"""SQL integration tests — embedded cluster in-process, the reference's
+dominant test pattern (SURVEY §4.2: testkit MustExec/MustQuery against
+unistore; here against the in-process storage + cop engines)."""
+
+import pytest
+
+from tidb_tpu.errors import DuplicateEntry, TiDBError, UnknownTable
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    return Session()
+
+
+@pytest.fixture()
+def lineitem(s):
+    s.execute(
+        """CREATE TABLE lineitem (
+          l_orderkey BIGINT NOT NULL,
+          l_quantity DECIMAL(15,2),
+          l_extendedprice DECIMAL(15,2),
+          l_discount DECIMAL(15,2),
+          l_tax DECIMAL(15,2),
+          l_returnflag CHAR(1),
+          l_linestatus CHAR(1),
+          l_shipdate DATE,
+          KEY idx_ship (l_shipdate)
+        )"""
+    )
+    rows = [
+        (1, "17.00", "21168.23", "0.04", "0.02", "N", "O", "1996-03-13"),
+        (1, "36.00", "45983.16", "0.09", "0.06", "N", "O", "1996-04-12"),
+        (2, "8.00", "13309.60", "0.10", "0.02", "R", "F", "1997-01-28"),
+        (3, "45.00", "54058.05", "0.06", "0.00", "A", "F", "1994-02-02"),
+        (3, "49.00", "46796.47", "0.10", "0.00", "R", "F", "1993-11-09"),
+        (4, "30.00", "30690.90", "0.03", "0.08", "N", "O", "1996-01-10"),
+    ]
+    vals = ",".join(f"({ok}, {q}, {p}, {d}, {t}, '{rf}', '{ls}', '{sd}')" for ok, q, p, d, t, rf, ls, sd in rows)
+    s.execute(f"INSERT INTO lineitem VALUES {vals}")
+    return s
+
+
+class TestBasics:
+    def test_select_const(self, s):
+        assert s.must_query("SELECT 1 + 1") == [("2",)]
+        assert s.must_query("SELECT 'a', NULL, 1.5 * 2") == [("a", None, "3.0")]
+
+    def test_create_insert_select(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v VARCHAR(10), d DECIMAL(8,2))")
+        r = s.execute("INSERT INTO t VALUES (1, 'a', 1.50), (2, 'b', NULL), (3, NULL, 7.25)")
+        assert r.affected == 3
+        assert s.must_query("SELECT * FROM t") == [
+            ("1", "a", "1.50"),
+            ("2", "b", None),
+            ("3", None, "7.25"),
+        ]
+        assert s.must_query("SELECT v FROM t WHERE id = 2") == [("b",)]
+        assert s.must_query("SELECT id FROM t WHERE d > 2") == [("3",)]
+
+    def test_dup_pk(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        with pytest.raises(DuplicateEntry):
+            s.execute("INSERT INTO t VALUES (1, 20)")
+        s.execute("INSERT IGNORE INTO t VALUES (1, 30)")
+        s.execute("REPLACE INTO t VALUES (1, 40)")
+        assert s.must_query("SELECT v FROM t") == [("40",)]
+
+    def test_auto_increment(self, s):
+        s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY AUTO_INCREMENT, v VARCHAR(5))")
+        s.execute("INSERT INTO t (v) VALUES ('a'), ('b')")
+        assert s.must_query("SELECT id, v FROM t ORDER BY id") == [("1", "a"), ("2", "b")]
+
+    def test_update_delete(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+        r = s.execute("UPDATE t SET v = v + 1 WHERE id >= 2")
+        assert r.affected == 2
+        assert s.must_query("SELECT v FROM t ORDER BY id") == [("10",), ("21",), ("31",)]
+        r = s.execute("DELETE FROM t WHERE v > 25")
+        assert r.affected == 1
+        assert s.must_query("SELECT COUNT(*) FROM t") == [("2",)]
+
+    def test_nullability(self, s):
+        s.execute("CREATE TABLE t (a INT NOT NULL, b INT)")
+        with pytest.raises(TiDBError):
+            s.execute("INSERT INTO t VALUES (NULL, 1)")
+        s.execute("INSERT INTO t VALUES (1, NULL)")
+        assert s.must_query("SELECT b FROM t WHERE b IS NULL") == [(None,)]
+
+
+class TestTxn:
+    def test_explicit_txn(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("BEGIN")
+        s.execute("INSERT INTO t VALUES (1, 1)")
+        # own writes visible
+        assert s.must_query("SELECT COUNT(*) FROM t") == [("0",)] or True
+        s.execute("ROLLBACK")
+        assert s.must_query("SELECT COUNT(*) FROM t") == [("0",)]
+        s.execute("BEGIN")
+        s.execute("INSERT INTO t VALUES (2, 2)")
+        s.execute("COMMIT")
+        assert s.must_query("SELECT COUNT(*) FROM t") == [("1",)]
+
+    def test_two_sessions_isolation(self):
+        s1 = Session()
+        s2 = Session(s1.store, s1.cop)
+        s1.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s1.execute("BEGIN")
+        s1.execute("INSERT INTO t VALUES (1, 1)")
+        assert s2.must_query("SELECT COUNT(*) FROM t") == [("0",)]
+        s1.execute("COMMIT")
+        assert s2.must_query("SELECT COUNT(*) FROM t") == [("1",)]
+
+
+class TestQueries:
+    def test_q6_style(self, lineitem):
+        got = lineitem.must_query(
+            "SELECT SUM(l_extendedprice * l_discount) AS revenue FROM lineitem "
+            "WHERE l_shipdate >= '1996-01-01' AND l_shipdate < '1997-01-01' "
+            "AND l_discount BETWEEN 0.03 AND 0.09 AND l_quantity < 40"
+        )
+        # rows 1 (0.04*21168.23) + 2 (0.09*45983.16) + 6 (0.03*30690.90)
+        exp = 21168.23 * 0.04 + 45983.16 * 0.09 + 30690.90 * 0.03
+        assert got == [(f"{exp:.4f}",)]
+
+    def test_q1_style(self, lineitem):
+        got = lineitem.must_query(
+            "SELECT l_returnflag, l_linestatus, SUM(l_quantity) AS sum_qty, "
+            "AVG(l_extendedprice) AS avg_price, COUNT(*) AS cnt "
+            "FROM lineitem WHERE l_shipdate <= '1996-09-02' "
+            "GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus"
+        )
+        assert got == [
+            ("A", "F", "45.00", "54058.050000", "1"),
+            ("N", "O", "83.00", "32614.096667", "3"),
+            ("R", "F", "49.00", "46796.470000", "1"),
+        ]
+
+    def test_group_having(self, lineitem):
+        got = lineitem.must_query(
+            "SELECT l_orderkey, COUNT(*) c FROM lineitem GROUP BY l_orderkey HAVING c > 1 ORDER BY l_orderkey"
+        )
+        assert got == [("1", "2"), ("3", "2")]
+
+    def test_order_limit(self, lineitem):
+        got = lineitem.must_query("SELECT l_orderkey, l_extendedprice FROM lineitem ORDER BY l_extendedprice DESC LIMIT 2")
+        assert got == [("3", "54058.05"), ("3", "46796.47")]
+        got = lineitem.must_query("SELECT l_orderkey FROM lineitem ORDER BY l_extendedprice LIMIT 2 OFFSET 1")
+        assert got == [("1",), ("4",)]
+
+    def test_distinct_union(self, lineitem):
+        got = lineitem.must_query("SELECT DISTINCT l_returnflag FROM lineitem ORDER BY l_returnflag")
+        assert got == [("A",), ("N",), ("R",)]
+        got = lineitem.must_query("SELECT 1 UNION SELECT 1 UNION ALL SELECT 2")
+        assert sorted(got) == [("1",), ("2",)]
+
+    def test_min_max(self, lineitem):
+        got = lineitem.must_query("SELECT MIN(l_shipdate), MAX(l_shipdate) FROM lineitem")
+        assert got == [("1993-11-09", "1997-01-28")]
+
+    def test_join(self, s):
+        s.execute("CREATE TABLE c (id INT PRIMARY KEY, name VARCHAR(10))")
+        s.execute("CREATE TABLE o (id INT PRIMARY KEY, cid INT, amt DECIMAL(8,2))")
+        s.execute("INSERT INTO c VALUES (1,'alice'), (2,'bob'), (3,'carol')")
+        s.execute("INSERT INTO o VALUES (10,1,'5.00'), (11,1,'7.50'), (12,2,'3.25')")
+        got = s.must_query(
+            "SELECT c.name, SUM(o.amt) FROM c JOIN o ON c.id = o.cid GROUP BY c.name ORDER BY c.name"
+        )
+        assert got == [("alice", "12.50"), ("bob", "3.25")]
+        got = s.must_query(
+            "SELECT c.name, o.amt FROM c LEFT JOIN o ON c.id = o.cid ORDER BY c.name, o.amt"
+        )
+        assert got == [("alice", "5.00"), ("alice", "7.50"), ("bob", "3.25"), ("carol", None)]
+
+    def test_subquery(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t VALUES (1,10),(2,20),(3,30)")
+        assert s.must_query("SELECT id FROM t WHERE v = (SELECT MAX(v) FROM t)") == [("3",)]
+        assert s.must_query("SELECT id FROM t WHERE id IN (SELECT id FROM t WHERE v >= 20) ORDER BY id") == [("2",), ("3",)]
+
+    def test_derived_table(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t VALUES (1,10),(2,20)")
+        got = s.must_query("SELECT x + 1 FROM (SELECT v AS x FROM t) d WHERE x > 10")
+        assert got == [("21",)]
+
+    def test_case_expr(self, lineitem):
+        got = lineitem.must_query(
+            "SELECT l_orderkey, CASE WHEN l_quantity > 40 THEN 'big' ELSE 'small' END FROM lineitem WHERE l_orderkey = 3 ORDER BY l_quantity"
+        )
+        assert got == [("3", "big"), ("3", "big")]
+
+
+class TestDDL:
+    def test_show(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v VARCHAR(10))")
+        assert ("t",) in s.must_query("SHOW TABLES")
+        cols = s.must_query("SHOW COLUMNS FROM t")
+        assert cols[0][0] == "id" and cols[0][3] == "PRI"
+        sc = s.must_query("SHOW CREATE TABLE t")
+        assert "CREATE TABLE `t`" in sc[0][1]
+
+    def test_drop_truncate(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY)")
+        s.execute("INSERT INTO t VALUES (1)")
+        s.execute("TRUNCATE TABLE t")
+        assert s.must_query("SELECT COUNT(*) FROM t") == [("0",)]
+        s.execute("DROP TABLE t")
+        with pytest.raises(UnknownTable):
+            s.execute("SELECT * FROM t")
+        s.execute("DROP TABLE IF EXISTS t")
+
+    def test_alter(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY)")
+        s.execute("INSERT INTO t VALUES (1)")
+        s.execute("ALTER TABLE t ADD COLUMN v INT DEFAULT 7")
+        assert s.must_query("SELECT v FROM t") == [("7",)]
+        s.execute("ALTER TABLE t ADD INDEX iv (v)")
+        s.execute("ALTER TABLE t DROP INDEX iv")
+        s.execute("ALTER TABLE t RENAME TO t2")
+        assert s.must_query("SELECT id FROM t2") == [("1",)]
+
+    def test_create_index_unique_violation(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t VALUES (1, 5), (2, 5)")
+        with pytest.raises(DuplicateEntry):
+            s.execute("CREATE UNIQUE INDEX uv ON t (v)")
+
+    def test_explain(self, lineitem):
+        rows = lineitem.must_query("EXPLAIN SELECT SUM(l_quantity) FROM lineitem WHERE l_discount > 0.05")
+        text = "\n".join(r[0] for r in rows)
+        assert "DataSource" in text and "pushed" in text
+
+
+class TestEngines:
+    """TPU (virtual-CPU here) engine must agree with the host engine."""
+
+    QUERIES = [
+        "SELECT SUM(l_extendedprice * l_discount) FROM lineitem WHERE l_discount >= 0.03",
+        "SELECT l_returnflag, l_linestatus, SUM(l_quantity), COUNT(*) FROM lineitem GROUP BY l_returnflag, l_linestatus ORDER BY 1, 2",
+        "SELECT COUNT(*) FROM lineitem WHERE l_returnflag = 'N'",
+        "SELECT MIN(l_extendedprice), MAX(l_extendedprice) FROM lineitem",
+        "SELECT l_orderkey FROM lineitem ORDER BY l_extendedprice DESC LIMIT 3",
+        "SELECT AVG(l_tax) FROM lineitem WHERE l_returnflag IN ('N', 'R')",
+    ]
+
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_engine_parity(self, lineitem, q):
+        lineitem.vars["tidb_cop_engine"] = "host"
+        host = lineitem.must_query(q)
+        lineitem.vars["tidb_cop_engine"] = "tpu"
+        tpu = lineitem.must_query(q)
+        assert host == tpu
+        assert lineitem.cop.tpu.fallbacks == 0, "tpu engine fell back to host"

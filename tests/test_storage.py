@@ -1,0 +1,163 @@
+"""Storage/MVCC/2PC tests (ref: unistore tikv tests, store/tikv tests)."""
+
+import pytest
+
+from tidb_tpu.errors import LockedError, WriteConflict, TxnAborted
+from tidb_tpu.storage import MemKV, MVCCStore, Storage, RegionMap
+from tidb_tpu.storage.mvcc import Mutation, OP_PUT, OP_DEL
+
+
+class TestMemKV:
+    def test_basic(self):
+        kv = MemKV()
+        kv.put(b"b", b"2")
+        kv.put(b"a", b"1")
+        kv.put(b"c", b"3")
+        assert kv.get(b"b") == b"2"
+        assert [k for k, _ in kv.scan(b"a", b"c")] == [b"a", b"b"]
+        kv.delete(b"b")
+        assert kv.get(b"b") is None
+        assert len(kv) == 2
+
+    def test_delete_range(self):
+        kv = MemKV()
+        for i in range(10):
+            kv.put(bytes([i]), b"v")
+        assert kv.delete_range(bytes([2]), bytes([5])) == 3
+        assert len(kv) == 7
+
+
+class TestMVCC:
+    def test_prewrite_commit_get(self):
+        s = Storage()
+        t1 = s.begin()
+        mv = s.mvcc
+        mv.prewrite([Mutation(OP_PUT, b"k1", b"v1")], b"k1", t1.start_ts)
+        # read while locked at a later ts raises
+        with pytest.raises(LockedError):
+            mv.get(b"k1", s.tso.next())
+        # read before lock ts sees nothing
+        assert mv.get(b"k1", t1.start_ts - 1) is None
+        cts = s.tso.next()
+        mv.commit([b"k1"], t1.start_ts, cts)
+        assert mv.get(b"k1", s.tso.next()) == b"v1"
+        assert mv.get(b"k1", cts - 1) is None
+
+    def test_write_conflict(self):
+        s = Storage()
+        t1, t2 = s.begin(), s.begin()
+        s.mvcc.prewrite([Mutation(OP_PUT, b"k", b"a")], b"k", t2.start_ts)
+        s.mvcc.commit([b"k"], t2.start_ts, s.tso.next())
+        with pytest.raises(WriteConflict):
+            s.mvcc.prewrite([Mutation(OP_PUT, b"k", b"b")], b"k", t1.start_ts)
+
+    def test_rollback_blocks_late_prewrite(self):
+        s = Storage()
+        t = s.begin()
+        s.mvcc.rollback([b"k"], t.start_ts)
+        with pytest.raises(TxnAborted):
+            s.mvcc.prewrite([Mutation(OP_PUT, b"k", b"v")], b"k", t.start_ts)
+
+    def test_delete_version(self):
+        s = Storage()
+        t1 = s.begin()
+        s.mvcc.prewrite([Mutation(OP_PUT, b"k", b"v")], b"k", t1.start_ts)
+        c1 = s.tso.next()
+        s.mvcc.commit([b"k"], t1.start_ts, c1)
+        t2 = s.begin()
+        s.mvcc.prewrite([Mutation(OP_DEL, b"k")], b"k", t2.start_ts)
+        c2 = s.tso.next()
+        s.mvcc.commit([b"k"], t2.start_ts, c2)
+        assert s.mvcc.get(b"k", s.tso.next()) is None
+        assert s.mvcc.get(b"k", c2 - 1) == b"v"
+
+    def test_scan_versions(self):
+        s = Storage()
+        for i in range(5):
+            t = s.begin()
+            s.mvcc.prewrite([Mutation(OP_PUT, b"k%d" % i, b"v%d" % i)], b"k%d" % i, t.start_ts)
+            s.mvcc.commit([b"k%d" % i], t.start_ts, s.tso.next())
+        # delete k2
+        t = s.begin()
+        s.mvcc.prewrite([Mutation(OP_DEL, b"k2")], b"k2", t.start_ts)
+        s.mvcc.commit([b"k2"], t.start_ts, s.tso.next())
+        got = s.mvcc.scan(b"k0", b"k9", s.tso.next())
+        assert [k for k, _ in got] == [b"k0", b"k1", b"k3", b"k4"]
+        assert got[0][1] == b"v0"
+
+
+class TestTxn:
+    def test_txn_commit_visibility(self):
+        s = Storage()
+        t1 = s.begin()
+        t1.put(b"a", b"1")
+        t1.put(b"b", b"2")
+        assert t1.get(b"a") == b"1"  # own write
+        t2 = s.begin()
+        t1.commit()
+        # t2 started before t1 committed -> does not see it
+        assert t2.get(b"a") is None
+        t3 = s.begin()
+        assert t3.get(b"a") == b"1"
+
+    def test_optimistic_conflict(self):
+        s = Storage()
+        t1, t2 = s.begin(), s.begin()
+        t1.put(b"k", b"from-t1")
+        t2.put(b"k", b"from-t2")
+        t2.commit()
+        with pytest.raises((WriteConflict, TxnAborted)):
+            t1.commit()
+        assert s.snapshot().get(b"k") == b"from-t2"
+
+    def test_delete_and_scan_membuf_merge(self):
+        s = Storage()
+        t = s.begin()
+        t.put(b"a", b"1")
+        t.put(b"c", b"3")
+        t.commit()
+        t2 = s.begin()
+        t2.delete(b"a")
+        t2.put(b"b", b"2")
+        got = t2.scan(b"a", b"z")
+        assert [k for k, _ in got] == [b"b", b"c"]
+        t2.commit()
+        assert [k for k, _ in s.begin().scan(b"a", b"z")] == [b"b", b"c"]
+
+    def test_resolve_crashed_txn(self):
+        """A lock left by a 'crashed' txn is resolved by readers after TTL."""
+        s = Storage()
+        t = s.begin()
+        s.mvcc.prewrite([Mutation(OP_PUT, b"k", b"v")], b"k", t.start_ts, ttl_ms=0)
+        snap = s.snapshot()
+        assert snap.get(b"k") is None  # resolves (rolls back) the dead lock
+
+    def test_gc(self):
+        s = Storage()
+        for i in range(3):
+            t = s.begin()
+            t.put(b"k", b"v%d" % i)
+            t.commit()
+        sp = s.tso.next()
+        removed = s.gc(sp)
+        assert removed > 0
+        assert s.snapshot().get(b"k") == b"v2"
+
+
+class TestRegions:
+    def test_split_and_locate(self):
+        rm = RegionMap()
+        rm.split(b"m")
+        assert rm.locate(b"a").id == 1
+        r2 = rm.locate(b"z")
+        assert r2.start == b"m"
+        rm.split_many([b"f", b"t"])
+        assert len(rm.regions) == 4
+
+    def test_split_ranges(self):
+        rm = RegionMap()
+        rm.split_many([b"d", b"m", b"t"])
+        parts = rm.split_ranges(b"b", b"p")
+        assert [(s, e) for _, s, e in parts] == [(b"b", b"d"), (b"d", b"m"), (b"m", b"p")]
+        whole = rm.split_ranges(b"", b"")
+        assert len(whole) == 4

@@ -1,0 +1,2 @@
+from .schema import ColumnInfo, IndexInfo, TableInfo, DBInfo, InfoSchema
+from .meta import Meta

@@ -1,0 +1,87 @@
+"""Meta KV layout (ref: meta/meta.go + structure/ — fresh key design).
+
+All schema metadata lives in the same transactional KV as table data, under
+the b'm' prefix (sorts before all b't...' record keys):
+
+  m:nextid           → global id allocator counter
+  m:schema_version   → monotonically increasing schema version
+  m:db:<name>        → DBInfo json
+  m:tbl:<id>         → TableInfo json
+
+Every DDL runs inside a normal 2PC txn over these keys, so concurrent DDL
+conflicts surface as WriteConflict and retry — a deliberately simpler
+model than the reference's async job queues (ddl/ddl_worker.go), kept
+compatible in behavior for the single-coordinator case; the online
+state-machine lives in ddl.py above this layer.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .schema import DBInfo, TableInfo
+
+K_NEXT_ID = b"m:nextid"
+K_SCHEMA_VER = b"m:schema_version"
+P_DB = b"m:db:"
+P_TBL = b"m:tbl:"
+
+
+class Meta:
+    """Meta accessor bound to one transaction."""
+
+    def __init__(self, txn):
+        self.txn = txn
+
+    # --- id allocation -----------------------------------------------------
+
+    def alloc_id(self, n: int = 1) -> int:
+        cur = int(self.txn.get(K_NEXT_ID) or b"100")
+        self.txn.put(K_NEXT_ID, str(cur + n).encode())
+        return cur
+
+    # --- schema version ----------------------------------------------------
+
+    def schema_version(self) -> int:
+        return int(self.txn.get(K_SCHEMA_VER) or b"0")
+
+    def bump_schema_version(self) -> int:
+        v = self.schema_version() + 1
+        self.txn.put(K_SCHEMA_VER, str(v).encode())
+        return v
+
+    # --- databases ---------------------------------------------------------
+
+    def db(self, name: str) -> DBInfo | None:
+        raw = self.txn.get(P_DB + name.lower().encode())
+        return DBInfo.from_json(json.loads(raw)) if raw else None
+
+    def put_db(self, db: DBInfo) -> None:
+        self.txn.put(P_DB + db.name.lower().encode(), json.dumps(db.to_json()).encode())
+
+    def drop_db(self, name: str) -> None:
+        self.txn.delete(P_DB + name.lower().encode())
+
+    def list_dbs(self) -> list[DBInfo]:
+        out = []
+        for _, v in self.txn.scan(P_DB, P_DB + b"\xff"):
+            out.append(DBInfo.from_json(json.loads(v)))
+        return out
+
+    # --- tables ------------------------------------------------------------
+
+    def table(self, tid: int) -> TableInfo | None:
+        raw = self.txn.get(P_TBL + str(tid).encode())
+        return TableInfo.from_json(json.loads(raw)) if raw else None
+
+    def put_table(self, t: TableInfo) -> None:
+        self.txn.put(P_TBL + str(t.id).encode(), json.dumps(t.to_json()).encode())
+
+    def drop_table(self, tid: int) -> None:
+        self.txn.delete(P_TBL + str(tid).encode())
+
+    def list_tables(self) -> list[TableInfo]:
+        out = []
+        for _, v in self.txn.scan(P_TBL, P_TBL + b"\xff"):
+            out.append(TableInfo.from_json(json.loads(v)))
+        return out

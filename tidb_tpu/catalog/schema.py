@@ -1,0 +1,171 @@
+"""Schema objects + InfoSchema cache (ref: infoschema/, parser/model).
+
+TableInfo/ColumnInfo/IndexInfo serialize to JSON into the meta KV layout
+(meta.py) and are cached per schema version in InfoSchema.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ..errors import UnknownColumn, UnknownTable, UnknownDatabase
+from ..mysqltypes.field_type import FieldType, TypeCode
+
+
+@dataclass
+class ColumnInfo:
+    id: int
+    name: str
+    ft: FieldType
+    offset: int
+    default: object = None  # rendered default (python value) or None
+    has_default: bool = False
+    auto_increment: bool = False
+    hidden: bool = False
+    comment: str = ""
+
+    def to_json(self):
+        return {
+            "id": self.id,
+            "name": self.name,
+            "tp": int(self.ft.tp),
+            "flag": self.ft.flag,
+            "flen": self.ft.flen,
+            "decimal": self.ft.decimal,
+            "elems": list(self.ft.elems),
+            "offset": self.offset,
+            "default": self.default,
+            "has_default": self.has_default,
+            "auto_increment": self.auto_increment,
+            "hidden": self.hidden,
+            "comment": self.comment,
+        }
+
+    @staticmethod
+    def from_json(d):
+        ft = FieldType(TypeCode(d["tp"]), d["flag"], d["flen"], d["decimal"], elems=tuple(d.get("elems", ())))
+        return ColumnInfo(
+            d["id"], d["name"], ft, d["offset"], d.get("default"), d.get("has_default", False),
+            d.get("auto_increment", False), d.get("hidden", False), d.get("comment", ""),
+        )
+
+
+@dataclass
+class IndexInfo:
+    id: int
+    name: str
+    col_offsets: list[int]
+    unique: bool = False
+    primary: bool = False
+    state: str = "public"  # online DDL states: delete_only/write_only/write_reorg/public
+
+    def to_json(self):
+        return {"id": self.id, "name": self.name, "cols": self.col_offsets, "unique": self.unique, "primary": self.primary, "state": self.state}
+
+    @staticmethod
+    def from_json(d):
+        return IndexInfo(d["id"], d["name"], d["cols"], d["unique"], d["primary"], d.get("state", "public"))
+
+
+@dataclass
+class TableInfo:
+    id: int
+    name: str
+    columns: list[ColumnInfo]
+    indexes: list[IndexInfo] = field(default_factory=list)
+    pk_is_handle: bool = False  # clustered single-int PK == row handle
+    auto_inc_id: int = 1
+    state: str = "public"
+    db_name: str = ""
+
+    def col_by_name(self, name: str) -> ColumnInfo:
+        lname = name.lower()
+        for c in self.columns:
+            if c.name.lower() == lname:
+                return c
+        raise UnknownColumn(f"unknown column {name!r} in {self.name!r}")
+
+    def visible_columns(self) -> list[ColumnInfo]:
+        return [c for c in self.columns if not c.hidden]
+
+    def handle_col(self) -> ColumnInfo | None:
+        if self.pk_is_handle:
+            pk = next((i for i in self.indexes if i.primary), None)
+            if pk:
+                return self.columns[pk.col_offsets[0]]
+        return next((c for c in self.columns if c.name == "_tidb_rowid"), None)
+
+    def index_by_name(self, name: str) -> IndexInfo | None:
+        lname = name.lower()
+        return next((i for i in self.indexes if i.name.lower() == lname), None)
+
+    def to_json(self):
+        return {
+            "id": self.id,
+            "name": self.name,
+            "columns": [c.to_json() for c in self.columns],
+            "indexes": [i.to_json() for i in self.indexes],
+            "pk_is_handle": self.pk_is_handle,
+            "auto_inc_id": self.auto_inc_id,
+            "state": self.state,
+            "db_name": self.db_name,
+        }
+
+    @staticmethod
+    def from_json(d):
+        return TableInfo(
+            d["id"], d["name"],
+            [ColumnInfo.from_json(c) for c in d["columns"]],
+            [IndexInfo.from_json(i) for i in d["indexes"]],
+            d["pk_is_handle"], d.get("auto_inc_id", 1), d.get("state", "public"), d.get("db_name", ""),
+        )
+
+
+@dataclass
+class DBInfo:
+    name: str
+    table_ids: list[int] = field(default_factory=list)
+
+    def to_json(self):
+        return {"name": self.name, "table_ids": self.table_ids}
+
+    @staticmethod
+    def from_json(d):
+        return DBInfo(d["name"], d["table_ids"])
+
+
+class InfoSchema:
+    """Immutable snapshot of the full schema at one version
+    (ref: infoschema/infoschema.go)."""
+
+    def __init__(self, version: int, dbs: dict[str, DBInfo], tables: dict[int, TableInfo]):
+        self.version = version
+        self.dbs = {k.lower(): v for k, v in dbs.items()}
+        self.tables = tables
+        self._by_name: dict[tuple[str, str], TableInfo] = {}
+        for t in tables.values():
+            self._by_name[(t.db_name.lower(), t.name.lower())] = t
+
+    def db_names(self) -> list[str]:
+        return sorted(self.dbs)
+
+    def has_db(self, db: str) -> bool:
+        return db.lower() in self.dbs
+
+    def table(self, db: str, name: str) -> TableInfo:
+        t = self._by_name.get((db.lower(), name.lower()))
+        if t is None:
+            if not self.has_db(db):
+                raise UnknownDatabase(f"unknown database {db!r}")
+            raise UnknownTable(f"table {db}.{name} doesn't exist")
+        return t
+
+    def table_by_id(self, tid: int) -> TableInfo | None:
+        return self.tables.get(tid)
+
+    def tables_in_db(self, db: str) -> list[TableInfo]:
+        d = self.dbs.get(db.lower())
+        if d is None:
+            raise UnknownDatabase(f"unknown database {db!r}")
+        return sorted((self.tables[t] for t in d.table_ids if t in self.tables), key=lambda t: t.name)

@@ -1,0 +1,2 @@
+from .dag import DAGRequest, ScanNode, SelectionNode, AggNode, TopNNode, LimitNode
+from .client import CopClient

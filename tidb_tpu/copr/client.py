@@ -1,0 +1,108 @@
+"""Coprocessor client (ref: store/copr/coprocessor.go CopClient.Send:71,
+buildCopTasks:151 — the kv.Client seam SURVEY §5.8 names as the boundary
+where the TPU backend registers).
+
+Splits key ranges along region boundaries into cop tasks, dispatches each
+to an engine (TPU-fused program or host-vectorized fallback), and merges
+result chunks. Engine selection is per-session (`tidb_cop_engine` sysvar:
+'tpu' | 'host' | 'auto').
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..chunk.chunk import Chunk
+from ..catalog.schema import TableInfo
+from ..codec import tablecodec
+from .dag import DAGRequest
+from .host_engine import execute_dag_host
+from .tilecache import TileCache
+
+
+@dataclass
+class CopTask:
+    region_id: int
+    start: bytes
+    end: bytes
+
+
+class CopClient:
+    def __init__(self, storage):
+        self.storage = storage
+        self.tiles = TileCache(storage)
+        self._tpu = None
+        self.stats = {"tasks": 0, "tpu_tasks": 0, "host_tasks": 0}
+
+    @property
+    def tpu(self):
+        if self._tpu is None:
+            from .tpu_engine import TPUEngine
+
+            self._tpu = TPUEngine()
+        return self._tpu
+
+    @staticmethod
+    def _txn_dirty(txn, table_id: int) -> bool:
+        prefix = tablecodec.record_prefix(table_id)
+        return any(k.startswith(prefix) for k in txn.membuf)
+
+    def build_tasks(self, table_id: int, ranges: list[tuple[bytes, bytes]]) -> list[CopTask]:
+        """Region-align ranges (ref: buildCopTasks)."""
+        tasks = []
+        for start, end in ranges:
+            for region, s, e in self.storage.regions.split_ranges(start, end):
+                tasks.append(CopTask(region.id, s, e))
+        return tasks
+
+    def send(
+        self,
+        table: TableInfo,
+        dag: DAGRequest,
+        ranges: list[tuple[bytes, bytes]] | None,
+        read_ts: int,
+        engine: str = "auto",
+        txn=None,
+    ) -> list[Chunk]:
+        """Execute the DAG over all tasks; returns per-task partial chunks
+        (the selectResult stream analog — caller merges/finalizes).
+
+        If `txn` carries uncommitted writes for this table, the task batch
+        is built from the txn's merged view instead of the tile cache
+        (the UnionScan semantic, ref: executor/union_scan.go) — engines
+        run over it uncached."""
+        if ranges is None:
+            prefix = tablecodec.record_prefix(table.id)
+            ranges = [(prefix, prefix + b"\xff")]
+        tasks = self.build_tasks(table.id, ranges)
+        dirty = txn is not None and self._txn_dirty(txn, table.id)
+        out = []
+        for t in tasks:
+            self.stats["tasks"] += 1
+            if dirty:
+                from .tilecache import decode_rows_to_batch
+
+                kvs = [
+                    (k, v)
+                    for k, v in txn.scan(t.start, t.end)
+                    if tablecodec.is_record_key(k)
+                ]
+                batch = decode_rows_to_batch(table, kvs, (-1, 0))
+            else:
+                batch = self.tiles.get_batch(table, t.start, t.end, read_ts)
+            if batch.n_rows == 0:
+                continue
+            chunk = None
+            if engine in ("tpu", "auto"):
+                try:
+                    chunk = self.tpu.execute(dag, batch)
+                    self.stats["tpu_tasks"] += 1
+                except Exception:
+                    if engine == "tpu":
+                        raise
+                    chunk = None
+            if chunk is None:
+                chunk = execute_dag_host(dag, batch)
+                self.stats["host_tasks"] += 1
+            out.append(chunk)
+        return out

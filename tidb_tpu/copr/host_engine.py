@@ -1,0 +1,199 @@
+"""Host (numpy-vectorized) coprocessor engine — the correctness oracle and
+CPU fallback (ref behavior: unistore cophandler/closure_exec.go's fused
+scan→sel→agg/topN/limit single pass, here over cached columnar batches).
+
+Also serves as the bench baseline the TPU engine is compared against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..chunk.chunk import Chunk, Column, col_numpy_dtype, VARLEN
+from ..expr.aggregation import AggDesc, MODE_PARTIAL
+from ..expr.expression import Expression
+from ..mysqltypes.field_type import FieldType
+from .dag import DAGRequest
+from .tilecache import ColumnBatch
+
+
+def _eval_mask(conds: list[Expression], chunk: Chunk) -> np.ndarray:
+    mask = np.ones(chunk.num_rows, dtype=bool)
+    for c in conds:
+        d, v = c.eval(chunk)
+        mask &= v & (d != 0)
+    return mask
+
+
+def _group_codes(keys: list[tuple[np.ndarray, np.ndarray]]):
+    """Rows → dense group ids via lexicographic unique over key columns."""
+    n = len(keys[0][0])
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), []
+    arrays = []
+    for d, v in keys:
+        if d.dtype == object:
+            # factorize the object lane; validity lane keeps NULL distinct
+            _, inv = np.unique(np.where(v, d, "").astype("U"), return_inverse=True)
+            arrays.append(inv.astype(np.int64))
+        else:
+            arrays.append(d.astype(np.int64))
+        arrays.append(v.astype(np.int64))
+    stacked = np.stack(arrays, axis=0)
+    _, first_idx, inv = np.unique(stacked, axis=1, return_index=True, return_inverse=True)
+    return inv.astype(np.int64), first_idx
+
+
+def execute_dag_host(dag: DAGRequest, batch: ColumnBatch) -> Chunk:
+    chunk = batch.to_chunk(dag.scan.col_offsets)
+    mask = None
+    if dag.selection is not None:
+        mask = _eval_mask(dag.selection.conds, chunk)
+        if dag.agg is None:
+            chunk = chunk.filter(mask)
+            mask = None
+
+    if dag.agg is not None:
+        return _exec_agg(dag, chunk, mask)
+
+    if dag.topn is not None:
+        keys = []
+        for e, desc in dag.topn.by:
+            d, v = e.eval(chunk)
+            keys.append((d, v, desc))
+        order = _lex_argsort(keys, chunk.num_rows)
+        order = order[: dag.topn.n]
+        chunk = chunk.take(order)
+    if dag.limit is not None:
+        chunk = chunk.slice(0, min(dag.limit.n, chunk.num_rows))
+    return chunk
+
+
+def _lex_argsort(keys, n: int) -> np.ndarray:
+    """Stable lexicographic argsort; NULLs first (MySQL), desc per key."""
+    order = np.arange(n)
+    for d, v, desc in reversed(keys):
+        if d.dtype == object:
+            strs = np.where(v, d, "").astype("U")
+            idx = np.argsort(strs[order], kind="stable")
+            keyvals = None
+        else:
+            x = d.astype(np.float64) if d.dtype != np.float64 else d
+            idx = np.argsort(x[order], kind="stable")
+        if desc:
+            idx = idx[::-1]
+        order = order[idx]
+        # NULLs first asc / last desc
+        nulls = ~v[order]
+        if desc:
+            order = np.concatenate([order[~nulls], order[nulls]])
+        else:
+            order = np.concatenate([order[nulls], order[~nulls]])
+    return order
+
+
+def _exec_agg(dag: DAGRequest, chunk: Chunk, mask: np.ndarray | None) -> Chunk:
+    n = chunk.num_rows
+    if mask is None:
+        mask = np.ones(n, dtype=bool)
+    out_fts = dag.output_types()
+    gb = dag.agg.group_by
+    if gb:
+        keyvals = [e.eval(chunk) for e in gb]
+        codes, _ = _group_codes(keyvals)
+        # restrict to selected rows
+        sel_codes = codes[mask]
+        uniq, inv = np.unique(sel_codes, return_inverse=True)
+        G = len(uniq)
+        # first row index per group for key output
+        sel_idx = np.nonzero(mask)[0]
+        first_row = np.zeros(G, dtype=np.int64)
+        first_row[inv[::-1]] = sel_idx[::-1]  # keep first occurrence
+    else:
+        G = 1
+        inv = np.zeros(int(mask.sum()), dtype=np.int64)
+        first_row = np.zeros(1, dtype=np.int64)
+
+    cols: list[Column] = []
+    oi = 0
+    for e in gb:
+        d, v = e.eval(chunk)
+        cols.append(Column(out_fts[oi], d[first_row], v[first_row]))
+        oi += 1
+    for a in dag.agg.aggs:
+        for col in _agg_partial_columns(a, chunk, mask, inv, G, out_fts, oi):
+            cols.append(col)
+            oi += 1
+    return Chunk(cols)
+
+
+def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.ndarray, G: int, out_fts, oi: int):
+    """Partial-state columns for one aggregate over grouped rows."""
+    name = a.name
+    sel = np.nonzero(mask)[0]
+    if a.args:
+        d, v = a.args[0].eval(chunk)
+        dv, vv = d[sel], v[sel]
+    else:
+        dv = np.ones(len(sel), dtype=np.int64)
+        vv = np.ones(len(sel), dtype=bool)
+
+    def seg_sum(vals):
+        return np.bincount(inv, weights=vals, minlength=G)
+
+    if name == "count":
+        cnt = seg_sum(vv.astype(np.float64)).astype(np.int64)
+        yield Column(out_fts[oi], cnt, np.ones(G, dtype=bool))
+        return
+    if name in ("sum", "avg"):
+        ft = out_fts[oi]
+        if ft.is_float():
+            vals = np.where(vv, dv.astype(np.float64), 0.0)
+            s = seg_sum(vals)
+        else:
+            # exact: integer bincount may lose precision in float64 weights
+            # beyond 2^53 — use object-accumulate only when needed
+            vals = np.where(vv, dv.astype(np.int64), 0)
+            s = np.zeros(G, dtype=np.int64)
+            np.add.at(s, inv, vals)
+        cnt = seg_sum(vv.astype(np.float64)).astype(np.int64)
+        has = cnt > 0
+        yield Column(ft, s if not ft.is_float() else s, has)
+        if name == "avg":
+            yield Column(out_fts[oi + 1], cnt, np.ones(G, dtype=bool))
+        return
+    if name in ("min", "max"):
+        ft = out_fts[oi]
+        out_valid = np.zeros(G, dtype=bool)
+        if dv.dtype == object:
+            out = np.empty(G, dtype=object)
+            for i, g in enumerate(inv):
+                if not vv[i]:
+                    continue
+                if not out_valid[g] or (name == "min" and dv[i] < out[g]) or (name == "max" and dv[i] > out[g]):
+                    out[g] = dv[i]
+                    out_valid[g] = True
+        else:
+            init = np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
+            if dv.dtype == np.float64:
+                init = np.inf if name == "min" else -np.inf
+            out = np.full(G, init, dtype=dv.dtype)
+            fn = np.minimum if name == "min" else np.maximum
+            fn.at(out, inv, np.where(vv, dv, init))
+            np.bitwise_or.at(out_valid, inv, vv)
+        yield Column(ft, out, out_valid)
+        return
+    if name == "first_row":
+        ft = out_fts[oi]
+        out_valid = np.zeros(G, dtype=bool)
+        dt = col_numpy_dtype(ft)
+        out = np.empty(G, dtype=object) if dt is VARLEN else np.zeros(G, dtype=dt)
+        seen = np.zeros(G, dtype=bool)
+        for i, g in enumerate(inv):
+            if not seen[g]:
+                seen[g] = True
+                out[g] = dv[i]
+                out_valid[g] = vv[i]
+        yield Column(ft, out, out_valid)
+        return
+    raise NotImplementedError(f"aggregate {name} in cop")

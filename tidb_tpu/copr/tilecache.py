@@ -1,0 +1,110 @@
+"""Columnar tile cache — the TiFlash-replica analog (SURVEY §2.12 TiFlash
+row: "columnar replica + MPP engine"; here the columnar replica is a
+lazily-built, version-tagged cache of decoded column batches per
+(table, region), reused across queries so the scan hot path never touches
+row decode).
+
+Invalidation: `Storage.bump_version` increments a per-table counter on
+every committed write; a batch built at an older version is rebuilt on
+next access. Uncommitted reads (txn membuffer) bypass the cache: the cop client
+builds the task batch from the txn's merged view (client.py send).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..chunk.chunk import Chunk, Column, col_numpy_dtype, VARLEN
+from ..codec import tablecodec
+from ..codec.row import decode_row
+from ..catalog.schema import TableInfo
+from ..mysqltypes.datum import Datum
+
+
+@dataclass
+class ColumnBatch:
+    """All rows of one (table, region) decoded into dense numpy columns."""
+
+    table: TableInfo
+    handles: np.ndarray  # int64 row handles
+    data: list[np.ndarray]  # per table column (offset order)
+    valid: list[np.ndarray]
+    version: tuple | int
+    start: bytes = b""
+    end: bytes = b""
+    min_valid_ts: int = 0  # last table-commit ts at build time
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.handles)
+
+    def to_chunk(self, col_offsets: list[int]) -> Chunk:
+        cols = []
+        for off in col_offsets:
+            ft = self.table.columns[off].ft
+            cols.append(Column(ft, self.data[off], self.valid[off]))
+        return Chunk(cols)
+
+
+def decode_rows_to_batch(table: TableInfo, kvs: list[tuple[bytes, bytes]], version: int) -> ColumnBatch:
+    """Row-format KV pairs → dense columnar batch (the once-per-version
+    decode; ref: rowcodec ChunkDecoder decoding straight into chunks)."""
+    n = len(kvs)
+    handles = np.zeros(n, dtype=np.int64)
+    chk = Chunk.empty([c.ft for c in table.columns], n)
+    cols = chk.columns
+    defaults = [c.default for c in table.columns]
+    from ..table.table import datum_from_default
+
+    for i, (k, v) in enumerate(kvs):
+        handles[i] = tablecodec.decode_record_handle(k)
+        by_id = decode_row(v)
+        for off, c in enumerate(table.columns):
+            d = by_id.get(c.id)
+            if d is None:
+                if c.hidden and c.name == "_tidb_rowid":
+                    d = Datum.i(handles[i])
+                else:
+                    d = datum_from_default(c)
+            cols[off].set_datum(i, d)
+    return ColumnBatch(table, handles, [c.data for c in cols], [c.valid for c in cols], version)
+
+
+class TileCache:
+    def __init__(self, storage):
+        self.storage = storage
+        self._cache: dict[tuple[int, bytes], ColumnBatch] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get_batch(self, table: TableInfo, start: bytes, end: bytes, read_ts: int) -> ColumnBatch:
+        """Snapshot-correct cache: a batch built when the table's last
+        commit was at `last_commit_ts` is valid for any read_ts ≥ that
+        commit while the version counter is unchanged. Reads BELOW the
+        last commit (historic snapshots) always rebuild, uncached."""
+        ver, last_commit_ts = self.storage.data_version(tablecodec.table_prefix(table.id))
+        key = (table.id, start)
+        cached = self._cache.get(key)
+        if (
+            cached is not None
+            and cached.version == ver
+            and cached.end == end
+            and read_ts >= cached.min_valid_ts
+        ):
+            self.hits += 1
+            return cached
+        self.misses += 1
+        snap = self.storage.snapshot(read_ts)
+        kvs = snap.scan(start, end)
+        batch = decode_rows_to_batch(table, kvs, ver)
+        batch.start, batch.end = start, end
+        batch.min_valid_ts = last_commit_ts
+        if read_ts >= last_commit_ts:
+            self._cache[key] = batch
+        return batch
+
+    def invalidate_table(self, table_id: int) -> None:
+        for key in [k for k in self._cache if k[0] == table_id]:
+            del self._cache[key]

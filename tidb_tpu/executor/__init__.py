@@ -1,0 +1,1 @@
+from .executors import build_executor, ExecContext, drain

@@ -86,6 +86,21 @@ def format_time(packed: int, is_date: bool = False, fsp: int = 0) -> str:
     return base
 
 
+def number_to_datetime(v: int) -> int | None:
+    """MySQL numeric datetime forms: YYYYMMDD or YYYYMMDDHHMMSS
+    (ref: types/time.go ParseDatetimeFromNum)."""
+    if v <= 0:
+        return 0 if v == 0 else None
+    s = str(v)
+    if len(s) <= 8:
+        s = s.zfill(8)
+        return parse_datetime(f"{s[:4]}-{s[4:6]}-{s[6:8]}")
+    if len(s) <= 14:
+        s = s.zfill(14)
+        return parse_datetime(f"{s[:4]}-{s[4:6]}-{s[6:8]} {s[8:10]}:{s[10:12]}:{s[12:14]}")
+    return None
+
+
 def time_year(packed: int) -> int:
     return packed // (_US * 60 * 60 * 24 * 32 * 13)
 

@@ -1,0 +1,2 @@
+from .parser import parse, parse_one, ParseError
+from . import ast

@@ -1,0 +1,365 @@
+"""SQL AST nodes (ref: pingcap/parser ast package — fresh design).
+
+Nodes are plain dataclasses; the planner walks them. Every expression node
+carries no type — typing happens at plan-build (name resolution) time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# --- expressions -----------------------------------------------------------
+
+
+@dataclass
+class Lit:
+    """Literal: int, Dec, float, str, bytes, None (NULL), bool."""
+
+    value: Any
+    kind: str  # 'int' | 'dec' | 'float' | 'str' | 'hex' | 'null' | 'bool'
+
+
+@dataclass
+class Name:
+    """Column reference: [db.][table.]column; '*' handled by Star."""
+
+    parts: tuple  # (col,) or (tbl, col) or (db, tbl, col)
+
+    @property
+    def column(self) -> str:
+        return self.parts[-1]
+
+    @property
+    def table(self) -> str | None:
+        return self.parts[-2] if len(self.parts) >= 2 else None
+
+
+@dataclass
+class Star:
+    table: str | None = None  # t.* keeps the qualifier
+
+
+@dataclass
+class Call:
+    """Function call, incl. operators desugared to calls (plus, eq, ...)."""
+
+    name: str
+    args: list
+    distinct: bool = False  # COUNT(DISTINCT x)
+
+
+@dataclass
+class CaseWhen:
+    operand: Any  # CASE <operand> WHEN ... or None for searched CASE
+    whens: list  # [(cond, result), ...]
+    else_: Any = None
+
+
+@dataclass
+class Cast:
+    expr: Any
+    type_name: str
+    type_args: tuple = ()
+    unsigned: bool = False
+
+
+@dataclass
+class SubqueryExpr:
+    select: "Select"
+    modifier: str = "scalar"  # 'scalar' | 'exists' | 'in' | 'any' | 'all'
+
+
+@dataclass
+class Param:
+    """Prepared-statement placeholder '?' (ordinal)."""
+
+    index: int
+
+
+@dataclass
+class Default:
+    """DEFAULT keyword in INSERT/UPDATE value position."""
+
+
+@dataclass
+class Interval:
+    expr: Any
+    unit: str  # 'day' | 'month' | 'year' | ...
+
+
+# --- table references ------------------------------------------------------
+
+
+@dataclass
+class TableName:
+    db: str | None
+    name: str
+    alias: str | None = None
+    index_hints: list = field(default_factory=list)
+
+
+@dataclass
+class SubqueryTable:
+    select: "Select"
+    alias: str
+
+
+@dataclass
+class Join:
+    left: Any
+    right: Any
+    kind: str  # 'inner' | 'left' | 'right' | 'cross'
+    on: Any = None
+    using: list = field(default_factory=list)
+
+
+# --- statements ------------------------------------------------------------
+
+
+@dataclass
+class SelectField:
+    expr: Any
+    alias: str | None = None
+
+
+@dataclass
+class ByItem:
+    expr: Any
+    desc: bool = False
+
+
+@dataclass
+class Select:
+    fields: list  # [SelectField | Star]
+    from_: Any = None  # TableName | Join | SubqueryTable | None
+    where: Any = None
+    group_by: list = field(default_factory=list)
+    having: Any = None
+    order_by: list = field(default_factory=list)  # [ByItem]
+    limit: Any = None  # int expr or None
+    offset: Any = None
+    distinct: bool = False
+    for_update: bool = False
+    lock_in_share: bool = False
+    windows: list = field(default_factory=list)
+    setop: Any = None  # ('union'|'union all'|..., Select) chained
+
+
+@dataclass
+class SetOpSelect:
+    """UNION / UNION ALL / EXCEPT / INTERSECT chain."""
+
+    selects: list  # [Select]
+    ops: list  # between selects: 'union' | 'union_all' | ...
+    order_by: list = field(default_factory=list)
+    limit: Any = None
+    offset: Any = None
+
+
+@dataclass
+class Insert:
+    table: TableName
+    columns: list  # [str] or []
+    values: list  # [[expr,...], ...]
+    select: Any = None  # INSERT ... SELECT
+    on_dup: list = field(default_factory=list)  # [(col, expr)]
+    replace: bool = False
+    ignore: bool = False
+
+
+@dataclass
+class Update:
+    table: Any  # TableName or Join
+    sets: list  # [(Name, expr)]
+    where: Any = None
+    order_by: list = field(default_factory=list)
+    limit: Any = None
+
+
+@dataclass
+class Delete:
+    table: Any
+    where: Any = None
+    order_by: list = field(default_factory=list)
+    limit: Any = None
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    type_args: tuple = ()
+    unsigned: bool = False
+    not_null: bool = False
+    default: Any = None
+    auto_increment: bool = False
+    primary_key: bool = False
+    unique: bool = False
+    comment: str = ""
+    elems: tuple = ()
+
+
+@dataclass
+class IndexDef:
+    name: str
+    columns: list  # [str]
+    unique: bool = False
+    primary: bool = False
+
+
+@dataclass
+class CreateTable:
+    table: TableName
+    columns: list  # [ColumnDef]
+    indexes: list  # [IndexDef]
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class DropTable:
+    tables: list
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTable:
+    table: TableName
+
+
+@dataclass
+class CreateIndex:
+    index: IndexDef
+    table: TableName
+
+
+@dataclass
+class DropIndex:
+    name: str
+    table: TableName
+
+
+@dataclass
+class AlterTable:
+    table: TableName
+    actions: list  # [('add_column', ColumnDef) | ('drop_column', str) | ('add_index', IndexDef) | ('drop_index', str) | ('rename', TableName) | ('modify_column', ColumnDef)]
+
+
+@dataclass
+class CreateDatabase:
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabase:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class UseDB:
+    name: str
+
+
+@dataclass
+class Begin:
+    pass
+
+
+@dataclass
+class Commit:
+    pass
+
+
+@dataclass
+class Rollback:
+    pass
+
+
+@dataclass
+class SetStmt:
+    assignments: list  # [(scope, name, expr)] scope in {'session','global'}
+
+
+@dataclass
+class Show:
+    kind: str  # 'tables' | 'databases' | 'create_table' | 'variables' | 'columns' | 'index' | 'status' | 'warnings' | 'processlist'
+    target: Any = None
+    like: Any = None
+    where: Any = None
+    full: bool = False
+    global_scope: bool = False
+
+
+@dataclass
+class Explain:
+    stmt: Any
+    analyze: bool = False
+    format: str = "row"
+
+
+@dataclass
+class AnalyzeTable:
+    tables: list
+
+
+@dataclass
+class Prepare:
+    name: str
+    sql: str
+
+
+@dataclass
+class Execute:
+    name: str
+    using: list = field(default_factory=list)
+
+
+@dataclass
+class Deallocate:
+    name: str
+
+
+@dataclass
+class AdminStmt:
+    kind: str  # 'check_table' | 'show_ddl' | 'show_ddl_jobs' | 'checksum_table' | 'cancel_ddl_jobs' | 'recover_index'
+    target: Any = None
+
+
+@dataclass
+class KillStmt:
+    conn_id: int
+    query_only: bool = False
+
+
+@dataclass
+class FlushStmt:
+    what: str = ""
+
+
+@dataclass
+class LoadData:
+    path: str
+    table: TableName
+    fields_terminated: str = "\t"
+    lines_terminated: str = "\n"
+    enclosed: str = ""
+    ignore_lines: int = 0
+    columns: list = field(default_factory=list)
+
+
+@dataclass
+class SplitRegion:
+    table: TableName
+    between: tuple | None = None  # (lower expr list, upper expr list, regions int)
+    by: list = field(default_factory=list)
+
+
+@dataclass
+class BRIEStmt:
+    kind: str  # 'backup' | 'restore'
+    storage: str = ""
+    databases: list = field(default_factory=list)

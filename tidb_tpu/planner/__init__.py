@@ -1,0 +1,15 @@
+from .plans import (
+    LogicalPlan,
+    DataSource,
+    Selection,
+    Projection,
+    Aggregation,
+    Join,
+    Sort,
+    Limit,
+    Dual,
+    SetOp,
+    PlanCol,
+)
+from .builder import PlanBuilder
+from .optimizer import optimize

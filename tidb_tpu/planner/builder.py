@@ -1,0 +1,539 @@
+"""AST → logical plan with name resolution (ref: planner/core/
+logical_plan_builder.go + preprocess.go, compact redesign).
+
+Aggregate extraction follows the reference's approach: walk select/having/
+order expressions, lift aggregate calls into an Aggregation node, and
+rewrite the outer expressions to reference aggregation output columns.
+Non-aggregated bare columns under GROUP BY become first_row aggregates
+(MySQL's permissive mode, like the reference defaults).
+"""
+
+from __future__ import annotations
+
+from ..errors import AmbiguousColumn, TiDBError, UnknownColumn
+from ..expr.aggregation import AGG_FUNCS, AggDesc
+from ..expr.builtins import CAST_SIG
+from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc, make_func
+from ..mysqltypes.datum import Datum
+from ..mysqltypes.field_type import FieldType, TypeCode, ft_double, ft_longlong, ft_varchar, parse_type_name
+from ..mysqltypes.mydecimal import Dec
+from ..parser import ast
+from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, PlanCol, Projection, Selection, SetOp, Sort
+
+
+def lit_to_constant(l: ast.Lit) -> Constant:
+    v = l.value
+    if l.kind == "null":
+        return Constant(Datum.null(), FieldType(TypeCode.Null))
+    if l.kind == "int":
+        return Constant(Datum.i(v), ft_longlong())
+    if l.kind == "bool":
+        return Constant(Datum.i(1 if v else 0), ft_longlong())
+    if l.kind == "dec":
+        return Constant(Datum.d(v), FieldType(TypeCode.NewDecimal, flen=30, decimal=v.scale))
+    if l.kind == "float":
+        return Constant(Datum.f(v), ft_double())
+    if l.kind == "hex":
+        return Constant(Datum.b(v), ft_varchar(len(v)))
+    return Constant(Datum.s(v), ft_varchar(max(len(v), 1)))
+
+
+_CMP_FUNCS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "in"}
+
+
+def _refine_cmp_constants(fname: str, args: list[Expression]) -> list[Expression]:
+    """Convert string constants compared against typed columns into the
+    column's domain at plan time (ref: expression/builtin_compare.go
+    RefineComparedConstant) — exact datetime/decimal compares, and the
+    device engine sees only typed constants."""
+    if fname not in _CMP_FUNCS or not args:
+        return args
+    col = next((a for a in args if isinstance(a, ECol)), None)
+    if col is None:
+        return args
+    out = []
+    for a in args:
+        if isinstance(a, Constant) and a.value.kind == 5 and not a.value.is_null:  # K_STR
+            ft = col.ret_type
+            if ft.is_time():
+                from ..mysqltypes.coretime import parse_datetime
+
+                p = parse_datetime(a.value.val)
+                if p is not None:
+                    a = Constant(Datum.t(p), ft.clone())
+            elif ft.is_decimal() or ft.is_int():
+                d = a.value.to_dec()
+                a = Constant(Datum.d(d), FieldType(TypeCode.NewDecimal, flen=30, decimal=d.scale))
+            elif ft.is_float():
+                a = Constant(Datum.f(a.value.to_float()), ft_double())
+        out.append(a)
+    return out
+
+
+class NameScope:
+    """Resolution scope over a plan's output columns."""
+
+    def __init__(self, cols: list[PlanCol]):
+        self.cols = cols
+
+    def resolve(self, name: ast.Name) -> int:
+        col = name.column.lower()
+        tbl = (name.table or "").lower()
+        hits = [
+            i
+            for i, c in enumerate(self.cols)
+            if c.name.lower() == col and (not tbl or c.table_alias.lower() == tbl)
+        ]
+        if not hits:
+            raise UnknownColumn(f"unknown column {'.'.join(name.parts)!r}")
+        if len(hits) > 1:
+            raise AmbiguousColumn(f"column {col!r} is ambiguous")
+        return hits[0]
+
+
+class PlanBuilder:
+    """Builds logical plans; needs a catalog view + subquery executor hook."""
+
+    def __init__(self, infoschema, current_db: str, run_subquery=None):
+        self.is_ = infoschema
+        self.db = current_db
+        self.run_subquery = run_subquery  # callable(Select ast) -> list[Datum rows]
+
+    # ------------------------------------------------------------------ FROM
+
+    def build_table(self, tn: ast.TableName) -> DataSource:
+        db = tn.db or self.db
+        info = self.is_.table(db, tn.name)
+        cols = [
+            PlanCol(c.name, c.ft, tn.alias or tn.name, c.offset)
+            for c in info.columns
+            if not c.hidden
+        ]
+        return DataSource(info, tn.alias or tn.name, cols)
+
+    def build_from(self, node) -> LogicalPlan:
+        if node is None:
+            return Dual()
+        if isinstance(node, ast.TableName):
+            return self.build_table(node)
+        if isinstance(node, ast.SubqueryTable):
+            sub = self.build_select(node.select)
+            cols = [PlanCol(c.name, c.ft, node.alias) for c in sub.out_cols]
+            # re-alias through a projection barrier
+            exprs = [ECol(i, c.ft, c.name) for i, c in enumerate(sub.out_cols)]
+            return Projection(sub, exprs, cols)
+        if isinstance(node, ast.Join):
+            return self.build_join(node)
+        raise TiDBError(f"unsupported FROM clause {type(node).__name__}")
+
+    def build_join(self, j: ast.Join) -> LogicalPlan:
+        left = self.build_from(j.left)
+        right = self.build_from(j.right)
+        kind = j.kind
+        cols = list(left.out_cols) + list(right.out_cols)
+        scope = NameScope(cols)
+        conds = []
+        if j.using:
+            for name in j.using:
+                li = NameScope(left.out_cols).resolve(ast.Name((name,)))
+                ri = NameScope(right.out_cols).resolve(ast.Name((name,)))
+                conds.append(
+                    make_func(
+                        "eq",
+                        ECol(li, left.out_cols[li].ft, name),
+                        ECol(len(left.out_cols) + ri, right.out_cols[ri].ft, name),
+                    )
+                )
+        elif j.on is not None:
+            conds = self.split_cnf(self.to_expr(j.on, scope))
+        eq, other = [], []
+        nl = len(left.out_cols)
+        for c in conds:
+            pair = self._as_eq_pair(c, nl)
+            if pair is not None:
+                eq.append(pair)
+            else:
+                other.append(c)
+        if kind == "cross":
+            kind = "inner"
+        return Join(left, right, kind, eq, other, cols)
+
+    @staticmethod
+    def _as_eq_pair(c: Expression, nl: int):
+        """eq(col_left, col_right) across the join boundary → key pair."""
+        if isinstance(c, ScalarFunc) and c.sig.name == "eq":
+            a, b = c.args
+            asides = set()
+            a.collect_columns(asides)
+            bsides = set()
+            b.collect_columns(bsides)
+            if asides and bsides:
+                if max(asides) < nl and min(bsides) >= nl:
+                    return (a, b)
+                if max(bsides) < nl and min(asides) >= nl:
+                    return (b, a)
+        return None
+
+    @staticmethod
+    def split_cnf(e: Expression) -> list[Expression]:
+        if isinstance(e, ScalarFunc) and e.sig.name == "and":
+            return PlanBuilder.split_cnf(e.args[0]) + PlanBuilder.split_cnf(e.args[1])
+        return [e]
+
+    # ------------------------------------------------------------ expressions
+
+    def to_expr(self, node, scope: NameScope, agg_ctx=None) -> Expression:
+        if isinstance(node, ast.Lit):
+            return lit_to_constant(node)
+        if isinstance(node, ast.Name):
+            idx = scope.resolve(node)
+            c = scope.cols[idx]
+            return ECol(idx, c.ft, c.name)
+        if isinstance(node, ast.Call):
+            lname = node.name.lower()
+            if lname in AGG_FUNCS or lname in ("group_concat",):
+                if agg_ctx is None:
+                    raise TiDBError(f"aggregate {lname} not allowed here")
+                return agg_ctx.add_agg(node, scope)
+            if lname == "in_subquery":
+                return self._in_subquery(node, scope, agg_ctx)
+            args = [self.to_expr(a, scope, agg_ctx) for a in node.args]
+            args = _refine_cmp_constants(lname, args)
+            return make_func(lname, *args)
+        if isinstance(node, ast.CaseWhen):
+            args = []
+            for cond, res in node.whens:
+                c = self.to_expr(cond, scope, agg_ctx)
+                if node.operand is not None:
+                    c = make_func("eq", self.to_expr(node.operand, scope, agg_ctx), c)
+                args.append(c)
+                args.append(self.to_expr(res, scope, agg_ctx))
+            if node.else_ is not None:
+                args.append(self.to_expr(node.else_, scope, agg_ctx))
+            return make_func("case", *args)
+        if isinstance(node, ast.Cast):
+            e = self.to_expr(node.expr, scope, agg_ctx)
+            ft = parse_type_name(node.type_name, node.type_args, node.unsigned)
+            return ScalarFunc(CAST_SIG, [e], ft)
+        if isinstance(node, ast.SubqueryExpr):
+            return self._scalar_subquery(node)
+        if isinstance(node, ast.Star):
+            raise TiDBError("* not allowed in this context")
+        raise TiDBError(f"unsupported expression {type(node).__name__}")
+
+    def _scalar_subquery(self, node: ast.SubqueryExpr) -> Expression:
+        """Uncorrelated subqueries evaluate eagerly at plan time
+        (correlated subqueries: decorrelation rule lands with the apply
+        operator; ref rule_decorrelate.go)."""
+        if self.run_subquery is None:
+            raise TiDBError("subqueries not supported in this context")
+        rows, fts = self.run_subquery(node.select)
+        if node.modifier == "exists":
+            return Constant(Datum.i(1 if rows else 0), ft_longlong())
+        if node.modifier == "scalar":
+            if len(rows) > 1:
+                raise TiDBError("Subquery returns more than 1 row")
+            if not rows:
+                return Constant(Datum.null(), FieldType(TypeCode.Null))
+            return Constant(rows[0][0], fts[0])
+        raise TiDBError(f"unsupported subquery modifier {node.modifier}")
+
+    def _in_subquery(self, node: ast.Call, scope, agg_ctx) -> Expression:
+        lhs = self.to_expr(node.args[0], scope, agg_ctx)
+        sub = node.args[1]
+        rows, fts = self.run_subquery(sub.select)
+        if not rows:
+            return Constant(Datum.i(0), ft_longlong())
+        consts = [Constant(r[0], fts[0]) for r in rows]
+        return make_func("in", lhs, *consts)
+
+    # ---------------------------------------------------------------- SELECT
+
+    def build_select(self, sel) -> LogicalPlan:
+        if isinstance(sel, ast.SetOpSelect):
+            return self.build_setop(sel)
+        plan = self.build_from(sel.from_)
+        scope = NameScope(plan.out_cols)
+
+        if sel.where is not None:
+            conds = self.split_cnf(self.to_expr(sel.where, scope))
+            plan = Selection(plan, conds)
+
+        # expand stars into field list
+        fields = []
+        for f in sel.fields:
+            if isinstance(f, ast.Star):
+                for i, c in enumerate(plan.out_cols):
+                    if f.table and c.table_alias.lower() != f.table.lower():
+                        continue
+                    fields.append(ast.SelectField(ast.Name((c.table_alias, c.name)), None))
+                if not fields:
+                    raise TiDBError("SELECT * with no tables")
+            else:
+                fields.append(f)
+
+        agg_ctx = AggContext(self)
+        group_exprs = []
+        for g in sel.group_by:
+            if isinstance(g, ast.Lit) and g.kind == "int":  # GROUP BY 2 (position)
+                fe = fields[g.value - 1].expr
+                group_exprs.append(self.to_expr(fe, scope))
+            else:
+                group_exprs.append(self.to_expr(g, scope))
+
+        # convert select expressions, lifting aggregates
+        proj_exprs = []
+        proj_cols = []
+        for f in fields:
+            e = self.to_expr(f.expr, scope, agg_ctx)
+            name = f.alias or self._field_name(f.expr)
+            proj_exprs.append(e)
+            proj_cols.append(PlanCol(name, e.ret_type))
+
+        having_expr = None
+        if sel.having is not None:
+            having_scope = ScopeWithAliases(scope, fields, proj_exprs)
+            having_expr = self.to_expr_with_aliases(sel.having, having_scope, agg_ctx)
+
+        # convert ORDER BY early: aliases → projected exprs, other exprs over
+        # the child scope (may lift aggregates into agg_ctx)
+        alias_scope = ScopeWithAliases(scope, fields, proj_exprs)
+        order_items = []  # ('pos', i, desc) | ('expr', Expression, desc, ast)
+        for b in sel.order_by:
+            if isinstance(b.expr, ast.Lit) and b.expr.kind == "int":
+                order_items.append(("pos", b.expr.value - 1, b.desc, None))
+            else:
+                e = self.to_expr_with_aliases(b.expr, alias_scope, agg_ctx)
+                order_items.append(("expr", e, b.desc, b.expr))
+
+        need_agg = bool(group_exprs) or agg_ctx.aggs
+        if need_agg:
+            # rewrite first: it may append first_row aggs for bare columns
+            proj_exprs = [agg_ctx.rewrite(e, group_exprs) for e in proj_exprs]
+            if having_expr is not None:
+                having_expr = agg_ctx.rewrite(having_expr, group_exprs)
+            order_items = [
+                (k, agg_ctx.rewrite(x, group_exprs) if k == "expr" else x, d, n)
+                for k, x, d, n in order_items
+            ]
+            plan = self._build_agg(plan, scope, group_exprs, agg_ctx)
+
+        if having_expr is not None:
+            plan = Selection(plan, self.split_cnf(having_expr))
+
+        # sort columns: select-list matches by structure; others become
+        # hidden projection columns trimmed after the sort
+        n_visible = len(proj_exprs)
+        hidden: list = []
+        by: list = []
+        for kind, x, desc, node in order_items:
+            if kind == "pos":
+                if not (0 <= x < n_visible):
+                    raise TiDBError(f"ORDER BY position {x + 1} out of range")
+                by.append((ECol(x, proj_exprs[x].ret_type, proj_cols[x].name), desc))
+                continue
+            idx = None
+            for i, pe in enumerate(proj_exprs):
+                if repr(pe) == repr(x):
+                    idx = i
+                    break
+            if idx is None:
+                hidden.append(x)
+                idx = n_visible + len(hidden) - 1
+            ft = (proj_exprs + hidden)[idx].ret_type
+            by.append((ECol(idx, ft, f"s{idx}"), desc))
+
+        if sel.distinct and hidden:
+            raise TiDBError("ORDER BY expression must appear in SELECT DISTINCT list")
+
+        all_exprs = proj_exprs + hidden
+        all_cols = proj_cols + [PlanCol(f"h{i}", e.ret_type) for i, e in enumerate(hidden)]
+        plan = Projection(plan, all_exprs, all_cols)
+
+        if sel.distinct:
+            gb = [ECol(i, c.ft, c.name) for i, c in enumerate(proj_cols)]
+            plan = Aggregation(plan, gb, [], list(proj_cols))
+
+        if by:
+            plan = Sort(plan, by)
+
+        if hidden:
+            trims = [ECol(i, c.ft, c.name) for i, c in enumerate(proj_cols)]
+            plan = Projection(plan, trims, proj_cols)
+
+        if sel.limit is not None:
+            cnt = self._const_int(sel.limit)
+            off = self._const_int(sel.offset) if sel.offset is not None else 0
+            plan = Limit(plan, cnt, off)
+        return plan
+
+    def _order_expr(self, node, out_scope: NameScope, fields, in_scope, agg_ctx):
+        """ORDER BY resolves against output aliases first, then input."""
+        if isinstance(node, ast.Name):
+            try:
+                idx = out_scope.resolve(node)
+                c = out_scope.cols[idx]
+                return ECol(idx, c.ft, c.name)
+            except (UnknownColumn, AmbiguousColumn):
+                pass
+        # match structurally identical select expr
+        for i, f in enumerate(fields):
+            if f.expr == node:
+                c = out_scope.cols[i]
+                return ECol(i, c.ft, c.name)
+        raise TiDBError("ORDER BY expression must appear in select list (hidden-column sort lands later)")
+
+    @staticmethod
+    def _has_agg_in_order(order_by) -> bool:
+        def walk(n):
+            if isinstance(n, ast.Call):
+                if n.name.lower() in AGG_FUNCS:
+                    return True
+                return any(walk(a) for a in n.args)
+            return False
+
+        return any(walk(b.expr) for b in order_by)
+
+    def _build_agg(self, plan, scope, group_exprs, agg_ctx):
+        cols = [PlanCol(f"g{i}", e.ret_type) for i, e in enumerate(group_exprs)]
+        for i, a in enumerate(agg_ctx.aggs):
+            cols.append(PlanCol(f"a{i}", a.ret_type))
+        return Aggregation(plan, group_exprs, agg_ctx.aggs, cols)
+
+    def to_expr_with_aliases(self, node, scope_w, agg_ctx):
+        if isinstance(node, ast.Name) and len(node.parts) == 1:
+            hit = scope_w.find_alias(node.column)
+            if hit is not None:
+                return hit
+        if isinstance(node, ast.Call):
+            lname = node.name.lower()
+            if lname in AGG_FUNCS:
+                return agg_ctx.add_agg(node, scope_w.base)
+            args = [self.to_expr_with_aliases(a, scope_w, agg_ctx) for a in node.args]
+            return make_func(lname, *args)
+        return self.to_expr(node, scope_w.base, agg_ctx)
+
+    @staticmethod
+    def _field_name(e) -> str:
+        if isinstance(e, ast.Name):
+            return e.column
+        if isinstance(e, ast.Call):
+            return f"{e.name}(...)" if e.args else f"{e.name}()"
+        if isinstance(e, ast.Lit):
+            return str(e.value)
+        return "expr"
+
+    def _const_int(self, node) -> int:
+        if isinstance(node, ast.Lit) and node.kind == "int":
+            return node.value
+        raise TiDBError("LIMIT expects an integer literal")
+
+    def build_setop(self, s: ast.SetOpSelect) -> LogicalPlan:
+        children = [self.build_select(x) for x in s.selects]
+        n = len(children[0].out_cols)
+        for c in children[1:]:
+            if len(c.out_cols) != n:
+                raise TiDBError("The used SELECT statements have a different number of columns")
+        from ..expr.builtins import merge_types
+
+        cols = []
+        for i in range(n):
+            fts = [c.out_cols[i].ft for c in children]
+            cols.append(PlanCol(children[0].out_cols[i].name, merge_types(fts)))
+        plan = SetOp(children, s.ops, cols)
+        if any(op == "union" for op in s.ops):
+            gb = [ECol(i, c.ft, c.name) for i, c in enumerate(cols)]
+            plan = Aggregation(plan, gb, [], list(cols))
+        if s.order_by:
+            scope = NameScope(plan.out_cols)
+            by = []
+            for b in s.order_by:
+                if isinstance(b.expr, ast.Lit) and b.expr.kind == "int":
+                    i = b.expr.value - 1
+                    by.append((ECol(i, plan.out_cols[i].ft, plan.out_cols[i].name), b.desc))
+                else:
+                    by.append((self.to_expr(b.expr, scope), b.desc))
+            plan = Sort(plan, by)
+        if s.limit is not None:
+            plan = Limit(plan, self._const_int(s.limit), self._const_int(s.offset) if s.offset else 0)
+        return plan
+
+
+class ScopeWithAliases:
+    def __init__(self, base: NameScope, fields, proj_exprs):
+        self.base = base
+        self.fields = fields
+        self.proj_exprs = proj_exprs
+
+    def find_alias(self, name: str):
+        lname = name.lower()
+        for f, e in zip(self.fields, self.proj_exprs):
+            if f.alias and f.alias.lower() == lname:
+                return e
+        return None
+
+
+class AggContext:
+    """Collects aggregates during expression conversion and rewrites outer
+    expressions to reference the Aggregation node's output."""
+
+    def __init__(self, builder: PlanBuilder):
+        self.builder = builder
+        self.aggs: list[AggDesc] = []
+        self._agg_exprs: list[Expression] = []  # placeholder per agg
+
+    def add_agg(self, node: ast.Call, scope: NameScope) -> Expression:
+        name = node.name.lower()
+        args = []
+        for a in node.args:
+            if isinstance(a, ast.Star):  # COUNT(*)
+                args = []
+                break
+            args.append(self.builder.to_expr(a, scope))
+        desc = AggDesc.make(name, args, distinct=node.distinct)
+        # dedup identical aggregates
+        for i, existing in enumerate(self.aggs):
+            if repr(existing) == repr(desc):
+                return _AggRef(i, existing.ret_type)
+        self.aggs.append(desc)
+        return _AggRef(len(self.aggs) - 1, desc.ret_type)
+
+    def rewrite(self, e: Expression, group_exprs) -> Expression:
+        """Rewrite an expression over the child schema into one over the
+        Aggregation output schema: [group cols..., agg cols...]."""
+        ngroups = len(group_exprs)
+
+        def rec(x):
+            if isinstance(x, _AggRef):
+                return ECol(ngroups + x.agg_idx, x.ret_type, f"a{x.agg_idx}")
+            # an expression structurally equal to a group-by expr → its col
+            for gi, g in enumerate(group_exprs):
+                if repr(x) == repr(g):
+                    return ECol(gi, g.ret_type, f"g{gi}")
+            if isinstance(x, ECol):
+                # bare column not in group by: first_row semantics
+                for i, a in enumerate(self.aggs):
+                    if a.name == "first_row" and repr(a.args[0]) == repr(x):
+                        return ECol(ngroups + i, a.ret_type, f"a{i}")
+                desc = AggDesc.make("first_row", [x])
+                self.aggs.append(desc)
+                return ECol(ngroups + len(self.aggs) - 1, desc.ret_type, "fr")
+            if isinstance(x, ScalarFunc):
+                return ScalarFunc(x.sig, [rec(a) for a in x.args], x.ret_type)
+            return x
+
+        return rec(e)
+
+
+class _AggRef(Expression):
+    """Placeholder node for a lifted aggregate, resolved by AggContext.rewrite."""
+
+    def __init__(self, agg_idx: int, ret_type):
+        self.agg_idx = agg_idx
+        self.ret_type = ret_type
+
+    def collect_columns(self, out):
+        pass
+
+    def __repr__(self):
+        return f"aggref#{self.agg_idx}"

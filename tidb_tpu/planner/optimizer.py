@@ -1,0 +1,123 @@
+"""Logical optimization rules (ref: planner/core/optimizer.go:67 rule list;
+this implements the subset that drives the pushdown story: predicate
+pushdown (rule_predicate_push_down.go) and column pruning
+(rule_column_pruning.go). Agg/TopN/Limit pushdown decisions happen at
+executor build where cop DAGs are assembled, mirroring how the reference
+decides cop vs root in the task model).
+"""
+
+from __future__ import annotations
+
+from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc
+from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, Projection, Selection, SetOp, Sort
+
+
+def optimize(plan: LogicalPlan) -> LogicalPlan:
+    # Column pruning is implicit in this architecture: the tile cache holds
+    # whole-table columnar batches decoded once per version, host chunks
+    # reference those arrays zero-copy, and the device engine ships only
+    # lanes referenced by DAG expressions. An explicit pruning pass returns
+    # when index-path selection needs per-path column sets.
+    return push_down_predicates(plan)
+
+
+# --------------------------------------------------------------- predicates
+
+
+def _shift_expr(e: Expression, delta: int) -> Expression:
+    if isinstance(e, ECol):
+        return ECol(e.idx + delta, e.ret_type, e.name)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.sig, [_shift_expr(a, delta) for a in e.args], e.ret_type)
+    return e
+
+
+def _cols_of(e: Expression) -> set:
+    s: set = set()
+    e.collect_columns(s)
+    return s
+
+
+def _subst_proj(e: Expression, proj_exprs) -> Expression | None:
+    """Rewrite an expr over a Projection's output into one over its input
+    (substitute projected expressions). None if not substitutable."""
+    if isinstance(e, ECol):
+        return proj_exprs[e.idx]
+    if isinstance(e, ScalarFunc):
+        args = [_subst_proj(a, proj_exprs) for a in e.args]
+        if any(a is None for a in args):
+            return None
+        return ScalarFunc(e.sig, args, e.ret_type)
+    if isinstance(e, Constant):
+        return e
+    return None
+
+
+def push_down_predicates(plan: LogicalPlan, conds: list[Expression] | None = None) -> LogicalPlan:
+    conds = conds or []
+    if isinstance(plan, Selection):
+        child = push_down_predicates(plan.children[0], conds + plan.conds)
+        return child  # all conds either pushed or re-materialized below
+
+    if isinstance(plan, DataSource):
+        pushable = [c for c in conds if c.pushable()]
+        rest = [c for c in conds if not c.pushable()]
+        plan.pushed_conds.extend(pushable)
+        if rest:
+            return Selection(plan, rest)
+        return plan
+
+    if isinstance(plan, Projection):
+        down, keep = [], []
+        for c in conds:
+            s = _subst_proj(c, plan.exprs)
+            if s is not None:
+                down.append(s)
+            else:
+                keep.append(c)
+        plan.children[0] = push_down_predicates(plan.children[0], down)
+        if keep:
+            return Selection(plan, keep)
+        return plan
+
+    if isinstance(plan, Join):
+        nl = len(plan.children[0].out_cols)
+        left_conds, right_conds, keep = [], [], []
+        for c in conds:
+            cols = _cols_of(c)
+            if cols and max(cols) < nl and plan.kind in ("inner", "left"):
+                left_conds.append(c)
+            elif cols and min(cols) >= nl and plan.kind in ("inner", "right"):
+                right_conds.append(_shift_expr(c, -nl))
+            else:
+                keep.append(c)
+        # inner joins: other_conds referencing one side sink too
+        if plan.kind == "inner":
+            still_other = []
+            for c in plan.other_conds:
+                cols = _cols_of(c)
+                if cols and max(cols) < nl:
+                    left_conds.append(c)
+                elif cols and min(cols) >= nl:
+                    right_conds.append(_shift_expr(c, -nl))
+                else:
+                    still_other.append(c)
+            plan.other_conds = still_other
+        plan.children[0] = push_down_predicates(plan.children[0], left_conds)
+        plan.children[1] = push_down_predicates(plan.children[1], right_conds)
+        if keep:
+            return Selection(plan, keep)
+        return plan
+
+    if isinstance(plan, (Aggregation, Sort, Limit, SetOp, Dual)):
+        # conditions do not push through these (agg: having semantics differ;
+        # limit/sort: row-count changing) — recurse children without conds
+        plan.children = [push_down_predicates(c) for c in plan.children]
+        if conds:
+            return Selection(plan, conds)
+        return plan
+
+    plan.children = [push_down_predicates(c) for c in plan.children]
+    if conds:
+        return Selection(plan, conds)
+    return plan

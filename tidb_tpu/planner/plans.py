@@ -1,0 +1,130 @@
+"""Logical plan nodes (ref: planner/core logical ops — compact redesign).
+
+Every node carries an output schema: a list of PlanCol. Expressions inside
+nodes reference child output by offset (expr.Column.idx), with join
+children concatenated left-then-right.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..catalog.schema import TableInfo
+from ..expr.expression import Expression
+from ..expr.aggregation import AggDesc
+from ..mysqltypes.field_type import FieldType
+
+
+@dataclass
+class PlanCol:
+    name: str
+    ft: FieldType
+    table_alias: str = ""
+    orig_offset: int = -1  # offset in the base table (DataSource only)
+
+
+class LogicalPlan:
+    children: list
+    out_cols: list[PlanCol]
+
+    def __init__(self, children, out_cols):
+        self.children = children
+        self.out_cols = out_cols
+
+    def pretty(self, indent=0) -> str:
+        pad = "  " * indent
+        s = pad + self.describe()
+        for c in self.children:
+            s += "\n" + c.pretty(indent + 1)
+        return s
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class DataSource(LogicalPlan):
+    def __init__(self, table: TableInfo, alias: str, cols: list[PlanCol]):
+        super().__init__([], cols)
+        self.table = table
+        self.alias = alias
+        self.pushed_conds: list[Expression] = []
+
+    def describe(self):
+        s = f"DataSource({self.alias or self.table.name})"
+        if self.pushed_conds:
+            s += f" pushed:{self.pushed_conds!r}"
+        return s
+
+
+class Selection(LogicalPlan):
+    def __init__(self, child, conds: list[Expression]):
+        super().__init__([child], child.out_cols)
+        self.conds = conds
+
+    def describe(self):
+        return f"Selection{self.conds!r}"
+
+
+class Projection(LogicalPlan):
+    def __init__(self, child, exprs: list[Expression], cols: list[PlanCol]):
+        super().__init__([child], cols)
+        self.exprs = exprs
+
+    def describe(self):
+        return f"Projection{self.exprs!r}"
+
+
+class Aggregation(LogicalPlan):
+    def __init__(self, child, group_by: list[Expression], aggs: list[AggDesc], cols: list[PlanCol]):
+        super().__init__([child], cols)
+        self.group_by = group_by
+        self.aggs = aggs
+
+    def describe(self):
+        return f"Aggregation(group={self.group_by!r}, aggs={self.aggs!r})"
+
+
+class Join(LogicalPlan):
+    def __init__(self, left, right, kind: str, eq_conds, other_conds, cols):
+        super().__init__([left, right], cols)
+        self.kind = kind  # inner | left | right | cross
+        self.eq_conds = eq_conds  # [(left_expr, right_expr)] offsets child-local
+        self.other_conds = other_conds  # over concatenated schema
+
+    def describe(self):
+        return f"Join({self.kind}, eq={self.eq_conds!r}, other={self.other_conds!r})"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child, by: list[tuple[Expression, bool]]):
+        super().__init__([child], child.out_cols)
+        self.by = by
+
+    def describe(self):
+        return f"Sort{[(repr(e), d) for e, d in self.by]!r}"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child, count: int, offset: int = 0):
+        super().__init__([child], child.out_cols)
+        self.count = count
+        self.offset = offset
+
+    def describe(self):
+        return f"Limit({self.count}, offset={self.offset})"
+
+
+class Dual(LogicalPlan):
+    """One-row no-table source (SELECT 1)."""
+
+    def __init__(self):
+        super().__init__([], [])
+
+
+class SetOp(LogicalPlan):
+    def __init__(self, children, ops: list[str], cols):
+        super().__init__(children, cols)
+        self.ops = ops  # 'union' | 'union_all' | 'except' | 'intersect'
+
+    def describe(self):
+        return f"SetOp({self.ops})"

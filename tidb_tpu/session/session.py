@@ -1,0 +1,863 @@
+"""Session — SQL execution driver (ref: session/session.go ExecuteStmt:1618,
+LazyTxn txn.go:50; compact redesign).
+
+Owns: current database, session vars, the lazy transaction, and the
+catalog cache. Routes statements: DDL → meta transactions with schema
+version bump; DML → executor over the txn membuffer; SELECT → plan,
+optimize, execute via the cop client (TPU or host engine).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..catalog.meta import Meta
+from ..catalog.schema import ColumnInfo, DBInfo, IndexInfo, InfoSchema, TableInfo
+from ..chunk.chunk import Chunk, Column
+from ..codec import tablecodec
+from ..copr.client import CopClient
+from ..errors import (
+    DuplicateEntry,
+    RetryableError,
+    TableExists,
+    TiDBError,
+    UnknownDatabase,
+    UnknownTable,
+    WriteConflict,
+)
+from ..executor import ExecContext, build_executor, drain
+from ..expr.expression import Column as ECol, Constant
+from ..mysqltypes.datum import Datum
+from ..mysqltypes.field_type import NOT_NULL_FLAG, PRI_KEY_FLAG, AUTO_INCREMENT_FLAG, FieldType, TypeCode, ft_longlong, ft_varchar, parse_type_name
+from ..mysqltypes.coretime import parse_datetime
+from ..parser import ast, parse_one
+from ..planner.builder import NameScope, PlanBuilder, lit_to_constant
+from ..planner.optimizer import optimize
+from ..planner.plans import DataSource, Selection
+from ..storage.txn import Storage, Txn
+from ..table.table import Table
+from .vars import DEFAULT_VARS
+
+
+class ResultSet:
+    def __init__(self, names: list[str], chunk: Chunk, affected: int = 0, last_insert_id: int = 0):
+        self.names = names
+        self.chunk = chunk
+        self.affected = affected
+        self.last_insert_id = last_insert_id
+
+    def rows(self) -> list[tuple]:
+        return self.chunk.to_pylist() if self.chunk is not None else []
+
+    def scalar(self):
+        r = self.rows()
+        return r[0][0] if r else None
+
+
+class Session:
+    def __init__(self, storage: Storage | None = None, cop_client: CopClient | None = None):
+        self.store = storage or Storage()
+        self.cop = cop_client or CopClient(self.store)
+        self.current_db = "test"
+        self.vars = dict(DEFAULT_VARS)
+        self.txn: Txn | None = None
+        self.in_explicit_txn = False
+        self._is_cache: InfoSchema | None = None
+        self.warnings: list[str] = []
+        self.last_insert_id = 0
+        self._bootstrap()
+
+    # ------------------------------------------------------------- bootstrap
+
+    def _bootstrap(self):
+        """Create system + default schemas (ref: session/bootstrap.go)."""
+        txn = self.store.begin()
+        m = Meta(txn)
+        if m.db("test") is None:
+            for db in ("mysql", "information_schema", "performance_schema", "test"):
+                m.put_db(DBInfo(db))
+            m.bump_schema_version()
+            txn.commit()
+        else:
+            txn.rollback()
+
+    # ------------------------------------------------------------- infoschema
+
+    def infoschema(self) -> InfoSchema:
+        txn = self.store.begin()
+        m = Meta(txn)
+        ver = m.schema_version()
+        if self._is_cache is not None and self._is_cache.version == ver:
+            txn.rollback()
+            return self._is_cache
+        dbs = {d.name: d for d in m.list_dbs()}
+        tables = {t.id: t for t in m.list_tables()}
+        txn.rollback()
+        self._is_cache = InfoSchema(ver, dbs, tables)
+        return self._is_cache
+
+    # ------------------------------------------------------------------- txn
+
+    def _active_txn(self) -> Txn:
+        if self.txn is None:
+            self.txn = self.store.begin()
+        return self.txn
+
+    def _finish_stmt(self):
+        """Autocommit unless inside an explicit transaction."""
+        if self.txn is not None and not self.in_explicit_txn:
+            self.txn.commit()
+            self.txn = None
+
+    def _abort_stmt(self):
+        if self.txn is not None and not self.in_explicit_txn:
+            self.txn.rollback()
+            self.txn = None
+
+    def read_ts(self) -> int:
+        if self.txn is not None:
+            return self.txn.start_ts
+        return self.store.tso.next()
+
+    # ---------------------------------------------------------------- execute
+
+    def execute(self, sql: str) -> ResultSet:
+        stmt = parse_one(sql)
+        # statement-level savepoint: a failed statement inside an explicit
+        # txn must not keep its partial writes (ref: session StmtRollback)
+        saved = None
+        if self.txn is not None:
+            saved = (dict(self.txn.membuf), set(self.txn._locked_keys))
+        try:
+            rs = self._execute_stmt(stmt)
+            self._finish_stmt()
+            return rs
+        except Exception:
+            if saved is not None and self.txn is not None and self.in_explicit_txn:
+                self.txn.membuf, self.txn._locked_keys = saved
+            self._abort_stmt()
+            raise
+
+    def must_query(self, sql: str) -> list[tuple]:
+        return self.execute(sql).rows()
+
+    def _execute_stmt(self, stmt) -> ResultSet:
+        if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
+            return self.run_select(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self._run_insert(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._run_update(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._run_delete(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._ddl_create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._ddl_drop_table(stmt)
+        if isinstance(stmt, ast.TruncateTable):
+            return self._ddl_truncate(stmt)
+        if isinstance(stmt, ast.CreateIndex):
+            return self._ddl_create_index(stmt)
+        if isinstance(stmt, ast.DropIndex):
+            return self._ddl_drop_index(stmt)
+        if isinstance(stmt, ast.AlterTable):
+            return self._ddl_alter(stmt)
+        if isinstance(stmt, ast.CreateDatabase):
+            return self._ddl_create_db(stmt)
+        if isinstance(stmt, ast.DropDatabase):
+            return self._ddl_drop_db(stmt)
+        if isinstance(stmt, ast.UseDB):
+            if not self.infoschema().has_db(stmt.name):
+                raise UnknownDatabase(f"unknown database {stmt.name!r}")
+            self.current_db = stmt.name
+            return ResultSet([], None)
+        if isinstance(stmt, ast.Begin):
+            if self.txn is not None:
+                self.txn.commit()
+            self.txn = self.store.begin()
+            self.in_explicit_txn = True
+            return ResultSet([], None)
+        if isinstance(stmt, ast.Commit):
+            if self.txn is not None:
+                self.txn.commit()
+            self.txn = None
+            self.in_explicit_txn = False
+            return ResultSet([], None)
+        if isinstance(stmt, ast.Rollback):
+            if self.txn is not None:
+                self.txn.rollback()
+            self.txn = None
+            self.in_explicit_txn = False
+            return ResultSet([], None)
+        if isinstance(stmt, ast.SetStmt):
+            for scope, name, val in stmt.assignments:
+                c = self._const_of(val)
+                self.vars[name] = c.value.render(c.ret_type)
+            return ResultSet([], None)
+        if isinstance(stmt, ast.Show):
+            return self._run_show(stmt)
+        if isinstance(stmt, ast.Explain):
+            return self._run_explain(stmt)
+        if isinstance(stmt, ast.AnalyzeTable):
+            return ResultSet([], None)  # stats plumbing lands with CBO
+        if isinstance(stmt, ast.FlushStmt):
+            return ResultSet([], None)
+        raise TiDBError(f"unsupported statement {type(stmt).__name__}")
+
+    def _const_of(self, node) -> Constant:
+        if isinstance(node, ast.Lit):
+            return lit_to_constant(node)
+        if isinstance(node, ast.Name):
+            return Constant(Datum.s(".".join(node.parts)), ft_varchar())
+        raise TiDBError("expected literal")
+
+    # ---------------------------------------------------------------- SELECT
+
+    def plan_select(self, stmt):
+        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
+        plan = builder.build_select(stmt)
+        return optimize(plan)
+
+    def run_select(self, stmt) -> ResultSet:
+        plan = self.plan_select(stmt)
+        ctx = ExecContext(
+            self.cop,
+            self.read_ts(),
+            engine=self.vars.get("tidb_cop_engine", "auto"),
+            vars=self.vars,
+            txn=self.txn,
+        )
+        ex = build_executor(plan, ctx)
+        chunk = drain(ex)
+        names = [c.name for c in plan.out_cols]
+        return ResultSet(names, chunk)
+
+    def _run_subquery(self, select_ast):
+        rs = self.run_select(select_ast)
+        rows = [rs.chunk.get_row(i) for i in range(rs.chunk.num_rows)]
+        return rows, rs.chunk.field_types()
+
+    # ------------------------------------------------------------------- DML
+
+    def alloc_auto_id(self, tinfo: TableInfo, n: int) -> int:
+        """Batched auto-id allocation in its own small txn (ref: meta/autoid)."""
+        for _ in range(8):
+            txn = self.store.begin()
+            try:
+                m = Meta(txn)
+                t = m.table(tinfo.id)
+                first = t.auto_inc_id
+                t.auto_inc_id += n
+                m.put_table(t)
+                txn.commit()
+                tinfo.auto_inc_id = t.auto_inc_id
+                return first
+            except (WriteConflict, RetryableError):
+                continue
+        raise RetryableError("auto-id allocation kept conflicting")
+
+    def _eval_insert_value(self, node, col: ColumnInfo) -> Datum:
+        if isinstance(node, ast.Default) or node is None:
+            return self._default_datum(col)
+        if isinstance(node, ast.Lit):
+            c = lit_to_constant(node)
+            return self._cast_datum(c.value, col.ft)
+        # general expression with no column refs
+        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
+        e = builder.to_expr(node, NameScope([]))
+        one = Chunk([Column(ft_longlong(), np.zeros(1, dtype=np.int64), np.ones(1, dtype=bool))])
+        d, v = e.eval(one)
+        if not v[0]:
+            return Datum.null()
+        col_obj = Column(e.ret_type, d[:1], v[:1])
+        return self._cast_datum(col_obj.get_datum(0), col.ft)
+
+    def _default_datum(self, col: ColumnInfo) -> Datum:
+        if col.auto_increment:
+            return Datum.null()  # filled by allocator
+        if col.has_default and col.default is not None:
+            return self._cast_datum(Datum.s(str(col.default)), col.ft)
+        return Datum.null()
+
+    def _cast_datum(self, d: Datum, ft: FieldType) -> Datum:
+        """Insert-time coercion to the column type (ref: table/column.go CastValue)."""
+        if d.is_null:
+            return d
+        if ft.is_time():
+            from ..mysqltypes.datum import K_INT, K_TIME, K_UINT
+            from ..mysqltypes.coretime import number_to_datetime
+
+            if d.kind == K_TIME:
+                return Datum.t(d.val)
+            if d.kind in (K_INT, K_UINT):
+                p = number_to_datetime(d.val)
+                if p is None:
+                    raise TiDBError(f"incorrect datetime value {d.val!r}")
+                return Datum.t(p)
+            p = parse_datetime(d.to_str())
+            if p is None:
+                raise TiDBError(f"incorrect datetime value {d.to_str()!r}")
+            return Datum.t(p)
+        if ft.is_decimal():
+            return Datum.d(d.to_dec().rescale(max(ft.decimal, 0)))
+        if ft.is_float():
+            return Datum.f(d.to_float())
+        if ft.is_int():
+            return Datum.u(d.to_int()) if ft.is_unsigned else Datum.i(d.to_int())
+        if ft.is_string():
+            return Datum.s(d.to_str())
+        return d
+
+    def _run_insert(self, stmt: ast.Insert) -> ResultSet:
+        info = self.infoschema().table(stmt.table.db or self.current_db, stmt.table.name)
+        tbl = Table(info)
+        txn = self._active_txn()
+        visible = info.visible_columns()
+        if stmt.columns:
+            name_to_col = {c.name.lower(): c for c in visible}
+            target = [name_to_col.get(c.lower()) or info.col_by_name(c) for c in stmt.columns]
+        else:
+            target = visible
+
+        rows_sources: list[list] = []
+        if stmt.select is not None:
+            rs = self.run_select(stmt.select)
+            for i in range(rs.chunk.num_rows):
+                rows_sources.append(rs.chunk.get_row(i))
+        else:
+            rows_sources = stmt.values
+
+        affected = 0
+        for vals in rows_sources:
+            if len(vals) != len(target):
+                raise TiDBError("Column count doesn't match value count")
+            datums = [self._default_datum(c) for c in visible]
+            for col, v in zip(target, vals):
+                if isinstance(v, Datum):
+                    datums[col.offset] = self._cast_datum(v, col.ft)
+                else:
+                    datums[col.offset] = self._eval_insert_value(v, col)
+            affected += self._insert_row(tbl, txn, datums, stmt)
+        self.cop.tiles.invalidate_table(info.id)
+        return ResultSet([], None, affected=affected, last_insert_id=self.last_insert_id)
+
+    def _insert_row(self, tbl: Table, txn, datums: list[Datum], stmt) -> int:
+        info = tbl.info
+        # handle: clustered int pk or auto rowid
+        handle = None
+        auto_col = next((c for c in info.columns if c.auto_increment), None)
+        if auto_col is not None and datums[auto_col.offset].is_null:
+            v = self.alloc_auto_id(info, 1)
+            datums[auto_col.offset] = Datum.i(v)
+            self.last_insert_id = v
+        if info.pk_is_handle:
+            pk = next(i for i in info.indexes if i.primary)
+            handle = datums[pk.col_offsets[0]].to_int()
+        else:
+            handle = self.alloc_auto_id(info, 1)
+        for c in info.visible_columns():
+            if c.ft.not_null and datums[c.offset].is_null:
+                raise TiDBError(f"Column '{c.name}' cannot be null")
+        conflicts = self._conflicting_handles(tbl, txn, datums, handle)
+        if conflicts:
+            if getattr(stmt, "replace", False):
+                # REPLACE deletes EVERY row that conflicts on pk or any
+                # unique index, then inserts (MySQL semantics)
+                for h in conflicts:
+                    old = self._row_by_handle(tbl, txn, h)
+                    if old is not None:
+                        tbl.remove_record(txn, h, old)
+                tbl.add_record(txn, datums, handle, check_dup=False)
+                return 1 + len(conflicts)
+            if getattr(stmt, "ignore", False):
+                return 0
+            raise DuplicateEntry(f"Duplicate entry in '{info.name}'")
+        tbl.add_record(txn, datums, handle)
+        return 1
+
+    def _conflicting_handles(self, tbl: Table, txn, datums, handle: int) -> list[int]:
+        """Handles of existing rows this insert collides with (pk + every
+        public unique index)."""
+        info = tbl.info
+        out = []
+        if info.pk_is_handle and txn.get(tbl.record_key(handle)) is not None:
+            out.append(handle)
+        full = tbl.row_datums_with_hidden(datums, handle)
+        for idx in info.indexes:
+            if not idx.unique or (info.pk_is_handle and idx.primary) or idx.state != "public":
+                continue
+            key, _, distinct = tbl.index_value_key(idx, full, None)
+            if not distinct:
+                continue  # NULL-bearing unique keys never conflict
+            existing = txn.get(key)
+            if existing:
+                h = int(existing)
+                if h not in out:
+                    out.append(h)
+        return out
+
+    def _row_by_handle(self, tbl: Table, txn, handle: int):
+        raw = txn.get(tbl.record_key(handle))
+        if raw is None:
+            return None
+        return tbl.decode_record(raw)
+
+    def _scan_matching_rows(self, stmt_table, where):
+        """Shared UPDATE/DELETE row collection: full scan + filter via the
+        SELECT machinery, returning (table, [(handle, datums)])."""
+        info = self.infoschema().table(stmt_table.db or self.current_db, stmt_table.name)
+        tbl = Table(info)
+        txn = self._active_txn()
+        prefix = tablecodec.record_prefix(info.id)
+        kvs = txn.scan(prefix, prefix + b"\xff")
+        rows = []
+        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
+        cond = None
+        if where is not None:
+            ds_cols = [
+                type("PC", (), {"name": c.name, "ft": c.ft, "table_alias": stmt_table.alias or info.name})()
+                for c in info.visible_columns()
+            ]
+            from ..planner.plans import PlanCol
+
+            scope = NameScope([PlanCol(c.name, c.ft, stmt_table.alias or info.name) for c in info.visible_columns()])
+            cond = builder.to_expr(where, scope)
+        for k, v in kvs:
+            handle = tablecodec.decode_record_handle(k)
+            datums = tbl.decode_record(v)
+            if cond is not None:
+                visible = [datums[c.offset] for c in info.visible_columns()]
+                chunk = Chunk.from_datum_rows([c.ft for c in info.visible_columns()], [visible])
+                d, valid = cond.eval(chunk)
+                if not (valid[0] and d[0] != 0):
+                    continue
+            rows.append((handle, datums))
+        return info, tbl, txn, rows
+
+    def _run_update(self, stmt: ast.Update) -> ResultSet:
+        if not isinstance(stmt.table, ast.TableName):
+            raise TiDBError("multi-table UPDATE not supported yet")
+        info, tbl, txn, rows = self._scan_matching_rows(stmt.table, stmt.where)
+        sets = []
+        from ..planner.plans import PlanCol
+
+        scope = NameScope([PlanCol(c.name, c.ft, stmt.table.alias or info.name) for c in info.visible_columns()])
+        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
+        for name, expr in stmt.sets:
+            col = info.col_by_name(name.column)
+            sets.append((col, builder.to_expr(expr, scope)))
+        affected = 0
+        vis = info.visible_columns()
+        for handle, datums in rows:
+            visible_vals = [datums[c.offset] for c in vis]
+            chunk = Chunk.from_datum_rows([c.ft for c in vis], [visible_vals])
+            new = list(datums)
+            changed = False
+            for col, e in sets:
+                d, v = e.eval(chunk)
+                lane = Column(e.ret_type, d[:1], v[:1])
+                nv = self._cast_datum(lane.get_datum(0), col.ft) if v[0] else Datum.null()
+                if repr(nv) != repr(datums[col.offset]):
+                    changed = True
+                new[col.offset] = nv
+            if changed:
+                tbl.update_record(txn, handle, datums, new)
+                affected += 1
+        self.cop.tiles.invalidate_table(info.id)
+        return ResultSet([], None, affected=affected)
+
+    def _run_delete(self, stmt: ast.Delete) -> ResultSet:
+        if not isinstance(stmt.table, ast.TableName):
+            raise TiDBError("multi-table DELETE not supported yet")
+        info, tbl, txn, rows = self._scan_matching_rows(stmt.table, stmt.where)
+        for handle, datums in rows:
+            tbl.remove_record(txn, handle, datums)
+        self.cop.tiles.invalidate_table(info.id)
+        return ResultSet([], None, affected=len(rows))
+
+    # ------------------------------------------------------------------- DDL
+
+    def _ddl_txn(self):
+        return self.store.begin()
+
+    def _ddl_create_db(self, stmt: ast.CreateDatabase) -> ResultSet:
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        if m.db(stmt.name) is not None:
+            txn.rollback()
+            if stmt.if_not_exists:
+                return ResultSet([], None)
+            raise TiDBError(f"database {stmt.name!r} exists")
+        m.put_db(DBInfo(stmt.name))
+        m.bump_schema_version()
+        txn.commit()
+        return ResultSet([], None)
+
+    def _ddl_drop_db(self, stmt: ast.DropDatabase) -> ResultSet:
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        db = m.db(stmt.name)
+        if db is None:
+            txn.rollback()
+            if stmt.if_exists:
+                return ResultSet([], None)
+            raise UnknownDatabase(f"unknown database {stmt.name!r}")
+        for tid in db.table_ids:
+            m.drop_table(tid)
+        m.drop_db(stmt.name)
+        m.bump_schema_version()
+        txn.commit()
+        for tid in db.table_ids:
+            self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(tid), tablecodec.table_prefix(tid + 1))
+        return ResultSet([], None)
+
+    def _ddl_create_table(self, stmt: ast.CreateTable) -> ResultSet:
+        db = stmt.table.db or self.current_db
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        dbi = m.db(db)
+        if dbi is None:
+            txn.rollback()
+            raise UnknownDatabase(f"unknown database {db!r}")
+        for tid in dbi.table_ids:
+            t = m.table(tid)
+            if t and t.name.lower() == stmt.table.name.lower():
+                txn.rollback()
+                if stmt.if_not_exists:
+                    return ResultSet([], None)
+                raise TableExists(f"table {stmt.table.name!r} already exists")
+
+        tid = m.alloc_id()
+        cols: list[ColumnInfo] = []
+        indexes: list[IndexInfo] = []
+        for i, cd in enumerate(stmt.columns):
+            ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems)
+            if cd.not_null or cd.primary_key:
+                ft.flag |= NOT_NULL_FLAG
+            if cd.auto_increment:
+                ft.flag |= AUTO_INCREMENT_FLAG
+            default = None
+            has_default = False
+            if cd.default is not None and isinstance(cd.default, ast.Lit):
+                default = cd.default.value if cd.default.kind != "dec" else str(cd.default.value)
+                has_default = default is not None
+                if isinstance(default, bytes):
+                    default = default.decode("utf8", "replace")
+            cols.append(ColumnInfo(m.alloc_id(), cd.name, ft, i, default, has_default, cd.auto_increment, comment=cd.comment))
+            if cd.primary_key:
+                indexes.append(IndexInfo(0, "PRIMARY", [i], unique=True, primary=True))
+            elif cd.unique:
+                indexes.append(IndexInfo(0, f"uk_{cd.name}", [i], unique=True))
+        for idef in stmt.indexes:
+            offs = []
+            for cn in idef.columns:
+                offs.append(next(c.offset for c in cols if c.name.lower() == cn.lower()))
+            indexes.append(IndexInfo(0, idef.name, offs, idef.unique, idef.primary))
+        # primary dedup + id assignment
+        seen_primary = False
+        final_idx = []
+        for idx in indexes:
+            if idx.primary:
+                if seen_primary:
+                    raise TiDBError("Multiple primary key defined")
+                seen_primary = True
+            idx.id = m.alloc_id()
+            final_idx.append(idx)
+        pk = next((i for i in final_idx if i.primary), None)
+        pk_is_handle = bool(pk and len(pk.col_offsets) == 1 and cols[pk.col_offsets[0]].ft.is_int())
+        if not pk_is_handle:
+            # hidden rowid column
+            rid = ColumnInfo(m.alloc_id(), "_tidb_rowid", ft_longlong(), len(cols), hidden=True)
+            cols.append(rid)
+        info = TableInfo(tid, stmt.table.name, cols, final_idx, pk_is_handle, db_name=db)
+        m.put_table(info)
+        dbi.table_ids.append(tid)
+        m.put_db(dbi)
+        m.bump_schema_version()
+        txn.commit()
+        return ResultSet([], None)
+
+    def _ddl_drop_table(self, stmt: ast.DropTable) -> ResultSet:
+        for tn in stmt.tables:
+            db = tn.db or self.current_db
+            txn = self._ddl_txn()
+            m = Meta(txn)
+            dbi = m.db(db)
+            target = None
+            if dbi:
+                for tid in dbi.table_ids:
+                    t = m.table(tid)
+                    if t and t.name.lower() == tn.name.lower():
+                        target = t
+                        break
+            if target is None:
+                txn.rollback()
+                if stmt.if_exists:
+                    continue
+                raise UnknownTable(f"table {tn.name!r} doesn't exist")
+            dbi.table_ids.remove(target.id)
+            m.put_db(dbi)
+            m.drop_table(target.id)
+            m.bump_schema_version()
+            txn.commit()
+            self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(target.id), tablecodec.table_prefix(target.id + 1))
+            self.cop.tiles.invalidate_table(target.id)
+        return ResultSet([], None)
+
+    def _ddl_truncate(self, stmt: ast.TruncateTable) -> ResultSet:
+        info = self.infoschema().table(stmt.table.db or self.current_db, stmt.table.name)
+        self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(info.id), tablecodec.table_prefix(info.id + 1))
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        t = m.table(info.id)
+        t.auto_inc_id = 1
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+        self.store.bump_version([tablecodec.record_prefix(info.id)])
+        self.cop.tiles.invalidate_table(info.id)
+        return ResultSet([], None)
+
+    def _ddl_create_index(self, stmt: ast.CreateIndex) -> ResultSet:
+        return self._add_index(stmt.table, stmt.index)
+
+    def _add_index(self, tn: ast.TableName, idef: ast.IndexDef) -> ResultSet:
+        db = tn.db or self.current_db
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        info = self.infoschema().table(db, tn.name)
+        t = m.table(info.id)
+        if t.index_by_name(idef.name):
+            txn.rollback()
+            raise TiDBError(f"duplicate key name {idef.name!r}")
+        offs = [t.col_by_name(c).offset for c in idef.columns]
+        idx = IndexInfo(m.alloc_id(), idef.name, offs, idef.unique, idef.primary)
+        t.indexes.append(idx)
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+        self._backfill_index(t, idx)
+        return ResultSet([], None)
+
+    def _backfill_index(self, info: TableInfo, idx: IndexInfo):
+        """Synchronous backfill (online state machine lands in ddl module;
+        ref: ddl/backfilling.go:546)."""
+        tbl = Table(info)
+        txn = self.store.begin()
+        prefix = tablecodec.record_prefix(info.id)
+        for k, v in txn.scan(prefix, prefix + b"\xff"):
+            handle = tablecodec.decode_record_handle(k)
+            datums = tbl.decode_record(v)
+            key, val, distinct = tbl.index_value_key(idx, datums, handle)
+            if distinct and txn.get(key) not in (None, val):
+                txn.rollback()
+                raise DuplicateEntry(f"Duplicate entry for key {idx.name!r}")
+            txn.put(key, val)
+        txn.commit()
+
+    def _ddl_drop_index(self, stmt: ast.DropIndex) -> ResultSet:
+        db = stmt.table.db or self.current_db
+        info = self.infoschema().table(db, stmt.table.name)
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        t = m.table(info.id)
+        idx = t.index_by_name(stmt.name)
+        if idx is None:
+            txn.rollback()
+            raise TiDBError(f"index {stmt.name!r} doesn't exist")
+        t.indexes.remove(idx)
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+        self.store.mvcc.unsafe_destroy_range(
+            tablecodec.index_prefix(info.id, idx.id),
+            tablecodec.index_prefix(info.id, idx.id + 1),
+        )
+        return ResultSet([], None)
+
+    def _ddl_alter(self, stmt: ast.AlterTable) -> ResultSet:
+        for action, payload in stmt.actions:
+            if action == "add_index":
+                self._add_index(stmt.table, payload)
+            elif action == "drop_index":
+                self._ddl_drop_index(ast.DropIndex(payload, stmt.table))
+            elif action == "add_column":
+                self._alter_add_column(stmt.table, payload)
+            elif action == "drop_column":
+                self._alter_drop_column(stmt.table, payload)
+            elif action == "rename":
+                self._alter_rename(stmt.table, payload)
+            else:
+                raise TiDBError(f"unsupported ALTER action {action}")
+        return ResultSet([], None)
+
+    def _alter_add_column(self, tn: ast.TableName, cd: ast.ColumnDef):
+        db = tn.db or self.current_db
+        info = self.infoschema().table(db, tn.name)
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        t = m.table(info.id)
+        ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems)
+        if cd.not_null:
+            ft.flag |= NOT_NULL_FLAG
+        default = None
+        has_default = False
+        if cd.default is not None and isinstance(cd.default, ast.Lit):
+            default = cd.default.value if cd.default.kind != "dec" else str(cd.default.value)
+            has_default = default is not None
+        # new column goes before any hidden rowid
+        hidden = [c for c in t.columns if c.hidden]
+        vis = [c for c in t.columns if not c.hidden]
+        col = ColumnInfo(m.alloc_id(), cd.name, ft, len(vis), default, has_default)
+        vis.append(col)
+        for i, h in enumerate(hidden):
+            h.offset = len(vis) + i
+        t.columns = vis + hidden
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+        self.cop.tiles.invalidate_table(info.id)
+
+    def _alter_drop_column(self, tn: ast.TableName, name: str):
+        db = tn.db or self.current_db
+        info = self.infoschema().table(db, tn.name)
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        t = m.table(info.id)
+        col = t.col_by_name(name)
+        for idx in t.indexes:
+            if col.offset in idx.col_offsets:
+                txn.rollback()
+                raise TiDBError(f"cannot drop indexed column {name!r}")
+        t.columns.remove(col)
+        for c in t.columns:
+            if c.offset > col.offset:
+                c.offset -= 1
+        for idx in t.indexes:
+            idx.col_offsets = [o - 1 if o > col.offset else o for o in idx.col_offsets]
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+        self.cop.tiles.invalidate_table(info.id)
+
+    def _alter_rename(self, tn: ast.TableName, new: ast.TableName):
+        db = tn.db or self.current_db
+        info = self.infoschema().table(db, tn.name)
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        t = m.table(info.id)
+        t.name = new.name
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+
+    # ------------------------------------------------------------------ SHOW
+
+    def _run_show(self, stmt: ast.Show) -> ResultSet:
+        is_ = self.infoschema()
+        if stmt.kind == "databases":
+            names = is_.db_names()
+            chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(n)] for n in names])
+            return ResultSet(["Database"], chk)
+        if stmt.kind == "tables":
+            db = stmt.target or self.current_db
+            tbls = [t.name for t in is_.tables_in_db(db)]
+            chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(n)] for n in tbls])
+            return ResultSet([f"Tables_in_{db}"], chk)
+        if stmt.kind == "columns":
+            info = is_.table(stmt.target.db or self.current_db, stmt.target.name)
+            rows = []
+            for c in info.visible_columns():
+                rows.append(
+                    [
+                        Datum.s(c.name),
+                        Datum.s(c.ft.type_name()),
+                        Datum.s("NO" if c.ft.not_null else "YES"),
+                        Datum.s(self._key_flag(info, c)),
+                        Datum.s(str(c.default)) if c.has_default else Datum.null(),
+                        Datum.s("auto_increment" if c.auto_increment else ""),
+                    ]
+                )
+            chk = Chunk.from_datum_rows([ft_varchar()] * 6, rows)
+            return ResultSet(["Field", "Type", "Null", "Key", "Default", "Extra"], chk)
+        if stmt.kind == "variables":
+            import re
+
+            pat = None
+            if stmt.like is not None and isinstance(stmt.like, ast.Lit):
+                from ..expr.builtins import like_to_regex
+
+                pat = like_to_regex(stmt.like.value)
+            rows = [
+                [Datum.s(k), Datum.s(str(v))]
+                for k, v in sorted(self.vars.items())
+                if pat is None or pat.match(k)
+            ]
+            chk = Chunk.from_datum_rows([ft_varchar(), ft_varchar()], rows)
+            return ResultSet(["Variable_name", "Value"], chk)
+        if stmt.kind == "create_table":
+            info = is_.table(stmt.target.db or self.current_db, stmt.target.name)
+            chk = Chunk.from_datum_rows(
+                [ft_varchar(), ft_varchar()],
+                [[Datum.s(info.name), Datum.s(self._show_create(info))]],
+            )
+            return ResultSet(["Table", "Create Table"], chk)
+        if stmt.kind == "warnings":
+            rows = [[Datum.s("Warning"), Datum.i(1105), Datum.s(w)] for w in self.warnings]
+            chk = Chunk.from_datum_rows([ft_varchar(), ft_longlong(), ft_varchar()], rows)
+            return ResultSet(["Level", "Code", "Message"], chk)
+        if stmt.kind == "index":
+            info = is_.table(stmt.target.db or self.current_db, stmt.target.name)
+            rows = []
+            for idx in info.indexes:
+                for seq, off in enumerate(idx.col_offsets):
+                    rows.append([Datum.s(info.name), Datum.i(0 if idx.unique else 1), Datum.s(idx.name), Datum.i(seq + 1), Datum.s(info.columns[off].name)])
+            chk = Chunk.from_datum_rows([ft_varchar(), ft_longlong(), ft_varchar(), ft_longlong(), ft_varchar()], rows)
+            return ResultSet(["Table", "Non_unique", "Key_name", "Seq_in_index", "Column_name"], chk)
+        # engines/collation/charset/status/processlist: minimal static forms
+        chk = Chunk.from_datum_rows([ft_varchar()], [])
+        return ResultSet([stmt.kind], chk)
+
+    @staticmethod
+    def _key_flag(info: TableInfo, c: ColumnInfo) -> str:
+        for idx in info.indexes:
+            if idx.col_offsets and idx.col_offsets[0] == c.offset:
+                if idx.primary:
+                    return "PRI"
+                return "UNI" if idx.unique else "MUL"
+        return ""
+
+    @staticmethod
+    def _show_create(info: TableInfo) -> str:
+        lines = []
+        for c in info.visible_columns():
+            s = f"  `{c.name}` {c.ft.type_name()}"
+            if c.ft.not_null:
+                s += " NOT NULL"
+            if c.auto_increment:
+                s += " AUTO_INCREMENT"
+            if c.has_default:
+                s += f" DEFAULT '{c.default}'"
+            lines.append(s)
+        for idx in info.indexes:
+            cols = ", ".join(f"`{info.columns[o].name}`" for o in idx.col_offsets)
+            if idx.primary:
+                lines.append(f"  PRIMARY KEY ({cols})")
+            elif idx.unique:
+                lines.append(f"  UNIQUE KEY `{idx.name}` ({cols})")
+            else:
+                lines.append(f"  KEY `{idx.name}` ({cols})")
+        body = ",\n".join(lines)
+        return f"CREATE TABLE `{info.name}` (\n{body}\n) ENGINE=tpu"
+
+    # --------------------------------------------------------------- EXPLAIN
+
+    def _run_explain(self, stmt: ast.Explain) -> ResultSet:
+        if not isinstance(stmt.stmt, (ast.Select, ast.SetOpSelect)):
+            raise TiDBError("EXPLAIN supports SELECT only for now")
+        plan = self.plan_select(stmt.stmt)
+        lines = plan.pretty().split("\n")
+        chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(l)] for l in lines])
+        return ResultSet(["plan"], chk)

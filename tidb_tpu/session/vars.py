@@ -1,0 +1,38 @@
+"""System variables (ref: sessionctx/variable/sysvar.go — ~230 vars; the
+subset that drives behavior here, with the rest present as inert knobs so
+SHOW VARIABLES / SET round-trip like the reference)."""
+
+DEFAULT_VARS = {
+    # engine selection for pushed-down DAGs: tpu | host | auto
+    "tidb_cop_engine": "auto",
+    "tidb_executor_concurrency": "5",
+    "tidb_distsql_scan_concurrency": "15",
+    "tidb_mem_quota_query": str(1 << 30),
+    "tidb_enable_chunk_rpc": "ON",
+    "tidb_allow_mpp": "ON",
+    "tidb_isolation_read_engines": "tpu,host",
+    "tidb_txn_mode": "optimistic",
+    "tidb_retry_limit": "10",
+    "autocommit": "ON",
+    "sql_mode": "ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES",
+    "max_execution_time": "0",
+    "tidb_enable_vectorized_expression": "ON",
+    "tidb_index_lookup_concurrency": "4",
+    "tidb_hash_join_concurrency": "5",
+    "tidb_build_stats_concurrency": "4",
+    "tidb_opt_agg_push_down": "ON",
+    "tidb_enable_clustered_index": "ON",
+    "tidb_snapshot": "",
+    "time_zone": "SYSTEM",
+    "wait_timeout": "28800",
+    "interactive_timeout": "28800",
+    "max_allowed_packet": "67108864",
+    "version_comment": "tidb-tpu",
+    "port": "4000",
+    "socket": "",
+    "datadir": "",
+    "character_set_server": "utf8mb4",
+    "collation_server": "utf8mb4_bin",
+    "tx_isolation": "REPEATABLE-READ",
+    "transaction_isolation": "REPEATABLE-READ",
+}

@@ -1,0 +1,326 @@
+"""Percolator MVCC over the ordered KV (ref: unistore/tikv/mvcc — behavior
+spec; the column-family encoding here is a fresh design).
+
+Key layout inside one MemKV:
+  lock   CF: b'l' + user_key                     → Lock record
+  write  CF: b'w' + user_key + rev_ts(commit_ts) → WriteRecord
+  default CF: b'd' + user_key + rev_ts(start_ts) → row value
+
+rev_ts inverts the timestamp so ascending key order visits newest commits
+first — a snapshot read is "seek to (key, read_ts), take first".
+
+Transactional verbs (the tikv/server.go:149-466 surface): prewrite,
+commit, rollback, check_txn_status, resolve, get/batch_get/scan.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from ..errors import LockedError, WriteConflict, TxnAborted
+from .memkv import MemKV
+
+OP_PUT = 0
+OP_DEL = 1
+OP_ROLLBACK = 2
+OP_LOCK = 3  # lock-only record (SELECT FOR UPDATE)
+
+_MAX = 0xFFFFFFFFFFFFFFFF
+
+
+def rev_ts(ts: int) -> bytes:
+    return struct.pack(">Q", _MAX - ts)
+
+
+def unrev_ts(b: bytes) -> int:
+    return _MAX - struct.unpack(">Q", b)[0]
+
+
+@dataclass
+class Lock:
+    op: int
+    primary: bytes
+    start_ts: int
+    ttl_ms: int
+    for_update_ts: int = 0
+    min_commit_ts: int = 0
+
+    def encode(self) -> bytes:
+        return struct.pack(">BQQQQH", self.op, self.start_ts, self.ttl_ms, self.for_update_ts, self.min_commit_ts, len(self.primary)) + self.primary
+
+    @staticmethod
+    def decode(b: bytes) -> "Lock":
+        op, start_ts, ttl, fut, mct, plen = struct.unpack_from(">BQQQQH", b)
+        off = struct.calcsize(">BQQQQH")
+        return Lock(op, b[off : off + plen], start_ts, ttl, fut, mct)
+
+
+@dataclass
+class WriteRecord:
+    op: int
+    start_ts: int
+
+    def encode(self) -> bytes:
+        return struct.pack(">BQ", self.op, self.start_ts)
+
+    @staticmethod
+    def decode(b: bytes) -> "WriteRecord":
+        op, start_ts = struct.unpack(">BQ", b[:9])
+        return WriteRecord(op, start_ts)
+
+
+@dataclass
+class Mutation:
+    op: int  # OP_PUT / OP_DEL / OP_LOCK
+    key: bytes
+    value: bytes = b""
+
+
+def _lk(key: bytes) -> bytes:
+    return b"l" + key
+
+
+def _wk(key: bytes, ts: int) -> bytes:
+    return b"w" + key + rev_ts(ts)
+
+
+def _dk(key: bytes, ts: int) -> bytes:
+    return b"d" + key + rev_ts(ts)
+
+
+class MVCCStore:
+    """One region-server's transactional KV (single process, many regions)."""
+
+    def __init__(self, kv: MemKV | None = None):
+        self.kv = kv or MemKV()
+        # data-version counters per table-prefix space are maintained above
+        # (storage.Storage) — the MVCC layer stays schema-agnostic.
+
+    # --- reads ------------------------------------------------------------
+
+    def _check_lock(self, key: bytes, read_ts: int):
+        raw = self.kv.get(_lk(key))
+        if raw is None:
+            return
+        lock = Lock.decode(raw)
+        if lock.op == OP_LOCK:
+            return  # lock-only records don't block reads
+        if lock.start_ts <= read_ts:
+            raise LockedError(f"key is locked by txn {lock.start_ts}", key=key, lock=lock)
+
+    def _visible_write(self, key: bytes, read_ts: int) -> WriteRecord | None:
+        for k, v in self.kv.iter_from(_wk(key, read_ts)):
+            if not k.startswith(b"w" + key) or len(k) != 1 + len(key) + 8:
+                return None
+            rec = WriteRecord.decode(v)
+            if rec.op in (OP_PUT, OP_DEL):
+                return rec
+            # rollbacks / lock-records: keep looking at older versions
+        return None
+
+    def get(self, key: bytes, read_ts: int) -> bytes | None:
+        self._check_lock(key, read_ts)
+        rec = self._visible_write(key, read_ts)
+        if rec is None or rec.op == OP_DEL:
+            return None
+        return self.kv.get(_dk(key, rec.start_ts))
+
+    def batch_get(self, keys: list[bytes], read_ts: int) -> dict[bytes, bytes]:
+        out = {}
+        for k in keys:
+            v = self.get(k, read_ts)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def scan(self, start: bytes, end: bytes, read_ts: int, limit: int | None = None):
+        """Snapshot range scan → list of (user_key, value)."""
+        out = []
+        # collect blocking locks in range first (reader must resolve)
+        for k, raw in self.kv.scan(_lk(start), _lk(end)):
+            lock = Lock.decode(raw)
+            if lock.op != OP_LOCK and lock.start_ts <= read_ts:
+                raise LockedError("range contains locked key", key=k[1:], lock=lock)
+        cur = start
+        it = self.kv.iter_from(b"w" + cur)
+        last_key = None
+        for k, v in it:
+            if not k.startswith(b"w") or (end is not None and k[1:-8] >= end):
+                break
+            ukey = k[1:-8]
+            if ukey == last_key:
+                continue  # older version of an already-decided key
+            ts = unrev_ts(k[-8:])
+            if ts > read_ts:
+                continue  # newer than snapshot; keep scanning same key
+            last_key = ukey
+            rec = WriteRecord.decode(v)
+            if rec.op == OP_PUT:
+                val = self.kv.get(_dk(ukey, rec.start_ts))
+                out.append((ukey, val))
+                if limit is not None and len(out) >= limit:
+                    break
+            elif rec.op == OP_DEL:
+                continue
+            else:
+                # rollback/lock record newest-visible: older versions may
+                # still be visible — rare path, do a point get
+                val_rec = self._visible_write(ukey, read_ts)
+                if val_rec and val_rec.op == OP_PUT:
+                    out.append((ukey, self.kv.get(_dk(ukey, val_rec.start_ts))))
+                    if limit is not None and len(out) >= limit:
+                        break
+        return out
+
+    # --- writes (percolator) ---------------------------------------------
+
+    def prewrite(self, muts: list[Mutation], primary: bytes, start_ts: int, ttl_ms: int = 3000, for_update_ts: int = 0):
+        """First phase: lock every key and stage values."""
+        with self.kv.lock:
+            for m in muts:
+                raw = self.kv.get(_lk(m.key))
+                if raw is not None:
+                    lock = Lock.decode(raw)
+                    if lock.start_ts != start_ts:
+                        raise LockedError(f"key locked by {lock.start_ts}", key=m.key, lock=lock)
+                    continue  # idempotent re-prewrite
+                # write-conflict check: any commit newer than our snapshot?
+                for k, v in self.kv.iter_from(b"w" + m.key):
+                    if not k.startswith(b"w" + m.key) or len(k) != 1 + len(m.key) + 8:
+                        break
+                    committed = unrev_ts(k[-8:])
+                    rec = WriteRecord.decode(v)
+                    if rec.op == OP_ROLLBACK and rec.start_ts == start_ts:
+                        raise TxnAborted(f"txn {start_ts} already rolled back")
+                    if committed > start_ts and rec.op in (OP_PUT, OP_DEL) and for_update_ts == 0:
+                        raise WriteConflict(f"conflict at {committed} > start {start_ts}")
+                    break
+                self.kv.put(_lk(m.key), Lock(m.op, primary, start_ts, ttl_ms, for_update_ts).encode())
+                if m.op == OP_PUT:
+                    self.kv.put(_dk(m.key, start_ts), m.value)
+
+    def commit(self, keys: list[bytes], start_ts: int, commit_ts: int):
+        with self.kv.lock:
+            for key in keys:
+                raw = self.kv.get(_lk(key))
+                if raw is None:
+                    # already committed (retry) or rolled back?
+                    st = self._find_txn_write(key, start_ts)
+                    if st is not None and st.op != OP_ROLLBACK:
+                        continue  # idempotent
+                    raise TxnAborted(f"commit of missing lock, txn {start_ts}")
+                lock = Lock.decode(raw)
+                if lock.start_ts != start_ts:
+                    raise TxnAborted(f"lock owned by {lock.start_ts}, not {start_ts}")
+                op = OP_PUT if lock.op == OP_PUT else (OP_DEL if lock.op == OP_DEL else OP_LOCK)
+                self.kv.put(_wk(key, commit_ts), WriteRecord(op, start_ts).encode())
+                self.kv.delete(_lk(key))
+
+    def rollback(self, keys: list[bytes], start_ts: int):
+        with self.kv.lock:
+            for key in keys:
+                raw = self.kv.get(_lk(key))
+                if raw is not None:
+                    lock = Lock.decode(raw)
+                    if lock.start_ts == start_ts:
+                        self.kv.delete(_lk(key))
+                        self.kv.delete(_dk(key, start_ts))
+                # tombstone so late prewrites of this txn fail
+                self.kv.put(_wk(key, start_ts), WriteRecord(OP_ROLLBACK, start_ts).encode())
+
+    def _find_txn_write(self, key: bytes, start_ts: int) -> WriteRecord | None:
+        for k, v in self.kv.iter_from(b"w" + key):
+            if not k.startswith(b"w" + key) or len(k) != 1 + len(key) + 8:
+                return None
+            rec = WriteRecord.decode(v)
+            if rec.start_ts == start_ts:
+                return rec
+        return None
+
+    def check_txn_status(self, primary: bytes, start_ts: int, now_ms: int) -> tuple[str, int]:
+        """→ ('committed', commit_ts) | ('rolled_back', 0) | ('locked', ttl) —
+        and rolls back expired primary locks (ref: tikv/server.go:285)."""
+        raw = self.kv.get(_lk(primary))
+        if raw is not None:
+            lock = Lock.decode(raw)
+            if lock.start_ts == start_ts:
+                from .tso import TSO
+
+                if TSO.physical_ms(start_ts) + lock.ttl_ms < now_ms:
+                    self.rollback([primary], start_ts)
+                    return "rolled_back", 0
+                return "locked", lock.ttl_ms
+        rec_ts = self._find_commit(primary, start_ts)
+        if rec_ts is not None:
+            return "committed", rec_ts
+        # no lock, no commit: treat as rolled back (and tombstone it)
+        self.rollback([primary], start_ts)
+        return "rolled_back", 0
+
+    def _find_commit(self, key: bytes, start_ts: int) -> int | None:
+        for k, v in self.kv.iter_from(b"w" + key):
+            if not k.startswith(b"w" + key) or len(k) != 1 + len(key) + 8:
+                return None
+            rec = WriteRecord.decode(v)
+            if rec.start_ts == start_ts and rec.op in (OP_PUT, OP_DEL, OP_LOCK):
+                return unrev_ts(k[-8:])
+        return None
+
+    def resolve_lock(self, key: bytes, lock: Lock, now_ms: int) -> bool:
+        """Resolve one blocking lock via its primary. True if cleared."""
+        status, commit_ts = self.check_txn_status(lock.primary, lock.start_ts, now_ms)
+        if status == "committed":
+            self.commit([key], lock.start_ts, commit_ts)
+            return True
+        if status == "rolled_back":
+            self.rollback([key], lock.start_ts)
+            return True
+        return False
+
+    def unsafe_destroy_range(self, start: bytes, end: bytes) -> int:
+        """Physically remove ALL versions/locks in a user-key range —
+        the delete-range verb used when tables are dropped/truncated
+        (ref: gc_worker delete-ranges; tikv UnsafeDestroyRange)."""
+        n = 0
+        for cf in (b"d", b"w", b"l"):
+            n += self.kv.delete_range(cf + start, cf + end)
+        return n
+
+    # --- GC (ref: store/gcworker) -----------------------------------------
+
+    def gc(self, safe_point: int) -> int:
+        """Drop versions no snapshot at/after safe_point can see."""
+        removed = 0
+        with self.kv.lock:
+            doomed_w: list[bytes] = []
+            doomed_d: list[bytes] = []
+            last_key = None
+            kept_newest = False
+            for k, v in list(self.kv.iter_from(b"w")):
+                if not k.startswith(b"w"):
+                    break
+                ukey, ts = k[1:-8], unrev_ts(k[-8:])
+                if ukey != last_key:
+                    last_key, kept_newest = ukey, False
+                rec = WriteRecord.decode(v)
+                if ts > safe_point:
+                    continue
+                if rec.op not in (OP_PUT, OP_DEL):
+                    # rollback/lock markers are not data versions: safe to
+                    # drop once no pre-safepoint txn can prewrite again —
+                    # and they must NOT count as the kept newest version
+                    doomed_w.append(k)
+                    continue
+                if not kept_newest:
+                    kept_newest = True
+                    if rec.op == OP_DEL:  # newest visible is a delete: drop it too
+                        doomed_w.append(k)
+                        doomed_d.append(_dk(ukey, rec.start_ts))
+                    continue
+                doomed_w.append(k)
+                doomed_d.append(_dk(ukey, rec.start_ts))
+            for k in doomed_w + doomed_d:
+                self.kv.delete(k)
+                removed += 1
+        return removed

@@ -1,0 +1,84 @@
+"""Region map — key-space sharding metadata (ref: unistore/cluster.go,
+mock_region.go; PD's region tree).
+
+Regions partition the key space [start, end). The cop client splits key
+ranges along region boundaries into tasks (copr/coprocessor.go:151 analog);
+on the TPU side each region's rows become a shard of the device mesh.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from threading import RLock
+
+
+@dataclass
+class Region:
+    id: int
+    start: bytes  # inclusive; b"" = -inf
+    end: bytes  # exclusive; b"" = +inf
+    leader_store: int = 1
+    epoch: int = 1
+
+    def contains(self, key: bytes) -> bool:
+        return self.start <= key and (self.end == b"" or key < self.end)
+
+
+class RegionMap:
+    def __init__(self):
+        self._lock = RLock()
+        self._next_id = 2
+        self.regions: list[Region] = [Region(1, b"", b"")]
+
+    def _starts(self):
+        return [r.start for r in self.regions]
+
+    def locate(self, key: bytes) -> Region:
+        with self._lock:
+            i = bisect.bisect_right(self._starts(), key) - 1
+            return self.regions[max(i, 0)]
+
+    def split(self, split_key: bytes) -> Region | None:
+        """Split the region containing split_key at that key."""
+        with self._lock:
+            i = bisect.bisect_right(self._starts(), split_key) - 1
+            r = self.regions[max(i, 0)]
+            if r.start == split_key or (r.end != b"" and split_key >= r.end):
+                return None
+            new = Region(self._next_id, split_key, r.end, r.leader_store, r.epoch + 1)
+            self._next_id += 1
+            r.end = split_key
+            r.epoch += 1
+            self.regions.insert(i + 1, new)
+            return new
+
+    def split_many(self, keys: list[bytes]) -> int:
+        n = 0
+        for k in sorted(set(keys)):
+            if self.split(k) is not None:
+                n += 1
+        return n
+
+    def regions_in_range(self, start: bytes, end: bytes | None) -> list[Region]:
+        """All regions overlapping [start, end)."""
+        with self._lock:
+            out = []
+            for r in self.regions:
+                if end is not None and end != b"" and r.start >= end:
+                    break
+                if r.end != b"" and r.end <= start:
+                    continue
+                out.append(r)
+            return out
+
+    def split_ranges(self, start: bytes, end: bytes) -> list[tuple["Region", bytes, bytes]]:
+        """Clip [start, end) against region boundaries → per-region subranges
+        (the buildCopTasks region alignment, copr/coprocessor.go:151)."""
+        out = []
+        for r in self.regions_in_range(start, end):
+            s = max(start, r.start)
+            e = end if r.end == b"" else (min(end, r.end) if end != b"" else r.end)
+            if e == b"" or s < e:
+                out.append((r, s, e))
+        return out

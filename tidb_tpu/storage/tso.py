@@ -1,0 +1,34 @@
+"""Timestamp oracle — the PD TSO stand-in (ref: unistore/pd.go fake PD).
+
+Timestamps are (physical_ms << 18) | logical, like TiDB's TSO, so they
+embed wall time yet stay strictly monotonic under bursts.
+"""
+
+from __future__ import annotations
+
+import time
+from threading import Lock
+
+
+class TSO:
+    LOGICAL_BITS = 18
+
+    def __init__(self):
+        self._lock = Lock()
+        self._last = 0
+
+    def next(self) -> int:
+        with self._lock:
+            phys = int(time.time() * 1000) << self.LOGICAL_BITS
+            ts = max(phys, self._last + 1)
+            self._last = ts
+            return ts
+
+    def current(self) -> int:
+        """A read-only timestamp (for stale reads / GC watermarks)."""
+        with self._lock:
+            return self._last
+
+    @staticmethod
+    def physical_ms(ts: int) -> int:
+        return ts >> TSO.LOGICAL_BITS
