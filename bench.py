@@ -1,0 +1,90 @@
+"""Benchmark: TPC-H Q1 through the full SQL path on the TPU cop engine.
+
+Prints ONE JSON line:
+  {"metric": "tpch_q1_rows_per_sec", "value": N, "unit": "rows/s",
+   "vs_baseline": tpu_throughput / host_numpy_throughput}
+
+The baseline is this framework's own host (numpy-vectorized) cop engine on
+identical data and plans — the stand-in for the reference's Go unistore
+closure executor (BASELINE.md: "≥10× unistore cop throughput" is the
+north star; the Go engine isn't runnable in this image, so the ratio is
+reported against the strongest CPU path available).
+
+Env knobs: BENCH_ROWS (default 2,000,000), BENCH_QUERY (q1|q6|topn).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def main():
+    # honor an explicit CPU request even though the axon plugin pins
+    # jax_platforms at interpreter start (env alone is too late here)
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    rows = int(os.environ.get("BENCH_ROWS", "2000000"))
+    which = os.environ.get("BENCH_QUERY", "q1")
+    reps = int(os.environ.get("BENCH_REPS", "5"))
+
+    from tidb_tpu.session import Session
+    from tidb_tpu.models import tpch
+
+    s = Session()
+    t0 = time.time()
+    tpch.setup_lineitem(s, rows)
+    load_s = time.time() - t0
+
+    q = {"q1": tpch.Q1, "q6": tpch.Q6, "topn": tpch.TOPN}[which]
+
+    def run(engine: str, n: int):
+        s.vars["tidb_cop_engine"] = engine
+        times = []
+        result = None
+        for _ in range(n):
+            t = time.time()
+            result = s.execute(q)
+            times.append(time.time() - t)
+        return result, min(times), statistics.median(times)
+
+    # warm both paths (compile + tile/device cache build)
+    host_res, _, _ = run("host", 1)
+    tpu_res, _, _ = run("tpu", 1)
+    if s.cop.tpu.fallbacks:
+        print(f"WARNING: tpu engine fell back {s.cop.tpu.fallbacks}x", file=sys.stderr)
+    assert host_res.rows() == tpu_res.rows(), "engine results diverge"
+
+    _, host_best, host_med = run("host", max(reps // 2, 2))
+    _, tpu_best, tpu_med = run("tpu", reps)
+
+    value = rows / tpu_med
+    vs = (rows / tpu_med) / (rows / host_med)
+    meta = {
+        "rows": rows,
+        "query": which,
+        "load_s": round(load_s, 2),
+        "tpu_median_s": round(tpu_med, 4),
+        "tpu_best_s": round(tpu_best, 4),
+        "host_median_s": round(host_med, 4),
+        "groups": len(tpu_res.rows()),
+    }
+    print(json.dumps(meta), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_{which}_rows_per_sec",
+                "value": round(value, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,37 @@ DIRECT_GROUP_MAX = 1 << 16
 _CMP_SWAP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
 
 
+def _dict_encode_lane(d: np.ndarray, v: np.ndarray):
+    """Vectorized sorted-dict encoding of an object lane → (int32 codes,
+    vocab list). Handles str lanes (numpy 'U' fast path) and bytes lanes
+    (latin-1 view: byte order == code-point order, so code order stays
+    binary-collation order); mixed lanes take the generic python path."""
+    if not v.any():
+        return np.zeros(len(d), np.int32), []
+    present = d[v]
+    kinds = {type(x) for x in present.tolist()}
+    if kinds <= {str}:
+        vals = np.where(v, d, "").astype("U")
+        vocab_arr = np.unique(vals[v])
+        codes = np.searchsorted(vocab_arr, vals).astype(np.int32)
+        codes[~v] = 0
+        return codes, vocab_arr.tolist()
+    if kinds <= {bytes}:
+        as_str = np.array([x.decode("latin-1") for x in present.tolist()], dtype="U")
+        vocab_arr = np.unique(as_str)
+        codes = np.zeros(len(d), np.int32)
+        codes[v] = np.searchsorted(vocab_arr, as_str).astype(np.int32)
+        return codes, [s.encode("latin-1") for s in vocab_arr.tolist()]
+    # mixed str/bytes/other: generic exact path
+    vocab = sorted({x if isinstance(x, str) else x.decode("latin-1") for x in present.tolist()})
+    code_of = {s: i for i, s in enumerate(vocab)}
+    codes = np.zeros(len(d), np.int32)
+    for i in np.nonzero(v)[0]:
+        x = d[i]
+        codes[i] = code_of[x if isinstance(x, str) else x.decode("latin-1")]
+    return codes, vocab
+
+
 class DeviceBatch:
     """Device-resident mirror of a ColumnBatch: [T, R] lanes per column."""
 
@@ -73,11 +104,7 @@ class DeviceBatch:
             d = self.batch.data[off]
             v = self.batch.valid[off]
             if d.dtype == object:
-                vocab = sorted({x for x, ok in zip(d.tolist(), v.tolist()) if ok})
-                code_of = {s: i for i, s in enumerate(vocab)}
-                codes = np.zeros(len(d), dtype=np.int32)
-                for i in np.nonzero(v)[0]:
-                    codes[i] = code_of[d[i]]
+                codes, vocab = _dict_encode_lane(d, v)
                 self.vocabs[off] = vocab
                 d = codes
             self._data[off] = jnp.asarray(self._pad2d(d))

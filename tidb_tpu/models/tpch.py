@@ -1,0 +1,174 @@
+"""TPC-H workload module — the framework's flagship "model family"
+(BASELINE.md configs: Q1/Q6/Q3/TopN on lineitem/orders/customer).
+
+Provides schema DDL, a fast numpy data generator, a bulk loader through
+the ingest path (the Lightning-analog, storage/mvcc.py ingest), and the
+benchmark queries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..codec.row import encode_row
+from ..codec import tablecodec
+from ..mysqltypes.coretime import pack_time
+from ..mysqltypes.datum import Datum, K_DEC, K_INT, K_STR, K_TIME
+from ..mysqltypes.mydecimal import Dec
+
+LINEITEM_DDL = """CREATE TABLE lineitem (
+  l_orderkey BIGINT NOT NULL,
+  l_partkey BIGINT NOT NULL,
+  l_suppkey BIGINT NOT NULL,
+  l_linenumber BIGINT NOT NULL,
+  l_quantity DECIMAL(15,2) NOT NULL,
+  l_extendedprice DECIMAL(15,2) NOT NULL,
+  l_discount DECIMAL(15,2) NOT NULL,
+  l_tax DECIMAL(15,2) NOT NULL,
+  l_returnflag CHAR(1) NOT NULL,
+  l_linestatus CHAR(1) NOT NULL,
+  l_shipdate DATE NOT NULL,
+  l_commitdate DATE NOT NULL,
+  l_receiptdate DATE NOT NULL,
+  KEY idx_ship (l_shipdate)
+)"""
+
+ORDERS_DDL = """CREATE TABLE orders (
+  o_orderkey BIGINT NOT NULL PRIMARY KEY,
+  o_custkey BIGINT NOT NULL,
+  o_orderstatus CHAR(1) NOT NULL,
+  o_totalprice DECIMAL(15,2) NOT NULL,
+  o_orderdate DATE NOT NULL,
+  o_orderpriority CHAR(15) NOT NULL,
+  o_shippriority BIGINT NOT NULL
+)"""
+
+CUSTOMER_DDL = """CREATE TABLE customer (
+  c_custkey BIGINT NOT NULL PRIMARY KEY,
+  c_name VARCHAR(25) NOT NULL,
+  c_mktsegment CHAR(10) NOT NULL,
+  c_acctbal DECIMAL(15,2) NOT NULL
+)"""
+
+Q1 = """SELECT l_returnflag, l_linestatus,
+  SUM(l_quantity) AS sum_qty,
+  SUM(l_extendedprice) AS sum_base_price,
+  SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+  SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+  AVG(l_quantity) AS avg_qty,
+  AVG(l_extendedprice) AS avg_price,
+  AVG(l_discount) AS avg_disc,
+  COUNT(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= '1998-09-02'
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus"""
+
+Q6 = """SELECT SUM(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= '1994-01-01' AND l_shipdate < '1995-01-01'
+  AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24"""
+
+TOPN = "SELECT l_orderkey, l_extendedprice FROM lineitem ORDER BY l_extendedprice DESC LIMIT 100"
+
+Q3 = """SELECT o.o_orderkey, SUM(l.l_extendedprice * (1 - l.l_discount)) AS revenue, o.o_orderdate
+FROM customer c JOIN orders o ON c.c_custkey = o.o_custkey
+JOIN lineitem l ON l.l_orderkey = o.o_orderkey
+WHERE c.c_mktsegment = 'BUILDING' AND o.o_orderdate < '1995-03-15' AND l.l_shipdate > '1995-03-15'
+GROUP BY o.o_orderkey, o.o_orderdate
+ORDER BY revenue DESC LIMIT 10"""
+
+
+def _rand_dates(rng, n, y0=1992, y1=1998):
+    """Packed date int64s uniform over [y0, y1]."""
+    years = rng.integers(y0, y1 + 1, n)
+    months = rng.integers(1, 13, n)
+    days = rng.integers(1, 29, n)
+    return ((years * 13 + months) * 32 + days) * (24 * 60 * 60 * 1_000_000)
+
+
+def gen_lineitem(n_rows: int, seed: int = 42) -> dict[str, np.ndarray]:
+    """Generate lineitem columns, distribution-shaped like dbgen."""
+    rng = np.random.default_rng(seed)
+    orderkey = np.sort(rng.integers(1, max(n_rows // 4, 2), n_rows))
+    qty = rng.integers(100, 5100, n_rows)  # 1.00..51.00 scale 2
+    price = rng.integers(90000, 10500000, n_rows)  # 900.00..105000.00
+    discount = rng.integers(0, 11, n_rows)  # 0.00..0.10
+    tax = rng.integers(0, 9, n_rows)
+    shipdate = _rand_dates(rng, n_rows)
+    rf = rng.choice(np.array(["A", "N", "R"], dtype=object), n_rows, p=[0.25, 0.5, 0.25])
+    ls = np.where(rng.random(n_rows) < 0.5, "O", "F").astype(object)
+    return {
+        "l_orderkey": orderkey,
+        "l_partkey": rng.integers(1, 200000, n_rows),
+        "l_suppkey": rng.integers(1, 10000, n_rows),
+        "l_linenumber": rng.integers(1, 8, n_rows),
+        "l_quantity": qty,
+        "l_extendedprice": price,
+        "l_discount": discount,
+        "l_tax": tax,
+        "l_returnflag": rf,
+        "l_linestatus": ls,
+        "l_shipdate": shipdate,
+        "l_commitdate": shipdate + 32 * 24 * 3600 * 1_000_000,
+        "l_receiptdate": shipdate + 33 * 24 * 3600 * 1_000_000,
+    }
+
+
+
+
+def _kind_of(ft) -> int:
+    if ft.is_decimal():
+        return K_DEC
+    if ft.is_time():
+        return K_TIME
+    if ft.is_string():
+        return K_STR
+    return K_INT
+
+
+def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: dict[str, int] | None = None, batch: int = 200_000):
+    """Bulk-load columns into a table through the ingest path (2PC bypass,
+    the Lightning local backend analog). Rows get sequential handles.
+    Column kinds derive from the table schema unless overridden."""
+    info = session.infoschema().table(session.current_db, table_name)
+    names = list(columns)
+    col_infos = [info.col_by_name(n) for n in names]
+    if kinds is None:
+        kinds = {n: _kind_of(c.ft) for n, c in zip(names, col_infos)}
+    col_ids = [c.id for c in col_infos]
+    n = len(columns[names[0]])
+    first_handle = session.alloc_auto_id(info, n)
+    arrays = [columns[n_] for n_ in names]
+    kind_list = [kinds[n_] for n_ in names]
+    commit_ts = session.store.tso.next()
+    scale_fix = []
+    for c, k in zip(col_infos, kind_list):
+        scale_fix.append(max(c.ft.decimal, 0) if k == K_DEC else 0)
+
+    kvs = []
+    for lo in range(0, n, batch):
+        hi = min(lo + batch, n)
+        for i in range(lo, hi):
+            datums = []
+            for arr, k, sf in zip(arrays, kind_list, scale_fix):
+                v = arr[i]
+                if k == K_DEC:
+                    datums.append(Datum.d(Dec(int(v), sf)))
+                elif k == K_STR:
+                    datums.append(Datum.s(v))
+                else:
+                    datums.append(Datum(k, int(v)))
+            kvs.append((tablecodec.record_key(info.id, first_handle + i), encode_row(col_ids, datums)))
+        session.store.mvcc.ingest(kvs, commit_ts)
+        kvs = []
+    session.store.bump_version([tablecodec.record_prefix(info.id)])
+    session.cop.tiles.invalidate_table(info.id)
+    return n
+
+
+def setup_lineitem(session, n_rows: int, seed: int = 42) -> int:
+    session.execute("DROP TABLE IF EXISTS lineitem")
+    session.execute(LINEITEM_DDL)
+    cols = gen_lineitem(n_rows, seed)
+    return bulk_load(session, "lineitem", cols)

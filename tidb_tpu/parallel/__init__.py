@@ -1,0 +1,1 @@
+from .mesh import make_mesh, Q1Spec, build_q1_arrays, q1_local_kernel, distributed_q1_step, hash_repartition

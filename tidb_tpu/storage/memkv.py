@@ -72,6 +72,23 @@ class MemKV:
             yield from batch
             cur = batch[-1][0] + b"\x00"
 
+    def bulk_load(self, pairs: list[tuple[bytes, bytes]]) -> None:
+        """Bulk ingest (the Lightning local-backend analog): sorts only the
+        NEW keys and merges with the existing sorted key array — O(m log m
+        + n + m), and a pure append when the batch lands past the tail."""
+        import heapq
+
+        with self.lock:
+            fresh = [k for k, _ in pairs if k not in self._map]
+            self._map.update(pairs)
+            if not fresh:
+                return
+            fresh = sorted(set(fresh))
+            if not self._keys or fresh[0] > self._keys[-1]:
+                self._keys.extend(fresh)
+            else:
+                self._keys = list(heapq.merge(self._keys, fresh))
+
     def delete_range(self, start: bytes, end: bytes) -> int:
         with self.lock:
             i = bisect.bisect_left(self._keys, start)

@@ -278,6 +278,15 @@ class MVCCStore:
             return True
         return False
 
+    def ingest(self, kvs: list[tuple[bytes, bytes]], commit_ts: int) -> None:
+        """Bulk ingest pre-committed data, bypassing 2PC (ref:
+        br/pkg/lightning local backend — builds SSTs and ingests)."""
+        pairs = []
+        for k, v in kvs:
+            pairs.append((_wk(k, commit_ts), WriteRecord(OP_PUT, commit_ts).encode()))
+            pairs.append((_dk(k, commit_ts), v))
+        self.kv.bulk_load(pairs)
+
     def unsafe_destroy_range(self, start: bytes, end: bytes) -> int:
         """Physically remove ALL versions/locks in a user-key range —
         the delete-range verb used when tables are dropped/truncated
