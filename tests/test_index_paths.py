@@ -1,0 +1,144 @@
+"""Index access paths: ranger, PointGet, IndexReader (covering),
+IndexLookUp double read (ref behavior: executor/distsql.go,
+executor/point_get.go, util/ranger)."""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create database d")
+    s.execute("use d")
+    s.execute(
+        "create table t (id int primary key, a int, b int, c varchar(20), "
+        "key ia (a), unique key ib (b), key iab (a, b))"
+    )
+    for i in range(50):
+        s.execute(f"insert into t values ({i}, {i % 10}, {i * 2}, 'v{i}')")
+    return s
+
+
+def _plan(s, sql) -> str:
+    rows = s.must_query(f"explain {sql}")
+    return "\n".join(r[0] for r in rows)
+
+
+def test_point_get_pk(s):
+    assert s.must_query("select id, a from t where id = 7") == [("7", "7")]
+    assert "point:[7]" in _plan(s, "select id, a from t where id = 7")
+
+
+def test_batch_point_get_pk_in(s):
+    got = s.must_query("select id from t where id in (3, 1, 40)")
+    assert sorted(got, key=lambda r: int(r[0])) == [("1",), ("3",), ("40",)]
+    assert "point:" in _plan(s, "select id from t where id in (3, 1, 40)")
+
+
+def test_point_get_miss(s):
+    assert s.must_query("select id from t where id = 999") == []
+
+
+def test_pk_range_scan(s):
+    got = s.must_query("select id from t where id >= 45 and id < 48")
+    assert sorted(got) == [("45",), ("46",), ("47",)]
+    assert "handle_ranges:1" in _plan(s, "select id from t where id >= 45 and id < 48")
+
+
+def test_index_reader_covering(s):
+    # select only indexed col + pk → covering
+    got = s.must_query("select id from t where a = 3")
+    assert sorted(got, key=lambda r: int(r[0])) == [("3",), ("13",), ("23",), ("33",), ("43",)]
+    assert "IndexReader(ia" in _plan(s, "select id from t where a = 3")
+
+
+def test_index_lookup_non_covering(s):
+    got = s.must_query("select c from t where a = 3")
+    assert sorted(got) == [("v13",), ("v23",), ("v3",), ("v33",), ("v43",)]
+    assert "IndexLookUp(ia" in _plan(s, "select c from t where a = 3")
+
+
+def test_unique_index_full_eq(s):
+    assert s.must_query("select id, c from t where b = 24") == [("12", "v12")]
+    assert "ib" in _plan(s, "select id from t where b = 24")
+
+
+def test_composite_index_eq_plus_range(s):
+    got = s.must_query("select id from t where a = 2 and b > 40")
+    # a=2 → ids 2,12,22,32,42; b=2*id > 40 → ids 22,32,42... b=44,64,84
+    assert sorted(got) == [("22",), ("32",), ("42",)]
+    assert "iab" in _plan(s, "select id from t where a = 2 and b > 40")
+
+
+def test_index_range_only(s):
+    got = s.must_query("select id from t where b >= 96")
+    assert sorted(got) == [("48",), ("49",)]
+
+
+def test_remaining_filter_applies(s):
+    # a=3 via index, extra non-access filter on c
+    got = s.must_query("select id from t where a = 3 and c = 'v13'")
+    assert got == [("13",)]
+
+
+def test_index_agg_pushdown(s):
+    got = s.must_query("select a, count(*) from t where a in (1, 2) group by a order by a")
+    assert got == [("1", "5"), ("2", "5")]
+
+
+def test_dirty_read_through_index(s):
+    s.execute("begin")
+    s.execute("insert into t values (100, 3, 200, 'v100')")
+    got = s.must_query("select id from t where a = 3 and id > 90")
+    assert got == [("100",)]
+    s.execute("rollback")
+    assert s.must_query("select id from t where a = 3 and id > 90") == []
+
+
+def test_update_delete_visible_via_index(s):
+    s.execute("update t set a = 99 where id = 5")
+    assert s.must_query("select id from t where a = 99") == [("5",)]
+    s.execute("delete from t where id = 5")
+    assert s.must_query("select id from t where a = 99") == []
+
+
+def test_null_excluded_from_ranges(s):
+    s.execute("insert into t values (200, null, null, null)")
+    assert s.must_query("select id from t where a > -100 and id >= 200") == []
+    assert s.must_query("select id from t where a is null and id >= 200") == [("200",)]
+
+
+def test_lossy_const_stays_filter(s):
+    # 1.5 can't equal an int col — must not crash, returns empty
+    assert s.must_query("select id from t where id = 1.5") == []
+    got = s.must_query("select id from t where a > 2.5 and a < 3.5")
+    assert sorted(got, key=lambda r: int(r[0])) == [("3",), ("13",), ("23",), ("33",), ("43",)]
+
+
+def test_string_index_range():
+    s = Session()
+    s.execute("create database d2")
+    s.execute("use d2")
+    s.execute("create table st (k varchar(10), v int, key ik (k))")
+    for k, v in [("apple", 1), ("banana", 2), ("cherry", 3), ("apricot", 4)]:
+        s.execute(f"insert into st values ('{k}', {v})")
+    got = s.must_query("select v from st where k >= 'apple' and k < 'b'")
+    assert sorted(got) == [("1",), ("4",)]
+    assert s.must_query("select v from st where k = 'cherry'") == [("3",)]
+
+
+def test_contradictory_eq_and_range(s):
+    # mixed eq + bound on one column must intersect, not drop the bound
+    assert s.must_query("select id from t where id = 1 and id > 5") == []
+    assert s.must_query("select id from t where a = 3 and a > 5") == []
+    assert s.must_query("select id from t where a = 3 and a >= 3 and id < 10") == [("3",)]
+    assert s.must_query("select id from t where id = 7 and id >= 7") == [("7",)]
+
+
+def test_empty_eq_intersection_stays_empty(s):
+    assert s.must_query("select id from t where a = 1 and a = 2 and a = 2") == []
+    assert s.must_query("select id from t where id = 1 and id = 2 and id = 2") == []
+    got = s.must_query("select id from t where a in (1, 2) and a in (2, 3)")
+    assert sorted(got) == [("12",), ("2",), ("22",), ("32",), ("42",)]

@@ -12,12 +12,16 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import numpy as np
+
 from ..chunk.chunk import Chunk
-from ..catalog.schema import TableInfo
+from ..catalog.schema import IndexInfo, TableInfo
 from ..codec import tablecodec
+from ..codec.key import decode_datum_key
+from ..mysqltypes.datum import Datum, K_BYTES
 from .dag import DAGRequest
 from .host_engine import execute_dag_host
-from .tilecache import TileCache
+from .tilecache import ColumnBatch, TileCache, decode_rows_to_batch
 
 
 @dataclass
@@ -45,6 +49,11 @@ class CopClient:
     @staticmethod
     def _txn_dirty(txn, table_id: int) -> bool:
         prefix = tablecodec.record_prefix(table_id)
+        return any(k.startswith(prefix) for k in txn.membuf)
+
+    @staticmethod
+    def _txn_dirty_index(txn, table_id: int, index_id: int) -> bool:
+        prefix = tablecodec.index_prefix(table_id, index_id)
         return any(k.startswith(prefix) for k in txn.membuf)
 
     def build_tasks(self, table_id: int, ranges: list[tuple[bytes, bytes]]) -> list[CopTask]:
@@ -78,10 +87,7 @@ class CopClient:
         dirty = txn is not None and self._txn_dirty(txn, table.id)
         out = []
         for t in tasks:
-            self.stats["tasks"] += 1
             if dirty:
-                from .tilecache import decode_rows_to_batch
-
                 kvs = [
                     (k, v)
                     for k, v in txn.scan(t.start, t.end)
@@ -92,17 +98,105 @@ class CopClient:
                 batch = self.tiles.get_batch(table, t.start, t.end, read_ts)
             if batch.n_rows == 0:
                 continue
-            chunk = None
-            if engine in ("tpu", "auto"):
-                try:
-                    chunk = self.tpu.execute(dag, batch)
-                    self.stats["tpu_tasks"] += 1
-                except Exception:
-                    if engine == "tpu":
-                        raise
-                    chunk = None
-            if chunk is None:
-                chunk = execute_dag_host(dag, batch)
-                self.stats["host_tasks"] += 1
-            out.append(chunk)
+            out.append(self._run_engines(dag, batch, engine))
         return out
+
+    # --- engine dispatch over an arbitrary batch --------------------------
+
+    def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str) -> Chunk:
+        self.stats["tasks"] += 1
+        if engine in ("tpu", "auto"):
+            try:
+                chunk = self.tpu.execute(dag, batch)
+                self.stats["tpu_tasks"] += 1
+                return chunk
+            except Exception:
+                if engine == "tpu":
+                    raise
+        chunk = execute_dag_host(dag, batch)
+        self.stats["host_tasks"] += 1
+        return chunk
+
+    # --- index scans (ref: executor/distsql.go IndexReader/IndexLookUp) ---
+
+    def _scan_kvs(self, start: bytes, end: bytes, read_ts: int, txn, dirty: bool):
+        if dirty:
+            return list(txn.scan(start, end))
+        return self.storage.snapshot(read_ts).scan(start, end)
+
+    def index_entries(
+        self, table: TableInfo, idx: IndexInfo, ranges: list[tuple[bytes, bytes]], read_ts: int, txn=None
+    ) -> list[tuple[list[Datum], int]]:
+        """Scan index key ranges → [(index column datums, row handle)] in
+        index key order (the stage-1 half of a double read)."""
+        dirty = txn is not None and self._txn_dirty_index(txn, table.id, idx.id)
+        prefix_len = len(tablecodec.index_prefix(table.id, idx.id))
+        ncols = len(idx.col_offsets)
+        out = []
+        for start, end in ranges:
+            for k, v in self._scan_kvs(start, end, read_ts, txn, dirty):
+                mv = memoryview(k)
+                pos = prefix_len
+                datums = []
+                for _ in range(ncols):
+                    d, pos = decode_datum_key(mv, pos)
+                    if d.kind == K_BYTES:
+                        d = Datum.s(d.val.decode("utf8", "replace"))
+                    datums.append(d)
+                if pos < len(k):
+                    handle = tablecodec.decode_index_handle(k)
+                else:
+                    handle = int(v)
+                out.append((datums, handle))
+        return out
+
+    def index_batch(
+        self, table: TableInfo, idx: IndexInfo, ranges, read_ts: int, txn=None
+    ) -> ColumnBatch:
+        """Index entries materialized as a full-visible-layout columnar
+        batch (covering reads): index-supplied lanes are filled, all other
+        lanes stay invalid — the planner guarantees they are unreferenced."""
+        entries = self.index_entries(table, idx, ranges, read_ts, txn)
+        n = len(entries)
+        handles = np.zeros(n, dtype=np.int64)
+        chk = Chunk.empty([c.ft for c in table.columns], n)
+        cols = chk.columns
+        hc = table.handle_col()
+        pk_off = hc.offset if (hc is not None and not hc.hidden) else None
+        for i, (datums, handle) in enumerate(entries):
+            handles[i] = handle
+            for off, d in zip(idx.col_offsets, datums):
+                cols[off].set_datum(i, d)
+            if pk_off is not None:
+                cols[pk_off].set_datum(i, Datum.i(handle))
+        ver, _ = self.storage.data_version(tablecodec.table_prefix(table.id))
+        return ColumnBatch(table, handles, [c.data for c in cols], [c.valid for c in cols], ver)
+
+    def send_index(
+        self, table: TableInfo, idx: IndexInfo, dag: DAGRequest, ranges, read_ts: int,
+        engine: str = "auto", txn=None,
+    ) -> list[Chunk]:
+        """Covering index read: one cop task per range batch."""
+        batch = self.index_batch(table, idx, ranges, read_ts, txn)
+        if batch.n_rows == 0:
+            return []
+        return [self._run_engines(dag, batch, engine)]
+
+    def send_handles(
+        self, table: TableInfo, dag: DAGRequest, handles: list[int], read_ts: int,
+        engine: str = "auto", txn=None,
+    ) -> list[Chunk]:
+        """Stage-2 of a double read: fetch rows by handle, run the DAG
+        (ref: IndexLookUp table-worker)."""
+        if not handles:
+            return []
+        keys = [tablecodec.record_key(table.id, h) for h in handles]
+        if txn is not None and self._txn_dirty(txn, table.id):
+            got = txn.batch_get(keys)
+        else:
+            got = self.storage.snapshot(read_ts).batch_get(keys)
+        kvs = [(k, got[k]) for k in keys if k in got]
+        batch = decode_rows_to_batch(table, kvs, (-1, 0))
+        if batch.n_rows == 0:
+            return []
+        return [self._run_engines(dag, batch, engine)]

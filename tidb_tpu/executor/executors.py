@@ -113,7 +113,14 @@ def _build_reader(ds: DataSource, ctx: ExecContext) -> "TableReaderExec":
     dag = DAGRequest(scan)
     if ds.pushed_conds:
         dag.selection = SelectionNode(ds.pushed_conds)
-    return TableReaderExec(ds.table, dag, ctx)
+    path = getattr(ds, "path", "table")
+    if path == "point":
+        return PointGetExec(ds.table, dag, ctx, ds.point_handles)
+    if path == "index":
+        return IndexReaderExec(ds.table, dag, ctx, ds.index, ds.key_ranges)
+    if path == "index_lookup":
+        return IndexLookUpExec(ds.table, dag, ctx, ds.index, ds.key_ranges)
+    return TableReaderExec(ds.table, dag, ctx, ranges=getattr(ds, "key_ranges", None))
 
 
 def _pushable_reader(e: Executor) -> "TableReaderExec | None":
@@ -205,6 +212,57 @@ class TableReaderExec(Executor):
         c = self._results[self._i]
         self._i += 1
         return c
+
+
+class IndexReaderExec(TableReaderExec):
+    """Covering index read — index entries decoded straight into the
+    visible-column layout, no second read (ref: executor/distsql.go
+    IndexReaderExecutor)."""
+
+    def __init__(self, table, dag: DAGRequest, ctx: ExecContext, index, ranges):
+        super().__init__(table, dag, ctx, ranges)
+        self.index = index
+
+    def open(self):
+        self._results = self.ctx.cop.send_index(
+            self.table, self.index, self.dag, self.ranges or [], self.ctx.read_ts,
+            self.ctx.engine, txn=self.ctx.txn,
+        )
+        self._i = 0
+
+
+class IndexLookUpExec(TableReaderExec):
+    """Double read: index scan → handles → table rows + DAG over them
+    (ref: executor/distsql.go IndexLookUpExecutor's index/table workers)."""
+
+    def __init__(self, table, dag: DAGRequest, ctx: ExecContext, index, ranges):
+        super().__init__(table, dag, ctx, ranges)
+        self.index = index
+
+    def open(self):
+        entries = self.ctx.cop.index_entries(
+            self.table, self.index, self.ranges or [], self.ctx.read_ts, txn=self.ctx.txn
+        )
+        handles = [h for _, h in entries]
+        self._results = self.ctx.cop.send_handles(
+            self.table, self.dag, handles, self.ctx.read_ts, self.ctx.engine, txn=self.ctx.txn
+        )
+        self._i = 0
+
+
+class PointGetExec(TableReaderExec):
+    """Handle-equality fast path bypassing the device engines
+    (ref: executor/point_get.go, batch_point_get.go)."""
+
+    def __init__(self, table, dag: DAGRequest, ctx: ExecContext, handles: list[int]):
+        super().__init__(table, dag, ctx, None)
+        self.handles = handles
+
+    def open(self):
+        self._results = self.ctx.cop.send_handles(
+            self.table, self.dag, self.handles, self.ctx.read_ts, "host", txn=self.ctx.txn
+        )
+        self._i = 0
 
 
 class SelectionExec(Executor):

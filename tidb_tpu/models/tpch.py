@@ -146,10 +146,17 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
     for c, k in zip(col_infos, kind_list):
         scale_fix.append(max(c.ft.decimal, 0) if k == K_DEC else 0)
 
+    from ..table.table import Table
+
+    tbl = Table(info)
+    offsets = [c.offset for c in col_infos]
+    n_tbl_cols = len(info.columns)
+    indexes = [ix for ix in info.indexes if ix.state != "delete_only" and not (info.pk_is_handle and ix.primary)]
     kvs = []
     for lo in range(0, n, batch):
         hi = min(lo + batch, n)
         for i in range(lo, hi):
+            handle = first_handle + i
             datums = []
             for arr, k, sf in zip(arrays, kind_list, scale_fix):
                 v = arr[i]
@@ -159,7 +166,17 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
                     datums.append(Datum.s(v))
                 else:
                     datums.append(Datum(k, int(v)))
-            kvs.append((tablecodec.record_key(info.id, first_handle + i), encode_row(col_ids, datums)))
+            kvs.append((tablecodec.record_key(info.id, handle), encode_row(col_ids, datums)))
+            if indexes:
+                full = [Datum.null()] * n_tbl_cols
+                for off, d in zip(offsets, datums):
+                    full[off] = d
+                for c in info.columns:
+                    if c.hidden and c.name == "_tidb_rowid":
+                        full[c.offset] = Datum.i(handle)
+                for ix in indexes:
+                    ikey, ival, _ = tbl.index_value_key(ix, full, handle)
+                    kvs.append((ikey, ival))
         session.store.mvcc.ingest(kvs, commit_ts)
         kvs = []
     session.store.bump_version([tablecodec.record_prefix(info.id)])

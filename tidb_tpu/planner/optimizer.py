@@ -12,13 +12,15 @@ from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc
 from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, Projection, Selection, SetOp, Sort
 
 
-def optimize(plan: LogicalPlan) -> LogicalPlan:
+def optimize(plan: LogicalPlan, stats=None) -> LogicalPlan:
     # Column pruning is implicit in this architecture: the tile cache holds
     # whole-table columnar batches decoded once per version, host chunks
     # reference those arrays zero-copy, and the device engine ships only
-    # lanes referenced by DAG expressions. An explicit pruning pass returns
-    # when index-path selection needs per-path column sets.
-    return push_down_predicates(plan)
+    # lanes referenced by DAG expressions. The usage analysis below serves
+    # index-covering decisions.
+    plan = push_down_predicates(plan)
+    choose_access_paths(plan, stats)
+    return plan
 
 
 # --------------------------------------------------------------- predicates
@@ -121,3 +123,185 @@ def push_down_predicates(plan: LogicalPlan, conds: list[Expression] | None = Non
     if conds:
         return Selection(plan, conds)
     return plan
+
+
+# ------------------------------------------------------- access path choice
+
+
+def _analyze_usage(node: LogicalPlan, uses: dict):
+    """Map each node's output columns back to (DataSource, visible-pos) and
+    record which DataSource columns any expression reads. Returns the
+    colmap for `node`'s output schema (None for derived columns)."""
+    from ..expr.expression import Column as EC
+
+    if isinstance(node, DataSource):
+        u = uses.setdefault(id(node), set())
+        for c in node.pushed_conds:
+            u |= _cols_of(c)
+        return [(node, i) for i in range(len(node.out_cols))]
+    if isinstance(node, Dual):
+        return [None] * len(node.out_cols)
+
+    maps = [_analyze_usage(c, uses) for c in node.children]
+
+    def mark(e: Expression, colmap):
+        for i in _cols_of(e):
+            m = colmap[i] if 0 <= i < len(colmap) else None
+            if m is not None:
+                uses[id(m[0])].add(m[1])
+
+    if isinstance(node, Selection):
+        for c in node.conds:
+            mark(c, maps[0])
+        return maps[0]
+    if isinstance(node, Projection):
+        for e in node.exprs:
+            mark(e, maps[0])
+        return [
+            maps[0][e.idx] if isinstance(e, EC) and 0 <= e.idx < len(maps[0]) else None
+            for e in node.exprs
+        ]
+    if isinstance(node, Aggregation):
+        for e in node.group_by:
+            mark(e, maps[0])
+        for a in node.aggs:
+            for arg in a.args:
+                mark(arg, maps[0])
+        out = [
+            maps[0][e.idx] if isinstance(e, EC) and 0 <= e.idx < len(maps[0]) else None
+            for e in node.group_by
+        ]
+        out += [None] * (len(node.out_cols) - len(out))
+        return out
+    if isinstance(node, Join):
+        cm = maps[0] + maps[1]
+        for le, re_ in node.eq_conds:
+            mark(le, maps[0])
+            mark(re_, maps[1])
+        for c in node.other_conds:
+            mark(c, cm)
+        return cm
+    if isinstance(node, Sort):
+        for e, _ in node.by:
+            mark(e, maps[0])
+        return maps[0]
+    if isinstance(node, Limit):
+        return maps[0]
+    if isinstance(node, SetOp):
+        # outputs are merged across children: conservatively mark all
+        for m in maps:
+            for entry in m:
+                if entry is not None:
+                    uses[id(entry[0])].add(entry[1])
+        return [None] * len(node.out_cols)
+    # unknown node: conservative — everything below counts as used
+    for m in maps:
+        for entry in m:
+            if entry is not None:
+                uses[id(entry[0])].add(entry[1])
+    return [None] * len(node.out_cols)
+
+
+def choose_access_paths(root: LogicalPlan, stats=None) -> None:
+    """Pick per-DataSource access paths: PointGet / table handle ranges /
+    covering IndexReader / IndexLookUp double read (ref: planner/core
+    find_best_task.go skyline+cost pruning; here a deterministic heuristic
+    until the statistics CBO lands)."""
+    uses: dict = {}
+    root_map = _analyze_usage(root, uses)
+    for entry in root_map:
+        if entry is not None:
+            uses[id(entry[0])].add(entry[1])
+
+    def walk(n: LogicalPlan):
+        if isinstance(n, DataSource):
+            _choose_for_ds(n, uses.get(id(n), set()), stats)
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+
+
+def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
+    from . import ranger
+
+    table = ds.table
+    visible = table.visible_columns()
+    vis_by_off = {c.offset: i for i, c in enumerate(visible)}
+    ds.path = "table"
+    ds.index = None
+    ds.key_ranges = None
+    ds.point_handles = None
+    conds = ds.pushed_conds
+
+    # 1. clustered pk → point handles / record ranges
+    pk_vis = None
+    if table.pk_is_handle:
+        hc = table.handle_col()
+        if hc is not None and hc.offset in vis_by_off:
+            pk_vis = vis_by_off[hc.offset]
+    ha = None
+    if pk_vis is not None and conds:
+        ha = ranger.detach_handle_conditions(conds, table.id, pk_vis)
+        if ha is not None and ha.point_handles is not None:
+            ds.path = "point"
+            ds.point_handles = ha.point_handles
+            _drop_conds(ds, ha.access_conds)
+            return
+
+    # 2. secondary indexes
+    best = None  # (score, idx, ia)
+    for idx in table.indexes:
+        if idx.state != "public" or (table.pk_is_handle and idx.primary):
+            continue
+        col_vis, col_fts = [], []
+        ok = True
+        for off in idx.col_offsets:
+            if off not in vis_by_off:
+                ok = False
+                break
+            col_vis.append(vis_by_off[off])
+            col_fts.append(table.columns[off].ft)
+        if not ok:
+            continue
+        ia = ranger.detach_index_conditions(conds, table.id, idx.id, col_vis, col_fts)
+        if ia is None:
+            continue
+        score = ia.eq_count * 2 + (1 if ia.has_range else 0)
+        if idx.unique and ia.eq_count == len(idx.col_offsets):
+            score += 100
+        if best is None or score > best[0]:
+            best = (score, idx, ia, col_vis)
+
+    if best is not None and best[0] > 0:
+        score, idx, ia, col_vis = best
+        covered = set(col_vis)
+        if pk_vis is not None:
+            covered.add(pk_vis)
+        remaining = [c for c in conds if not any(c is a for a in ia.access_conds)]
+        need = set(used)
+        for c in remaining:
+            need |= _cols_of(c)
+        covering = need <= covered
+        # Without row-count stats a range-only (no equality prefix) match is
+        # presumed unselective: a double read would out-cost the table scan,
+        # so only a covering IndexReader may take it (find_best_task.go's
+        # cost pruning approximated; the statistics CBO refines this).
+        if ia.eq_count == 0 and not covering:
+            best = None
+        else:
+            ds.index = idx
+            ds.key_ranges = ia.ranges
+            ds.path = "index" if covering else "index_lookup"
+            _drop_conds(ds, ia.access_conds)
+            return
+
+    # 3. pk record ranges
+    if ha is not None and ha.ranges is not None:
+        ds.path = "table"
+        ds.key_ranges = ha.ranges
+        _drop_conds(ds, ha.access_conds)
+
+
+def _drop_conds(ds: DataSource, consumed: list) -> None:
+    ds.pushed_conds = [c for c in ds.pushed_conds if not any(c is a for a in consumed)]

@@ -51,6 +51,14 @@ class DataSource(LogicalPlan):
 
     def describe(self):
         s = f"DataSource({self.alias or self.table.name})"
+        path = getattr(self, "path", "table")
+        if path == "point":
+            s += f" point:{self.point_handles!r}"
+        elif path in ("index", "index_lookup"):
+            kind = "IndexReader" if path == "index" else "IndexLookUp"
+            s += f" {kind}({self.index.name}, {len(self.key_ranges)} ranges)"
+        elif getattr(self, "key_ranges", None) is not None:
+            s += f" handle_ranges:{len(self.key_ranges)}"
         if self.pushed_conds:
             s += f" pushed:{self.pushed_conds!r}"
         return s
