@@ -1,0 +1,180 @@
+"""Statistics subsystem: histogram/CMSketch/FMSketch accuracy, ANALYZE,
+selectivity-driven access paths, auto-analyze policy (ref: statistics/,
+statistics/handle/)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.statistics import CMSketch, FMSketch, Histogram
+from tidb_tpu.statistics.cmsketch import hash_values
+
+
+class TestSketches:
+    def test_histogram_range_estimates(self):
+        rng = np.random.default_rng(1)
+        vals = rng.integers(0, 1000, size=50_000).astype(np.float64)
+        h = Histogram.build(vals, total_rows=len(vals), ndv=1000)
+        est = h.range_row_count(100.0, 200.0, True, False)
+        true = ((vals >= 100) & (vals < 200)).sum()
+        assert abs(est - true) / true < 0.15
+        assert abs(h.less_row_count(500.0) - (vals < 500).sum()) / len(vals) < 0.05
+
+    def test_histogram_scaled_sample(self):
+        # built from a 10% sample but scaled to full count
+        vals = np.arange(100_000, dtype=np.float64)
+        h = Histogram.build(vals[::10], total_rows=len(vals), ndv=100_000)
+        assert abs(h.less_row_count(50_000.0) - 50_000) < 2500
+
+    def test_cmsketch_point_queries(self):
+        cms = CMSketch()
+        vals = np.arange(5000, dtype=np.float64)
+        counts = np.ones(5000, dtype=np.int64) * 3
+        cms.insert_many(hash_values(vals), counts)
+        h = int(hash_values(np.array([42.0]))[0])
+        q = cms.query_hash(h)
+        assert q >= 3  # CMS never undercounts
+        assert q <= 30  # and rarely overcounts by much at this load
+
+    def test_fmsketch_ndv(self):
+        fm = FMSketch(max_size=1000)
+        rng = np.random.default_rng(2)
+        vals = rng.integers(0, 20_000, size=100_000)
+        fm.insert_hashes(hash_values(vals.astype(np.float64)))
+        ndv = fm.ndv()
+        assert 0.7 * 20_000 < ndv < 1.3 * 20_000
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create database d")
+    s.execute("use d")
+    s.execute("create table t (id int primary key, grp int, val int, pad varchar(16), key ig (grp))")
+    # grp: 100 groups x 20 rows; val uniform
+    rows = []
+    for i in range(2000):
+        rows.append(f"({i}, {i % 100}, {i * 7 % 1000}, 'p{i}')")
+    s.execute("insert into t values " + ",".join(rows))
+    return s
+
+
+class TestAnalyze:
+    def test_analyze_and_show_stats(self, s):
+        s.execute("analyze table t")
+        meta = s.must_query("show stats_meta")
+        assert ("d", "t", "0", "2000") == meta[0][:4]
+        hist = s.must_query("show stats_histograms")
+        cols = {r[2]: r for r in hist}
+        assert int(cols["grp"][3]) == 100  # exact NDV
+        assert int(cols["id"][3]) == 2000
+        assert int(cols["grp"][4]) == 0  # null count
+
+    def test_stats_persist_across_sessions(self, s):
+        s.execute("analyze table t")
+        s2 = Session(storage=s.store)
+        s2.execute("use d")
+        ts = s2.store.stats.get(s.infoschema().table("d", "t").id)
+        assert ts is not None and ts.row_count == 2000
+
+    def test_index_chosen_when_selective(self, s):
+        s.execute("analyze table t")
+        plan = "\n".join(r[0] for r in s.must_query("explain select pad from t where grp = 5"))
+        # 20 of 2000 rows → double read wins
+        assert "IndexLookUp(ig" in plan
+
+    def test_table_scan_when_unselective(self, s):
+        s.execute("analyze table t")
+        plan = "\n".join(r[0] for r in s.must_query("explain select pad from t where grp >= 1"))
+        # ~99% of rows match → stay on the table scan
+        assert "IndexLookUp" not in plan
+
+    def test_range_only_lookup_when_selective(self, s):
+        s.execute("analyze table t")
+        # grp >= 98 matches ~2% → with stats the range-only double read is
+        # allowed (the no-stats heuristic would refuse it)
+        plan = "\n".join(r[0] for r in s.must_query("explain select pad from t where grp >= 98"))
+        assert "IndexLookUp(ig" in plan
+        got = s.must_query("select count(*) from t where grp >= 98")
+        assert got == [("40",)]
+
+    def test_auto_analyze_trigger(self, s):
+        s.execute("analyze table t")
+        hid = s.infoschema().table("d", "t").id
+        # bulk modifications beyond ratio 0.5 + min 1000:
+        # 2500 mods / 4500 rows = 0.56 > 0.5
+        rows = ",".join(f"({i}, {i % 100}, 0, 'x')" for i in range(5000, 7500))
+        s.execute("insert into t values " + rows)
+        ts = s.store.stats.get(hid)
+        assert ts.modify_count == 0  # auto-analyze ran at commit boundary
+        assert ts.row_count == 4500
+
+    def test_analyze_string_and_null_stats(self):
+        s = Session()
+        s.execute("create database d3")
+        s.execute("use d3")
+        s.execute("create table u (a varchar(10), b int)")
+        s.execute("insert into u values ('x', 1), ('x', 2), ('y', null), (null, 4)")
+        s.execute("analyze table u")
+        hist = {r[2]: r for r in s.must_query("show stats_histograms")}
+        assert int(hist["a"][3]) == 2  # ndv: x, y
+        assert int(hist["a"][4]) == 1  # one null
+        assert int(hist["b"][4]) == 1
+
+
+class TestRegressions:
+    def test_rollback_does_not_skew_stats(self):
+        s = Session()
+        s.execute("create database dr")
+        s.execute("use dr")
+        s.execute("create table t (id int primary key, v int)")
+        rows = ",".join(f"({i}, {i})" for i in range(20))
+        s.execute("insert into t values " + rows)
+        s.execute("analyze table t")
+        tid = s.infoschema().table("dr", "t").id
+        s.execute("begin")
+        s.execute("delete from t")
+        s.execute("rollback")
+        ts = s.store.stats.get(tid)
+        assert ts.row_count == 20 and ts.modify_count == 0
+        # committed txn DOES flush
+        s.execute("begin")
+        s.execute("delete from t where id < 5")
+        s.execute("commit")
+        ts = s.store.stats.get(tid)
+        assert ts.row_count == 15 and ts.modify_count == 5
+
+    def test_covering_not_chosen_over_join_key(self):
+        # right join key must count as used → no covering IndexReader that
+        # drops the key lane
+        s = Session()
+        s.execute("create database dj")
+        s.execute("use dj")
+        s.execute("create table t (id int primary key, b int)")
+        s.execute("create table r (rid int primary key, x int, y int, key ix (x))")
+        s.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+        s.execute("insert into r values (1, 1, 10), (2, 1, 20), (3, 2, 30)")
+        got = s.must_query("select t.id from t join r on t.b = r.y where r.x = 1 order by t.id")
+        assert got == [("1",), ("2",)]
+
+    def test_bulk_load_clustered_pk_handles(self):
+        from tidb_tpu.models import tpch
+        import numpy as np
+
+        s = Session()
+        s.execute("create database db")
+        s.execute("use db")
+        s.execute(tpch.ORDERS_DDL)
+        cols = {
+            "o_orderkey": np.array([100, 7, 55]),
+            "o_custkey": np.array([1, 2, 3]),
+            "o_orderstatus": np.array(["O", "F", "O"], dtype=object),
+            "o_totalprice": np.array([1000, 2000, 3000]),
+            "o_orderdate": np.array([0, 0, 0]),
+            "o_orderpriority": np.array(["1-URGENT"] * 3, dtype=object),
+            "o_shippriority": np.array([0, 0, 0]),
+        }
+        tpch.bulk_load(s, "orders", cols)
+        assert s.must_query("select o_custkey from orders where o_orderkey = 7") == [("2",)]
+        assert s.must_query("select o_custkey from orders where o_orderkey = 55") == [("3",)]
+        assert s.must_query("select count(*) from orders") == [("3",)]

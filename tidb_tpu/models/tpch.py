@@ -138,7 +138,16 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
         kinds = {n: _kind_of(c.ft) for n, c in zip(names, col_infos)}
     col_ids = [c.id for c in col_infos]
     n = len(columns[names[0]])
-    first_handle = session.alloc_auto_id(info, n)
+    # clustered int pk: the pk VALUE is the row handle (ref: tables.go
+    # AddRecord pkIsHandle) — sequential handles would mis-key PointGet
+    # and index back-reads
+    pk_handle_pos = None
+    if info.pk_is_handle:
+        hc = info.handle_col()
+        pk_handle_pos = next(i for i, c in enumerate(col_infos) if c.offset == hc.offset)
+        first_handle = None
+    else:
+        first_handle = session.alloc_auto_id(info, n)
     arrays = [columns[n_] for n_ in names]
     kind_list = [kinds[n_] for n_ in names]
     commit_ts = session.store.tso.next()
@@ -156,7 +165,6 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
     for lo in range(0, n, batch):
         hi = min(lo + batch, n)
         for i in range(lo, hi):
-            handle = first_handle + i
             datums = []
             for arr, k, sf in zip(arrays, kind_list, scale_fix):
                 v = arr[i]
@@ -166,6 +174,7 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
                     datums.append(Datum.s(v))
                 else:
                     datums.append(Datum(k, int(v)))
+            handle = datums[pk_handle_pos].to_int() if pk_handle_pos is not None else first_handle + i
             kvs.append((tablecodec.record_key(info.id, handle), encode_row(col_ids, datums)))
             if indexes:
                 full = [Datum.null()] * n_tbl_cols

@@ -1087,6 +1087,10 @@ class Parser:
             self.expect_kw("TABLE")
             node.kind = "create_table"
             node.target = self._table_name()
+        elif self.try_kw("STATS_META"):
+            node.kind = "stats_meta"
+        elif self.try_kw("STATS_HISTOGRAMS"):
+            node.kind = "stats_histograms"
         elif self.try_kw("VARIABLES"):
             node.kind = "variables"
         elif self.try_kw("COLUMNS") or self.try_kw("FIELDS"):

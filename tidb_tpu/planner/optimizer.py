@@ -174,10 +174,12 @@ def _analyze_usage(node: LogicalPlan, uses: dict):
         out += [None] * (len(node.out_cols) - len(out))
         return out
     if isinstance(node, Join):
+        # eq_conds exprs reference the CONCATENATED schema (the executor
+        # shifts right keys child-local at build time) — mark against cm
         cm = maps[0] + maps[1]
         for le, re_ in node.eq_conds:
-            mark(le, maps[0])
-            mark(re_, maps[1])
+            mark(le, cm)
+            mark(re_, cm)
         for c in node.other_conds:
             mark(c, cm)
         return cm
@@ -233,6 +235,7 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
     ds.key_ranges = None
     ds.point_handles = None
     conds = ds.pushed_conds
+    tstats = stats.get(table.id) if stats is not None else None
 
     # 1. clustered pk → point handles / record ranges
     pk_vis = None
@@ -249,8 +252,8 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
             _drop_conds(ds, ha.access_conds)
             return
 
-    # 2. secondary indexes
-    best = None  # (score, idx, ia)
+    # 2. secondary indexes — gather candidates
+    candidates = []  # (idx, ia, col_vis, covering)
     for idx in table.indexes:
         if idx.state != "public" or (table.pk_is_handle and idx.primary):
             continue
@@ -267,14 +270,6 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
         ia = ranger.detach_index_conditions(conds, table.id, idx.id, col_vis, col_fts)
         if ia is None:
             continue
-        score = ia.eq_count * 2 + (1 if ia.has_range else 0)
-        if idx.unique and ia.eq_count == len(idx.col_offsets):
-            score += 100
-        if best is None or score > best[0]:
-            best = (score, idx, ia, col_vis)
-
-    if best is not None and best[0] > 0:
-        score, idx, ia, col_vis = best
         covered = set(col_vis)
         if pk_vis is not None:
             covered.add(pk_vis)
@@ -282,19 +277,46 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
         need = set(used)
         for c in remaining:
             need |= _cols_of(c)
-        covering = need <= covered
-        # Without row-count stats a range-only (no equality prefix) match is
-        # presumed unselective: a double read would out-cost the table scan,
-        # so only a covering IndexReader may take it (find_best_task.go's
-        # cost pruning approximated; the statistics CBO refines this).
-        if ia.eq_count == 0 and not covering:
-            best = None
-        else:
-            ds.index = idx
-            ds.key_ranges = ia.ranges
-            ds.path = "index" if covering else "index_lookup"
-            _drop_conds(ds, ia.access_conds)
-            return
+        candidates.append((idx, ia, col_vis, need <= covered))
+
+    chosen = None
+    if tstats is not None and tstats.row_count > 0 and candidates:
+        # cost-based: est rows through the access conds vs full scan;
+        # a double read pays a per-row lookup penalty (ref: find_best_task
+        # cost model, coefficients simplified)
+        from ..statistics.selectivity import estimate_conds
+
+        total = float(tstats.row_count)
+        best_cost = total  # full table scan
+        for idx, ia, col_vis, covering in candidates:
+            est = estimate_conds(tstats, ia.access_conds, visible) * total
+            if not ia.ranges:
+                est = 0.0
+            cost = est * (1.1 if covering else 3.0)
+            if cost < best_cost:
+                best_cost = cost
+                chosen = (idx, ia, covering)
+    elif candidates:
+        # no stats: deterministic heuristic — eq-prefix beats range-only;
+        # range-only allowed only when covering (presumed unselective)
+        best_score = 0
+        for idx, ia, col_vis, covering in candidates:
+            score = ia.eq_count * 2 + (1 if ia.has_range else 0)
+            if idx.unique and ia.eq_count == len(idx.col_offsets):
+                score += 100
+            if ia.eq_count == 0 and not covering:
+                continue
+            if score > best_score:
+                best_score = score
+                chosen = (idx, ia, covering)
+
+    if chosen is not None:
+        idx, ia, covering = chosen
+        ds.index = idx
+        ds.key_ranges = ia.ranges
+        ds.path = "index" if covering else "index_lookup"
+        _drop_conds(ds, ia.access_conds)
+        return
 
     # 3. pk record ranges
     if ha is not None and ha.ranges is not None:

@@ -96,7 +96,7 @@ class Join(LogicalPlan):
     def __init__(self, left, right, kind: str, eq_conds, other_conds, cols):
         super().__init__([left, right], cols)
         self.kind = kind  # inner | left | right | cross
-        self.eq_conds = eq_conds  # [(left_expr, right_expr)] offsets child-local
+        self.eq_conds = eq_conds  # [(left_expr, right_expr)] over the concatenated schema
         self.other_conds = other_conds  # over concatenated schema
 
     def describe(self):

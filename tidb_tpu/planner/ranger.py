@@ -223,7 +223,6 @@ def detach_index_conditions(
 
     idx_prefix = tablecodec.index_prefix(table_id, index_id)
     eq_values: list[list[Datum]] = []  # per eq column: candidate values
-    consumed: list[Expression] = []
     i = 0
     for off in col_offsets:
         a = acc.get(off)

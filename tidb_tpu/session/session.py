@@ -67,6 +67,9 @@ class Session:
         self._is_cache: InfoSchema | None = None
         self.warnings: list[str] = []
         self.last_insert_id = 0
+        # stats deltas buffered per-txn, flushed only on commit
+        # (ref: statistics/handle SessionStatsCollector)
+        self._pending_deltas: dict[int, list[int]] = {}
         self._bootstrap()
 
     # ------------------------------------------------------------- bootstrap
@@ -105,16 +108,36 @@ class Session:
             self.txn = self.store.begin()
         return self.txn
 
+    def _note_delta(self, table_id: int, changed: int, delta_rows: int) -> None:
+        d = self._pending_deltas.setdefault(table_id, [0, 0])
+        d[0] += changed
+        d[1] += delta_rows
+
+    def _flush_deltas(self) -> None:
+        for tid, (m, d) in self._pending_deltas.items():
+            self.store.stats.report_delta(tid, m, d)
+        self._pending_deltas.clear()
+
+    def _txn_committed(self) -> None:
+        """Post-commit hooks: flush stats deltas, auto-analyze trigger check
+        (ref: domain autoAnalyzeWorker — ratio policy runs at commit
+        boundaries, not a bg loop)."""
+        self._flush_deltas()
+        if self.vars.get("tidb_enable_auto_analyze", "ON") == "ON":
+            self.store.stats.auto_analyze(self)
+
     def _finish_stmt(self):
         """Autocommit unless inside an explicit transaction."""
         if self.txn is not None and not self.in_explicit_txn:
             self.txn.commit()
             self.txn = None
+            self._txn_committed()
 
     def _abort_stmt(self):
         if self.txn is not None and not self.in_explicit_txn:
             self.txn.rollback()
             self.txn = None
+            self._pending_deltas.clear()
 
     def read_ts(self) -> int:
         if self.txn is not None:
@@ -176,6 +199,7 @@ class Session:
         if isinstance(stmt, ast.Begin):
             if self.txn is not None:
                 self.txn.commit()
+                self._flush_deltas()
             self.txn = self.store.begin()
             self.in_explicit_txn = True
             return ResultSet([], None)
@@ -184,12 +208,14 @@ class Session:
                 self.txn.commit()
             self.txn = None
             self.in_explicit_txn = False
+            self._txn_committed()
             return ResultSet([], None)
         if isinstance(stmt, ast.Rollback):
             if self.txn is not None:
                 self.txn.rollback()
             self.txn = None
             self.in_explicit_txn = False
+            self._pending_deltas.clear()
             return ResultSet([], None)
         if isinstance(stmt, ast.SetStmt):
             for scope, name, val in stmt.assignments:
@@ -201,7 +227,7 @@ class Session:
         if isinstance(stmt, ast.Explain):
             return self._run_explain(stmt)
         if isinstance(stmt, ast.AnalyzeTable):
-            return ResultSet([], None)  # stats plumbing lands with CBO
+            return self._run_analyze(stmt)
         if isinstance(stmt, ast.FlushStmt):
             return ResultSet([], None)
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
@@ -218,7 +244,7 @@ class Session:
     def plan_select(self, stmt):
         builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
         plan = builder.build_select(stmt)
-        return optimize(plan)
+        return optimize(plan, self.store.stats)
 
     def run_select(self, stmt) -> ResultSet:
         plan = self.plan_select(stmt)
@@ -341,6 +367,7 @@ class Session:
                     datums[col.offset] = self._eval_insert_value(v, col)
             affected += self._insert_row(tbl, txn, datums, stmt)
         self.cop.tiles.invalidate_table(info.id)
+        self._note_delta(info.id, affected, affected)
         return ResultSet([], None, affected=affected, last_insert_id=self.last_insert_id)
 
     def _insert_row(self, tbl: Table, txn, datums: list[Datum], stmt) -> int:
@@ -466,6 +493,7 @@ class Session:
                 tbl.update_record(txn, handle, datums, new)
                 affected += 1
         self.cop.tiles.invalidate_table(info.id)
+        self._note_delta(info.id, affected, 0)
         return ResultSet([], None, affected=affected)
 
     def _run_delete(self, stmt: ast.Delete) -> ResultSet:
@@ -475,6 +503,7 @@ class Session:
         for handle, datums in rows:
             tbl.remove_record(txn, handle, datums)
         self.cop.tiles.invalidate_table(info.id)
+        self._note_delta(info.id, len(rows), -len(rows))
         return ResultSet([], None, affected=len(rows))
 
     # ------------------------------------------------------------------- DDL
@@ -797,6 +826,33 @@ class Session:
             ]
             chk = Chunk.from_datum_rows([ft_varchar(), ft_varchar()], rows)
             return ResultSet(["Variable_name", "Value"], chk)
+        if stmt.kind == "stats_meta":
+            rows = []
+            for db in is_.db_names():
+                for t in is_.tables_in_db(db):
+                    ts = self.store.stats.get(t.id)
+                    if ts is None:
+                        continue
+                    rows.append([Datum.s(db), Datum.s(t.name), Datum.s(str(ts.modify_count)),
+                                 Datum.s(str(ts.row_count)), Datum.s(str(ts.version))])
+            chk = Chunk.from_datum_rows([ft_varchar()] * 5, rows)
+            return ResultSet(["Db_name", "Table_name", "Modify_count", "Row_count", "Version"], chk)
+        if stmt.kind == "stats_histograms":
+            rows = []
+            for db in is_.db_names():
+                for t in is_.tables_in_db(db):
+                    ts = self.store.stats.get(t.id)
+                    if ts is None:
+                        continue
+                    for c in t.visible_columns():
+                        cs = ts.col(c.id)
+                        if cs is None:
+                            continue
+                        nb = len(cs.hist.uppers) if cs.hist is not None else 0
+                        rows.append([Datum.s(db), Datum.s(t.name), Datum.s(c.name),
+                                     Datum.s(str(cs.ndv)), Datum.s(str(cs.null_count)), Datum.s(str(nb))])
+            chk = Chunk.from_datum_rows([ft_varchar()] * 6, rows)
+            return ResultSet(["Db_name", "Table_name", "Column_name", "Distinct_count", "Null_count", "Buckets"], chk)
         if stmt.kind == "create_table":
             info = is_.table(stmt.target.db or self.current_db, stmt.target.name)
             chk = Chunk.from_datum_rows(
@@ -853,6 +909,14 @@ class Session:
         return f"CREATE TABLE `{info.name}` (\n{body}\n) ENGINE=tpu"
 
     # --------------------------------------------------------------- EXPLAIN
+
+    def _run_analyze(self, stmt: ast.AnalyzeTable) -> ResultSet:
+        """ANALYZE TABLE — full stats build over columnar batches
+        (ref: executor/analyze.go:68)."""
+        for tn in stmt.tables:
+            info = self.infoschema().table(tn.db or self.current_db, tn.name)
+            self.store.stats.analyze_table(self, info)
+        return ResultSet([], None)
 
     def _run_explain(self, stmt: ast.Explain) -> ResultSet:
         if not isinstance(stmt.stmt, (ast.Select, ast.SetOpSelect)):

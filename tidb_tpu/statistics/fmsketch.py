@@ -1,0 +1,37 @@
+"""Flajolet-Martin sketch for NDV estimation (ref: statistics/fmsketch.go —
+numpy mask-based redesign)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class FMSketch:
+    __slots__ = ("mask", "hashset", "max_size")
+
+    def __init__(self, max_size: int = 10000):
+        self.mask = np.uint64(0)
+        self.hashset: set[int] = set()
+        self.max_size = max_size
+
+    def insert_hashes(self, hashes: np.ndarray) -> None:
+        for h in hashes.tolist():
+            h = int(h)
+            if h & int(self.mask) != 0:
+                continue
+            self.hashset.add(h)
+            while len(self.hashset) > self.max_size:
+                self.mask = np.uint64((int(self.mask) << 1) | 1)
+                self.hashset = {x for x in self.hashset if x & int(self.mask) == 0}
+
+    def ndv(self) -> int:
+        return (int(self.mask) + 1) * len(self.hashset)
+
+    def merge(self, other: "FMSketch") -> None:
+        mask = max(int(self.mask), int(other.mask))
+        merged = {x for x in self.hashset | other.hashset if x & mask == 0}
+        self.mask = np.uint64(mask)
+        self.hashset = merged
+        while len(self.hashset) > self.max_size:
+            self.mask = np.uint64((int(self.mask) << 1) | 1)
+            self.hashset = {x for x in self.hashset if x & int(self.mask) == 0}

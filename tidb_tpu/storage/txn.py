@@ -185,6 +185,17 @@ class Storage:
         # table-prefix data-version counters: the tile cache (TiFlash-
         # columnar-replica analog) invalidates on these.
         self._versions: dict[bytes, int] = {}
+        self._stats = None
+
+    @property
+    def stats(self):
+        """Shared stats handle (ref: statistics/handle — hangs off Storage
+        so all sessions over this store see one stats view)."""
+        if self._stats is None:
+            from ..statistics.handle import StatsHandle
+
+            self._stats = StatsHandle(self)
+        return self._stats
 
     def begin(self) -> Txn:
         return Txn(self, self.tso.next())
