@@ -46,6 +46,14 @@ class CopClient:
             self._tpu = TPUEngine()
         return self._tpu
 
+    @property
+    def mpp(self):
+        if getattr(self, "_mpp", None) is None:
+            from ..parallel.mpp import MPPEngine
+
+            self._mpp = MPPEngine()
+        return self._mpp
+
     @staticmethod
     def _txn_dirty(txn, table_id: int) -> bool:
         prefix = tablecodec.record_prefix(table_id)

@@ -78,6 +78,14 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
         return DualExec()
     if isinstance(plan, DataSource):
         return _build_reader(plan, ctx)
+    if isinstance(plan, (Aggregation, Join)):
+        # MPP seam: Aggregation(Join…)/Join subtrees may compile into one
+        # mesh SPMD program (ref: planner mppTask, task.go:2088)
+        from .mpp_gather import try_build_mpp
+
+        mpp = try_build_mpp(plan, ctx)
+        if mpp is not None:
+            return mpp
     if isinstance(plan, Selection):
         return SelectionExec(build_executor(plan.children[0], ctx), plan.conds)
     if isinstance(plan, Projection):
@@ -572,7 +580,7 @@ class HashJoinExec(Executor):
         nl = lchunk.num_cols
 
         lkeys = [l for l, _ in self.eq_conds]
-        rkeys = [r for r, _ in self.eq_conds]
+        rkeys = [r for _, r in self.eq_conds]
         # right-side key exprs are over the concatenated schema; shift down
         from ..planner.optimizer import _shift_expr
 

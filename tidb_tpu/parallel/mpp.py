@@ -1,0 +1,674 @@
+"""Mesh MPP engine — the TiFlash-MPP replacement (SURVEY §3.4, §2.13.4).
+
+The reference dispatches plan fragments to stores and streams hash-
+partitioned chunks between them over gRPC tunnels (copr/mpp.go:461
+DispatchMPPTasks, cophandler/mpp_exec.go exchange/join/agg executors).
+Here the whole fragment tree compiles into ONE jit-compiled SPMD program
+over a `jax.sharding.Mesh`:
+
+    scan shards (P("dp"))            TableScan + Selection, fused
+      │  [optional all_to_all]       ExchangeSender(hash) → ICI collective
+      ▼
+    local equi-join                  sort build keys + searchsorted probe
+      │                              (unique build side: FK/PK joins)
+      ▼
+    partial agg + psum               Aggregation partial/final split
+      ▼
+    host finalize                    FinalHashAggExec (exact decimals)
+
+Design notes:
+  * broadcast join: build lanes enter the shard_map replicated (P()) —
+    the all_gather is free at dispatch; probe stays sharded.
+  * shuffle join: both sides bucketed by key%n_dev and exchanged with
+    `all_to_all` (send caps sized so nothing can drop: cap == local rows).
+  * the build side must have unique join keys (checked host-side on the
+    unfiltered lane — a superset, hence safe). Non-unique build → host
+    hash join fallback.
+  * static shapes everywhere; programs cached per (plan digest, shapes,
+    mesh) exactly like the TPU cop engine's jit cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..jaxenv import jax, jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..chunk.chunk import Chunk, Column, col_numpy_dtype, VARLEN
+from ..expr.expression import Column as ExprCol, Constant, Expression
+from ..mysqltypes.datum import Datum
+from ..planner.fragment import BROADCAST, HASH, JoinFrag, MPPPlan, ScanFrag
+
+I64_MAX = np.iinfo(np.int64).max
+DIRECT_GROUP_MAX = 1 << 16
+
+
+class ScanData:
+    """Host-side lanes for one scan: full numpy columns (for output
+    gather) plus dict-encoded device lanes for the columns the program
+    reads. Built by the gather executor from tile-cache batches."""
+
+    def __init__(self, frag: ScanFrag, data: list[np.ndarray], valid: list[np.ndarray]):
+        self.frag = frag
+        self.data = data  # per ds.out_cols position
+        self.valid = valid
+        self.n_rows = len(data[0]) if data else 0
+        self.vocabs: dict[int, list] = {}
+        self._dev: dict[int, np.ndarray] = {}
+
+    def lane(self, off: int) -> tuple[np.ndarray, np.ndarray]:
+        """Device-shaped lane for a scan-local column offset (dict-encodes
+        object lanes on first use)."""
+        if off not in self._dev:
+            d, v = self.data[off], self.valid[off]
+            if d.dtype == object:
+                from ..copr.tpu_engine import _dict_encode_lane
+
+                codes, vocab = _dict_encode_lane(d, v)
+                self.vocabs[off] = vocab
+                d = codes.astype(np.int64)
+            elif d.dtype == bool:
+                d = d.astype(np.int64)
+            self._dev[off] = d
+        return self._dev[off], self.valid[off]
+
+
+def _pad(a: np.ndarray, total: int):
+    out = np.zeros(total, dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+class _Level:
+    """Static per-join-level metadata resolved on host before compile."""
+
+    def __init__(self, frag: JoinFrag, key_lo: list[int], key_stride: list[int]):
+        self.frag = frag
+        self.key_lo = key_lo
+        self.key_stride = key_stride
+        self.r_post: list[Expression] = []
+
+
+class MPPEngine:
+    def __init__(self):
+        self._programs: dict = {}
+        self.compile_count = 0
+        self.fallbacks = 0
+
+    # ------------------------------------------------------------ planning
+
+    def prepare(self, mplan: MPPPlan, scans: list[ScanData], variables: dict):
+        """Resolve all data-dependent static choices; None → fallback."""
+        from ..copr.tpu_engine import TPUEngine
+
+        by_frag = {id(s.frag): s for s in scans}
+        scan_of_joined = {}  # joined idx -> (ScanData, local off)
+        for s in scans:
+            for off in range(len(s.frag.ds.out_cols)):
+                scan_of_joined[s.frag.side_offset + off] = (s, off)
+
+        # rewrite pushed conds per scan (string → dict-code space)
+        r_pushed: dict[int, list] = {}
+        eng = TPUEngine()
+        for s in scans:
+            conds = s.frag.ds.pushed_conds
+            used: set[int] = set()
+            for c in conds:
+                c.collect_columns(used)
+            vocabs = {}
+            for off in used:
+                s.lane(off)
+                if off in s.vocabs:
+                    vocabs[off] = s.vocabs[off]
+            rc = [eng._rewrite(c, vocabs) for c in conds]
+            if any(c is None for c in rc):
+                return None
+            r_pushed[id(s)] = rc
+
+        # per join level: key packing + uniqueness + exchange mode
+        threshold = int(variables.get("tidb_broadcast_join_threshold_count", 10240))
+        levels: list[_Level] = []
+
+        def visit(frag):
+            if isinstance(frag, ScanFrag):
+                return True
+            if not visit(frag.probe):
+                return False
+            bscan = by_frag[id(frag.build)]
+            # key domains from both sides (host lanes)
+            los, sizes = [], []
+            for pk, bk in zip(frag.probe_keys, frag.build_keys):
+                ps, poff = scan_of_joined[pk]
+                bs, boff = scan_of_joined[bk]
+                if poff in ps.vocabs or boff in bs.vocabs:
+                    return False  # string keys: dict codes differ per table
+                vals = []
+                for sd, off in ((ps, poff), (bs, boff)):
+                    d, v = sd.lane(off)
+                    if d.dtype.kind == "f":
+                        return False
+                    if v.any():
+                        vals.append((int(d[v].min()), int(d[v].max())))
+                if not vals:
+                    los.append(0)
+                    sizes.append(1)
+                    continue
+                lo = min(a for a, _ in vals)
+                hi = max(b for _, b in vals)
+                los.append(lo)
+                sizes.append(hi - lo + 1)
+            strides = [1] * len(sizes)
+            acc = 1
+            for i in range(len(sizes) - 1, -1, -1):
+                strides[i] = acc
+                acc *= sizes[i]
+                if acc > 1 << 62:
+                    return False
+            lvl = _Level(frag, los, strides)
+            # build-side key uniqueness (superset of the filtered set)
+            bkeys = self._pack_host(frag.build_keys, scan_of_joined, los, strides)
+            if bkeys is None:
+                return False
+            kv, km = bkeys
+            present = kv[km]
+            if len(np.unique(present)) != len(present):
+                return False
+            frag.exchange = BROADCAST if bscan.n_rows <= threshold else HASH
+            # left join with extra ON conditions filters *matches*, which
+            # the mask model below can't express yet → host fallback
+            if frag.post_conds:
+                if frag.kind != "inner":
+                    return False
+                vocabs = {}
+                used = set()
+                for c in frag.post_conds:
+                    c.collect_columns(used)
+                for j in used:
+                    sd, off = scan_of_joined[j]
+                    sd.lane(off)
+                    if off in sd.vocabs:
+                        vocabs[j] = sd.vocabs[off]
+                lvl.r_post = [eng._rewrite(c, vocabs) for c in frag.post_conds]
+                if any(c is None for c in lvl.r_post):
+                    return False
+            levels.append(lvl)
+            return True
+
+        if not visit(mplan.root):
+            return None
+
+        agg_meta = None
+        if mplan.agg is not None:
+            agg_meta = self._prepare_agg(mplan, scans, scan_of_joined, eng)
+            if agg_meta is None:
+                return None
+        return {
+            "scan_of_joined": scan_of_joined,
+            "r_pushed": r_pushed,
+            "levels": {id(l.frag): l for l in levels},
+            "agg": agg_meta,
+        }
+
+    @staticmethod
+    def _pack_host(key_idxs, scan_of_joined, los, strides):
+        acc = None
+        mask = None
+        for j, lo, st in zip(key_idxs, los, strides):
+            sd, off = scan_of_joined[j]
+            d, v = sd.lane(off)
+            term = (d.astype(np.int64) - lo) * st
+            acc = term if acc is None else acc + term
+            mask = v if mask is None else (mask & v)
+        if acc is None:
+            return None
+        return acc, mask
+
+    def _prepare_agg(self, mplan: MPPPlan, scans, scan_of_joined, eng):
+        """Direct-addressed group-by over the joined schema (mirrors
+        TPUEngine._lower_agg's domain rules)."""
+        agg = mplan.agg
+        domains, key_meta = [], []
+        for g in agg.group_by:
+            if not isinstance(g, ExprCol):
+                return None
+            sd, off = scan_of_joined[g.idx]
+            d, v = sd.lane(off)
+            if off in sd.vocabs:
+                domains.append(max(len(sd.vocabs[off]), 1))
+                key_meta.append(("dict", sd.vocabs[off]))
+            else:
+                if d.dtype.kind == "f" or not len(d):
+                    return None
+                pres = d[v]
+                if not len(pres):
+                    lo, hi = 0, 0
+                else:
+                    lo, hi = int(pres.min()), int(pres.max())
+                if hi - lo + 1 > DIRECT_GROUP_MAX:
+                    return None
+                domains.append(hi - lo + 1)
+                key_meta.append(("int", lo))
+        nseg = 1
+        for s in domains:
+            nseg *= s + 1
+        if nseg > DIRECT_GROUP_MAX:
+            return None
+        r_args = []
+        for a in agg.aggs:
+            ra = []
+            for x in a.args:
+                if isinstance(x, ExprCol):
+                    sd, off = scan_of_joined[x.idx]
+                    sd.lane(off)
+                    if off in sd.vocabs:
+                        if a.name in ("min", "max"):
+                            ra.append(x)  # code order == collation order
+                            continue
+                        return None
+                    ra.append(x)
+                    continue
+                used = set()
+                x.collect_columns(used)
+                if any(scan_of_joined[j][1] in scan_of_joined[j][0].vocabs for j in used):
+                    return None
+                ra.append(x)
+            r_args.append(ra)
+        return {"domains": domains, "key_meta": key_meta, "nseg": nseg, "r_args": r_args}
+
+    # ------------------------------------------------------------- compile
+
+    def execute(self, mplan: MPPPlan, scans: list[ScanData], mesh: Mesh, variables: dict, axis: str = "dp"):
+        """Run the fragment plan; returns a Chunk in partial-agg layout
+        (agg case) or joined-schema layout (rows case), or None → caller
+        falls back to the host join path."""
+        meta = self.prepare(mplan, scans, variables)
+        if meta is None:
+            self.fallbacks += 1
+            return None
+        n_dev = mesh.shape[axis]
+        # which scans are sharded: the stream source + hash-side builds
+        sharded = {id(self._stream_source(mplan.root))}
+        for lvl in meta["levels"].values():
+            if lvl.frag.exchange == HASH:
+                sharded.add(id(lvl.frag.build))
+
+        # collect device lanes needed per scan
+        need: dict[int, set] = {id(s): set() for s in scans}
+        soj = meta["scan_of_joined"]
+        def note(j):
+            sd, off = soj[j]
+            need[id(sd)].add(off)
+        for lvl in meta["levels"].values():
+            for j in lvl.frag.probe_keys + lvl.frag.build_keys:
+                note(j)
+            for c in lvl.r_post:
+                used = set(); c.collect_columns(used)
+                for j in used:
+                    note(j)
+        for s in scans:
+            for c in meta["r_pushed"][id(s)]:
+                used = set(); c.collect_columns(used)
+                for off in used:
+                    need[id(s)].add(off)
+        if mplan.agg is not None:
+            for g in mplan.agg.group_by:
+                note(g.idx)
+            for ra in meta["agg"]["r_args"]:
+                for x in ra:
+                    used = set(); x.collect_columns(used)
+                    for j in used:
+                        note(j)
+
+        # flatten args: per scan (in mplan.scans order): rowid, row_valid,
+        # then (data, valid) per needed offset (sorted)
+        args, in_specs, scan_arg_meta = [], [], []
+        shapes = []
+        for s in scans:
+            offs = sorted(need[id(s)])
+            is_sharded = id(s.frag) in sharded
+            n = s.n_rows
+            total = max(-(-n // n_dev), 1) * n_dev if is_sharded else max(n, 1)
+            rowid = _pad(np.arange(n, dtype=np.int64), total)
+            rv = np.zeros(total, dtype=bool)
+            rv[:n] = True
+            spec = P(axis) if is_sharded else P()
+            args += [rowid, rv]
+            in_specs += [spec, spec]
+            for off in offs:
+                d, v = s.lane(off)
+                args.append(_pad(d, total))
+                args.append(_pad(v, total))
+                in_specs += [spec, spec]
+            scan_arg_meta.append((id(s.frag), offs, is_sharded))
+            shapes.append((total, is_sharded, offs))
+
+        key = self._program_key(mplan, meta, scans, shapes, n_dev)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._build_program(mplan, meta, scan_arg_meta, mesh, axis, n_dev, tuple(in_specs))
+            self._programs[key] = prog
+            self.compile_count += 1
+        outs = prog(*[jnp.asarray(a) for a in args])
+        if mplan.agg is not None:
+            return self._finalize_agg(mplan, meta, outs)
+        return self._finalize_rows(mplan, meta, scans, outs)
+
+    @staticmethod
+    def _stream_source(frag):
+        while isinstance(frag, JoinFrag):
+            frag = frag.probe
+        return frag
+
+    def _program_key(self, mplan, meta, scans, shapes, n_dev):
+        parts = [repr(shapes), str(n_dev)]
+        for s in scans:
+            parts.append(repr(meta["r_pushed"][id(s)]))
+        for fid, lvl in meta["levels"].items():
+            parts += [
+                lvl.frag.kind, lvl.frag.exchange,
+                repr(lvl.frag.probe_keys), repr(lvl.frag.build_keys),
+                repr(lvl.key_lo), repr(lvl.key_stride), repr(lvl.r_post),
+            ]
+        if meta["agg"]:
+            a = meta["agg"]
+            parts += [repr(a["domains"]), repr([m[0] for m in a["key_meta"]]),
+                      repr(a["r_args"]), repr([x.name for x in mplan.agg.aggs]),
+                      repr(mplan.agg.group_by)]
+        return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+    # ------------------------------------------------------------- kernel
+
+    def _build_program(self, mplan, meta, scan_arg_meta, mesh, axis, n_dev, in_specs):
+        from ..copr.tpu_engine import TPUEngine
+
+        eval_dev = TPUEngine._eval_device
+        soj = meta["scan_of_joined"]
+        r_pushed = meta["r_pushed"]
+        levels = meta["levels"]
+        agg_meta = meta["agg"]
+        agg = mplan.agg
+        scans = mplan.scans
+
+        # arg unpacking plan: index into flat args per scan
+        arg_plan = []
+        pos = 0
+        for fid, offs, is_sharded in scan_arg_meta:
+            arg_plan.append((fid, pos, offs))
+            pos += 2 + 2 * len(offs)
+
+        # r_pushed is keyed by id(ScanData); scan_arg_meta carries frag ids.
+        # Re-key via scan_of_joined (every ScanData maps to its frag).
+        sd_by_fid = {}
+        for j, (sd, off) in soj.items():
+            sd_by_fid[id(sd.frag)] = sd
+
+        def scan_stage(frag_id, flat):
+            fid, base, offs = next(a for a in arg_plan if a[0] == frag_id)
+            rowid = flat[base]
+            rv = flat[base + 1]
+            lanes = {}
+            for k, off in enumerate(offs):
+                lanes[off] = (flat[base + 2 + 2 * k], flat[base + 3 + 2 * k])
+            sd = sd_by_fid[frag_id]
+            mask = rv
+            for c in r_pushed[id(sd)]:
+                d, v = eval_dev(c, lanes)
+                d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d
+                v = jnp.broadcast_to(v, mask.shape) if getattr(v, "ndim", 0) == 0 else v
+                mask = mask & v & (d != 0)
+            # re-key lanes into joined-schema space
+            joined = {sd.frag.side_offset + off: lv for off, lv in lanes.items()}
+            return joined, mask, {frag_id: rowid}
+
+        def pack_keys(lanemap, key_idxs, lvl):
+            acc = None
+            kv = None
+            for j, lo, st in zip(key_idxs, lvl.key_lo, lvl.key_stride):
+                d, v = lanemap[j]
+                term = (d.astype(jnp.int64) - lo) * st
+                acc = term if acc is None else acc + term
+                kv = v if kv is None else (kv & v)
+            return acc, kv
+
+        def exchange_all(lanemap, mask, rowids, okey):
+            """all_to_all every lane, bucketed by owner = okey % n_dev."""
+            rows = mask.shape[0]
+            cap = rows
+            owner = (okey % n_dev).astype(jnp.int32)
+            order = jnp.argsort(jnp.where(mask, owner, n_dev))
+            own_s = jnp.where(mask, owner, n_dev)[order]
+            counts = jax.ops.segment_sum(
+                (own_s < n_dev).astype(jnp.int32), own_s, num_segments=n_dev + 1
+            )[:n_dev]
+            starts = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+            )
+            idx = jnp.arange(rows)
+            within = idx - starts[jnp.clip(own_s, 0, n_dev - 1)]
+            ok = (own_s < n_dev) & (within < cap)
+            tgt = (jnp.clip(own_s, 0, n_dev - 1), jnp.clip(within, 0, cap - 1))
+
+            def xc(lane):
+                lane_s = lane[order]
+                buf = jnp.zeros((n_dev, cap), dtype=lane.dtype)
+                buf = buf.at[tgt].set(jnp.where(ok, lane_s, jnp.zeros((), lane.dtype)))
+                out = jax.lax.all_to_all(buf, axis, 0, 0, tiled=True)
+                return out.reshape(-1)
+
+            new_map = {j: (xc(d), xc(v)) for j, (d, v) in lanemap.items()}
+            new_rowids = {fid: xc(r) for fid, r in rowids.items()}
+            mask_out = xc(mask)
+            return new_map, mask_out, new_rowids
+
+        def join_stage(frag, flat):
+            if isinstance(frag, ScanFrag):
+                return scan_stage(id(frag), flat)
+            pmap_, pmask, prow = join_stage(frag.probe, flat)
+            bmap, bmask, brow = scan_stage(id(frag.build), flat)
+            lvl = levels[id(frag)]
+            pkey, pkv = pack_keys(pmap_, frag.probe_keys, lvl)
+            bkey, bkv = pack_keys(bmap, frag.build_keys, lvl)
+            if frag.exchange == HASH:
+                pmap_, pmask, prow = exchange_all(
+                    pmap_, pmask, prow, jnp.where(pkv, pkey, jnp.arange(pkey.shape[0]))
+                )
+                bmap, bmask, brow = exchange_all(bmap, bmask, brow, bkey)
+                pkey, pkv = pack_keys(pmap_, frag.probe_keys, lvl)
+                bkey, bkv = pack_keys(bmap, frag.build_keys, lvl)
+            bvalid = bmask & bkv
+            B = bkey.shape[0]
+            order = jnp.argsort(jnp.where(bvalid, bkey, I64_MAX))
+            sk = jnp.where(bvalid, bkey, I64_MAX)[order]
+            sv = bvalid[order]
+            pos = jnp.clip(jnp.searchsorted(sk, pkey), 0, B - 1)
+            match = pmask & pkv & sv[pos] & (sk[pos] == pkey)
+            bsel = order[pos]
+            merged = dict(pmap_)
+            for j, (d, v) in bmap.items():
+                merged[j] = (d[bsel], v[bsel] & match)
+            rowids = dict(prow)
+            rowids[id(frag.build)] = jnp.where(match, brow[id(frag.build)][bsel], -1)
+            mask = match if frag.kind == "inner" else pmask
+            for c in lvl.r_post:
+                d, v = eval_dev(c, merged)
+                d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d
+                v = jnp.broadcast_to(v, mask.shape) if getattr(v, "ndim", 0) == 0 else v
+                mask = mask & v & (d != 0)
+            return merged, mask, rowids
+
+        def kernel(*flat):
+            lanemap, mask, rowids = join_stage(mplan.root, flat)
+            if agg is None:
+                outs = [mask]
+                for s in scans:
+                    outs.append(rowids.get(id(s), jnp.full(mask.shape, -1, jnp.int64)))
+                return tuple(outs)
+            # fused partial aggregation + psum (exact int/scaled-decimal)
+            nseg = agg_meta["nseg"]
+            code = jnp.zeros(mask.shape, dtype=jnp.int32)
+            for g, dom, km in zip(agg.group_by, agg_meta["domains"], agg_meta["key_meta"]):
+                d, v = lanemap[g.idx]
+                lo = km[1] if km[0] == "int" else 0
+                kd = (d.astype(jnp.int32) - lo + 1) * v
+                code = code * (dom + 1) + kd
+            seg = jnp.where(mask, code, nseg)
+            outs = [(jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg], "sum")]
+            for a, ra in zip(agg.aggs, agg_meta["r_args"]):
+                outs.extend(self._agg_partials(a, ra, lanemap, mask, seg, nseg, eval_dev))
+            red = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
+            return tuple(red[op](o, axis) for o, op in outs)
+
+        n_scan_out = 1 + len(scans)
+        if agg is None:
+            out_specs = tuple([P(axis)] * n_scan_out)
+        else:
+            nout = 1
+            for a in agg.aggs:
+                nout += 1 if a.name == "count" else 2
+            out_specs = tuple([P()] * nout)
+
+        sm = shard_map(kernel, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs)
+        return jax.jit(sm)
+
+    @staticmethod
+    def _agg_partials(a, r_args, lanemap, mask, seg, nseg, eval_dev):
+        if r_args:
+            d, v = eval_dev(r_args[0], lanemap)
+            d = jnp.broadcast_to(d, seg.shape) if getattr(d, "ndim", 0) == 0 else d
+            v = jnp.broadcast_to(v, seg.shape) if getattr(v, "ndim", 0) == 0 else v
+        else:
+            d = jnp.ones(seg.shape, dtype=jnp.int64)
+            v = jnp.ones(seg.shape, dtype=bool)
+        ok = mask & v
+        if a.name == "count":
+            return [(jax.ops.segment_sum(ok.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg], "sum")]
+        if a.name in ("sum", "avg"):
+            if d.dtype in (jnp.float64, jnp.float32):
+                s = jax.ops.segment_sum(jnp.where(ok, d, 0.0), seg, num_segments=nseg + 1)[:nseg]
+            else:
+                s = jax.ops.segment_sum(jnp.where(ok, d.astype(jnp.int64), 0), seg, num_segments=nseg + 1)[:nseg]
+            cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg]
+            return [(s, "sum"), (cnt, "sum")]
+        if a.name in ("min", "max"):
+            if a.name == "min":
+                big = jnp.inf if d.dtype in (jnp.float64, jnp.float32) else I64_MAX
+                s = jax.ops.segment_min(jnp.where(ok, d, big), seg, num_segments=nseg + 1)[:nseg]
+                op = "min"
+            else:
+                small = -jnp.inf if d.dtype in (jnp.float64, jnp.float32) else -I64_MAX - 1
+                s = jax.ops.segment_max(jnp.where(ok, d, small), seg, num_segments=nseg + 1)[:nseg]
+                op = "max"
+            cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg]
+            return [(s, op), (cnt, "sum")]
+        raise NotImplementedError(a.name)
+
+    # ------------------------------------------------------------ finalize
+
+    def _finalize_agg(self, mplan, meta, outs) -> Chunk:
+        """psum'd partial arrays → partial-layout chunk (group keys then
+        per-agg partial states) for FinalHashAggExec."""
+        agg = mplan.agg
+        agg_meta = meta["agg"]
+        soj = meta["scan_of_joined"]
+        nseg = agg_meta["nseg"]
+        group_count = np.asarray(outs[0])
+        present = np.nonzero(group_count > 0)[0]
+        G = len(present)
+        out_fts = [g.ret_type for g in agg.group_by]
+        for a in agg.aggs:
+            out_fts.extend(ft for _, ft in a.partial_final_types())
+        cols: list[Column] = []
+        radix = [d + 1 for d in agg_meta["domains"]]
+        codes = present.copy()
+        key_vals = []
+        for r in reversed(radix):
+            key_vals.append(codes % r)
+            codes = codes // r
+        key_vals.reverse()
+        oi = 0
+        for km, kv in zip(agg_meta["key_meta"], key_vals):
+            ft = out_fts[oi]
+            valid = kv > 0
+            if km[0] == "dict":
+                vocab = km[1]
+                data = np.empty(G, dtype=object)
+                for j, c in enumerate(kv):
+                    data[j] = vocab[c - 1] if c > 0 else None
+            else:
+                data = (kv.astype(np.int64) - 1) + km[1]
+                data[~valid] = 0
+            cols.append(Column(ft, data, valid))
+            oi += 1
+        pos = 1
+        for a, ra in zip(agg.aggs, agg_meta["r_args"]):
+            if a.name == "count":
+                cnt = np.asarray(outs[pos])[present]
+                cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
+                pos += 1
+                oi += 1
+            elif a.name in ("sum", "avg"):
+                s = np.asarray(outs[pos])[present]
+                cnt = np.asarray(outs[pos + 1])[present]
+                has = cnt > 0
+                sd = s if out_fts[oi].is_float() else s.astype(np.int64)
+                cols.append(Column(out_fts[oi], sd, has))
+                oi += 1
+                if a.name == "avg":
+                    cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
+                    oi += 1
+                pos += 2
+            elif a.name in ("min", "max"):
+                s = np.asarray(outs[pos])[present]
+                cnt = np.asarray(outs[pos + 1])[present]
+                has = cnt > 0
+                ft = out_fts[oi]
+                arg = a.args[0] if a.args else None
+                if isinstance(arg, ExprCol):
+                    sd, off = soj[arg.idx]
+                    if off in sd.vocabs:
+                        vocab = sd.vocabs[off]
+                        data = np.empty(G, dtype=object)
+                        for j in range(G):
+                            data[j] = vocab[int(s[j])] if has[j] and 0 <= int(s[j]) < len(vocab) else None
+                        cols.append(Column(ft, data, has))
+                        pos += 2
+                        oi += 1
+                        continue
+                data = s if ft.is_float() else np.where(has, s.astype(np.int64), 0)
+                cols.append(Column(ft, data, has))
+                pos += 2
+                oi += 1
+        return Chunk(cols)
+
+    def _finalize_rows(self, mplan, meta, scans, outs) -> Chunk:
+        """(mask, per-scan rowids) → joined-schema chunk via host gather
+        from the original (string-preserving) numpy lanes."""
+        mask = np.asarray(outs[0])
+        rowids = [np.asarray(o) for o in outs[1:]]
+        sel = np.nonzero(mask)[0]
+        by_frag = {id(s.frag): (s, i) for i, s in enumerate(scans)}
+        cols: list[Column] = []
+        for j, pc in enumerate(mplan.out_cols):
+            sd, off = meta["scan_of_joined"][j]
+            _, si = by_frag[id(sd.frag)]
+            rid = rowids[si][sel]
+            ok = rid >= 0
+            safe = np.clip(rid, 0, max(sd.n_rows - 1, 0))
+            src = sd.data[off]
+            srcv = sd.valid[off]
+            if sd.n_rows == 0:
+                dt = col_numpy_dtype(pc.ft)
+                data = np.empty(len(sel), dtype=object) if dt is VARLEN else np.zeros(len(sel), dtype=dt)
+                valid = np.zeros(len(sel), bool)
+            else:
+                data = src[safe]
+                valid = srcv[safe] & ok
+                if data.dtype == object:
+                    data = data.copy()
+                    data[~valid] = None
+            cols.append(Column(pc.ft, data, valid))
+        return Chunk(cols)

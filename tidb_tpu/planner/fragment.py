@@ -1,0 +1,175 @@
+"""MPP fragment slicing (ref: planner/core/fragment.go:64
+GenerateRootMPPTasks, :202 buildFragments; exchange types in
+plan_to_pb.go:229).
+
+The reference slices a physical plan into fragments at ExchangeSender/
+ExchangeReceiver boundaries and dispatches each fragment to TiFlash
+stores, with hash/broadcast chunk exchange over gRPC tunnels
+(cophandler/mpp_exec.go:109). The TPU-native redesign keeps the same
+*logical* slicing — this module produces the fragment tree — but the
+fragments do not become separate processes: the whole tree compiles into
+ONE SPMD program over a `jax.sharding.Mesh` (parallel/mpp.py), where an
+ExchangeSender(hash) is an `all_to_all` collective over the mesh axis and
+ExchangeSender(broadcast) is a replicated operand. XLA then fuses and
+overlaps compute with ICI communication — the fusion boundary the
+reference pays a serialization+network cost for disappears.
+
+Eligibility here mirrors `CanExprsPushDown` + mppTask checks
+(planner/core/task.go:2088): inner/left equi-joins on integer-typed keys,
+scans without index paths, device-lowerable conditions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..expr.expression import Column as ExprCol, Expression
+from ..mysqltypes.field_type import FieldType
+from .plans import Aggregation, DataSource, Join, LogicalPlan, Projection, Selection
+
+# exchange modes (ref: tipb ExchangeType)
+HASH = "hash"
+BROADCAST = "broadcast"
+PASSTHROUGH = "passthrough"
+
+
+@dataclass
+class ScanFrag:
+    """A leaf fragment: one table scan with pushed-down conditions."""
+
+    ds: DataSource
+    side_offset: int  # where this scan's columns start in the joined schema
+
+    @property
+    def n_cols(self) -> int:
+        return len(self.ds.out_cols)
+
+
+@dataclass
+class JoinFrag:
+    """A join fragment: probe child (sharded stream) ⋈ build child (scan).
+
+    `exchange` is decided at compile time from build-side cardinality:
+    BROADCAST replicates the build lanes to every device (all_gather
+    analog); HASH repartitions both sides by join key (all_to_all)."""
+
+    probe: "JoinFrag | ScanFrag"
+    build: ScanFrag
+    kind: str  # inner | left
+    probe_keys: list[int]  # joined-schema column indices
+    build_keys: list[int]
+    post_conds: list[Expression] = field(default_factory=list)
+    exchange: str = BROADCAST
+
+
+@dataclass
+class MPPPlan:
+    root: JoinFrag
+    scans: list[ScanFrag]
+    agg: Aggregation | None  # fused partial aggregation, if any
+    out_cols: list  # joined schema (probe cols then build cols, leftmost first)
+    join_node: Join = None  # original plan node (host fallback path)
+
+    def explain(self, indent: int = 0) -> str:
+        """Fragment-tree rendering for EXPLAIN (sender/receiver parity)."""
+        lines: list[str] = []
+        if self.agg is not None:
+            lines.append("PartialAggregation(psum)")
+        def walk(f, depth):
+            pad = "  " * depth
+            if isinstance(f, ScanFrag):
+                lines.append(f"{pad}ExchangeSender({PASSTHROUGH})")
+                lines.append(f"{pad}  TableScan({f.ds.alias or f.ds.table.name})")
+                return
+            lines.append(f"{pad}HashJoin({f.kind})")
+            walk(f.probe, depth + 1)
+            lines.append(f"{pad}  ExchangeReceiver")
+            lines.append(f"{pad}    ExchangeSender({f.exchange})")
+            lines.append(f"{pad}      TableScan({f.build.ds.alias or f.build.ds.table.name})")
+        walk(self.root, 1 if self.agg else 0)
+        return "\n".join(lines)
+
+
+def _int_key(ft: FieldType) -> bool:
+    """Join keys must be integer-shaped on device: ints, dates/times
+    (packed int64), decimals (scaled int64). Floats (inexact) and strings
+    (per-table dict codes are not comparable across tables) fall back."""
+    return not ft.is_float() and not ft.is_string()
+
+
+def _fold_selection(node: LogicalPlan):
+    """Selection(DataSource) → DataSource with conds folded into pushed."""
+    if isinstance(node, Selection) and isinstance(node.children[0], DataSource):
+        ds = node.children[0]
+        ds.pushed_conds = list(ds.pushed_conds) + list(node.conds)
+        return ds
+    return node
+
+
+def _slice_join(node: Join, offset: int, scans: list[ScanFrag]):
+    """Left-deep join tree → JoinFrag tree; None if ineligible."""
+    if node.kind not in ("inner", "left"):
+        return None, offset
+    left, right = (_fold_selection(c) for c in node.children)
+    # probe side: nested join or scan; build side: scan only (left-deep)
+    if isinstance(left, Join):
+        probe, offset = _slice_join(left, offset, scans)
+        if probe is None:
+            return None, offset
+    elif isinstance(left, DataSource):
+        if getattr(left, "path", "table") != "table":
+            return None, offset
+        probe = ScanFrag(left, offset)
+        scans.append(probe)
+        offset += probe.n_cols
+    else:
+        return None, offset
+    if not (isinstance(right, DataSource) and getattr(right, "path", "table") == "table"):
+        return None, offset
+    build = ScanFrag(right, offset)
+    scans.append(build)
+    offset += build.n_cols
+
+    if not node.eq_conds:
+        return None, offset  # cross join: no MPP
+    pk, bk = [], []
+    for le, re in node.eq_conds:
+        if not (isinstance(le, ExprCol) and isinstance(re, ExprCol)):
+            return None, offset
+        if not (_int_key(le.ret_type) and _int_key(re.ret_type)):
+            return None, offset
+        # eq_conds are over the concatenated schema; build side is the
+        # right child, i.e. indices >= build.side_offset
+        a, b = (le, re) if le.idx < build.side_offset else (re, le)
+        if a.idx >= build.side_offset or b.idx < build.side_offset:
+            return None, offset
+        pk.append(a.idx)
+        bk.append(b.idx)
+    return JoinFrag(probe, build, node.kind, pk, bk, list(node.other_conds)), offset
+
+
+def slice_plan(plan: LogicalPlan) -> MPPPlan | None:
+    """Try to slice an optimized plan (sub)tree into an MPP fragment plan.
+
+    Accepted roots: Aggregation(JoinTree) — fully fused partial-agg
+    program; JoinTree — joined-rows program (host operators continue on
+    top). Returns None when the shape/types don't qualify; caller falls
+    back to the root HashJoin path."""
+    agg = None
+    node = plan
+    if isinstance(node, Aggregation) and isinstance(node.children[0], (Join, Selection)):
+        inner = _fold_selection(node.children[0])
+        if isinstance(inner, Join):
+            agg = node
+            node = inner
+    if not isinstance(node, Join):
+        return None
+    scans: list[ScanFrag] = []
+    root, _ = _slice_join(node, 0, scans)
+    if root is None:
+        return None
+    if agg is not None:
+        for a in agg.aggs:
+            if a.name not in ("count", "sum", "avg", "min", "max") or a.distinct:
+                return None
+    return MPPPlan(root, scans, agg, list(node.out_cols), node)

@@ -10,6 +10,7 @@ DEFAULT_VARS = {
     "tidb_mem_quota_query": str(1 << 30),
     "tidb_enable_chunk_rpc": "ON",
     "tidb_allow_mpp": "ON",
+    "tidb_broadcast_join_threshold_count": "10240",
     "tidb_isolation_read_engines": "tpu,host",
     "tidb_txn_mode": "optimistic",
     "tidb_retry_limit": "10",
