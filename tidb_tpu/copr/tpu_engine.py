@@ -42,6 +42,36 @@ from .tilecache import ColumnBatch
 
 TILE_ROWS = 1 << 16
 DIRECT_GROUP_MAX = 1 << 16
+# group domains up to this size reduce via dense masked reductions
+# (VPU-friendly compare+reduce, fuses across agg lanes) instead of
+# segment_sum: TPU scatter-adds serialize and cost ~100ms per lane at 2M
+# rows while the dense form is bandwidth-bound (~µs at Q1 scale)
+SEG_DENSE_MAX = 64
+
+
+def _seg_ids(seg, nseg):
+    return jnp.arange(nseg, dtype=seg.dtype)[:, None] == seg[None, :]
+
+
+def _seg_sum(vals, seg, nseg):
+    """Sum `vals` per segment; rows with seg >= nseg are dropped (the
+    masked-row overflow slot)."""
+    if nseg <= SEG_DENSE_MAX:
+        zero = jnp.zeros((), dtype=vals.dtype)
+        return jnp.sum(jnp.where(_seg_ids(seg, nseg), vals[None, :], zero), axis=1)
+    return jax.ops.segment_sum(vals, seg, num_segments=nseg + 1)[:nseg]
+
+
+def _seg_min(vals, seg, nseg, fill):
+    if nseg <= SEG_DENSE_MAX:
+        return jnp.min(jnp.where(_seg_ids(seg, nseg), vals[None, :], fill), axis=1)
+    return jax.ops.segment_min(vals, seg, num_segments=nseg + 1)[:nseg]
+
+
+def _seg_max(vals, seg, nseg, fill):
+    if nseg <= SEG_DENSE_MAX:
+        return jnp.max(jnp.where(_seg_ids(seg, nseg), vals[None, :], fill), axis=1)
+    return jax.ops.segment_max(vals, seg, num_segments=nseg + 1)[:nseg]
 
 _CMP_SWAP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
 
@@ -300,7 +330,7 @@ class TPUEngine:
         fn = self._program(key, lambda flat, rv: self._mask(r_conds, self._unflatten(flat, order), rv))
 
         def run():
-            mask = np.asarray(fn(arrs, dev.row_valid)).reshape(-1)[: dev.batch.n_rows]
+            mask = jax.device_get(fn(arrs, dev.row_valid)).reshape(-1)[: dev.batch.n_rows]
             chunk = dev.batch.to_chunk(dag.scan.col_offsets)
             chunk = chunk.filter(mask)
             if dag.limit is not None:
@@ -385,18 +415,56 @@ class TPUEngine:
             else:
                 code = jnp.zeros(flat_mask.shape, dtype=jnp.int32)
             seg = jnp.where(flat_mask, code, nseg)  # masked rows → overflow slot
-            outs = [jax.ops.segment_sum(flat_mask.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg]]
+            outs = [_seg_sum(flat_mask.astype(jnp.int64), seg, nseg)]
             for a in agg.aggs:
                 outs.extend(self._agg_partials_device(a, l, flat_mask, seg, nseg))
             return outs
 
-        fn = self._program(key, kernel)
+        fn, aux = self._packed_program(key, kernel, nseg)
 
         def run():
-            outs = fn(arrs, dev.row_valid)
+            # The whole partial state comes back as (at most) TWO stacked
+            # arrays — each device->host fetch over the tunnel pays a full
+            # round-trip, so per-array fetches dominated query time before
+            # (32 × ~15-75ms); one packed fetch is one round-trip.
+            outs = self._unpack(jax.device_get(fn(arrs, dev.row_valid)), aux)
             return self._agg_outputs_to_chunk(dag, dev, outs, domains, key_cols, vocabs, nseg)
 
         return run
+
+    def _packed_program(self, key, kernel, nseg):
+        """jit `kernel` (→ list of [nseg] arrays of mixed int/float dtype)
+        wrapped so the compiled program returns one stacked int64 array +
+        one stacked float64 array. The unpack layout is discovered at trace
+        time and cached next to the compiled fn."""
+        cached = self._programs.get(key)
+        if cached is None:
+            aux: dict = {}
+
+            def packed(flat, row_valid):
+                outs = kernel(flat, row_valid)
+                ints, flts, lay = [], [], []
+                for o in outs:
+                    if jnp.issubdtype(o.dtype, jnp.floating):
+                        lay.append(("f", len(flts)))
+                        flts.append(o.astype(jnp.float64))
+                    else:
+                        lay.append(("i", len(ints)))
+                        ints.append(o.astype(jnp.int64))
+                aux["layout"] = lay
+                i_arr = jnp.stack(ints) if ints else jnp.zeros((0, nseg), jnp.int64)
+                f_arr = jnp.stack(flts) if flts else jnp.zeros((0, nseg), jnp.float64)
+                return i_arr, f_arr
+
+            cached = (jax.jit(packed), aux)
+            self._programs[key] = cached
+            self.compile_count += 1
+        return cached
+
+    @staticmethod
+    def _unpack(packed, aux):
+        i_arr, f_arr = packed
+        return [i_arr[k] if t == "i" else f_arr[k] for t, k in aux["layout"]]
 
     def _agg_partials_device(self, a, lanes, flat_mask, seg, nseg):
         name = a.name
@@ -409,26 +477,26 @@ class TPUEngine:
             v = jnp.ones(seg.shape, dtype=bool)
         ok = flat_mask & v
         if name == "count":
-            return [jax.ops.segment_sum(ok.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg]]
+            return [_seg_sum(ok.astype(jnp.int64), seg, nseg)]
         if name in ("sum", "avg"):
             if d.dtype == jnp.float64 or d.dtype == jnp.float32:
-                s = jax.ops.segment_sum(jnp.where(ok, d, 0.0), seg, num_segments=nseg + 1)[:nseg]
+                s = _seg_sum(jnp.where(ok, d, 0.0), seg, nseg)
             else:
-                s = jax.ops.segment_sum(jnp.where(ok, d.astype(jnp.int64), 0), seg, num_segments=nseg + 1)[:nseg]
-            cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg]
-            return [s, cnt] if name == "avg" else [s, cnt]
+                s = _seg_sum(jnp.where(ok, d.astype(jnp.int64), 0), seg, nseg)
+            cnt = _seg_sum(ok.astype(jnp.int64), seg, nseg)
+            return [s, cnt]
         if name in ("min", "max"):
             if name == "min":
                 big = jnp.asarray(np.iinfo(np.int64).max) if d.dtype != jnp.float64 else jnp.inf
-                s = jax.ops.segment_min(jnp.where(ok, d, big), seg, num_segments=nseg + 1)[:nseg]
+                s = _seg_min(jnp.where(ok, d, big), seg, nseg, big)
             else:
                 small = jnp.asarray(np.iinfo(np.int64).min) if d.dtype != jnp.float64 else -jnp.inf
-                s = jax.ops.segment_max(jnp.where(ok, d, small), seg, num_segments=nseg + 1)[:nseg]
-            cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg, num_segments=nseg + 1)[:nseg]
+                s = _seg_max(jnp.where(ok, d, small), seg, nseg, small)
+            cnt = _seg_sum(ok.astype(jnp.int64), seg, nseg)
             return [s, cnt]
         if name == "first_row":
             idx = jnp.arange(seg.shape[0])
-            first = jax.ops.segment_min(jnp.where(ok, idx, seg.shape[0]), seg, num_segments=nseg + 1)[:nseg]
+            first = _seg_min(jnp.where(ok, idx, seg.shape[0]), seg, nseg, jnp.asarray(seg.shape[0]))
             return [first]
         raise NotImplementedError(name)
 
@@ -558,9 +626,8 @@ class TPUEngine:
         fn = self._program(key, kernel)
 
         def run():
-            idx, m = fn(arrs, dev.row_valid)
-            idx = np.asarray(idx)
-            m = np.asarray(m).reshape(-1)
+            idx, m = jax.device_get(fn(arrs, dev.row_valid))
+            m = m.reshape(-1)
             idx = idx[m[idx]]  # drop indices pointing at masked rows
             chunk = dev.batch.to_chunk(dag.scan.col_offsets)
             return chunk.take(idx[: dag.topn.n])

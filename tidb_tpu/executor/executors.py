@@ -375,7 +375,9 @@ class SortExec(Executor):
         self._out = None
 
     def open(self):
-        self.child.open()
+        # child is opened by drain() in _sorted_chunk — opening it here too
+        # would run the whole subtree (incl. cop sends) twice
+        self._out = None
 
     def _sorted_chunk(self) -> Chunk:
         from ..copr.host_engine import _lex_argsort
@@ -567,8 +569,7 @@ class HashJoinExec(Executor):
         self._done = False
 
     def open(self):
-        self.left.open()
-        self.right.open()
+        # children are opened by drain() in next() — see SortExec.open
         self._done = False
 
     def next(self):

@@ -375,7 +375,11 @@ class MPPEngine:
             ]
         if meta["agg"]:
             a = meta["agg"]
-            parts += [repr(a["domains"]), repr([m[0] for m in a["key_meta"]]),
+            # int keys bake `lo` (km[1]) into the compiled kernel, so the
+            # cache key must carry it; dict keys are covered by kind+domain
+            # (vocab only affects host decode + already-keyed r_pushed).
+            parts += [repr(a["domains"]),
+                      repr([(m[0], m[1]) if m[0] == "int" else (m[0],) for m in a["key_meta"]]),
                       repr(a["r_args"]), repr([x.name for x in mplan.agg.aggs]),
                       repr(mplan.agg.group_by)]
         return hashlib.sha256("|".join(parts).encode()).hexdigest()

@@ -21,6 +21,7 @@ scans without index paths, device-lowerable conditions.
 
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass, field
 
 from ..expr.expression import Column as ExprCol, Expression
@@ -98,11 +99,30 @@ def _int_key(ft: FieldType) -> bool:
 
 
 def _fold_selection(node: LogicalPlan):
-    """Selection(DataSource) → DataSource with conds folded into pushed."""
+    """Selection(DataSource) → DataSource with conds folded into pushed.
+
+    Works on a shallow COPY of the DataSource: slicing is an eligibility
+    probe that may be declined (or run twice when try_build_mpp fires at
+    nested nodes), so the shared plan tree must stay untouched."""
     if isinstance(node, Selection) and isinstance(node.children[0], DataSource):
-        ds = node.children[0]
+        ds = copy.copy(node.children[0])
         ds.pushed_conds = list(ds.pushed_conds) + list(node.conds)
         return ds
+    return node
+
+
+def _peel_identity_projection(node: LogicalPlan) -> LogicalPlan:
+    """The optimizer roots every SELECT with a Projection; when it is the
+    identity over its child's schema it is a no-op for slicing, so peel it
+    (mirrors eliminatePhysicalProjection, ref planner/core/optimizer.go:196)."""
+    while isinstance(node, Projection):
+        exprs = node.exprs
+        child = node.children[0]
+        if len(exprs) != len(child.out_cols):
+            break
+        if not all(isinstance(e, ExprCol) and e.idx == i for i, e in enumerate(exprs)):
+            break
+        node = child
     return node
 
 
@@ -156,7 +176,7 @@ def slice_plan(plan: LogicalPlan) -> MPPPlan | None:
     top). Returns None when the shape/types don't qualify; caller falls
     back to the root HashJoin path."""
     agg = None
-    node = plan
+    node = _peel_identity_projection(plan)
     if isinstance(node, Aggregation) and isinstance(node.children[0], (Join, Selection)):
         inner = _fold_selection(node.children[0])
         if isinstance(inner, Join):
