@@ -69,6 +69,10 @@ def encode_row(col_ids: list[int], datums: list[Datum]) -> bytes:
 
 
 def decode_row(data: bytes) -> dict[int, Datum]:
+    if data and data[0] == 0x81:  # row format v2 (vectorized batch codec)
+        from .rowfast import decode_row_v2
+
+        return decode_row_v2(data)
     pos = 0
     n, pos = _rvarint(data, pos)
     out: dict[int, Datum] = {}

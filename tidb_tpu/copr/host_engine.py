@@ -25,23 +25,68 @@ def _eval_mask(conds: list[Expression], chunk: Chunk) -> np.ndarray:
     return mask
 
 
-def _group_codes(keys: list[tuple[np.ndarray, np.ndarray]]):
-    """Rows → dense group ids via lexicographic unique over key columns."""
-    n = len(keys[0][0])
-    if n == 0:
-        return np.zeros(0, dtype=np.int64), []
-    arrays = []
-    for d, v in keys:
-        if d.dtype == object:
-            # factorize the object lane; validity lane keeps NULL distinct
-            _, inv = np.unique(np.where(v, d, "").astype("U"), return_inverse=True)
-            arrays.append(inv.astype(np.int64))
+def _lane_codes(d: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """One key lane → small-range non-negative int64 codes (NULL = extra
+    code 0; valid codes start at 1)."""
+    if d.dtype == object:
+        filled = np.where(v, d, "")
+        try:
+            s = filled.astype("S")  # ascii fast path
+        except UnicodeEncodeError:
+            s = filled.astype("U")  # non-ascii: factorize unicode directly
+        w = s.dtype.itemsize
+        if s.dtype.kind == "S" and 0 < w <= 8:
+            # ≤8-byte strings: big-endian byte code preserves ordering and
+            # identity — factorize with ONE 1-D sort instead of string sorts
+            mat = np.zeros((len(s), 8), dtype=np.uint8)
+            mat[:, :w] = s.view(np.uint8).reshape(len(s), w)
+            raw = mat.view(">u8").reshape(len(s))
         else:
-            arrays.append(d.astype(np.int64))
-        arrays.append(v.astype(np.int64))
-    stacked = np.stack(arrays, axis=0)
-    _, first_idx, inv = np.unique(stacked, axis=1, return_index=True, return_inverse=True)
-    return inv.astype(np.int64), first_idx
+            raw = s
+        _, inv = np.unique(raw, return_inverse=True)
+        codes = inv.astype(np.int64) + 1
+    elif d.dtype == np.float64:
+        _, inv = np.unique(np.where(v, d, 0.0), return_inverse=True)
+        codes = inv.astype(np.int64) + 1
+    else:
+        x = np.where(v, d.astype(np.int64), 0)
+        lo = int(x.min()) if len(x) else 0
+        hi = int(x.max()) if len(x) else 0
+        if hi - lo >= (1 << 62):  # huge span: factorize instead of shifting
+            _, inv = np.unique(x, return_inverse=True)
+            codes = inv.astype(np.int64) + 1
+        else:
+            codes = (x - lo) + 1
+    return np.where(v, codes, 0)
+
+
+def _group_codes_masked(keys: list[tuple[np.ndarray, np.ndarray]], mask: np.ndarray):
+    """Selected rows → dense group ids.
+
+    → (inv: group id per selected row, first_row: absolute row index of
+    each group's first occurrence, G). Lanes factorize to small ranges,
+    pack into one int64 (single final sort); falls back to a stacked
+    column unique if the range product overflows.
+    """
+    sel_idx = np.nonzero(mask)[0]
+    if len(sel_idx) == 0:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64), 0
+    lanes = [_lane_codes(d[sel_idx], v[sel_idx]) for d, v in keys]
+    packed = None
+    total = 1
+    for lane in lanes:
+        rng = int(lane.max()) + 1
+        if total > (1 << 62) // max(rng, 1):
+            packed = None
+            break
+        packed = lane if packed is None else packed * rng + lane
+        total *= rng
+    if packed is None:  # overflow — stacked lexicographic unique
+        stacked = np.stack(lanes, axis=0)
+        _, first_sel, inv = np.unique(stacked, axis=1, return_index=True, return_inverse=True)
+    else:
+        _, first_sel, inv = np.unique(packed, return_index=True, return_inverse=True)
+    return inv.astype(np.int64), sel_idx[first_sel], len(first_sel)
 
 
 def execute_dag_host(dag: DAGRequest, batch: ColumnBatch) -> Chunk:
@@ -100,15 +145,7 @@ def _exec_agg(dag: DAGRequest, chunk: Chunk, mask: np.ndarray | None) -> Chunk:
     gb = dag.agg.group_by
     if gb:
         keyvals = [e.eval(chunk) for e in gb]
-        codes, _ = _group_codes(keyvals)
-        # restrict to selected rows
-        sel_codes = codes[mask]
-        uniq, inv = np.unique(sel_codes, return_inverse=True)
-        G = len(uniq)
-        # first row index per group for key output
-        sel_idx = np.nonzero(mask)[0]
-        first_row = np.zeros(G, dtype=np.int64)
-        first_row[inv[::-1]] = sel_idx[::-1]  # keep first occurrence
+        inv, first_row, G = _group_codes_masked(keyvals, mask)
     else:
         G = 1
         inv = np.zeros(int(mask.sum()), dtype=np.int64)

@@ -48,28 +48,122 @@ class ColumnBatch:
         return Chunk(cols)
 
 
+def _decode_handles(keybuf: np.ndarray, n: int) -> np.ndarray:
+    """(n, 19) record-key byte matrix → int64 handles (vectorized BE+sign)."""
+    enc = np.ascontiguousarray(keybuf[:, 11:19]).view(">u8").reshape(n)
+    return (enc.astype(np.uint64) ^ np.uint64(1 << 63)).view(np.int64)
+
+
+def _decode_values_into(table, cols, big: np.ndarray, offs: np.ndarray, lens: np.ndarray, rows_idx: np.ndarray, handles: np.ndarray) -> None:
+    """Decode row values (at byte offsets `offs`, byte lengths `lens`, in
+    buffer `big`) into chunk columns at target positions `rows_idx`; v2
+    rows vectorized, v1 rows per-row."""
+    from ..codec import rowfast
+
+    n = len(offs)
+    if n == 0:
+        return
+    first = big[offs]
+    v2 = first == rowfast.V2_FLAG
+    v2_pos = np.nonzero(v2)[0]
+    if len(v2_pos):
+        # batch-decode header-identical rows; fall back on the rest
+        bad = rowfast.decode_v2_batch(big, offs[v2_pos], table, cols, rows_idx[v2_pos])
+        for b in bad:  # rare: schema drifted mid-table
+            p = v2_pos[int(b)]
+            end = int(offs[p]) + int(lens[p])
+            _decode_one(table, cols, int(rows_idx[p]), big[offs[p] : end].tobytes(), int(handles[p]))
+    for p in np.nonzero(~v2)[0]:
+        end = int(offs[p]) + int(lens[p])
+        _decode_one(table, cols, int(rows_idx[p]), big[offs[p] : end].tobytes(), int(handles[p]))
+
+
 def decode_rows_to_batch(table: TableInfo, kvs: list[tuple[bytes, bytes]], version: int) -> ColumnBatch:
     """Row-format KV pairs → dense columnar batch (the once-per-version
-    decode; ref: rowcodec ChunkDecoder decoding straight into chunks)."""
+    decode; ref: rowcodec ChunkDecoder decoding straight into chunks).
+
+    v2 rows (bulk-loaded, identical headers) decode with vectorized numpy
+    gathers; v1 rows (DML path) fall back to per-row decode. A mixed batch
+    routes each row down the right path by its version flag.
+    """
     n = len(kvs)
-    handles = np.zeros(n, dtype=np.int64)
     chk = Chunk.empty([c.ft for c in table.columns], n)
     cols = chk.columns
-    defaults = [c.default for c in table.columns]
+
+    # handles: record keys are fixed 19 bytes → one vectorized BE decode
+    keybuf = np.frombuffer(b"".join(k for k, _ in kvs), dtype=np.uint8)
+    if n and len(keybuf) == 19 * n:
+        handles = _decode_handles(keybuf.reshape(n, 19), n)
+    else:  # ragged keys (shouldn't happen for record scans) — per-row
+        handles = np.fromiter((tablecodec.decode_record_handle(k) for k, _ in kvs), np.int64, n)
+
+    vals = [v for _, v in kvs]
+    lens = np.fromiter((len(v) for v in vals), np.int64, n)
+    big = np.frombuffer(b"".join(vals), dtype=np.uint8)
+    offs = np.zeros(n, dtype=np.int64)
+    if n > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    _decode_values_into(table, cols, big, offs, lens, np.arange(n, dtype=np.int64), handles)
+
+    # hidden rowid column mirrors handles
+    for c in table.columns:
+        if c.hidden and c.name == "_tidb_rowid":
+            cols[c.offset].data[:] = handles
+            cols[c.offset].valid[:] = True
+    return ColumnBatch(table, handles, [c.data for c in cols], [c.valid for c in cols], version)
+
+
+def build_batch_from_segments(table: TableInfo, segs, loose, version) -> ColumnBatch:
+    """Segment scan results → columnar batch, gathering key/value bytes
+    straight out of run buffers (zero per-row materialization for the
+    bulk-loaded fast path)."""
+    keeps = [s.keep_idx() for s in segs]
+    n = sum(len(k) for k in keeps) + len(loose)
+    chk = Chunk.empty([c.ft for c in table.columns], n)
+    cols = chk.columns
+    handles = np.zeros(n, dtype=np.int64)
+    row0 = 0
+    for s, keep in zip(segs, keeps):
+        m = len(keep)
+        if m == 0:
+            continue
+        run = s.run
+        key_mat = run.key_mat[keep]
+        if key_mat.shape[1] == 19:
+            seg_handles = _decode_handles(key_mat, m)
+        else:
+            seg_handles = np.fromiter(
+                (tablecodec.decode_record_handle(run.key_at(int(i))) for i in keep), np.int64, m
+            )
+        handles[row0 : row0 + m] = seg_handles
+        big = run.value_buffer()
+        rows_idx = np.arange(row0, row0 + m, dtype=np.int64)
+        _decode_values_into(table, cols, big, run.starts[keep], run.lens[keep], rows_idx, seg_handles)
+        row0 += m
+    for k, v in loose:
+        h = tablecodec.decode_record_handle(k)
+        handles[row0] = h
+        _decode_one(table, cols, row0, v, h)
+        row0 += 1
+    for c in table.columns:
+        if c.hidden and c.name == "_tidb_rowid":
+            cols[c.offset].data[:] = handles
+            cols[c.offset].valid[:] = True
+    return ColumnBatch(table, handles, [c.data for c in cols], [c.valid for c in cols], version)
+
+
+def _decode_one(table: TableInfo, cols, i: int, val: bytes, handle: int) -> None:
     from ..table.table import datum_from_default
 
-    for i, (k, v) in enumerate(kvs):
-        handles[i] = tablecodec.decode_record_handle(k)
-        by_id = decode_row(v)
-        for off, c in enumerate(table.columns):
-            d = by_id.get(c.id)
-            if d is None:
-                if c.hidden and c.name == "_tidb_rowid":
-                    d = Datum.i(handles[i])
-                else:
-                    d = datum_from_default(c)
-            cols[off].set_datum(i, d)
-    return ColumnBatch(table, handles, [c.data for c in cols], [c.valid for c in cols], version)
+    by_id = decode_row(val)
+    for off, c in enumerate(table.columns):
+        d = by_id.get(c.id)
+        if d is None:
+            if c.hidden and c.name == "_tidb_rowid":
+                d = Datum.i(handle)
+            else:
+                d = datum_from_default(c)
+        cols[off].set_datum(i, d)
 
 
 class TileCache:
@@ -97,8 +191,8 @@ class TileCache:
             return cached
         self.misses += 1
         snap = self.storage.snapshot(read_ts)
-        kvs = snap.scan(start, end)
-        batch = decode_rows_to_batch(table, kvs, ver)
+        segs, loose = snap.scan_segments(start, end)
+        batch = build_batch_from_segments(table, segs, loose, ver)
         batch.start, batch.end = start, end
         batch.min_valid_ts = last_commit_ts
         if read_ts >= last_commit_ts:

@@ -127,10 +127,18 @@ def _kind_of(ft) -> int:
     return K_INT
 
 
-def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: dict[str, int] | None = None, batch: int = 200_000):
+def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: dict[str, int] | None = None, batch: int = 500_000):
     """Bulk-load columns into a table through the ingest path (2PC bypass,
     the Lightning local backend analog). Rows get sequential handles.
-    Column kinds derive from the table schema unless overridden."""
+    Column kinds derive from the table schema unless overridden.
+
+    Hot path is fully vectorized: row values batch-encode in format v2
+    (codec/rowfast.py), record keys and int-keyed index keys build as numpy
+    byte matrices (ref: Lightning's backend/kv encoder, which likewise
+    batch-encodes without per-cell interpretation).
+    """
+    from ..codec import rowfast
+
     info = session.infoschema().table(session.current_db, table_name)
     names = list(columns)
     col_infos = [info.col_by_name(n) for n in names]
@@ -151,16 +159,80 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
     arrays = [columns[n_] for n_ in names]
     kind_list = [kinds[n_] for n_ in names]
     commit_ts = session.store.tso.next()
-    scale_fix = []
-    for c, k in zip(col_infos, kind_list):
-        scale_fix.append(max(c.ft.decimal, 0) if k == K_DEC else 0)
+    scale_fix = [max(c.ft.decimal, 0) if k == K_DEC else 0 for c, k in zip(col_infos, kind_list)]
+    indexes = [ix for ix in info.indexes if ix.state != "delete_only" and not (info.pk_is_handle and ix.primary)]
 
+    if rowfast.encodable_kinds(kind_list):
+        name_pos = {c.offset: i for i, c in enumerate(col_infos)}
+        int_kinds = (K_INT, K_TIME)
+        mvcc = session.store.mvcc
+        for lo in range(0, n, batch):
+            hi = min(lo + batch, n)
+            m = hi - lo
+            arrs = [a[lo:hi] for a in arrays]
+            if pk_handle_pos is not None:
+                handles = np.asarray(arrs[pk_handle_pos]).astype(np.int64)
+                presorted = bool(np.all(np.diff(handles) > 0)) if m > 1 else True
+            else:
+                handles = np.arange(first_handle + lo, first_handle + hi, dtype=np.int64)
+                presorted = True
+            buf, offs = rowfast.encode_rows_v2(col_ids, kind_list, scale_fix, arrs)
+            key_mat = rowfast.record_key_matrix(info.id, handles)
+            mvcc.ingest_run(key_mat, buf, offs[:-1], np.diff(offs), commit_ts, presorted=presorted)
+            for ix in indexes:
+                poss = [name_pos.get(off) for off in ix.col_offsets]
+                if all(p is not None and kind_list[p] in int_kinds for p in poss):
+                    kcols = [np.asarray(arrs[p]).astype(np.int64) for p in poss]
+                    if ix.unique:
+                        imat = rowfast.int_index_key_matrix(info.id, ix.id, kcols, None)
+                        vbuf, vstarts, vlens = rowfast.handle_value_buffer(handles)
+                        mvcc.ingest_run(imat, vbuf, vstarts, vlens, commit_ts)
+                    else:
+                        imat = rowfast.int_index_key_matrix(info.id, ix.id, kcols, handles)
+                        z = np.zeros(m, dtype=np.int64)
+                        mvcc.ingest_run(imat, b"", z, z, commit_ts)
+                else:  # string/decimal/missing index cols — per-row fallback
+                    kvs: list[tuple[bytes, bytes]] = []
+                    _index_kvs_slow(info, ix, col_infos, arrs, kind_list, scale_fix, handles, kvs)
+                    mvcc.ingest(kvs, commit_ts)
+    else:
+        _bulk_load_rows(session, info, col_infos, col_ids, arrays, kind_list, scale_fix, pk_handle_pos, first_handle, indexes, commit_ts, batch)
+    session.store.bump_version([tablecodec.record_prefix(info.id)])
+    session.cop.tiles.invalidate_table(info.id)
+    return n
+
+
+def _index_kvs_slow(info, ix, col_infos, arrs, kind_list, scale_fix, handles, kvs):
+    from ..table.table import Table
+
+    tbl = Table(info)
+    n_tbl_cols = len(info.columns)
+    offsets = [c.offset for c in col_infos]
+    for i in range(len(handles)):
+        full = [Datum.null()] * n_tbl_cols
+        for off, arr, k, sf in zip(offsets, arrs, kind_list, scale_fix):
+            v = arr[i]
+            if k == K_DEC:
+                full[off] = Datum.d(Dec(int(v), sf))
+            elif k == K_STR:
+                full[off] = Datum.s(str(v))
+            else:
+                full[off] = Datum(k, int(v))
+        for c in info.columns:
+            if c.hidden and c.name == "_tidb_rowid":
+                full[c.offset] = Datum.i(int(handles[i]))
+        ikey, ival, _ = tbl.index_value_key(ix, full, int(handles[i]))
+        kvs.append((ikey, ival))
+
+
+def _bulk_load_rows(session, info, col_infos, col_ids, arrays, kind_list, scale_fix, pk_handle_pos, first_handle, indexes, commit_ts, batch):
+    """Per-row fallback for kinds the vectorized encoder doesn't cover."""
     from ..table.table import Table
 
     tbl = Table(info)
     offsets = [c.offset for c in col_infos]
     n_tbl_cols = len(info.columns)
-    indexes = [ix for ix in info.indexes if ix.state != "delete_only" and not (info.pk_is_handle and ix.primary)]
+    n = len(arrays[0])
     kvs = []
     for lo in range(0, n, batch):
         hi = min(lo + batch, n)
@@ -188,9 +260,6 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
                     kvs.append((ikey, ival))
         session.store.mvcc.ingest(kvs, commit_ts)
         kvs = []
-    session.store.bump_version([tablecodec.record_prefix(info.id)])
-    session.cop.tiles.invalidate_table(info.id)
-    return n
 
 
 def setup_lineitem(session, n_rows: int, seed: int = 42) -> int:

@@ -90,10 +90,19 @@ def _dk(key: bytes, ts: int) -> bytes:
 
 
 class MVCCStore:
-    """One region-server's transactional KV (single process, many regions)."""
+    """One region-server's transactional KV (single process, many regions).
+
+    Two planes:
+      - mutable plane: lock/write/default CFs in the ordered MemKV — the
+        percolator write path (prewrite/commit), versioned per key;
+      - ingest plane: immutable sorted `Run` segments (storage/segment.py),
+        one commit_ts per run — the Lightning-SST / TiFlash-replica analog.
+    Reads merge both; newer commit_ts wins per key.
+    """
 
     def __init__(self, kv: MemKV | None = None):
         self.kv = kv or MemKV()
+        self.runs: list = []  # Run segments, ascending commit_ts
         # data-version counters per table-prefix space are maintained above
         # (storage.Storage) — the MVCC layer stays schema-agnostic.
 
@@ -109,22 +118,44 @@ class MVCCStore:
         if lock.start_ts <= read_ts:
             raise LockedError(f"key is locked by txn {lock.start_ts}", key=key, lock=lock)
 
-    def _visible_write(self, key: bytes, read_ts: int) -> WriteRecord | None:
+    def _visible_write(self, key: bytes, read_ts: int) -> tuple[WriteRecord, int] | None:
+        """Newest visible PUT/DEL record → (record, commit_ts)."""
         for k, v in self.kv.iter_from(_wk(key, read_ts)):
             if not k.startswith(b"w" + key) or len(k) != 1 + len(key) + 8:
                 return None
             rec = WriteRecord.decode(v)
             if rec.op in (OP_PUT, OP_DEL):
-                return rec
+                return rec, unrev_ts(k[-8:])
             # rollbacks / lock-records: keep looking at older versions
         return None
 
+    def _run_get(self, key: bytes, read_ts: int) -> tuple[bytes | None, int]:
+        """Newest run entry visible at read_ts → (value, commit_ts)."""
+        for run in reversed(self.runs):
+            if run.commit_ts > read_ts:
+                continue
+            i = run.find(key)
+            if i >= 0:
+                return run.value(i), run.commit_ts
+        return None, 0
+
+    def _run_newest_commit(self, key: bytes) -> int:
+        for run in reversed(self.runs):
+            if run.find(key) >= 0:
+                return run.commit_ts
+        return 0
+
     def get(self, key: bytes, read_ts: int) -> bytes | None:
         self._check_lock(key, read_ts)
-        rec = self._visible_write(key, read_ts)
-        if rec is None or rec.op == OP_DEL:
-            return None
-        return self.kv.get(_dk(key, rec.start_ts))
+        found = self._visible_write(key, read_ts)
+        rval, rts = self._run_get(key, read_ts) if self.runs else (None, 0)
+        if found is not None:
+            rec, cts = found
+            if cts >= rts:  # mutable write newer than any run entry
+                if rec.op == OP_DEL:
+                    return None
+                return self.kv.get(_dk(key, rec.start_ts))
+        return rval
 
     def batch_get(self, keys: list[bytes], read_ts: int) -> dict[bytes, bytes]:
         out = {}
@@ -134,16 +165,10 @@ class MVCCStore:
                 out[k] = v
         return out
 
-    def scan(self, start: bytes, end: bytes, read_ts: int, limit: int | None = None):
-        """Snapshot range scan → list of (user_key, value)."""
+    def _scan_mut(self, start: bytes, end: bytes | None, read_ts: int):
+        """Mutable-plane scan → [(user_key, value | None-for-delete, commit_ts)]."""
         out = []
-        # collect blocking locks in range first (reader must resolve)
-        for k, raw in self.kv.scan(_lk(start), _lk(end)):
-            lock = Lock.decode(raw)
-            if lock.op != OP_LOCK and lock.start_ts <= read_ts:
-                raise LockedError("range contains locked key", key=k[1:], lock=lock)
-        cur = start
-        it = self.kv.iter_from(b"w" + cur)
+        it = self.kv.iter_from(b"w" + start)
         last_key = None
         for k, v in it:
             if not k.startswith(b"w") or (end is not None and k[1:-8] >= end):
@@ -157,21 +182,97 @@ class MVCCStore:
             last_key = ukey
             rec = WriteRecord.decode(v)
             if rec.op == OP_PUT:
-                val = self.kv.get(_dk(ukey, rec.start_ts))
-                out.append((ukey, val))
-                if limit is not None and len(out) >= limit:
-                    break
+                out.append((ukey, self.kv.get(_dk(ukey, rec.start_ts)), ts))
             elif rec.op == OP_DEL:
-                continue
+                out.append((ukey, None, ts))
             else:
                 # rollback/lock record newest-visible: older versions may
                 # still be visible — rare path, do a point get
-                val_rec = self._visible_write(ukey, read_ts)
-                if val_rec and val_rec.op == OP_PUT:
-                    out.append((ukey, self.kv.get(_dk(ukey, val_rec.start_ts))))
-                    if limit is not None and len(out) >= limit:
-                        break
+                found = self._visible_write(ukey, read_ts)
+                if found and found[0].op == OP_PUT:
+                    out.append((ukey, self.kv.get(_dk(ukey, found[0].start_ts)), found[1]))
+                elif found:
+                    out.append((ukey, None, found[1]))
         return out
+
+    def _check_range_locks(self, start: bytes, end: bytes | None, read_ts: int) -> None:
+        # cap at b"m": the l-CF's end — an open-ended scan must not run
+        # into the next CF's keys
+        hi = _lk(end) if end is not None else b"m"
+        for k, raw in self.kv.scan(_lk(start), hi):
+            lock = Lock.decode(raw)
+            if lock.op != OP_LOCK and lock.start_ts <= read_ts:
+                raise LockedError("range contains locked key", key=k[1:], lock=lock)
+
+    def scan_segments(self, start: bytes, end: bytes | None, read_ts: int):
+        """Snapshot range scan without materializing per-row objects:
+        → (segments: list[SegmentView], loose: list[(user_key, value)]).
+
+        Segments are slices of ingest runs visible at read_ts; `loose` is
+        the (usually small) mutable plane. Shadowing is resolved here:
+        newer runs drop duplicate keys from older ones, and mutable writes
+        newer than a run entry drop it (a mutable DELETE suppresses it)."""
+        from .segment import SegmentView
+
+        self._check_range_locks(start, end, read_ts)
+        mut = self._scan_mut(start, end, read_ts)
+        segs: list[SegmentView] = []
+        for run in self.runs:  # ascending commit_ts
+            if run.commit_ts > read_ts:
+                continue
+            i, j = run.range(start, end)
+            if i < j:
+                segs.append(SegmentView(run, i, j))
+        # run-vs-run: a newer run shadows duplicate keys in older runs.
+        # Pairs can only collide when key widths match (different widths
+        # can't encode equal keys) and commit_ts differs (one bulk_load's
+        # runs share a ts and are disjoint by construction) — so the
+        # per-key set walk below runs only on genuine re-ingest overlap.
+        for bi in range(1, len(segs)):
+            b = segs[bi]
+            for ai in range(bi):
+                a = segs[ai]
+                if (
+                    a.run.w == b.run.w
+                    and a.run.commit_ts != b.run.commit_ts
+                    and a.min_key() <= b.max_key()
+                    and b.min_key() <= a.max_key()
+                ):
+                    bkeys = {b.run.key_at(i) for i in range(b.i, b.j)}
+                    drop = {idx for idx in range(a.i, a.j) if a.run.key_at(idx) in bkeys}
+                    if drop:
+                        a.drop = (a.drop or set()) | drop
+        loose: list[tuple[bytes, bytes]] = []
+        for k, v, ts in mut:
+            shadowed = False
+            for s in segs:
+                idx = s.run.find(k)
+                if s.i <= idx < s.j:
+                    if s.run.commit_ts > ts:
+                        shadowed = True  # run entry is newer — run wins
+                    else:
+                        s.drop = (s.drop or set()) | {idx}
+            if not shadowed and v is not None:
+                loose.append((k, v))
+        return segs, loose
+
+    def scan(self, start: bytes, end: bytes, read_ts: int, limit: int | None = None):
+        """Snapshot range scan → list of (user_key, value), key-ordered."""
+        segs, loose = self.scan_segments(start, end, read_ts)
+        if not segs:
+            out = loose
+        else:
+            segs.sort(key=lambda s: s.min_key())
+            disjoint = all(
+                segs[i].max_key() < segs[i + 1].min_key() for i in range(len(segs) - 1)
+            )
+            out = []
+            for s in segs:
+                out.extend(s.pairs())
+            if loose or not disjoint:
+                out.extend(loose)
+                out.sort(key=lambda kv: kv[0])
+        return out[:limit] if limit is not None else out
 
     # --- writes (percolator) ---------------------------------------------
 
@@ -196,6 +297,8 @@ class MVCCStore:
                     if committed > start_ts and rec.op in (OP_PUT, OP_DEL) and for_update_ts == 0:
                         raise WriteConflict(f"conflict at {committed} > start {start_ts}")
                     break
+                if self.runs and for_update_ts == 0 and self._run_newest_commit(m.key) > start_ts:
+                    raise WriteConflict(f"ingest-run conflict newer than start {start_ts}")
                 self.kv.put(_lk(m.key), Lock(m.op, primary, start_ts, ttl_ms, for_update_ts).encode())
                 if m.op == OP_PUT:
                     self.kv.put(_dk(m.key, start_ts), m.value)
@@ -278,14 +381,40 @@ class MVCCStore:
             return True
         return False
 
+    def ingest_run(
+        self,
+        key_mat,
+        vbuf: bytes,
+        starts,
+        lens,
+        commit_ts: int,
+        presorted: bool = False,
+    ) -> None:
+        """Bulk ingest one fixed-width-key segment, bypassing 2PC (ref:
+        br/pkg/lightning local backend — builds SSTs and ingests). All
+        entries become visible atomically at commit_ts."""
+        from .segment import Run
+
+        run = Run.build(key_mat, vbuf, starts, lens, commit_ts, presorted=presorted)
+        if run.n:
+            self.runs.append(run)
+
     def ingest(self, kvs: list[tuple[bytes, bytes]], commit_ts: int) -> None:
-        """Bulk ingest pre-committed data, bypassing 2PC (ref:
-        br/pkg/lightning local backend — builds SSTs and ingests)."""
-        pairs = []
+        """Bulk ingest arbitrary (key, value) pairs: groups by key width
+        into fixed-width runs (one run per width)."""
+        import numpy as np
+
+        by_w: dict[int, list[tuple[bytes, bytes]]] = {}
         for k, v in kvs:
-            pairs.append((_wk(k, commit_ts), WriteRecord(OP_PUT, commit_ts).encode()))
-            pairs.append((_dk(k, commit_ts), v))
-        self.kv.bulk_load(pairs)
+            by_w.setdefault(len(k), []).append((k, v))
+        for w, group in by_w.items():
+            n = len(group)
+            key_mat = np.frombuffer(b"".join(k for k, _ in group), dtype=np.uint8).reshape(n, w)
+            vbuf = b"".join(v for _, v in group)
+            lens = np.fromiter((len(v) for _, v in group), np.int64, n)
+            starts = np.zeros(n, dtype=np.int64)
+            np.cumsum(lens[:-1], out=starts[1:])
+            self.ingest_run(key_mat, vbuf, starts, lens, commit_ts)
 
     def unsafe_destroy_range(self, start: bytes, end: bytes) -> int:
         """Physically remove ALL versions/locks in a user-key range —
@@ -294,6 +423,9 @@ class MVCCStore:
         n = 0
         for cf in (b"d", b"w", b"l"):
             n += self.kv.delete_range(cf + start, cf + end)
+        for run in self.runs:
+            n += run.kill_range(start, end)
+        self.runs = [r for r in self.runs if r.alive is None or r.alive.any()]
         return n
 
     # --- GC (ref: store/gcworker) -----------------------------------------

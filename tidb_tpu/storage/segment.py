@@ -1,0 +1,156 @@
+"""Immutable sorted ingest segments — the LSM-run / TiFlash-columnar-replica
+analog (ref: br/pkg/lightning local backend builds SSTs and ingests them
+without touching the write path; unistore sits on badger's LSM runs).
+
+A `Run` is one bulk-ingested, single-commit-ts sorted segment:
+  - fixed-width user keys as a (n, w) uint8 matrix (memcomparable order)
+  - values as ONE buffer + (starts, lens) — no per-row bytes objects
+  - a whole-run commit_ts: every entry became visible atomically, so MVCC
+    visibility is a single comparison per run, not per key
+
+Point/range lookups binary-search the key matrix directly (no per-key
+Python objects are ever materialized on the ingest or scan hot paths).
+Scans return `SegmentView`s (run slice + optional dropped rows) so the
+columnar decode layer (copr/tilecache.py) can gather straight from the
+run's buffers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sort_key_matrix(key_mat: np.ndarray) -> np.ndarray:
+    """Row order that sorts fixed-width byte-string rows lexicographically.
+    Views rows as big-endian u64 words (zero-padded) and lexsorts."""
+    n, w = key_mat.shape
+    pad = (-w) % 8
+    if pad:
+        m = np.zeros((n, w + pad), dtype=np.uint8)
+        m[:, :w] = key_mat
+    else:
+        m = np.ascontiguousarray(key_mat)
+    words = m.view(">u8").reshape(n, (w + pad) // 8)
+    return np.lexsort(tuple(words[:, c] for c in range(words.shape[1] - 1, -1, -1)))
+
+
+class Run:
+    """One immutable sorted segment (all keys same width, one commit_ts)."""
+
+    __slots__ = ("key_mat", "vbuf", "starts", "lens", "commit_ts", "alive", "n", "w", "_keybuf")
+
+    def __init__(self, key_mat: np.ndarray, vbuf, starts: np.ndarray, lens: np.ndarray, commit_ts: int):
+        self.key_mat = key_mat
+        self.vbuf = vbuf  # bytes or 1-D uint8 array
+        self.starts = starts
+        self.lens = lens
+        self.commit_ts = commit_ts
+        self.alive: np.ndarray | None = None  # None = all alive
+        self.n, self.w = key_mat.shape
+        self._keybuf: bytes | None = None  # lazy contiguous key bytes
+
+    @staticmethod
+    def build(key_mat: np.ndarray, vbuf, starts: np.ndarray, lens: np.ndarray,
+              commit_ts: int, presorted: bool = False) -> "Run":
+        key_mat = np.ascontiguousarray(key_mat, dtype=np.uint8)
+        if not presorted and key_mat.shape[0] > 1:
+            order = sort_key_matrix(key_mat)
+            if not np.array_equal(order, np.arange(len(order))):
+                key_mat = np.ascontiguousarray(key_mat[order])
+                starts = np.asarray(starts)[order]
+                lens = np.asarray(lens)[order]
+        return Run(key_mat, vbuf, np.asarray(starts, np.int64), np.asarray(lens, np.int64), commit_ts)
+
+    # --- key access -------------------------------------------------------
+
+    def key_at(self, i: int) -> bytes:
+        if self._keybuf is None:
+            self._keybuf = self.key_mat.tobytes()
+        return self._keybuf[i * self.w : (i + 1) * self.w]
+
+    def _bisect(self, key: bytes) -> int:
+        """Leftmost row index with key_at(row) >= key (bytes comparison —
+        a shorter probe key sorts before any key it prefixes, matching
+        python bytes ordering used by MemKV)."""
+        lo, hi = 0, self.n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.key_at(mid) < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    # --- point ops --------------------------------------------------------
+
+    def find(self, key: bytes) -> int:
+        """Row index of key, or -1."""
+        if len(key) != self.w:
+            return -1
+        i = self._bisect(key)
+        if i < self.n and self.key_at(i) == key and (self.alive is None or self.alive[i]):
+            return i
+        return -1
+
+    def value(self, i: int) -> bytes:
+        s = int(self.starts[i])
+        v = self.vbuf[s : s + int(self.lens[i])]
+        return v.tobytes() if isinstance(v, np.ndarray) else v
+
+    def value_buffer(self) -> np.ndarray:
+        """The whole value plane as a u8 array (decode fast path)."""
+        if isinstance(self.vbuf, np.ndarray):
+            return self.vbuf
+        return np.frombuffer(self.vbuf, dtype=np.uint8)
+
+    def range(self, start: bytes, end: bytes | None) -> tuple[int, int]:
+        i = self._bisect(start)
+        j = self._bisect(end) if end is not None else self.n
+        return i, j
+
+    def kill_range(self, start: bytes, end: bytes | None) -> int:
+        """Tombstone all rows in [start, end) (unsafe_destroy_range)."""
+        i, j = self.range(start, end)
+        if i >= j:
+            return 0
+        if self.alive is None:
+            self.alive = np.ones(self.n, dtype=bool)
+        killed = int(self.alive[i:j].sum())
+        self.alive[i:j] = False
+        return killed
+
+
+class SegmentView:
+    """A scan's view of one run slice, minus dropped (shadowed) rows."""
+
+    __slots__ = ("run", "i", "j", "drop")
+
+    def __init__(self, run: Run, i: int, j: int, drop: set[int] | None = None):
+        self.run = run
+        self.i = i
+        self.j = j
+        self.drop = drop  # absolute row indices within run
+
+    def keep_idx(self) -> np.ndarray:
+        """Absolute row indices surviving drop + alive mask, in key order."""
+        idx = np.arange(self.i, self.j, dtype=np.int64)
+        if self.run.alive is not None:
+            idx = idx[self.run.alive[self.i : self.j]]
+        if self.drop:
+            idx = idx[~np.isin(idx, np.fromiter(self.drop, np.int64, len(self.drop)))]
+        return idx
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.keep_idx())
+
+    def min_key(self) -> bytes:
+        return self.run.key_at(self.i)
+
+    def max_key(self) -> bytes:
+        return self.run.key_at(self.j - 1)
+
+    def pairs(self) -> list[tuple[bytes, bytes]]:
+        """Materialize (key, value) pairs — the legacy-scan compat path."""
+        r = self.run
+        return [(r.key_at(int(i)), r.value(int(i))) for i in self.keep_idx()]

@@ -36,6 +36,11 @@ class Snapshot:
     def scan(self, start: bytes, end: bytes, limit: int | None = None):
         return self._with_resolve(lambda: self.store.mvcc.scan(start, end, self.read_ts, limit))
 
+    def scan_segments(self, start: bytes, end: bytes):
+        """Zero-materialization scan → (segments, loose pairs); the columnar
+        decode path (copr/tilecache.py) gathers straight from run buffers."""
+        return self._with_resolve(lambda: self.store.mvcc.scan_segments(start, end, self.read_ts))
+
     def _with_resolve(self, fn, max_retry: int = 12):
         """Reads resolve blocking locks via the primary (client-go behavior)."""
         backoff = 0.002
