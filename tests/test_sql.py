@@ -255,3 +255,47 @@ class TestEngines:
         tpu = lineitem.must_query(q)
         assert host == tpu
         assert lineitem.cop.tpu.fallbacks == 0, "tpu engine fell back to host"
+
+
+class TestSortedAgg:
+    """High-cardinality / NULLable GROUP BY keys must run on device via the
+    sort-based segment path (no host fallback)."""
+
+    @pytest.fixture()
+    def wide(self, s):
+        s.execute("CREATE TABLE w (k BIGINT, g INT, v INT, name VARCHAR(16))")
+        rows = []
+        for i in range(200):
+            k = (i % 37) * 1_000_003  # domain span >> DIRECT_GROUP_MAX
+            g = None if i % 11 == 0 else i % 5
+            nm = f"n{i % 7}"
+            rows.append(f"({k}, {'NULL' if g is None else g}, {i}, '{nm}')")
+        s.execute("INSERT INTO w VALUES " + ",".join(rows))
+        return s
+
+    QUERIES = [
+        "SELECT k, COUNT(*), SUM(v) FROM w GROUP BY k ORDER BY k",
+        "SELECT g, COUNT(*), AVG(v) FROM w GROUP BY g ORDER BY g",
+        "SELECT k, g, MIN(v), MAX(v) FROM w GROUP BY k, g ORDER BY k, g",
+        "SELECT k, name, COUNT(*) FROM w GROUP BY k, name ORDER BY k, name",
+        "SELECT k, MIN(name), MAX(name) FROM w WHERE v < 150 GROUP BY k ORDER BY k",
+        "SELECT g, SUM(k) FROM w WHERE v >= 20 GROUP BY g ORDER BY g",
+    ]
+
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_sorted_agg_parity(self, wide, q):
+        wide.vars["tidb_cop_engine"] = "host"
+        host = wide.must_query(q)
+        wide.vars["tidb_cop_engine"] = "tpu"
+        tpu = wide.must_query(q)
+        assert host == tpu
+        assert wide.cop.tpu.fallbacks == 0, "tpu engine fell back to host"
+
+    def test_capacity_escalation(self, wide):
+        wide.vars["tidb_cop_engine"] = "tpu"
+        wide.cop.tpu.gcap0 = 4  # force the overflow/retry path
+        tpu = wide.must_query("SELECT k, COUNT(*) FROM w GROUP BY k ORDER BY k")
+        wide.vars["tidb_cop_engine"] = "host"
+        host = wide.must_query("SELECT k, COUNT(*) FROM w GROUP BY k ORDER BY k")
+        assert host == tpu
+        assert wide.cop.tpu.fallbacks == 0

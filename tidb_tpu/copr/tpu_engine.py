@@ -145,6 +145,8 @@ class DeviceBatch:
 class TPUEngine:
     def __init__(self):
         self._programs: dict = {}  # (digest, T, domains) -> compiled fn
+        self._gcap: dict = {}  # sorted-agg digest -> last sufficient capacity
+        self.gcap0 = 1 << 16  # initial sorted-agg group capacity
         self.compile_count = 0
         self.fallbacks = 0
 
@@ -356,32 +358,16 @@ class TPUEngine:
     def _lower_agg(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
         agg = dag.agg
         gb = agg.group_by
-        # group keys must be plain columns with a known finite domain
-        domains = []
-        key_cols = []
+        # group keys must be plain columns; float keys stay on host (bit
+        # equality vs MySQL value equality is not worth the hazard) and so
+        # do uint64 keys (values >= 2^63 would wrap in the int64 sort lanes)
         for g in gb:
             if not isinstance(g, ExprCol):
                 return None
-            if g.idx in vocabs:
-                domains.append(max(len(vocabs[g.idx]), 1))
-            else:
+            if g.idx not in vocabs:
                 d = dev.batch.data[dag.scan.col_offsets[g.idx]]
-                v = dev.batch.valid[dag.scan.col_offsets[g.idx]]
-                if d.dtype == np.float64 or not v.all() or len(d) == 0:
-                    lo, hi = 0, -1
-                else:
-                    lo, hi = int(d.min()), int(d.max())
-                if hi < lo or hi - lo + 1 > DIRECT_GROUP_MAX:
-                    return None  # unbounded int domain → host (sort path later)
-                domains.append(hi - lo + 1)
-                key_cols.append((g.idx, lo))
-                continue
-            key_cols.append((g.idx, 0))
-        nseg = 1
-        for s in domains:
-            nseg *= s + 1  # +1 lane for NULL keys
-        if nseg > DIRECT_GROUP_MAX:
-            return None
+                if d.dtype == np.float64 or d.dtype == np.uint64:
+                    return None
         for a in agg.aggs:
             if a.name not in ("count", "sum", "avg", "min", "max", "first_row"):
                 return None
@@ -389,6 +375,34 @@ class TPUEngine:
             if any(x is None for x in r_args):
                 return None
             a._device_args = r_args
+
+        # direct addressing needs NULL-free keys with small finite domains;
+        # anything else routes to the sort-based segment path
+        domains = []
+        key_cols = []
+        direct = True
+        for g in gb:
+            if g.idx in vocabs:
+                domains.append(max(len(vocabs[g.idx]), 1))
+            else:
+                d = dev.batch.data[dag.scan.col_offsets[g.idx]]
+                v = dev.batch.valid[dag.scan.col_offsets[g.idx]]
+                if not v.all() or len(d) == 0:
+                    direct = False
+                    break
+                lo, hi = int(d.min()), int(d.max())
+                if hi - lo + 1 > DIRECT_GROUP_MAX:
+                    direct = False
+                    break
+                domains.append(hi - lo + 1)
+                key_cols.append((g.idx, lo))
+                continue
+            key_cols.append((g.idx, 0))
+        nseg = 1
+        for s in domains:
+            nseg *= s + 1  # +1 lane for NULL keys
+        if not direct or nseg > DIRECT_GROUP_MAX:
+            return self._lower_agg_sorted(dag, dev, lanes, vocabs, r_conds)
 
         arrs, order = self._flatten_lanes(lanes)
         key = (
@@ -432,17 +446,133 @@ class TPUEngine:
 
         return run
 
-    def _packed_program(self, key, kernel, nseg):
-        """jit `kernel` (→ list of [nseg] arrays of mixed int/float dtype)
-        wrapped so the compiled program returns one stacked int64 array +
-        one stacked float64 array. The unpack layout is discovered at trace
-        time and cached next to the compiled fn."""
+    # --- sort-based aggregation (high-cardinality GROUP BY) -----------------
+
+    def _lower_agg_sorted(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
+        """GROUP BY with unbounded/NULLable key domains, fully on device.
+
+        The reference's high-NDV path is a murmur3 hash shuffle into
+        partial/final worker maps (executor/aggregate.go:544); hash tables
+        don't map onto the MXU/VPU, so the TPU redesign is sort-based: one
+        multi-operand `lax.sort` over (mask, null-flags, key lanes) makes
+        groups contiguous, a cumsum over boundary flags assigns dense
+        segment ids, and the same masked segment reductions as the direct
+        path produce partial states. Output capacity must be static under
+        jit, so programs are compiled at a group capacity that escalates
+        (and is remembered per DAG digest) when a batch overflows it."""
+        agg = dag.agg
+        gb = agg.group_by
+        key_idx = [g.idx for g in gb]
+        if not key_idx:
+            return None
+        arrs, order = self._flatten_lanes(lanes)
+        base_key = (
+            "aggsort",
+            repr(r_conds),
+            repr([(a.name, repr(a._device_args)) for a in agg.aggs]),
+            repr(key_idx),
+            dev.t,
+        )
+        I64_MIN = np.iinfo(np.int64).min
+
+        def make_kernel(gcap):
+            def kernel(flat, row_valid):
+                l = self._unflatten(flat, order)
+                mask = self._mask(r_conds, l, row_valid).reshape(-1)
+                n = mask.shape[0]
+                # lexicographic sort: masked rows last, then NULL flag +
+                # value per key; the trailing iota operand is the row perm
+                ops = [(~mask).astype(jnp.int32)]
+                for ki in key_idx:
+                    d, v = l[ki]
+                    vf = v.reshape(-1)
+                    ops.append((~vf).astype(jnp.int32))
+                    # zero data under NULL so residual bytes can't split
+                    # the NULL group (direct path normalizes the same way)
+                    ops.append(jnp.where(vf, d.reshape(-1).astype(jnp.int64), 0))
+                iota = jnp.arange(n, dtype=jnp.int32)
+                res = jax.lax.sort(tuple(ops) + (iota,), num_keys=len(ops))
+                perm = res[-1]
+                s_mask = res[0] == 0
+                s_keys = res[1:-1]
+                diff = jnp.zeros(n, dtype=bool).at[0].set(True)
+                one = jnp.ones(1, dtype=bool)
+                for k in s_keys:
+                    diff = diff | jnp.concatenate([one, k[1:] != k[:-1]])
+                new = diff & s_mask
+                seg0 = jnp.cumsum(new.astype(jnp.int32)) - 1
+                n_groups = jnp.maximum(seg0[-1] + 1, 0)
+                # groups beyond capacity fold into the overflow slot; the
+                # exact n_groups triggers a host-side retry at higher cap
+                seg = jnp.where(s_mask, jnp.minimum(seg0, gcap), gcap)
+                outs = []
+                for j in range(len(key_idx)):
+                    knull = s_keys[2 * j]
+                    kval = s_keys[2 * j + 1]
+                    outs.append(_seg_max(jnp.where(s_mask, kval, I64_MIN), seg, gcap, I64_MIN))
+                    outs.append(_seg_max(jnp.where(s_mask, 1 - knull.astype(jnp.int64), -1), seg, gcap, -1))
+                l_perm = {i: (dd.reshape(-1)[perm], vv.reshape(-1)[perm]) for i, (dd, vv) in l.items()}
+                for a in agg.aggs:
+                    outs.extend(self._agg_partials_device(a, l_perm, s_mask, seg, gcap, index_lane=perm))
+                return n_groups, outs
+
+            return kernel
+
+        def run():
+            gcap = self._gcap.get(base_key, self.gcap0)
+            while True:
+                fn, aux = self._packed_program(base_key + (gcap,), make_kernel(gcap), gcap, has_scalar=True)
+                ng_a, i_arr, f_arr = jax.device_get(fn(arrs, dev.row_valid))
+                ng = int(ng_a)
+                if ng <= gcap:
+                    break
+                while gcap < ng:
+                    gcap <<= 2
+                self._gcap[base_key] = gcap
+            outs = self._unpack((i_arr, f_arr), aux)
+            return self._agg_sorted_to_chunk(dag, dev, outs, key_idx, vocabs, ng)
+
+        return run
+
+    def _agg_sorted_to_chunk(self, dag, dev, outs, key_idx, vocabs, ng):
+        agg = dag.agg
+        out_fts = dag.output_types()
+        present = np.arange(ng)
+        cols: list[Column] = []
+        pos = 0
+        oi = 0
+        for ki in key_idx:
+            kval = np.asarray(outs[pos])[:ng]
+            valid = np.asarray(outs[pos + 1])[:ng] == 1
+            ft = out_fts[oi]
+            if ki in vocabs:
+                vocab = vocabs[ki]
+                data = np.empty(ng, dtype=object)
+                for j in range(ng):
+                    c = int(kval[j])
+                    data[j] = vocab[c] if valid[j] and 0 <= c < len(vocab) else None
+            else:
+                data = kval.astype(np.int64)
+                data[~valid] = 0
+            cols.append(Column(ft, data, valid))
+            pos += 2
+            oi += 1
+        cols.extend(self._agg_value_cols(dag, dev, outs, pos, oi, present, vocabs))
+        return Chunk(cols)
+
+    def _packed_program(self, key, kernel, nseg, has_scalar=False):
+        """jit `kernel` (→ list of [nseg] arrays of mixed int/float dtype;
+        with has_scalar, a (scalar, outs) pair) wrapped so the compiled
+        program returns one stacked int64 array + one stacked float64 array
+        (+ the scalar). The unpack layout is discovered at trace time and
+        cached next to the compiled fn."""
         cached = self._programs.get(key)
         if cached is None:
             aux: dict = {}
 
             def packed(flat, row_valid):
-                outs = kernel(flat, row_valid)
+                res = kernel(flat, row_valid)
+                scalar, outs = res if has_scalar else (None, res)
                 ints, flts, lay = [], [], []
                 for o in outs:
                     if jnp.issubdtype(o.dtype, jnp.floating):
@@ -454,7 +584,7 @@ class TPUEngine:
                 aux["layout"] = lay
                 i_arr = jnp.stack(ints) if ints else jnp.zeros((0, nseg), jnp.int64)
                 f_arr = jnp.stack(flts) if flts else jnp.zeros((0, nseg), jnp.float64)
-                return i_arr, f_arr
+                return (scalar, i_arr, f_arr) if has_scalar else (i_arr, f_arr)
 
             cached = (jax.jit(packed), aux)
             self._programs[key] = cached
@@ -466,7 +596,7 @@ class TPUEngine:
         i_arr, f_arr = packed
         return [i_arr[k] if t == "i" else f_arr[k] for t, k in aux["layout"]]
 
-    def _agg_partials_device(self, a, lanes, flat_mask, seg, nseg):
+    def _agg_partials_device(self, a, lanes, flat_mask, seg, nseg, index_lane=None):
         name = a.name
         if a._device_args:
             d, v = self._eval_device(a._device_args[0], lanes)
@@ -495,7 +625,7 @@ class TPUEngine:
             cnt = _seg_sum(ok.astype(jnp.int64), seg, nseg)
             return [s, cnt]
         if name == "first_row":
-            idx = jnp.arange(seg.shape[0])
+            idx = jnp.arange(seg.shape[0]) if index_lane is None else index_lane
             first = _seg_min(jnp.where(ok, idx, seg.shape[0]), seg, nseg, jnp.asarray(seg.shape[0]))
             return [first]
         raise NotImplementedError(name)
@@ -529,7 +659,17 @@ class TPUEngine:
                 data[~valid] = 0
             cols.append(Column(ft, data, valid))
             oi += 1
-        pos = 1
+        cols.extend(self._agg_value_cols(dag, dev, outs, 1, oi, present, vocabs))
+        return Chunk(cols)
+
+    def _agg_value_cols(self, dag, dev, outs, pos, oi, present, vocabs):
+        """Shared partial-state → Column decode for both agg paths.
+        `present` selects live group slots; `pos`/`oi` index the first
+        agg partial in `outs` / the first agg field in output_types()."""
+        agg = dag.agg
+        out_fts = dag.output_types()
+        G = len(present)
+        cols: list[Column] = []
         for a in agg.aggs:
             pf = a.partial_final_types()
             if a.name == "count":
@@ -584,7 +724,7 @@ class TPUEngine:
                 cols.append(Column(ft, data, valid))
                 pos += 1
                 oi += 1
-        return Chunk(cols)
+        return cols
 
     # --- topn ----------------------------------------------------------------
 
