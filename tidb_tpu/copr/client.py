@@ -2,17 +2,26 @@
 buildCopTasks:151 — the kv.Client seam SURVEY §5.8 names as the boundary
 where the TPU backend registers).
 
-Splits key ranges along region boundaries into cop tasks, dispatches each
-to an engine (TPU-fused program or host-vectorized fallback), and merges
-result chunks. Engine selection is per-session (`tidb_cop_engine` sysvar:
-'tpu' | 'host' | 'auto').
+Splits key ranges along region boundaries into cop tasks, dispatches them
+through a bounded worker pool (copIterator's run:363 analog) with
+ordered/unordered streaming merge (:461,533), retries tasks whose region
+epoch changed by re-splitting the remaining range (:1025
+buildCopTasksFromRemain), and streams result chunks back lazily so the
+root operators overlap with in-flight cop work. Engine selection is
+per-session (`tidb_cop_engine` sysvar: 'tpu' | 'host' | 'auto').
 """
 
 from __future__ import annotations
 
+import logging
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass
+from threading import Lock
 
 import numpy as np
+
+log = logging.getLogger("tidb_tpu.copr")
 
 from ..chunk.chunk import Chunk
 from ..catalog.schema import IndexInfo, TableInfo
@@ -29,6 +38,10 @@ class CopTask:
     region_id: int
     start: bytes
     end: bytes
+    epoch: int = 1
+
+
+MAX_REGION_RETRY = 4
 
 
 class CopClient:
@@ -36,14 +49,36 @@ class CopClient:
         self.storage = storage
         self.tiles = TileCache(storage)
         self._tpu = None
-        self.stats = {"tasks": 0, "tpu_tasks": 0, "host_tasks": 0}
+        self._pool = None
+        self._lock = Lock()  # guards lazy singletons + stats counters
+        self.stats = {
+            "tasks": 0,
+            "tpu_tasks": 0,
+            "host_tasks": 0,
+            "region_errors": 0,
+            "fallback_errors": 0,
+        }
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[key] += n
+
+    @property
+    def pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            with self._lock:
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(max_workers=16, thread_name_prefix="cop")
+        return self._pool
 
     @property
     def tpu(self):
         if self._tpu is None:
-            from .tpu_engine import TPUEngine
+            with self._lock:
+                if self._tpu is None:
+                    from .tpu_engine import TPUEngine
 
-            self._tpu = TPUEngine()
+                    self._tpu = TPUEngine()
         return self._tpu
 
     @property
@@ -69,7 +104,7 @@ class CopClient:
         tasks = []
         for start, end in ranges:
             for region, s, e in self.storage.regions.split_ranges(start, end):
-                tasks.append(CopTask(region.id, s, e))
+                tasks.append(CopTask(region.id, s, e, region.epoch))
         return tasks
 
     def send(
@@ -80,49 +115,116 @@ class CopClient:
         read_ts: int,
         engine: str = "auto",
         txn=None,
-    ) -> list[Chunk]:
-        """Execute the DAG over all tasks; returns per-task partial chunks
-        (the selectResult stream analog — caller merges/finalizes).
+        concurrency: int = 1,
+        keep_order: bool = True,
+    ):
+        """Execute the DAG over all tasks; yields per-task partial chunks
+        lazily (the selectResult/copIterator stream analog — caller
+        merges/finalizes). With concurrency > 1 tasks run through the
+        worker pool: host decode of task N+1 overlaps device execution of
+        task N; `keep_order` picks the ordered vs completion-order merge
+        (ref copr/coprocessor.go:461,533).
 
         If `txn` carries uncommitted writes for this table, the task batch
         is built from the txn's merged view instead of the tile cache
         (the UnionScan semantic, ref: executor/union_scan.go) — engines
-        run over it uncached."""
+        run over it uncached and serially (the membuffer is not shared
+        across workers)."""
         if ranges is None:
             prefix = tablecodec.record_prefix(table.id)
             ranges = [(prefix, prefix + b"\xff")]
         tasks = self.build_tasks(table.id, ranges)
         dirty = txn is not None and self._txn_dirty(txn, table.id)
-        out = []
-        for t in tasks:
-            if dirty:
+        if dirty:
+            out = []
+            for t in tasks:
                 kvs = [
                     (k, v)
                     for k, v in txn.scan(t.start, t.end)
                     if tablecodec.is_record_key(k)
                 ]
                 batch = decode_rows_to_batch(table, kvs, (-1, 0))
-            else:
-                batch = self.tiles.get_batch(table, t.start, t.end, read_ts)
-            if batch.n_rows == 0:
-                continue
-            out.append(self._run_engines(dag, batch, engine))
-        return out
+                if batch.n_rows == 0:
+                    continue
+                out.append(self._run_engines(dag, batch, engine))
+            return out
+        if concurrency <= 1 or len(tasks) <= 1:
+            return self._send_serial(table, dag, tasks, read_ts, engine)
+        return self._send_parallel(table, dag, tasks, read_ts, engine, concurrency, keep_order)
+
+    def _send_serial(self, table, dag, tasks, read_ts, engine):
+        for t in tasks:
+            yield from self._run_task(table, dag, t, read_ts, engine)
+
+    def _send_parallel(self, table, dag, tasks, read_ts, engine, concurrency, keep_order):
+        """Bounded in-flight window (the copIterator concurrency semantic):
+        at most `concurrency` tasks run/buffer ahead of the consumer, new
+        tasks are submitted as results drain, and abandoning the stream
+        cancels everything not yet started."""
+        it = iter(tasks)
+        futs: deque = deque()
+
+        def submit_next():
+            t = next(it, None)
+            if t is not None:
+                futs.append(self.pool.submit(self._run_task, table, dag, t, read_ts, engine))
+
+        for _ in range(min(concurrency, len(tasks))):
+            submit_next()
+        try:
+            while futs:
+                if keep_order:
+                    f = futs.popleft()
+                    f.result()  # wait first so a refill overlaps the yield
+                else:
+                    f = next(as_completed(futs))
+                    futs.remove(f)
+                submit_next()
+                yield from f.result()
+        finally:
+            for f in futs:
+                f.cancel()
+
+    def _run_task(self, table, dag, t: CopTask, read_ts, engine, depth: int = 0) -> list[Chunk]:
+        """Execute one cop task, re-splitting on region epoch change
+        (ref: handleCopResponse region-error path, coprocessor.go:1025)."""
+        region = self.storage.regions.locate(t.start)
+        stale = (
+            region.id != t.region_id
+            or region.epoch != t.epoch
+            or (region.end != b"" and (t.end == b"" or t.end > region.end))
+        )
+        if stale:
+            self._bump("region_errors")
+            if depth >= MAX_REGION_RETRY:
+                raise RuntimeError(f"cop task {t} exceeded region retry budget")
+            out = []
+            for sub in self.build_tasks(None, [(t.start, t.end)]):
+                out.extend(self._run_task(table, dag, sub, read_ts, engine, depth + 1))
+            return out
+        batch = self.tiles.get_batch(table, t.start, t.end, read_ts)
+        if batch.n_rows == 0:
+            return []
+        return [self._run_engines(dag, batch, engine)]
 
     # --- engine dispatch over an arbitrary batch --------------------------
 
     def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str) -> Chunk:
-        self.stats["tasks"] += 1
+        self._bump("tasks")
         if engine in ("tpu", "auto"):
             try:
                 chunk = self.tpu.execute(dag, batch)
-                self.stats["tpu_tasks"] += 1
+                self._bump("tpu_tasks")
                 return chunk
             except Exception:
                 if engine == "tpu":
                     raise
+                # a device-path failure must never be silent: it is a
+                # correctness bug masked by the host answer (VERDICT Weak#5)
+                self._bump("fallback_errors")
+                log.exception("TPU engine raised; falling back to host engine")
         chunk = execute_dag_host(dag, batch)
-        self.stats["host_tasks"] += 1
+        self._bump("host_tasks")
         return chunk
 
     # --- index scans (ref: executor/distsql.go IndexReader/IndexLookUp) ---

@@ -13,6 +13,7 @@ builds the task batch from the txn's merged view (client.py send).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from threading import RLock
 
 import numpy as np
 
@@ -170,6 +171,7 @@ class TileCache:
     def __init__(self, storage):
         self.storage = storage
         self._cache: dict[tuple[int, bytes], ColumnBatch] = {}
+        self._lock = RLock()  # cop worker pool shares this cache
         self.hits = 0
         self.misses = 0
 
@@ -180,25 +182,28 @@ class TileCache:
         last commit (historic snapshots) always rebuild, uncached."""
         ver, last_commit_ts = self.storage.data_version(tablecodec.table_prefix(table.id))
         key = (table.id, start)
-        cached = self._cache.get(key)
-        if (
-            cached is not None
-            and cached.version == ver
-            and cached.end == end
-            and read_ts >= cached.min_valid_ts
-        ):
-            self.hits += 1
-            return cached
-        self.misses += 1
+        with self._lock:
+            cached = self._cache.get(key)
+            if (
+                cached is not None
+                and cached.version == ver
+                and cached.end == end
+                and read_ts >= cached.min_valid_ts
+            ):
+                self.hits += 1
+                return cached
+            self.misses += 1
         snap = self.storage.snapshot(read_ts)
         segs, loose = snap.scan_segments(start, end)
         batch = build_batch_from_segments(table, segs, loose, ver)
         batch.start, batch.end = start, end
         batch.min_valid_ts = last_commit_ts
         if read_ts >= last_commit_ts:
-            self._cache[key] = batch
+            with self._lock:
+                self._cache[key] = batch
         return batch
 
     def invalidate_table(self, table_id: int) -> None:
-        for key in [k for k in self._cache if k[0] == table_id]:
-            del self._cache[key]
+        with self._lock:
+            for key in [k for k in self._cache if k[0] == table_id]:
+                del self._cache[key]

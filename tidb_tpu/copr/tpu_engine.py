@@ -28,6 +28,7 @@ The jit cache is the compile-once analog of the coprocessor cache
 from __future__ import annotations
 
 import bisect
+from threading import Lock
 
 import numpy as np
 
@@ -147,6 +148,7 @@ class TPUEngine:
         self._programs: dict = {}  # (digest, T, domains) -> compiled fn
         self._gcap: dict = {}  # sorted-agg digest -> last sufficient capacity
         self.gcap0 = 1 << 16  # initial sorted-agg group capacity
+        self._lock = Lock()  # cop pool workers share this engine
         self.compile_count = 0
         self.fallbacks = 0
 
@@ -160,7 +162,8 @@ class TPUEngine:
 
         plan = self._lower(dag, dev)
         if plan is None:
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             return execute_dag_host(dag, batch)
         return plan()
 
@@ -314,11 +317,12 @@ class TPUEngine:
         return mask
 
     def _program(self, key, builder):
-        fn = self._programs.get(key)
-        if fn is None:
-            fn = jax.jit(builder)
-            self._programs[key] = fn
-            self.compile_count += 1
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is None:
+                fn = jax.jit(builder)
+                self._programs[key] = fn
+                self.compile_count += 1
         return fn
 
     # --- filter-only --------------------------------------------------------
@@ -566,6 +570,10 @@ class TPUEngine:
         program returns one stacked int64 array + one stacked float64 array
         (+ the scalar). The unpack layout is discovered at trace time and
         cached next to the compiled fn."""
+        with self._lock:
+            return self._packed_program_locked(key, kernel, nseg, has_scalar)
+
+    def _packed_program_locked(self, key, kernel, nseg, has_scalar):
         cached = self._programs.get(key)
         if cached is None:
             aux: dict = {}

@@ -204,22 +204,20 @@ class TableReaderExec(Executor):
         self.ranges = ranges
         self.out_fts = dag.output_types()
         self._results = None
-        self._i = 0
+        self._iter = None
 
     def open(self):
+        conc = int(self.ctx.vars.get("tidb_distsql_scan_concurrency", "15"))
         self._results = self.ctx.cop.send(
-            self.table, self.dag, self.ranges, self.ctx.read_ts, self.ctx.engine, txn=self.ctx.txn
+            self.table, self.dag, self.ranges, self.ctx.read_ts, self.ctx.engine,
+            txn=self.ctx.txn, concurrency=conc,
         )
-        self._i = 0
+        self._iter = iter(self._results)
 
     def next(self):
-        if self._results is None:
+        if self._iter is None:
             self.open()
-        if self._i >= len(self._results):
-            return None
-        c = self._results[self._i]
-        self._i += 1
-        return c
+        return next(self._iter, None)
 
 
 class IndexReaderExec(TableReaderExec):
@@ -236,7 +234,7 @@ class IndexReaderExec(TableReaderExec):
             self.table, self.index, self.dag, self.ranges or [], self.ctx.read_ts,
             self.ctx.engine, txn=self.ctx.txn,
         )
-        self._i = 0
+        self._iter = iter(self._results)
 
 
 class IndexLookUpExec(TableReaderExec):
@@ -255,7 +253,7 @@ class IndexLookUpExec(TableReaderExec):
         self._results = self.ctx.cop.send_handles(
             self.table, self.dag, handles, self.ctx.read_ts, self.ctx.engine, txn=self.ctx.txn
         )
-        self._i = 0
+        self._iter = iter(self._results)
 
 
 class PointGetExec(TableReaderExec):
@@ -270,7 +268,7 @@ class PointGetExec(TableReaderExec):
         self._results = self.ctx.cop.send_handles(
             self.table, self.dag, self.handles, self.ctx.read_ts, "host", txn=self.ctx.txn
         )
-        self._i = 0
+        self._iter = iter(self._results)
 
 
 class SelectionExec(Executor):

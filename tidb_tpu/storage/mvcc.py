@@ -398,6 +398,9 @@ class MVCCStore:
         run = Run.build(key_mat, vbuf, starts, lens, commit_ts, presorted=presorted)
         if run.n:
             self.runs.append(run)
+            hook = getattr(self, "split_hook", None)
+            if hook is not None:
+                hook(run)
 
     def ingest(self, kvs: list[tuple[bytes, bytes]], commit_ts: int) -> None:
         """Bulk ingest arbitrary (key, value) pairs: groups by key width

@@ -187,6 +187,11 @@ class Storage:
         self.mvcc = MVCCStore(self.kv)
         self.tso = TSO()
         self.regions = RegionMap()
+        # auto-split: regions split when a bulk ingest lands more than
+        # this many keys (PD's size-based split policy analog; ref:
+        # unistore cluster.go region management + executor/split.go)
+        self.region_split_size = 1 << 19
+        self.mvcc.split_hook = self._auto_split_run
         # table-prefix data-version counters: the tile cache (TiFlash-
         # columnar-replica analog) invalidates on these.
         self._versions: dict[bytes, int] = {}
@@ -227,3 +232,12 @@ class Storage:
     def gc(self, safe_point: int | None = None) -> int:
         sp = safe_point if safe_point is not None else self.tso.current()
         return self.mvcc.gc(sp)
+
+    def _auto_split_run(self, run) -> None:
+        """Split regions at every region_split_size-th key of a freshly
+        ingested (sorted) run so large tables scan region-parallel."""
+        step = self.region_split_size
+        if run.n < 2 * step:
+            return
+        keys = [bytes(run.key_mat[i]) for i in range(step, run.n - step // 2, step)]
+        self.regions.split_many(keys)
