@@ -1,0 +1,202 @@
+"""Window function tests (ref: executor/window.go, pipelined_window.go;
+MySQL 8 semantics: default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute(
+        "CREATE TABLE emp (id INT PRIMARY KEY, dept VARCHAR(10), name VARCHAR(10), sal INT, bonus DECIMAL(8,2))"
+    )
+    sess.execute(
+        "INSERT INTO emp VALUES "
+        "(1, 'eng',  'ann', 100, 10.50),"
+        "(2, 'eng',  'bob', 200, NULL),"
+        "(3, 'eng',  'cat', 200, 20.25),"
+        "(4, 'sales','dan', 150, 5.00),"
+        "(5, 'sales','eve', 300, 7.75),"
+        "(6, 'ops',  'fay', 120, NULL)"
+    )
+    return sess
+
+
+class TestRanking:
+    def test_row_number(self, s):
+        rows = s.must_query(
+            "SELECT id, ROW_NUMBER() OVER (PARTITION BY dept ORDER BY sal) FROM emp ORDER BY id"
+        )
+        assert rows == [("1", "1"), ("2", "2"), ("3", "3"), ("4", "1"), ("5", "2"), ("6", "1")]
+
+    def test_rank_dense_rank_ties(self, s):
+        rows = s.must_query(
+            "SELECT id, RANK() OVER (PARTITION BY dept ORDER BY sal), "
+            "DENSE_RANK() OVER (PARTITION BY dept ORDER BY sal) FROM emp ORDER BY id"
+        )
+        assert rows == [
+            ("1", "1", "1"),
+            ("2", "2", "2"),
+            ("3", "2", "2"),
+            ("4", "1", "1"),
+            ("5", "2", "2"),
+            ("6", "1", "1"),
+        ]
+
+    def test_global_rank_no_partition(self, s):
+        rows = s.must_query("SELECT id, RANK() OVER (ORDER BY sal DESC) FROM emp ORDER BY id")
+        assert rows == [("1", "6"), ("2", "2"), ("3", "2"), ("4", "4"), ("5", "1"), ("6", "5")]
+
+    def test_ntile(self, s):
+        rows = s.must_query("SELECT id, NTILE(2) OVER (ORDER BY id) FROM emp ORDER BY id")
+        assert rows == [("1", "1"), ("2", "1"), ("3", "1"), ("4", "2"), ("5", "2"), ("6", "2")]
+        rows = s.must_query("SELECT id, NTILE(4) OVER (ORDER BY id) FROM emp ORDER BY id")
+        # 6 rows, 4 tiles: sizes 2,2,1,1
+        assert rows == [("1", "1"), ("2", "1"), ("3", "2"), ("4", "2"), ("5", "3"), ("6", "4")]
+
+    def test_cume_dist_percent_rank(self, s):
+        rows = s.must_query(
+            "SELECT id, CUME_DIST() OVER (PARTITION BY dept ORDER BY sal), "
+            "PERCENT_RANK() OVER (PARTITION BY dept ORDER BY sal) FROM emp WHERE dept = 'eng' ORDER BY id"
+        )
+        assert [(r[0], float(r[1]), float(r[2])) for r in rows] == [
+            ("1", 1 / 3, 0.0),
+            ("2", 1.0, 0.5),
+            ("3", 1.0, 0.5),
+        ]
+
+
+class TestAggregateWindows:
+    def test_sum_whole_partition(self, s):
+        rows = s.must_query("SELECT id, SUM(sal) OVER (PARTITION BY dept) FROM emp ORDER BY id")
+        assert rows == [
+            ("1", "500"), ("2", "500"), ("3", "500"),
+            ("4", "450"), ("5", "450"), ("6", "120"),
+        ]
+
+    def test_cumulative_sum_with_peers(self, s):
+        # sal 200 appears twice in eng: RANGE frame → peers share the value
+        rows = s.must_query(
+            "SELECT id, SUM(sal) OVER (PARTITION BY dept ORDER BY sal) FROM emp WHERE dept = 'eng' ORDER BY id"
+        )
+        assert rows == [("1", "100"), ("2", "500"), ("3", "500")]
+
+    def test_count_avg_over_partition(self, s):
+        rows = s.must_query(
+            "SELECT id, COUNT(bonus) OVER (PARTITION BY dept), AVG(sal) OVER (PARTITION BY dept) FROM emp ORDER BY id"
+        )
+        assert rows == [
+            ("1", "2", "166.6667"), ("2", "2", "166.6667"), ("3", "2", "166.6667"),
+            ("4", "2", "225.0000"), ("5", "2", "225.0000"), ("6", "0", "120.0000"),
+        ]
+
+    def test_avg_decimal_cumulative(self, s):
+        rows = s.must_query(
+            "SELECT id, AVG(bonus) OVER (ORDER BY id) FROM emp WHERE bonus IS NOT NULL ORDER BY id"
+        )
+        # 10.50 | (10.50+20.25)/2 | (30.75+5)/3 | (35.75+7.75)/4
+        assert rows == [
+            ("1", "10.500000"), ("3", "15.375000"), ("4", "11.916667"), ("5", "10.875000")
+        ]
+
+    def test_min_max_cumulative(self, s):
+        rows = s.must_query(
+            "SELECT id, MIN(sal) OVER (PARTITION BY dept ORDER BY id), "
+            "MAX(sal) OVER (PARTITION BY dept ORDER BY id) FROM emp ORDER BY id"
+        )
+        assert rows == [
+            ("1", "100", "100"), ("2", "100", "200"), ("3", "100", "200"),
+            ("4", "150", "150"), ("5", "150", "300"), ("6", "120", "120"),
+        ]
+
+    def test_min_max_strings(self, s):
+        rows = s.must_query(
+            "SELECT id, MIN(name) OVER (PARTITION BY dept), MAX(name) OVER (PARTITION BY dept) FROM emp ORDER BY id"
+        )
+        assert rows == [
+            ("1", "ann", "cat"), ("2", "ann", "cat"), ("3", "ann", "cat"),
+            ("4", "dan", "eve"), ("5", "dan", "eve"), ("6", "fay", "fay"),
+        ]
+
+    def test_sum_with_nulls(self, s):
+        rows = s.must_query("SELECT id, SUM(bonus) OVER (PARTITION BY dept) FROM emp ORDER BY id")
+        assert rows == [
+            ("1", "30.75"), ("2", "30.75"), ("3", "30.75"),
+            ("4", "12.75"), ("5", "12.75"), ("6", None),
+        ]
+
+
+class TestValueWindows:
+    def test_lead_lag(self, s):
+        rows = s.must_query(
+            "SELECT id, LAG(sal) OVER (ORDER BY id), LEAD(sal, 2, 0) OVER (ORDER BY id) FROM emp ORDER BY id"
+        )
+        assert rows == [
+            ("1", None, "200"), ("2", "100", "150"), ("3", "200", "300"),
+            ("4", "200", "120"), ("5", "150", "0"), ("6", "300", "0"),
+        ]
+
+    def test_lead_lag_respect_partitions(self, s):
+        rows = s.must_query(
+            "SELECT id, LAG(sal) OVER (PARTITION BY dept ORDER BY id) FROM emp ORDER BY id"
+        )
+        assert rows == [("1", None), ("2", "100"), ("3", "200"), ("4", None), ("5", "150"), ("6", None)]
+
+    def test_first_last_nth_value(self, s):
+        rows = s.must_query(
+            "SELECT id, FIRST_VALUE(name) OVER (PARTITION BY dept ORDER BY sal), "
+            "LAST_VALUE(name) OVER (PARTITION BY dept ORDER BY sal), "
+            "NTH_VALUE(name, 2) OVER (PARTITION BY dept ORDER BY sal) FROM emp WHERE dept = 'eng' ORDER BY id"
+        )
+        # eng sorted by sal: ann(100), bob(200), cat(200) — bob/cat are peers
+        assert rows == [("1", "ann", "ann", None), ("2", "ann", "cat", "bob"), ("3", "ann", "cat", "bob")]
+
+
+class TestWindowPlanning:
+    def test_window_over_group_by(self, s):
+        rows = s.must_query(
+            "SELECT dept, SUM(sal), SUM(SUM(sal)) OVER (ORDER BY SUM(sal)) FROM emp GROUP BY dept ORDER BY dept"
+        )
+        # dept sums: eng 500, ops 120, sales 450 → cumulative by sum: 120, 570, 1070
+        assert rows == [("eng", "500", "1070"), ("ops", "120", "120"), ("sales", "450", "570")]
+
+    def test_multiple_specs(self, s):
+        rows = s.must_query(
+            "SELECT id, ROW_NUMBER() OVER (ORDER BY sal, id), SUM(sal) OVER (PARTITION BY dept) FROM emp ORDER BY id"
+        )
+        assert rows == [
+            ("1", "1", "500"), ("2", "4", "500"), ("3", "5", "500"),
+            ("4", "3", "450"), ("5", "6", "450"), ("6", "2", "120"),
+        ]
+
+    def test_window_in_expression(self, s):
+        rows = s.must_query("SELECT id, 1 + ROW_NUMBER() OVER (ORDER BY id) FROM emp ORDER BY id")
+        assert rows == [(str(i), str(i + 1)) for i in range(1, 7)]
+
+    def test_order_by_window(self, s):
+        rows = s.must_query(
+            "SELECT id, RANK() OVER (ORDER BY sal) AS r FROM emp ORDER BY r, id"
+        )
+        assert [r[0] for r in rows] == ["1", "6", "4", "2", "3", "5"]
+
+    def test_window_not_allowed_in_where(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("SELECT id FROM emp WHERE ROW_NUMBER() OVER (ORDER BY id) = 1")
+
+    def test_default_frame_accepted(self, s):
+        rows = s.must_query(
+            "SELECT id, SUM(sal) OVER (ORDER BY id RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) FROM emp ORDER BY id"
+        )
+        assert [r[1] for r in rows] == ["100", "300", "500", "650", "950", "1070"]
+
+    def test_explicit_rows_frame_rejected(self, s):
+        with pytest.raises(Exception):
+            s.execute("SELECT SUM(sal) OVER (ORDER BY id ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM emp")
+
+    def test_explain_shows_window(self, s):
+        rows = s.must_query("EXPLAIN SELECT ROW_NUMBER() OVER (ORDER BY id) FROM emp")
+        text = "\n".join(r[0] for r in rows)
+        assert "Window" in text

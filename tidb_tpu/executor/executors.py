@@ -30,6 +30,7 @@ from ..planner.plans import (
     Selection,
     SetOp,
     Sort,
+    Window as WindowPlan,
 )
 
 
@@ -99,6 +100,14 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
             plan.kind,
             plan.eq_conds,
             plan.other_conds,
+            [c.ft for c in plan.out_cols],
+        )
+    if isinstance(plan, WindowPlan):
+        return WindowExec(
+            build_executor(plan.children[0], ctx),
+            plan.part_by,
+            plan.order_by,
+            plan.funcs,
             [c.ft for c in plan.out_cols],
         )
     if isinstance(plan, Sort):
@@ -321,16 +330,21 @@ class ProjectionExec(Executor):
         self.child.close()
 
 
+def _broadcast_lane(d, v, n: int):
+    """Expand scalar/0-d eval results to n-row lanes."""
+    if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+        d = np.full(n, d)
+        v = np.full(n, v)
+    return d, v
+
+
 def _coerce_lane(d, v, src_ft: FieldType, dst_ft: FieldType, n: int):
     """Align a lane to the projection's output type (scale fixes etc.)."""
     if dst_ft.is_decimal() and src_ft.is_decimal():
         ss, ds_ = max(src_ft.decimal, 0), max(dst_ft.decimal, 0)
         if ss != ds_:
             d = d * pow10(ds_ - ss) if ds_ > ss else d // pow10(ss - ds_)
-    if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-        d = np.full(n, d)
-        v = np.full(n, v)
-    return d, v
+    return _broadcast_lane(d, v, n)
 
 
 class LimitExec(Executor):
@@ -363,6 +377,228 @@ class LimitExec(Executor):
 
     def close(self):
         self.child.close()
+
+
+class WindowExec(Executor):
+    """Window functions for one (PARTITION BY, ORDER BY) spec (ref:
+    executor/window.go:31, pipelined_window.go:37, aggfuncs window funcs).
+
+    One lexicographic sort by (partition, order) keys makes partitions and
+    peer groups contiguous; every function is then computed vectorized on
+    the sorted lanes (cumulative frames read at peer-group ends — MySQL's
+    default RANGE UNBOUNDED PRECEDING..CURRENT ROW frame) and scattered
+    back to input row order. Only min/max accumulation and decimal AVG
+    walk partitions/peers in Python; everything else is numpy."""
+
+    def __init__(self, child: Executor, part_by, order_by, funcs, out_fts):
+        self.child = child
+        self.part_by = part_by
+        self.order_by = order_by
+        self.funcs = funcs
+        self.out_fts = out_fts
+        self._done = False
+
+    def open(self):
+        self._done = False
+
+    def close(self):
+        self.child.close()
+
+    @staticmethod
+    def _lane(e, c, n):
+        return _broadcast_lane(*e.eval(c), n)
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        c = drain(self.child)
+        n = c.num_rows
+        if n == 0:
+            return Chunk.empty(self.out_fts, 0)
+        from ..copr.host_engine import _lex_argsort
+
+        part_lanes = [self._lane(e, c, n) for e in self.part_by]
+        order_lanes = [(self._lane(e, c, n), desc) for e, desc in self.order_by]
+        keys = [(d, v, False) for d, v in part_lanes]
+        keys += [(d, v, desc) for (d, v), desc in order_lanes]
+        order = _lex_argsort(keys, n) if keys else np.arange(n)
+
+        def changed(lanes) -> np.ndarray:
+            ch = np.zeros(n, dtype=bool)
+            for d, v in lanes:
+                sd, sv = d[order], v[order]
+                if n > 1:
+                    null_flip = sv[1:] != sv[:-1]
+                    both = sv[1:] & sv[:-1]
+                    ch[1:] |= null_flip | (both & (sd[1:] != sd[:-1]))
+            return ch
+
+        pstart = np.zeros(n, dtype=bool)
+        pstart[0] = True
+        pstart |= changed(part_lanes)
+        pid = np.cumsum(pstart) - 1
+        pidx = np.nonzero(pstart)[0]
+        pend = np.append(pidx[1:], n) - 1
+        pfirst_row = pidx[pid]
+        plast_row = pend[pid]
+        psize = (pend - pidx + 1)[pid]
+        rn = np.arange(n) - pfirst_row
+
+        ostart = pstart | (changed([l for l, _ in order_lanes]) if order_lanes else False)
+        peer_id = np.cumsum(ostart) - 1
+        oidx = np.nonzero(ostart)[0]
+        oend_arr = np.append(oidx[1:], n) - 1
+        peer_last = oend_arr[peer_id]
+        frame_end = peer_last if self.order_by else plast_row
+
+        env = dict(
+            n=n, order=order, pid=pid, pidx=pidx, pend=pend,
+            pfirst=pfirst_row, plast=plast_row, psize=psize, rn=rn,
+            peer_id=peer_id, oidx=oidx, oend=oend_arr, peer_last=peer_last,
+            frame_end=frame_end,
+        )
+        cols = list(c.columns)
+        nbase = len(cols)
+        for i, f in enumerate(self.funcs):
+            ft = self.out_fts[nbase + i]
+            sd, sv = self._compute(f, c, env)
+            data = np.empty_like(sd)
+            valid = np.empty(n, dtype=bool)
+            data[order] = sd
+            valid[order] = sv
+            cols.append(Column(ft, data, valid))
+        return Chunk(cols)
+
+    # -- per-function kernels over the sorted domain ------------------------
+
+    def _compute(self, f, c, env):
+        n, order = env["n"], env["order"]
+        name = f.name
+        ones = np.ones(n, dtype=bool)
+        if name == "row_number":
+            return env["rn"] + 1, ones
+        if name == "rank":
+            return env["oidx"][env["peer_id"]] - env["pfirst"] + 1, ones
+        if name == "dense_rank":
+            return env["peer_id"] - env["peer_id"][env["pfirst"]] + 1, ones
+        if name == "ntile":
+            k = f.args[0].value.to_int()
+            s, rn = env["psize"], env["rn"]
+            big, rem = s // k, s % k
+            cut = rem * (big + 1)
+            tile = np.where(
+                big > 0,
+                np.where(rn < cut, rn // np.maximum(big + 1, 1), rem + (rn - cut) // np.maximum(big, 1)),
+                rn,
+            )
+            return tile + 1, ones
+        if name == "cume_dist":
+            return (env["peer_last"] - env["pfirst"] + 1) / env["psize"], ones
+        if name == "percent_rank":
+            rank = env["oidx"][env["peer_id"]] - env["pfirst"] + 1
+            return np.where(env["psize"] > 1, (rank - 1) / np.maximum(env["psize"] - 1, 1), 0.0), ones
+        if name in ("lead", "lag"):
+            d, v = self._lane(f.args[0], c, n)
+            sd, sv = d[order], v[order]
+            off = f.args[1].value.to_int() if len(f.args) > 1 else 1
+            tgt = np.arange(n) + (off if name == "lead" else -off)
+            ok = (tgt >= 0) & (tgt < n)
+            tgt_c = np.clip(tgt, 0, n - 1)
+            ok &= env["pid"][tgt_c] == env["pid"]
+            if len(f.args) > 2:
+                dd, dv = self._lane(f.args[2], c, n)
+                dd, dv = dd[order], dv[order]
+            else:
+                dd, dv = np.zeros_like(sd), np.zeros(n, dtype=bool)
+            data = np.where(ok, sd[tgt_c], dd)
+            valid = np.where(ok, sv[tgt_c], dv)
+            return data, valid
+        if name in ("first_value", "last_value", "nth_value"):
+            d, v = self._lane(f.args[0], c, n)
+            sd, sv = d[order], v[order]
+            if name == "first_value":
+                pos = env["pfirst"]
+                ok = ones
+            elif name == "last_value":
+                pos = env["frame_end"]
+                ok = ones
+            else:
+                k = f.args[1].value.to_int()
+                pos = env["pfirst"] + k - 1
+                ok = pos <= env["frame_end"]
+                pos = np.minimum(pos, n - 1)
+            return sd[pos], sv[pos] & ok
+        if name in ("count", "sum", "avg", "min", "max"):
+            return self._compute_agg(f, c, env)
+        raise TiDBError(f"unsupported window function {name}")
+
+    def _compute_agg(self, f, c, env):
+        n, order = env["n"], env["order"]
+        name = f.name
+        fe, pfirst = env["frame_end"], env["pfirst"]
+        if f.args:
+            d, v = self._lane(f.args[0], c, n)
+            sd, sv = d[order], v[order]
+        else:
+            sd, sv = np.ones(n, dtype=np.int64), np.ones(n, dtype=bool)
+        if sd.dtype == object and name in ("sum", "avg"):
+            raise TiDBError(f"window {name} over string operands is not supported")
+        cnt_cs = np.cumsum(sv.astype(np.int64))
+        before = np.where(pfirst > 0, cnt_cs[np.maximum(pfirst - 1, 0)], 0)
+        frame_cnt = cnt_cs[fe] - before
+        if name == "count":
+            return frame_cnt, np.ones(n, dtype=bool)
+        if name in ("sum", "avg"):
+            is_f = sd.dtype == np.float64
+            vals = np.where(sv, sd, 0.0 if is_f else 0)
+            val_cs = np.cumsum(vals)
+            vbefore = np.where(pfirst > 0, val_cs[np.maximum(pfirst - 1, 0)], 0)
+            frame_sum = val_cs[fe] - vbefore
+            if name == "sum":
+                return frame_sum, frame_cnt > 0
+            if is_f or f.ret_type.is_float():
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    return np.where(frame_cnt > 0, frame_sum / np.maximum(frame_cnt, 1), 0.0), frame_cnt > 0
+            # decimal AVG: exact Dec division at peer granularity
+            arg_scale = max(f.args[0].ret_type.decimal, 0) if f.args[0].ret_type.is_decimal() else 0
+            out_scale = max(f.ret_type.decimal, 0)
+            oidx = env["oidx"]
+            qs = np.zeros(len(oidx), dtype=np.int64)
+            qv = np.zeros(len(oidx), dtype=bool)
+            for g, p in enumerate(oidx):
+                s_, c_ = int(frame_sum[p]), int(frame_cnt[p])
+                if c_ > 0:
+                    q = Dec(s_, arg_scale).div(Dec(c_, 0))
+                    if q is not None:
+                        qs[g] = q.rescale(out_scale).value
+                        qv[g] = True
+            return qs[env["peer_id"]], qv[env["peer_id"]]
+        # min / max: accumulate within partitions (python over partitions)
+        pidx, pend_arr = env["pidx"], env["pend"]
+        is_obj = sd.dtype == object
+        acc = np.empty(n, dtype=object) if is_obj else np.empty_like(sd)
+        accv = np.zeros(n, dtype=bool)
+        better = (lambda a, b: a < b) if name == "min" else (lambda a, b: a > b)
+        if is_obj:
+            for p0, p1 in zip(pidx, pend_arr):
+                cur, curv = None, False
+                for i in range(p0, p1 + 1):
+                    if sv[i] and (not curv or better(sd[i], cur)):
+                        cur, curv = sd[i], True
+                    acc[i], accv[i] = cur, curv
+        else:
+            ufunc = np.minimum if name == "min" else np.maximum
+            fill = (np.inf if name == "min" else -np.inf) if sd.dtype == np.float64 else (
+                np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
+            )
+            masked = np.where(sv, sd, fill)
+            vcnt = np.cumsum(sv.astype(np.int64))
+            for p0, p1 in zip(pidx, pend_arr):
+                acc[p0 : p1 + 1] = ufunc.accumulate(masked[p0 : p1 + 1])
+                base = vcnt[p0 - 1] if p0 > 0 else 0
+                accv[p0 : p1 + 1] = (vcnt[p0 : p1 + 1] - base) > 0
+        return acc[env["frame_end"]], accv[env["frame_end"]]
 
 
 class SortExec(Executor):

@@ -85,3 +85,38 @@ class AggDesc:
     def __repr__(self):
         d = "distinct " if self.distinct else ""
         return f"{self.name}({d}{', '.join(map(repr, self.args))})"
+
+
+# window-only functions (ref: executor/aggfuncs window builders; the agg
+# functions above are also valid window functions via OVER)
+WINDOW_FUNCS = (
+    "row_number",
+    "rank",
+    "dense_rank",
+    "ntile",
+    "lead",
+    "lag",
+    "first_value",
+    "last_value",
+    "nth_value",
+    "cume_dist",
+    "percent_rank",
+)
+
+
+@dataclass
+class WinDesc:
+    """One window function over a (PARTITION BY, ORDER BY) spec
+    (ref: planner/core WindowFuncDesc + ast WindowSpec)."""
+
+    name: str
+    args: list[Expression]
+    part_by: list[Expression]
+    order_by: list  # [(Expression, desc: bool)]
+    ret_type: FieldType = field(default_factory=ft_longlong)
+
+    def spec_key(self) -> str:
+        return f"part={self.part_by!r}|order={[(repr(e), d) for e, d in self.order_by]!r}"
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))}) over({self.spec_key()})"

@@ -42,12 +42,22 @@ class Star:
 
 
 @dataclass
+class WindowSpec:
+    """OVER (...) clause (ref: parser ast WindowSpec; frames beyond the
+    default RANGE UNBOUNDED PRECEDING..CURRENT ROW are rejected upstream)."""
+
+    partition_by: list
+    order_by: list  # ByItem
+
+
+@dataclass
 class Call:
     """Function call, incl. operators desugared to calls (plus, eq, ...)."""
 
     name: str
     args: list
     distinct: bool = False  # COUNT(DISTINCT x)
+    over: Any = None  # WindowSpec for window function calls
 
 
 @dataclass

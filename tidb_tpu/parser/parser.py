@@ -631,14 +631,45 @@ class Parser:
         if self.at_op("*") and fname == "count":
             self.next()
             self.expect_op(")")
-            return ast.Call("count", [ast.Star()], distinct=False)
+            return self._maybe_over(ast.Call("count", [ast.Star()], distinct=False))
         if not self.at_op(")"):
             args.append(self.expr())
             while self.try_op(","):
                 args.append(self.expr())
         self.expect_op(")")
-        # window functions / OVER clause parsed later when windows land
-        return ast.Call(fname, args, distinct=distinct)
+        return self._maybe_over(ast.Call(fname, args, distinct=distinct))
+
+    def _maybe_over(self, call: ast.Call) -> ast.Call:
+        """OVER ([PARTITION BY ...] [ORDER BY ...] [frame]) — only the
+        default-equivalent frame is accepted (ref: ast WindowSpec)."""
+        if not self.at_kw("OVER"):
+            return call
+        self.next()
+        self.expect_op("(")
+        part, order = [], []
+        if self.try_kw("PARTITION"):
+            self.expect_kw("BY")
+            part.append(self.expr())
+            while self.try_op(","):
+                part.append(self.expr())
+        if self.try_kw("ORDER"):
+            self.expect_kw("BY")
+            order = self.by_items()
+        if self.at_kw("ROWS", "RANGE"):
+            unit = self.next().upper
+            # accept only the default frame: <unit> BETWEEN UNBOUNDED
+            # PRECEDING AND CURRENT ROW (and RANGE must have ORDER BY)
+            ok = True
+            if self.try_kw("BETWEEN"):
+                ok = self.try_kw("UNBOUNDED") and self.try_kw("PRECEDING") \
+                    and self.try_kw("AND") and self.try_kw("CURRENT") and self.try_kw("ROW")
+            else:
+                ok = self.try_kw("UNBOUNDED") and self.try_kw("PRECEDING")
+            if not ok or unit == "ROWS":
+                self.fail("only the default window frame (RANGE UNBOUNDED PRECEDING .. CURRENT ROW) is supported")
+        self.expect_op(")")
+        call.over = ast.WindowSpec(part, order)
+        return call
 
     def case_expr(self):
         self.expect_kw("CASE")

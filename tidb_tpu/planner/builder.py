@@ -11,14 +11,14 @@ Non-aggregated bare columns under GROUP BY become first_row aggregates
 from __future__ import annotations
 
 from ..errors import AmbiguousColumn, TiDBError, UnknownColumn
-from ..expr.aggregation import AGG_FUNCS, AggDesc
+from ..expr.aggregation import AGG_FUNCS, WINDOW_FUNCS, AggDesc, WinDesc, agg_ret_type
 from ..expr.builtins import CAST_SIG
 from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc, make_func
 from ..mysqltypes.datum import Datum
 from ..mysqltypes.field_type import FieldType, TypeCode, ft_double, ft_longlong, ft_varchar, parse_type_name
 from ..mysqltypes.mydecimal import Dec
 from ..parser import ast
-from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, PlanCol, Projection, Selection, SetOp, Sort
+from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, PlanCol, Projection, Selection, SetOp, Sort, Window
 
 
 def lit_to_constant(l: ast.Lit) -> Constant:
@@ -182,7 +182,7 @@ class PlanBuilder:
 
     # ------------------------------------------------------------ expressions
 
-    def to_expr(self, node, scope: NameScope, agg_ctx=None) -> Expression:
+    def to_expr(self, node, scope: NameScope, agg_ctx=None, allow_window=False) -> Expression:
         if isinstance(node, ast.Lit):
             return lit_to_constant(node)
         if isinstance(node, ast.Name):
@@ -191,28 +191,34 @@ class PlanBuilder:
             return ECol(idx, c.ft, c.name)
         if isinstance(node, ast.Call):
             lname = node.name.lower()
+            if getattr(node, "over", None) is not None or lname in WINDOW_FUNCS:
+                if node.over is None:
+                    raise TiDBError(f"window function {lname} requires an OVER clause")
+                if agg_ctx is None or not allow_window:
+                    raise TiDBError(f"window function {lname} is not allowed here")
+                return self._window_expr(node, scope, agg_ctx)
             if lname in AGG_FUNCS or lname in ("group_concat",):
                 if agg_ctx is None:
                     raise TiDBError(f"aggregate {lname} not allowed here")
                 return agg_ctx.add_agg(node, scope)
             if lname == "in_subquery":
                 return self._in_subquery(node, scope, agg_ctx)
-            args = [self.to_expr(a, scope, agg_ctx) for a in node.args]
+            args = [self.to_expr(a, scope, agg_ctx, allow_window) for a in node.args]
             args = _refine_cmp_constants(lname, args)
             return make_func(lname, *args)
         if isinstance(node, ast.CaseWhen):
             args = []
             for cond, res in node.whens:
-                c = self.to_expr(cond, scope, agg_ctx)
+                c = self.to_expr(cond, scope, agg_ctx, allow_window)
                 if node.operand is not None:
-                    c = make_func("eq", self.to_expr(node.operand, scope, agg_ctx), c)
+                    c = make_func("eq", self.to_expr(node.operand, scope, agg_ctx, allow_window), c)
                 args.append(c)
-                args.append(self.to_expr(res, scope, agg_ctx))
+                args.append(self.to_expr(res, scope, agg_ctx, allow_window))
             if node.else_ is not None:
-                args.append(self.to_expr(node.else_, scope, agg_ctx))
+                args.append(self.to_expr(node.else_, scope, agg_ctx, allow_window))
             return make_func("case", *args)
         if isinstance(node, ast.Cast):
-            e = self.to_expr(node.expr, scope, agg_ctx)
+            e = self.to_expr(node.expr, scope, agg_ctx, allow_window)
             ft = parse_type_name(node.type_name, node.type_args, node.unsigned)
             return ScalarFunc(CAST_SIG, [e], ft)
         if isinstance(node, ast.SubqueryExpr):
@@ -220,6 +226,125 @@ class PlanBuilder:
         if isinstance(node, ast.Star):
             raise TiDBError("* not allowed in this context")
         raise TiDBError(f"unsupported expression {type(node).__name__}")
+
+    def _window_expr(self, node: ast.Call, scope, agg_ctx) -> "_WindowFuncExpr":
+        """ast window call → placeholder expression lifted later by
+        _build_windows (ref: logical_plan_builder.go buildWindowFunctions)."""
+        lname = node.name.lower()
+        if node.distinct:
+            raise TiDBError(f"DISTINCT is not supported in window function {lname}")
+        args = []
+        for a in node.args:
+            if isinstance(a, ast.Star):
+                continue  # COUNT(*) OVER (...)
+            args.append(self.to_expr(a, scope, agg_ctx))
+        part = [self.to_expr(p, scope, agg_ctx) for p in node.over.partition_by]
+        order = [(self.to_expr(b.expr, scope, agg_ctx), b.desc) for b in node.over.order_by]
+
+        def need(lo, hi):
+            if not (lo <= len(args) <= hi):
+                raise TiDBError(f"wrong argument count for window function {lname}")
+
+        if lname in ("row_number", "rank", "dense_rank", "cume_dist", "percent_rank"):
+            need(0, 0)
+            ft = ft_double() if lname in ("cume_dist", "percent_rank") else ft_longlong()
+        elif lname == "ntile":
+            need(1, 1)
+            if not (isinstance(args[0], Constant) and self._const_pos_int(args[0])):
+                raise TiDBError("NTILE requires a positive integer constant")
+            ft = ft_longlong()
+        elif lname in ("lead", "lag"):
+            need(1, 3)
+            if len(args) >= 2:
+                ok = isinstance(args[1], Constant) and not args[1].value.is_null
+                try:
+                    ok = ok and args[1].value.to_int() >= 0
+                except Exception:
+                    ok = False
+                if not ok:
+                    raise TiDBError(f"{lname} offset must be a non-negative integer constant")
+            ft = args[0].ret_type.clone()
+        elif lname == "nth_value":
+            need(2, 2)
+            if not (isinstance(args[1], Constant) and self._const_pos_int(args[1])):
+                raise TiDBError("NTH_VALUE position must be a positive integer constant")
+            ft = args[0].ret_type.clone()
+        elif lname in ("first_value", "last_value"):
+            need(1, 1)
+            ft = args[0].ret_type.clone()
+        elif lname == "count":
+            need(0, 1)
+            ft = ft_longlong()
+        elif lname in ("sum", "avg"):
+            need(1, 1)
+            ft = agg_ret_type(lname, args[0].ret_type)
+        elif lname in ("min", "max"):
+            need(1, 1)
+            ft = args[0].ret_type.clone()
+        else:
+            raise TiDBError(f"{lname} cannot be used as a window function")
+        return _WindowFuncExpr(WinDesc(lname, args, part, order, ft))
+
+    @staticmethod
+    def _const_pos_int(c: Constant) -> bool:
+        try:
+            return not c.value.is_null and c.value.to_int() > 0
+        except Exception:
+            return False
+
+    def _build_windows(self, plan, proj_exprs, order_items):
+        """Lift _WindowFuncExpr placeholders into stacked Window nodes (one
+        per distinct PARTITION/ORDER spec) and rewrite the outer exprs to
+        reference the window output columns."""
+        descs: list[WinDesc] = []
+        seen: dict[str, WinDesc] = {}
+
+        def collect(e):
+            if isinstance(e, _WindowFuncExpr):
+                k = repr(e.desc)
+                if k not in seen:
+                    seen[k] = e.desc
+                    descs.append(e.desc)
+                return
+            if isinstance(e, ScalarFunc):
+                for a in e.args:
+                    collect(a)
+
+        for e in proj_exprs:
+            collect(e)
+        for k, x, d, n in order_items:
+            if k == "expr":
+                collect(x)
+        if not descs:
+            return proj_exprs, order_items, plan
+
+        # group by spec (first-seen order), stack one Window node per spec
+        idx_of: dict[str, int] = {}
+        by_spec: dict[str, list[WinDesc]] = {}
+        for d in descs:
+            by_spec.setdefault(d.spec_key(), []).append(d)
+        for spec, ds in by_spec.items():
+            base = len(plan.out_cols)
+            cols = list(plan.out_cols) + [
+                PlanCol(f"w{base + j}", d.ret_type) for j, d in enumerate(ds)
+            ]
+            plan = Window(plan, ds[0].part_by, ds[0].order_by, ds, cols)
+            for j, d in enumerate(ds):
+                idx_of[repr(d)] = base + j
+
+        def replace(e):
+            if isinstance(e, _WindowFuncExpr):
+                i = idx_of[repr(e.desc)]
+                return ECol(i, e.ret_type, f"w{i}")
+            if isinstance(e, ScalarFunc):
+                return ScalarFunc(e.sig, [replace(a) for a in e.args], e.ret_type)
+            return e
+
+        proj_exprs = [replace(e) for e in proj_exprs]
+        order_items = [
+            (k, replace(x) if k == "expr" else x, d, n) for k, x, d, n in order_items
+        ]
+        return proj_exprs, order_items, plan
 
     def _scalar_subquery(self, node: ast.SubqueryExpr) -> Expression:
         """Uncorrelated subqueries evaluate eagerly at plan time
@@ -285,7 +410,7 @@ class PlanBuilder:
         proj_exprs = []
         proj_cols = []
         for f in fields:
-            e = self.to_expr(f.expr, scope, agg_ctx)
+            e = self.to_expr(f.expr, scope, agg_ctx, allow_window=True)
             name = f.alias or self._field_name(f.expr)
             proj_exprs.append(e)
             proj_cols.append(PlanCol(name, e.ret_type))
@@ -303,7 +428,7 @@ class PlanBuilder:
             if isinstance(b.expr, ast.Lit) and b.expr.kind == "int":
                 order_items.append(("pos", b.expr.value - 1, b.desc, None))
             else:
-                e = self.to_expr_with_aliases(b.expr, alias_scope, agg_ctx)
+                e = self.to_expr_with_aliases(b.expr, alias_scope, agg_ctx, allow_window=True)
                 order_items.append(("expr", e, b.desc, b.expr))
 
         need_agg = bool(group_exprs) or agg_ctx.aggs
@@ -320,6 +445,10 @@ class PlanBuilder:
 
         if having_expr is not None:
             plan = Selection(plan, self.split_cnf(having_expr))
+
+        # window functions sit above aggregation/HAVING, below the final
+        # projection/DISTINCT/ORDER BY (ref: logical_plan_builder.go build order)
+        proj_exprs, order_items, plan = self._build_windows(plan, proj_exprs, order_items)
 
         # sort columns: select-list matches by structure; others become
         # hidden projection columns trimmed after the sort
@@ -400,18 +529,20 @@ class PlanBuilder:
             cols.append(PlanCol(f"a{i}", a.ret_type))
         return Aggregation(plan, group_exprs, agg_ctx.aggs, cols)
 
-    def to_expr_with_aliases(self, node, scope_w, agg_ctx):
+    def to_expr_with_aliases(self, node, scope_w, agg_ctx, allow_window=False):
         if isinstance(node, ast.Name) and len(node.parts) == 1:
             hit = scope_w.find_alias(node.column)
             if hit is not None:
                 return hit
         if isinstance(node, ast.Call):
             lname = node.name.lower()
+            if getattr(node, "over", None) is not None or lname in WINDOW_FUNCS:
+                return self.to_expr(node, scope_w.base, agg_ctx, allow_window=allow_window)
             if lname in AGG_FUNCS:
                 return agg_ctx.add_agg(node, scope_w.base)
-            args = [self.to_expr_with_aliases(a, scope_w, agg_ctx) for a in node.args]
+            args = [self.to_expr_with_aliases(a, scope_w, agg_ctx, allow_window) for a in node.args]
             return make_func(lname, *args)
-        return self.to_expr(node, scope_w.base, agg_ctx)
+        return self.to_expr(node, scope_w.base, agg_ctx, allow_window=allow_window)
 
     @staticmethod
     def _field_name(e) -> str:
@@ -518,11 +649,40 @@ class AggContext:
                 desc = AggDesc.make("first_row", [x])
                 self.aggs.append(desc)
                 return ECol(ngroups + len(self.aggs) - 1, desc.ret_type, "fr")
+            if isinstance(x, _WindowFuncExpr):
+                d = x.desc
+                return _WindowFuncExpr(
+                    WinDesc(
+                        d.name,
+                        [rec(a) for a in d.args],
+                        [rec(p) for p in d.part_by],
+                        [(rec(o), dsc) for o, dsc in d.order_by],
+                        d.ret_type,
+                    )
+                )
             if isinstance(x, ScalarFunc):
                 return ScalarFunc(x.sig, [rec(a) for a in x.args], x.ret_type)
             return x
 
         return rec(e)
+
+
+class _WindowFuncExpr(Expression):
+    """Placeholder for a window function call, lifted into a Window plan
+    node by PlanBuilder._build_windows."""
+
+    def __init__(self, desc: WinDesc):
+        self.desc = desc
+        self.ret_type = desc.ret_type
+
+    def collect_columns(self, out):
+        for e in self.desc.args + self.desc.part_by:
+            e.collect_columns(out)
+        for e, _ in self.desc.order_by:
+            e.collect_columns(out)
+
+    def __repr__(self):
+        return f"win[{self.desc!r}]"
 
 
 class _AggRef(Expression):

@@ -9,7 +9,7 @@ decides cop vs root in the task model).
 from __future__ import annotations
 
 from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc
-from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, Projection, Selection, SetOp, Sort
+from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, Projection, Selection, SetOp, Sort, Window
 
 
 def optimize(plan: LogicalPlan, stats=None) -> LogicalPlan:
@@ -183,6 +183,15 @@ def _analyze_usage(node: LogicalPlan, uses: dict):
         for c in node.other_conds:
             mark(c, cm)
         return cm
+    if isinstance(node, Window):
+        for e in node.part_by:
+            mark(e, maps[0])
+        for e, _ in node.order_by:
+            mark(e, maps[0])
+        for f in node.funcs:
+            for a in f.args:
+                mark(a, maps[0])
+        return maps[0] + [None] * len(node.funcs)
     if isinstance(node, Sort):
         for e, _ in node.by:
             mark(e, maps[0])

@@ -103,6 +103,25 @@ class Join(LogicalPlan):
         return f"Join({self.kind}, eq={self.eq_conds!r}, other={self.other_conds!r})"
 
 
+class Window(LogicalPlan):
+    """Window functions over one PARTITION BY / ORDER BY spec (ref:
+    planner/core PhysicalWindow; executor/window.go:31). Output = child
+    columns followed by one column per window function; several specs in
+    one query stack several Window nodes."""
+
+    def __init__(self, child, part_by: list[Expression], order_by, funcs, cols):
+        super().__init__([child], cols)
+        self.part_by = part_by
+        self.order_by = order_by  # [(Expression, desc)]
+        self.funcs = funcs  # list[WinDesc]
+
+    def describe(self):
+        return (
+            f"Window(partition={self.part_by!r}, order={[(repr(e), d) for e, d in self.order_by]!r}, "
+            f"funcs={[f.name for f in self.funcs]!r})"
+        )
+
+
 class Sort(LogicalPlan):
     def __init__(self, child, by: list[tuple[Expression, bool]]):
         super().__init__([child], child.out_cols)
