@@ -101,6 +101,7 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
             plan.eq_conds,
             plan.other_conds,
             [c.ft for c in plan.out_cols],
+            na_key=plan.na_key,
         )
     if isinstance(plan, WindowPlan):
         return WindowExec(
@@ -790,16 +791,33 @@ class FinalHashAggExec(Executor):
         raise NotImplementedError(name)
 
 
-class HashJoinExec(Executor):
-    """Hash join building on the right child (ref: executor/join.go:50)."""
+def _split_sides(c: Expression):
+    """Concatenated-schema condition → per-(left i, right j) predicate."""
 
-    def __init__(self, left: Executor, right: Executor, kind: str, eq_conds, other_conds, out_fts):
+    def check(lchunk, rchunk, i, j) -> bool:
+        row = Chunk(
+            [col.take(np.array([i])) for col in lchunk.columns]
+            + [col.take(np.array([j])) for col in rchunk.columns]
+        )
+        d, v = _broadcast_lane(*c.eval(row), 1)
+        return bool(v[0]) and bool(d[0] != 0)
+
+    return check
+
+
+class HashJoinExec(Executor):
+    """Hash join building on the right child (ref: executor/join.go:50;
+    semi/anti variants ref joiner.go semiJoiner/antiSemiJoiner, null-aware
+    NOT IN per the reference's NAAJ semantics)."""
+
+    def __init__(self, left: Executor, right: Executor, kind: str, eq_conds, other_conds, out_fts, na_key=None):
         self.left = left
         self.right = right
         self.kind = kind
         self.eq_conds = eq_conds
         self.other_conds = other_conds
         self.out_fts = out_fts
+        self.na_key = na_key
         self._done = False
 
     def open(self):
@@ -812,6 +830,8 @@ class HashJoinExec(Executor):
         self._done = True
         lchunk = drain(self.left)
         rchunk = drain(self.right)
+        if self.kind in ("semi", "anti"):
+            return self._semi_anti(lchunk, rchunk)
         nl = lchunk.num_cols
 
         lkeys = [l for l, _ in self.eq_conds]
@@ -861,6 +881,78 @@ class HashJoinExec(Executor):
                 pad = _assemble_join(lchunk, rchunk, [-1] * len(extra_r), extra_r, self.out_fts)
                 out = out.concat(pad)
         return out
+
+    def _semi_anti(self, lchunk: Chunk, rchunk: Chunk) -> Chunk:
+        """Semi: emit left rows with >=1 match. Anti: emit left rows with
+        none. na_key (NOT IN) adds null-awareness: a NULL probe value or a
+        NULL build value among candidates yields SQL NULL → row dropped."""
+        from ..planner.optimizer import _shift_expr
+
+        nl = lchunk.num_cols
+        lkeys = [l for l, _ in self.eq_conds]
+        rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
+        table: dict = {}
+        if rchunk.num_rows and rkeys:
+            key_lanes = [k.eval(rchunk) for k in rkeys]
+            for j in range(rchunk.num_rows):
+                kt = _key_tuple(key_lanes, j)
+                if kt is not None:
+                    table.setdefault(kt, []).append(j)
+        n = lchunk.num_rows
+        if n == 0:
+            return lchunk
+        lkey_lanes = [k.eval(lchunk) for k in lkeys]
+        na_l = na_r = None
+        if self.na_key is not None:
+            na_l = _broadcast_lane(*self.na_key[0].eval(lchunk), n)
+            na_r = _broadcast_lane(*_shift_expr(self.na_key[1], -nl).eval(rchunk), rchunk.num_rows)
+        other = [_split_sides(c) for c in self.other_conds]
+        keep = np.zeros(n, dtype=bool)
+        if self.na_key is not None and not lkeys and not other:
+            # uncorrelated NOT IN fast path: one value-set + has-null scan
+            if rchunk.num_rows == 0:
+                keep[:] = True
+            else:
+                has_null = not bool(na_r[1].all())
+                if not has_null:
+                    vals = set(na_r[0][na_r[1]].tolist())
+                    for i in range(n):
+                        keep[i] = bool(na_l[1][i]) and na_l[0][i] not in vals
+            return lchunk.filter(keep)
+        for i in range(n):
+            if lkeys:
+                kt = _key_tuple(lkey_lanes, i)
+                cands = table.get(kt, []) if kt is not None else []
+            else:
+                cands = range(rchunk.num_rows)
+            if other:
+                cands = [j for j in cands if self._other_pass(other, lchunk, rchunk, i, j)]
+            if self.na_key is None:
+                hit = bool(cands) if not isinstance(cands, range) else rchunk.num_rows > 0
+                keep[i] = hit if self.kind == "semi" else not hit
+                continue
+            # null-aware NOT IN over the candidate set
+            cands = list(cands)
+            if not cands:
+                keep[i] = True  # x NOT IN (empty) is TRUE even for NULL x
+                continue
+            if not na_l[1][i]:
+                continue  # NULL probe vs non-empty set → NULL → dropped
+            x = na_l[0][i]
+            verdict = True
+            for j in cands:
+                if not na_r[1][j] or na_r[0][j] == x:
+                    verdict = False  # NULL build value or a match → not TRUE
+                    break
+            keep[i] = verdict
+        return lchunk.filter(keep)
+
+    @staticmethod
+    def _other_pass(other, lchunk, rchunk, i, j) -> bool:
+        for fn in other:
+            if not fn(lchunk, rchunk, i, j):
+                return False
+        return True
 
     def _apply_other(self, out: Chunk, lchunk, rchunk, li, ri):
         mask = np.ones(out.num_rows, dtype=bool)

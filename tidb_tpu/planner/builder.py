@@ -94,10 +94,32 @@ class NameScope:
 class PlanBuilder:
     """Builds logical plans; needs a catalog view + subquery executor hook."""
 
+    def _resolve_name(self, node: ast.Name, scope: NameScope) -> Expression:
+        """Resolve a column name; names unknown in the local scope fall
+        back to the enclosing query's scope as correlated references
+        (ref: expression.CorrelatedColumn, rule_decorrelate.go)."""
+        try:
+            idx = scope.resolve(node)
+        except UnknownColumn:
+            for outer in reversed(self._outer_scopes):
+                try:
+                    oidx = outer.resolve(node)
+                except UnknownColumn:
+                    continue
+                c = outer.cols[oidx]
+                return _CorrRef(oidx, c.ft, c.name)
+            raise
+        c = scope.cols[idx]
+        return ECol(idx, c.ft, c.name)
+
     def __init__(self, infoschema, current_db: str, run_subquery=None):
         self.is_ = infoschema
         self.db = current_db
         self.run_subquery = run_subquery  # callable(Select ast) -> list[Datum rows]
+        # correlated-subquery build state (rule_decorrelate.go analog):
+        # while building a subquery, unknown names resolve against the
+        # enclosing scopes as _CorrRef placeholders
+        self._outer_scopes: list[NameScope] = []
 
     # ------------------------------------------------------------------ FROM
 
@@ -186,9 +208,7 @@ class PlanBuilder:
         if isinstance(node, ast.Lit):
             return lit_to_constant(node)
         if isinstance(node, ast.Name):
-            idx = scope.resolve(node)
-            c = scope.cols[idx]
-            return ECol(idx, c.ft, c.name)
+            return self._resolve_name(node, scope)
         if isinstance(node, ast.Call):
             lname = node.name.lower()
             if getattr(node, "over", None) is not None or lname in WINDOW_FUNCS:
@@ -263,6 +283,15 @@ class PlanBuilder:
                     ok = False
                 if not ok:
                     raise TiDBError(f"{lname} offset must be a non-negative integer constant")
+            if len(args) == 3:
+                a0, d2 = args[0].ret_type, args[2]
+                if a0.is_string() != d2.ret_type.is_string():
+                    raise TiDBError(f"{lname} default value type is incompatible with the value column")
+                if a0.is_decimal() and isinstance(d2, Constant) and not d2.value.is_null:
+                    # align the default to the value lane's scaled-int form
+                    args[2] = Constant(
+                        Datum.d(d2.value.to_dec().rescale(max(a0.decimal, 0))), a0.clone()
+                    )
             ft = args[0].ret_type.clone()
         elif lname == "nth_value":
             need(2, 2)
@@ -381,8 +410,7 @@ class PlanBuilder:
         scope = NameScope(plan.out_cols)
 
         if sel.where is not None:
-            conds = self.split_cnf(self.to_expr(sel.where, scope))
-            plan = Selection(plan, conds)
+            plan = self._build_where(plan, scope, sel.where)
 
         # expand stars into field list
         fields = []
@@ -495,6 +523,146 @@ class PlanBuilder:
             off = self._const_int(sel.offset) if sel.offset is not None else 0
             plan = Limit(plan, cnt, off)
         return plan
+
+    # ----------------------------------------------- WHERE / decorrelation
+
+    @staticmethod
+    def _ast_conjuncts(node) -> list:
+        if isinstance(node, ast.Call) and node.name.lower() == "and":
+            out = []
+            for a in node.args:
+                out.extend(PlanBuilder._ast_conjuncts(a))
+            return out
+        return [node]
+
+    @staticmethod
+    def _subquery_conjunct(cj):
+        """Classify a WHERE conjunct that can decorrelate into a semi/anti
+        join → (kind, lhs_ast, sub_select) or None."""
+        if isinstance(cj, ast.SubqueryExpr) and cj.modifier == "exists":
+            return ("semi", None, cj.select)
+        if isinstance(cj, ast.Call) and cj.name.lower() == "in_subquery":
+            return ("semi", cj.args[0], cj.args[1].select)
+        if isinstance(cj, ast.Call) and cj.name.lower() == "not" and len(cj.args) == 1:
+            inner = cj.args[0]
+            if isinstance(inner, ast.SubqueryExpr) and inner.modifier == "exists":
+                return ("anti", None, inner.select)
+            if isinstance(inner, ast.Call) and inner.name.lower() == "in_subquery":
+                return ("anti_in", inner.args[0], inner.args[1].select)
+        return None
+
+    @staticmethod
+    def _simple_subquery(sel) -> bool:
+        """Subqueries the decorrelated semi-join path handles: plain
+        SELECT-FROM-WHERE (no agg/group/having/limit/distinct/set-ops)."""
+        return (
+            isinstance(sel, ast.Select)
+            and not sel.group_by
+            and sel.having is None
+            and sel.limit is None
+            and not sel.distinct
+            and not sel_has_agg(sel)
+        )
+
+    def _build_where(self, plan, scope, where_ast):
+        """WHERE with IN/EXISTS conjuncts rewritten to semi/anti hash joins
+        (ref: planner/core/rule_decorrelate.go, expression_rewriter.go
+        buildSemiJoin) so subqueries never re-execute per row. Subqueries
+        beyond plain SPJ shape keep the eager-evaluation path (correct for
+        uncorrelated; correlated ones error in name resolution)."""
+        normal: list[Expression] = []
+        subs = []
+        for cj in self._ast_conjuncts(where_ast):
+            hit = self._subquery_conjunct(cj)
+            if hit is not None and self._simple_subquery(hit[2]):
+                subs.append(hit)
+                continue
+            normal.extend(self.split_cnf(self.to_expr(cj, scope)))
+        if normal:
+            plan = Selection(plan, normal)
+        for kind, lhs_ast, sub_sel in subs:
+            plan = self._build_semi_join(plan, scope, kind, lhs_ast, sub_sel)
+        return plan
+
+    @staticmethod
+    def _contains_corr(e: Expression) -> bool:
+        if isinstance(e, _CorrRef):
+            return True
+        if isinstance(e, ScalarFunc):
+            return any(PlanBuilder._contains_corr(a) for a in e.args)
+        return False
+
+    def _build_semi_join(self, plan, scope, kind, lhs_ast, sub_sel):
+        """Build the subquery's FROM+WHERE manually (join right side keeps
+        the subquery's FROM schema), extracting correlated conjuncts into
+        join conditions."""
+        nl = len(plan.out_cols)
+        self._outer_scopes.append(scope)
+        try:
+            subplan = self.build_from(sub_sel.from_)
+            sub_scope = NameScope(subplan.out_cols)
+            corr: list[Expression] = []
+            local: list[Expression] = []
+            if sub_sel.where is not None:
+                for cj in self._ast_conjuncts(sub_sel.where):
+                    for e in self.split_cnf(self.to_expr(cj, sub_scope)):
+                        (corr if self._contains_corr(e) else local).append(e)
+            if local:
+                subplan = Selection(subplan, local)
+            field_e = None
+            if lhs_ast is not None:  # IN (SELECT <one expr> ...)
+                if len(sub_sel.fields) != 1 or isinstance(sub_sel.fields[0], ast.Star):
+                    raise TiDBError("Operand should contain 1 column(s)")
+                field_e = self.to_expr(sub_sel.fields[0].expr, sub_scope)
+                if self._contains_corr(field_e):
+                    raise TiDBError("correlated expression in IN subquery select list is not supported")
+        finally:
+            self._outer_scopes.pop()
+
+        def rewrite(e):
+            # subquery-schema expr → concatenated (outer + inner) schema
+            if isinstance(e, _CorrRef):
+                return ECol(e.idx, e.ret_type, e.name)
+            if isinstance(e, ECol):
+                return ECol(e.idx + nl, e.ret_type, e.name)
+            if isinstance(e, ScalarFunc):
+                return ScalarFunc(e.sig, [rewrite(a) for a in e.args], e.ret_type)
+            return e
+
+        def side(e) -> str:
+            cols = set()
+            e.collect_columns(cols)
+            if cols and max(cols) < nl:
+                return "outer"
+            if cols and min(cols) >= nl:
+                return "inner"
+            return "mixed"
+
+        eq, other = [], []
+        for c in corr:
+            rc = rewrite(c)
+            if isinstance(rc, ScalarFunc) and rc.sig.name == "eq":
+                a, b = rc.args
+                sa, sb = side(a), side(b)
+                if {sa, sb} == {"outer", "inner"}:
+                    eq.append((a, b) if sa == "outer" else (b, a))
+                    continue
+            other.append(rc)
+
+        na_key = None
+        if field_e is not None:
+            from .optimizer import _shift_expr
+
+            lhs = self.to_expr(lhs_ast, scope)
+            rhs = _shift_expr(field_e, nl)
+            if kind == "anti_in":
+                na_key = (lhs, rhs)  # null-aware NOT IN key
+            else:
+                eq.append((lhs, rhs))
+
+        join = Join(plan, subplan, "anti" if kind == "anti_in" else kind, eq, other, list(plan.out_cols))
+        join.na_key = na_key
+        return join
 
     def _order_expr(self, node, out_scope: NameScope, fields, in_scope, agg_ctx):
         """ORDER BY resolves against output aliases first, then input."""
@@ -665,6 +833,42 @@ class AggContext:
             return x
 
         return rec(e)
+
+
+def sel_has_agg(sel) -> bool:
+    def walk(n):
+        if isinstance(n, ast.Call):
+            if n.name.lower() in AGG_FUNCS and getattr(n, "over", None) is None:
+                return True
+            return any(walk(a) for a in n.args)
+        if isinstance(n, ast.CaseWhen):
+            parts = [n.operand, n.else_] + [x for pair in n.whens for x in pair]
+            return any(walk(x) for x in parts if x is not None)
+        if isinstance(n, ast.Cast):
+            return walk(n.expr)
+        return False  # SubqueryExpr: nested aggs belong to the inner scope
+
+    return any(walk(f.expr) for f in sel.fields if not isinstance(f, ast.Star))
+
+
+class _CorrRef(Expression):
+    """A correlated reference to a column of the enclosing query
+    (ref: expression.CorrelatedColumn). Only valid during subquery builds;
+    _build_semi_join rewrites it to an outer-schema Column."""
+
+    def __init__(self, idx: int, ret_type, name: str):
+        self.idx = idx
+        self.ret_type = ret_type
+        self.name = name
+
+    def collect_columns(self, out):
+        pass  # not a local column
+
+    def eval(self, chunk):
+        raise TiDBError(f"correlated reference {self.name!r} is not supported in this position")
+
+    def __repr__(self):
+        return f"corr({self.name}#{self.idx})"
 
 
 class _WindowFuncExpr(Expression):

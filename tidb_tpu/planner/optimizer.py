@@ -87,7 +87,7 @@ def push_down_predicates(plan: LogicalPlan, conds: list[Expression] | None = Non
         left_conds, right_conds, keep = [], [], []
         for c in conds:
             cols = _cols_of(c)
-            if cols and max(cols) < nl and plan.kind in ("inner", "left"):
+            if cols and max(cols) < nl and plan.kind in ("inner", "left", "semi", "anti"):
                 left_conds.append(c)
             elif cols and min(cols) >= nl and plan.kind in ("inner", "right"):
                 right_conds.append(_shift_expr(c, -nl))
@@ -182,6 +182,11 @@ def _analyze_usage(node: LogicalPlan, uses: dict):
             mark(re_, cm)
         for c in node.other_conds:
             mark(c, cm)
+        if getattr(node, "na_key", None) is not None:
+            mark(node.na_key[0], maps[0])
+            mark(node.na_key[1], cm)
+        if node.kind in ("semi", "anti"):
+            return maps[0]  # output schema is the left side only
         return cm
     if isinstance(node, Window):
         for e in node.part_by:

@@ -95,9 +95,12 @@ class Aggregation(LogicalPlan):
 class Join(LogicalPlan):
     def __init__(self, left, right, kind: str, eq_conds, other_conds, cols):
         super().__init__([left, right], cols)
-        self.kind = kind  # inner | left | right | cross
+        self.kind = kind  # inner | left | right | cross | semi | anti
         self.eq_conds = eq_conds  # [(left_expr, right_expr)] over the concatenated schema
         self.other_conds = other_conds  # over concatenated schema
+        # null-aware NOT IN key pair (lhs over left schema, rhs over
+        # concatenated schema); only set on anti joins built from NOT IN
+        self.na_key = None
 
     def describe(self):
         return f"Join({self.kind}, eq={self.eq_conds!r}, other={self.other_conds!r})"
