@@ -21,12 +21,14 @@ from ..mysqltypes.field_type import FieldType, ft_longlong
 from ..mysqltypes.mydecimal import Dec, pow10
 from ..planner.plans import (
     Aggregation,
+    CTERef as CTERefPlan,
     DataSource,
     Dual,
     Join,
     Limit,
     LogicalPlan,
     Projection,
+    RecursiveCTE as RecursiveCTEPlan,
     Selection,
     SetOp,
     Sort,
@@ -94,15 +96,34 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
     if isinstance(plan, Aggregation):
         return _build_agg(plan, ctx)
     if isinstance(plan, Join):
+        out_fts = [c.ft for c in plan.out_cols]
+        if plan.kind in ("inner", "left") and plan.eq_conds and plan.na_key is None:
+            if ctx.vars.get("tidb_opt_prefer_index_join") == "ON":
+                ex = _try_index_join(plan, ctx, out_fts)
+                if ex is not None:
+                    return ex
+            merge_ok = all(
+                l.ret_type.is_string() == r.ret_type.is_string() for l, r in plan.eq_conds
+            )  # ordered merge can't compare string keys against numeric ones
+            if merge_ok and ctx.vars.get("tidb_opt_prefer_merge_join") == "ON":
+                return MergeJoinExec(
+                    build_executor(plan.children[0], ctx),
+                    build_executor(plan.children[1], ctx),
+                    plan.kind, plan.eq_conds, plan.other_conds, out_fts,
+                )
         return HashJoinExec(
             build_executor(plan.children[0], ctx),
             build_executor(plan.children[1], ctx),
             plan.kind,
             plan.eq_conds,
             plan.other_conds,
-            [c.ft for c in plan.out_cols],
+            out_fts,
             na_key=plan.na_key,
         )
+    if isinstance(plan, CTERefPlan):
+        return CTERefExec(plan)
+    if isinstance(plan, RecursiveCTEPlan):
+        return RecursiveCTEExec(plan, ctx)
     if isinstance(plan, WindowPlan):
         return WindowExec(
             build_executor(plan.children[0], ctx),
@@ -139,6 +160,53 @@ def _build_reader(ds: DataSource, ctx: ExecContext) -> "TableReaderExec":
     if path == "index_lookup":
         return IndexLookUpExec(ds.table, dag, ctx, ds.index, ds.key_ranges)
     return TableReaderExec(ds.table, dag, ctx, ranges=getattr(ds, "key_ranges", None))
+
+
+def _try_index_join(plan: Join, ctx: ExecContext, out_fts) -> "IndexLookupJoinExec | None":
+    """Pick an index-lookup join when the inner (right) side is a base
+    table with an index led by the join key (ref: planner
+    exhaust_physical_plans.go tryToGetIndexJoin, simplified to the
+    sysvar-gated heuristic)."""
+    right = plan.children[1]
+    if not isinstance(right, DataSource) or len(plan.eq_conds) != 1:
+        return None
+    if getattr(right, "path", "table") != "table" or getattr(right, "key_ranges", None) is not None:
+        return None  # access-path ranges already consumed pushed conds
+    nl = len(plan.children[0].out_cols)
+    rexpr = plan.eq_conds[0][1]
+    if not isinstance(rexpr, ECol):
+        return None
+    ridx = rexpr.idx - nl
+    if not (0 <= ridx < len(right.out_cols)):
+        return None
+    orig = right.out_cols[ridx].orig_offset
+    index = next(
+        (ix for ix in right.table.indexes if ix.col_offsets and ix.col_offsets[0] == orig),
+        None,
+    )
+    if index is None:
+        return None
+    # probe keys are key-encoded with the outer expression's type flag;
+    # anything but an exact int/int match would never equal the index
+    # entries' encoding (silent empty result) — gate to same-class ints
+    lft = plan.eq_conds[0][0].ret_type
+    rft = right.table.columns[orig].ft
+    if not (lft.is_int() and rft.is_int() and lft.is_unsigned == rft.is_unsigned):
+        return None
+    visible = right.table.visible_columns()
+    scan = ScanNode(
+        right.table.id,
+        [c.offset for c in visible],
+        [c.ft for c in visible],
+        [c.id for c in visible],
+    )
+    dag = DAGRequest(scan)
+    if right.pushed_conds:
+        dag.selection = SelectionNode(right.pushed_conds)
+    return IndexLookupJoinExec(
+        build_executor(plan.children[0], ctx), ctx, right.table, index, dag,
+        plan.kind, plan.eq_conds, plan.other_conds, out_fts,
+    )
 
 
 def _pushable_reader(e: Executor) -> "TableReaderExec | None":
@@ -851,7 +919,6 @@ class HashJoinExec(Executor):
                 table.setdefault(kt, []).append(i)
 
         li_out, ri_out = [], []
-        matched_right = np.zeros(rchunk.num_rows, dtype=bool)
         if lchunk.num_rows:
             lkey_lanes = [k.eval(lchunk) for k in lkeys]
             for i in range(lchunk.num_rows):
@@ -867,12 +934,16 @@ class HashJoinExec(Executor):
                 if not hit and self.kind == "left":
                     li_out.append(i)
                     ri_out.append(-1)
+        return self._emit(lchunk, rchunk, li_out, ri_out)
 
+    def _emit(self, lchunk, rchunk, li_out, ri_out) -> Chunk:
+        """Shared tail: assemble pairs, apply other-conditions, pad
+        unmatched right rows for right-outer joins."""
         out = _assemble_join(lchunk, rchunk, li_out, ri_out, self.out_fts)
         if self.other_conds:
             out, li_out, ri_out = self._apply_other(out, lchunk, rchunk, li_out, ri_out)
         if self.kind == "right":
-            # right outer: emit unmatched right rows null-padded
+            matched_right = np.zeros(rchunk.num_rows, dtype=bool)
             for j in ri_out:
                 if j >= 0:
                     matched_right[j] = True
@@ -983,6 +1054,234 @@ class HashJoinExec(Executor):
     def close(self):
         self.left.close()
         self.right.close()
+
+
+class MergeJoinExec(HashJoinExec):
+    """Sort-merge join (ref: executor/merge_join.go MergeJoinExec): sorts
+    both inputs on the join keys and zips equal-key groups. Inner and
+    left-outer kinds; picked by `tidb_opt_prefer_merge_join`."""
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        lchunk = drain(self.left)
+        rchunk = drain(self.right)
+        nl = lchunk.num_cols
+        from ..copr.host_engine import _lex_argsort
+        from ..planner.optimizer import _shift_expr
+
+        lkeys = [l for l, _ in self.eq_conds]
+        rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
+        if not lkeys:
+            raise TiDBError("merge join requires equality join keys")
+        ll = [_broadcast_lane(*k.eval(lchunk), lchunk.num_rows) for k in lkeys]
+        rl = [_broadcast_lane(*k.eval(rchunk), rchunk.num_rows) for k in rkeys]
+        lorder = _lex_argsort([(d, v, False) for d, v in ll], lchunk.num_rows)
+        rorder = _lex_argsort([(d, v, False) for d, v in rl], rchunk.num_rows)
+        # key tuples materialized once per row (None = NULL key, never matches)
+        lk = [_key_tuple(ll, i) for i in lorder]
+        rk = [_key_tuple(rl, j) for j in rorder]
+
+        li_out, ri_out = [], []
+        i = j = 0
+        n, m = len(lorder), len(rorder)
+        while i < n:
+            kl = lk[i]
+            if kl is None:
+                if self.kind == "left":
+                    li_out.append(lorder[i])
+                    ri_out.append(-1)
+                i += 1
+                continue
+            # advance right to the first key >= kl
+            while j < m and (rk[j] is None or rk[j] < kl):
+                j += 1
+            # gather the right equal-key group
+            j2 = j
+            while j2 < m and rk[j2] == kl:
+                j2 += 1
+            # emit all left rows of this key against the group
+            i2 = i
+            while i2 < n and lk[i2] == kl:
+                if j2 > j:
+                    for jj in range(j, j2):
+                        li_out.append(lorder[i2])
+                        ri_out.append(rorder[jj])
+                elif self.kind == "left":
+                    li_out.append(lorder[i2])
+                    ri_out.append(-1)
+                i2 += 1
+            i = i2
+        return self._emit(lchunk, rchunk, li_out, ri_out)
+
+
+class ChunkSourceExec(Executor):
+    """Feeds a pre-materialized chunk into an executor tree."""
+
+    def __init__(self, chunk: Chunk, out_fts):
+        self.chunk = chunk
+        self.out_fts = out_fts
+        self._done = False
+
+    def open(self):
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        return self.chunk
+
+
+class IndexLookupJoinExec(Executor):
+    """Index-lookup join (ref: executor/index_lookup_join.go): batches the
+    outer side's join keys into inner-index point lookups, fetches only
+    matching inner rows, then probes them as a hash join. Wins when the
+    outer side is small relative to the inner table."""
+
+    def __init__(self, outer: Executor, ctx, table, index, dag, kind, eq_conds, other_conds, out_fts):
+        self.outer = outer
+        self.ctx = ctx
+        self.table = table
+        self.index = index
+        self.dag = dag
+        self.kind = kind
+        self.eq_conds = eq_conds
+        self.other_conds = other_conds
+        self.out_fts = out_fts
+        self._done = False
+
+    def open(self):
+        self._done = False
+
+    def close(self):
+        self.outer.close()
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        from ..codec import tablecodec
+        from ..codec.key import encode_datum_key
+
+        lchunk = drain(self.outer)
+        lkey = self.eq_conds[0][0]
+        d, v = _broadcast_lane(*lkey.eval(lchunk), lchunk.num_rows)
+        # distinct non-null probe datums → index point ranges
+        col = Column(lkey.ret_type, d, v)
+        seen = set()
+        ranges = []
+        for i in range(lchunk.num_rows):
+            if not v[i]:
+                continue
+            dat = col.get_datum(i)
+            key = dat.val if not isinstance(dat.val, (bytearray,)) else bytes(dat.val)
+            if key in seen:
+                continue
+            seen.add(key)
+            buf = bytearray(tablecodec.index_prefix(self.table.id, self.index.id))
+            encode_datum_key(buf, dat)
+            enc = bytes(buf)
+            ranges.append((enc, enc + b"\xff"))
+        handles = []
+        if ranges:
+            entries = self.ctx.cop.index_entries(
+                self.table, self.index, ranges, self.ctx.read_ts, txn=self.ctx.txn
+            )
+            handles = [h for _, h in entries]
+        chunks = list(
+            self.ctx.cop.send_handles(
+                self.table, self.dag, handles, self.ctx.read_ts, self.ctx.engine, txn=self.ctx.txn
+            )
+        )
+        rchunk = Chunk.concat_all(chunks) if chunks else Chunk.empty(self.dag.output_types(), 0)
+        inner = HashJoinExec(
+            ChunkSourceExec(lchunk, [c.ft for c in lchunk.columns]),
+            ChunkSourceExec(rchunk, self.dag.output_types()),
+            self.kind,
+            self.eq_conds,
+            self.other_conds,
+            self.out_fts,
+        )
+        return drain(inner)
+
+
+class CTERefExec(Executor):
+    """Reads the recursive CTE's current working table
+    (ref: executor/cte_table_reader.go)."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.out_fts = [c.ft for c in plan.out_cols]
+        self._done = False
+
+    def open(self):
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        c = self.plan.storage.chunk
+        return c if c is not None else Chunk.empty(self.out_fts, 0)
+
+
+class RecursiveCTEExec(Executor):
+    """WITH RECURSIVE fixpoint iteration (ref: executor/cte.go:60 CTEExec):
+    materialize the seed, then run the recursive branch against the
+    previous iteration's rows until it produces nothing new."""
+
+    MAX_ITER = 1000  # MySQL cte_max_recursion_depth default
+
+    def __init__(self, plan, ctx):
+        self.plan = plan
+        self.ctx = ctx
+        self.out_fts = [c.ft for c in plan.out_cols]
+        self._done = False
+
+    def open(self):
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        seed = _coerce_chunk(drain(build_executor(self.plan.children[0], self.ctx)), self.out_fts)
+        seen = None
+        if self.plan.distinct:
+            seen = set()
+            keep = []
+            for i, r in enumerate(seed.iter_rows()):
+                t = tuple(r)
+                if t not in seen:
+                    seen.add(t)
+                    keep.append(i)
+            if len(keep) < seed.num_rows:
+                seed = seed.take(np.asarray(keep, dtype=np.int64))
+        result = [seed]
+        work = seed
+        for _ in range(self.MAX_ITER):
+            if work.num_rows == 0:
+                break
+            self.plan.storage.chunk = work
+            rec = _coerce_chunk(drain(build_executor(self.plan.children[1], self.ctx)), self.out_fts)
+            if self.plan.distinct:
+                keep = []
+                for i, r in enumerate(rec.iter_rows()):
+                    t = tuple(r)
+                    if t not in seen:
+                        seen.add(t)
+                        keep.append(i)
+                rec = rec.take(np.asarray(keep, dtype=np.int64))
+            if rec.num_rows == 0:
+                break
+            result.append(rec)
+            work = rec
+        else:
+            raise TiDBError("recursive CTE exceeded max recursion depth")
+        self.plan.storage.chunk = None
+        return Chunk.concat_all(result)
 
 
 def _key_tuple(key_lanes, i):

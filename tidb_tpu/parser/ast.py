@@ -129,6 +129,21 @@ class Join:
 
 
 @dataclass
+class CTEDef:
+    """One WITH-clause table (ref: parser ast CommonTableExpression)."""
+
+    name: str
+    cols: list  # optional explicit column names
+    select: Any  # Select | SetOpSelect
+
+
+@dataclass
+class WithClause:
+    recursive: bool
+    ctes: list  # [CTEDef]
+
+
+@dataclass
 class SelectField:
     expr: Any
     alias: str | None = None
@@ -155,6 +170,7 @@ class Select:
     lock_in_share: bool = False
     windows: list = field(default_factory=list)
     setop: Any = None  # ('union'|'union all'|..., Select) chained
+    with_: Any = None  # WithClause
 
 
 @dataclass
@@ -166,6 +182,7 @@ class SetOpSelect:
     order_by: list = field(default_factory=list)
     limit: Any = None
     offset: Any = None
+    with_: Any = None  # WithClause
 
 
 @dataclass

@@ -190,6 +190,31 @@ class Parser:
     # --- SELECT ------------------------------------------------------------
 
     def select_stmt(self):
+        with_ = None
+        if self.at_kw("WITH"):
+            self.next()
+            recursive = self.try_kw("RECURSIVE")
+            ctes = []
+            while True:
+                name = self.ident()
+                cols = []
+                if self.try_op("("):
+                    cols = self.name_list()
+                    self.expect_op(")")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                sub = self.select_stmt()
+                self.expect_op(")")
+                ctes.append(ast.CTEDef(name, cols, sub))
+                if not self.try_op(","):
+                    break
+            with_ = ast.WithClause(recursive, ctes)
+        stmt = self._select_body()
+        if with_ is not None:
+            stmt.with_ = with_
+        return stmt
+
+    def _select_body(self):
         first = self.select_core()
         selects = [first]
         ops = []

@@ -18,7 +18,23 @@ from ..mysqltypes.datum import Datum
 from ..mysqltypes.field_type import FieldType, TypeCode, ft_double, ft_longlong, ft_varchar, parse_type_name
 from ..mysqltypes.mydecimal import Dec
 from ..parser import ast
-from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, PlanCol, Projection, Selection, SetOp, Sort, Window
+from .plans import (
+    Aggregation,
+    CTERef,
+    CTEStorage,
+    DataSource,
+    Dual,
+    Join,
+    Limit,
+    LogicalPlan,
+    PlanCol,
+    Projection,
+    RecursiveCTE,
+    Selection,
+    SetOp,
+    Sort,
+    Window,
+)
 
 
 def lit_to_constant(l: ast.Lit) -> Constant:
@@ -120,10 +136,106 @@ class PlanBuilder:
         # while building a subquery, unknown names resolve against the
         # enclosing scopes as _CorrRef placeholders
         self._outer_scopes: list[NameScope] = []
+        # WITH-clause tables visible to the current (sub)query, innermost
+        # last; entries: name → CTEDef | ("recursive", CTERef factory)
+        self._cte_frames: list[dict] = []
 
     # ------------------------------------------------------------------ FROM
 
-    def build_table(self, tn: ast.TableName) -> DataSource:
+    # ------------------------------------------------------------------ CTE
+
+    MAX_CTE_DEPTH = 32
+
+    def _cte_frame(self, wf: ast.WithClause) -> dict:
+        frame = {}
+        for cte in wf.ctes:
+            if cte.name.lower() in frame:
+                raise TiDBError(f"Not unique table/alias: {cte.name!r}")
+            kind = "recursive" if (wf.recursive and _refs_table(cte.select, cte.name)) else "plain"
+            frame[cte.name.lower()] = (kind, cte)
+        return frame
+
+    def _lookup_cte(self, name: str):
+        key = name.lower()
+        # recursive-branch binding shadows everything
+        bind = getattr(self, "_rec_bindings", {}).get(key)
+        if bind is not None:
+            return ("ref", bind)
+        for frame in reversed(self._cte_frames):
+            if key in frame:
+                return frame[key]
+        return None
+
+    def _build_cte(self, tn: ast.TableName, entry) -> LogicalPlan:
+        kind, payload = entry
+        alias = tn.alias or tn.name
+        if kind == "ref":
+            storage, cols = payload
+            return CTERef(tn.name, storage, [PlanCol(c.name, c.ft, alias) for c in cols])
+        cte: ast.CTEDef = payload
+        if kind == "building":
+            raise TiDBError(f"CTE {cte.name!r} references itself but is not declared RECURSIVE")
+        if kind == "plain":
+            # inline the CTE body (materialization is an executor concern);
+            # mark it 'building' so non-recursive self-reference errors
+            for frame in reversed(self._cte_frames):
+                if frame.get(cte.name.lower()) is entry:
+                    frame[cte.name.lower()] = ("building", cte)
+                    break
+            try:
+                sub = self.build_select(cte.select)
+            finally:
+                for frame in reversed(self._cte_frames):
+                    if frame.get(cte.name.lower()) == ("building", cte):
+                        frame[cte.name.lower()] = entry
+                        break
+            return self._alias_barrier(sub, cte, alias)
+        # recursive CTE: split seed vs recursive branches
+        sel = cte.select
+        if not isinstance(sel, ast.SetOpSelect) or len(sel.selects) != 2:
+            raise TiDBError("recursive CTE must be 'seed UNION [ALL] recursive' with two branches")
+        seed_ast, rec_ast = sel.selects
+        if _refs_table(seed_ast, cte.name) or not _refs_table(rec_ast, cte.name):
+            raise TiDBError("recursive CTE needs a non-recursive seed branch first")
+        distinct = sel.ops[0] == "union"
+        seed_plan = self.build_select(seed_ast)
+        names = cte.cols or [c.name for c in seed_plan.out_cols]
+        if len(names) != len(seed_plan.out_cols):
+            raise TiDBError("CTE column list length mismatch")
+        cols = [PlanCol(nm, c.ft, cte.name) for nm, c in zip(names, seed_plan.out_cols)]
+        storage = CTEStorage()
+        if not hasattr(self, "_rec_bindings"):
+            self._rec_bindings = {}
+        if cte.name.lower() in self._rec_bindings:
+            raise TiDBError("nested recursion in recursive CTE is not supported")
+        self._rec_bindings[cte.name.lower()] = (storage, cols)
+        try:
+            rec_plan = self.build_select(rec_ast)
+        finally:
+            del self._rec_bindings[cte.name.lower()]
+        if len(rec_plan.out_cols) != len(cols):
+            raise TiDBError(
+                f"recursive branch of CTE {cte.name!r} returns {len(rec_plan.out_cols)} "
+                f"columns, expected {len(cols)}"
+            )
+        node = RecursiveCTE(cte.name, seed_plan, rec_plan, storage, distinct,
+                            [PlanCol(c.name, c.ft, alias) for c in cols])
+        return node
+
+    @staticmethod
+    def _alias_barrier(sub: LogicalPlan, cte: ast.CTEDef, alias: str) -> LogicalPlan:
+        names = cte.cols or [c.name for c in sub.out_cols]
+        if len(names) != len(sub.out_cols):
+            raise TiDBError("CTE column list length mismatch")
+        cols = [PlanCol(nm, c.ft, alias) for nm, c in zip(names, sub.out_cols)]
+        exprs = [ECol(i, c.ft, c.name) for i, c in enumerate(sub.out_cols)]
+        return Projection(sub, exprs, cols)
+
+    def build_table(self, tn: ast.TableName):
+        if tn.db is None:
+            ent = self._lookup_cte(tn.name)
+            if ent is not None:
+                return self._build_cte(tn, ent)
         db = tn.db or self.db
         info = self.is_.table(db, tn.name)
         cols = [
@@ -404,6 +516,16 @@ class PlanBuilder:
     # ---------------------------------------------------------------- SELECT
 
     def build_select(self, sel) -> LogicalPlan:
+        wf = getattr(sel, "with_", None)
+        if wf is not None:
+            self._cte_frames.append(self._cte_frame(wf))
+            try:
+                return self._build_select_body(sel)
+            finally:
+                self._cte_frames.pop()
+        return self._build_select_body(sel)
+
+    def _build_select_body(self, sel) -> LogicalPlan:
         if isinstance(sel, ast.SetOpSelect):
             return self.build_setop(sel)
         plan = self.build_from(sel.from_)
@@ -833,6 +955,43 @@ class AggContext:
             return x
 
         return rec(e)
+
+
+def _refs_table(node, name: str) -> bool:
+    """Does this (set-op) select reference `name` as a table — in FROM or
+    inside an expression subquery (EXISTS/IN/scalar)?"""
+    nm = name.lower()
+
+    def from_tree(f):
+        if isinstance(f, ast.TableName):
+            return f.db is None and f.name.lower() == nm
+        if isinstance(f, ast.Join):
+            return from_tree(f.left) or from_tree(f.right)
+        if isinstance(f, ast.SubqueryTable):
+            return walk(f.select)
+        return False
+
+    def expr_walk(e):
+        if isinstance(e, ast.SubqueryExpr):
+            return walk(e.select)
+        if isinstance(e, ast.Call):
+            return any(expr_walk(a) for a in e.args)
+        if isinstance(e, ast.CaseWhen):
+            parts = [e.operand, e.else_] + [x for pair in e.whens for x in pair]
+            return any(expr_walk(x) for x in parts if x is not None)
+        if isinstance(e, ast.Cast):
+            return expr_walk(e.expr)
+        return False
+
+    def walk(s):
+        if isinstance(s, ast.SetOpSelect):
+            return any(walk(x) for x in s.selects)
+        if s.from_ is not None and from_tree(s.from_):
+            return True
+        exprs = [s.where, s.having] + [f.expr for f in s.fields if not isinstance(f, ast.Star)]
+        return any(expr_walk(e) for e in exprs if e is not None)
+
+    return walk(node)
 
 
 def sel_has_agg(sel) -> bool:

@@ -151,6 +151,41 @@ class Dual(LogicalPlan):
         super().__init__([], [])
 
 
+class CTEStorage:
+    """Shared buffer between a RecursiveCTE producer and its CTERef readers
+    (ref: util/cteutil storage)."""
+
+    def __init__(self):
+        self.chunk = None  # current iteration's working chunk
+
+
+class CTERef(LogicalPlan):
+    """Reads the recursive CTE's working table inside the recursive branch
+    (ref: executor/cte_table_reader.go CTETableReaderExec)."""
+
+    def __init__(self, name: str, storage: CTEStorage, cols):
+        super().__init__([], cols)
+        self.name = name
+        self.storage = storage
+
+    def describe(self):
+        return f"CTERef({self.name})"
+
+
+class RecursiveCTE(LogicalPlan):
+    """WITH RECURSIVE: seed plan UNION [ALL] recursive plan iterated to a
+    fixpoint (ref: executor/cte.go:60 CTEExec)."""
+
+    def __init__(self, name: str, seed, recursive, storage: CTEStorage, distinct: bool, cols):
+        super().__init__([seed, recursive], cols)
+        self.name = name
+        self.storage = storage
+        self.distinct = distinct  # UNION vs UNION ALL between iterations
+
+    def describe(self):
+        return f"RecursiveCTE({self.name}, {'union' if self.distinct else 'union_all'})"
+
+
 class SetOp(LogicalPlan):
     def __init__(self, children, ops: list[str], cols):
         super().__init__(children, cols)
