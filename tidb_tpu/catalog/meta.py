@@ -25,6 +25,8 @@ K_NEXT_ID = b"m:nextid"
 K_SCHEMA_VER = b"m:schema_version"
 P_DB = b"m:db:"
 P_TBL = b"m:tbl:"
+P_JOB = b"m:job:"  # queued/running DDL jobs (ref: meta job queues, ddl_worker.go:67)
+P_JOB_HIST = b"m:jobh:"  # finished jobs (ADMIN SHOW DDL JOBS)
 
 
 class Meta:
@@ -84,4 +86,50 @@ class Meta:
         out = []
         for _, v in self.txn.scan(P_TBL, P_TBL + b"\xff"):
             out.append(TableInfo.from_json(json.loads(v)))
+        return out
+
+    # --- DDL job queue (ref: ddl.go:535 doDDLJob, meta job lists) ----------
+
+    @staticmethod
+    def _job_key(jid: int) -> bytes:
+        return P_JOB + f"{jid:012d}".encode()  # zero-pad: queue scans in id order
+
+    def put_job(self, job) -> None:
+        self.txn.put(self._job_key(job.id), json.dumps(job.to_json()).encode())
+
+    def job(self, jid: int):
+        from ..ddl.jobs import DDLJob
+
+        raw = self.txn.get(self._job_key(jid))
+        return DDLJob.from_json(json.loads(raw)) if raw else None
+
+    def first_job(self):
+        from ..ddl.jobs import DDLJob
+
+        for _, v in self.txn.scan(P_JOB, P_JOB + b"\xff", limit=1):
+            return DDLJob.from_json(json.loads(v))
+        return None
+
+    def jobs(self) -> list:
+        from ..ddl.jobs import DDLJob
+
+        return [DDLJob.from_json(json.loads(v)) for _, v in self.txn.scan(P_JOB, P_JOB + b"\xff")]
+
+    def history_job(self, jid: int):
+        from ..ddl.jobs import DDLJob
+
+        raw = self.txn.get(P_JOB_HIST + f"{jid:012d}".encode())
+        return DDLJob.from_json(json.loads(raw)) if raw else None
+
+    def finish_job(self, job) -> None:
+        """Move a job from the queue to history (ref: finishDDLJob)."""
+        self.txn.delete(self._job_key(job.id))
+        self.txn.put(P_JOB_HIST + f"{job.id:012d}".encode(), json.dumps(job.to_json()).encode())
+
+    def job_history(self) -> list:
+        from ..ddl.jobs import DDLJob
+
+        out = []
+        for _, v in self.txn.scan(P_JOB_HIST, P_JOB_HIST + b"\xff"):
+            out.append(DDLJob.from_json(json.loads(v)))
         return out

@@ -1,0 +1,4 @@
+from .jobs import DDLJob
+from .worker import DDLWorker
+
+__all__ = ["DDLJob", "DDLWorker"]

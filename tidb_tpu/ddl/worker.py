@@ -1,0 +1,241 @@
+"""Online DDL worker — F1-style asynchronous schema change
+(ref: ddl/ddl_worker.go:490 handleDDLJobQueue, ddl/index.go onCreateIndex,
+ddl/backfilling.go:546 writePhysicalTableRecord, ddl/reorg.go checkpoints).
+
+ADD INDEX walks delete_only → write_only → write_reorg → public, one meta
+transaction + schema-version bump per transition, so any concurrent
+session (which reloads the schema per statement) is at most one state
+behind — the F1 invariant that makes dual-writes + backfill safe:
+
+  delete_only : new index accepts deletes only (no dangling entries when
+                a one-state-behind session deletes a row)
+  write_only  : DML dual-writes the index, readers don't use it
+  write_reorg : backfill copies snapshot rows in batches; the done-handle
+                checkpoint persists in the job so an interrupted reorg
+                resumes where it stopped
+  public      : readable; unique constraints enforced at write time
+
+The single-process owner is a lock on the worker (the etcd election seam,
+owner/manager.go:94, collapses to in-process mutual exclusion here).
+`hook` is the test seam for interleaving DML between transitions
+(ref: ddl/callback.go).
+"""
+
+from __future__ import annotations
+
+from threading import RLock
+
+from ..catalog.meta import Meta
+from ..codec import tablecodec
+from ..errors import DuplicateEntry, TiDBError
+from .jobs import (
+    DDLJob,
+    JOB_DONE,
+    JOB_QUEUED,
+    JOB_ROLLBACK,
+    JOB_RUNNING,
+    ST_DELETE_ONLY,
+    ST_NONE,
+    ST_PUBLIC,
+    ST_WRITE_ONLY,
+    ST_WRITE_REORG,
+)
+
+BACKFILL_BATCH = 256  # rows per reorg txn (ref: ddl.reorg batch size)
+
+ADD_INDEX_STATES = [ST_DELETE_ONLY, ST_WRITE_ONLY, ST_WRITE_REORG, ST_PUBLIC]
+DROP_INDEX_STATES = [ST_WRITE_ONLY, ST_DELETE_ONLY, ST_NONE]
+
+
+class DDLWorker:
+    def __init__(self, storage):
+        self.storage = storage
+        self._lock = RLock()  # the owner-election seam: one runner at a time
+        self.hook = None  # callable(event: str, job: DDLJob) — test seam
+
+    def _fire(self, event: str, job: DDLJob) -> None:
+        if self.hook is not None:
+            self.hook(event, job)
+
+    # --- queue driving -----------------------------------------------------
+
+    def enqueue(self, job_type: str, table_id: int, args: dict) -> int:
+        txn = self.storage.begin()
+        m = Meta(txn)
+        job = DDLJob(m.alloc_id(), job_type, table_id, args)
+        m.put_job(job)
+        txn.commit()
+        return job.id
+
+    def run_until_done(self, job_id: int) -> DDLJob:
+        """Drive the queue until `job_id` finishes (the doDDLJob wait loop,
+        ddl.go:562). Raises the job's error if it rolled back."""
+        with self._lock:
+            while True:
+                txn = self.storage.begin()
+                m = Meta(txn)
+                done = m.history_job(job_id)
+                job = m.first_job()
+                txn.rollback()
+                if done is not None:
+                    if done.state == JOB_ROLLBACK:
+                        err = done.error or "DDL job rolled back"
+                        if "Duplicate entry" in err:
+                            raise DuplicateEntry(err)
+                        raise TiDBError(err)
+                    return done
+                if job is None:
+                    raise TiDBError(f"DDL job {job_id} vanished from the queue")
+                self._step(job)
+
+    def run_pending(self) -> None:
+        """Drain the whole queue (background-owner mode)."""
+        with self._lock:
+            while True:
+                txn = self.storage.begin()
+                job = Meta(txn).first_job()
+                txn.rollback()
+                if job is None:
+                    return
+                self._step(job)
+
+    # --- job execution -----------------------------------------------------
+
+    def _step(self, job: DDLJob) -> None:
+        """Run ONE state transition (or one backfill round) of the job."""
+        if job.type == "add_index":
+            self._step_add_index(job)
+        elif job.type == "drop_index":
+            self._step_drop_index(job)
+        else:
+            self._finish(job, JOB_ROLLBACK, error=f"unknown DDL job type {job.type!r}")
+
+    def _set_index_state(self, job: DDLJob, new_state: str) -> None:
+        """One meta txn: flip the index state + bump schema version +
+        persist job progress (ref: updateSchemaVersion per transition)."""
+        txn = self.storage.begin()
+        m = Meta(txn)
+        t = m.table(job.table_id)
+        idx = next((i for i in t.indexes if i.id == job.args["index_id"]), None)
+        if idx is None:
+            txn.rollback()
+            raise TiDBError(f"index {job.args['index_id']} missing during DDL job {job.id}")
+        idx.state = new_state
+        m.put_table(t)
+        job.schema_state = new_state
+        job.state = JOB_RUNNING
+        m.put_job(job)
+        m.bump_schema_version()
+        txn.commit()
+        self._fire(f"state:{new_state}", job)
+
+    def _finish(self, job: DDLJob, state: str, error: str | None = None) -> None:
+        txn = self.storage.begin()
+        m = Meta(txn)
+        job.state = state
+        job.error = error
+        m.finish_job(job)
+        m.bump_schema_version()
+        txn.commit()
+        self._fire("finish", job)
+
+    # --- ADD INDEX ---------------------------------------------------------
+
+    def _step_add_index(self, job: DDLJob) -> None:
+        cur = job.schema_state
+        if cur == ST_NONE:
+            self._set_index_state(job, ST_DELETE_ONLY)
+        elif cur == ST_DELETE_ONLY:
+            self._set_index_state(job, ST_WRITE_ONLY)
+        elif cur == ST_WRITE_ONLY:
+            self._set_index_state(job, ST_WRITE_REORG)
+        elif cur == ST_WRITE_REORG:
+            finished = self._backfill_batch(job)
+            if finished:
+                self._set_index_state(job, ST_PUBLIC)
+        elif cur == ST_PUBLIC:
+            self._finish(job, JOB_DONE)
+
+    def _backfill_batch(self, job: DDLJob) -> bool:
+        """Copy one batch of snapshot rows into the index; the done-handle
+        checkpoint commits atomically with the entries (ref:
+        backfilling.go:546 + BackfillDataInTxn). Returns True when the
+        table is exhausted."""
+        from ..table.table import Table
+
+        txn = self.storage.begin()
+        m = Meta(txn)
+        t = m.table(job.table_id)
+        idx = next(i for i in t.indexes if i.id == job.args["index_id"])
+        tbl = Table(t)
+        prefix = tablecodec.record_prefix(t.id)
+        start = prefix if job.reorg_handle is None else tablecodec.record_key(t.id, job.reorg_handle + 1)
+        rows = txn.scan(start, prefix + b"\xff", limit=BACKFILL_BATCH)
+        last_handle = None
+        for k, v in rows:
+            handle = tablecodec.decode_record_handle(k)
+            datums = tbl.decode_record(v)
+            key, val, distinct = tbl.index_value_key(idx, datums, handle)
+            if distinct:
+                existing = txn.get(key)
+                # a dual-written entry for the same handle/value is fine;
+                # a different one is a real duplicate → roll the job back
+                if existing is not None and existing != val:
+                    txn.rollback()
+                    self._rollback_add_index(job)
+                    return False
+            txn.put(key, val)
+            last_handle = handle
+        if last_handle is not None:
+            job.reorg_handle = last_handle
+            m.put_job(job)
+        txn.commit()
+        if last_handle is not None:
+            self._fire("backfill_batch", job)
+        return len(rows) < BACKFILL_BATCH
+
+    def _rollback_add_index(self, job: DDLJob) -> None:
+        """Duplicate data found mid-reorg: retract the index (reverse
+        transitions) and finish the job rolled-back (ref: rollingback.go)."""
+        for st in (ST_WRITE_ONLY, ST_DELETE_ONLY):
+            self._set_index_state(job, st)
+        txn = self.storage.begin()
+        m = Meta(txn)
+        t = m.table(job.table_id)
+        t.indexes = [i for i in t.indexes if i.id != job.args["index_id"]]
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+        self.storage.mvcc.unsafe_destroy_range(
+            tablecodec.index_prefix(job.table_id, job.args["index_id"]),
+            tablecodec.index_prefix(job.table_id, job.args["index_id"] + 1),
+        )
+        self._finish(job, JOB_ROLLBACK, error=f"Duplicate entry for key {job.args.get('index_name')!r}")
+
+    # --- DROP INDEX --------------------------------------------------------
+
+    def _step_drop_index(self, job: DDLJob) -> None:
+        cur = job.schema_state
+        if cur == ST_NONE:
+            # entry point: job starts with the index public
+            job.schema_state = ST_PUBLIC
+            self._step_drop_index(job)
+        elif cur == ST_PUBLIC:
+            self._set_index_state(job, ST_WRITE_ONLY)
+        elif cur == ST_WRITE_ONLY:
+            self._set_index_state(job, ST_DELETE_ONLY)
+        elif cur == ST_DELETE_ONLY:
+            txn = self.storage.begin()
+            m = Meta(txn)
+            t = m.table(job.table_id)
+            t.indexes = [i for i in t.indexes if i.id != job.args["index_id"]]
+            m.put_table(t)
+            m.bump_schema_version()
+            txn.commit()
+            # deferred data removal (ref: ddl/delete_range.go)
+            self.storage.mvcc.unsafe_destroy_range(
+                tablecodec.index_prefix(job.table_id, job.args["index_id"]),
+                tablecodec.index_prefix(job.table_id, job.args["index_id"] + 1),
+            )
+            self._fire("state:none", job)
+            self._finish(job, JOB_DONE)

@@ -181,7 +181,11 @@ def _try_index_join(plan: Join, ctx: ExecContext, out_fts) -> "IndexLookupJoinEx
         return None
     orig = right.out_cols[ridx].orig_offset
     index = next(
-        (ix for ix in right.table.indexes if ix.col_offsets and ix.col_offsets[0] == orig),
+        (
+            ix
+            for ix in right.table.indexes
+            if ix.state == "public" and ix.col_offsets and ix.col_offsets[0] == orig
+        ),
         None,
     )
     if index is None:

@@ -230,7 +230,29 @@ class Session:
             return self._run_analyze(stmt)
         if isinstance(stmt, ast.FlushStmt):
             return ResultSet([], None)
+        if isinstance(stmt, ast.AdminStmt) and stmt.kind == "show_ddl_jobs":
+            return self._admin_show_ddl_jobs()
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
+
+    def _admin_show_ddl_jobs(self) -> ResultSet:
+        """ADMIN SHOW DDL JOBS (ref: executor ShowDDLJobsExec)."""
+        from ..mysqltypes.field_type import ft_varchar
+
+        txn = self.store.begin()
+        m = Meta(txn)
+        jobs = m.job_history()
+        pending = m.jobs()
+        txn.rollback()
+        names = ["JOB_ID", "JOB_TYPE", "TABLE_ID", "SCHEMA_STATE", "STATE", "ERROR"]
+        rows = [
+            (str(j.id), j.type, str(j.table_id), j.schema_state, j.state, j.error or "")
+            for j in pending + sorted(jobs, key=lambda x: -x.id)
+        ]
+        chk = Chunk.empty([ft_varchar(64) for _ in names], len(rows))
+        for r, row in enumerate(rows):
+            for c, v in enumerate(row):
+                chk.columns[c].set_datum(r, Datum.s(v))
+        return ResultSet(names, chk)
 
     def _const_of(self, node) -> Constant:
         if isinstance(node, ast.Lit):
@@ -653,6 +675,11 @@ class Session:
         return self._add_index(stmt.table, stmt.index)
 
     def _add_index(self, tn: ast.TableName, idef: ast.IndexDef) -> ResultSet:
+        """Online ADD INDEX through the F1 state machine (ref:
+        ddl/index.go onCreateIndex): the index is registered in state
+        'none', a DDL job is enqueued, and the worker drives
+        delete_only→write_only→write_reorg→public with a resumable
+        backfill. This session waits for completion (doDDLJob loop)."""
         db = tn.db or self.current_db
         txn = self._ddl_txn()
         m = Meta(txn)
@@ -662,29 +689,16 @@ class Session:
             txn.rollback()
             raise TiDBError(f"duplicate key name {idef.name!r}")
         offs = [t.col_by_name(c).offset for c in idef.columns]
-        idx = IndexInfo(m.alloc_id(), idef.name, offs, idef.unique, idef.primary)
+        idx = IndexInfo(m.alloc_id(), idef.name, offs, idef.unique, idef.primary, state="none")
         t.indexes.append(idx)
         m.put_table(t)
         m.bump_schema_version()
         txn.commit()
-        self._backfill_index(t, idx)
+        jid = self.store.ddl.enqueue(
+            "add_index", info.id, {"index_id": idx.id, "index_name": idx.name}
+        )
+        self.store.ddl.run_until_done(jid)
         return ResultSet([], None)
-
-    def _backfill_index(self, info: TableInfo, idx: IndexInfo):
-        """Synchronous backfill (online state machine lands in ddl module;
-        ref: ddl/backfilling.go:546)."""
-        tbl = Table(info)
-        txn = self.store.begin()
-        prefix = tablecodec.record_prefix(info.id)
-        for k, v in txn.scan(prefix, prefix + b"\xff"):
-            handle = tablecodec.decode_record_handle(k)
-            datums = tbl.decode_record(v)
-            key, val, distinct = tbl.index_value_key(idx, datums, handle)
-            if distinct and txn.get(key) not in (None, val):
-                txn.rollback()
-                raise DuplicateEntry(f"Duplicate entry for key {idx.name!r}")
-            txn.put(key, val)
-        txn.commit()
 
     def _ddl_drop_index(self, stmt: ast.DropIndex) -> ResultSet:
         db = stmt.table.db or self.current_db
@@ -693,17 +707,13 @@ class Session:
         m = Meta(txn)
         t = m.table(info.id)
         idx = t.index_by_name(stmt.name)
+        txn.rollback()
         if idx is None:
-            txn.rollback()
             raise TiDBError(f"index {stmt.name!r} doesn't exist")
-        t.indexes.remove(idx)
-        m.put_table(t)
-        m.bump_schema_version()
-        txn.commit()
-        self.store.mvcc.unsafe_destroy_range(
-            tablecodec.index_prefix(info.id, idx.id),
-            tablecodec.index_prefix(info.id, idx.id + 1),
+        jid = self.store.ddl.enqueue(
+            "drop_index", info.id, {"index_id": idx.id, "index_name": idx.name}
         )
+        self.store.ddl.run_until_done(jid)
         return ResultSet([], None)
 
     def _ddl_alter(self, stmt: ast.AlterTable) -> ResultSet:

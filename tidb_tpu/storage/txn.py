@@ -198,6 +198,15 @@ class Storage:
         self._stats = None
 
     @property
+    def ddl(self):
+        """Shared online-DDL worker (the owner seam: one per store)."""
+        if getattr(self, "_ddl", None) is None:
+            from ..ddl.worker import DDLWorker
+
+            self._ddl = DDLWorker(self)
+        return self._ddl
+
+    @property
     def stats(self):
         """Shared stats handle (ref: statistics/handle — hangs off Storage
         so all sessions over this store see one stats view)."""

@@ -89,10 +89,13 @@ class Table:
         for idx in info.indexes:
             if info.pk_is_handle and idx.primary:
                 continue  # clustered: the record key IS the pk index
-            if idx.state == "delete_only":
+            if idx.state in ("none", "delete_only"):
                 continue  # online DDL: index not yet writable
             key, val, distinct = self.index_value_key(idx, full, handle)
-            if distinct and check_dup and idx.state == "public":
+            # unique check applies in EVERY writable state: during
+            # write_only/write_reorg a silent overwrite would corrupt the
+            # entry backfill already wrote (F1 dual-write invariant)
+            if distinct and check_dup:
                 existing = txn.get(key)
                 if existing is not None and existing != val:
                     raise DuplicateEntry(f"Duplicate entry for key '{idx.name}'")
@@ -105,6 +108,8 @@ class Table:
         for idx in self.info.indexes:
             if self.info.pk_is_handle and idx.primary:
                 continue
+            if idx.state == "none":
+                continue  # no entries can exist yet
             key, _, _ = self.index_value_key(idx, full, handle)
             txn.delete(key)
 
