@@ -189,7 +189,15 @@ class DDLWorker:
         if last_handle is not None:
             job.reorg_handle = last_handle
             m.put_job(job)
-        txn.commit()
+        from ..errors import RetryableError, WriteConflict
+
+        try:
+            txn.commit()
+        except (WriteConflict, RetryableError):
+            # concurrent DML dual-wrote a key this batch staged: the batch
+            # simply re-runs from the unchanged checkpoint (ref: reorg txn
+            # retry in backfilling.go)
+            return False
         if last_handle is not None:
             self._fire("backfill_batch", job)
         return len(rows) < BACKFILL_BATCH

@@ -54,6 +54,10 @@ class LockedError(TiDBError):
         self.lock = lock
 
 
+class DeadlockError(TiDBError):
+    """Pessimistic lock wait closed a cycle (MySQL ER_LOCK_DEADLOCK)."""
+
+
 class RetryableError(TiDBError):
     code = 9009
 

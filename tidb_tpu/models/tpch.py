@@ -160,7 +160,7 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
     kind_list = [kinds[n_] for n_ in names]
     commit_ts = session.store.tso.next()
     scale_fix = [max(c.ft.decimal, 0) if k == K_DEC else 0 for c, k in zip(col_infos, kind_list)]
-    indexes = [ix for ix in info.indexes if ix.state != "delete_only" and not (info.pk_is_handle and ix.primary)]
+    indexes = [ix for ix in info.indexes if ix.state not in ("none", "delete_only") and not (info.pk_is_handle and ix.primary)]
 
     if rowfast.encodable_kinds(kind_list):
         name_pos = {c.offset: i for i, c in enumerate(col_infos)}

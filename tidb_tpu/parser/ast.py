@@ -293,7 +293,7 @@ class UseDB:
 
 @dataclass
 class Begin:
-    pass
+    mode: str = ""  # '' (session default) | 'pessimistic' | 'optimistic'
 
 
 @dataclass

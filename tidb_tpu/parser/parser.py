@@ -1090,7 +1090,12 @@ class Parser:
             self.expect_kw("TRANSACTION")
         else:
             self.next()
-        return ast.Begin()
+        mode = ""
+        if self.try_kw("PESSIMISTIC"):
+            mode = "pessimistic"
+        elif self.try_kw("OPTIMISTIC"):
+            mode = "optimistic"
+        return ast.Begin(mode)
 
     def set_stmt(self):
         self.expect_kw("SET")

@@ -36,7 +36,7 @@ from ..parser import ast, parse_one
 from ..planner.builder import NameScope, PlanBuilder, lit_to_constant
 from ..planner.optimizer import optimize
 from ..planner.plans import DataSource, Selection
-from ..storage.txn import Storage, Txn
+from ..storage.txn import Storage, TOMBSTONE, Txn
 from ..table.table import Table
 from .vars import DEFAULT_VARS
 
@@ -103,9 +103,13 @@ class Session:
 
     # ------------------------------------------------------------------- txn
 
+    def _txn_mode_pessimistic(self, stmt_mode: str = "") -> bool:
+        mode = stmt_mode or self.vars.get("tidb_txn_mode", "optimistic")
+        return mode == "pessimistic"
+
     def _active_txn(self) -> Txn:
         if self.txn is None:
-            self.txn = self.store.begin()
+            self.txn = self.store.begin(pessimistic=self._txn_mode_pessimistic())
         return self.txn
 
     def _note_delta(self, table_id: int, changed: int, delta_rows: int) -> None:
@@ -200,7 +204,7 @@ class Session:
             if self.txn is not None:
                 self.txn.commit()
                 self._flush_deltas()
-            self.txn = self.store.begin()
+            self.txn = self.store.begin(pessimistic=self._txn_mode_pessimistic(stmt.mode))
             self.in_explicit_txn = True
             return ResultSet([], None)
         if isinstance(stmt, ast.Commit):
@@ -404,6 +408,9 @@ class Session:
         if info.pk_is_handle:
             pk = next(i for i in info.indexes if i.primary)
             handle = datums[pk.col_offsets[0]].to_int()
+            if txn.pessimistic:
+                # serialize racing inserts of the same pk (current read)
+                txn.lock_keys_for_update([tbl.record_key(handle)])
         else:
             handle = self.alloc_auto_id(info, 1)
         for c in info.visible_columns():
@@ -426,12 +433,23 @@ class Session:
         tbl.add_record(txn, datums, handle)
         return 1
 
+    def _read_for_write(self, txn, key: bytes):
+        """Existence read for write-conflict checks: pessimistic txns must
+        see the LATEST committed value (current read at for_update_ts),
+        not their start_ts snapshot; the membuffer always wins."""
+        if key in txn.membuf:
+            v = txn.membuf[key]
+            return None if v == TOMBSTONE else v
+        if txn.pessimistic:
+            return self.store.snapshot(txn.for_update_ts).get(key)
+        return txn.snapshot.get(key)
+
     def _conflicting_handles(self, tbl: Table, txn, datums, handle: int) -> list[int]:
         """Handles of existing rows this insert collides with (pk + every
         public unique index)."""
         info = tbl.info
         out = []
-        if info.pk_is_handle and txn.get(tbl.record_key(handle)) is not None:
+        if info.pk_is_handle and self._read_for_write(txn, tbl.record_key(handle)) is not None:
             out.append(handle)
         full = tbl.row_datums_with_hidden(datums, handle)
         for idx in info.indexes:
@@ -440,7 +458,7 @@ class Session:
             key, _, distinct = tbl.index_value_key(idx, full, None)
             if not distinct:
                 continue  # NULL-bearing unique keys never conflict
-            existing = txn.get(key)
+            existing = self._read_for_write(txn, key)
             if existing:
                 h = int(existing)
                 if h not in out:
@@ -460,7 +478,13 @@ class Session:
         tbl = Table(info)
         txn = self._active_txn()
         prefix = tablecodec.record_prefix(info.id)
-        kvs = txn.scan(prefix, prefix + b"\xff")
+        if txn.pessimistic:
+            # pessimistic DML scans with a CURRENT read (fresh
+            # for_update_ts) so rows that started matching after start_ts
+            # are found and locked, not just re-filtered
+            kvs = txn.scan_current(prefix, prefix + b"\xff")
+        else:
+            kvs = txn.scan(prefix, prefix + b"\xff")
         rows = []
         builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
         cond = None
@@ -473,16 +497,43 @@ class Session:
 
             scope = NameScope([PlanCol(c.name, c.ft, stmt_table.alias or info.name) for c in info.visible_columns()])
             cond = builder.to_expr(where, scope)
+        def matches(datums) -> bool:
+            if cond is None:
+                return True
+            visible = [datums[c.offset] for c in info.visible_columns()]
+            chunk = Chunk.from_datum_rows([c.ft for c in info.visible_columns()], [visible])
+            d, valid = cond.eval(chunk)
+            return bool(valid[0] and d[0] != 0)
+
         for k, v in kvs:
             handle = tablecodec.decode_record_handle(k)
             datums = tbl.decode_record(v)
-            if cond is not None:
-                visible = [datums[c.offset] for c in info.visible_columns()]
-                chunk = Chunk.from_datum_rows([c.ft for c in info.visible_columns()], [visible])
-                d, valid = cond.eval(chunk)
-                if not (valid[0] and d[0] != 0):
-                    continue
-            rows.append((handle, datums))
+            if matches(datums):
+                rows.append((handle, datums))
+
+        if txn.pessimistic and rows:
+            # pessimistic "current read" (ref: executor/adapter.go:588
+            # handlePessimisticDML + client-go for_update_ts): lock the
+            # matched rows, then recompute from the LATEST committed values
+            # so concurrent committed updates are not lost
+            keys = [tbl.record_key(h) for h, _ in rows]
+            txn.lock_keys_for_update(keys)
+            snap = self.store.snapshot(txn.for_update_ts)
+            fresh = snap.batch_get([k for k in keys if k not in txn.membuf])
+            cur_rows = []
+            for (h, _), k in zip(rows, keys):
+                if k in txn.membuf:
+                    v = txn.membuf[k]
+                    if v == TOMBSTONE:
+                        continue
+                else:
+                    v = fresh.get(k)
+                    if v is None:
+                        continue  # deleted underneath us
+                datums = tbl.decode_record(v)
+                if matches(datums):  # re-filter on current values
+                    cur_rows.append((h, datums))
+            rows = cur_rows
         return info, tbl, txn, rows
 
     def _run_update(self, stmt: ast.Update) -> ResultSet:

@@ -1,0 +1,39 @@
+"""First-waiter deadlock detector for pessimistic locks
+(ref: store/mockstore/unistore/tikv/detector.go).
+
+Each transaction waits on at most one holder at a time (the first lock it
+blocks on), so the wait-for graph is a function txn → txn and cycle
+detection is a pointer chase. The LATER waiter — the one whose edge
+closes the cycle — gets the DeadlockError, matching the reference's
+first-waiter victim policy.
+"""
+
+from __future__ import annotations
+
+from threading import Lock
+
+from ..errors import DeadlockError
+
+
+class DeadlockDetector:
+    def __init__(self):
+        self._lock = Lock()
+        self._wait_for: dict[int, int] = {}  # waiter start_ts → holder start_ts
+
+    def register(self, waiter: int, holder: int) -> None:
+        """Record waiter→holder; raises DeadlockError if it closes a cycle."""
+        with self._lock:
+            cur = holder
+            for _ in range(len(self._wait_for) + 1):
+                if cur == waiter:
+                    raise DeadlockError(
+                        f"Deadlock found when trying to get lock: txn {waiter} waits for {holder}"
+                    )
+                cur = self._wait_for.get(cur)
+                if cur is None:
+                    break
+            self._wait_for[waiter] = holder
+
+    def done(self, waiter: int) -> None:
+        with self._lock:
+            self._wait_for.pop(waiter, None)

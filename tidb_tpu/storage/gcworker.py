@@ -1,0 +1,40 @@
+"""MVCC garbage collection orchestrator
+(ref: store/gcworker/gc_worker.go:63 — leader-elected worker; :397
+safepoint = now - gc_life_time; :616 runGCJob resolve-locks + delete
+ranges + version compaction).
+
+Single-process: leadership collapses to the worker instance on Storage.
+The physical version compaction itself lives in MVCCStore.gc; this layer
+owns the safepoint policy and bookkeeping.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .tso import TSO
+
+
+class GCWorker:
+    def __init__(self, storage, life_ms: int = 10 * 60 * 1000):
+        self.storage = storage
+        self.life_ms = life_ms  # tidb_gc_life_time analog
+        self.last_safe_point = 0
+        self.runs = 0
+        self.removed_total = 0
+
+    def compute_safe_point(self, now_ms: int | None = None) -> int:
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        return max(0, now_ms - self.life_ms) << TSO.LOGICAL_BITS
+
+    def tick(self, now_ms: int | None = None) -> int:
+        """One GC round; returns versions removed. Skips when the
+        safepoint hasn't advanced (gc_worker leaderTick behavior)."""
+        sp = self.compute_safe_point(now_ms)
+        if sp <= self.last_safe_point:
+            return 0
+        self.last_safe_point = sp
+        self.runs += 1
+        removed = self.storage.mvcc.gc(sp)
+        self.removed_total += removed
+        return removed

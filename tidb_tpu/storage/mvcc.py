@@ -25,6 +25,7 @@ OP_PUT = 0
 OP_DEL = 1
 OP_ROLLBACK = 2
 OP_LOCK = 3  # lock-only record (SELECT FOR UPDATE)
+OP_PESSIMISTIC = 4  # pessimistic lock, no staged data (ref: tikv LockType::Pessimistic)
 
 _MAX = 0xFFFFFFFFFFFFFFFF
 
@@ -101,7 +102,9 @@ class MVCCStore:
     """
 
     def __init__(self, kv: MemKV | None = None):
-        self.kv = kv or MemKV()
+        # NOT `kv or MemKV()`: an empty MemKV is falsy (__len__ == 0) and
+        # would silently orphan the caller's store
+        self.kv = kv if kv is not None else MemKV()
         self.runs: list = []  # Run segments, ascending commit_ts
         # data-version counters per table-prefix space are maintained above
         # (storage.Storage) — the MVCC layer stays schema-agnostic.
@@ -113,8 +116,8 @@ class MVCCStore:
         if raw is None:
             return
         lock = Lock.decode(raw)
-        if lock.op == OP_LOCK:
-            return  # lock-only records don't block reads
+        if lock.op in (OP_LOCK, OP_PESSIMISTIC):
+            return  # lock-only / pessimistic locks stage no data: reads pass
         if lock.start_ts <= read_ts:
             raise LockedError(f"key is locked by txn {lock.start_ts}", key=key, lock=lock)
 
@@ -201,7 +204,7 @@ class MVCCStore:
         hi = _lk(end) if end is not None else b"m"
         for k, raw in self.kv.scan(_lk(start), hi):
             lock = Lock.decode(raw)
-            if lock.op != OP_LOCK and lock.start_ts <= read_ts:
+            if lock.op not in (OP_LOCK, OP_PESSIMISTIC) and lock.start_ts <= read_ts:
                 raise LockedError("range contains locked key", key=k[1:], lock=lock)
 
     def scan_segments(self, start: bytes, end: bytes | None, read_ts: int):
@@ -285,7 +288,12 @@ class MVCCStore:
                     lock = Lock.decode(raw)
                     if lock.start_ts != start_ts:
                         raise LockedError(f"key locked by {lock.start_ts}", key=m.key, lock=lock)
-                    continue  # idempotent re-prewrite
+                    # our own lock: pessimistic→prewrite conversion (or an
+                    # idempotent re-prewrite) replaces it and stages data
+                    self.kv.put(_lk(m.key), Lock(m.op, primary, start_ts, ttl_ms, for_update_ts).encode())
+                    if m.op == OP_PUT:
+                        self.kv.put(_dk(m.key, start_ts), m.value)
+                    continue
                 # write-conflict check: any commit newer than our snapshot?
                 for k, v in self.kv.iter_from(b"w" + m.key):
                     if not k.startswith(b"w" + m.key) or len(k) != 1 + len(m.key) + 8:
@@ -294,14 +302,63 @@ class MVCCStore:
                     rec = WriteRecord.decode(v)
                     if rec.op == OP_ROLLBACK and rec.start_ts == start_ts:
                         raise TxnAborted(f"txn {start_ts} already rolled back")
-                    if committed > start_ts and rec.op in (OP_PUT, OP_DEL) and for_update_ts == 0:
+                    # keys the txn pessimistically locked never reach here
+                    # (the own-lock branch above handles them). Unlocked
+                    # keys ARE conflict-checked even in pessimistic txns —
+                    # against the current-read horizon for_update_ts (TiKV
+                    # constraint-check semantics), start_ts for optimistic.
+                    if committed > max(start_ts, for_update_ts) and rec.op in (OP_PUT, OP_DEL):
                         raise WriteConflict(f"conflict at {committed} > start {start_ts}")
                     break
-                if self.runs and for_update_ts == 0 and self._run_newest_commit(m.key) > start_ts:
+                if self.runs and self._run_newest_commit(m.key) > max(start_ts, for_update_ts):
                     raise WriteConflict(f"ingest-run conflict newer than start {start_ts}")
                 self.kv.put(_lk(m.key), Lock(m.op, primary, start_ts, ttl_ms, for_update_ts).encode())
                 if m.op == OP_PUT:
                     self.kv.put(_dk(m.key, start_ts), m.value)
+
+    def _newest_commit_ts(self, key: bytes) -> int:
+        """Newest PUT/DEL commit ts for a key across both planes."""
+        newest = 0
+        for k, v in self.kv.iter_from(b"w" + key):
+            if not k.startswith(b"w" + key) or len(k) != 1 + len(key) + 8:
+                break
+            rec = WriteRecord.decode(v)
+            if rec.op in (OP_PUT, OP_DEL):
+                newest = unrev_ts(k[-8:])
+                break
+        if self.runs:
+            newest = max(newest, self._run_newest_commit(key))
+        return newest
+
+    def acquire_pessimistic_lock(
+        self, keys: list[bytes], primary: bytes, start_ts: int, for_update_ts: int, ttl_ms: int = 3000
+    ) -> None:
+        """Lock keys at DML time without staging data (ref: unistore
+        tikv/server.go:192 KvPessimisticLock). Raises LockedError when a
+        key is held by another txn and WriteConflict when a commit newer
+        than for_update_ts exists (caller retries with a fresh ts)."""
+        with self.kv.lock:
+            for key in keys:
+                raw = self.kv.get(_lk(key))
+                if raw is not None:
+                    lock = Lock.decode(raw)
+                    if lock.start_ts != start_ts:
+                        raise LockedError(f"key locked by {lock.start_ts}", key=key, lock=lock)
+                if self._newest_commit_ts(key) > for_update_ts:
+                    raise WriteConflict(f"pessimistic lock sees commit newer than {for_update_ts}")
+            for key in keys:
+                self.kv.put(_lk(key), Lock(OP_PESSIMISTIC, primary, start_ts, ttl_ms, for_update_ts).encode())
+
+    def pessimistic_rollback(self, keys: list[bytes], start_ts: int) -> None:
+        """Release pessimistic locks without aborting the txn (no rollback
+        tombstone — the txn may still prewrite later)."""
+        with self.kv.lock:
+            for key in keys:
+                raw = self.kv.get(_lk(key))
+                if raw is not None:
+                    lock = Lock.decode(raw)
+                    if lock.start_ts == start_ts and lock.op == OP_PESSIMISTIC:
+                        self.kv.delete(_lk(key))
 
     def commit(self, keys: list[bytes], start_ts: int, commit_ts: int):
         with self.kv.lock:

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import time
 
-from ..errors import LockedError, RetryableError, TxnAborted, WriteConflict
+from ..errors import DeadlockError, LockedError, RetryableError, TxnAborted, WriteConflict
 from .memkv import MemKV
 from .mvcc import MVCCStore, Mutation, OP_DEL, OP_LOCK, OP_PUT
 from .regions import RegionMap
@@ -56,9 +56,13 @@ class Snapshot:
 
 
 class Txn:
-    """Buffered optimistic transaction (pessimistic locks layer on later)."""
+    """Buffered transaction: optimistic by default; with pessimistic=True,
+    DML acquires pessimistic locks at statement time via lock_keys_for_update
+    (ref: client-go pessimistic txns + unistore KvPessimisticLock)."""
 
-    def __init__(self, store: "Storage", start_ts: int):
+    LOCK_WAIT_S = 3.0  # innodb_lock_wait_timeout analog (shortened)
+
+    def __init__(self, store: "Storage", start_ts: int, pessimistic: bool = False):
         self.store = store
         self.start_ts = start_ts
         self.membuf: dict[bytes, bytes] = {}  # TOMBSTONE value = delete
@@ -66,6 +70,51 @@ class Txn:
         self.committed = False
         self.commit_ts = 0
         self._locked_keys: set[bytes] = set()
+        self.pessimistic = pessimistic
+        self.for_update_ts = start_ts
+        self._pess_keys: set[bytes] = set()
+        self._pess_primary: bytes | None = None
+
+    def lock_keys_for_update(self, keys) -> None:
+        """Pessimistic DML lock acquisition with deadlock detection and a
+        lock-wait timeout; optimistic txns record the keys for commit-time
+        locking (SELECT FOR UPDATE semantics)."""
+        keys = sorted(set(keys) - self._pess_keys)
+        if not keys:
+            return
+        if not self.pessimistic:
+            self._locked_keys.update(keys)
+            return
+        mvcc = self.store.mvcc
+        if self._pess_primary is None:
+            self._pess_primary = keys[0]
+        deadline = time.time() + self.LOCK_WAIT_S
+        backoff = 0.002
+        while True:
+            self.for_update_ts = self.store.tso.next()
+            try:
+                mvcc.acquire_pessimistic_lock(keys, self._pess_primary, self.start_ts, self.for_update_ts)
+                self.store.detector.done(self.start_ts)
+                self._pess_keys.update(keys)
+                self._locked_keys.update(keys)
+                return
+            except LockedError as e:
+                try:
+                    # raises DeadlockError when this edge closes a cycle
+                    self.store.detector.register(self.start_ts, e.lock.start_ts)
+                except DeadlockError:
+                    self.store.detector.done(self.start_ts)
+                    raise
+                now_ms = int(time.time() * 1000)
+                if not mvcc.resolve_lock(e.key, e.lock, now_ms):
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 0.05)
+                if time.time() > deadline:
+                    self.store.detector.done(self.start_ts)
+                    raise RetryableError("pessimistic lock wait timeout")
+            except WriteConflict:
+                # a commit landed after our for_update_ts: take a fresh one
+                continue
 
     # --- reads see own writes ---------------------------------------------
 
@@ -90,12 +139,22 @@ class Txn:
     def scan(self, start: bytes, end: bytes, limit: int | None = None):
         """Merge membuffer over snapshot (the UnionScan semantic,
         ref: executor/union_scan.go)."""
+        return self._scan_with(self.snapshot, start, end, limit)
+
+    def scan_current(self, start: bytes, end: bytes, limit: int | None = None):
+        """Pessimistic current read: scan at a FRESH for_update_ts so
+        commits after start_ts are visible (ref: client-go for_update_ts
+        statement reads), still merged under the membuffer."""
+        self.for_update_ts = self.store.tso.next()
+        return self._scan_with(Snapshot(self.store, self.for_update_ts), start, end, limit)
+
+    def _scan_with(self, snapshot: Snapshot, start: bytes, end: bytes, limit: int | None):
         dirty = sorted(
             (k, v) for k, v in self.membuf.items() if start <= k and (not end or k < end)
         )
         # deletes can shrink the snapshot below the limit: fetch unlimited
         # when dirty keys overlap, then clip after the merge
-        snap = self.snapshot.scan(start, end, None if dirty else limit)
+        snap = snapshot.scan(start, end, None if dirty else limit)
         if not dirty:
             return snap
         merged: dict[bytes, bytes] = dict(snap)
@@ -144,11 +203,16 @@ class Txn:
         primary = muts[0].key
         mvcc = self.store.mvcc
 
+        if self.pessimistic and self._pess_primary is not None:
+            # keys were locked under this primary; keep resolve paths valid
+            primary = self._pess_primary
+
         # phase 1: prewrite with lock-resolution retry
         backoff = 0.002
+        fut = self.for_update_ts if self.pessimistic else 0
         for attempt in range(12):
             try:
-                mvcc.prewrite(muts, primary, self.start_ts, ttl_ms=3000)
+                mvcc.prewrite(muts, primary, self.start_ts, ttl_ms=3000, for_update_ts=fut)
                 break
             except LockedError as e:
                 now_ms = int(time.time() * 1000)
@@ -174,6 +238,10 @@ class Txn:
         return self.commit_ts
 
     def rollback(self) -> None:
+        if self._pess_keys:
+            self.store.mvcc.pessimistic_rollback(sorted(self._pess_keys), self.start_ts)
+            self._pess_keys.clear()
+        self.store.detector.done(self.start_ts)
         self.membuf.clear()
         self._locked_keys.clear()
         self.committed = True
@@ -192,6 +260,15 @@ class Storage:
         # unistore cluster.go region management + executor/split.go)
         self.region_split_size = 1 << 19
         self.mvcc.split_hook = self._auto_split_run
+        # pessimistic-lock wait-for graph (ref: unistore tikv/detector.go)
+        from .detector import DeadlockDetector
+
+        self.detector = DeadlockDetector()
+        self._gc_worker = None
+        # eager: racing lazy-inits would defeat the worker's owner lock
+        from ..ddl.worker import DDLWorker
+
+        self._ddl = DDLWorker(self)
         # table-prefix data-version counters: the tile cache (TiFlash-
         # columnar-replica analog) invalidates on these.
         self._versions: dict[bytes, int] = {}
@@ -200,10 +277,6 @@ class Storage:
     @property
     def ddl(self):
         """Shared online-DDL worker (the owner seam: one per store)."""
-        if getattr(self, "_ddl", None) is None:
-            from ..ddl.worker import DDLWorker
-
-            self._ddl = DDLWorker(self)
         return self._ddl
 
     @property
@@ -216,8 +289,8 @@ class Storage:
             self._stats = StatsHandle(self)
         return self._stats
 
-    def begin(self) -> Txn:
-        return Txn(self, self.tso.next())
+    def begin(self, pessimistic: bool = False) -> Txn:
+        return Txn(self, self.tso.next(), pessimistic=pessimistic)
 
     def snapshot(self, read_ts: int | None = None) -> Snapshot:
         return Snapshot(self, read_ts if read_ts is not None else self.tso.next())
@@ -241,6 +314,14 @@ class Storage:
     def gc(self, safe_point: int | None = None) -> int:
         sp = safe_point if safe_point is not None else self.tso.current()
         return self.mvcc.gc(sp)
+
+    @property
+    def gc_worker(self):
+        if self._gc_worker is None:
+            from .gcworker import GCWorker
+
+            self._gc_worker = GCWorker(self)
+        return self._gc_worker
 
     def _auto_split_run(self, run) -> None:
         """Split regions at every region_split_size-th key of a freshly
