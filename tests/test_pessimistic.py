@@ -51,6 +51,9 @@ class TestPessimisticDML:
         errors = []
 
         def transfer(src, dst, amt):
+            import random
+
+            rng = random.Random(src * 31 + dst)
             sess = Session(s.store)
             try:
                 done = 0
@@ -62,9 +65,11 @@ class TestPessimisticDML:
                         sess.execute("COMMIT")
                         done += 1
                     except (DeadlockError, RetryableError):
-                        # the deadlock victim rolls back and retries — the
-                        # application-level contract MySQL documents
+                        # the deadlock victim rolls back, backs off with
+                        # jitter, and retries — the application contract
+                        # MySQL documents for ER_LOCK_DEADLOCK
                         sess.execute("ROLLBACK")
+                        time.sleep(rng.uniform(0.001, 0.02))
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 

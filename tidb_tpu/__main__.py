@@ -1,0 +1,49 @@
+"""Process entry — `python -m tidb_tpu` starts the MySQL-protocol server
+(ref: tidb-server/main.go:157 main, :505 setGlobalVars, :621 createServer;
+flags subset + graceful signal shutdown)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tidb-tpu-server", description="TPU-native TiDB-compatible SQL server")
+    ap.add_argument("--host", default="127.0.0.1", help="listen address")
+    ap.add_argument("-P", "--port", type=int, default=4000, help="listen port (0 = ephemeral)")
+    ap.add_argument("--log-level", default="info", choices=["debug", "info", "warn", "error"])
+    ap.add_argument("--gc-life-minutes", type=int, default=10, help="MVCC GC retention window")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level={"debug": logging.DEBUG, "info": logging.INFO, "warn": logging.WARNING, "error": logging.ERROR}[args.log_level],
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    from .server import Server
+
+    srv = Server(host=args.host, port=args.port)
+    srv.storage.gc_worker.life_ms = args.gc_life_minutes * 60 * 1000
+    port = srv.start()
+    print(f"tidb-tpu server listening on {args.host}:{port}", flush=True)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):  # noqa: ARG001
+        print("shutting down...", flush=True)
+        srv.close()
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    while not stop.is_set():
+        stop.wait(30)
+        srv.storage.gc_worker.tick()  # background GC loop (gc_worker leaderTick)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

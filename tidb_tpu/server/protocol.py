@@ -1,0 +1,215 @@
+"""MySQL client/server wire protocol — packet codec
+(ref: server/packetio.go, server/util.go dumpTextRow, server/column.go;
+protocol spec mirrored from the reference's implementation behavior).
+
+Covers the v10 handshake, CLIENT_PROTOCOL_41 status/err packets,
+length-encoded integers/strings, column definitions and text resultset
+rows — the surface a stock `mysql` CLI or connector needs for COM_QUERY.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..mysqltypes.field_type import FieldType, TypeCode
+
+PROTOCOL_VERSION = 10
+SERVER_VERSION = b"8.0.11-tidb-tpu"
+
+# capability flags (subset)
+CLIENT_LONG_PASSWORD = 0x1
+CLIENT_FOUND_ROWS = 0x2
+CLIENT_LONG_FLAG = 0x4
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+CLIENT_DEPRECATE_EOF = 0x1000000
+
+SERVER_CAPABILITIES = (
+    CLIENT_LONG_PASSWORD
+    | CLIENT_FOUND_ROWS
+    | CLIENT_LONG_FLAG
+    | CLIENT_CONNECT_WITH_DB
+    | CLIENT_PROTOCOL_41
+    | CLIENT_TRANSACTIONS
+    | CLIENT_SECURE_CONNECTION
+    | CLIENT_PLUGIN_AUTH
+)
+
+SERVER_STATUS_AUTOCOMMIT = 0x2
+
+# commands (ref: dispatch, server/conn.go:1112)
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+
+# MySQL column types
+MYSQL_TYPE = {
+    TypeCode.Tiny: 1,
+    TypeCode.Short: 2,
+    TypeCode.Long: 3,
+    TypeCode.Float: 4,
+    TypeCode.Double: 5,
+    TypeCode.Null: 6,
+    TypeCode.Timestamp: 7,
+    TypeCode.Longlong: 8,
+    TypeCode.Int24: 9,
+    TypeCode.Date: 10,
+    TypeCode.Duration: 11,
+    TypeCode.Datetime: 12,
+    TypeCode.Year: 13,
+    TypeCode.NewDecimal: 246,
+    TypeCode.Blob: 252,
+    TypeCode.Varchar: 253,
+    TypeCode.String: 254,
+}
+
+CHARSET_UTF8MB4 = 255
+
+
+def lenc_int(n: int) -> bytes:
+    if n < 0xFB:
+        return bytes([n])
+    if n < (1 << 16):
+        return b"\xfc" + struct.pack("<H", n)
+    if n < (1 << 24):
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def lenc_str(b: bytes) -> bytes:
+    return lenc_int(len(b)) + b
+
+
+def read_lenc_int(buf: bytes, pos: int) -> tuple[int, int]:
+    first = buf[pos]
+    if first < 0xFB:
+        return first, pos + 1
+    if first == 0xFC:
+        return struct.unpack_from("<H", buf, pos + 1)[0], pos + 3
+    if first == 0xFD:
+        return struct.unpack("<I", buf[pos + 1 : pos + 4] + b"\x00")[0], pos + 4
+    return struct.unpack_from("<Q", buf, pos + 1)[0], pos + 9
+
+
+class PacketIO:
+    """4-byte-header packet framing over a socket (ref: packetio.go)."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.seq = 0
+
+    def read_packet(self) -> bytes:
+        header = self._read_n(4)
+        length = header[0] | (header[1] << 8) | (header[2] << 16)
+        self.seq = (header[3] + 1) % 256
+        return self._read_n(length)
+
+    def _read_n(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("client closed connection")
+            out += chunk
+        return out
+
+    def write_packet(self, payload: bytes) -> None:
+        out = b""
+        while True:
+            chunk = payload[:0xFFFFFF]
+            payload = payload[0xFFFFFF:]
+            out += struct.pack("<I", len(chunk))[:3] + bytes([self.seq]) + chunk
+            self.seq = (self.seq + 1) % 256
+            if len(chunk) < 0xFFFFFF:
+                break  # a full-size chunk demands a (possibly empty) follow-up
+        self.sock.sendall(out)
+
+    def reset_seq(self) -> None:
+        self.seq = 0
+
+
+def handshake_v10(conn_id: int, salt: bytes) -> bytes:
+    """Initial handshake packet (ref: conn.go writeInitialHandshake)."""
+    out = bytes([PROTOCOL_VERSION]) + SERVER_VERSION + b"\x00"
+    out += struct.pack("<I", conn_id)
+    out += salt[:8] + b"\x00"
+    out += struct.pack("<H", SERVER_CAPABILITIES & 0xFFFF)
+    out += bytes([CHARSET_UTF8MB4])
+    out += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+    out += struct.pack("<H", (SERVER_CAPABILITIES >> 16) & 0xFFFF)
+    out += bytes([21])  # auth plugin data length
+    out += b"\x00" * 10
+    out += salt[8:20] + b"\x00"
+    out += b"mysql_native_password\x00"
+    return out
+
+
+def parse_handshake_response(payload: bytes) -> dict:
+    """Client handshake response 41 → {capabilities, user, db, auth}."""
+    caps = struct.unpack_from("<I", payload, 0)[0]
+    pos = 4 + 4 + 1 + 23  # caps, max packet, charset, reserved
+    end = payload.index(b"\x00", pos)
+    user = payload[pos:end].decode("utf8", "replace")
+    pos = end + 1
+    if caps & CLIENT_SECURE_CONNECTION:
+        alen = payload[pos]
+        auth = payload[pos + 1 : pos + 1 + alen]
+        pos += 1 + alen
+    else:
+        end = payload.index(b"\x00", pos)
+        auth = payload[pos:end]
+        pos = end + 1
+    db = ""
+    if caps & CLIENT_CONNECT_WITH_DB and pos < len(payload):
+        end = payload.find(b"\x00", pos)
+        if end != -1:
+            db = payload[pos:end].decode("utf8", "replace")
+    return {"capabilities": caps, "user": user, "db": db, "auth": auth}
+
+
+def ok_packet(affected: int = 0, last_insert_id: int = 0, status: int = SERVER_STATUS_AUTOCOMMIT, warnings: int = 0) -> bytes:
+    return b"\x00" + lenc_int(affected) + lenc_int(last_insert_id) + struct.pack("<HH", status, warnings)
+
+
+def eof_packet(status: int = SERVER_STATUS_AUTOCOMMIT, warnings: int = 0) -> bytes:
+    return b"\xfe" + struct.pack("<HH", warnings, status)
+
+
+def err_packet(errno: int, message: str, sqlstate: str = "HY000") -> bytes:
+    return b"\xff" + struct.pack("<H", errno) + b"#" + sqlstate.encode() + message.encode("utf8", "replace")
+
+
+def column_def(name: str, ft: FieldType) -> bytes:
+    """Column definition 41 (ref: server/column.go Dump)."""
+    mtype = MYSQL_TYPE.get(ft.tp, 253)
+    charset = CHARSET_UTF8MB4 if ft.is_string() else 63  # 63 = binary
+    flen = ft.flen if ft.flen > 0 else 255
+    out = lenc_str(b"def")  # catalog
+    out += lenc_str(b"")  # schema
+    out += lenc_str(b"")  # table
+    out += lenc_str(b"")  # org_table
+    out += lenc_str(name.encode("utf8", "replace"))
+    out += lenc_str(b"")  # org_name
+    out += bytes([0x0C])  # fixed fields length
+    out += struct.pack("<H", charset)
+    out += struct.pack("<I", flen)
+    out += bytes([mtype])
+    out += struct.pack("<H", 0)  # flags
+    out += bytes([max(ft.decimal, 0) if ft.decimal is not None and ft.decimal >= 0 else 0])
+    out += b"\x00\x00"
+    return out
+
+
+def text_row(values: list[str | None]) -> bytes:
+    out = b""
+    for v in values:
+        if v is None:
+            out += b"\xfb"
+        else:
+            out += lenc_str(v.encode("utf8", "replace"))
+    return out

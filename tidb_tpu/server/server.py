@@ -1,0 +1,178 @@
+"""MySQL-protocol server — the framework's front door
+(ref: server/server.go:322 Run, :452 onConn; server/conn.go:912
+clientConn.Run, :1112 dispatch, :1634 handleQuery).
+
+One OS thread per connection over a shared Storage; every connection
+owns a Session (catalog/vars/txn state). COM_QUERY results stream as
+text resultsets; KILL/graceful shutdown drain via the closing flag.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+
+from ..errors import TiDBError
+from ..session import Session
+from ..storage.txn import Storage
+from . import protocol as p
+
+log = logging.getLogger("tidb_tpu.server")
+
+
+class ClientConn:
+    def __init__(self, server: "Server", sock, conn_id: int):
+        self.server = server
+        self.pkt = p.PacketIO(sock)
+        self.conn_id = conn_id
+        self.session = Session(server.storage)
+        self.user = ""
+        self.alive = True
+
+    # --- lifecycle (ref: clientConn.Run) -----------------------------------
+
+    def handshake(self) -> None:
+        salt = os.urandom(20)
+        self.pkt.write_packet(p.handshake_v10(self.conn_id, salt))
+        resp = p.parse_handshake_response(self.pkt.read_packet())
+        self.user = resp["user"]
+        if resp["db"]:
+            self.session.current_db = resp["db"]
+        # auth seam: accept all users until the privilege cache lands
+        self.pkt.write_packet(p.ok_packet())
+
+    def run(self) -> None:
+        try:
+            self.handshake()
+            while self.alive and not self.server.closing:
+                self.pkt.reset_seq()
+                try:
+                    payload = self.pkt.read_packet()
+                except ConnectionError:
+                    return
+                self.dispatch(payload)
+        except Exception:  # noqa: BLE001 — connection thread must not leak exceptions
+            log.exception("connection %d aborted", self.conn_id)
+        finally:
+            self.server.deregister(self.conn_id)
+            try:
+                self.pkt.sock.close()
+            except OSError:
+                pass
+
+    # --- command dispatch (ref: conn.go:1112) ------------------------------
+
+    def dispatch(self, payload: bytes) -> None:
+        cmd, data = payload[0], payload[1:]
+        if cmd == p.COM_QUIT:
+            self.alive = False
+            return
+        if cmd == p.COM_PING:
+            self.pkt.write_packet(p.ok_packet())
+            return
+        if cmd == p.COM_INIT_DB:
+            return self.handle_query(f"USE `{data.decode('utf8', 'replace')}`")
+        if cmd == p.COM_QUERY:
+            return self.handle_query(data.decode("utf8", "replace"))
+        if cmd == p.COM_FIELD_LIST:
+            self.pkt.write_packet(p.eof_packet())
+            return
+        self.pkt.write_packet(p.err_packet(1047, f"unsupported command {cmd:#x}"))
+
+    def handle_query(self, sql: str) -> None:
+        """COM_QUERY → execute → OK or text resultset
+        (ref: conn.go:1634 handleQuery, writeChunks)."""
+        try:
+            rs = self.session.execute(sql)
+        except TiDBError as e:
+            self.pkt.write_packet(p.err_packet(1105, str(e)))
+            return
+        except Exception as e:  # noqa: BLE001 — surface as SQL error, keep conn
+            log.exception("query failed: %s", sql)
+            self.pkt.write_packet(p.err_packet(1105, f"internal error: {e}"))
+            return
+        if not rs.names:
+            self.pkt.write_packet(p.ok_packet(rs.affected, rs.last_insert_id))
+            return
+        fts = rs.chunk.field_types() if rs.chunk is not None else []
+        self.pkt.write_packet(p.lenc_int(len(rs.names)))
+        for name, ft in zip(rs.names, fts):
+            self.pkt.write_packet(p.column_def(name, ft))
+        self.pkt.write_packet(p.eof_packet())
+        for row in rs.rows():
+            self.pkt.write_packet(p.text_row(list(row)))
+        self.pkt.write_packet(p.eof_packet())
+
+
+class Server:
+    """Socket accept loop (ref: server/server.go Run/onConn)."""
+
+    def __init__(self, storage: Storage | None = None, host: str = "127.0.0.1", port: int = 4000):
+        self.storage = storage or Storage()
+        self.host = host
+        self.port = port
+        self.closing = False
+        self._sock: socket.socket | None = None
+        self._conns: dict[int, ClientConn] = {}
+        self._next_id = 1
+        self._lock = threading.Lock()
+
+    def start(self) -> int:
+        """Bind + spawn the accept loop; returns the bound port."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        threading.Thread(target=self._accept_loop, name="mysql-accept", daemon=True).start()
+        log.info("listening on %s:%d", self.host, self.port)
+        return self.port
+
+    def _accept_loop(self) -> None:
+        while not self.closing:
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed during shutdown
+            with self._lock:
+                cid = self._next_id
+                self._next_id += 1
+                conn = ClientConn(self, sock, cid)
+                self._conns[cid] = conn
+            threading.Thread(target=conn.run, name=f"conn-{cid}", daemon=True).start()
+
+    def deregister(self, conn_id: int) -> None:
+        with self._lock:
+            self._conns.pop(conn_id, None)
+
+    def kill(self, conn_id: int) -> bool:
+        """KILL <id> (ref: server.go:609 Kill)."""
+        with self._lock:
+            conn = self._conns.get(conn_id)
+        if conn is None:
+            return False
+        conn.alive = False
+        try:
+            conn.pkt.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        return True
+
+    def close(self) -> None:
+        """Graceful shutdown: stop accepting, drop connections
+        (ref: server.go:409 startShutdown)."""
+        self.closing = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            try:
+                c.pkt.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass

@@ -381,7 +381,7 @@ class Session:
         else:
             rows_sources = stmt.values
 
-        affected = 0
+        all_datums = []
         for vals in rows_sources:
             if len(vals) != len(target):
                 raise TiDBError("Column count doesn't match value count")
@@ -391,6 +391,11 @@ class Session:
                     datums[col.offset] = self._cast_datum(v, col.ft)
                 else:
                     datums[col.offset] = self._eval_insert_value(v, col)
+            all_datums.append(datums)
+        if txn.pessimistic and all_datums:
+            self._lock_insert_keys(tbl, txn, all_datums)
+        affected = 0
+        for datums in all_datums:
             affected += self._insert_row(tbl, txn, datums, stmt)
         self.cop.tiles.invalidate_table(info.id)
         self._note_delta(info.id, affected, affected)
@@ -408,9 +413,6 @@ class Session:
         if info.pk_is_handle:
             pk = next(i for i in info.indexes if i.primary)
             handle = datums[pk.col_offsets[0]].to_int()
-            if txn.pessimistic:
-                # serialize racing inserts of the same pk (current read)
-                txn.lock_keys_for_update([tbl.record_key(handle)])
         else:
             handle = self.alloc_auto_id(info, 1)
         for c in info.visible_columns():
@@ -432,6 +434,26 @@ class Session:
             raise DuplicateEntry(f"Duplicate entry in '{info.name}'")
         tbl.add_record(txn, datums, handle)
         return 1
+
+    def _lock_insert_keys(self, tbl: Table, txn, rows: list[list[Datum]]) -> None:
+        """Pessimistic INSERT locks, batched per statement: explicit-pk
+        record keys (racing same-pk inserts serialize) and public unique
+        index keys (racing same-unique-value inserts serialize) — one TSO
+        fetch + one acquisition round for the whole statement."""
+        info = tbl.info
+        pk = next((i for i in info.indexes if i.primary), None) if info.pk_is_handle else None
+        keys: list[bytes] = []
+        for datums in rows:
+            if pk is not None and not datums[pk.col_offsets[0]].is_null:
+                keys.append(tbl.record_key(datums[pk.col_offsets[0]].to_int()))
+            full = tbl.row_datums_with_hidden(datums, 0)
+            for idx in info.indexes:
+                if not idx.unique or (info.pk_is_handle and idx.primary) or idx.state != "public":
+                    continue
+                key, _, distinct = tbl.index_value_key(idx, full, None)
+                if distinct:
+                    keys.append(key)
+        txn.lock_keys_for_update(keys)
 
     def _read_for_write(self, txn, key: bytes):
         """Existence read for write-conflict checks: pessimistic txns must

@@ -279,11 +279,18 @@ class MVCCStore:
 
     # --- writes (percolator) ---------------------------------------------
 
-    def prewrite(self, muts: list[Mutation], primary: bytes, start_ts: int, ttl_ms: int = 3000, for_update_ts: int = 0):
-        """First phase: lock every key and stage values."""
+    def prewrite(self, muts: list[Mutation], primary: bytes, start_ts: int, ttl_ms: int = 3000, for_update_ts: int = 0, pess_keys=frozenset()):
+        """First phase: lock every key and stage values. Keys in
+        `pess_keys` were pessimistically locked by this txn: finding them
+        unlocked means a waiter resolved them away (TTL expiry) — the txn
+        must abort (TiKV's PessimisticLockNotFound)."""
         with self.kv.lock:
             for m in muts:
                 raw = self.kv.get(_lk(m.key))
+                if raw is None and m.key in pess_keys:
+                    raise TxnAborted(
+                        f"pessimistic lock on {m.key!r} was resolved away (txn {start_ts})"
+                    )
                 if raw is not None:
                     lock = Lock.decode(raw)
                     if lock.start_ts != start_ts:
@@ -407,7 +414,11 @@ class MVCCStore:
             if lock.start_ts == start_ts:
                 from .tso import TSO
 
-                if TSO.physical_ms(start_ts) + lock.ttl_ms < now_ms:
+                # TTL counts from the LAST acquisition (for_update_ts is
+                # refreshed per pessimistic lock round), so long-lived but
+                # active txns aren't rolled back by impatient waiters
+                base = max(start_ts, lock.for_update_ts)
+                if TSO.physical_ms(base) + lock.ttl_ms < now_ms:
                     self.rollback([primary], start_ts)
                     return "rolled_back", 0
                 return "locked", lock.ttl_ms

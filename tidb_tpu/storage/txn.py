@@ -86,15 +86,19 @@ class Txn:
             self._locked_keys.update(keys)
             return
         mvcc = self.store.mvcc
-        if self._pess_primary is None:
-            self._pess_primary = keys[0]
+        # the primary is only PINNED once an acquisition succeeds — a
+        # never-locked primary would read as rolled_back to waiters, who
+        # would then steal our live locks
+        primary = self._pess_primary if self._pess_primary is not None else keys[0]
         deadline = time.time() + self.LOCK_WAIT_S
         backoff = 0.002
         while True:
             self.for_update_ts = self.store.tso.next()
             try:
-                mvcc.acquire_pessimistic_lock(keys, self._pess_primary, self.start_ts, self.for_update_ts)
+                mvcc.acquire_pessimistic_lock(keys, primary, self.start_ts, self.for_update_ts)
                 self.store.detector.done(self.start_ts)
+                if self._pess_primary is None:
+                    self._pess_primary = primary
                 self._pess_keys.update(keys)
                 self._locked_keys.update(keys)
                 return
@@ -114,7 +118,12 @@ class Txn:
                     raise RetryableError("pessimistic lock wait timeout")
             except WriteConflict:
                 # a commit landed after our for_update_ts: take a fresh one
-                continue
+                # (bounded by the same lock-wait deadline)
+                if time.time() > deadline:
+                    self.store.detector.done(self.start_ts)
+                    raise RetryableError("pessimistic lock kept conflicting")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.05)
 
     # --- reads see own writes ---------------------------------------------
 
@@ -212,13 +221,20 @@ class Txn:
         fut = self.for_update_ts if self.pessimistic else 0
         for attempt in range(12):
             try:
-                mvcc.prewrite(muts, primary, self.start_ts, ttl_ms=3000, for_update_ts=fut)
+                mvcc.prewrite(
+                    muts, primary, self.start_ts, ttl_ms=3000, for_update_ts=fut,
+                    pess_keys=frozenset(self._pess_keys),
+                )
                 break
             except LockedError as e:
                 now_ms = int(time.time() * 1000)
                 if not mvcc.resolve_lock(e.key, e.lock, now_ms):
                     time.sleep(backoff)
                     backoff = min(backoff * 2, 0.1)
+            except (WriteConflict, TxnAborted):
+                # partially-prewritten locks must not linger for their TTL
+                mvcc.rollback([m.key for m in muts], self.start_ts)
+                raise
         else:
             mvcc.rollback([m.key for m in muts], self.start_ts)
             raise RetryableError("prewrite kept hitting live locks")
