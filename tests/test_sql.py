@@ -299,3 +299,33 @@ class TestSortedAgg:
         host = wide.must_query("SELECT k, COUNT(*) FROM w GROUP BY k ORDER BY k")
         assert host == tpu
         assert wide.cop.tpu.fallbacks == 0
+
+
+class TestExplainAnalyze:
+    """EXPLAIN ANALYZE runtime stats (ref: util/execdetails, explain.go)."""
+
+    def test_runtime_stats_present(self, lineitem):
+        rows = lineitem.must_query(
+            "EXPLAIN ANALYZE SELECT l_returnflag, SUM(l_quantity) FROM lineitem "
+            "WHERE l_discount > 0.01 GROUP BY l_returnflag"
+        )
+        text = "\n".join(r[0] for r in rows)
+        assert "rows:" in text and "time:" in text and "loops:" in text
+        assert "cop: tasks:" in text
+        assert "FinalHashAggExec" in text and "TableReaderExec" in text
+        assert "total:" in text
+
+    def test_reader_row_counts(self, lineitem):
+        rows = lineitem.must_query("EXPLAIN ANALYZE SELECT * FROM lineitem")
+        text = "\n".join(r[0] for r in rows)
+        # the reader surfaces all 6 rows
+        assert "TableReaderExec rows:6" in text
+
+    def test_join_tree_rendered(self, lineitem):
+        # string join keys are MPP-ineligible → host hash join shape
+        rows = lineitem.must_query(
+            "EXPLAIN ANALYZE SELECT a.l_orderkey FROM lineitem a JOIN lineitem b ON a.l_returnflag = b.l_returnflag"
+        )
+        text = "\n".join(r[0] for r in rows)
+        assert "HashJoinExec" in text
+        assert text.count("TableReaderExec") == 2

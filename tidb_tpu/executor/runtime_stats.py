@@ -1,0 +1,85 @@
+"""Per-operator runtime statistics for EXPLAIN ANALYZE
+(ref: util/execdetails/execdetails.go:34 ExecDetails; the reference
+collects per-executor rows/loops/time in the guarded Next wrapper,
+executor/executor.go:268, and merges cop-task summaries at
+distsql/select_result.go:341).
+
+Stats attach by wrapping the built executor tree's bound `next` methods —
+no class-identity changes, so plan-shape decisions (which use isinstance
+on executors) are unaffected. Parent times are cumulative over children,
+matching the reference's presentation.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .executors import Executor
+
+
+def child_execs(e: Executor) -> list[Executor]:
+    out = []
+    for attr in ("child", "left", "right", "outer"):
+        c = getattr(e, attr, None)
+        if isinstance(c, Executor):
+            out.append(c)
+    cs = getattr(e, "children", None)
+    if isinstance(cs, (list, tuple)):
+        out.extend(c for c in cs if isinstance(c, Executor))
+    return out
+
+
+def attach_runtime_stats(root: Executor) -> dict[int, dict]:
+    """Instrument every node's next(); returns {id(executor): stats}."""
+    stats: dict[int, dict] = {}
+
+    def wrap(e: Executor) -> None:
+        st = {"rows": 0, "loops": 0, "time_ns": 0}
+        stats[id(e)] = st
+        orig_next = e.next
+
+        def timed_next():
+            t0 = time.perf_counter_ns()
+            c = orig_next()
+            st["time_ns"] += time.perf_counter_ns() - t0
+            st["loops"] += 1
+            if c is not None:
+                st["rows"] += c.num_rows
+            return c
+
+        e.next = timed_next
+        for ch in child_execs(e):
+            wrap(ch)
+
+    wrap(root)
+    return stats
+
+
+def render_tree(root: Executor, stats: dict[int, dict]) -> list[str]:
+    lines: list[str] = []
+
+    def rec(e: Executor, depth: int) -> None:
+        st = stats.get(id(e), {"rows": 0, "loops": 0, "time_ns": 0})
+        extra = ""
+        dag = getattr(e, "dag", None)
+        if dag is not None:
+            parts = []
+            if dag.selection:
+                parts.append("sel")
+            if dag.agg:
+                parts.append("agg")
+            if dag.topn:
+                parts.append("topn")
+            if dag.limit:
+                parts.append("limit")
+            if parts:
+                extra = f" cop:[{'+'.join(parts)}]"
+        lines.append(
+            f"{'  ' * depth}{type(e).__name__}{extra} "
+            f"rows:{st['rows']} loops:{st['loops']} time:{st['time_ns'] / 1e6:.3f}ms"
+        )
+        for ch in child_execs(e):
+            rec(ch, depth + 1)
+
+    rec(root, 0)
+    return lines

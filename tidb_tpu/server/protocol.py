@@ -38,6 +38,7 @@ SERVER_CAPABILITIES = (
     | CLIENT_PLUGIN_AUTH
 )
 
+SERVER_STATUS_IN_TRANS = 0x1
 SERVER_STATUS_AUTOCOMMIT = 0x2
 
 # commands (ref: dispatch, server/conn.go:1112)
@@ -104,10 +105,14 @@ class PacketIO:
         self.seq = 0
 
     def read_packet(self) -> bytes:
-        header = self._read_n(4)
-        length = header[0] | (header[1] << 8) | (header[2] << 16)
-        self.seq = (header[3] + 1) % 256
-        return self._read_n(length)
+        out = b""
+        while True:
+            header = self._read_n(4)
+            length = header[0] | (header[1] << 8) | (header[2] << 16)
+            self.seq = (header[3] + 1) % 256
+            out += self._read_n(length)
+            if length < 0xFFFFFF:
+                return out  # a full-size frame implies a continuation
 
     def _read_n(self, n: int) -> bytes:
         out = b""

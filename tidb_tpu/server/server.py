@@ -27,9 +27,17 @@ class ClientConn:
         self.server = server
         self.pkt = p.PacketIO(sock)
         self.conn_id = conn_id
-        self.session = Session(server.storage)
+        # one CopClient per server: connections share the tile cache,
+        # worker pool, and jit program caches
+        self.session = Session(server.storage, cop_client=server.cop)
         self.user = ""
         self.alive = True
+
+    def _status(self) -> int:
+        st = p.SERVER_STATUS_AUTOCOMMIT
+        if self.session.in_explicit_txn:
+            st |= p.SERVER_STATUS_IN_TRANS
+        return st
 
     # --- lifecycle (ref: clientConn.Run) -----------------------------------
 
@@ -70,10 +78,11 @@ class ClientConn:
             self.alive = False
             return
         if cmd == p.COM_PING:
-            self.pkt.write_packet(p.ok_packet())
+            self.pkt.write_packet(p.ok_packet(status=self._status()))
             return
         if cmd == p.COM_INIT_DB:
-            return self.handle_query(f"USE `{data.decode('utf8', 'replace')}`")
+            name = data.decode("utf8", "replace").replace("`", "``")
+            return self.handle_query(f"USE `{name}`")
         if cmd == p.COM_QUERY:
             return self.handle_query(data.decode("utf8", "replace"))
         if cmd == p.COM_FIELD_LIST:
@@ -94,16 +103,16 @@ class ClientConn:
             self.pkt.write_packet(p.err_packet(1105, f"internal error: {e}"))
             return
         if not rs.names:
-            self.pkt.write_packet(p.ok_packet(rs.affected, rs.last_insert_id))
+            self.pkt.write_packet(p.ok_packet(rs.affected, rs.last_insert_id, status=self._status()))
             return
         fts = rs.chunk.field_types() if rs.chunk is not None else []
         self.pkt.write_packet(p.lenc_int(len(rs.names)))
         for name, ft in zip(rs.names, fts):
             self.pkt.write_packet(p.column_def(name, ft))
-        self.pkt.write_packet(p.eof_packet())
+        self.pkt.write_packet(p.eof_packet(status=self._status()))
         for row in rs.rows():
             self.pkt.write_packet(p.text_row(list(row)))
-        self.pkt.write_packet(p.eof_packet())
+        self.pkt.write_packet(p.eof_packet(status=self._status()))
 
 
 class Server:
@@ -111,6 +120,9 @@ class Server:
 
     def __init__(self, storage: Storage | None = None, host: str = "127.0.0.1", port: int = 4000):
         self.storage = storage or Storage()
+        from ..copr.client import CopClient
+
+        self.cop = CopClient(self.storage)  # shared across connections
         self.host = host
         self.port = port
         self.closing = False

@@ -1005,6 +1005,42 @@ class Session:
         if not isinstance(stmt.stmt, (ast.Select, ast.SetOpSelect)):
             raise TiDBError("EXPLAIN supports SELECT only for now")
         plan = self.plan_select(stmt.stmt)
+        if getattr(stmt, "analyze", False):
+            return self._run_explain_analyze(plan)
         lines = plan.pretty().split("\n")
+        chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(l)] for l in lines])
+        return ResultSet(["plan"], chk)
+
+    def _run_explain_analyze(self, plan) -> ResultSet:
+        """Execute with per-operator runtime stats + cop-layer counters
+        (ref: executor/explain.go EXPLAIN ANALYZE; util/execdetails)."""
+        from ..executor.runtime_stats import attach_runtime_stats, render_tree
+
+        ctx = ExecContext(
+            self.cop,
+            self.read_ts(),
+            engine=self.vars.get("tidb_cop_engine", "auto"),
+            vars=self.vars,
+            txn=self.txn,
+        )
+        before = dict(self.cop.stats)
+        tpu0 = (self.cop.tpu.compile_count, self.cop.tpu.fallbacks) if self.cop._tpu else (0, 0)
+        ex = build_executor(plan, ctx)
+        stats = attach_runtime_stats(ex)
+        t0 = time.perf_counter_ns()
+        drain(ex)
+        wall_ms = (time.perf_counter_ns() - t0) / 1e6
+        lines = render_tree(ex, stats)
+        d = {k: self.cop.stats[k] - before.get(k, 0) for k in self.cop.stats}
+        lines.append(
+            f"cop: tasks:{d['tasks']} tpu:{d['tpu_tasks']} host:{d['host_tasks']} "
+            f"region_errors:{d['region_errors']} fallback_errors:{d['fallback_errors']}"
+        )
+        if self.cop._tpu:
+            lines.append(
+                f"tpu: compiles:{self.cop.tpu.compile_count - tpu0[0]} "
+                f"fallbacks:{self.cop.tpu.fallbacks - tpu0[1]}"
+            )
+        lines.append(f"total: {wall_ms:.3f}ms")
         chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(l)] for l in lines])
         return ResultSet(["plan"], chk)

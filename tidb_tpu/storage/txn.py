@@ -196,7 +196,7 @@ class Txn:
     def commit(self) -> int:
         if self.committed:
             raise TxnAborted("transaction already committed")
-        if not self.membuf and not self._locked_keys:
+        if not self.membuf and not self._locked_keys and not self._pess_keys:
             self.committed = True
             return self.start_ts
         muts = []
@@ -205,7 +205,12 @@ class Txn:
                 muts.append(Mutation(OP_DEL, k))
             else:
                 muts.append(Mutation(OP_PUT, k, v))
-        for k in self._locked_keys:
+        locked = self._locked_keys | self._pess_keys
+        # _pess_keys beyond _locked_keys = locks taken by statements that
+        # later failed (the statement savepoint restores _locked_keys
+        # only); committing them as lock-only mutations both releases the
+        # physical lock and leaves a commit record for resolvers
+        for k in locked:
             if k not in self.membuf:
                 muts.append(Mutation(OP_LOCK, k))
         muts.sort(key=lambda m: m.key)
