@@ -1,0 +1,115 @@
+"""PREPARE / EXECUTE / DEALLOCATE + plan cache
+(ref: session.go:2042 ExecutePreparedStmt, planner/core/cache.go:128)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, name VARCHAR(16))")
+    sess.execute(
+        "INSERT INTO t VALUES " + ",".join(f"({i}, {i % 5}, 'n{i}')" for i in range(50))
+    )
+    return sess
+
+
+class TestPrepared:
+    def test_point_get_params(self, s):
+        s.execute("PREPARE p FROM 'SELECT name FROM t WHERE id = ?'")
+        s.execute("SET @a = 7")
+        assert s.must_query("EXECUTE p USING @a") == [("n7",)]
+        s.execute("SET @a = 31")
+        assert s.must_query("EXECUTE p USING @a") == [("n31",)]
+
+    def test_multi_params_and_types(self, s):
+        s.execute("PREPARE p FROM 'SELECT COUNT(*) FROM t WHERE g = ? AND name > ?'")
+        s.execute("SET @g = 2")
+        s.execute("SET @n = 'n3'")
+        expect = sum(1 for i in range(50) if i % 5 == 2 and f"n{i}" > "n3")
+        assert s.must_query("EXECUTE p USING @g, @n") == [(str(expect),)]
+
+    def test_prepared_insert(self, s):
+        s.execute("PREPARE ins FROM 'INSERT INTO t VALUES (?, ?, ?)'")
+        s.execute("SET @i = 100")
+        s.execute("SET @g = 1")
+        s.execute("SET @n = 'new'")
+        r = s.execute("EXECUTE ins USING @i, @g, @n")
+        assert r.affected == 1
+        assert s.must_query("SELECT name FROM t WHERE id = 100") == [("new",)]
+
+    def test_wrong_arity(self, s):
+        s.execute("PREPARE p FROM 'SELECT * FROM t WHERE id = ?'")
+        with pytest.raises(TiDBError, match="Incorrect arguments"):
+            s.execute("EXECUTE p")
+
+    def test_deallocate(self, s):
+        s.execute("PREPARE p FROM 'SELECT 1'")
+        s.execute("DEALLOCATE PREPARE p")
+        with pytest.raises(TiDBError, match="Unknown prepared statement"):
+            s.execute("EXECUTE p")
+
+    def test_unknown_handler(self, s):
+        with pytest.raises(TiDBError, match="Unknown prepared statement"):
+            s.execute("EXECUTE nope")
+
+
+class TestPlanCache:
+    def test_repeat_select_hits_cache(self, s):
+        q = "SELECT g, COUNT(*) FROM t GROUP BY g ORDER BY g"
+        first = s.must_query(q)
+        h0 = s.plan_cache_hits
+        assert s.must_query(q) == first
+        assert s.plan_cache_hits == h0 + 1
+
+    def test_ddl_invalidates(self, s):
+        q = "SELECT COUNT(*) FROM t"
+        s.must_query(q)
+        h0 = s.plan_cache_hits
+        s.execute("CREATE INDEX ig ON t (g)")  # bumps schema version
+        s.must_query(q)
+        assert s.plan_cache_hits == h0  # key changed → re-planned
+
+    def test_analyze_invalidates(self, s):
+        q = "SELECT COUNT(*) FROM t WHERE g = 1"
+        s.must_query(q)
+        h0 = s.plan_cache_hits
+        s.execute("ANALYZE TABLE t")
+        s.must_query(q)
+        assert s.plan_cache_hits == h0
+
+    def test_data_dependent_subquery_not_cached(self, s):
+        q = "SELECT COUNT(*) FROM t WHERE g = (SELECT MIN(g) FROM t WHERE id > 40)"
+        a = s.must_query(q)
+        s.execute("UPDATE t SET g = 4 WHERE id > 40")
+        b = s.must_query(q)
+        # the eager subquery re-evaluates: result reflects the update
+        assert a != b or s.plan_cache_hits == 0
+
+    def test_cache_respects_data_changes(self, s):
+        q = "SELECT COUNT(*) FROM t"
+        assert s.must_query(q) == [("50",)]
+        s.execute("INSERT INTO t VALUES (200, 0, 'x')")
+        assert s.must_query(q) == [("51",)]
+
+
+class TestPreparedEdges:
+    def test_prepare_from_user_var(self, s):
+        s.execute("SET @q = 'SELECT COUNT(*) FROM t WHERE g = ?'")
+        s.execute("PREPARE p FROM @q")
+        s.execute("SET @g = 3")
+        assert s.must_query("EXECUTE p USING @g") == [("10",)]
+
+    def test_set_var_expression(self, s):
+        s.execute("SET @neg = -5")
+        s.execute("SET @calc = 2 * 3 + 1")
+        s.execute("PREPARE p FROM 'SELECT COUNT(*) FROM t WHERE id > ? AND id < ?'")
+        assert s.must_query("EXECUTE p USING @neg, @calc") == [("7",)]
+
+    def test_using_non_var_rejected(self, s):
+        s.execute("PREPARE p FROM 'SELECT * FROM t WHERE id = ?'")
+        with pytest.raises(Exception):
+            s.execute("EXECUTE p USING 5")

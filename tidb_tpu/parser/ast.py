@@ -336,7 +336,8 @@ class AnalyzeTable:
 @dataclass
 class Prepare:
     name: str
-    sql: str
+    sql: str | None
+    from_var: str | None = None  # PREPARE name FROM @var
 
 
 @dataclass

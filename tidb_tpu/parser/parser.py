@@ -1227,8 +1227,12 @@ class Parser:
         self.expect_kw("PREPARE")
         name = self.ident()
         self.expect_kw("FROM")
-        sql = self.next().text
-        return ast.Prepare(name, sql)
+        t = self.next()
+        if t.kind == "uservar":
+            return ast.Prepare(name, None, from_var=t.text.lower())
+        if t.kind != "str":
+            self.fail("PREPARE ... FROM expects a string literal or @user_var")
+        return ast.Prepare(name, t.text)  # str tokens are already unquoted
 
     def execute_stmt(self):
         self.expect_kw("EXECUTE")
@@ -1236,7 +1240,10 @@ class Parser:
         using = []
         if self.try_kw("USING"):
             while True:
-                using.append(self.next().text)
+                t = self.next()
+                if t.kind != "uservar":
+                    self.fail("EXECUTE ... USING expects @user_var arguments")
+                using.append(t.text)
                 if not self.try_op(","):
                     break
         return ast.Execute(name, using)

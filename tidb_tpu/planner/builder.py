@@ -128,10 +128,14 @@ class PlanBuilder:
         c = scope.cols[idx]
         return ECol(idx, c.ft, c.name)
 
-    def __init__(self, infoschema, current_db: str, run_subquery=None):
+    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None):
         self.is_ = infoschema
         self.db = current_db
         self.run_subquery = run_subquery  # callable(Select ast) -> list[Datum rows]
+        self.params = params  # EXECUTE-bound Constants for '?' placeholders
+        # set when a subquery was evaluated eagerly at plan time: such a
+        # plan bakes in data and must not enter the plan cache
+        self.used_eager_subquery = False
         # correlated-subquery build state (rule_decorrelate.go analog):
         # while building a subquery, unknown names resolve against the
         # enclosing scopes as _CorrRef placeholders
@@ -319,6 +323,10 @@ class PlanBuilder:
     def to_expr(self, node, scope: NameScope, agg_ctx=None, allow_window=False) -> Expression:
         if isinstance(node, ast.Lit):
             return lit_to_constant(node)
+        if isinstance(node, ast.Param):
+            if self.params is None or node.index >= len(self.params):
+                raise TiDBError("statement has placeholders but no parameters were bound")
+            return self.params[node.index]
         if isinstance(node, ast.Name):
             return self._resolve_name(node, scope)
         if isinstance(node, ast.Call):
@@ -493,6 +501,7 @@ class PlanBuilder:
         operator; ref rule_decorrelate.go)."""
         if self.run_subquery is None:
             raise TiDBError("subqueries not supported in this context")
+        self.used_eager_subquery = True
         rows, fts = self.run_subquery(node.select)
         if node.modifier == "exists":
             return Constant(Datum.i(1 if rows else 0), ft_longlong())
@@ -507,6 +516,7 @@ class PlanBuilder:
     def _in_subquery(self, node: ast.Call, scope, agg_ctx) -> Expression:
         lhs = self.to_expr(node.args[0], scope, agg_ctx)
         sub = node.args[1]
+        self.used_eager_subquery = True
         rows, fts = self.run_subquery(sub.select)
         if not rows:
             return Constant(Datum.i(0), ft_longlong())

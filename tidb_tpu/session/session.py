@@ -70,7 +70,19 @@ class Session:
         # stats deltas buffered per-txn, flushed only on commit
         # (ref: statistics/handle SessionStatsCollector)
         self._pending_deltas: dict[int, list[int]] = {}
+        # prepared statements + plan cache (ref: session.go:2042
+        # ExecutePreparedStmt, planner/core/cache.go:128)
+        # name → (source sql, parsed ast, param count)
+        self.prepared: dict[str, tuple[str, object, int]] = {}
+        self.user_vars: dict[str, Constant] = {}
+        self._exec_params: list | None = None
+        from collections import OrderedDict
+
+        self._plan_cache: OrderedDict = OrderedDict()
+        self.plan_cache_hits = 0
         self._bootstrap()
+
+    PLAN_CACHE_SIZE = 128
 
     # ------------------------------------------------------------- bootstrap
 
@@ -158,7 +170,7 @@ class Session:
         if self.txn is not None:
             saved = (dict(self.txn.membuf), set(self.txn._locked_keys))
         try:
-            rs = self._execute_stmt(stmt)
+            rs = self._execute_stmt(stmt, sql=sql)
             self._finish_stmt()
             return rs
         except Exception:
@@ -170,9 +182,9 @@ class Session:
     def must_query(self, sql: str) -> list[tuple]:
         return self.execute(sql).rows()
 
-    def _execute_stmt(self, stmt) -> ResultSet:
+    def _execute_stmt(self, stmt, sql: str | None = None) -> ResultSet:
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
-            return self.run_select(stmt)
+            return self.run_select(stmt, sql=sql)
         if isinstance(stmt, ast.Insert):
             return self._run_insert(stmt)
         if isinstance(stmt, ast.Update):
@@ -223,8 +235,20 @@ class Session:
             return ResultSet([], None)
         if isinstance(stmt, ast.SetStmt):
             for scope, name, val in stmt.assignments:
-                c = self._const_of(val)
-                self.vars[name] = c.value.render(c.ret_type)
+                c = self._eval_const_expr(val)
+                if name.startswith("@") and not name.startswith("@@"):
+                    self.user_vars[name.lower()] = c  # typed, for EXECUTE USING
+                else:
+                    self.vars[name] = c.value.render(c.ret_type)
+            return ResultSet([], None)
+        if isinstance(stmt, ast.Prepare):
+            return self._run_prepare(stmt)
+        if isinstance(stmt, ast.Execute):
+            return self._run_execute(stmt)
+        if isinstance(stmt, ast.Deallocate):
+            if stmt.name not in self.prepared:
+                raise TiDBError(f"Unknown prepared statement handler ({stmt.name})")
+            del self.prepared[stmt.name]
             return ResultSet([], None)
         if isinstance(stmt, ast.Show):
             return self._run_show(stmt)
@@ -265,15 +289,65 @@ class Session:
             return Constant(Datum.s(".".join(node.parts)), ft_varchar())
         raise TiDBError("expected literal")
 
+    def _eval_const_expr(self, node) -> Constant:
+        """Evaluate a column-free expression to a typed Constant (for
+        SET @var = <expr>, incl. negatives and computed values)."""
+        try:
+            return self._const_of(node)
+        except TiDBError:
+            pass
+        builder = self._builder()
+        e = builder.to_expr(node, NameScope([]))
+        one = Chunk([Column(ft_longlong(), np.zeros(1, dtype=np.int64), np.ones(1, dtype=bool))])
+        d, v = e.eval(one)
+        d = np.asarray(d).reshape(-1)
+        v = np.asarray(v).reshape(-1)
+        if not v[0]:
+            return Constant(Datum.null(), e.ret_type)
+        return Constant(Column(e.ret_type, d[:1], v[:1]).get_datum(0), e.ret_type)
+
     # ---------------------------------------------------------------- SELECT
 
-    def plan_select(self, stmt):
-        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
-        plan = builder.build_select(stmt)
-        return optimize(plan, self.store.stats)
+    def _builder(self) -> PlanBuilder:
+        return PlanBuilder(
+            self.infoschema(), self.current_db,
+            run_subquery=self._run_subquery, params=self._exec_params,
+        )
 
-    def run_select(self, stmt) -> ResultSet:
+    def _plan_for(self, stmt, sql: str | None):
+        """Plan with an LRU plan cache for parameter-free statements
+        (ref: planner/core/cache.go:128 plan-cache key = stmt digest +
+        schema version; stats generation added so ANALYZE invalidates)."""
+        if sql is None or self._exec_params is not None or self.txn is not None:
+            return self.plan_select(stmt)
+        key = (
+            sql,
+            self.current_db,
+            self.infoschema().version,
+            self.store.stats.generation,
+            self.vars.get("tidb_cop_engine", ""),
+        )
+        plan = self._plan_cache.get(key)
+        if plan is not None:
+            self._plan_cache.move_to_end(key)
+            self.plan_cache_hits += 1
+            return plan
         plan = self.plan_select(stmt)
+        if not getattr(plan, "_uncacheable", False):
+            self._plan_cache[key] = plan
+            while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
+                self._plan_cache.popitem(last=False)
+        return plan
+
+    def plan_select(self, stmt):
+        builder = self._builder()
+        plan = builder.build_select(stmt)
+        plan = optimize(plan, self.store.stats)
+        plan._uncacheable = builder.used_eager_subquery
+        return plan
+
+    def run_select(self, stmt, sql: str | None = None) -> ResultSet:
+        plan = self._plan_for(stmt, sql)
         ctx = ExecContext(
             self.cop,
             self.read_ts(),
@@ -285,6 +359,67 @@ class Session:
         chunk = drain(ex)
         names = [c.name for c in plan.out_cols]
         return ResultSet(names, chunk)
+
+    # --------------------------------------------------- prepared statements
+
+    @staticmethod
+    def _count_params(node) -> int:
+        """Max '?' ordinal in a statement AST (+1)."""
+        import dataclasses
+
+        best = 0
+
+        def walk(x):
+            nonlocal best
+            if isinstance(x, ast.Param):
+                best = max(best, x.index + 1)
+            elif dataclasses.is_dataclass(x) and not isinstance(x, type):
+                for f in dataclasses.fields(x):
+                    walk(getattr(x, f.name))
+            elif isinstance(x, (list, tuple)):
+                for i in x:
+                    walk(i)
+
+        walk(node)
+        return best
+
+    def _run_prepare(self, stmt: ast.Prepare) -> ResultSet:
+        sql = stmt.sql
+        if stmt.from_var is not None:  # PREPARE name FROM @var
+            c = self.user_vars.get(stmt.from_var)
+            if c is None or c.value.is_null:
+                raise TiDBError(f"user variable {stmt.from_var} holds no statement")
+            sql = c.value.to_str()
+        parsed = parse_one(sql)
+        self.prepared[stmt.name] = (sql, parsed, self._count_params(parsed))
+        return ResultSet([], None)
+
+    def _run_execute(self, stmt: ast.Execute) -> ResultSet:
+        """EXECUTE name [USING @a, ...] (ref: session.go:2042
+        ExecutePreparedStmt): binds typed user-var Constants onto the
+        stored AST's '?' placeholders and runs it. The planner re-runs
+        per execution (it is microseconds); the expensive device programs
+        are reused through the DAG-digest jit cache."""
+        ent = self.prepared.get(stmt.name)
+        if ent is None:
+            raise TiDBError(f"Unknown prepared statement handler ({stmt.name})")
+        sql, parsed, n_params = ent
+        params = []
+        for ref in stmt.using:
+            c = self.user_vars.get(ref.lower())
+            if c is None:
+                params.append(Constant(Datum.null(), ft_varchar()))
+            else:
+                params.append(c)
+        if len(params) != n_params:
+            raise TiDBError(
+                f"Incorrect arguments to EXECUTE: statement needs {n_params}, got {len(params)}"
+            )
+        self._exec_params = params
+        try:
+            return self._execute_stmt(parsed)
+        finally:
+            self._exec_params = None
 
     def _run_subquery(self, select_ast):
         rs = self.run_select(select_ast)
@@ -317,7 +452,7 @@ class Session:
             c = lit_to_constant(node)
             return self._cast_datum(c.value, col.ft)
         # general expression with no column refs
-        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
+        builder = self._builder()
         e = builder.to_expr(node, NameScope([]))
         one = Chunk([Column(ft_longlong(), np.zeros(1, dtype=np.int64), np.ones(1, dtype=bool))])
         d, v = e.eval(one)
@@ -508,7 +643,7 @@ class Session:
         else:
             kvs = txn.scan(prefix, prefix + b"\xff")
         rows = []
-        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
+        builder = self._builder()
         cond = None
         if where is not None:
             ds_cols = [
@@ -566,7 +701,7 @@ class Session:
         from ..planner.plans import PlanCol
 
         scope = NameScope([PlanCol(c.name, c.ft, stmt.table.alias or info.name) for c in info.visible_columns()])
-        builder = PlanBuilder(self.infoschema(), self.current_db, run_subquery=self._run_subquery)
+        builder = self._builder()
         for name, expr in stmt.sets:
             col = info.col_by_name(name.column)
             sets.append((col, builder.to_expr(expr, scope)))

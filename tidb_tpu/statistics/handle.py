@@ -26,6 +26,7 @@ class StatsHandle:
     def __init__(self, storage):
         self.storage = storage
         self.cache: dict[int, TableStats] = {}
+        self.generation = 0  # bumped on stats writes; plan caches key on it
 
     # --- access ------------------------------------------------------------
 
@@ -56,6 +57,7 @@ class StatsHandle:
         return ts
 
     def save(self, ts: TableStats, session) -> None:
+        self.generation += 1
         self.cache[ts.table_id] = ts
         txn = session.store.begin()
         txn.put(_stats_key(ts.table_id), json.dumps(ts.to_json()).encode())
@@ -70,6 +72,7 @@ class StatsHandle:
     # --- DML delta + auto-analyze (ref: handle/update.go) -------------------
 
     def report_delta(self, table_id: int, changed: int, delta_rows: int = 0) -> None:
+        self.generation += 1  # DML re-costs: plan caches must not go stale
         ts = self.cache.get(table_id)
         if ts is not None:
             ts.modify_count += changed
