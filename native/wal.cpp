@@ -1,0 +1,244 @@
+// Write-ahead log + snapshot engine (C ABI, loaded via ctypes).
+//
+// The reference's storage node persists through a native LSM (unistore on
+// pingcap/badger; production TiKV on RocksDB). This is the framework's
+// native durability plane: an append-only record log with CRC32C-guarded
+// framing, buffered group commit, torn-tail-tolerant replay, and
+// atomic-rename snapshot files.
+//
+// Record framing:  [u32 len][u32 crc32(payload)][payload bytes]
+// A record whose length or checksum does not match terminates replay
+// (torn tail after a crash) — everything before it is intact.
+//
+// Snapshot files: [8-byte magic][u64 len][u32 crc32][payload], written to
+// <path>.tmp then rename(2)'d over <path> so readers see old-or-new.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#if defined(_WIN32)
+#error "POSIX only"
+#endif
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+    if (crc_init_done) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+    crc_init();
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < len; i++) c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+struct Wal {
+    int fd = -1;
+    std::string path;
+    uint8_t* buf = nullptr;   // group-commit buffer
+    size_t cap = 0;
+    size_t used = 0;
+    uint64_t appended = 0;    // records accepted since open
+};
+
+const size_t kBufCap = 1 << 20;  // 1MB group-commit buffer
+
+bool flush_buf(Wal* w) {
+    size_t off = 0;
+    while (off < w->used) {
+        ssize_t n = write(w->fd, w->buf + off, w->used - off);
+        if (n < 0) return false;
+        off += (size_t)n;
+    }
+    w->used = 0;
+    return true;
+}
+
+struct Replay {
+    uint8_t* data = nullptr;
+    size_t size = 0;
+    size_t pos = 0;
+    size_t valid_end = 0;  // bytes of intact prefix
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- writer
+
+void* wal_open(const char* path) {
+    Wal* w = new Wal();
+    w->path = path;
+    w->fd = open(path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (w->fd < 0) { delete w; return nullptr; }
+    w->buf = (uint8_t*)malloc(kBufCap);
+    w->cap = kBufCap;
+    return w;
+}
+
+// Buffered append; returns the record ordinal, or -1 on error.
+long long wal_append(void* h, const uint8_t* payload, uint64_t len) {
+    Wal* w = (Wal*)h;
+    uint32_t hdr[2] = {(uint32_t)len, crc32(payload, len)};
+    if (w->used + sizeof(hdr) + len > w->cap) {
+        if (!flush_buf(w)) return -1;
+        if (sizeof(hdr) + len > w->cap) {
+            // oversized record: write header + payload straight through
+            ssize_t a = write(w->fd, hdr, sizeof(hdr));
+            if (a != (ssize_t)sizeof(hdr)) return -1;
+            size_t off = 0;
+            while (off < len) {
+                ssize_t n = write(w->fd, payload + off, len - off);
+                if (n < 0) return -1;
+                off += (size_t)n;
+            }
+            return (long long)(w->appended++);
+        }
+    }
+    memcpy(w->buf + w->used, hdr, sizeof(hdr));
+    w->used += sizeof(hdr);
+    memcpy(w->buf + w->used, payload, len);
+    w->used += len;
+    return (long long)(w->appended++);
+}
+
+// Durability point: drain the buffer and fsync.
+int wal_sync(void* h) {
+    Wal* w = (Wal*)h;
+    if (!flush_buf(w)) return -1;
+    return fsync(w->fd);
+}
+
+void wal_close(void* h) {
+    Wal* w = (Wal*)h;
+    if (w == nullptr) return;
+    flush_buf(w);
+    if (w->fd >= 0) { fsync(w->fd); close(w->fd); }
+    free(w->buf);
+    delete w;
+}
+
+// Truncate the log (after a snapshot checkpoint subsumed it).
+int wal_reset(void* h) {
+    Wal* w = (Wal*)h;
+    if (!flush_buf(w)) return -1;
+    if (ftruncate(w->fd, 0) != 0) return -1;
+    w->appended = 0;
+    return fsync(w->fd);
+}
+
+// ---------------------------------------------------------------- replay
+
+void* wal_replay_open(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (f == nullptr) return nullptr;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    Replay* r = new Replay();
+    r->size = (size_t)(sz > 0 ? sz : 0);
+    r->data = (uint8_t*)malloc(r->size ? r->size : 1);
+    if (r->size && fread(r->data, 1, r->size, f) != r->size) {
+        fclose(f); free(r->data); delete r; return nullptr;
+    }
+    fclose(f);
+    // pre-scan the intact prefix: stop at the first torn/corrupt record
+    size_t pos = 0;
+    while (pos + 8 <= r->size) {
+        uint32_t len, crc;
+        memcpy(&len, r->data + pos, 4);
+        memcpy(&crc, r->data + pos + 4, 4);
+        if (pos + 8 + (size_t)len > r->size) break;
+        if (crc32(r->data + pos + 8, len) != crc) break;
+        pos += 8 + len;
+    }
+    r->valid_end = pos;
+    return r;
+}
+
+// Next record → sets *out/*out_len (pointer into the replay buffer, valid
+// until wal_replay_close). Returns 1 on a record, 0 at end.
+int wal_replay_next(void* h, const uint8_t** out, uint64_t* out_len) {
+    Replay* r = (Replay*)h;
+    if (r->pos + 8 > r->valid_end) return 0;
+    uint32_t len;
+    memcpy(&len, r->data + r->pos, 4);
+    *out = r->data + r->pos + 8;
+    *out_len = len;
+    r->pos += 8 + len;
+    return 1;
+}
+
+// Bytes of log that replayed cleanly (diagnostics: torn tail size = file - this).
+uint64_t wal_replay_valid_bytes(void* h) { return ((Replay*)h)->valid_end; }
+
+void wal_replay_close(void* h) {
+    Replay* r = (Replay*)h;
+    if (r == nullptr) return;
+    free(r->data);
+    delete r;
+}
+
+// --------------------------------------------------------------- snapshot
+
+static const uint64_t kSnapMagic = 0x54504453'4e415031ULL;  // "TPDSNAP1"
+
+int snap_write(const char* path, const uint8_t* payload, uint64_t len) {
+    std::string tmp = std::string(path) + ".tmp";
+    int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return -1;
+    uint64_t magic = kSnapMagic;
+    uint32_t crc = crc32(payload, len);
+    bool ok = write(fd, &magic, 8) == 8 && write(fd, &len, 8) == 8 && write(fd, &crc, 4) == 4;
+    size_t off = 0;
+    while (ok && off < len) {
+        ssize_t n = write(fd, payload + off, len - off);
+        if (n < 0) { ok = false; break; }
+        off += (size_t)n;
+    }
+    ok = ok && fsync(fd) == 0;
+    close(fd);
+    if (!ok) { unlink(tmp.c_str()); return -1; }
+    if (rename(tmp.c_str(), path) != 0) { unlink(tmp.c_str()); return -1; }
+    return 0;
+}
+
+// Load a snapshot; returns a malloc'd buffer (caller frees via snap_free)
+// or nullptr when absent/corrupt. *out_len receives the payload size.
+uint8_t* snap_read(const char* path, uint64_t* out_len) {
+    FILE* f = fopen(path, "rb");
+    if (f == nullptr) return nullptr;
+    uint64_t magic = 0, len = 0;
+    uint32_t crc = 0;
+    if (fread(&magic, 8, 1, f) != 1 || magic != kSnapMagic ||
+        fread(&len, 8, 1, f) != 1 || fread(&crc, 4, 1, f) != 1) {
+        fclose(f);
+        return nullptr;
+    }
+    uint8_t* buf = (uint8_t*)malloc(len ? len : 1);
+    if (len && fread(buf, 1, len, f) != len) { fclose(f); free(buf); return nullptr; }
+    fclose(f);
+    if (crc32(buf, len) != crc) { free(buf); return nullptr; }
+    *out_len = len;
+    return buf;
+}
+
+void snap_free(uint8_t* buf) { free(buf); }
+
+}  // extern "C"

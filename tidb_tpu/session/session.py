@@ -452,14 +452,8 @@ class Session:
             c = lit_to_constant(node)
             return self._cast_datum(c.value, col.ft)
         # general expression with no column refs
-        builder = self._builder()
-        e = builder.to_expr(node, NameScope([]))
-        one = Chunk([Column(ft_longlong(), np.zeros(1, dtype=np.int64), np.ones(1, dtype=bool))])
-        d, v = e.eval(one)
-        if not v[0]:
-            return Datum.null()
-        col_obj = Column(e.ret_type, d[:1], v[:1])
-        return self._cast_datum(col_obj.get_datum(0), col.ft)
+        c = self._eval_const_expr(node)
+        return self._cast_datum(c.value, col.ft)
 
     def _default_datum(self, col: ColumnInfo) -> Datum:
         if col.auto_increment:

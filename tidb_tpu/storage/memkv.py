@@ -21,6 +21,7 @@ class MemKV:
         self._keys: list[bytes] = []
         self._map: dict[bytes, bytes] = {}
         self.lock = RLock()
+        self.journal = None  # durable-mode WAL hook (storage/wal.py)
 
     def __len__(self):
         return len(self._keys)
@@ -33,6 +34,10 @@ class MemKV:
             if key not in self._map:
                 bisect.insort(self._keys, key)
             self._map[key] = value
+            if self.journal is not None:
+                from .wal import rec_put
+
+                self.journal.append(rec_put(key, value))
 
     def delete(self, key: bytes) -> None:
         with self.lock:
@@ -41,6 +46,10 @@ class MemKV:
                 i = bisect.bisect_left(self._keys, key)
                 if i < len(self._keys) and self._keys[i] == key:
                     self._keys.pop(i)
+                if self.journal is not None:
+                    from .wal import rec_delete
+
+                    self.journal.append(rec_delete(key))
 
     def write_batch(self, puts: list[tuple[bytes, bytes]], deletes: list[bytes] = ()) -> None:
         with self.lock:
@@ -48,6 +57,10 @@ class MemKV:
                 if k not in self._map:
                     bisect.insort(self._keys, k)
                 self._map[k] = v
+                if self.journal is not None:
+                    from .wal import rec_put
+
+                    self.journal.append(rec_put(k, v))
             for k in deletes:
                 self.delete(k)
 
@@ -79,6 +92,11 @@ class MemKV:
         import heapq
 
         with self.lock:
+            if self.journal is not None:
+                from .wal import rec_put
+
+                for k, v in pairs:
+                    self.journal.append(rec_put(k, v))
             fresh = [k for k, _ in pairs if k not in self._map]
             self._map.update(pairs)
             if not fresh:
@@ -97,4 +115,8 @@ class MemKV:
             for k in doomed:
                 del self._map[k]
             del self._keys[i:j]
+            if doomed and self.journal is not None:
+                from .wal import rec_delete_range
+
+                self.journal.append(rec_delete_range(start, end))
             return len(doomed)

@@ -466,6 +466,12 @@ class MVCCStore:
         run = Run.build(key_mat, vbuf, starts, lens, commit_ts, presorted=presorted)
         if run.n:
             self.runs.append(run)
+            j = getattr(self, "journal", None)
+            if j is not None:
+                from .wal import rec_run
+
+                j.append(rec_run(run.key_mat, run.vbuf, run.starts, run.lens, commit_ts))
+                j.sync()  # bulk ingests are their own durability point
             hook = getattr(self, "split_hook", None)
             if hook is not None:
                 hook(run)
@@ -487,6 +493,13 @@ class MVCCStore:
             np.cumsum(lens[:-1], out=starts[1:])
             self.ingest_run(key_mat, vbuf, starts, lens, commit_ts)
 
+    def kill_runs_range(self, start: bytes, end: bytes) -> int:
+        n = 0
+        for run in self.runs:
+            n += run.kill_range(start, end)
+        self.runs = [r for r in self.runs if r.alive is None or r.alive.any()]
+        return n
+
     def unsafe_destroy_range(self, start: bytes, end: bytes) -> int:
         """Physically remove ALL versions/locks in a user-key range —
         the delete-range verb used when tables are dropped/truncated
@@ -494,9 +507,13 @@ class MVCCStore:
         n = 0
         for cf in (b"d", b"w", b"l"):
             n += self.kv.delete_range(cf + start, cf + end)
-        for run in self.runs:
-            n += run.kill_range(start, end)
-        self.runs = [r for r in self.runs if r.alive is None or r.alive.any()]
+        killed = self.kill_runs_range(start, end)
+        n += killed
+        j = getattr(self, "journal", None)
+        if j is not None and killed:
+            from .wal import rec_kill_runs
+
+            j.append(rec_kill_runs(start, end))
         return n
 
     # --- GC (ref: store/gcworker) -----------------------------------------

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import time
 
-from ..errors import DeadlockError, LockedError, RetryableError, TxnAborted, WriteConflict
+from ..errors import DeadlockError, LockedError, RetryableError, TiDBError, TxnAborted, WriteConflict
 from .memkv import MemKV
 from .mvcc import MVCCStore, Mutation, OP_DEL, OP_LOCK, OP_PUT
 from .regions import RegionMap
@@ -256,6 +256,7 @@ class Txn:
             mvcc.commit(secondaries, self.start_ts, self.commit_ts)
         self.committed = True
         self.store.bump_version([m.key for m in muts])
+        self.store.wal_sync()  # group-commit durability point
         return self.commit_ts
 
     def rollback(self) -> None:
@@ -269,12 +270,21 @@ class Txn:
 
 
 class Storage:
-    """The kv.Storage of the framework: MVCC + TSO + regions + versions."""
+    """The kv.Storage of the framework: MVCC + TSO + regions + versions.
 
-    def __init__(self):
+    With `data_dir`, the store is durable: a native WAL (native/wal.cpp)
+    journals every mutation, commits group-flush + fsync, a fresh Storage
+    over the same dir recovers snapshot + intact log prefix, and
+    checkpoint() compacts log into snapshot (the reference's storage node
+    persists the same way through badger/RocksDB WALs + SSTs)."""
+
+    def __init__(self, data_dir: str | None = None):
         self.kv = MemKV()
         self.mvcc = MVCCStore(self.kv)
         self.tso = TSO()
+        self.data_dir = data_dir
+        self.wal = None
+        self._wal_epoch = 0
         self.regions = RegionMap()
         # auto-split: regions split when a bulk ingest lands more than
         # this many keys (PD's size-based split policy analog; ref:
@@ -294,6 +304,10 @@ class Storage:
         # columnar-replica analog) invalidates on these.
         self._versions: dict[bytes, int] = {}
         self._stats = None
+        # durable mode opens LAST: replay re-runs ingest hooks (region
+        # splits) against fully-initialized state
+        if data_dir is not None:
+            self._open_durable(data_dir)
 
     @property
     def ddl(self):
@@ -335,6 +349,116 @@ class Storage:
     def gc(self, safe_point: int | None = None) -> int:
         sp = safe_point if safe_point is not None else self.tso.current()
         return self.mvcc.gc(sp)
+
+    # --- durability (native WAL + snapshot) --------------------------------
+
+    def _wal_path(self, epoch: int) -> str:
+        import os
+
+        return os.path.join(self.data_dir, f"wal.{epoch:06d}.log")
+
+    def _open_durable(self, data_dir: str) -> None:
+        import os
+        import struct
+
+        from . import wal as w
+
+        os.makedirs(data_dir, exist_ok=True)
+        snap_path = os.path.join(data_dir, "snapshot.bin")
+        # 1) snapshot (if any); its header names the WAL epoch it subsumes
+        payload = w.snap_read(snap_path)
+        if payload:
+            pos = 0
+            (self._wal_epoch,) = struct.unpack_from("<Q", payload, pos)
+            pos += 8
+            (n_entries,) = struct.unpack_from("<Q", payload, pos)
+            pos += 8
+            pairs = []
+            for _ in range(n_entries):
+                klen, vlen = struct.unpack_from("<II", payload, pos)
+                pos += 8
+                k = payload[pos : pos + klen]
+                pos += klen
+                v = payload[pos : pos + vlen]
+                pos += vlen
+                pairs.append((k, v))
+            self.kv.bulk_load(pairs)
+            (n_runs,) = struct.unpack_from("<I", payload, pos)
+            pos += 4
+            for _ in range(n_runs):
+                rec_len = struct.unpack_from("<Q", payload, pos)[0]
+                pos += 8
+                w.apply_record(payload[pos : pos + rec_len], self.kv, self.mvcc)
+                pos += rec_len
+        # 2) replay the intact prefix of THIS epoch's log only — a crash
+        # between snapshot rename and log rotation must not re-apply runs
+        # the snapshot already contains
+        wal_path = self._wal_path(self._wal_epoch)
+        if os.path.exists(wal_path):
+            recs, valid = w.Wal.replay_records(wal_path)
+            for rec in recs:
+                w.apply_record(rec, self.kv, self.mvcc)
+            if valid < os.path.getsize(wal_path):
+                os.truncate(wal_path, valid)  # drop the torn tail for append
+        # stale epochs (pre-checkpoint logs) are garbage
+        for f in os.listdir(data_dir):
+            if f.startswith("wal.") and f.endswith(".log") and f != os.path.basename(wal_path):
+                os.unlink(os.path.join(data_dir, f))
+        # 3) attach journals (AFTER replay so replay doesn't self-append)
+        self.wal = w.Wal(wal_path)
+        self.kv.journal = self.wal
+        self.mvcc.journal = self.wal
+
+    def wal_sync(self) -> None:
+        if self.wal is not None:
+            self.wal.sync()
+
+    def checkpoint(self) -> None:
+        """Compact the WAL into an atomic snapshot file (the storage
+        node's flush/compaction analog)."""
+        if self.wal is None:
+            raise TiDBError("checkpoint requires a durable Storage (data_dir)")
+        import os
+        import struct
+
+        from . import wal as w
+
+        with self.kv.lock:
+            new_epoch = self._wal_epoch + 1
+            parts = [struct.pack("<Q", new_epoch), struct.pack("<Q", len(self.kv._keys))]
+            for k in self.kv._keys:
+                v = self.kv._map[k]
+                parts.append(struct.pack("<II", len(k), len(v)))
+                parts.append(k)
+                parts.append(v)
+            runs = list(self.mvcc.runs)
+            parts.append(struct.pack("<I", len(runs)))
+            for run in runs:
+                # compact killed rows out at checkpoint time
+                if run.alive is not None:
+                    keep = run.alive
+                    km = run.key_mat[keep]
+                    st = run.starts[keep]
+                    ln = run.lens[keep]
+                else:
+                    km, st, ln = run.key_mat, run.starts, run.lens
+                rec = w.rec_run(km, run.vbuf, st, ln, run.commit_ts)
+                parts.append(struct.pack("<Q", len(rec)))
+                parts.append(rec)
+            payload = b"".join(parts)
+            # snapshot names epoch E+1 and atomically renames BEFORE the
+            # new log exists: a crash in between recovers from the
+            # snapshot alone (the old epoch's log is simply ignored)
+            w.snap_write(os.path.join(self.data_dir, "snapshot.bin"), payload)
+            old = self.wal
+            self._wal_epoch = new_epoch
+            self.wal = w.Wal(self._wal_path(new_epoch))
+            self.kv.journal = self.wal
+            self.mvcc.journal = self.wal
+            old.close()
+            old_path = self._wal_path(new_epoch - 1)
+            if os.path.exists(old_path):
+                os.unlink(old_path)
 
     @property
     def gc_worker(self):

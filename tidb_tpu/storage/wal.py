@@ -1,0 +1,214 @@
+"""ctypes binding + journal layer over the native WAL engine
+(native/wal.cpp). The durability spec is the reference's storage-node
+model (unistore over badger's value-log, production TiKV over RocksDB
+WAL): every mutation appends a framed record, commits group-flush +
+fsync, recovery replays the intact prefix, and snapshots checkpoint the
+full state so the log can reset.
+
+Record payloads (framing/CRC live in C++; payloads are ours):
+  b'P' u32 klen key value          put
+  b'D' u32 klen key                delete
+  b'X' u32 slen start u32 elen end delete_range
+  b'R' run: u32 w, u64 n, u64 commit_ts, key_mat, starts, lens, vbuf
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "wal.cpp")
+_LIB: ctypes.CDLL | None = None
+_LIB_LOCK = threading.Lock()
+
+
+def _load_lib() -> ctypes.CDLL:
+    """Build (once, mtime-cached) and load the native library."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.abspath(_SRC)
+        so = os.path.join(os.path.dirname(src), "libtpuwal.so")
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so, src],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+        lib.wal_open.restype = ctypes.c_void_p
+        lib.wal_open.argtypes = [ctypes.c_char_p]
+        lib.wal_append.restype = ctypes.c_longlong
+        lib.wal_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.wal_sync.restype = ctypes.c_int
+        lib.wal_sync.argtypes = [ctypes.c_void_p]
+        lib.wal_close.argtypes = [ctypes.c_void_p]
+        lib.wal_reset.restype = ctypes.c_int
+        lib.wal_reset.argtypes = [ctypes.c_void_p]
+        lib.wal_replay_open.restype = ctypes.c_void_p
+        lib.wal_replay_open.argtypes = [ctypes.c_char_p]
+        lib.wal_replay_next.restype = ctypes.c_int
+        lib.wal_replay_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.wal_replay_valid_bytes.restype = ctypes.c_uint64
+        lib.wal_replay_valid_bytes.argtypes = [ctypes.c_void_p]
+        lib.wal_replay_close.argtypes = [ctypes.c_void_p]
+        lib.snap_write.restype = ctypes.c_int
+        lib.snap_write.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.snap_read.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.snap_read.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.snap_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        _LIB = lib
+        return lib
+
+
+class Wal:
+    """One open write-ahead log."""
+
+    def __init__(self, path: str):
+        self.lib = _load_lib()
+        self.path = path
+        self._h = self.lib.wal_open(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open WAL at {path}")
+        self._lock = threading.Lock()
+
+    def append(self, payload: bytes) -> None:
+        with self._lock:
+            if self.lib.wal_append(self._h, payload, len(payload)) < 0:
+                raise OSError("WAL append failed")
+
+    def sync(self) -> None:
+        with self._lock:
+            if self.lib.wal_sync(self._h) != 0:
+                raise OSError("WAL fsync failed")
+
+    def reset(self) -> None:
+        with self._lock:
+            if self.lib.wal_reset(self._h) != 0:
+                raise OSError("WAL reset failed")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._h:
+                self.lib.wal_close(self._h)
+                self._h = None
+
+    @staticmethod
+    def replay(path: str):
+        """Yield intact record payloads (stops at a torn tail)."""
+        recs, _ = Wal.replay_records(path)
+        yield from recs
+
+    @staticmethod
+    def replay_records(path: str) -> tuple[list[bytes], int]:
+        """→ (intact record payloads, intact byte prefix length). The
+        caller must truncate the file to the prefix before appending, or
+        post-recovery commits land beyond the torn bytes and are lost on
+        the next replay."""
+        lib = _load_lib()
+        h = lib.wal_replay_open(path.encode())
+        if not h:
+            return [], 0
+        try:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = ctypes.c_uint64()
+            recs = []
+            while lib.wal_replay_next(h, ctypes.byref(out), ctypes.byref(n)):
+                recs.append(ctypes.string_at(out, n.value))
+            return recs, int(lib.wal_replay_valid_bytes(h))
+        finally:
+            lib.wal_replay_close(h)
+
+
+def snap_write(path: str, payload: bytes) -> None:
+    if _load_lib().snap_write(path.encode(), payload, len(payload)) != 0:
+        raise OSError(f"snapshot write failed: {path}")
+
+
+def snap_read(path: str) -> bytes | None:
+    lib = _load_lib()
+    n = ctypes.c_uint64()
+    buf = lib.snap_read(path.encode(), ctypes.byref(n))
+    if not buf:
+        return None
+    try:
+        return ctypes.string_at(buf, n.value)
+    finally:
+        lib.snap_free(buf)
+
+
+# --------------------------------------------------------- record payloads
+
+
+def rec_put(key: bytes, value: bytes) -> bytes:
+    return b"P" + struct.pack("<I", len(key)) + key + value
+
+
+def rec_delete(key: bytes) -> bytes:
+    return b"D" + struct.pack("<I", len(key)) + key
+
+
+def rec_delete_range(start: bytes, end: bytes) -> bytes:
+    return b"X" + struct.pack("<I", len(start)) + start + struct.pack("<I", len(end)) + end
+
+
+def rec_kill_runs(start: bytes, end: bytes) -> bytes:
+    return b"K" + struct.pack("<I", len(start)) + start + struct.pack("<I", len(end)) + end
+
+
+def rec_run(key_mat: np.ndarray, vbuf, starts: np.ndarray, lens: np.ndarray, commit_ts: int) -> bytes:
+    n, w = key_mat.shape
+    vb = bytes(vbuf) if not isinstance(vbuf, bytes) else vbuf
+    return (
+        b"R"
+        + struct.pack("<IQQ", w, n, commit_ts)
+        + np.ascontiguousarray(key_mat, dtype=np.uint8).tobytes()
+        + np.ascontiguousarray(starts, dtype=np.int64).tobytes()
+        + np.ascontiguousarray(lens, dtype=np.int64).tobytes()
+        + struct.pack("<Q", len(vb))
+        + vb
+    )
+
+
+def apply_record(payload: bytes, kv, mvcc) -> None:
+    """Replay one journal record into the in-memory store."""
+    tag = payload[:1]
+    if tag == b"P":
+        (klen,) = struct.unpack_from("<I", payload, 1)
+        key = payload[5 : 5 + klen]
+        kv.put(key, payload[5 + klen :])
+    elif tag == b"D":
+        (klen,) = struct.unpack_from("<I", payload, 1)
+        kv.delete(payload[5 : 5 + klen])
+    elif tag in (b"X", b"K"):
+        (slen,) = struct.unpack_from("<I", payload, 1)
+        start = payload[5 : 5 + slen]
+        (elen,) = struct.unpack_from("<I", payload, 5 + slen)
+        end = payload[9 + slen : 9 + slen + elen]
+        if tag == b"X":
+            kv.delete_range(start, end)
+        else:
+            mvcc.kill_runs_range(start, end)
+    elif tag == b"R":
+        w, n, commit_ts = struct.unpack_from("<IQQ", payload, 1)
+        pos = 1 + 20
+        key_mat = np.frombuffer(payload, np.uint8, n * w, pos).reshape(int(n), w).copy()
+        pos += n * w
+        starts = np.frombuffer(payload, np.int64, n, pos).copy()
+        pos += 8 * n
+        lens = np.frombuffer(payload, np.int64, n, pos).copy()
+        pos += 8 * n
+        (vlen,) = struct.unpack_from("<Q", payload, pos)
+        vbuf = payload[pos + 8 : pos + 8 + vlen]
+        mvcc.ingest_run(key_mat, vbuf, starts, lens, commit_ts, presorted=True)
+    else:
+        raise ValueError(f"unknown WAL record tag {tag!r}")
