@@ -95,6 +95,7 @@ void* wal_open(const char* path) {
 // Buffered append; returns the record ordinal, or -1 on error.
 long long wal_append(void* h, const uint8_t* payload, uint64_t len) {
     Wal* w = (Wal*)h;
+    if (len > 0xFFFFFFFFull) return -1;  // frame header is u32
     uint32_t hdr[2] = {(uint32_t)len, crc32(payload, len)};
     if (w->used + sizeof(hdr) + len > w->cap) {
         if (!flush_buf(w)) return -1;
@@ -132,15 +133,6 @@ void wal_close(void* h) {
     if (w->fd >= 0) { fsync(w->fd); close(w->fd); }
     free(w->buf);
     delete w;
-}
-
-// Truncate the log (after a snapshot checkpoint subsumed it).
-int wal_reset(void* h) {
-    Wal* w = (Wal*)h;
-    if (!flush_buf(w)) return -1;
-    if (ftruncate(w->fd, 0) != 0) return -1;
-    w->appended = 0;
-    return fsync(w->fd);
 }
 
 // ---------------------------------------------------------------- replay
@@ -199,6 +191,17 @@ void wal_replay_close(void* h) {
 
 static const uint64_t kSnapMagic = 0x54504453'4e415031ULL;  // "TPDSNAP1"
 
+static int fsync_parent_dir(const char* path) {
+    std::string dir(path);
+    size_t slash = dir.find_last_of('/');
+    dir = (slash == std::string::npos) ? "." : dir.substr(0, slash ? slash : 1);
+    int dfd = open(dir.c_str(), O_RDONLY);
+    if (dfd < 0) return -1;
+    int rc = fsync(dfd);
+    close(dfd);
+    return rc;
+}
+
 int snap_write(const char* path, const uint8_t* payload, uint64_t len) {
     std::string tmp = std::string(path) + ".tmp";
     int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
@@ -216,7 +219,9 @@ int snap_write(const char* path, const uint8_t* payload, uint64_t len) {
     close(fd);
     if (!ok) { unlink(tmp.c_str()); return -1; }
     if (rename(tmp.c_str(), path) != 0) { unlink(tmp.c_str()); return -1; }
-    return 0;
+    // the rename is directory metadata: without fsyncing the parent dir a
+    // power loss can persist later ops (e.g. old-log unlink) but not this
+    return fsync_parent_dir(path);
 }
 
 // Load a snapshot; returns a malloc'd buffer (caller frees via snap_free)
@@ -224,14 +229,19 @@ int snap_write(const char* path, const uint8_t* payload, uint64_t len) {
 uint8_t* snap_read(const char* path, uint64_t* out_len) {
     FILE* f = fopen(path, "rb");
     if (f == nullptr) return nullptr;
+    fseek(f, 0, SEEK_END);
+    long fsz = ftell(f);
+    fseek(f, 0, SEEK_SET);
     uint64_t magic = 0, len = 0;
     uint32_t crc = 0;
     if (fread(&magic, 8, 1, f) != 1 || magic != kSnapMagic ||
-        fread(&len, 8, 1, f) != 1 || fread(&crc, 4, 1, f) != 1) {
+        fread(&len, 8, 1, f) != 1 || fread(&crc, 4, 1, f) != 1 ||
+        fsz < 20 || len > (uint64_t)(fsz - 20)) {  // len bounded by file size
         fclose(f);
         return nullptr;
     }
     uint8_t* buf = (uint8_t*)malloc(len ? len : 1);
+    if (buf == nullptr) { fclose(f); return nullptr; }
     if (len && fread(buf, 1, len, f) != len) { fclose(f); free(buf); return nullptr; }
     fclose(f);
     if (crc32(buf, len) != crc) { free(buf); return nullptr; }

@@ -1,0 +1,108 @@
+"""BACKUP / RESTORE + LOAD DATA (ref: br/pkg/backup+restore via
+executor/brie.go; br/pkg/lightning checkpointed import)."""
+
+import os
+
+import pytest
+
+from tidb_tpu.errors import TableExists, TiDBError
+from tidb_tpu.session import Session
+from tidb_tpu.storage.txn import Storage
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, v VARCHAR(10), d DECIMAL(8,2), KEY iv (v))")
+    sess.execute("INSERT INTO t VALUES (1, 'a', 1.50), (2, 'b', NULL), (3, NULL, 7.25)")
+    sess.execute("CREATE TABLE u (id INT PRIMARY KEY, k INT)")
+    sess.execute("INSERT INTO u VALUES " + ",".join(f"({i},{i%7})" for i in range(200)))
+    return sess
+
+
+class TestBackupRestore:
+    def test_roundtrip_into_fresh_store(self, s, tmp_path):
+        bdir = str(tmp_path / "bk")
+        r = s.execute(f"BACKUP DATABASE * TO '{bdir}'")
+        assert r.rows()[0][0] == bdir
+        t_rows = s.must_query("SELECT * FROM t ORDER BY id")
+        u_sum = s.must_query("SELECT k, COUNT(*) FROM u GROUP BY k ORDER BY k")
+
+        fresh = Session(Storage())
+        fresh.execute(f"RESTORE DATABASE * FROM '{bdir}'")
+        assert fresh.must_query("SELECT * FROM t ORDER BY id") == t_rows
+        assert fresh.must_query("SELECT k, COUNT(*) FROM u GROUP BY k ORDER BY k") == u_sum
+        # restored secondary index works
+        assert fresh.must_query("SELECT id FROM t WHERE v = 'b'") == [("2",)]
+        # restored tables accept writes
+        fresh.execute("INSERT INTO t VALUES (9, 'z', 0.01)")
+        assert fresh.must_query("SELECT COUNT(*) FROM t") == [("4",)]
+
+    def test_snapshot_consistency(self, s, tmp_path):
+        bdir = str(tmp_path / "bk")
+        s.execute(f"BACKUP DATABASE * TO '{bdir}'")
+        s.execute("INSERT INTO t VALUES (99, 'post', 9.99)")  # after backup_ts
+        fresh = Session(Storage())
+        fresh.execute(f"RESTORE DATABASE * FROM '{bdir}'")
+        assert fresh.must_query("SELECT COUNT(*) FROM t") == [("3",)]
+
+    def test_restore_conflict_errors(self, s, tmp_path):
+        bdir = str(tmp_path / "bk")
+        s.execute(f"BACKUP DATABASE * TO '{bdir}'")
+        with pytest.raises(TableExists):
+            s.execute(f"RESTORE DATABASE * FROM '{bdir}'")
+
+    def test_selective_database(self, s, tmp_path):
+        s.execute("CREATE DATABASE other")
+        s.execute("USE other")
+        s.execute("CREATE TABLE only_here (id INT PRIMARY KEY)")
+        s.execute("INSERT INTO only_here VALUES (42)")
+        bdir = str(tmp_path / "bk")
+        s.execute(f"BACKUP DATABASE other TO '{bdir}'")
+        fresh = Session(Storage())
+        fresh.execute(f"RESTORE DATABASE other FROM '{bdir}'")
+        fresh.execute("USE other")
+        assert fresh.must_query("SELECT * FROM only_here") == [("42",)]
+        from tidb_tpu.errors import UnknownTable
+
+        with pytest.raises(UnknownTable):
+            fresh.execute("SELECT * FROM test.t")
+
+
+class TestLoadData:
+    def _write_csv(self, tmp_path, lines):
+        p = str(tmp_path / "in.csv")
+        with open(p, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return p
+
+    def test_basic_csv(self, s, tmp_path):
+        p = self._write_csv(tmp_path, ["10,hello,3.50", "11,world,\\N"])
+        r = s.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t FIELDS TERMINATED BY ','")
+        assert r.affected == 2
+        assert s.must_query("SELECT v, d FROM t WHERE id = 10") == [("hello", "3.50")]
+        assert s.must_query("SELECT d FROM t WHERE id = 11") == [(None,)]
+
+    def test_ignore_lines_and_columns(self, s, tmp_path):
+        p = self._write_csv(tmp_path, ["id,v", "20,x", "21,y"])
+        s.execute(
+            f"LOAD DATA INFILE '{p}' INTO TABLE t FIELDS TERMINATED BY ',' IGNORE 1 LINES (id, v)"
+        )
+        assert s.must_query("SELECT v FROM t WHERE id = 21") == [("y",)]
+
+    def test_checkpoint_resume(self, s, tmp_path, monkeypatch):
+        import tidb_tpu.br.importer as imp
+
+        monkeypatch.setattr(imp, "BATCH_ROWS", 10)
+        lines = [f"{1000 + i},r{i},{i}.00" for i in range(35)]
+        p = self._write_csv(tmp_path, lines)
+        # simulate a crash after 2 batches: pre-seed the checkpoint
+        with open(p + ".ckpt", "w") as f:
+            import json
+
+            f.write(json.dumps({"table": "test.t", "rows_done": 20}))
+        r = s.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t FIELDS TERMINATED BY ','")
+        assert r.affected == 15  # only rows 20..34 imported on resume
+        assert not os.path.exists(p + ".ckpt")
+        assert s.must_query("SELECT COUNT(*) FROM t WHERE id >= 1020") == [("15",)]
+        assert s.must_query("SELECT COUNT(*) FROM t WHERE id >= 1000 AND id < 1020") == [("0",)]

@@ -1,0 +1,4 @@
+from .backup import run_backup, run_restore
+from .importer import run_load_data
+
+__all__ = ["run_backup", "run_restore", "run_load_data"]

@@ -1,0 +1,138 @@
+"""BACKUP / RESTORE — snapshot backup to local storage
+(ref: br/pkg/backup + restore driven from SQL via executor/brie.go;
+BR's rewrite rules map backed-up table ids onto freshly allocated ids
+at restore, which is what `_rewrite_key` does here).
+
+Layout of a backup directory:
+  manifest.bin   — CRC-framed JSON: backup_ts + per-table schema/file info
+  t<id>.sst      — CRC-framed KV payload: all record+index keys of one
+                   table at the backup snapshot (the SST analog)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+from ..catalog.meta import Meta
+from ..catalog.schema import TableInfo
+from ..codec import tablecodec
+from ..errors import TableExists, TiDBError, UnknownDatabase
+from ..storage import wal as w
+
+SYSTEM_DBS = {"mysql", "information_schema", "performance_schema"}
+
+
+def _pack_pairs(pairs) -> bytes:
+    parts = [struct.pack("<Q", len(pairs))]
+    for k, v in pairs:
+        parts.append(struct.pack("<II", len(k), len(v)))
+        parts.append(k)
+        parts.append(v)
+    return b"".join(parts)
+
+
+def _unpack_pairs(payload: bytes):
+    (n,) = struct.unpack_from("<Q", payload, 0)
+    pos = 8
+    out = []
+    for _ in range(n):
+        klen, vlen = struct.unpack_from("<II", payload, pos)
+        pos += 8
+        out.append((payload[pos : pos + klen], payload[pos + klen : pos + klen + vlen]))
+        pos += klen + vlen
+    return out
+
+
+def run_backup(session, stmt):
+    """BACKUP DATABASE *|db[,db] TO 'dir'."""
+    from ..session.session import ResultSet
+
+    path = stmt.storage
+    os.makedirs(path, exist_ok=True)
+    backup_ts = session.store.tso.next()
+    is_ = session.infoschema()
+    dbs = set(d.lower() for d in stmt.databases) or {
+        d for d in is_.db_names() if d not in SYSTEM_DBS
+    }
+    snap = session.store.snapshot(backup_ts)
+    manifest = {"backup_ts": backup_ts, "tables": []}
+    total_kvs = total_bytes = 0
+    for t in sorted(is_.tables.values(), key=lambda x: x.id):
+        if t.db_name.lower() not in dbs:
+            continue
+        pairs = snap.scan(tablecodec.table_prefix(t.id), tablecodec.table_prefix(t.id + 1))
+        payload = _pack_pairs(pairs)
+        fname = f"t{t.id}.sst"
+        w.snap_write(os.path.join(path, fname), payload)
+        manifest["tables"].append(
+            {"db": t.db_name, "schema": t.to_json(), "file": fname, "kvs": len(pairs)}
+        )
+        total_kvs += len(pairs)
+        total_bytes += len(payload)
+    w.snap_write(os.path.join(path, "manifest.bin"), json.dumps(manifest).encode())
+    return ResultSet.message_row(
+        ["Destination", "Size", "BackupTS", "Queue Time", "Execution Time"],
+        [path, str(total_bytes), str(backup_ts), "0", "0"],
+    )
+
+
+def _rewrite_key(key: bytes, new_id: int) -> bytes:
+    # keys are 't' + 8-byte big-endian-comparable table id + suffix
+    return tablecodec.table_prefix(new_id) + key[9:]
+
+
+def run_restore(session, stmt):
+    """RESTORE DATABASE *|db[,db] FROM 'dir' — schemas re-register under
+    freshly allocated table ids; keys rewrite on ingest (BR rewrite-rule
+    analog)."""
+    from ..session.session import ResultSet
+
+    path = stmt.storage
+    raw = w.snap_read(os.path.join(path, "manifest.bin"))
+    if raw is None:
+        raise TiDBError(f"no backup manifest at {path!r}")
+    manifest = json.loads(raw)
+    want = set(d.lower() for d in stmt.databases)
+    store = session.store
+    total_kvs = 0
+    for ent in manifest["tables"]:
+        if want and ent["db"].lower() not in want:
+            continue
+        schema = TableInfo.from_json(ent["schema"])
+        txn = store.begin()
+        m = Meta(txn)
+        dbi = m.db(ent["db"])
+        if dbi is None:
+            from ..catalog.schema import DBInfo
+
+            dbi = DBInfo(ent["db"])
+        for tid in dbi.table_ids:
+            existing = m.table(tid)
+            if existing and existing.name.lower() == schema.name.lower():
+                txn.rollback()
+                raise TableExists(f"table {ent['db']}.{schema.name} already exists")
+        new_id = m.alloc_id()
+        schema.id = new_id
+        schema.db_name = ent["db"]
+        m.put_table(schema)
+        dbi.table_ids.append(new_id)
+        m.put_db(dbi)
+        m.bump_schema_version()
+        txn.commit()
+
+        payload = w.snap_read(os.path.join(path, ent["file"]))
+        if payload is None:
+            raise TiDBError(f"backup file {ent['file']} missing/corrupt")
+        pairs = [(_rewrite_key(k, new_id), v) for k, v in _unpack_pairs(payload)]
+        commit_ts = store.tso.next()
+        store.mvcc.ingest(pairs, commit_ts)
+        store.bump_version([p[0] for p in pairs[:1]])
+        session.cop.tiles.invalidate_table(new_id)
+        total_kvs += len(pairs)
+    session._is_cache = None
+    return ResultSet.message_row(
+        ["Destination", "Size", "BackupTS", "Queue Time", "Execution Time"],
+        [path, str(total_kvs), str(manifest["backup_ts"]), "0", "0"],
+    )

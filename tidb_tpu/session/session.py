@@ -55,6 +55,15 @@ class ResultSet:
         r = self.rows()
         return r[0][0] if r else None
 
+    @classmethod
+    def message_row(cls, names: list[str], values: list[str]) -> "ResultSet":
+        from ..mysqltypes.field_type import ft_varchar
+
+        chk = Chunk.empty([ft_varchar(64) for _ in names], 1)
+        for c, v in enumerate(values):
+            chk.columns[c].set_datum(0, Datum.s(v))
+        return cls(names, chk)
+
 
 class Session:
     def __init__(self, storage: Storage | None = None, cop_client: CopClient | None = None):
@@ -260,6 +269,14 @@ class Session:
             return ResultSet([], None)
         if isinstance(stmt, ast.AdminStmt) and stmt.kind == "show_ddl_jobs":
             return self._admin_show_ddl_jobs()
+        if isinstance(stmt, ast.BRIEStmt):
+            from .. import br
+
+            return br.run_backup(self, stmt) if stmt.kind == "backup" else br.run_restore(self, stmt)
+        if isinstance(stmt, ast.LoadData):
+            from .. import br
+
+            return br.run_load_data(self, stmt)
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
 
     def _admin_show_ddl_jobs(self) -> ResultSet:
@@ -291,11 +308,11 @@ class Session:
 
     def _eval_const_expr(self, node) -> Constant:
         """Evaluate a column-free expression to a typed Constant (for
-        SET @var = <expr>, incl. negatives and computed values)."""
-        try:
-            return self._const_of(node)
-        except TiDBError:
-            pass
+        SET @var = <expr> and INSERT value expressions). Bare identifiers
+        are NOT treated as strings here — they must resolve (and cannot,
+        in an empty scope), matching MySQL's unknown-column error."""
+        if isinstance(node, ast.Lit):
+            return lit_to_constant(node)
         builder = self._builder()
         e = builder.to_expr(node, NameScope([]))
         one = Chunk([Column(ft_longlong(), np.zeros(1, dtype=np.int64), np.ones(1, dtype=bool))])

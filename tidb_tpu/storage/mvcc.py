@@ -465,13 +465,16 @@ class MVCCStore:
 
         run = Run.build(key_mat, vbuf, starts, lens, commit_ts, presorted=presorted)
         if run.n:
-            self.runs.append(run)
-            j = getattr(self, "journal", None)
-            if j is not None:
-                from .wal import rec_run
+            # kv.lock serializes against checkpoint() snapshotting runs and
+            # rotating the journal under the same lock
+            with self.kv.lock:
+                self.runs.append(run)
+                j = getattr(self, "journal", None)
+                if j is not None:
+                    from .wal import rec_run
 
-                j.append(rec_run(run.key_mat, run.vbuf, run.starts, run.lens, commit_ts))
-                j.sync()  # bulk ingests are their own durability point
+                    j.append(rec_run(run.key_mat, run.vbuf, run.starts, run.lens, commit_ts))
+                    j.sync()  # bulk ingests are their own durability point
             hook = getattr(self, "split_hook", None)
             if hook is not None:
                 hook(run)

@@ -456,9 +456,14 @@ class Storage:
             self.kv.journal = self.wal
             self.mvcc.journal = self.wal
             old.close()
+            # the new log must be durably present in the dir BEFORE the
+            # old one disappears (power-loss ordering)
+            self.wal.sync()
+            w.fsync_dir(self.data_dir)
             old_path = self._wal_path(new_epoch - 1)
             if os.path.exists(old_path):
                 os.unlink(old_path)
+                w.fsync_dir(self.data_dir)
 
     @property
     def gc_worker(self):

@@ -48,8 +48,6 @@ def _load_lib() -> ctypes.CDLL:
         lib.wal_sync.restype = ctypes.c_int
         lib.wal_sync.argtypes = [ctypes.c_void_p]
         lib.wal_close.argtypes = [ctypes.c_void_p]
-        lib.wal_reset.restype = ctypes.c_int
-        lib.wal_reset.argtypes = [ctypes.c_void_p]
         lib.wal_replay_open.restype = ctypes.c_void_p
         lib.wal_replay_open.argtypes = [ctypes.c_char_p]
         lib.wal_replay_next.restype = ctypes.c_int
@@ -91,11 +89,6 @@ class Wal:
             if self.lib.wal_sync(self._h) != 0:
                 raise OSError("WAL fsync failed")
 
-    def reset(self) -> None:
-        with self._lock:
-            if self.lib.wal_reset(self._h) != 0:
-                raise OSError("WAL reset failed")
-
     def close(self) -> None:
         with self._lock:
             if self._h:
@@ -117,6 +110,10 @@ class Wal:
         lib = _load_lib()
         h = lib.wal_replay_open(path.encode())
         if not h:
+            # distinguish "no log" from "log unreadable": truncating an
+            # intact-but-unreadable log would destroy committed data
+            if os.path.exists(path) and os.path.getsize(path) > 0:
+                raise OSError(f"WAL {path!r} exists but could not be read")
             return [], 0
         try:
             out = ctypes.POINTER(ctypes.c_uint8)()
@@ -127,6 +124,15 @@ class Wal:
             return recs, int(lib.wal_replay_valid_bytes(h))
         finally:
             lib.wal_replay_close(h)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so renames/unlinks inside it are durable."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def snap_write(path: str, payload: bytes) -> None:
