@@ -1,0 +1,161 @@
+"""Privileges + authentication (ref: privilege/privileges/cache.go:94,
+mysql_native_password handshake auth in server/conn.go:246)."""
+
+import hashlib
+import struct
+
+import pytest
+
+from tidb_tpu.privilege.cache import PrivilegeError, mysql_native_hash, verify_native_password
+from tidb_tpu.server import Server
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    sess.execute("INSERT INTO t VALUES (1, 10)")
+    return sess
+
+
+def _as_user(base: Session, user: str) -> Session:
+    u = Session(base.store)
+    u.user = user
+    return u
+
+
+class TestGrants:
+    def test_default_deny_then_grant_select(self, s):
+        s.execute("CREATE USER 'bob' IDENTIFIED BY 'pw'")
+        bob = _as_user(s, "bob")
+        with pytest.raises(PrivilegeError):
+            bob.execute("SELECT * FROM t")
+        s.execute("GRANT SELECT ON test.* TO 'bob'")
+        assert bob.must_query("SELECT v FROM t WHERE id = 1") == [("10",)]
+        with pytest.raises(PrivilegeError):
+            bob.execute("INSERT INTO t VALUES (2, 20)")
+
+    def test_global_grant(self, s):
+        s.execute("CREATE USER adm")
+        s.execute("GRANT ALL ON *.* TO adm")
+        adm = _as_user(s, "adm")
+        adm.execute("CREATE TABLE t2 (id INT PRIMARY KEY)")
+        adm.execute("INSERT INTO t2 VALUES (5)")
+        assert adm.must_query("SELECT * FROM t2") == [("5",)]
+
+    def test_revoke(self, s):
+        s.execute("CREATE USER carol")
+        s.execute("GRANT SELECT, INSERT ON test.* TO carol")
+        carol = _as_user(s, "carol")
+        carol.execute("INSERT INTO t VALUES (3, 30)")
+        s.execute("REVOKE INSERT ON test.* FROM carol")
+        with pytest.raises(PrivilegeError):
+            carol.execute("INSERT INTO t VALUES (4, 40)")
+        assert carol.must_query("SELECT COUNT(*) FROM t") == [("2",)]
+
+    def test_ddl_privileges(self, s):
+        s.execute("CREATE USER dev")
+        s.execute("GRANT SELECT, CREATE ON test.* TO dev")
+        dev = _as_user(s, "dev")
+        dev.execute("CREATE TABLE devt (id INT PRIMARY KEY)")
+        with pytest.raises(PrivilegeError):
+            dev.execute("DROP TABLE devt")
+        with pytest.raises(PrivilegeError):
+            dev.execute("CREATE INDEX i ON t (v)")
+
+    def test_super_required_for_admin(self, s):
+        s.execute("CREATE USER pleb")
+        s.execute("GRANT SELECT ON test.* TO pleb")
+        pleb = _as_user(s, "pleb")
+        with pytest.raises(PrivilegeError):
+            pleb.execute("CREATE USER other")
+        with pytest.raises(PrivilegeError):
+            pleb.execute("ADMIN SHOW DDL JOBS")
+
+    def test_show_grants(self, s):
+        s.execute("CREATE USER gg")
+        s.execute("GRANT SELECT, UPDATE ON test.* TO gg")
+        rows = s.must_query("SHOW GRANTS FOR gg")
+        text = "\n".join(r[0] for r in rows)
+        assert "GRANT USAGE ON *.* TO 'gg'@'%'" in text
+        assert "GRANT SELECT, UPDATE ON `test`.* TO 'gg'@'%'" in text
+
+    def test_drop_user(self, s):
+        s.execute("CREATE USER tmp")
+        s.execute("DROP USER tmp")
+        tmp = _as_user(s, "tmp")
+        with pytest.raises(PrivilegeError):
+            tmp.execute("SELECT 1 FROM t")
+        with pytest.raises(PrivilegeError):
+            s.execute("DROP USER tmp")
+        s.execute("DROP USER IF EXISTS tmp")
+
+
+class TestNativePassword:
+    def test_hash_and_verify(self):
+        salt = b"0123456789abcdefghij"
+        pw = "sekrit"
+        stored = mysql_native_hash(pw)
+        inner = hashlib.sha1(pw.encode()).digest()
+        token = hashlib.sha1(salt + hashlib.sha1(inner).digest()).digest()
+        scramble = bytes(a ^ b for a, b in zip(token, inner))
+        assert verify_native_password(stored, salt, scramble)
+        assert not verify_native_password(stored, salt, b"\x00" * 20)
+        assert verify_native_password("", salt, b"")  # empty password user
+        assert not verify_native_password(stored, salt, b"")
+
+
+class TestWireAuth:
+    @pytest.fixture()
+    def server(self, s):
+        srv = Server(storage=s.store, port=0)
+        srv.start()
+        yield srv
+        srv.close()
+
+    def _connect(self, port, user, password):
+        from test_server import MiniMySQLClient
+        import socket
+
+        # handshake manually to compute the real scramble
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c = MiniMySQLClient.__new__(MiniMySQLClient)
+        c.sock = sock
+        c.seq = 0
+        hello = c._read_packet()
+        # salt: 8 bytes after version string + null, then 12 more later
+        i = hello.index(b"\x00", 1)
+        cid_end = i + 1 + 4
+        salt1 = hello[cid_end : cid_end + 8]
+        rest = hello[cid_end + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10 :]
+        salt2 = rest[:12]
+        salt = salt1 + salt2
+        if password:
+            inner = hashlib.sha1(password.encode()).digest()
+            token = hashlib.sha1(salt + hashlib.sha1(inner).digest()).digest()
+            auth = bytes(a ^ b for a, b in zip(token, inner))
+        else:
+            auth = b""
+        caps = 0x200 | 0x8000 | 0x1
+        payload = struct.pack("<IIB23x", caps, 1 << 24, 45)
+        payload += user.encode() + b"\x00" + bytes([len(auth)]) + auth
+        c._write_packet(payload)
+        return c, c._read_packet()
+
+    def test_password_auth_roundtrip(self, s, server):
+        s.execute("CREATE USER wired IDENTIFIED BY 'hunter2'")
+        s.execute("GRANT SELECT ON test.* TO wired")
+        c, ok = self._connect(server.port, "wired", "hunter2")
+        assert ok[0] == 0x00
+        assert c.query("SELECT v FROM t")[1] == [("10",)]
+        with pytest.raises(RuntimeError, match="denied"):
+            c.query("INSERT INTO t VALUES (9, 9)")
+        c.close()
+
+    def test_bad_password_rejected(self, s, server):
+        s.execute("CREATE USER wired2 IDENTIFIED BY 'right'")
+        _, resp = self._connect(server.port, "wired2", "wrong")
+        assert resp[0] == 0xFF
+        _, resp = self._connect(server.port, "ghost", "")
+        assert resp[0] == 0xFF

@@ -387,6 +387,45 @@ class SplitRegion:
 
 
 @dataclass
+class UserSpec:
+    user: str
+    host: str = "%"
+    password: str | None = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.user}@{self.host}"
+
+
+@dataclass
+class CreateUser:
+    users: list  # [UserSpec]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUser:
+    users: list
+    if_exists: bool = False
+
+
+@dataclass
+class Grant:
+    privs: list  # ['ALL'] or ['SELECT', ...]
+    db: str  # '*' for global
+    table: str  # '*' (table granularity folds into db level)
+    users: list  # [UserSpec]
+
+
+@dataclass
+class Revoke:
+    privs: list
+    db: str
+    table: str
+    users: list
+
+
+@dataclass
 class BRIEStmt:
     kind: str  # 'backup' | 'restore'
     storage: str = ""

@@ -17,7 +17,7 @@ TOKEN_RE = re.compile(
   | (?P<ident>[A-Za-z_\$][A-Za-z0-9_\$]*)
   | (?P<sysvar>@@(?:global\.|session\.)?[A-Za-z_][A-Za-z0-9_]*)
   | (?P<uservar>@[A-Za-z0-9_\.\$]+)
-  | (?P<op><=>|<<|>>|!=|<>|<=|>=|:=|\|\||&&|[-+*/%=<>(),.;!~&|^?{}\[\]:])
+  | (?P<op><=>|<<|>>|!=|<>|<=|>=|:=|\|\||&&|[-+*/%=<>(),.;!~&|^?{}\[\]:@])
     """,
     re.X | re.S,
 )

@@ -182,6 +182,8 @@ class Parser:
             "SPLIT": self.split_stmt,
             "BACKUP": self.brie_stmt,
             "RESTORE": self.brie_stmt,
+            "GRANT": self.grant_stmt,
+            "REVOKE": self.grant_stmt,
         }.get(kw)
         if fn is None:
             self.fail(f"unsupported statement {kw}")
@@ -855,8 +857,58 @@ class Parser:
 
     # --- DDL ---------------------------------------------------------------
 
+    def user_spec(self) -> "ast.UserSpec":
+        """'user'[@'host'] [IDENTIFIED BY 'pw'] (ref: parser user identity)."""
+        t = self.next()
+        if t.kind not in ("str", "ident", "qident"):
+            self.fail("expected user name")
+        host = "%"
+        if self.tok.kind == "uservar":  # unquoted u@host lexes the host as @ident
+            host = self.next().text[1:]
+        elif self.try_op("@"):
+            h = self.next()
+            if h.kind not in ("str", "ident", "qident"):
+                self.fail("expected host")
+            host = h.text
+        spec = ast.UserSpec(t.text, host)
+        if self.try_kw("IDENTIFIED"):
+            self.expect_kw("BY")
+            pw = self.next()
+            spec.password = pw.text
+        return spec
+
+    def _user_spec_list(self):
+        specs = [self.user_spec()]
+        while self.try_op(","):
+            specs.append(self.user_spec())
+        return specs
+
+    def grant_stmt(self):
+        kind = self.next().upper  # GRANT | REVOKE
+        privs = []
+        if self.try_kw("ALL"):
+            self.try_kw("PRIVILEGES")
+            privs = ["ALL"]
+        else:
+            while True:
+                privs.append(self.ident().upper())
+                if not self.try_op(","):
+                    break
+        self.expect_kw("ON")
+        db = self.ident() if not self.at_op("*") else (self.next().text and "*")
+        self.expect_op(".")
+        tbl = self.ident() if not self.at_op("*") else (self.next().text and "*")
+        self.expect_kw("TO") if kind == "GRANT" else self.expect_kw("FROM")
+        users = self._user_spec_list()
+        if kind == "GRANT":
+            return ast.Grant(privs, db, tbl, users)
+        return ast.Revoke(privs, db, tbl, users)
+
     def create_stmt(self):
         self.expect_kw("CREATE")
+        if self.try_kw("USER"):
+            ine = self._if_not_exists()
+            return ast.CreateUser(self._user_spec_list(), ine)
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ine = self._if_not_exists()
@@ -998,6 +1050,9 @@ class Parser:
 
     def drop_stmt(self):
         self.expect_kw("DROP")
+        if self.try_kw("USER"):
+            ie = self._if_exists()
+            return ast.DropUser(self._user_spec_list(), ie)
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ie = self._if_exists()
@@ -1144,6 +1199,10 @@ class Parser:
                 node.target = self.ident()
         elif self.try_kw("DATABASES") or self.try_kw("SCHEMAS"):
             node.kind = "databases"
+        elif self.try_kw("GRANTS"):
+            node.kind = "grants"
+            if self.try_kw("FOR"):
+                node.target = self.user_spec()
         elif self.try_kw("CREATE"):
             self.expect_kw("TABLE")
             node.kind = "create_table"

@@ -1,0 +1,3 @@
+from .cache import PrivilegeCache, mysql_native_hash
+
+__all__ = ["PrivilegeCache", "mysql_native_hash"]

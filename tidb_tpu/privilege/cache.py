@@ -1,0 +1,136 @@
+"""Privilege cache — MySQL-compatible grants loaded from mysql.user /
+mysql.db (ref: privilege/privileges/cache.go:94 UserRecord + :120; the
+reference caches the mysql.* privilege tables in memory and reloads on
+a notify version — here the version is a meta-keyspace counter bumped by
+user-admin statements)."""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..errors import TiDBError
+
+PRIVS = {
+    "SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
+    "ALTER", "INDEX", "PROCESS", "SUPER",
+}
+
+K_PRIV_VERSION = b"m:priv_version"
+
+
+class PrivilegeError(TiDBError):
+    pass
+
+
+def mysql_native_hash(password: str) -> str:
+    """MySQL password hash: '*' + HEX(SHA1(SHA1(pw)))."""
+    if not password:
+        return ""
+    inner = hashlib.sha1(password.encode()).digest()
+    return "*" + hashlib.sha1(inner).hexdigest().upper()
+
+
+def verify_native_password(auth_string: str, salt: bytes, scramble: bytes) -> bool:
+    """mysql_native_password: client sends SHA1(pw) XOR SHA1(salt+SHA1(SHA1(pw)))."""
+    if not auth_string:
+        return len(scramble) == 0
+    if not scramble:
+        return False
+    stored = bytes.fromhex(auth_string.lstrip("*"))
+    token = hashlib.sha1(salt + stored).digest()
+    candidate = bytes(a ^ b for a, b in zip(token, scramble))
+    return hashlib.sha1(candidate).digest() == stored
+
+
+class PrivilegeCache:
+    """Per-storage cache of user records + grants."""
+
+    def __init__(self, storage):
+        self.storage = storage
+        self._version = -1
+        self._users: dict[str, dict] = {}  # user → {auth, global: set}
+        self._db_privs: dict[tuple[str, str], set] = {}  # (user, db) → privs
+
+    # --- version -----------------------------------------------------------
+
+    def version(self) -> int:
+        txn = self.storage.begin()
+        v = int(txn.get(K_PRIV_VERSION) or b"0")
+        txn.rollback()
+        return v
+
+    def bump_version(self) -> None:
+        txn = self.storage.begin()
+        v = int(txn.get(K_PRIV_VERSION) or b"0") + 1
+        txn.put(K_PRIV_VERSION, str(v).encode())
+        txn.commit()
+
+    # --- load --------------------------------------------------------------
+
+    def _ensure(self, session) -> None:
+        v = self.version()
+        if v == self._version:
+            return
+        users: dict[str, dict] = {}
+        db_privs: dict[tuple[str, str], set] = {}
+        for host, user, auth, privs in session._sql_internal(
+            "SELECT host, user, auth_string, privs FROM mysql.user"
+        ):
+            pset = set() if not privs else set(privs.split(","))
+            users[(user or "").lower()] = {"auth": auth or "", "global": pset, "host": host}
+        for host, user, db, privs in session._sql_internal(
+            "SELECT host, user, db, privs FROM mysql.db"
+        ):
+            pset = set() if not privs else set(privs.split(","))
+            db_privs[((user or "").lower(), (db or "").lower())] = pset
+        self._users = users
+        self._db_privs = db_privs
+        self._version = v
+
+    # --- checks ------------------------------------------------------------
+
+    def user_exists(self, session, user: str) -> bool:
+        self._ensure(session)
+        return user.lower() in self._users
+
+    def auth(self, session, user: str, salt: bytes, scramble: bytes) -> bool:
+        self._ensure(session)
+        rec = self._users.get(user.lower())
+        if rec is None:
+            return False
+        return verify_native_password(rec["auth"], salt, scramble)
+
+    def check(self, session, user: str, db: str, priv: str) -> bool:
+        self._ensure(session)
+        rec = self._users.get(user.lower())
+        if rec is None:
+            return False
+        g = rec["global"]
+        if "ALL" in g or priv in g:
+            return True
+        d = self._db_privs.get((user.lower(), db.lower()), set())
+        return "ALL" in d or priv in d
+
+    def require(self, session, user: str, db: str, priv: str) -> None:
+        if not self.check(session, user, db, priv):
+            raise PrivilegeError(
+                f"{priv} command denied to user '{user}'@'%' for database '{db}'"
+            )
+
+    def grants_for(self, session, user: str) -> list[str]:
+        self._ensure(session)
+        rec = self._users.get(user.lower())
+        if rec is None:
+            raise PrivilegeError(f"There is no such grant defined for user '{user}'")
+        out = []
+        g = rec["global"]
+        if g:
+            privs = "ALL PRIVILEGES" if "ALL" in g else ", ".join(sorted(g))
+            out.append(f"GRANT {privs} ON *.* TO '{user}'@'%'")
+        else:
+            out.append(f"GRANT USAGE ON *.* TO '{user}'@'%'")
+        for (u, db), privs in sorted(self._db_privs.items()):
+            if u == user.lower() and privs:
+                ps = "ALL PRIVILEGES" if "ALL" in privs else ", ".join(sorted(privs))
+                out.append(f"GRANT {ps} ON `{db}`.* TO '{user}'@'%'")
+        return out

@@ -46,9 +46,17 @@ class ClientConn:
         self.pkt.write_packet(p.handshake_v10(self.conn_id, salt))
         resp = p.parse_handshake_response(self.pkt.read_packet())
         self.user = resp["user"]
+        # authenticate against the privilege cache (ref: conn.go:246
+        # openSessionAndDoAuth + privilege cache mysql_native_password)
+        if not self.session.priv.auth(self.session, self.user, salt[:20], resp["auth"]):
+            self.pkt.write_packet(
+                p.err_packet(1045, f"Access denied for user '{self.user}'@'%'", "28000")
+            )
+            self.alive = False
+            return
+        self.session.user = self.user
         if resp["db"]:
             self.session.current_db = resp["db"]
-        # auth seam: accept all users until the privilege cache lands
         self.pkt.write_packet(p.ok_packet())
 
     def run(self) -> None:

@@ -89,6 +89,10 @@ class Session:
 
         self._plan_cache: OrderedDict = OrderedDict()
         self.plan_cache_hits = 0
+        # authenticated identity (set by the wire handshake; in-process
+        # sessions run as root, the bootstrap superuser)
+        self.user = "root"
+        self._in_bootstrap = False
         self._bootstrap()
 
     PLAN_CACHE_SIZE = 128
@@ -96,7 +100,8 @@ class Session:
     # ------------------------------------------------------------- bootstrap
 
     def _bootstrap(self):
-        """Create system + default schemas (ref: session/bootstrap.go)."""
+        """Create system + default schemas and the privilege tables with a
+        root superuser (ref: session/bootstrap.go — mysql.user et al)."""
         txn = self.store.begin()
         m = Meta(txn)
         if m.db("test") is None:
@@ -106,6 +111,40 @@ class Session:
             txn.commit()
         else:
             txn.rollback()
+        self._ensure_priv_tables()
+
+    def _ensure_priv_tables(self):
+        """Idempotent bootstrap upgrade (ref: bootstrap.go upgrade():643):
+        stores created before the privilege subsystem gain mysql.user/db
+        with the root superuser on first open."""
+        try:
+            self.infoschema().table("mysql", "user")
+            return
+        except UnknownTable:
+            pass
+        self._in_bootstrap = True
+        try:
+            self.execute(
+                "CREATE TABLE mysql.user (host VARCHAR(64), user VARCHAR(32), "
+                "auth_string VARCHAR(64), privs VARCHAR(512))"
+            )
+            self.execute(
+                "CREATE TABLE mysql.db (host VARCHAR(64), user VARCHAR(32), "
+                "db VARCHAR(64), privs VARCHAR(512))"
+            )
+            self.execute("INSERT INTO mysql.user VALUES ('%', 'root', '', 'ALL')")
+        finally:
+            self._in_bootstrap = False
+
+    def _sql_internal(self, sql: str) -> list[tuple]:
+        """Run SQL as the internal superuser (privilege checks suspended —
+        the sysSessionPool analog, domain.go)."""
+        prev = self._in_bootstrap
+        self._in_bootstrap = True
+        try:
+            return self.execute(sql).rows()
+        finally:
+            self._in_bootstrap = prev
 
     # ------------------------------------------------------------- infoschema
 
@@ -191,7 +230,110 @@ class Session:
     def must_query(self, sql: str) -> list[tuple]:
         return self.execute(sql).rows()
 
+    # --------------------------------------------------------- privileges
+
+    @property
+    def priv(self):
+        if getattr(self.store, "_priv_cache", None) is None:
+            from ..privilege import PrivilegeCache
+
+            self.store._priv_cache = PrivilegeCache(self.store)
+        return self.store._priv_cache
+
+    def _stmt_privileges(self, stmt) -> list[tuple[str, str]]:
+        """→ [(priv, db)] required by this statement (ref: the reference's
+        visitInfo collection in planbuilder.go)."""
+
+        def from_dbs(node, out):
+            if isinstance(node, ast.TableName):
+                out.add((node.db or self.current_db).lower())
+            elif isinstance(node, ast.Join):
+                from_dbs(node.left, out)
+                from_dbs(node.right, out)
+            elif isinstance(node, ast.SubqueryTable):
+                sel_dbs(node.select, out)
+
+        def expr_dbs(e, out):
+            if isinstance(e, ast.SubqueryExpr):
+                sel_dbs(e.select, out)
+            elif isinstance(e, ast.Call):
+                for a in e.args:
+                    expr_dbs(a, out)
+            elif isinstance(e, ast.CaseWhen):
+                for pair in e.whens:
+                    expr_dbs(pair[0], out)
+                    expr_dbs(pair[1], out)
+                if e.operand is not None:
+                    expr_dbs(e.operand, out)
+                if e.else_ is not None:
+                    expr_dbs(e.else_, out)
+            elif isinstance(e, ast.Cast):
+                expr_dbs(e.expr, out)
+
+        def sel_dbs(sel, out):
+            if isinstance(sel, ast.SetOpSelect):
+                for s in sel.selects:
+                    sel_dbs(s, out)
+                return
+            wf = getattr(sel, "with_", None)
+            if wf is not None:
+                for cte in wf.ctes:
+                    sel_dbs(cte.select, out)
+            if sel.from_ is not None:
+                from_dbs(sel.from_, out)
+            for e in [sel.where, sel.having] + [f.expr for f in sel.fields if not isinstance(f, ast.Star)]:
+                if e is not None:
+                    expr_dbs(e, out)
+
+        if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
+            dbs: set[str] = set()
+            sel_dbs(stmt, dbs)
+            return [("SELECT", d) for d in dbs]
+        if isinstance(stmt, ast.Insert):
+            out = [("INSERT", (stmt.table.db or self.current_db).lower())]
+            if stmt.select is not None:  # INSERT ... SELECT reads too
+                dbs: set[str] = set()
+                sel_dbs(stmt.select, dbs)
+                out += [("SELECT", d) for d in dbs]
+            return out
+        if isinstance(stmt, ast.LoadData):
+            return [("INSERT", (stmt.table.db or self.current_db).lower())]
+        if isinstance(stmt, ast.Update):
+            db = (stmt.table.db or self.current_db).lower() if isinstance(stmt.table, ast.TableName) else self.current_db
+            return [("UPDATE", db)]
+        if isinstance(stmt, ast.Delete):
+            db = (stmt.table.db or self.current_db).lower() if isinstance(stmt.table, ast.TableName) else self.current_db
+            return [("DELETE", db)]
+        if isinstance(stmt, (ast.CreateTable, ast.CreateDatabase)):
+            db = getattr(getattr(stmt, "table", None), "db", None) or getattr(stmt, "name", None) or self.current_db
+            return [("CREATE", db.lower())]
+        if isinstance(stmt, ast.CreateIndex):
+            return [("INDEX", (stmt.table.db or self.current_db).lower())]
+        if isinstance(stmt, ast.DropIndex):
+            return [("INDEX", (stmt.table.db or self.current_db).lower())]
+        if isinstance(stmt, ast.DropTable):
+            return [("DROP", (tn.db or self.current_db).lower()) for tn in stmt.tables]
+        if isinstance(stmt, ast.DropDatabase):
+            return [("DROP", stmt.name.lower())]
+        if isinstance(stmt, ast.TruncateTable):
+            return [("DROP", (stmt.table.db or self.current_db).lower())]
+        if isinstance(stmt, ast.AlterTable):
+            return [("ALTER", (stmt.table.db or self.current_db).lower())]
+        if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.Grant, ast.Revoke,
+                             ast.BRIEStmt, ast.AdminStmt, ast.KillStmt)):
+            return [("SUPER", "*")]
+        return []  # SET/SHOW/USE/txn control etc. need no table privilege
+
+    def _check_privileges(self, stmt) -> None:
+        if self._in_bootstrap:
+            return
+        for priv, db in self._stmt_privileges(stmt):
+            if db in ("information_schema", "performance_schema"):
+                continue
+            self.priv.require(self, self.user, db, priv)
+
     def _execute_stmt(self, stmt, sql: str | None = None) -> ResultSet:
+        self._check_privileges(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             return self.run_select(stmt, sql=sql)
         if isinstance(stmt, ast.Insert):
@@ -269,6 +411,12 @@ class Session:
             return ResultSet([], None)
         if isinstance(stmt, ast.AdminStmt) and stmt.kind == "show_ddl_jobs":
             return self._admin_show_ddl_jobs()
+        if isinstance(stmt, ast.CreateUser):
+            return self._run_create_user(stmt)
+        if isinstance(stmt, ast.DropUser):
+            return self._run_drop_user(stmt)
+        if isinstance(stmt, (ast.Grant, ast.Revoke)):
+            return self._run_grant_revoke(stmt)
         if isinstance(stmt, ast.BRIEStmt):
             from .. import br
 
@@ -278,6 +426,99 @@ class Session:
 
             return br.run_load_data(self, stmt)
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------- user admin
+
+    @staticmethod
+    def _q(s: str) -> str:
+        """Escape a value for single-quoted interpolation into internal
+        SQL (privilege checks are suspended there — injection-proof)."""
+        return (s or "").replace("\\", "\\\\").replace("'", "''")
+
+    def _run_create_user(self, stmt: ast.CreateUser) -> ResultSet:
+        from ..privilege import mysql_native_hash
+        from ..privilege.cache import PrivilegeError
+
+        for spec in stmt.users:
+            if self.priv.user_exists(self, spec.user):
+                if stmt.if_not_exists:
+                    continue
+                raise PrivilegeError(f"CREATE USER failed: '{spec.user}' already exists")
+            h = mysql_native_hash(spec.password or "")
+            self._sql_internal(
+                f"INSERT INTO mysql.user VALUES ('{self._q(spec.host)}', '{self._q(spec.user)}', '{h}', '')"
+            )
+        self.priv.bump_version()
+        return ResultSet([], None)
+
+    def _run_drop_user(self, stmt: ast.DropUser) -> ResultSet:
+        from ..privilege.cache import PrivilegeError
+
+        for spec in stmt.users:
+            if not self.priv.user_exists(self, spec.user):
+                if stmt.if_exists:
+                    continue
+                raise PrivilegeError(f"DROP USER failed: '{spec.user}' does not exist")
+            self._sql_internal(f"DELETE FROM mysql.user WHERE user = '{self._q(spec.user)}'")
+            self._sql_internal(f"DELETE FROM mysql.db WHERE user = '{self._q(spec.user)}'")
+        self.priv.bump_version()
+        return ResultSet([], None)
+
+    def _run_grant_revoke(self, stmt) -> ResultSet:
+        from ..privilege.cache import PRIVS, PrivilegeError
+
+        grant = isinstance(stmt, ast.Grant)
+        privs = set(p.upper() for p in stmt.privs)
+        unknown = privs - PRIVS - {"ALL"}
+        if unknown:
+            raise TiDBError(f"unknown privilege(s): {', '.join(sorted(unknown))}")
+        for spec in stmt.users:
+            if not self.priv.user_exists(self, spec.user):
+                raise PrivilegeError(f"there is no such user '{spec.user}'")
+            u = self._q(spec.user)
+            if stmt.db == "*":
+                rows = self._sql_internal(f"SELECT privs FROM mysql.user WHERE user = '{u}'")
+                cur = set((rows[0][0] or "").split(",")) - {""}
+                new = self._apply_priv_change(cur, privs, grant)
+                self._sql_internal(
+                    f"UPDATE mysql.user SET privs = '{','.join(sorted(new))}' WHERE user = '{u}'"
+                )
+            else:
+                d = self._q(stmt.db)
+                rows = self._sql_internal(
+                    f"SELECT privs FROM mysql.db WHERE user = '{u}' AND db = '{d}'"
+                )
+                if not rows and not grant:
+                    raise PrivilegeError(
+                        f"there is no such grant defined for user '{spec.user}' on '{stmt.db}'"
+                    )
+                cur = set((rows[0][0] or "").split(",")) - {""} if rows else set()
+                new = self._apply_priv_change(cur, privs, grant)
+                if rows:
+                    self._sql_internal(
+                        f"UPDATE mysql.db SET privs = '{','.join(sorted(new))}' "
+                        f"WHERE user = '{u}' AND db = '{d}'"
+                    )
+                else:
+                    self._sql_internal(
+                        f"INSERT INTO mysql.db VALUES ('{self._q(spec.host)}', '{u}', "
+                        f"'{d}', '{','.join(sorted(new))}')"
+                    )
+        self.priv.bump_version()
+        return ResultSet([], None)
+
+    @staticmethod
+    def _apply_priv_change(cur: set, privs: set, grant: bool) -> set:
+        from ..privilege.cache import PrivilegeError
+
+        if grant:
+            return cur | privs
+        if "ALL" in privs:
+            return set()
+        if "ALL" in cur:
+            # MySQL: revoking a specific priv from an ALL holder errors
+            raise PrivilegeError("cannot partially revoke from an ALL PRIVILEGES grant")
+        return cur - privs
 
     def _admin_show_ddl_jobs(self) -> ResultSet:
         """ADMIN SHOW DDL JOBS (ref: executor ShowDDLJobsExec)."""
@@ -1015,6 +1256,11 @@ class Session:
 
     def _run_show(self, stmt: ast.Show) -> ResultSet:
         is_ = self.infoschema()
+        if stmt.kind == "grants":
+            user = stmt.target.user if stmt.target is not None else self.user
+            grants = self.priv.grants_for(self, user)
+            chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(g)] for g in grants])
+            return ResultSet([f"Grants for {user}@%"], chk)
         if stmt.kind == "databases":
             names = is_.db_names()
             chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(n)] for n in names])
