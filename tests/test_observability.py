@@ -1,0 +1,97 @@
+"""Observability: INFORMATION_SCHEMA memtables, slow log, statement
+summary, metrics, memory quota (ref: infoschema/tables.go,
+util/stmtsummary, metrics/, util/memory/tracker.go:54)."""
+
+import urllib.request
+
+import pytest
+
+from tidb_tpu.errors import MemoryQuotaExceeded
+from tidb_tpu.server import Server
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, v VARCHAR(16), KEY ig (g))")
+    sess.execute("INSERT INTO t VALUES " + ",".join(f"({i}, {i % 5}, 'v{i}')" for i in range(100)))
+    return sess
+
+
+class TestInfoSchema:
+    def test_tables_memtable(self, s):
+        rows = s.must_query(
+            "SELECT table_schema, table_name FROM information_schema.tables "
+            "WHERE table_schema = 'test' ORDER BY table_name"
+        )
+        assert ("test", "t") in rows
+
+    def test_columns_memtable(self, s):
+        rows = s.must_query(
+            "SELECT column_name, data_type FROM information_schema.columns "
+            "WHERE table_name = 't' ORDER BY ordinal_position"
+        )
+        assert [r[0] for r in rows] == ["id", "g", "v"]
+
+    def test_tidb_indexes(self, s):
+        rows = s.must_query(
+            "SELECT key_name, column_names, state FROM information_schema.tidb_indexes "
+            "WHERE table_name = 't' ORDER BY key_name"
+        )
+        assert ("ig", "g", "public") in rows
+
+    def test_metrics_memtable(self, s):
+        s.must_query("SELECT COUNT(*) FROM t")
+        rows = s.must_query(
+            "SELECT name, value FROM information_schema.metrics WHERE name = 'tidb_query_duration_seconds_count'"
+        )
+        assert len(rows) == 1 and float(rows[0][1]) > 0
+
+
+class TestSlowLogAndSummary:
+    def test_statement_summary_aggregates(self, s):
+        for i in range(3):
+            s.must_query(f"SELECT v FROM t WHERE id = {i}")
+        rows = s.must_query(
+            "SELECT exec_count, digest_text FROM information_schema.statements_summary "
+            "WHERE digest_text LIKE 'SELECT v FROM t%'"
+        )
+        assert len(rows) == 1
+        assert int(rows[0][0]) == 3  # same digest despite different literals
+
+    def test_slow_log_threshold(self, s):
+        s.vars["tidb_slow_log_threshold"] = "0"  # everything is slow
+        s.must_query("SELECT COUNT(*) FROM t")
+        s.vars["tidb_slow_log_threshold"] = "300"
+        rows = s.must_query(
+            "SELECT query, user FROM information_schema.slow_query ORDER BY time DESC"
+        )
+        assert any("SELECT COUNT(*) FROM t" in r[0] for r in rows)
+        assert all(r[1] == "root" for r in rows)
+
+
+class TestMemoryQuota:
+    def test_quota_exceeded_cancels(self, s):
+        s.vars["tidb_mem_quota_query"] = "64"
+        with pytest.raises(MemoryQuotaExceeded):
+            s.must_query("SELECT * FROM t")
+        s.vars["tidb_mem_quota_query"] = str(1 << 30)
+        assert len(s.must_query("SELECT * FROM t")) == 100
+
+
+class TestStatusHTTP:
+    def test_metrics_and_status_endpoints(self, s):
+        srv = Server(storage=s.store, port=0, status_port=0)
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/metrics", timeout=10
+            ).read().decode()
+            assert "tidb_query_duration_seconds_count" in body
+            status = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/status", timeout=10
+            ).read().decode()
+            assert "tidb-tpu" in status
+        finally:
+            srv.close()

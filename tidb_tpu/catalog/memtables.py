@@ -1,0 +1,100 @@
+"""INFORMATION_SCHEMA memtables (ref: infoschema/tables.go memtable
+framework + executor/infoschema_reader.go, slow_query.go,
+metrics_reader.go — virtual tables materialized from in-memory state at
+read time)."""
+
+from __future__ import annotations
+
+import datetime
+
+from ..mysqltypes.datum import Datum
+from ..mysqltypes.field_type import ft_double, ft_longlong, ft_varchar
+
+# table → (column names, field types)
+SCHEMAS: dict[str, tuple[list[str], list]] = {
+    "tables": (
+        ["TABLE_SCHEMA", "TABLE_NAME", "TABLE_ID", "TABLE_ROWS", "PK_IS_HANDLE"],
+        [ft_varchar(64), ft_varchar(64), ft_longlong(), ft_longlong(), ft_longlong()],
+    ),
+    "columns": (
+        ["TABLE_SCHEMA", "TABLE_NAME", "COLUMN_NAME", "ORDINAL_POSITION", "DATA_TYPE"],
+        [ft_varchar(64), ft_varchar(64), ft_varchar(64), ft_longlong(), ft_varchar(32)],
+    ),
+    "slow_query": (
+        ["TIME", "USER", "DB", "QUERY_TIME", "DIGEST", "SUCC", "QUERY"],
+        [ft_varchar(32), ft_varchar(32), ft_varchar(64), ft_double(), ft_varchar(32), ft_longlong(), ft_varchar(512)],
+    ),
+    "statements_summary": (
+        ["DIGEST", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY", "AVG_LATENCY", "ERRORS", "DIGEST_TEXT"],
+        [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_longlong(), ft_varchar(256)],
+    ),
+    "metrics": (
+        ["NAME", "LABELS", "VALUE"],
+        [ft_varchar(64), ft_varchar(128), ft_double()],
+    ),
+    "tidb_indexes": (
+        ["TABLE_SCHEMA", "TABLE_NAME", "KEY_NAME", "COLUMN_NAMES", "NON_UNIQUE", "STATE"],
+        [ft_varchar(64), ft_varchar(64), ft_varchar(64), ft_varchar(256), ft_longlong(), ft_varchar(16)],
+    ),
+}
+
+
+def rows_for(session, name: str) -> list[list[Datum]]:
+    name = name.lower()
+    if name == "tables":
+        is_ = session.infoschema()
+        out = []
+        for t in sorted(is_.tables.values(), key=lambda x: (x.db_name, x.name)):
+            st = session.store.stats.get(t.id)
+            rows = st.row_count if st is not None else 0
+            out.append([
+                Datum.s(t.db_name), Datum.s(t.name), Datum.i(t.id),
+                Datum.i(int(rows)), Datum.i(1 if t.pk_is_handle else 0),
+            ])
+        return out
+    if name == "columns":
+        is_ = session.infoschema()
+        out = []
+        for t in sorted(is_.tables.values(), key=lambda x: (x.db_name, x.name)):
+            for c in t.visible_columns():
+                out.append([
+                    Datum.s(t.db_name), Datum.s(t.name), Datum.s(c.name),
+                    Datum.i(c.offset + 1), Datum.s(c.ft.tp.name.lower()),
+                ])
+        return out
+    if name == "slow_query":
+        out = []
+        for e in session.store.stmt_stats.slow:
+            ts = datetime.datetime.fromtimestamp(e["time"]).strftime("%Y-%m-%d %H:%M:%S")
+            out.append([
+                Datum.s(ts), Datum.s(e["user"]), Datum.s(e["db"]),
+                Datum.f(e["query_time_s"]), Datum.s(e["digest"]),
+                Datum.i(1 if e["succ"] else 0), Datum.s(e["query"]),
+            ])
+        return out
+    if name == "statements_summary":
+        out = []
+        for st in session.store.stmt_stats.summary.values():
+            avg = st["sum_latency_s"] / st["exec_count"] if st["exec_count"] else 0.0
+            out.append([
+                Datum.s(st["digest"]), Datum.i(st["exec_count"]),
+                Datum.f(st["sum_latency_s"]), Datum.f(st["max_latency_s"]),
+                Datum.f(avg), Datum.i(st["errors"]), Datum.s(st["sample_sql"]),
+            ])
+        return out
+    if name == "metrics":
+        from ..utils.metrics import REGISTRY
+
+        return [[Datum.s(n), Datum.s(l), Datum.f(v)] for n, l, v in REGISTRY.rows()]
+    if name == "tidb_indexes":
+        is_ = session.infoschema()
+        out = []
+        for t in sorted(is_.tables.values(), key=lambda x: (x.db_name, x.name)):
+            for ix in t.indexes:
+                cols = ",".join(t.columns[o].name for o in ix.col_offsets)
+                out.append([
+                    Datum.s(t.db_name), Datum.s(t.name), Datum.s(ix.name),
+                    Datum.s(cols), Datum.i(0 if ix.unique else 1), Datum.s(ix.state),
+                ])
+        return out
+    raise KeyError(name)

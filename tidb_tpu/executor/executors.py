@@ -22,6 +22,7 @@ from ..mysqltypes.mydecimal import Dec, pow10
 from ..planner.plans import (
     Aggregation,
     CTERef as CTERefPlan,
+    Memtable as MemtablePlan,
     DataSource,
     Dual,
     Join,
@@ -44,6 +45,12 @@ class ExecContext:
         self.vars = vars or {}
         self.txn = txn  # for dirty-read merge (UnionScan) later
 
+import contextvars
+
+# statement-scoped memory tracker consumed by drain() at materialization
+# points (ref: util/memory tracker attached session->executor)
+_ACTIVE_TRACKER: contextvars.ContextVar = contextvars.ContextVar("mem_tracker", default=None)
+
 
 class Executor:
     out_fts: list[FieldType]
@@ -59,6 +66,7 @@ class Executor:
 
 
 def drain(e: Executor) -> Chunk:
+    tracker = _ACTIVE_TRACKER.get()
     e.open()
     chunks = []
     while True:
@@ -66,6 +74,10 @@ def drain(e: Executor) -> Chunk:
         if c is None:
             break
         if c.num_rows:
+            if tracker is not None:
+                from ..utils.memory import chunk_bytes
+
+                tracker.consume(chunk_bytes(c))
             chunks.append(c)
     e.close()
     if not chunks:
@@ -120,6 +132,8 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
             out_fts,
             na_key=plan.na_key,
         )
+    if isinstance(plan, MemtablePlan):
+        return MemtableExec(plan)
     if isinstance(plan, CTERefPlan):
         return CTERefExec(plan)
     if isinstance(plan, RecursiveCTEPlan):
@@ -1209,6 +1223,25 @@ class IndexLookupJoinExec(Executor):
             self.out_fts,
         )
         return drain(inner)
+
+
+class MemtableExec(Executor):
+    """Materializes an INFORMATION_SCHEMA virtual table
+    (ref: executor/infoschema_reader.go memtableRetriever)."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.out_fts = [c.ft for c in plan.out_cols]
+        self._done = False
+
+    def open(self):
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        return Chunk.from_datum_rows(self.out_fts, self.plan.provider())
 
 
 class CTERefExec(Executor):

@@ -128,11 +128,12 @@ class PlanBuilder:
         c = scope.cols[idx]
         return ECol(idx, c.ft, c.name)
 
-    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None):
+    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None):
         self.is_ = infoschema
         self.db = current_db
         self.run_subquery = run_subquery  # callable(Select ast) -> list[Datum rows]
         self.params = params  # EXECUTE-bound Constants for '?' placeholders
+        self.memtable_rows = memtable_rows  # callable(name) -> rows (info schema)
         # set when a subquery was evaluated eagerly at plan time: such a
         # plan bakes in data and must not enter the plan cache
         self.used_eager_subquery = False
@@ -240,6 +241,20 @@ class PlanBuilder:
             ent = self._lookup_cte(tn.name)
             if ent is not None:
                 return self._build_cte(tn, ent)
+        db = (tn.db or self.db).lower()
+        if db == "information_schema" and self.memtable_rows is not None:
+            from ..catalog.memtables import SCHEMAS
+
+            schema = SCHEMAS.get(tn.name.lower())
+            if schema is not None:
+                names, fts = schema
+                alias = tn.alias or tn.name
+                cols = [PlanCol(n, ft, alias) for n, ft in zip(names, fts)]
+                provider = self.memtable_rows
+                name = tn.name.lower()
+                from .plans import Memtable
+
+                return Memtable(name, lambda: provider(name), cols)
         db = tn.db or self.db
         info = self.is_.table(db, tn.name)
         cols = [

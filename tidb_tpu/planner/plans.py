@@ -151,6 +151,19 @@ class Dual(LogicalPlan):
         super().__init__([], [])
 
 
+class Memtable(LogicalPlan):
+    """Virtual table materialized from in-memory state at read time
+    (ref: infoschema memtable framework, tables.go)."""
+
+    def __init__(self, name: str, provider, cols):
+        super().__init__([], cols)
+        self.name = name
+        self.provider = provider  # callable() -> list[list[Datum]]
+
+    def describe(self):
+        return f"Memtable({self.name})"
+
+
 class CTEStorage:
     """Shared buffer between a RecursiveCTE producer and its CTERef readers
     (ref: util/cteutil storage)."""

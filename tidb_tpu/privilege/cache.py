@@ -8,15 +8,14 @@ from __future__ import annotations
 
 import hashlib
 
+import threading
+
 from ..errors import TiDBError
 
 PRIVS = {
     "SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
     "ALTER", "INDEX", "PROCESS", "SUPER",
 }
-
-K_PRIV_VERSION = b"m:priv_version"
-
 
 class PrivilegeError(TiDBError):
     pass
@@ -47,45 +46,51 @@ class PrivilegeCache:
 
     def __init__(self, storage):
         self.storage = storage
+        # in-memory notify version (the etcd-notify analog); the cache
+        # object lives on the Storage, so a restart naturally reloads
+        self.notify_version = 0
         self._version = -1
+        self._lock = threading.Lock()
+        self._sys_session = None
         self._users: dict[str, dict] = {}  # user → {auth, global: set}
         self._db_privs: dict[tuple[str, str], set] = {}  # (user, db) → privs
 
-    # --- version -----------------------------------------------------------
-
-    def version(self) -> int:
-        txn = self.storage.begin()
-        v = int(txn.get(K_PRIV_VERSION) or b"0")
-        txn.rollback()
-        return v
-
     def bump_version(self) -> None:
-        txn = self.storage.begin()
-        v = int(txn.get(K_PRIV_VERSION) or b"0") + 1
-        txn.put(K_PRIV_VERSION, str(v).encode())
-        txn.commit()
+        with self._lock:
+            self.notify_version += 1
+
+    def _sys(self):
+        """Dedicated internal session: cache loads must see COMMITTED
+        grants, never a calling session's transaction snapshot."""
+        if self._sys_session is None:
+            from ..session import Session
+
+            self._sys_session = Session(self.storage)
+        return self._sys_session
 
     # --- load --------------------------------------------------------------
 
     def _ensure(self, session) -> None:
-        v = self.version()
-        if v == self._version:
-            return
-        users: dict[str, dict] = {}
-        db_privs: dict[tuple[str, str], set] = {}
-        for host, user, auth, privs in session._sql_internal(
-            "SELECT host, user, auth_string, privs FROM mysql.user"
-        ):
-            pset = set() if not privs else set(privs.split(","))
-            users[(user or "").lower()] = {"auth": auth or "", "global": pset, "host": host}
-        for host, user, db, privs in session._sql_internal(
-            "SELECT host, user, db, privs FROM mysql.db"
-        ):
-            pset = set() if not privs else set(privs.split(","))
-            db_privs[((user or "").lower(), (db or "").lower())] = pset
-        self._users = users
-        self._db_privs = db_privs
-        self._version = v
+        with self._lock:
+            v = self.notify_version
+            if v == self._version:
+                return
+            sess = self._sys()
+            users: dict[str, dict] = {}
+            db_privs: dict[tuple[str, str], set] = {}
+            for host, user, auth, privs in sess._sql_internal(
+                "SELECT host, user, auth_string, privs FROM mysql.user"
+            ):
+                pset = set() if not privs else set(privs.split(","))
+                users[(user or "").lower()] = {"auth": auth or "", "global": pset, "host": host}
+            for host, user, db, privs in sess._sql_internal(
+                "SELECT host, user, db, privs FROM mysql.db"
+            ):
+                pset = set() if not privs else set(privs.split(","))
+                db_privs[((user or "").lower(), (db or "").lower())] = pset
+            self._users = users
+            self._db_privs = db_privs
+            self._version = v
 
     # --- checks ------------------------------------------------------------
 

@@ -126,13 +126,16 @@ class ClientConn:
 class Server:
     """Socket accept loop (ref: server/server.go Run/onConn)."""
 
-    def __init__(self, storage: Storage | None = None, host: str = "127.0.0.1", port: int = 4000):
+    def __init__(self, storage: Storage | None = None, host: str = "127.0.0.1", port: int = 4000,
+                 status_port: int | None = None):
         self.storage = storage or Storage()
         from ..copr.client import CopClient
 
         self.cop = CopClient(self.storage)  # shared across connections
         self.host = host
         self.port = port
+        self.status_port = status_port
+        self._status_httpd = None
         self.closing = False
         self._sock: socket.socket | None = None
         self._conns: dict[int, ClientConn] = {}
@@ -147,8 +150,49 @@ class Server:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(128)
         threading.Thread(target=self._accept_loop, name="mysql-accept", daemon=True).start()
+        if self.status_port is not None:
+            self._start_status_server()
         log.info("listening on %s:%d", self.host, self.port)
         return self.port
+
+    def _start_status_server(self) -> None:
+        """HTTP status/debug API: /status and /metrics
+        (ref: server/http_status.go:111-163)."""
+        import json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: D102 — quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    from ..utils.metrics import REGISTRY
+
+                    body = REGISTRY.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/status":
+                    with server._lock:
+                        conns = len(server._conns)
+                    body = json.dumps(
+                        {"connections": conns, "version": "8.0.11-tidb-tpu", "git_hash": "tpu-native"}
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._status_httpd = ThreadingHTTPServer((self.host, self.status_port), Handler)
+        self.status_port = self._status_httpd.server_address[1]
+        threading.Thread(target=self._status_httpd.serve_forever, name="http-status", daemon=True).start()
 
     def _accept_loop(self) -> None:
         while not self.closing:
@@ -184,6 +228,8 @@ class Server:
         """Graceful shutdown: stop accepting, drop connections
         (ref: server.go:409 startShutdown)."""
         self.closing = True
+        if self._status_httpd is not None:
+            self._status_httpd.shutdown()
         if self._sock is not None:
             try:
                 self._sock.close()

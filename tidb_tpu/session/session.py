@@ -217,15 +217,38 @@ class Session:
         saved = None
         if self.txn is not None:
             saved = (dict(self.txn.membuf), set(self.txn._locked_keys))
+        from ..executor.executors import _ACTIVE_TRACKER
+        from ..utils.memory import MemTracker
+        from ..utils import metrics as M
+
+        quota = int(self.vars.get("tidb_mem_quota_query", "0") or 0)
+        token = _ACTIVE_TRACKER.set(MemTracker(quota) if quota > 0 else None)
+        t0 = time.perf_counter()
+        ok = True
         try:
             rs = self._execute_stmt(stmt, sql=sql)
             self._finish_stmt()
             return rs
         except Exception:
+            ok = False
             if saved is not None and self.txn is not None and self.in_explicit_txn:
                 self.txn.membuf, self.txn._locked_keys = saved
             self._abort_stmt()
             raise
+        finally:
+            _ACTIVE_TRACKER.reset(token)
+            dur = time.perf_counter() - t0
+            if not self._in_bootstrap:
+                M.QUERY_TOTAL.inc(type=type(stmt).__name__, result="OK" if ok else "Error")
+                M.QUERY_DURATION.observe(dur)
+                threshold = float(self.vars.get("tidb_slow_log_threshold", "300")) / 1000.0
+                if isinstance(stmt, (ast.CreateUser, ast.Grant, ast.SetStmt)):
+                    # never record credential-bearing literals (MySQL
+                    # redacts user-admin statements from logs)
+                    sql = f"<redacted {type(stmt).__name__}>"
+                self.store.stmt_stats.record(
+                    sql, dur, self.user, self.current_db, ok, threshold
+                )
 
     def must_query(self, sql: str) -> list[tuple]:
         return self.execute(sql).rows()
@@ -285,25 +308,50 @@ class Session:
                 if e is not None:
                     expr_dbs(e, out)
 
+        def order_group_dbs(sel, out):
+            if isinstance(sel, ast.SetOpSelect):
+                for b in sel.order_by:
+                    expr_dbs(b.expr, out)
+                return
+            for b in sel.order_by:
+                expr_dbs(b.expr, out)
+            for g in sel.group_by:
+                expr_dbs(g, out)
+
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             dbs: set[str] = set()
             sel_dbs(stmt, dbs)
+            order_group_dbs(stmt, dbs)
             return [("SELECT", d) for d in dbs]
         if isinstance(stmt, ast.Insert):
             out = [("INSERT", (stmt.table.db or self.current_db).lower())]
+            dbs: set[str] = set()
             if stmt.select is not None:  # INSERT ... SELECT reads too
-                dbs: set[str] = set()
                 sel_dbs(stmt.select, dbs)
-                out += [("SELECT", d) for d in dbs]
+            for row in stmt.values:
+                for v in row:
+                    if v is not None and not isinstance(v, ast.Default):
+                        expr_dbs(v, dbs)
+            for _, e in stmt.on_dup:
+                expr_dbs(e, dbs)
+            out += [("SELECT", d) for d in dbs]
             return out
         if isinstance(stmt, ast.LoadData):
             return [("INSERT", (stmt.table.db or self.current_db).lower())]
         if isinstance(stmt, ast.Update):
             db = (stmt.table.db or self.current_db).lower() if isinstance(stmt.table, ast.TableName) else self.current_db
-            return [("UPDATE", db)]
+            dbs: set[str] = set()
+            if stmt.where is not None:
+                expr_dbs(stmt.where, dbs)
+            for _, e in stmt.sets:
+                expr_dbs(e, dbs)
+            return [("UPDATE", db)] + [("SELECT", d) for d in dbs]
         if isinstance(stmt, ast.Delete):
             db = (stmt.table.db or self.current_db).lower() if isinstance(stmt.table, ast.TableName) else self.current_db
-            return [("DELETE", db)]
+            dbs: set[str] = set()
+            if stmt.where is not None:
+                expr_dbs(stmt.where, dbs)
+            return [("DELETE", db)] + [("SELECT", d) for d in dbs]
         if isinstance(stmt, (ast.CreateTable, ast.CreateDatabase)):
             db = getattr(getattr(stmt, "table", None), "db", None) or getattr(stmt, "name", None) or self.current_db
             return [("CREATE", db.lower())]
@@ -435,10 +483,20 @@ class Session:
         SQL (privilege checks are suspended there — injection-proof)."""
         return (s or "").replace("\\", "\\\\").replace("'", "''")
 
+    def _implicit_commit(self) -> None:
+        """User-admin/DDL statements implicitly commit any open txn
+        (MySQL implicit-commit statement list)."""
+        if self.txn is not None:
+            self.txn.commit()
+            self.txn = None
+            self.in_explicit_txn = False
+            self._txn_committed()
+
     def _run_create_user(self, stmt: ast.CreateUser) -> ResultSet:
         from ..privilege import mysql_native_hash
         from ..privilege.cache import PrivilegeError
 
+        self._implicit_commit()
         for spec in stmt.users:
             if self.priv.user_exists(self, spec.user):
                 if stmt.if_not_exists:
@@ -454,6 +512,7 @@ class Session:
     def _run_drop_user(self, stmt: ast.DropUser) -> ResultSet:
         from ..privilege.cache import PrivilegeError
 
+        self._implicit_commit()
         for spec in stmt.users:
             if not self.priv.user_exists(self, spec.user):
                 if stmt.if_exists:
@@ -467,6 +526,7 @@ class Session:
     def _run_grant_revoke(self, stmt) -> ResultSet:
         from ..privilege.cache import PRIVS, PrivilegeError
 
+        self._implicit_commit()
         grant = isinstance(stmt, ast.Grant)
         privs = set(p.upper() for p in stmt.privs)
         unknown = privs - PRIVS - {"ALL"}
@@ -570,7 +630,13 @@ class Session:
         return PlanBuilder(
             self.infoschema(), self.current_db,
             run_subquery=self._run_subquery, params=self._exec_params,
+            memtable_rows=self._memtable_rows,
         )
+
+    def _memtable_rows(self, name: str):
+        from ..catalog.memtables import rows_for
+
+        return rows_for(self, name)
 
     def _plan_for(self, stmt, sql: str | None):
         """Plan with an LRU plan cache for parameter-free statements

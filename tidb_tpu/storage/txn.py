@@ -466,6 +466,14 @@ class Storage:
                 w.fsync_dir(self.data_dir)
 
     @property
+    def stmt_stats(self):
+        if getattr(self, "_stmt_stats", None) is None:
+            from ..utils.stmtstats import StmtStats
+
+            self._stmt_stats = StmtStats()
+        return self._stmt_stats
+
+    @property
     def gc_worker(self):
         if self._gc_worker is None:
             from .gcworker import GCWorker

@@ -1,0 +1,46 @@
+"""Memory quota tracker (ref: util/memory/tracker.go:54 tracker tree +
+action.go:29 action chain). One tracker per statement, consuming at
+chunk-materialization points; exceeding tidb_mem_quota_query fires the
+cancel action (MemoryQuotaExceeded, MySQL's OOM-kill analog)."""
+
+from __future__ import annotations
+
+import threading
+
+from ..errors import MemoryQuotaExceeded
+
+
+class MemTracker:
+    def __init__(self, quota: int = 0, label: str = "query"):
+        self.quota = quota  # 0 = unlimited
+        self.label = label
+        self.consumed = 0
+        self.max_consumed = 0
+        self._lock = threading.Lock()
+
+    def consume(self, nbytes: int) -> None:
+        with self._lock:
+            self.consumed += nbytes
+            if self.consumed > self.max_consumed:
+                self.max_consumed = self.consumed
+            if self.quota and self.consumed > self.quota:
+                raise MemoryQuotaExceeded(
+                    f"Out Of Memory Quota! [{self.label}] consumed {self.consumed} > quota {self.quota}"
+                )
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.consumed = max(0, self.consumed - nbytes)
+
+
+def chunk_bytes(chunk) -> int:
+    n = 0
+    for col in chunk.columns:
+        data = col.data
+        if getattr(data, "dtype", None) is not None and data.dtype == object:
+            n += sum(len(x) if isinstance(x, (str, bytes)) else 8 for x in data if x is not None)
+            n += len(data)
+        else:
+            n += getattr(data, "nbytes", 0)
+        n += getattr(col.valid, "nbytes", 0)
+    return n

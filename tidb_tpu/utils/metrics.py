@@ -1,0 +1,115 @@
+"""Metrics registry — Prometheus-style counters/histograms
+(ref: metrics/metrics.go registry + per-subsystem files; exposed at
+/metrics by server/http_status.go:115)."""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._v = defaultdict(float)  # label tuple → value
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._v[key] += n
+
+    def value(self, **labels) -> float:
+        return self._v[tuple(sorted(labels.items()))]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._v.items()):
+            lbl = ",".join(f'{k}="{val}"' for k, val in key)
+            out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(_BUCKETS) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(_BUCKETS):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, b in enumerate(_BUCKETS):
+            cum += self._counts[i]
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._n}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._n}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help_)
+                self._metrics[name] = m
+            return m
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_)
+                self._metrics[name] = m
+            return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+    def rows(self) -> list[tuple[str, str, float]]:
+        """Flat (metric, labels, value) rows for the METRICS memtable."""
+        out = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if isinstance(m, Counter):
+                for key, v in sorted(m._v.items()):
+                    out.append((name, ",".join(f"{k}={val}" for k, val in key), v))
+            else:
+                out.append((name + "_count", "", float(m._n)))
+                out.append((name + "_sum", "", m._sum))
+        return out
+
+
+REGISTRY = Registry()
+
+# core series (ref: metrics/{session,executor,distsql,ddl}.go)
+QUERY_TOTAL = REGISTRY.counter("tidb_query_total", "queries by statement type and result")
+QUERY_DURATION = REGISTRY.histogram("tidb_query_duration_seconds", "statement wall time")
+COP_TASKS = REGISTRY.counter("tidb_cop_tasks_total", "coprocessor tasks by engine")
+TXN_TOTAL = REGISTRY.counter("tidb_txn_total", "transaction outcomes")
+DDL_JOBS = REGISTRY.counter("tidb_ddl_jobs_total", "DDL jobs by type and state")

@@ -1,0 +1,82 @@
+"""Slow-query log + statement summary (ref: executor/adapter.go:922
+LogSlowQuery + util/stmtsummary/statement_summary.go — kept in memory and
+read back as INFORMATION_SCHEMA.SLOW_QUERY / STATEMENTS_SUMMARY)."""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import deque
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=2048)
+def sql_digest(sql: str) -> str:
+    """Normalized statement digest: literals → '?', idents lowercased
+    (ref: parser digests used by stmtsummary/topsql)."""
+    from ..parser.lexer import tokenize
+
+    try:
+        toks = tokenize(sql)
+    except Exception:  # noqa: BLE001 — digest must never fail the statement
+        return hashlib.sha256(sql.encode()).hexdigest()[:16]
+    parts = []
+    for t in toks:
+        if t.kind in ("num", "str", "hex"):
+            parts.append("?")
+        elif t.kind == "eof":
+            break
+        else:
+            parts.append(t.text.lower())
+    norm = " ".join(parts)
+    return hashlib.sha256(norm.encode()).hexdigest()[:16]
+
+
+class StmtStats:
+    """Shared per-store statement telemetry."""
+
+    def __init__(self, slow_capacity: int = 512, summary_capacity: int = 512):
+        self.slow: deque = deque(maxlen=slow_capacity)
+        self.summary: dict[str, dict] = {}
+        self.summary_capacity = summary_capacity
+        self._lock = threading.Lock()
+
+    def record(self, sql: str, dur_s: float, user: str, db: str, ok: bool, slow_threshold_s: float) -> None:
+        digest = sql_digest(sql)
+        now = time.time()
+        with self._lock:
+            st = self.summary.get(digest)
+            if st is None:
+                if len(self.summary) >= self.summary_capacity:
+                    # evict the least-executed entry (summary eviction)
+                    victim = min(self.summary, key=lambda k: self.summary[k]["exec_count"])
+                    del self.summary[victim]
+                st = {
+                    "digest": digest,
+                    "sample_sql": sql[:256],
+                    "exec_count": 0,
+                    "sum_latency_s": 0.0,
+                    "max_latency_s": 0.0,
+                    "errors": 0,
+                }
+                self.summary[digest] = st
+            st["exec_count"] += 1
+            st["sum_latency_s"] += dur_s
+            st["max_latency_s"] = max(st["max_latency_s"], dur_s)
+            if not ok:
+                st["errors"] += 1
+            if dur_s >= slow_threshold_s:
+                self.slow.append(
+                    {
+                        "time": now,
+                        "user": user,
+                        "db": db,
+                        "query_time_s": dur_s,
+                        "digest": digest,
+                        "query": sql[:512],
+                        "succ": ok,
+                    }
+                )
