@@ -1,0 +1,132 @@
+"""Type/builtin breadth: JSON, ENUM/SET, TIME(Duration), date arithmetic,
+string/math/info functions (ref: expression/builtin_*.go, types/json,
+types/duration.go, types/enum.go)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    return Session()
+
+
+class TestNewColumnTypes:
+    def test_enum(self, s):
+        s.execute("CREATE TABLE e (id INT PRIMARY KEY, mood ENUM('happy','sad','ok'))")
+        s.execute("INSERT INTO e VALUES (1, 'happy'), (2, 3), (3, 'SAD')")
+        assert s.must_query("SELECT mood FROM e ORDER BY id") == [("happy",), ("ok",), ("sad",)]
+        with pytest.raises(TiDBError):
+            s.execute("INSERT INTO e VALUES (4, 'angry')")
+        assert s.must_query("SELECT id FROM e WHERE mood = 'ok'") == [("2",)]
+
+    def test_set(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, tags SET('a','b','c'))")
+        s.execute("INSERT INTO t VALUES (1, 'c,a'), (2, ''), (3, 'b,b')")
+        # members normalize to definition order, dedup
+        assert s.must_query("SELECT tags FROM t ORDER BY id") == [("a,c",), ("",), ("b",)]
+        with pytest.raises(TiDBError):
+            s.execute("INSERT INTO t VALUES (4, 'a,z')")
+
+    def test_time_duration(self, s):
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, d TIME)")
+        s.execute("INSERT INTO t VALUES (1, '12:34:56'), (2, '-01:30:00'), (3, 123456)")
+        assert s.must_query("SELECT d FROM t ORDER BY id") == [
+            ("12:34:56",), ("-01:30:00",), ("12:34:56",)
+        ]
+        # durations order numerically (negative first)
+        assert s.must_query("SELECT id FROM t ORDER BY d, id") == [("2",), ("1",), ("3",)]
+        assert s.must_query("SELECT TIME_TO_SEC(d) FROM t WHERE id = 2") == [("-5400",)]
+        assert s.must_query("SELECT SEC_TO_TIME(3661)") == [("01:01:01",)]
+
+    def test_json_column(self, s):
+        s.execute("CREATE TABLE j (id INT PRIMARY KEY, doc JSON)")
+        s.execute("""INSERT INTO j VALUES (1, '{"a": {"b": [10, 20]}, "c": true}')""")
+        assert s.must_query("SELECT JSON_EXTRACT(doc, '$.a.b[1]') FROM j") == [("20",)]
+        assert s.must_query("SELECT JSON_LENGTH(doc) FROM j") == [("2",)]
+        assert s.must_query("SELECT JSON_KEYS(doc) FROM j") == [('["a", "c"]',)]
+        with pytest.raises(TiDBError):
+            s.execute("INSERT INTO j VALUES (2, 'not json')")
+
+
+class TestJsonFunctions:
+    def test_extract_and_type(self, s):
+        assert s.must_query("""SELECT JSON_EXTRACT('[1, [2, 3]]', '$[1][0]')""") == [("2",)]
+        assert s.must_query("""SELECT JSON_EXTRACT('{"a": 1, "b": 2}', '$.a', '$.b')""") == [("[1, 2]",)]
+        assert s.must_query("""SELECT JSON_EXTRACT('{"xs": [1,2,3]}', '$.xs[*]')""") == [("[1, 2, 3]",)]
+        assert s.must_query("SELECT JSON_TYPE('{}'), JSON_TYPE('3.5'), JSON_TYPE('\"s\"')") == [
+            ("OBJECT", "DOUBLE", "STRING")
+        ]
+
+    def test_unquote_object_array_contains(self, s):
+        assert s.must_query("""SELECT JSON_UNQUOTE('"hi"')""") == [("hi",)]
+        assert s.must_query("SELECT JSON_OBJECT('k', 1, 'l', 'x')") == [('{"k": 1, "l": "x"}',)]
+        assert s.must_query("""SELECT JSON_CONTAINS('[1,2,3]', '2'), JSON_CONTAINS('[1,2]', '5')""") == [("1", "0")]
+        assert s.must_query("SELECT JSON_VALID('{\"a\":1}'), JSON_VALID('{nope')") == [("1", "0")]
+
+
+class TestDateArithmetic:
+    def test_interval_forms(self, s):
+        assert s.must_query("SELECT DATE_ADD('2024-01-31', INTERVAL 1 MONTH)") == [("2024-02-29 00:00:00",)]
+        assert s.must_query("SELECT '2024-03-05' - INTERVAL 7 DAY") == [("2024-02-27 00:00:00",)]
+        assert s.must_query("SELECT '2023-12-30' + INTERVAL 5 DAY") == [("2024-01-04 00:00:00",)]
+        assert s.must_query("SELECT DATE_SUB('2024-03-01 00:30:00', INTERVAL 45 MINUTE)") == [
+            ("2024-02-29 23:45:00",)
+        ]
+
+    def test_date_helpers(self, s):
+        row = s.must_query(
+            "SELECT DAYOFWEEK('2024-03-05'), WEEKDAY('2024-03-05'), DAYOFYEAR('2024-03-05'), "
+            "QUARTER('2024-08-01'), LAST_DAY('2024-02-10'), DATEDIFF('2024-03-05', '2024-02-28')"
+        )[0]
+        assert row == ("3", "1", "65", "3", "2024-02-29", "6")
+        assert s.must_query("SELECT MONTHNAME('2024-03-05'), DAYNAME('2024-03-05')") == [
+            ("March", "Tuesday")
+        ]
+
+    def test_date_format(self, s):
+        assert s.must_query(
+            "SELECT DATE_FORMAT('2024-03-05 14:30:07', '%Y/%m/%d %H:%i:%s')"
+        ) == [("2024/03/05 14:30:07",)]
+        assert s.must_query("SELECT DATE_FORMAT('2024-03-05', '%M %e, %Y')") == [("March 5, 2024",)]
+
+    def test_unix_roundtrip(self, s):
+        assert s.must_query(
+            "SELECT FROM_UNIXTIME(UNIX_TIMESTAMP('2024-03-05 06:07:08'))"
+        ) == [("2024-03-05 06:07:08",)]
+
+    def test_on_table_column(self, s):
+        s.execute("CREATE TABLE d (id INT PRIMARY KEY, dt DATETIME)")
+        s.execute("INSERT INTO d VALUES (1, '2024-01-15 08:00:00')")
+        assert s.must_query("SELECT DATE_ADD(dt, INTERVAL 2 MONTH) FROM d") == [("2024-03-15 08:00:00",)]
+        assert s.must_query("SELECT DATE(dt) FROM d") == [("2024-01-15",)]
+
+
+class TestStringMathInfo:
+    def test_strings(self, s):
+        row = s.must_query(
+            "SELECT CONCAT_WS('-', 'a', 'b'), LPAD('5', 3, '0'), RPAD('5', 3, 'x'), "
+            "INSTR('hello', 'll'), LOCATE('l', 'hello', 4), REPEAT('ab', 2), "
+            "SUBSTRING_INDEX('a.b.c', '.', -1), STRCMP('a', 'b'), ASCII('A'), SPACE(2)"
+        )[0]
+        assert row == ("a-b", "005", "5xx", "3", "4", "abab", "c", "-1", "65", "  ")
+        assert s.must_query("SELECT FIELD('b', 'a', 'b', 'c'), ELT(2, 'x', 'y')") == [("2", "y")]
+
+    def test_math(self, s):
+        row = s.must_query(
+            "SELECT DEGREES(PI()), RADIANS(180) - PI(), ROUND(COT(1), 4), ROUND(ATAN(1) * 4, 6)"
+        )[0]
+        assert row == ("180", "0", "0.6421", "3.141593")
+        assert s.must_query("SELECT NULLIF(1, 1), NULLIF(1, 2)") == [(None, "1")]
+
+    def test_info_functions(self, s):
+        assert s.must_query("SELECT VERSION()") == [("8.0.11-tidb-tpu",)]
+        assert s.must_query("SELECT DATABASE()") == [("test",)]
+        assert s.must_query("SELECT CURRENT_USER") == [("root@%",)]
+        # NOW() is a plan-time constant and must not enter the plan cache
+        s.must_query("SELECT NOW()")
+        h0 = s.plan_cache_hits
+        s.must_query("SELECT NOW()")
+        assert s.plan_cache_hits == h0

@@ -19,6 +19,7 @@ import re
 
 import numpy as np
 
+from ..errors import TiDBError as TiDBErrorBase
 from ..mysqltypes.field_type import FieldType, TypeCode, ft_longlong, ft_double, ft_decimal, ft_varchar, UNSIGNED_FLAG
 from ..mysqltypes.mydecimal import pow10, MAX_SCALE, DIV_FRAC_INCR
 from .expression import (
@@ -651,9 +652,18 @@ def _obj_map(fn):
         n = len(avals[0][0])
         out = np.empty(n, dtype=object)
         idx = np.nonzero(valid)[0]
+        if valid.ndim == 0:
+            valid = np.asarray([bool(valid)])
+        else:
+            valid = valid.copy()
         args_data = [d for d, _ in avals]
         for i in idx:
-            out[i] = fn(*[d[i] for d in args_data])
+            try:
+                out[i] = fn(*[d[i] for d in args_data])
+            except TiDBErrorBase:
+                raise
+            except Exception:  # noqa: BLE001 — malformed input → SQL NULL
+                valid[i] = False
         return out, valid
 
     return kernel
@@ -809,3 +819,7 @@ def _cast_kernel(xp, avals, fts, ret_ft):
 
 CAST_SIG = FuncSig("cast", infer_first, _cast_kernel)
 register(CAST_SIG)
+
+
+# extended registry: date arithmetic, string/math breadth, JSON
+from . import builtins_ext  # noqa: E402,F401  (registration side effects)

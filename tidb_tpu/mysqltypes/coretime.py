@@ -123,3 +123,21 @@ def time_minute(packed: int) -> int:
 
 def time_second(packed: int) -> int:
     return (packed // _US) % 60
+
+
+_DUR_RE = re.compile(r"^\s*(-)?(\d+):(\d{1,2})(?::(\d{1,2})(?:\.(\d{1,6}))?)?\s*$")
+
+
+def parse_duration(s: str) -> int | None:
+    """'[-]HH:MM[:SS[.f]]' → signed microseconds; MySQL parses the
+    two-part form as hours:minutes (ref: types/duration.go)."""
+    m = _DUR_RE.match(s)
+    if m is None:
+        return None
+    neg, h, mi, sec, frac = m.groups()
+    mi = int(mi)
+    sec = int(sec) if sec is not None else 0
+    if mi > 59 or sec > 59:
+        return None
+    us = ((int(h) * 3600 + mi * 60 + sec) * 1_000_000) + int((frac or "0").ljust(6, "0"))
+    return -us if neg else us

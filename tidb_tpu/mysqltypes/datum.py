@@ -142,6 +142,17 @@ class Datum:
             is_date = ft is not None and ft.tp == TypeCode.Date
             fsp = ft.decimal if ft is not None and ft.decimal > 0 else 0
             return format_time(self.val, is_date=is_date, fsp=fsp)
+        if self.kind == K_DUR:
+            us = int(self.val)
+            sign = "-" if us < 0 else ""
+            us = abs(us)
+            h, rem = divmod(us // 1_000_000, 3600)
+            m, s = divmod(rem, 60)
+            out = f"{sign}{h:02d}:{m:02d}:{s:02d}"
+            fsp = ft.decimal if ft is not None and ft.decimal > 0 else 0
+            if fsp > 0:
+                out = (out + f".{us % 1_000_000:06d}")[: len(out) + 1 + fsp]
+            return out
         return self.to_str()
 
     def __repr__(self):

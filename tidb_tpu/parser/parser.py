@@ -612,6 +612,10 @@ class Parser:
             if up == "FALSE":
                 self.next()
                 return ast.Lit(False, "bool")
+            if up in ("CURRENT_TIMESTAMP", "CURRENT_DATE", "CURRENT_TIME", "CURRENT_USER",
+                      "LOCALTIME", "LOCALTIMESTAMP") and self.peek().text != "(":
+                self.next()
+                return ast.Call(up.lower(), [])
             if up == "CASE":
                 return self.case_expr()
             if up == "CAST" or up == "CONVERT":

@@ -128,12 +128,13 @@ class PlanBuilder:
         c = scope.cols[idx]
         return ECol(idx, c.ft, c.name)
 
-    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None):
+    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None, context_info=None):
         self.is_ = infoschema
         self.db = current_db
         self.run_subquery = run_subquery  # callable(Select ast) -> list[Datum rows]
         self.params = params  # EXECUTE-bound Constants for '?' placeholders
         self.memtable_rows = memtable_rows  # callable(name) -> rows (info schema)
+        self.context_info = context_info or {}  # user/conn info for info funcs
         # set when a subquery was evaluated eagerly at plan time: such a
         # plan bakes in data and must not enter the plan cache
         self.used_eager_subquery = False
@@ -346,6 +347,9 @@ class PlanBuilder:
             return self._resolve_name(node, scope)
         if isinstance(node, ast.Call):
             lname = node.name.lower()
+            info_c = self._info_func(lname, node)
+            if info_c is not None:
+                return info_c
             if getattr(node, "over", None) is not None or lname in WINDOW_FUNCS:
                 if node.over is None:
                     raise TiDBError(f"window function {lname} requires an OVER clause")
@@ -358,6 +362,26 @@ class PlanBuilder:
                 return agg_ctx.add_agg(node, scope)
             if lname == "in_subquery":
                 return self._in_subquery(node, scope, agg_ctx)
+            if lname in ("date_add", "date_sub", "adddate", "subdate") and len(node.args) == 2 \
+                    and isinstance(node.args[1], ast.Interval):
+                iv = node.args[1]
+                return make_func(
+                    lname,
+                    self.to_expr(node.args[0], scope, agg_ctx),
+                    self.to_expr(iv.expr, scope, agg_ctx),
+                    Constant(Datum.s(iv.unit), ft_varchar(16)),
+                )
+            if lname in ("plus", "minus") and any(isinstance(a, ast.Interval) for a in node.args):
+                # d + INTERVAL n unit  /  INTERVAL n unit + d  /  d - INTERVAL n unit
+                iv = next(a for a in node.args if isinstance(a, ast.Interval))
+                other = next(a for a in node.args if not isinstance(a, ast.Interval))
+                fname = "date_add" if lname == "plus" else "date_sub"
+                return make_func(
+                    fname,
+                    self.to_expr(other, scope, agg_ctx),
+                    self.to_expr(iv.expr, scope, agg_ctx),
+                    Constant(Datum.s(iv.unit), ft_varchar(16)),
+                )
             args = [self.to_expr(a, scope, agg_ctx, allow_window) for a in node.args]
             args = _refine_cmp_constants(lname, args)
             return make_func(lname, *args)
@@ -381,6 +405,46 @@ class PlanBuilder:
         if isinstance(node, ast.Star):
             raise TiDBError("* not allowed in this context")
         raise TiDBError(f"unsupported expression {type(node).__name__}")
+
+    def _info_func(self, lname: str, node) -> Constant | None:
+        """Session/time information functions evaluated at plan time
+        (ref: builtin_info.go, builtin_time.go NOW/CURDATE). Plans that
+        embed them are flagged uncacheable."""
+        import time as _time
+
+        from ..mysqltypes.coretime import pack_time
+        from ..mysqltypes.datum import K_DUR
+        from ..mysqltypes.field_type import TypeCode as TC
+
+        if node.args:
+            return None
+        if lname in ("database", "schema"):
+            self.used_eager_subquery = True
+            return Constant(Datum.s(self.db), ft_varchar(64))
+        if lname == "version":
+            return Constant(Datum.s("8.0.11-tidb-tpu"), ft_varchar(64))
+        if lname in ("user", "current_user", "session_user"):
+            self.used_eager_subquery = True
+            u = self.context_info.get("user", "root")
+            return Constant(Datum.s(f"{u}@%"), ft_varchar(64))
+        if lname == "connection_id":
+            self.used_eager_subquery = True
+            return Constant(Datum.i(int(self.context_info.get("conn_id", 0))), ft_longlong())
+        if lname in ("now", "current_timestamp", "sysdate", "localtime", "localtimestamp"):
+            self.used_eager_subquery = True
+            t = _time.localtime()
+            ft = FieldType(TC.Datetime)
+            return Constant(Datum.t(pack_time(t.tm_year, t.tm_mon, t.tm_mday, t.tm_hour, t.tm_min, t.tm_sec)), ft)
+        if lname in ("curdate", "current_date"):
+            self.used_eager_subquery = True
+            t = _time.localtime()
+            return Constant(Datum.t(pack_time(t.tm_year, t.tm_mon, t.tm_mday)), FieldType(TC.Date))
+        if lname in ("curtime", "current_time"):
+            self.used_eager_subquery = True
+            t = _time.localtime()
+            us = (t.tm_hour * 3600 + t.tm_min * 60 + t.tm_sec) * 1_000_000
+            return Constant(Datum(K_DUR, us), FieldType(TC.Duration))
+        return None
 
     def _window_expr(self, node: ast.Call, scope, agg_ctx) -> "_WindowFuncExpr":
         """ast window call → placeholder expression lifted later by
@@ -851,6 +915,9 @@ class PlanBuilder:
                 return hit
         if isinstance(node, ast.Call):
             lname = node.name.lower()
+            info_c = self._info_func(lname, node)
+            if info_c is not None:
+                return info_c
             if getattr(node, "over", None) is not None or lname in WINDOW_FUNCS:
                 return self.to_expr(node, scope_w.base, agg_ctx, allow_window=allow_window)
             if lname in AGG_FUNCS:

@@ -92,8 +92,13 @@ class Session:
         # authenticated identity (set by the wire handshake; in-process
         # sessions run as root, the bootstrap superuser)
         self.user = "root"
+        import itertools as _it
+
+        self.conn_id = next(Session._conn_counter)
         self._in_bootstrap = False
         self._bootstrap()
+
+    _conn_counter = __import__("itertools").count(1)
 
     PLAN_CACHE_SIZE = 128
 
@@ -631,6 +636,7 @@ class Session:
             self.infoschema(), self.current_db,
             run_subquery=self._run_subquery, params=self._exec_params,
             memtable_rows=self._memtable_rows,
+            context_info={"user": self.user, "conn_id": self.conn_id},
         )
 
     def _memtable_rows(self, name: str):
@@ -811,6 +817,50 @@ class Session:
             return Datum.f(d.to_float())
         if ft.is_int():
             return Datum.u(d.to_int()) if ft.is_unsigned else Datum.i(d.to_int())
+        if ft.tp == TypeCode.Duration:
+            from ..mysqltypes.datum import Datum as _D, K_DUR, K_INT, K_UINT
+            from ..mysqltypes.coretime import parse_duration
+
+            if d.kind == K_DUR:
+                return d
+            if d.kind in (K_INT, K_UINT):  # HHMMSS number form
+                v = abs(d.val)
+                us = ((v // 10000) * 3600 + ((v // 100) % 100) * 60 + v % 100) * 1_000_000
+                return _D(K_DUR, -us if d.val < 0 else us)
+            us = parse_duration(d.to_str())
+            if us is None:
+                raise TiDBError(f"incorrect time value {d.to_str()!r}")
+            return _D(K_DUR, us)
+        if ft.tp == TypeCode.Enum:
+            s = d.to_str()
+            low = [e.lower() for e in ft.elems]
+            if s.lower() in low:
+                return Datum.s(ft.elems[low.index(s.lower())])
+            if d.kind in (1, 2):  # numeric index, 1-based
+                i = d.to_int()
+                if 1 <= i <= len(ft.elems):
+                    return Datum.s(ft.elems[i - 1])
+            raise TiDBError(f"data truncated: {s!r} not in ENUM{ft.elems}")
+        if ft.tp == TypeCode.Set:
+            s = d.to_str()
+            low = [e.lower() for e in ft.elems]
+            members = []
+            for part in (p for p in s.split(",") if p != ""):
+                if part.lower() not in low:
+                    raise TiDBError(f"data truncated: {part!r} not in SET{ft.elems}")
+                canon = ft.elems[low.index(part.lower())]
+                if canon not in members:
+                    members.append(canon)
+            members.sort(key=lambda x: ft.elems.index(x))  # SET normalizes order
+            return Datum.s(",".join(members))
+        if ft.tp == TypeCode.JSON:
+            import json as _json
+
+            try:
+                obj = _json.loads(d.to_str())
+            except ValueError:
+                raise TiDBError(f"invalid JSON text: {d.to_str()[:64]!r}")
+            return Datum.s(_json.dumps(obj))
         if ft.is_string():
             return Datum.s(d.to_str())
         return d
