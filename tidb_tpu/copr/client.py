@@ -28,6 +28,7 @@ from ..catalog.schema import IndexInfo, TableInfo
 from ..codec import tablecodec
 from ..codec.key import decode_datum_key
 from ..mysqltypes.datum import Datum, K_BYTES
+from ..utils.failpoint import inject as _fp
 from .dag import DAGRequest
 from .host_engine import execute_dag_host
 from .tilecache import ColumnBatch, TileCache, decode_rows_to_batch
@@ -188,6 +189,7 @@ class CopClient:
     def _run_task(self, table, dag, t: CopTask, read_ts, engine, depth: int = 0) -> list[Chunk]:
         """Execute one cop task, re-splitting on region epoch change
         (ref: handleCopResponse region-error path, coprocessor.go:1025)."""
+        _fp("cop/before-task")
         region = self.storage.regions.locate(t.start)
         stale = (
             region.id != t.region_id

@@ -28,6 +28,7 @@ from threading import RLock
 from ..catalog.meta import Meta
 from ..codec import tablecodec
 from ..errors import DuplicateEntry, TiDBError
+from ..utils.failpoint import inject as _fp
 from .jobs import (
     DDLJob,
     JOB_DONE,
@@ -192,6 +193,7 @@ class DDLWorker:
         from ..errors import RetryableError, WriteConflict
 
         try:
+            _fp("ddl/before-backfill-commit")
             txn.commit()
         except (WriteConflict, RetryableError):
             # concurrent DML dual-wrote a key this batch staged: the batch

@@ -14,6 +14,7 @@ from __future__ import annotations
 import time
 
 from ..errors import DeadlockError, LockedError, RetryableError, TiDBError, TxnAborted, WriteConflict
+from ..utils.failpoint import inject as _fp
 from .memkv import MemKV
 from .mvcc import MVCCStore, Mutation, OP_DEL, OP_LOCK, OP_PUT
 from .regions import RegionMap
@@ -41,18 +42,27 @@ class Snapshot:
         decode path (copr/tilecache.py) gathers straight from run buffers."""
         return self._with_resolve(lambda: self.store.mvcc.scan_segments(start, end, self.read_ts))
 
-    def _with_resolve(self, fn, max_retry: int = 12):
-        """Reads resolve blocking locks via the primary (client-go behavior)."""
+    RESOLVE_DEADLINE_S = 8.0  # > lock TTL: orphan locks must expire within this
+
+    def _with_resolve(self, fn):
+        """Reads resolve blocking locks via the primary (client-go
+        behavior). Deadline-based: an orphaned prewrite lock only becomes
+        resolvable once its TTL expires, so the wait must outlive the TTL
+        (ref: Backoffer maxSleep in store/copr)."""
         backoff = 0.002
-        for _ in range(max_retry):
+        deadline = time.time() + self.RESOLVE_DEADLINE_S
+        while True:
             try:
                 return fn()
             except LockedError as e:
+                # deadline bounds BOTH outcomes: a stream of resolvable
+                # locks must not spin a reader forever either
+                if time.time() > deadline:
+                    raise RetryableError("could not resolve locks for read") from e
                 now_ms = int(time.time() * 1000)
                 if not self.store.mvcc.resolve_lock(e.key, e.lock, now_ms):
                     time.sleep(backoff)
-                    backoff = min(backoff * 2, 0.1)
-        raise RetryableError("could not resolve locks for read")
+                    backoff = min(backoff * 2, 0.25)
 
 
 class Txn:
@@ -222,6 +232,7 @@ class Txn:
             primary = self._pess_primary
 
         # phase 1: prewrite with lock-resolution retry
+        _fp("txn/before-prewrite")
         backoff = 0.002
         fut = self.for_update_ts if self.pessimistic else 0
         for attempt in range(12):
@@ -245,12 +256,14 @@ class Txn:
             raise RetryableError("prewrite kept hitting live locks")
 
         # phase 2
+        _fp("txn/commit-after-prewrite")
         self.commit_ts = self.store.tso.next()
         try:
             mvcc.commit([primary], self.start_ts, self.commit_ts)
         except TxnAborted:
             mvcc.rollback([m.key for m in muts], self.start_ts)
             raise
+        _fp("txn/commit-after-primary")
         secondaries = [m.key for m in muts if m.key != primary]
         if secondaries:
             mvcc.commit(secondaries, self.start_ts, self.commit_ts)

@@ -21,6 +21,7 @@ import subprocess
 import threading
 
 import numpy as np
+from ..utils.failpoint import inject as _fp
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "wal.cpp")
 _LIB: ctypes.CDLL | None = None
@@ -85,6 +86,7 @@ class Wal:
                 raise OSError("WAL append failed")
 
     def sync(self) -> None:
+        _fp("wal/before-sync")
         with self._lock:
             if self.lib.wal_sync(self._h) != 0:
                 raise OSError("WAL fsync failed")

@@ -1,0 +1,66 @@
+"""Failpoint framework — conditional fault-injection sites
+(ref: pingcap/failpoint; the reference compiles `failpoint.Inject` sites
+into 94 files and enables them per test via Makefile failpoint-enable.
+Here sites are always present and zero-cost when disarmed)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Failpoints:
+    def __init__(self):
+        self._active: dict[str, object] = {}
+        self._hits: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def enable(self, name: str, action) -> None:
+        """action: an Exception instance (raised at the site), a callable
+        (invoked at the site), or ("sleep", seconds)."""
+        with self._lock:
+            self._active[name] = action
+            self._hits[name] = 0  # fresh count per arm cycle
+
+    def disable(self, name: str) -> None:
+        with self._lock:
+            self._active.pop(name, None)
+
+    def disable_all(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._hits.clear()
+
+    def hits(self, name: str) -> int:
+        return self._hits.get(name, 0)
+
+    def inject(self, name: str) -> None:
+        """The site call: no-op unless armed."""
+        action = self._active.get(name)
+        if action is None:
+            return
+        with self._lock:
+            self._hits[name] = self._hits.get(name, 0) + 1
+        if isinstance(action, BaseException):
+            raise action
+        if isinstance(action, tuple) and action and action[0] == "sleep":
+            time.sleep(action[1])
+            return
+        if callable(action):
+            action()
+
+    @contextmanager
+    def enabled(self, name: str, action):
+        self.enable(name, action)
+        try:
+            yield self
+        finally:
+            self.disable(name)
+
+
+FP = Failpoints()
+
+
+def inject(name: str) -> None:
+    FP.inject(name)
