@@ -137,14 +137,25 @@ class TestMultiJoin:
 
 
 class TestFallbacks:
-    def test_non_unique_build_falls_back(self, sess):
-        # join key on the build side is NOT unique → host path, same result
+    def test_non_unique_build_stays_on_mesh(self, sess):
+        # duplicate build keys fan each probe row into capped static
+        # slots — the SPMD path handles 1-to-many joins now
         sess.execute("create table dup (d_k bigint, d_v bigint)")
         sess.execute("insert into dup values (1, 10), (1, 11), (2, 20)")
         mpp, host = _both(
-            sess, "select o_id, d_v from ord join dup on o_cust = d_k where o_id < 50"
+            sess, "select o_id, d_v from ord join dup on o_cust = d_k where o_cust < 50"
         )
         assert _sorted(mpp) == _sorted(host)
+
+    def test_extreme_multiplicity_falls_back(self, sess):
+        sess.execute("create table dup2 (d_k bigint, d_v bigint)")
+        sess.execute(
+            "insert into dup2 values " + ",".join(f"(1, {i})" for i in range(40))
+        )
+        mpp, host = _both(
+            sess, "select o_id, d_v from ord join dup2 on o_cust = d_k where o_cust < 20"
+        )
+        assert _sorted(mpp) == _sorted(host)  # >cap → host path, same rows
 
     def test_txn_dirty_falls_back(self, sess):
         sess.execute("begin")

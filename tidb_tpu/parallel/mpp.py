@@ -45,6 +45,7 @@ from ..planner.fragment import BROADCAST, HASH, JoinFrag, MPPPlan, ScanFrag
 
 I64_MAX = np.iinfo(np.int64).max
 DIRECT_GROUP_MAX = 1 << 16
+MAX_BUILD_DUP = 16  # per-level probe expansion cap (shapes scale by this)
 
 
 class ScanData:
@@ -91,6 +92,7 @@ class _Level:
         self.key_lo = key_lo
         self.key_stride = key_stride
         self.r_post: list[Expression] = []
+        self.mult = 1  # max build-key multiplicity (pow2-padded; 1 = unique)
 
 
 class MPPEngine:
@@ -169,14 +171,24 @@ class MPPEngine:
                 if acc > 1 << 62:
                     return False
             lvl = _Level(frag, los, strides)
-            # build-side key uniqueness (superset of the filtered set)
+            # build-side key multiplicity, measured on the UNFILTERED lane
+            # (a safe upper bound: pushed filters only shrink groups).
+            # Unique keys (FK/PK joins) probe 1:1; duplicates expand each
+            # probe row into `mult` static slots — capped so the expanded
+            # shapes stay sane, else host hash join takes over.
             bkeys = self._pack_host(frag.build_keys, scan_of_joined, los, strides)
             if bkeys is None:
                 return False
             kv, km = bkeys
             present = kv[km]
-            if len(np.unique(present)) != len(present):
+            if len(present):
+                _, counts = np.unique(present, return_counts=True)
+                mult = int(counts.max())
+            else:
+                mult = 1
+            if mult > MAX_BUILD_DUP:
                 return False
+            lvl.mult = 1 << (mult - 1).bit_length() if mult > 1 else 1
             frag.exchange = BROADCAST if bscan.n_rows <= threshold else HASH
             # left join with extra ON conditions filters *matches*, which
             # the mask model below can't express yet → host fallback
@@ -372,6 +384,7 @@ class MPPEngine:
                 lvl.frag.kind, lvl.frag.exchange,
                 repr(lvl.frag.probe_keys), repr(lvl.frag.build_keys),
                 repr(lvl.key_lo), repr(lvl.key_stride), repr(lvl.r_post),
+                str(lvl.mult),
             ]
         if meta["agg"]:
             a = meta["agg"]
@@ -488,15 +501,44 @@ class MPPEngine:
             order = jnp.argsort(jnp.where(bvalid, bkey, I64_MAX))
             sk = jnp.where(bvalid, bkey, I64_MAX)[order]
             sv = bvalid[order]
-            pos = jnp.clip(jnp.searchsorted(sk, pkey), 0, B - 1)
-            match = pmask & pkv & sv[pos] & (sk[pos] == pkey)
-            bsel = order[pos]
-            merged = dict(pmap_)
-            for j, (d, v) in bmap.items():
-                merged[j] = (d[bsel], v[bsel] & match)
-            rowids = dict(prow)
-            rowids[id(frag.build)] = jnp.where(match, brow[id(frag.build)][bsel], -1)
-            mask = match if frag.kind == "inner" else pmask
+            M = lvl.mult
+            if M == 1:
+                pos = jnp.clip(jnp.searchsorted(sk, pkey), 0, B - 1)
+                match = pmask & pkv & sv[pos] & (sk[pos] == pkey)
+                bsel = order[pos]
+                merged = dict(pmap_)
+                for j, (d, v) in bmap.items():
+                    merged[j] = (d[bsel], v[bsel] & match)
+                rowids = dict(prow)
+                rowids[id(frag.build)] = jnp.where(match, brow[id(frag.build)][bsel], -1)
+                mask = match if frag.kind == "inner" else pmask
+            else:
+                # duplicate build keys: each probe row fans into M slots
+                # reading consecutive positions of the sorted build run
+                rows = pkey.shape[0]
+                first = jnp.searchsorted(sk, pkey)  # leftmost match
+                slots = jnp.arange(M)
+                pos = (first[:, None] + slots[None, :]).reshape(-1)
+                inb = pos < B
+                posc = jnp.clip(pos, 0, B - 1)
+                rep = lambda x: jnp.repeat(x, M, axis=0)  # noqa: E731
+                pkey_e = rep(pkey)
+                pvalid_e = rep(pmask & pkv)
+                match = pvalid_e & inb & sv[posc] & (sk[posc] == pkey_e)
+                bsel = order[posc]
+                merged = {j: (rep(d), rep(v)) for j, (d, v) in pmap_.items()}
+                for j, (d, v) in bmap.items():
+                    merged[j] = (d[bsel], v[bsel] & match)
+                rowids = {fid: rep(r) for fid, r in prow.items()}
+                rowids[id(frag.build)] = jnp.where(match, brow[id(frag.build)][bsel], -1)
+                if frag.kind == "inner":
+                    mask = match
+                else:
+                    # left join: slot 0 always carries the probe row (its
+                    # build lanes are already invalidated when unmatched)
+                    slot0 = (jnp.arange(rows * M) % M) == 0
+                    mask = jnp.where(slot0, rep(pmask), match)
+                pmask = rep(pmask)  # downstream levels see expanded shapes
             for c in lvl.r_post:
                 d, v = eval_dev(c, merged)
                 d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d

@@ -98,6 +98,14 @@ def _int_key(ft: FieldType) -> bool:
     return not ft.is_float() and not ft.is_string()
 
 
+def _plain_scan(ds: DataSource) -> bool:
+    """Mesh gathers read whole-table lanes: a scan whose access path
+    consumed conditions into key_ranges (PK handle ranges, index paths)
+    must stay on the host readers or rows filtered by ranges would leak
+    back in."""
+    return getattr(ds, "path", "table") == "table" and getattr(ds, "key_ranges", None) is None
+
+
 def _fold_selection(node: LogicalPlan):
     """Selection(DataSource) → DataSource with conds folded into pushed.
 
@@ -137,14 +145,14 @@ def _slice_join(node: Join, offset: int, scans: list[ScanFrag]):
         if probe is None:
             return None, offset
     elif isinstance(left, DataSource):
-        if getattr(left, "path", "table") != "table":
+        if not _plain_scan(left):
             return None, offset
         probe = ScanFrag(left, offset)
         scans.append(probe)
         offset += probe.n_cols
     else:
         return None, offset
-    if not (isinstance(right, DataSource) and getattr(right, "path", "table") == "table"):
+    if not (isinstance(right, DataSource) and _plain_scan(right)):
         return None, offset
     build = ScanFrag(right, offset)
     scans.append(build)
