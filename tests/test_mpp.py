@@ -142,20 +142,24 @@ class TestFallbacks:
         # slots — the SPMD path handles 1-to-many joins now
         sess.execute("create table dup (d_k bigint, d_v bigint)")
         sess.execute("insert into dup values (1, 10), (1, 11), (2, 20)")
+        c0 = sess.cop.mpp.compile_count
         mpp, host = _both(
             sess, "select o_id, d_v from ord join dup on o_cust = d_k where o_cust < 50"
         )
         assert _sorted(mpp) == _sorted(host)
+        assert sess.cop.mpp.compile_count == c0 + 1, "expected the mesh path to run"
 
     def test_extreme_multiplicity_falls_back(self, sess):
         sess.execute("create table dup2 (d_k bigint, d_v bigint)")
         sess.execute(
             "insert into dup2 values " + ",".join(f"(1, {i})" for i in range(40))
         )
+        c0 = sess.cop.mpp.compile_count
         mpp, host = _both(
             sess, "select o_id, d_v from ord join dup2 on o_cust = d_k where o_cust < 20"
         )
-        assert _sorted(mpp) == _sorted(host)  # >cap → host path, same rows
+        assert _sorted(mpp) == _sorted(host)
+        assert sess.cop.mpp.compile_count == c0, ">cap must take the host path"
 
     def test_txn_dirty_falls_back(self, sess):
         sess.execute("begin")

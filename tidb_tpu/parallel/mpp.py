@@ -538,7 +538,6 @@ class MPPEngine:
                     # build lanes are already invalidated when unmatched)
                     slot0 = (jnp.arange(rows * M) % M) == 0
                     mask = jnp.where(slot0, rep(pmask), match)
-                pmask = rep(pmask)  # downstream levels see expanded shapes
             for c in lvl.r_post:
                 d, v = eval_dev(c, merged)
                 d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d

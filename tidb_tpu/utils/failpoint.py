@@ -44,6 +44,8 @@ class Failpoints:
             self._hits[name] = self._hits.get(name, 0) + 1
         if isinstance(action, BaseException):
             raise action
+        if isinstance(action, type) and issubclass(action, BaseException):
+            raise action()
         if isinstance(action, tuple) and action and action[0] == "sleep":
             time.sleep(action[1])
             return
