@@ -1,0 +1,133 @@
+"""Optimizer hints + plan bindings (ref: planner hint handling +
+bindinfo/handle.go)."""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute(
+        "CREATE TABLE t (id INT PRIMARY KEY, g INT, v INT, KEY ig (g), KEY iv (v))"
+    )
+    sess.execute("INSERT INTO t VALUES " + ",".join(f"({i}, {i % 10}, {i % 7})" for i in range(200)))
+    sess.execute("ANALYZE TABLE t")
+    return sess
+
+
+def _plan_text(sess, sql):
+    return "\n".join(r[0] for r in sess.must_query("EXPLAIN " + sql))
+
+
+class TestIndexHints:
+    def test_use_index_pins_choice(self, s):
+        base = "SELECT * FROM t WHERE g = 3 AND v = 2"
+        assert "ig" in _plan_text(s, f"SELECT /*+ USE_INDEX(t, ig) */ * FROM t WHERE g = 3 AND v = 2")
+        assert "iv" in _plan_text(s, f"SELECT /*+ USE_INDEX(t, iv) */ * FROM t WHERE g = 3 AND v = 2")
+
+    def test_ignore_index_forces_scan_or_other(self, s):
+        txt = _plan_text(s, "SELECT /*+ IGNORE_INDEX(t, ig), IGNORE_INDEX(t, iv) */ * FROM t WHERE g = 3")
+        assert "ig" not in txt and "iv" not in txt
+
+    def test_use_index_with_alias(self, s):
+        txt = _plan_text(s, "SELECT /*+ USE_INDEX(x, ig) */ * FROM t x WHERE g = 3")
+        assert "ig" in txt
+
+    def test_hint_results_identical(self, s):
+        q = "FROM t WHERE g = 3 AND v = 2 ORDER BY id"
+        plain = s.must_query(f"SELECT id {q}")
+        assert s.must_query(f"SELECT /*+ USE_INDEX(t, ig) */ id {q}") == plain
+        assert s.must_query(f"SELECT /*+ IGNORE_INDEX(t, ig), IGNORE_INDEX(t, iv) */ id {q}") == plain
+
+
+class TestJoinAndStorageHints:
+    def test_merge_join_hint(self, s):
+        q = "SELECT a.id FROM t a JOIN t b ON a.g = b.g WHERE a.v = 1 AND b.v = 2 ORDER BY a.id"
+        plain = s.must_query(q)
+        hinted = s.must_query(q.replace("SELECT ", "SELECT /*+ MERGE_JOIN(a) */ ", 1))
+        assert hinted == plain
+
+    def test_read_from_storage(self, s):
+        q = "SELECT COUNT(*) FROM t WHERE v > 2"
+        t0 = s.cop.stats["host_tasks"]
+        s.must_query("SELECT /*+ READ_FROM_STORAGE(HOST[t]) */ COUNT(*) FROM t WHERE v > 2")
+        assert s.cop.stats["host_tasks"] > t0
+
+
+class TestBindings:
+    def test_binding_applies_hints(self, s):
+        q = "SELECT * FROM t WHERE g = 5"
+        s.execute(f"CREATE GLOBAL BINDING FOR {q} USING SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t WHERE g = 5")
+        # the bound statement (different literal, same digest) avoids ig
+        txt = _plan_text(s, "SELECT * FROM t WHERE g = 7")
+        assert "ig" not in txt
+        rows = s.must_query("SHOW BINDINGS")
+        assert len(rows) == 1 and "IGNORE_INDEX" in rows[0][1]
+
+    def test_binding_not_applied_when_stmt_has_hints(self, s):
+        q = "SELECT * FROM t WHERE g = 5"
+        s.execute(f"CREATE GLOBAL BINDING FOR {q} USING SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t WHERE g = 5")
+        txt = _plan_text(s, "SELECT /*+ USE_INDEX(t, ig) */ * FROM t WHERE g = 7")
+        assert "ig" in txt  # explicit hints win over bindings
+
+    def test_drop_binding(self, s):
+        q = "SELECT * FROM t WHERE g = 5"
+        s.execute(f"CREATE GLOBAL BINDING FOR {q} USING SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t WHERE g = 5")
+        s.execute(f"DROP GLOBAL BINDING FOR {q}")
+        assert s.must_query("SHOW BINDINGS") == []
+        txt = _plan_text(s, "SELECT * FROM t WHERE g = 7")
+        assert "ig" in txt  # back to the cost-based choice
+
+    def test_binding_requires_hints(self, s):
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("CREATE GLOBAL BINDING FOR SELECT * FROM t USING SELECT * FROM t")
+
+    def test_binding_shared_across_sessions(self, s):
+        q = "SELECT * FROM t WHERE g = 5"
+        s.execute(f"CREATE GLOBAL BINDING FOR {q} USING SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t WHERE g = 5")
+        other = Session(s.store)
+        txt = _plan_text(other, "SELECT * FROM t WHERE g = 9")
+        assert "ig" not in txt
+
+
+class TestBindingScopes:
+    def test_session_binding_local_only(self, s):
+        q = "SELECT * FROM t WHERE g = 5"
+        s.execute(f"CREATE SESSION BINDING FOR {q} USING SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t WHERE g = 5")
+        assert "ig" not in _plan_text(s, "SELECT * FROM t WHERE g = 7")
+        other = Session(s.store)
+        assert "ig" in _plan_text(other, "SELECT * FROM t WHERE g = 7")
+        s.execute(f"DROP SESSION BINDING FOR {q}")
+        assert "ig" in _plan_text(s, "SELECT * FROM t WHERE g = 7")
+
+    def test_global_binding_needs_super(self, s):
+        from tidb_tpu.privilege.cache import PrivilegeError
+
+        s.execute("CREATE USER pleb2")
+        s.execute("GRANT SELECT ON test.* TO pleb2")
+        p = Session(s.store)
+        p.user = "pleb2"
+        with pytest.raises(PrivilegeError):
+            p.execute(
+                "CREATE GLOBAL BINDING FOR SELECT * FROM t USING SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t"
+            )
+        # session-scoped bindings are allowed for any user
+        p.execute(
+            "CREATE SESSION BINDING FOR SELECT * FROM t WHERE g = 1 "
+            "USING SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t WHERE g = 1"
+        )
+
+    def test_unknown_index_hint_errors(self, s):
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError, match="doesn't exist"):
+            s.must_query("SELECT /*+ USE_INDEX(t, nope) */ * FROM t WHERE g = 1")
+
+    def test_alias_only_addressing(self, s):
+        # the base name must NOT bind when the table is aliased
+        txt = _plan_text(s, "SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t x WHERE g = 3")
+        assert "ig" in txt  # hint didn't attach → index still chosen

@@ -1,0 +1,59 @@
+"""Plan bindings — persisted hint sets matched by statement digest
+(ref: bindinfo/handle.go:48 BindHandle, :124 Update; bindings live in
+mysql.bind_info and attach their hints to any un-hinted statement whose
+normalized digest matches)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class BindingCache:
+    def __init__(self, storage):
+        self.storage = storage
+        self.notify_version = 0
+        self._version = -1
+        self._lock = threading.Lock()
+        self._sys_session = None
+        self._by_digest: dict[str, list] = {}  # digest → hints [(NAME, args)]
+
+    def bump_version(self) -> None:
+        with self._lock:
+            self.notify_version += 1
+
+    def _sys(self):
+        if self._sys_session is None:
+            from .session import Session
+
+            self._sys_session = Session(self.storage)
+        return self._sys_session
+
+    def _ensure(self) -> None:
+        with self._lock:
+            v = self.notify_version
+            if v == self._version:
+                return
+            from .parser import parse_one
+
+            sess = self._sys()
+            by_digest: dict[str, list] = {}
+            for digest, bind_sql in sess._sql_internal(
+                "SELECT original_digest, bind_sql FROM mysql.bind_info WHERE status = 'enabled'"
+            ):
+                try:
+                    stmt = parse_one(bind_sql)
+                except Exception:  # noqa: BLE001 — a broken binding must not break queries
+                    continue
+                hints = list(getattr(stmt, "hints", []) or [])
+                if hints:
+                    by_digest[digest] = hints
+            self._by_digest = by_digest
+            self._version = v
+
+    def hints_for(self, digest: str) -> list:
+        self._ensure()
+        return self._by_digest.get(digest, [])
+
+    def rows(self):
+        self._ensure()
+        return sorted(self._by_digest.items())

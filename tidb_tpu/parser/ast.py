@@ -171,6 +171,7 @@ class Select:
     windows: list = field(default_factory=list)
     setop: Any = None  # ('union'|'union all'|..., Select) chained
     with_: Any = None  # WithClause
+    hints: list = field(default_factory=list)  # [(NAME, [args])]
 
 
 @dataclass
@@ -384,6 +385,19 @@ class SplitRegion:
     table: TableName
     between: tuple | None = None  # (lower expr list, upper expr list, regions int)
     by: list = field(default_factory=list)
+
+
+@dataclass
+class CreateBinding:
+    for_sql: str
+    using_sql: str
+    global_: bool = True
+
+
+@dataclass
+class DropBinding:
+    for_sql: str
+    global_: bool = True
 
 
 @dataclass

@@ -9,7 +9,8 @@ from ..errors import ParseError
 
 TOKEN_RE = re.compile(
     r"""
-    (?P<ws>\s+|\#[^\n]*|--\s[^\n]*|/\*.*?\*/)
+    (?P<hint>/\*\+.*?\*/)
+  | (?P<ws>\s+|\#[^\n]*|--\s[^\n]*|/\*.*?\*/)
   | (?P<hex>0[xX][0-9a-fA-F]+|[xX]'[0-9a-fA-F]*')
   | (?P<num>(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?)
   | (?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.|"")*")

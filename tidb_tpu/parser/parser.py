@@ -74,9 +74,31 @@ def parse_one(sql: str):
     return stmts[0]
 
 
+import re as _re
+
+_HINT_RE = _re.compile(r"(\w+)\s*(?:\(([^()]*)\))?")
+
+
+def parse_hint_text(text: str) -> list:
+    """'/*+ NAME(a, b) NAME2 */' → [(NAME, [a, b]), (NAME2, [])]."""
+    body = text[3:-2]
+    out = []
+    for m in _HINT_RE.finditer(body):
+        name = m.group(1).upper()
+        args = [a.strip().strip("'\"`").lower() for a in (m.group(2) or "").split(",") if a.strip()]
+        out.append((name, args))
+    return out
+
+
 class Parser:
     def __init__(self, toks: list[Token], sql: str = ""):
-        self.toks = toks
+        # optimizer hints apply statement-wide (query-block scoping is a
+        # later refinement): collect and strip them from the stream
+        self.hints = []
+        for t in toks:
+            if t.kind == "hint":
+                self.hints.extend(parse_hint_text(t.text))
+        self.toks = [t for t in toks if t.kind != "hint"]
         self.i = 0
         self.sql = sql
         self.param_count = 0
@@ -214,6 +236,8 @@ class Parser:
         stmt = self._select_body()
         if with_ is not None:
             stmt.with_ = with_
+        if self.hints:
+            stmt.hints = list(self.hints)
         return stmt
 
     def _select_body(self):
@@ -908,8 +932,31 @@ class Parser:
             return ast.Grant(privs, db, tbl, users)
         return ast.Revoke(privs, db, tbl, users)
 
+    def _binding_stmt(self, kind: str, global_: bool):
+        """CREATE/DROP [GLOBAL] BINDING FOR <stmt> [USING <stmt>]
+        (ref: bindinfo; the FOR/USING statements are captured as raw SQL
+        spans so digests normalize identically to live queries)."""
+        self.expect_kw("FOR")
+        start = self.tok.pos
+        self.statement()  # validate + advance
+        if kind == "drop":
+            end = self.tok.pos if not self.at("eof") else len(self.sql)
+            return ast.DropBinding(self.sql[start:end].strip(), global_)
+        using_tok = self.tok
+        self.expect_kw("USING")
+        for_sql = self.sql[start : using_tok.pos].strip()
+        ustart = self.tok.pos
+        self.statement()
+        uend = self.tok.pos if not self.at("eof") else len(self.sql)
+        return ast.CreateBinding(for_sql, self.sql[ustart:uend].strip(), global_)
+
     def create_stmt(self):
         self.expect_kw("CREATE")
+        g = self.try_kw("GLOBAL")
+        if not g:
+            self.try_kw("SESSION")
+        if self.try_kw("BINDING"):
+            return self._binding_stmt("create", g)
         if self.try_kw("USER"):
             ine = self._if_not_exists()
             return ast.CreateUser(self._user_spec_list(), ine)
@@ -1054,6 +1101,11 @@ class Parser:
 
     def drop_stmt(self):
         self.expect_kw("DROP")
+        g = self.try_kw("GLOBAL")
+        if not g:
+            self.try_kw("SESSION")
+        if self.try_kw("BINDING"):
+            return self._binding_stmt("drop", g)
         if self.try_kw("USER"):
             ie = self._if_exists()
             return ast.DropUser(self._user_spec_list(), ie)
@@ -1203,6 +1255,8 @@ class Parser:
                 node.target = self.ident()
         elif self.try_kw("DATABASES") or self.try_kw("SCHEMAS"):
             node.kind = "databases"
+        elif self.try_kw("BINDINGS"):
+            node.kind = "bindings"
         elif self.try_kw("GRANTS"):
             node.kind = "grants"
             if self.try_kw("FOR"):
@@ -1237,6 +1291,8 @@ class Parser:
             node.kind = "collation"
         elif self.try_kw("CHARSET") or (self.try_kw("CHARACTER") and self.expect_kw("SET")):
             node.kind = "charset"
+        elif self.try_kw("BINDINGS"):
+            node.kind = "bindings"
         elif self.try_kw("GRANTS"):
             node.kind = "grants"
             while not self.at("eof") and not self.at_op(";"):
@@ -1264,7 +1320,12 @@ class Parser:
             self.expect_op("=")
             fmt = self.next().text.lower()
         if self.at_kw("SELECT", "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH") or self.at_op("("):
-            return ast.Explain(self.statement(), analyze=analyze, format=fmt)
+            start = self.tok.pos
+            inner = self.statement()
+            end = self.tok.pos if not self.at("eof") else len(self.sql)
+            node = ast.Explain(inner, analyze=analyze, format=fmt)
+            node.inner_sql = self.sql[start:end].strip()
+            return node
         # EXPLAIN <table> == DESC <table>
         return ast.Show("columns", target=self._table_name())
 

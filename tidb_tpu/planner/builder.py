@@ -128,13 +128,14 @@ class PlanBuilder:
         c = scope.cols[idx]
         return ECol(idx, c.ft, c.name)
 
-    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None, context_info=None):
+    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None, context_info=None, hints=None):
         self.is_ = infoschema
         self.db = current_db
         self.run_subquery = run_subquery  # callable(Select ast) -> list[Datum rows]
         self.params = params  # EXECUTE-bound Constants for '?' placeholders
         self.memtable_rows = memtable_rows  # callable(name) -> rows (info schema)
         self.context_info = context_info or {}  # user/conn info for info funcs
+        self.hints = hints or []  # [(NAME, [args])] — statement-wide
         # set when a subquery was evaluated eagerly at plan time: such a
         # plan bakes in data and must not enter the plan cache
         self.used_eager_subquery = False
@@ -263,7 +264,24 @@ class PlanBuilder:
             for c in info.columns
             if not c.hidden
         ]
-        return DataSource(info, tn.alias or tn.name, cols)
+        ds = DataSource(info, tn.alias or tn.name, cols)
+        # an aliased table is addressable ONLY by its alias (TiDB rule)
+        name = (tn.alias or tn.name).lower()
+        known = {ix.name.lower() for ix in info.indexes}
+        for h, args in self.hints:
+            if not args or args[0] != name:
+                continue
+            if h in ("USE_INDEX", "FORCE_INDEX", "IGNORE_INDEX"):
+                wanted = {a.lower() for a in args[1:]}
+                missing = wanted - known
+                if missing:
+                    raise TiDBError(
+                        f"Key {sorted(missing)[0]!r} doesn't exist in table {name!r}"
+                    )
+                attr = "hint_ignore_index" if h == "IGNORE_INDEX" else "hint_use_index"
+                cur = getattr(ds, attr, None) or set()
+                setattr(ds, attr, cur | wanted)
+        return ds
 
     def build_from(self, node) -> LogicalPlan:
         if node is None:

@@ -266,10 +266,18 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
             _drop_conds(ds, ha.access_conds)
             return
 
-    # 2. secondary indexes — gather candidates
+    # 2. secondary indexes — gather candidates (USE_INDEX restricts,
+    # IGNORE_INDEX excludes — ref: planner/core hint handling)
+    use_hint = getattr(ds, "hint_use_index", None)
+    ignore_hint = getattr(ds, "hint_ignore_index", None) or ()
     candidates = []  # (idx, ia, col_vis, covering)
     for idx in table.indexes:
         if idx.state != "public" or (table.pk_is_handle and idx.primary):
+            continue
+        lname = idx.name.lower()
+        if use_hint is not None and lname not in use_hint:
+            continue
+        if lname in ignore_hint:
             continue
         col_vis, col_fts = [], []
         ok = True

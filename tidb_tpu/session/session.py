@@ -92,6 +92,7 @@ class Session:
         # authenticated identity (set by the wire handshake; in-process
         # sessions run as root, the bootstrap superuser)
         self.user = "root"
+        self._session_bindings: dict[str, list] = {}  # digest → hints
         import itertools as _it
 
         self.conn_id = next(Session._conn_counter)
@@ -140,6 +141,17 @@ class Session:
             self.execute("INSERT INTO mysql.user VALUES ('%', 'root', '', 'ALL')")
         finally:
             self._in_bootstrap = False
+        try:
+            self.infoschema().table("mysql", "bind_info")
+        except UnknownTable:
+            self._in_bootstrap = True
+            try:
+                self.execute(
+                    "CREATE TABLE mysql.bind_info (original_digest VARCHAR(32), "
+                    "original_sql VARCHAR(1024), bind_sql VARCHAR(1024), status VARCHAR(16))"
+                )
+            finally:
+                self._in_bootstrap = False
 
     def _sql_internal(self, sql: str) -> list[tuple]:
         """Run SQL as the internal superuser (privilege checks suspended —
@@ -375,6 +387,10 @@ class Session:
         if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.Grant, ast.Revoke,
                              ast.BRIEStmt, ast.AdminStmt, ast.KillStmt)):
             return [("SUPER", "*")]
+        if isinstance(stmt, (ast.CreateBinding, ast.DropBinding)):
+            # global bindings steer every session's plans; session-scoped
+            # ones only affect the caller
+            return [("SUPER", "*")] if stmt.global_ else []
         return []  # SET/SHOW/USE/txn control etc. need no table privilege
 
     def _check_privileges(self, stmt) -> None:
@@ -464,6 +480,10 @@ class Session:
             return ResultSet([], None)
         if isinstance(stmt, ast.AdminStmt) and stmt.kind == "show_ddl_jobs":
             return self._admin_show_ddl_jobs()
+        if isinstance(stmt, ast.CreateBinding):
+            return self._run_create_binding(stmt)
+        if isinstance(stmt, ast.DropBinding):
+            return self._run_drop_binding(stmt)
         if isinstance(stmt, ast.CreateUser):
             return self._run_create_user(stmt)
         if isinstance(stmt, ast.DropUser):
@@ -585,6 +605,39 @@ class Session:
             raise PrivilegeError("cannot partially revoke from an ALL PRIVILEGES grant")
         return cur - privs
 
+    def _run_create_binding(self, stmt: ast.CreateBinding) -> ResultSet:
+        from ..utils.stmtstats import sql_digest
+
+        using = parse_one(stmt.using_sql)
+        if not getattr(using, "hints", None):
+            raise TiDBError("the USING statement carries no optimizer hints")
+        digest = sql_digest(stmt.for_sql)
+        if not stmt.global_:
+            self._session_bindings[digest] = list(using.hints)
+            self._plan_cache.clear()
+            return ResultSet([], None)
+        self._sql_internal(f"DELETE FROM mysql.bind_info WHERE original_digest = '{digest}'")
+        self._sql_internal(
+            "INSERT INTO mysql.bind_info VALUES "
+            f"('{digest}', '{self._q(stmt.for_sql)}', '{self._q(stmt.using_sql)}', 'enabled')"
+        )
+        self.bindings.bump_version()
+        self._plan_cache.clear()
+        return ResultSet([], None)
+
+    def _run_drop_binding(self, stmt: ast.DropBinding) -> ResultSet:
+        from ..utils.stmtstats import sql_digest
+
+        digest = sql_digest(stmt.for_sql)
+        if not stmt.global_:
+            self._session_bindings.pop(digest, None)
+            self._plan_cache.clear()
+            return ResultSet([], None)
+        self._sql_internal(f"DELETE FROM mysql.bind_info WHERE original_digest = '{digest}'")
+        self.bindings.bump_version()
+        self._plan_cache.clear()
+        return ResultSet([], None)
+
     def _admin_show_ddl_jobs(self) -> ResultSet:
         """ADMIN SHOW DDL JOBS (ref: executor ShowDDLJobsExec)."""
         from ..mysqltypes.field_type import ft_varchar
@@ -637,7 +690,32 @@ class Session:
             run_subquery=self._run_subquery, params=self._exec_params,
             memtable_rows=self._memtable_rows,
             context_info={"user": self.user, "conn_id": self.conn_id},
+            hints=getattr(self, "_cur_hints", None),
         )
+
+    @property
+    def bindings(self):
+        if getattr(self.store, "_binding_cache", None) is None:
+            from ..bindinfo import BindingCache
+
+            self.store._binding_cache = BindingCache(self.store)
+        return self.store._binding_cache
+
+    def _effective_hints(self, stmt, sql: str | None) -> list:
+        hints = list(getattr(stmt, "hints", []) or [])
+        if hints or sql is None or self._in_bootstrap:
+            return hints
+        b = self.bindings
+        # fast path: no bindings anywhere → skip digesting entirely
+        if not self._session_bindings and b.notify_version == b._version and not b._by_digest:
+            return hints
+        from ..utils.stmtstats import sql_digest
+
+        digest = sql_digest(sql)
+        local = self._session_bindings.get(digest)
+        if local:
+            return local
+        return b.hints_for(digest)
 
     def _memtable_rows(self, name: str):
         from ..catalog.memtables import rows_for
@@ -656,6 +734,7 @@ class Session:
             self.infoschema().version,
             self.store.stats.generation,
             self.vars.get("tidb_cop_engine", ""),
+            repr(getattr(self, "_cur_hints", None) or []),
         )
         plan = self._plan_cache.get(key)
         if plan is not None:
@@ -677,12 +756,36 @@ class Session:
         return plan
 
     def run_select(self, stmt, sql: str | None = None) -> ResultSet:
-        plan = self._plan_for(stmt, sql)
+        prev_hints = getattr(self, "_cur_hints", None)
+        hints = self._effective_hints(stmt, sql)
+        self._cur_hints = hints
+        try:
+            plan = self._plan_for(stmt, sql)
+        finally:
+            # restore, not clear: subquery planning nests run_select
+            self._cur_hints = prev_hints
+        engine = self.vars.get("tidb_cop_engine", "auto")
+        exec_vars = self.vars
+        for h, args in hints:
+            if h == "MERGE_JOIN":
+                exec_vars = dict(exec_vars, tidb_opt_prefer_merge_join="ON")
+            elif h in ("INL_JOIN", "INDEX_JOIN"):
+                exec_vars = dict(exec_vars, tidb_opt_prefer_index_join="ON")
+            elif h == "HASH_JOIN":
+                exec_vars = dict(
+                    exec_vars, tidb_opt_prefer_merge_join="OFF", tidb_opt_prefer_index_join="OFF"
+                )
+            elif h == "READ_FROM_STORAGE" and args:
+                store_kind = args[0].split("[")[0]
+                if store_kind in ("tpu", "tiflash"):
+                    engine = "tpu"
+                elif store_kind in ("host", "tikv"):
+                    engine = "host"
         ctx = ExecContext(
             self.cop,
             self.read_ts(),
-            engine=self.vars.get("tidb_cop_engine", "auto"),
-            vars=self.vars,
+            engine=engine,
+            vars=exec_vars,
             txn=self.txn,
         )
         ex = build_executor(plan, ctx)
@@ -1372,6 +1475,15 @@ class Session:
 
     def _run_show(self, stmt: ast.Show) -> ResultSet:
         is_ = self.infoschema()
+        if stmt.kind == "bindings":
+            rows = self._sql_internal(
+                "SELECT original_sql, bind_sql, status FROM mysql.bind_info"
+            )
+            chk = Chunk.from_datum_rows(
+                [ft_varchar(), ft_varchar(), ft_varchar()],
+                [[Datum.s(a), Datum.s(b), Datum.s(c)] for a, b, c in rows],
+            )
+            return ResultSet(["Original_sql", "Bind_sql", "Status"], chk)
         if stmt.kind == "grants":
             user = stmt.target.user if stmt.target is not None else self.user
             grants = self.priv.grants_for(self, user)
@@ -1512,7 +1624,12 @@ class Session:
     def _run_explain(self, stmt: ast.Explain) -> ResultSet:
         if not isinstance(stmt.stmt, (ast.Select, ast.SetOpSelect)):
             raise TiDBError("EXPLAIN supports SELECT only for now")
-        plan = self.plan_select(stmt.stmt)
+        prev_hints = getattr(self, "_cur_hints", None)
+        self._cur_hints = self._effective_hints(stmt.stmt, getattr(stmt, "inner_sql", None))
+        try:
+            plan = self.plan_select(stmt.stmt)
+        finally:
+            self._cur_hints = prev_hints
         if getattr(stmt, "analyze", False):
             return self._run_explain_analyze(plan)
         lines = plan.pretty().split("\n")
