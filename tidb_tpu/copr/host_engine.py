@@ -115,20 +115,21 @@ def execute_dag_host(dag: DAGRequest, batch: ColumnBatch) -> Chunk:
 
 
 def _lex_argsort(keys, n: int) -> np.ndarray:
-    """Stable lexicographic argsort; NULLs first (MySQL), desc per key."""
+    """Stable lexicographic argsort; NULLs first asc / last desc (MySQL).
+
+    DESC keys sort by NEGATED rank under a stable sort — reversing an
+    ascending stable sort would also reverse the tie order established by
+    later (less significant) keys."""
     order = np.arange(n)
     for d, v, desc in reversed(keys):
         if d.dtype == object:
             strs = np.where(v, d, "").astype("U")
-            idx = np.argsort(strs[order], kind="stable")
-            keyvals = None
+            x = np.unique(strs, return_inverse=True)[1].astype(np.int64)
         else:
             x = d.astype(np.float64) if d.dtype != np.float64 else d
-            idx = np.argsort(x[order], kind="stable")
-        if desc:
-            idx = idx[::-1]
+        idx = np.argsort((-x if desc else x)[order], kind="stable")
         order = order[idx]
-        # NULLs first asc / last desc
+        # NULLs first asc / last desc (boolean selection is stable)
         nulls = ~v[order]
         if desc:
             order = np.concatenate([order[~nulls], order[nulls]])

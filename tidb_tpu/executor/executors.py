@@ -147,7 +147,8 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
             [c.ft for c in plan.out_cols],
         )
     if isinstance(plan, Sort):
-        return SortExec(build_executor(plan.children[0], ctx), plan.by)
+        quota = int(ctx.vars.get("tidb_mem_quota_query", "0") or 0)
+        return SortExec(build_executor(plan.children[0], ctx), plan.by, spill_limit=quota)
     if isinstance(plan, Limit):
         return _build_limit(plan, ctx)
     if isinstance(plan, SetOp):
@@ -688,36 +689,148 @@ class WindowExec(Executor):
         return acc[env["frame_end"]], accv[env["frame_end"]]
 
 
+SPILL_COUNT = 0  # process-wide spill events (observability + tests)
+
+
+class _MergeVal:
+    """Heap-comparable sort key element honoring NULL-first + desc;
+    comparison goes through compare_datum so every datum kind (Dec,
+    packed times, strings) orders correctly."""
+
+    __slots__ = ("d", "desc")
+
+    def __init__(self, d, desc):
+        self.d = d
+        self.desc = desc
+
+    def __lt__(self, other):
+        a, b = self.d, other.d
+        if a.is_null != b.is_null:
+            # asc: NULLs first; desc: NULLs last (MySQL)
+            return a.is_null if not self.desc else b.is_null
+        if a.is_null:
+            return False
+        c = compare_datum(a, b)
+        return c > 0 if self.desc else c < 0
+
+    def __eq__(self, other):
+        a, b = self.d, other.d
+        if a.is_null or b.is_null:
+            return a.is_null and b.is_null
+        return compare_datum(a, b) == 0
+
+
 class SortExec(Executor):
-    def __init__(self, child: Executor, by):
+    """External-merge sort (ref: executor/sort.go:35 + the spill action at
+    :60 / util/chunk/row_container.go:235): input accumulates in memory
+    until `spill_limit` bytes, each overflow sorts + spills one run file,
+    and the tail is a k-way merge over the sorted runs."""
+
+    def __init__(self, child: Executor, by, spill_limit: int = 0):
         self.child = child
         self.by = by
+        self.spill_limit = spill_limit  # 0 = never spill
         self.out_fts = child.out_fts
         self._out = None
 
     def open(self):
-        # child is opened by drain() in _sorted_chunk — opening it here too
+        # the child is pulled inside _sorted_chunk — opening it here too
         # would run the whole subtree (incl. cop sends) twice
         self._out = None
 
-    def _sorted_chunk(self) -> Chunk:
+    def _sort_in_mem(self, all_: Chunk) -> Chunk:
         from ..copr.host_engine import _lex_argsort
 
-        all_ = drain(self.child)
-        if all_.num_rows == 0:
-            return all_
         keys = []
         for e, desc in self.by:
-            d, v = e.eval(all_)
+            d, v = _broadcast_lane(*e.eval(all_), all_.num_rows)
             keys.append((d, v, desc))
         order = _lex_argsort(keys, all_.num_rows)
         return all_.take(order)
 
+    def _produce(self):
+        """Generator of output chunks. In-memory path yields once; the
+        spill path streams merge batches, so the full result is never
+        re-materialized (the caller's drain tracks each batch against the
+        statement quota). The working set is bounded by spill_limit +
+        one input chunk by construction."""
+        from ..chunk.chunk_io import SpillFile
+        from ..utils.memory import chunk_bytes
+
+        runs: list[SpillFile] = []
+        try:
+            mem: list[Chunk] = []
+            mem_bytes = 0
+            self.child.open()
+            try:
+                while True:
+                    c = self.child.next()
+                    if c is None:
+                        break
+                    if not c.num_rows:
+                        continue
+                    mem.append(c)
+                    mem_bytes += chunk_bytes(c)
+                    if self.spill_limit and mem_bytes >= self.spill_limit:
+                        global SPILL_COUNT
+                        SPILL_COUNT += 1
+                        run = SpillFile()
+                        srt = self._sort_in_mem(Chunk.concat_all(mem))
+                        for lo in range(0, srt.num_rows, 4096):
+                            run.write(srt.slice(lo, min(lo + 4096, srt.num_rows)))
+                        run.finish()
+                        runs.append(run)
+                        mem, mem_bytes = [], 0
+            finally:
+                self.child.close()
+            tail = Chunk.concat_all(mem) if mem else Chunk.empty(self.out_fts, 0)
+            if not runs:
+                if tail.num_rows:
+                    yield self._sort_in_mem(tail)
+                return
+            yield from self._merge_runs(runs, tail)
+        finally:
+            for r in runs:
+                r.cleanup()
+
+    def _merge_runs(self, runs, tail: Chunk):
+        """K-way streaming merge of sorted run files + the in-memory tail."""
+        import heapq
+
+        def keyed(chunks_iter, sid):
+            for c in chunks_iter:
+                # one Column per (chunk, key): get_datum(i) per row after
+                key_cols = []
+                for e, desc in self.by:
+                    d, v = _broadcast_lane(*e.eval(c), c.num_rows)
+                    key_cols.append((Column(e.ret_type, d, v), desc))
+                for i in range(c.num_rows):
+                    key = tuple(_MergeVal(col.get_datum(i), desc) for col, desc in key_cols)
+                    yield key, sid, c, i
+
+        sources = [keyed(r.chunks(self.out_fts), k) for k, r in enumerate(runs)]
+        if tail.num_rows:
+            sources.append(keyed([self._sort_in_mem(tail)], len(runs)))
+        batch_rows: list = []
+        for key, sid, c, i in heapq.merge(*sources, key=lambda t: t[0]):
+            batch_rows.append(c.get_row(i))
+            if len(batch_rows) >= 4096:
+                yield Chunk.from_datum_rows(self.out_fts, batch_rows)
+                batch_rows = []
+        if batch_rows:
+            yield Chunk.from_datum_rows(self.out_fts, batch_rows)
+
     def next(self):
         if self._out is None:
-            self._out = self._sorted_chunk()
-            return self._out
-        return None
+            self._out = self._produce()
+        return next(self._out, None)
+
+    def _sorted_chunk(self) -> Chunk:
+        """Fully-materialized sorted result (TopN's bounded path)."""
+        chunks = [c for c in self._produce() if c.num_rows]
+        if not chunks:
+            return Chunk.empty(self.out_fts, 0)
+        return Chunk.concat_all(chunks)
 
 
 class TopNExec(SortExec):
