@@ -1,0 +1,143 @@
+"""Plugin hooks, telemetry, SHOW PROCESSLIST + KILL
+(ref: plugin/audit.go, telemetry/, infoschema PROCESSLIST,
+server.go:609 Kill)."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import QueryInterrupted, TiDBError
+from tidb_tpu.plugin import Plugin
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    sess.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    return sess
+
+
+class Recorder(Plugin):
+    name = "recorder"
+
+    def __init__(self):
+        self.queries = []
+        self.connects = []
+
+    def on_connect(self, user, host):
+        self.connects.append((user, host))
+
+    def on_query(self, user, db, sql, ok, dur):
+        self.queries.append((user, db, sql, ok))
+
+
+class TestPlugins:
+    def test_audit_hook_fires(self, s):
+        rec = Recorder()
+        s.store.plugins.register(rec)
+        s.must_query("SELECT COUNT(*) FROM t")
+        with pytest.raises(TiDBError):
+            s.execute("SELECT nope FROM t")
+        oks = [q for q in rec.queries if q[3]]
+        fails = [q for q in rec.queries if not q[3]]
+        assert any("COUNT(*)" in q[2] for q in oks)
+        assert any("nope" in q[2] for q in fails)
+        assert all(q[0] == "root" for q in rec.queries)
+
+    def test_broken_plugin_does_not_break_queries(self, s):
+        class Broken(Plugin):
+            name = "broken"
+
+            def on_query(self, *a):
+                raise RuntimeError("boom")
+
+        s.store.plugins.register(Broken())
+        assert s.must_query("SELECT 1") == [("1",)]
+        s.store.plugins.unregister("broken")
+
+    def test_load_from_module(self, s, tmp_path, monkeypatch):
+        import sys
+
+        (tmp_path / "myplug.py").write_text(
+            "from tidb_tpu.plugin import Plugin\n"
+            "class P(Plugin):\n"
+            "    name = 'myplug'\n"
+            "plugin = P()\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        p = s.store.plugins.load("myplug")
+        assert p.name == "myplug"
+        s.store.plugins.unregister("myplug")
+
+
+class TestTelemetry:
+    def test_snapshot_shape(self, s):
+        from tidb_tpu import telemetry
+
+        s.must_query("SELECT 1")
+        snap = telemetry.snapshot(s.store, s)
+        assert snap["tables"] >= 1 and snap["databases"] >= 2
+        assert snap["uptime_s"] >= 0
+        assert not snap["durable"]
+
+
+class TestProcessListAndKill:
+    def test_show_processlist_self(self, s):
+        rows = s.must_query("SHOW PROCESSLIST")
+        assert any("SHOW PROCESSLIST" in r[4] for r in rows)
+        assert all(r[1] == "root" for r in rows)
+
+    def test_kill_unknown_id(self, s):
+        with pytest.raises(TiDBError, match="Unknown thread"):
+            s.execute("KILL 99999")
+
+    def test_kill_interrupts_running_query(self, s):
+        s.execute("INSERT INTO t VALUES " + ",".join(f"({i}, {i})" for i in range(3, 4000)))
+        victim = Session(s.store)
+        state = {}
+
+        def run_victim():
+            try:
+                # recursive CTE gives the executor many chunk boundaries
+                victim.execute(
+                    "WITH RECURSIVE r (n) AS (SELECT 1 UNION ALL SELECT n + 1 FROM r WHERE n < 900) "
+                    "SELECT COUNT(*) FROM r a JOIN r b ON a.n = b.n JOIN r c ON b.n = c.n"
+                )
+                state["result"] = "finished"
+            except QueryInterrupted:
+                state["result"] = "killed"
+            except Exception as e:  # noqa: BLE001
+                state["result"] = f"other: {e}"
+
+        t = threading.Thread(target=run_victim)
+        t.start()
+        deadline = time.time() + 10
+        killed = False
+        while time.time() < deadline and not killed:
+            rows = s.must_query("SHOW PROCESSLIST")
+            for r in rows:
+                if "RECURSIVE" in r[4]:
+                    s.execute(f"KILL {r[0]}")
+                    killed = True
+                    break
+            time.sleep(0.02)
+        t.join(timeout=30)
+        assert state.get("result") in ("killed", "finished")
+        if killed:
+            # whether it died mid-flight or won the race, the session must
+            # be healthy afterwards (at most one pending interrupt fires)
+            try:
+                r = victim.must_query("SELECT 1")
+            except QueryInterrupted:
+                r = victim.must_query("SELECT 1")
+            assert r == [("1",)]
+
+    def test_killed_flag_interrupts_next_statement(self, s):
+        other = Session(s.store)
+        other._killed = True
+        with pytest.raises(QueryInterrupted):
+            other.execute("SELECT 1")
+        assert other.must_query("SELECT 1") == [("1",)]  # flag clears

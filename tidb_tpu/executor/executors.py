@@ -50,6 +50,9 @@ import contextvars
 # statement-scoped memory tracker consumed by drain() at materialization
 # points (ref: util/memory tracker attached session->executor)
 _ACTIVE_TRACKER: contextvars.ContextVar = contextvars.ContextVar("mem_tracker", default=None)
+# the executing session, for KILL checks at chunk boundaries
+# (ref: sessVars.Killed checked in every guarded Next, executor.go:275)
+_ACTIVE_SESSION: contextvars.ContextVar = contextvars.ContextVar("active_session", default=None)
 
 
 class Executor:
@@ -67,9 +70,15 @@ class Executor:
 
 def drain(e: Executor) -> Chunk:
     tracker = _ACTIVE_TRACKER.get()
+    sess = _ACTIVE_SESSION.get()
     e.open()
     chunks = []
     while True:
+        if sess is not None and getattr(sess, "_killed", False):
+            from ..errors import QueryInterrupted
+
+            sess._killed = False
+            raise QueryInterrupted("Query execution was interrupted")
         c = e.next()
         if c is None:
             break
@@ -750,13 +759,14 @@ class SortExec(Executor):
 
     def _produce(self):
         """Generator of output chunks. In-memory path yields once; the
-        spill path streams merge batches, so the full result is never
-        re-materialized (the caller's drain tracks each batch against the
-        statement quota). The working set is bounded by spill_limit +
-        one input chunk by construction."""
+        spill path streams merge batches (the SORT's working set is
+        bounded by spill_limit + one input chunk; the final result is
+        still charged to the statement tracker by the consuming drain, so
+        quota bounds what the query ultimately materializes)."""
         from ..chunk.chunk_io import SpillFile
         from ..utils.memory import chunk_bytes
 
+        sess = _ACTIVE_SESSION.get()
         runs: list[SpillFile] = []
         try:
             mem: list[Chunk] = []
@@ -764,6 +774,11 @@ class SortExec(Executor):
             self.child.open()
             try:
                 while True:
+                    if sess is not None and getattr(sess, "_killed", False):
+                        from ..errors import QueryInterrupted
+
+                        sess._killed = False
+                        raise QueryInterrupted("Query execution was interrupted")
                     c = self.child.next()
                     if c is None:
                         break
@@ -825,15 +840,20 @@ class SortExec(Executor):
             self._out = self._produce()
         return next(self._out, None)
 
-    def _sorted_chunk(self) -> Chunk:
-        """Fully-materialized sorted result (TopN's bounded path)."""
-        chunks = [c for c in self._produce() if c.num_rows]
-        if not chunks:
-            return Chunk.empty(self.out_fts, 0)
-        return Chunk.concat_all(chunks)
+    def close(self):
+        # release the suspended generator promptly so spill files unlink
+        # now, not at an eventual gc cycle collection
+        if self._out is not None and hasattr(self._out, "close"):
+            self._out.close()
+        self._out = None
 
 
 class TopNExec(SortExec):
+    """ORDER BY ... LIMIT with a bounded working set: the buffer prunes
+    to the top-k whenever it overflows a multiple of k, so memory is
+    O(k + chunk) regardless of input size (ref: executor/sort.go:301
+    TopNExec's heap)."""
+
     def __init__(self, child: Executor, by, count: int, offset: int = 0):
         super().__init__(child, by)
         self.count = count
@@ -841,8 +861,31 @@ class TopNExec(SortExec):
 
     def next(self):
         if self._out is None:
-            c = self._sorted_chunk()
-            self._out = c.slice(min(self.offset, c.num_rows), min(self.offset + self.count, c.num_rows))
+            k = self.offset + self.count
+            sess = _ACTIVE_SESSION.get()
+            buf: Chunk | None = None
+            self.child.open()
+            try:
+                while True:
+                    if sess is not None and getattr(sess, "_killed", False):
+                        from ..errors import QueryInterrupted
+
+                        sess._killed = False
+                        raise QueryInterrupted("Query execution was interrupted")
+                    c = self.child.next()
+                    if c is None:
+                        break
+                    if not c.num_rows:
+                        continue
+                    buf = c if buf is None else Chunk.concat_all([buf, c])
+                    if buf.num_rows > max(4 * k, 4096):
+                        buf = self._sort_in_mem(buf).slice(0, k)
+            finally:
+                self.child.close()
+            if buf is None:
+                buf = Chunk.empty(self.out_fts, 0)
+            srt = self._sort_in_mem(buf) if buf.num_rows else buf
+            self._out = srt.slice(min(self.offset, srt.num_rows), min(k, srt.num_rows))
             return self._out
         return None
 

@@ -1,0 +1,62 @@
+"""Plugin framework — audit-style hook points
+(ref: plugin/plugin.go:135 Load + plugin/spi.go + plugin/audit.go; the
+reference loads .so plugins with audit hooks fired from session/conn.
+Here plugins are Python objects registered per Storage, with the same
+hook surface)."""
+
+from __future__ import annotations
+
+import importlib
+import threading
+
+
+class Plugin:
+    """Base plugin: override any subset of the hooks."""
+
+    name = "plugin"
+
+    def on_connect(self, user: str, host: str) -> None:  # noqa: B027
+        pass
+
+    def on_query(self, user: str, db: str, sql: str, ok: bool, duration_s: float) -> None:  # noqa: B027
+        pass
+
+    def on_shutdown(self) -> None:  # noqa: B027
+        pass
+
+
+class PluginRegistry:
+    def __init__(self):
+        self._plugins: list[Plugin] = []
+        self._lock = threading.Lock()
+
+    def register(self, plugin: Plugin) -> None:
+        with self._lock:
+            self._plugins.append(plugin)
+
+    def load(self, module_path: str) -> Plugin:
+        """Import a module exposing `plugin` (an instance) or `activate()`
+        (a factory) — the dlopen/Load analog."""
+        mod = importlib.import_module(module_path)
+        p = getattr(mod, "plugin", None)
+        if p is None and hasattr(mod, "activate"):
+            p = mod.activate()
+        if not isinstance(p, Plugin):
+            raise TypeError(f"{module_path} does not expose a Plugin")
+        self.register(p)
+        return p
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._plugins = [p for p in self._plugins if p.name != name]
+
+    def fire(self, hook: str, *args) -> None:
+        with self._lock:
+            plugins = list(self._plugins)
+        for p in plugins:
+            try:
+                getattr(p, hook)(*args)
+            except Exception:  # noqa: BLE001 — a broken plugin must not break queries
+                import logging
+
+                logging.getLogger("tidb_tpu.plugin").exception("plugin %s hook %s failed", p.name, hook)

@@ -57,6 +57,7 @@ class ClientConn:
         self.session.user = self.user
         if resp["db"]:
             self.session.current_db = resp["db"]
+        self.server.storage.plugins.fire("on_connect", self.user, "%")
         self.pkt.write_packet(p.ok_packet())
 
     def run(self) -> None:
@@ -200,12 +201,13 @@ class Server:
                 sock, _ = self._sock.accept()
             except OSError:
                 return  # socket closed during shutdown
+            conn = ClientConn(self, sock, 0)
+            # the wire-visible id IS the session id: KILL <id> from any
+            # client resolves against the same process registry
+            conn.conn_id = conn.session.conn_id
             with self._lock:
-                cid = self._next_id
-                self._next_id += 1
-                conn = ClientConn(self, sock, cid)
-                self._conns[cid] = conn
-            threading.Thread(target=conn.run, name=f"conn-{cid}", daemon=True).start()
+                self._conns[conn.conn_id] = conn
+            threading.Thread(target=conn.run, name=f"conn-{conn.conn_id}", daemon=True).start()
 
     def deregister(self, conn_id: int) -> None:
         with self._lock:

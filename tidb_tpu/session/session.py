@@ -234,12 +234,28 @@ class Session:
         saved = None
         if self.txn is not None:
             saved = (dict(self.txn.membuf), set(self.txn._locked_keys))
-        from ..executor.executors import _ACTIVE_TRACKER
+        from ..executor.executors import _ACTIVE_SESSION, _ACTIVE_TRACKER
         from ..utils.memory import MemTracker
         from ..utils import metrics as M
 
+        if getattr(self, "_killed", False):
+            self._killed = False
+            from ..errors import QueryInterrupted
+
+            raise QueryInterrupted("Query execution was interrupted")
         quota = int(self.vars.get("tidb_mem_quota_query", "0") or 0)
         token = _ACTIVE_TRACKER.set(MemTracker(quota) if quota > 0 else None)
+        stok = _ACTIVE_SESSION.set(self)
+        if not self._in_bootstrap:
+            import weakref
+
+            self.store.register_process(self.conn_id, {
+                "user": self.user,
+                "db": self.current_db,
+                "sql": sql[:256],
+                "start": time.time(),
+                "session": weakref.ref(self),
+            })
         t0 = time.perf_counter()
         ok = True
         try:
@@ -254,8 +270,11 @@ class Session:
             raise
         finally:
             _ACTIVE_TRACKER.reset(token)
+            _ACTIVE_SESSION.reset(stok)
             dur = time.perf_counter() - t0
             if not self._in_bootstrap:
+                self.store.clear_process(self.conn_id)
+                self.store.plugins.fire("on_query", self.user, self.current_db, sql, ok, dur)
                 M.QUERY_TOTAL.inc(type=type(stmt).__name__, result="OK" if ok else "Error")
                 M.QUERY_DURATION.observe(dur)
                 threshold = float(self.vars.get("tidb_slow_log_threshold", "300")) / 1000.0
@@ -478,6 +497,8 @@ class Session:
             return self._run_analyze(stmt)
         if isinstance(stmt, ast.FlushStmt):
             return ResultSet([], None)
+        if isinstance(stmt, ast.KillStmt):
+            return self._run_kill(stmt)
         if isinstance(stmt, ast.AdminStmt) and stmt.kind == "show_ddl_jobs":
             return self._admin_show_ddl_jobs()
         if isinstance(stmt, ast.CreateBinding):
@@ -636,6 +657,18 @@ class Session:
         self._sql_internal(f"DELETE FROM mysql.bind_info WHERE original_digest = '{digest}'")
         self.bindings.bump_version()
         self._plan_cache.clear()
+        return ResultSet([], None)
+
+    def _run_kill(self, stmt: ast.KillStmt) -> ResultSet:
+        """KILL [QUERY] <id> (ref: server.go:609 Kill + sessVars.Killed):
+        flags the target session; its executor loop raises
+        QueryInterrupted at the next chunk boundary."""
+        info = self.store.get_process(stmt.conn_id)
+        if info is None:
+            raise TiDBError(f"Unknown thread id: {stmt.conn_id}")
+        target = info["session"]()
+        if target is not None:
+            target._killed = True
         return ResultSet([], None)
 
     def _admin_show_ddl_jobs(self) -> ResultSet:
@@ -1475,6 +1508,18 @@ class Session:
 
     def _run_show(self, stmt: ast.Show) -> ResultSet:
         is_ = self.infoschema()
+        if stmt.kind == "processlist":
+            rows = []
+            now = time.time()
+            for cid, info in self.store.process_snapshot():
+                rows.append([
+                    Datum.i(cid), Datum.s(info["user"]), Datum.s(info["db"]),
+                    Datum.i(int(now - info["start"])), Datum.s(info["sql"]),
+                ])
+            chk = Chunk.from_datum_rows(
+                [ft_longlong(), ft_varchar(), ft_varchar(), ft_longlong(), ft_varchar()], rows
+            )
+            return ResultSet(["Id", "User", "db", "Time", "Info"], chk)
         if stmt.kind == "bindings":
             rows = self._sql_internal(
                 "SELECT original_sql, bind_sql, status FROM mysql.bind_info"

@@ -309,6 +309,10 @@ class Storage:
 
         self.detector = DeadlockDetector()
         self._gc_worker = None
+        import threading as _threading
+
+        self._processes: dict = {}
+        self._proc_lock = _threading.Lock()
         # eager: racing lazy-inits would defeat the worker's owner lock
         from ..ddl.worker import DDLWorker
 
@@ -477,6 +481,32 @@ class Storage:
             if os.path.exists(old_path):
                 os.unlink(old_path)
                 w.fsync_dir(self.data_dir)
+
+    @property
+    def plugins(self):
+        if getattr(self, "_plugins", None) is None:
+            from ..plugin import PluginRegistry
+
+            self._plugins = PluginRegistry()
+        return self._plugins
+
+    # --- live statement registry (ref: PROCESSLIST + server conn registry)
+
+    def register_process(self, conn_id: int, info: dict) -> None:
+        with self._proc_lock:
+            self._processes[conn_id] = info
+
+    def clear_process(self, conn_id: int) -> None:
+        with self._proc_lock:
+            self._processes.pop(conn_id, None)
+
+    def get_process(self, conn_id: int) -> dict | None:
+        with self._proc_lock:
+            return self._processes.get(conn_id)
+
+    def process_snapshot(self) -> list:
+        with self._proc_lock:
+            return sorted(self._processes.items())
 
     @property
     def stmt_stats(self):

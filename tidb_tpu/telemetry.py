@@ -1,0 +1,33 @@
+"""Telemetry — anonymous usage snapshot (ref: telemetry/telemetry.go;
+the reference periodically reports feature usage. Here the snapshot is
+computed on demand and NEVER leaves the process — there is no egress)."""
+
+from __future__ import annotations
+
+import time
+
+_START = time.time()
+
+
+def snapshot(storage, session=None) -> dict:
+    from .utils.metrics import REGISTRY
+
+    is_tables = 0
+    dbs = 0
+    if session is not None:
+        is_ = session.infoschema()
+        is_tables = len(is_.tables)
+        dbs = len(is_.db_names())
+    counters = {}
+    for name, labels, value in REGISTRY.rows():
+        if name.startswith("tidb_query_total"):
+            counters[labels or "total"] = counters.get(labels or "total", 0) + value
+    return {
+        "uptime_s": round(time.time() - _START, 1),
+        "databases": dbs,
+        "tables": is_tables,
+        "queries": counters,
+        "durable": storage.data_dir is not None,
+        "regions": len(storage.regions.regions),
+        "version": "8.0.11-tidb-tpu",
+    }
