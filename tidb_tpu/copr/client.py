@@ -211,8 +211,12 @@ class CopClient:
 
     # --- engine dispatch over an arbitrary batch --------------------------
 
+    AUTO_MIN_ROWS = 2048  # below this, device jit cost can't amortize
+
     def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str) -> Chunk:
         self._bump("tasks")
+        if engine == "auto" and batch.n_rows < self.AUTO_MIN_ROWS:
+            engine = "host"
         if engine in ("tpu", "auto"):
             try:
                 chunk = self.tpu.execute(dag, batch)

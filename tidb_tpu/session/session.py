@@ -155,13 +155,18 @@ class Session:
 
     def _sql_internal(self, sql: str) -> list[tuple]:
         """Run SQL as the internal superuser (privilege checks suspended —
-        the sysSessionPool analog, domain.go)."""
+        the sysSessionPool analog, domain.go). System-table reads pin the
+        host engine: compiling device programs for tiny mysql.* scans
+        would cost seconds of jit for microseconds of work."""
         prev = self._in_bootstrap
+        prev_engine = self.vars.get("tidb_cop_engine")
         self._in_bootstrap = True
+        self.vars["tidb_cop_engine"] = "host"
         try:
             return self.execute(sql).rows()
         finally:
             self._in_bootstrap = prev
+            self.vars["tidb_cop_engine"] = prev_engine
 
     # ------------------------------------------------------------- infoschema
 
