@@ -221,6 +221,52 @@ def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.nda
             np.bitwise_or.at(out_valid, inv, vv)
         yield Column(ft, out, out_valid)
         return
+    if name == "group_concat":
+        from ..chunk.chunk import Column as _C
+
+        argc = _C(a.args[0].ret_type, dv, vv)
+        from ..expr.aggregation import GROUP_CONCAT_MAX_LEN
+
+        parts: list[list[str]] = [[] for _ in range(G)]
+        for i, g in enumerate(inv):
+            if vv[i]:
+                parts[g].append(argc.get_datum(i).render(a.args[0].ret_type))
+        out = np.empty(G, dtype=object)
+        out_valid = np.zeros(G, dtype=bool)
+        for g in range(G):
+            if parts[g]:
+                out[g] = a.sep.join(parts[g])[:GROUP_CONCAT_MAX_LEN]
+                out_valid[g] = True
+        yield Column(out_fts[oi], out, out_valid)
+        return
+    if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+        from ..expr.expression import lane_as_float
+
+        x = np.where(vv, lane_as_float(np, dv, a.args[0].ret_type), 0.0)
+        cnt = seg_sum(vv.astype(np.float64)).astype(np.int64)
+        s = seg_sum(x)
+        sq = seg_sum(x * x)
+        ones = np.ones(G, dtype=bool)
+        yield Column(out_fts[oi], cnt, ones)
+        yield Column(out_fts[oi + 1], s, ones)
+        yield Column(out_fts[oi + 2], sq, ones)
+        return
+    if name in ("bit_and", "bit_or", "bit_xor"):
+        if dv.dtype == object:
+            from ..errors import TiDBError
+
+            raise TiDBError(f"{name.upper()} over string operands is not supported")
+        from ..expr.expression import lane_as_float
+
+        # MySQL rounds non-integers to the nearest integer before bit ops
+        ints = np.rint(lane_as_float(np, dv, a.args[0].ret_type)).astype(np.int64)
+        init = -1 if name == "bit_and" else 0  # all-ones / zero identities
+        out = np.full(G, init, dtype=np.int64)
+        fn = {"bit_and": np.bitwise_and, "bit_or": np.bitwise_or, "bit_xor": np.bitwise_xor}[name]
+        fn.at(out, inv, np.where(vv, ints, init if name == "bit_and" else 0))
+        # MySQL: bit aggregates over no rows return the identity, not NULL
+        yield Column(out_fts[oi], out, np.ones(G, dtype=bool))
+        return
     if name == "first_row":
         ft = out_fts[oi]
         out_valid = np.zeros(G, dtype=bool)

@@ -246,6 +246,10 @@ def _pushable_reader(e: Executor) -> "TableReaderExec | None":
 
 def _build_agg(plan: Aggregation, ctx: ExecContext) -> Executor:
     child = build_executor(plan.children[0], ctx)
+    if any(a.distinct for a in plan.aggs):
+        # DISTINCT aggregates cannot split into partial/final across
+        # chunks — complete mode over raw rows (ref: AggFuncMode Complete)
+        return CompleteAggExec(child, plan.group_by, plan.aggs, [c.ft for c in plan.out_cols])
     reader = _pushable_reader(child)
     pushable = (
         reader is not None
@@ -923,6 +927,131 @@ class LocalPartialAggExec(Executor):
         self.child.close()
 
 
+class CompleteAggExec(Executor):
+    """Complete-mode aggregation for DISTINCT (non-splittable) aggregates:
+    groups raw rows, dedups per-group argument values, computes finals
+    directly (ref: executor/aggregate.go unparallel path)."""
+
+    def __init__(self, child: Executor, group_by, aggs: list[AggDesc], out_fts):
+        self.child = child
+        self.group_by = group_by
+        self.aggs = aggs
+        self.out_fts = out_fts
+        self._done = False
+
+    def open(self):
+        self._done = False
+
+    def close(self):
+        self.child.close()
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        c = drain(self.child)
+        n = c.num_rows
+        key_lanes = [_broadcast_lane(*g.eval(c), n) for g in self.group_by]
+        arg_lanes = []
+        for a in self.aggs:
+            if a.args:
+                d, v = _broadcast_lane(*a.args[0].eval(c), n)
+                arg_lanes.append(Column(a.args[0].ret_type, d, v))
+            else:
+                arg_lanes.append(None)
+        key_cols = [Column(g.ret_type, d, v) for g, (d, v) in zip(self.group_by, key_lanes)]
+        groups: dict = {}
+        order: list = []
+        for i in range(n):
+            key = tuple(
+                (col.valid[i], col.data[i] if col.valid[i] else None) for col in key_cols
+            )
+            st = groups.get(key)
+            if st is None:
+                st = (i, [[] for _ in self.aggs])
+                groups[key] = st
+                order.append(key)
+            for k, col in enumerate(arg_lanes):
+                if col is None:
+                    st[1][k].append(Datum.i(1))
+                elif col.valid[i]:
+                    st[1][k].append(col.get_datum(i))
+        if not groups and not self.group_by:
+            groups[()] = (0, [[] for _ in self.aggs])
+            order.append(())
+        out = Chunk.empty(self.out_fts, len(order))
+        ng = len(self.group_by)
+        for r, key in enumerate(order):
+            first_i, states = groups[key]
+            for gi, col in enumerate(key_cols):
+                out.columns[gi].set_datum(r, col.get_datum(first_i))
+            for k, a in enumerate(self.aggs):
+                out.columns[ng + k].set_datum(r, self._final(a, states[k]))
+        return out
+
+    @staticmethod
+    def _final(a: AggDesc, datums: list) -> Datum:
+        vals = datums
+        if a.distinct:
+            seen = set()
+            vals = []
+            for d in datums:
+                key = (d.kind, d.val)
+                if key not in seen:
+                    seen.add(key)
+                    vals.append(d)
+        name = a.name
+        if name == "count":
+            return Datum.i(len(vals))
+        if not vals:
+            return Datum.null() if name not in ("bit_and", "bit_or", "bit_xor") else (
+                Datum.u(0xFFFFFFFFFFFFFFFF) if name == "bit_and" else Datum.u(0)
+            )
+        if name in ("sum", "avg"):
+            from ..mysqltypes.datum import K_FLOAT
+
+            if vals[0].kind == K_FLOAT or a.ret_type.is_float():
+                s = sum(d.to_float() for d in vals)
+                return Datum.f(s if name == "sum" else s / len(vals))
+            acc = vals[0].to_dec()
+            for d in vals[1:]:
+                acc = acc + d.to_dec()
+            if name == "sum":
+                return Datum.d(acc)
+            q = acc.div(Dec(len(vals), 0))
+            return Datum.d(q.rescale(max(a.ret_type.decimal, 0))) if q is not None else Datum.null()
+        if name in ("min", "max"):
+            best = vals[0]
+            for d in vals[1:]:
+                cmp = compare_datum(d, best)
+                if (name == "min" and cmp < 0) or (name == "max" and cmp > 0):
+                    best = d
+            return best
+        if name == "first_row":
+            return vals[0]
+        if name == "group_concat":
+            from ..expr.aggregation import GROUP_CONCAT_MAX_LEN
+
+            return Datum.s(a.sep.join(d.render(a.args[0].ret_type) for d in vals)[:GROUP_CONCAT_MAX_LEN])
+        if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            import math as _math
+
+            xs = [d.to_float() for d in vals]
+            m = len(xs)
+            if name.endswith("_samp") and m < 2:
+                return Datum.null()
+            mean = sum(xs) / m
+            var = sum((x - mean) ** 2 for x in xs) / (m if name.endswith("_pop") else m - 1)
+            return Datum.f(_math.sqrt(var) if name.startswith("stddev") else var)
+        if name in ("bit_and", "bit_or", "bit_xor"):
+            acc = -1 if name == "bit_and" else 0
+            for d in vals:
+                v = d.to_int()
+                acc = acc & v if name == "bit_and" else (acc | v if name == "bit_or" else acc ^ v)
+            return Datum.u(acc & 0xFFFFFFFFFFFFFFFF)
+        raise TiDBError(f"unsupported complete aggregate {name}")
+
+
 class FinalHashAggExec(Executor):
     """Merges partial-agg chunks (from cop tasks or LocalPartialAggExec)
     into final values (ref: HashAggExec final workers, aggregate.go:104)."""
@@ -981,6 +1110,7 @@ class FinalHashAggExec(Executor):
     @staticmethod
     def _merge_state(a: AggDesc, state, vals):
         name = a.name
+        vals_sep = a.sep
         if name == "count":
             v = vals[0].to_int() if not vals[0].is_null else 0
             return (state or 0) + v
@@ -1008,6 +1138,31 @@ class FinalHashAggExec(Executor):
             return v if (c < 0 if name == "min" else c > 0) else state
         if name == "first_row":
             return state if state is not None else vals[0]
+        if name == "group_concat":
+            v = vals[0]
+            if v.is_null:
+                return state
+            return v.to_str() if state is None else state + vals_sep + v.to_str()
+        if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            cnt = vals[0].to_int() if not vals[0].is_null else 0
+            s_ = vals[1].to_float() if not vals[1].is_null else 0.0
+            sq = vals[2].to_float() if not vals[2].is_null else 0.0
+            if state is None:
+                state = [0, 0.0, 0.0]
+            state[0] += cnt
+            state[1] += s_
+            state[2] += sq
+            return state
+        if name in ("bit_and", "bit_or", "bit_xor"):
+            ident = -1 if name == "bit_and" else 0
+            v = vals[0].to_int() if not vals[0].is_null else ident
+            if state is None:
+                state = ident
+            if name == "bit_and":
+                return state & v
+            if name == "bit_or":
+                return state | v
+            return state ^ v
         raise NotImplementedError(name)
 
     @staticmethod
@@ -1030,6 +1185,28 @@ class FinalHashAggExec(Executor):
             return Datum.d(q.rescale(max(ft.decimal, 0))) if q is not None else Datum.null()
         if name in ("min", "max", "first_row"):
             return state if state is not None else Datum.null()
+        if name == "group_concat":
+            from ..expr.aggregation import GROUP_CONCAT_MAX_LEN
+
+            return Datum.s(state[:GROUP_CONCAT_MAX_LEN]) if state is not None else Datum.null()
+        if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            import math as _math
+
+            if state is None or state[0] == 0:
+                return Datum.null()
+            n_, s_, sq = state
+            if name.endswith("_samp"):
+                if n_ < 2:
+                    return Datum.null()
+                var = (sq - s_ * s_ / n_) / (n_ - 1)
+            else:
+                var = sq / n_ - (s_ / n_) ** 2
+            var = max(var, 0.0)  # numeric guard
+            return Datum.f(_math.sqrt(var) if name.startswith("stddev") else var)
+        if name in ("bit_and", "bit_or", "bit_xor"):
+            ident = -1 if name == "bit_and" else 0
+            v = state if state is not None else ident
+            return Datum.u(v & 0xFFFFFFFFFFFFFFFF)
         raise NotImplementedError(name)
 
 

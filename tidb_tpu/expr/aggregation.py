@@ -28,7 +28,16 @@ MODE_COMPLETE = "complete"
 MODE_PARTIAL = "partial"
 MODE_FINAL = "final"
 
-AGG_FUNCS = ("count", "sum", "avg", "min", "max", "first_row")
+PUSHABLE_AGGS = ("count", "sum", "avg", "min", "max", "first_row")
+AGG_FUNCS = PUSHABLE_AGGS + (
+    "group_concat",
+    "stddev_pop", "stddev_samp", "std", "stddev",
+    "var_pop", "var_samp", "variance",
+    "bit_and", "bit_or", "bit_xor",
+)
+# aliases normalize at construction (ref: MySQL STD/STDDEV/VARIANCE)
+_AGG_ALIAS = {"std": "stddev_pop", "stddev": "stddev_pop", "variance": "var_pop"}
+GROUP_CONCAT_MAX_LEN = 1024  # MySQL group_concat_max_len default
 
 
 def _scale(ft: FieldType) -> int:
@@ -38,6 +47,18 @@ def _scale(ft: FieldType) -> int:
 def agg_ret_type(name: str, arg_ft: FieldType | None) -> FieldType:
     if name == "count":
         return ft_longlong()
+    if name == "group_concat":
+        from ..mysqltypes.field_type import ft_varchar
+
+        return ft_varchar(GROUP_CONCAT_MAX_LEN)
+    if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+        return ft_double()
+    if name in ("bit_and", "bit_or", "bit_xor"):
+        ft = ft_longlong()
+        from ..mysqltypes.field_type import UNSIGNED_FLAG
+
+        ft.flag |= UNSIGNED_FLAG
+        return ft
     if name == "sum":
         if arg_ft.is_float() or arg_ft.is_string():
             return ft_double()
@@ -59,17 +80,27 @@ class AggDesc:
     mode: str = MODE_COMPLETE
     ret_type: FieldType = field(default_factory=ft_longlong)
 
+    sep: str = ","  # GROUP_CONCAT separator
+
     @staticmethod
     def make(name: str, args: list[Expression], distinct: bool = False) -> "AggDesc":
-        name = name.lower()
+        name = _AGG_ALIAS.get(name.lower(), name.lower())
         if name not in AGG_FUNCS:
             raise ValueError(f"unknown aggregate {name}")
+        if len(args) > 1:
+            from ..errors import TiDBError
+
+            raise TiDBError(f"aggregate {name.upper()} supports a single argument here")
         arg_ft = args[0].ret_type if args else None
         return AggDesc(name, args, distinct, MODE_COMPLETE, agg_ret_type(name, arg_ft))
 
     def pushable(self) -> bool:
         """May this aggregate run as a cop/TPU partial? (ref: agg_to_pb.go)"""
-        return not self.distinct and all(a.pushable() for a in self.args)
+        return (
+            not self.distinct
+            and self.name in PUSHABLE_AGGS
+            and all(a.pushable() for a in self.args)
+        )
 
     def partial_final_types(self) -> list[tuple[str, FieldType]]:
         """The partial-state columns this agg ships back from the cop side."""
@@ -80,11 +111,16 @@ class AggDesc:
         if self.name == "avg":
             arg = self.args[0].ret_type
             return [("sum", agg_ret_type("sum", arg)), ("count", ft_longlong())]
+        if self.name == "group_concat":
+            return [("concat", self.ret_type)]
+        if self.name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            return [("count", ft_longlong()), ("sum", ft_double()), ("sumsq", ft_double())]
         return [(self.name, self.ret_type)]
 
     def __repr__(self):
         d = "distinct " if self.distinct else ""
-        return f"{self.name}({d}{', '.join(map(repr, self.args))})"
+        s = f" sep={self.sep!r}" if self.name == "group_concat" and self.sep != "," else ""
+        return f"{self.name}({d}{', '.join(map(repr, self.args))}{s})"
 
 
 # window-only functions (ref: executor/aggfuncs window builders; the agg

@@ -687,12 +687,18 @@ class Parser:
             self.next()
             self.expect_op(")")
             return self._maybe_over(ast.Call("count", [ast.Star()], distinct=False))
+        sep = None
         if not self.at_op(")"):
             args.append(self.expr())
             while self.try_op(","):
                 args.append(self.expr())
+            if fname == "group_concat" and self.try_kw("SEPARATOR"):
+                sep = self.next().text
         self.expect_op(")")
-        return self._maybe_over(ast.Call(fname, args, distinct=distinct))
+        call = ast.Call(fname, args, distinct=distinct)
+        if sep is not None:
+            call.sep = sep
+        return self._maybe_over(call)
 
     def _maybe_over(self, call: ast.Call) -> ast.Call:
         """OVER ([PARTITION BY ...] [ORDER BY ...] [frame]) — only the

@@ -374,7 +374,7 @@ class PlanBuilder:
                 if agg_ctx is None or not allow_window:
                     raise TiDBError(f"window function {lname} is not allowed here")
                 return self._window_expr(node, scope, agg_ctx)
-            if lname in AGG_FUNCS or lname in ("group_concat",):
+            if lname in AGG_FUNCS:
                 if agg_ctx is None:
                     raise TiDBError(f"aggregate {lname} not allowed here")
                 return agg_ctx.add_agg(node, scope)
@@ -1022,6 +1022,8 @@ class AggContext:
                 break
             args.append(self.builder.to_expr(a, scope))
         desc = AggDesc.make(name, args, distinct=node.distinct)
+        if getattr(node, "sep", None) is not None:
+            desc.sep = node.sep
         # dedup identical aggregates
         for i, existing in enumerate(self.aggs):
             if repr(existing) == repr(desc):
