@@ -114,3 +114,21 @@ class TestParallelDispatch:
         total = s.must_query("SELECT SUM(v) FROM t")
         assert total == [(str(sum(range(400))),)]
         assert any(n.startswith("cop") for n in seen), f"tasks ran on {seen}"
+
+
+class TestSplitStatement:
+    def test_split_between_regions(self, s):
+        s.execute("CREATE TABLE st (id BIGINT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO st VALUES " + ",".join(f"({i}, {i})" for i in range(1000)))
+        before = len(s.store.regions.regions)
+        rows = s.must_query("SPLIT TABLE st BETWEEN (0) AND (1000) REGIONS 4")
+        assert int(rows[0][0]) == 3
+        assert len(s.store.regions.regions) == before + 3
+        assert s.must_query("SELECT COUNT(*), SUM(v) FROM st") == [("1000", "499500")]
+
+    def test_split_by_values(self, s):
+        s.execute("CREATE TABLE sb (id BIGINT PRIMARY KEY)")
+        s.execute("INSERT INTO sb VALUES " + ",".join(f"({i})" for i in range(100)))
+        rows = s.must_query("SPLIT TABLE sb BY (25), (50), (75)")
+        assert int(rows[0][0]) == 3
+        assert s.must_query("SELECT COUNT(*) FROM sb WHERE id >= 20 AND id < 80") == [("60",)]

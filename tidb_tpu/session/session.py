@@ -502,6 +502,8 @@ class Session:
             return self._run_analyze(stmt)
         if isinstance(stmt, ast.FlushStmt):
             return ResultSet([], None)
+        if isinstance(stmt, ast.SplitRegion):
+            return self._run_split_region(stmt)
         if isinstance(stmt, ast.KillStmt):
             return self._run_kill(stmt)
         if isinstance(stmt, ast.AdminStmt) and stmt.kind == "show_ddl_jobs":
@@ -663,6 +665,27 @@ class Session:
         self.bindings.bump_version()
         self._plan_cache.clear()
         return ResultSet([], None)
+
+    def _run_split_region(self, stmt: ast.SplitRegion) -> ResultSet:
+        """SPLIT TABLE t BETWEEN (lo) AND (hi) REGIONS n | BY (v),(v)...
+        (ref: executor/split.go SplitTableRegionExec — here splits land in
+        the region map directly; the scatter step is a no-op in-process)."""
+        info = self.infoschema().table(stmt.table.db or self.current_db, stmt.table.name)
+        keys: list[bytes] = []
+        if stmt.between is not None:
+            lo_e, hi_e, n = stmt.between
+            lo = self._eval_const_expr(lo_e[0]).value.to_int()
+            hi = self._eval_const_expr(hi_e[0]).value.to_int()
+            if n <= 0 or hi <= lo:
+                raise TiDBError("Split table region lower value should be less than the upper value")
+            step = max((hi - lo) // n, 1)
+            keys = [tablecodec.record_key(info.id, lo + i * step) for i in range(1, n)]
+        else:
+            for vals in stmt.by:
+                h = self._eval_const_expr(vals[0]).value.to_int()
+                keys.append(tablecodec.record_key(info.id, h))
+        created = self.store.regions.split_many(keys)
+        return ResultSet.message_row(["TOTAL_SPLIT_REGION", "SCATTER_FINISH_RATIO"], [str(created), "1.0"])
 
     def _run_kill(self, stmt: ast.KillStmt) -> ResultSet:
         """KILL [QUERY] <id> (ref: server.go:609 Kill + sessVars.Killed):
