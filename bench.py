@@ -30,7 +30,7 @@ def main():
 
     rows = int(os.environ.get("BENCH_ROWS", "2000000"))
     which = os.environ.get("BENCH_QUERY", "q1")
-    reps = int(os.environ.get("BENCH_REPS", "5"))
+    reps = int(os.environ.get("BENCH_REPS", "11"))
 
     from tidb_tpu.session import Session
     from tidb_tpu.models import tpch
@@ -52,9 +52,10 @@ def main():
             times.append(time.time() - t)
         return result, min(times), statistics.median(times)
 
-    # warm both paths (compile + tile/device cache build)
+    # warm both paths (compile + tile/device cache build); two tpu warmups
+    # absorb tunnel-side first-touch latency
     host_res, _, _ = run("host", 1)
-    tpu_res, _, _ = run("tpu", 1)
+    tpu_res, _, _ = run("tpu", 2)
     if s.cop.tpu.fallbacks:
         print(f"WARNING: tpu engine fell back {s.cop.tpu.fallbacks}x", file=sys.stderr)
     assert host_res.rows() == tpu_res.rows(), "engine results diverge"

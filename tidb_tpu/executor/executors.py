@@ -509,6 +509,80 @@ class WindowExec(Executor):
     def _lane(e, c, n):
         return _broadcast_lane(*e.eval(c), n)
 
+    _AGG_FUNCS = ("count", "sum", "avg", "min", "max")
+
+    def _whole_partition_fast_path(self, c: Chunk, n: int):
+        """SUM()/COUNT()/... OVER (PARTITION BY k) with no ORDER BY — the
+        pipelined-window shape (ref: executor/pipelined_window.go:37,
+        BASELINE stretch config). Factorizes partition keys (np.unique)
+        and segment-reduces, skipping the O(n log n) lexicographic sort
+        and the inverse permutation entirely."""
+        if self.order_by or not self.part_by:
+            return None
+        if any(f.name not in self._AGG_FUNCS for f in self.funcs):
+            return None
+        part_lanes = [self._lane(e, c, n) for e in self.part_by]
+        arg_lanes = []
+        for f in self.funcs:
+            if f.args:
+                d, v = self._lane(f.args[0], c, n)
+                if d.dtype == object and f.name in ("sum", "avg", "min", "max"):
+                    return None  # string aggregates keep the generic path
+                arg_lanes.append((d, v))
+            else:
+                arg_lanes.append((np.ones(n, dtype=np.int64), np.ones(n, dtype=bool)))
+        from ..copr.host_engine import _group_codes_masked
+
+        inv_sel, _, G = _group_codes_masked(part_lanes, np.ones(n, dtype=bool))
+        pid = inv_sel  # mask is all-true: selected order == row order
+        cols = list(c.columns)
+        for (f, (d, v)), i in zip(zip(self.funcs, arg_lanes), range(len(self.funcs))):
+            ft = self.out_fts[len(c.columns) + i]
+            cnt = np.bincount(pid, weights=v.astype(np.float64), minlength=G)
+            if f.name == "count":
+                data, valid = cnt[pid].astype(np.int64), np.ones(n, dtype=bool)
+            elif f.name in ("sum", "avg"):
+                if d.dtype == np.float64:
+                    s = np.bincount(pid, weights=np.where(v, d, 0.0), minlength=G)
+                else:
+                    s = np.zeros(G, dtype=np.int64)
+                    np.add.at(s, pid, np.where(v, d.astype(np.int64), 0))
+                if f.name == "sum":
+                    data = s[pid] if ft.is_float() else s[pid].astype(np.int64)
+                    valid = cnt[pid] > 0
+                else:
+                    data, valid = self._avg_from_sums(f, ft, s, cnt, pid)
+            else:  # min / max
+                init = (np.inf if f.name == "min" else -np.inf) if d.dtype == np.float64 else (
+                    np.iinfo(np.int64).max if f.name == "min" else np.iinfo(np.int64).min
+                )
+                acc = np.full(G, init, dtype=d.dtype if d.dtype == np.float64 else np.int64)
+                fn = np.minimum if f.name == "min" else np.maximum
+                fn.at(acc, pid, np.where(v, d, init))
+                data, valid = acc[pid], cnt[pid] > 0
+            cols.append(Column(ft, data, valid))
+        return Chunk(cols)
+
+    def _avg_from_sums(self, f, ft, s, cnt, pid):
+        n = len(pid)
+        if ft.is_float():
+            with np.errstate(divide="ignore", invalid="ignore"):
+                g = np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0)
+            return g[pid], cnt[pid] > 0
+        arg_scale = max(f.args[0].ret_type.decimal, 0) if f.args[0].ret_type.is_decimal() else 0
+        out_scale = max(ft.decimal, 0)
+        G = len(s)
+        qs = np.zeros(G, dtype=np.int64)
+        qv = np.zeros(G, dtype=bool)
+        for g in range(G):
+            c_ = int(cnt[g])
+            if c_ > 0:
+                q = Dec(int(s[g]), arg_scale).div(Dec(c_, 0))
+                if q is not None:
+                    qs[g] = q.rescale(out_scale).value
+                    qv[g] = True
+        return qs[pid], qv[pid]
+
     def next(self):
         if self._done:
             return None
@@ -517,6 +591,9 @@ class WindowExec(Executor):
         n = c.num_rows
         if n == 0:
             return Chunk.empty(self.out_fts, 0)
+        fast = self._whole_partition_fast_path(c, n)
+        if fast is not None:
+            return fast
         from ..copr.host_engine import _lex_argsort
 
         part_lanes = [self._lane(e, c, n) for e in self.part_by]

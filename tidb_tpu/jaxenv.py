@@ -7,9 +7,26 @@ here keeps `import tidb_tpu` (and the pure-host modules: mysqltypes, codec,
 chunk, parser, planner) jax-free.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: cop/MPP programs are keyed by DAG
+# digest in-process, but across processes (server restart, bench runs,
+# the driver) recompiling identical programs costs seconds each on the
+# TPU. The on-disk cache makes warmup a read (ref: the jit-cache story
+# of copr/coprocessor_cache.go, taken one level down the stack).
+_cache_dir = os.environ.get(
+    "TIDB_TPU_XLA_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "tidb_tpu_xla")
+)
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+    pass
 
 from jax import numpy as jnp  # noqa: E402  (re-export for device modules)
 

@@ -301,8 +301,11 @@ class Storage:
         self.regions = RegionMap()
         # auto-split: regions split when a bulk ingest lands more than
         # this many keys (PD's size-based split policy analog; ref:
-        # unistore cluster.go region management + executor/split.go)
-        self.region_split_size = 1 << 19
+        # unistore cluster.go region management + executor/split.go).
+        # Sized like the reference's 96MB regions (~2M short rows): on a
+        # single chip each cop task pays a device launch + fetch round
+        # trip, so undersized regions tax warm queries for no parallelism
+        self.region_split_size = 1 << 21
         self.mvcc.split_hook = self._auto_split_run
         # pessimistic-lock wait-for graph (ref: unistore tikv/detector.go)
         from .detector import DeadlockDetector
