@@ -536,7 +536,7 @@ class WindowExec(Executor):
         inv_sel, _, G = _group_codes_masked(part_lanes, np.ones(n, dtype=bool))
         pid = inv_sel  # mask is all-true: selected order == row order
         cols = list(c.columns)
-        for (f, (d, v)), i in zip(zip(self.funcs, arg_lanes), range(len(self.funcs))):
+        for i, (f, (d, v)) in enumerate(zip(self.funcs, arg_lanes)):
             ft = self.out_fts[len(c.columns) + i]
             cnt = np.bincount(pid, weights=v.astype(np.float64), minlength=G)
             if f.name == "count":

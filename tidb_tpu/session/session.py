@@ -506,8 +506,13 @@ class Session:
             return self._run_split_region(stmt)
         if isinstance(stmt, ast.KillStmt):
             return self._run_kill(stmt)
-        if isinstance(stmt, ast.AdminStmt) and stmt.kind == "show_ddl_jobs":
-            return self._admin_show_ddl_jobs()
+        if isinstance(stmt, ast.AdminStmt):
+            if stmt.kind == "show_ddl_jobs":
+                return self._admin_show_ddl_jobs()
+            if stmt.kind == "check_table":
+                return self._admin_check_table(stmt.target)
+            if stmt.kind == "checksum_table":
+                return self._admin_checksum_table(stmt.target)
         if isinstance(stmt, ast.CreateBinding):
             return self._run_create_binding(stmt)
         if isinstance(stmt, ast.DropBinding):
@@ -698,6 +703,62 @@ class Session:
         if target is not None:
             target._killed = True
         return ResultSet([], None)
+
+    def _admin_check_table(self, tn) -> ResultSet:
+        """ADMIN CHECK TABLE: verify row↔index consistency for every
+        public index (ref: executor/admin.go CheckTableExec + executor.go
+        CheckTableExec). Raises on any dangling or missing entry."""
+        info = self.infoschema().table(tn.db or self.current_db, tn.name)
+        tbl = Table(info)
+        snap = self.store.snapshot()
+        prefix = tablecodec.record_prefix(info.id)
+        decoded = [
+            (tablecodec.decode_record_handle(k), tbl.decode_record(v))
+            for k, v in snap.scan(prefix, prefix + b"\xff")
+        ]
+        for idx in info.indexes:
+            if idx.state != "public" or (info.pk_is_handle and idx.primary):
+                continue
+            expected = {}
+            for handle, datums in decoded:
+                key, val, _ = tbl.index_value_key(idx, tbl.row_datums_with_hidden(datums, handle), handle)
+                expected[key] = val
+            ipfx = tablecodec.index_prefix(info.id, idx.id)
+            actual = dict(snap.scan(ipfx, ipfx + b"\xff"))
+            missing = set(expected) - set(actual)
+            dangling = set(actual) - set(expected)
+            # values must match too: a unique entry pointing at the wrong
+            # handle has the right KEY but the wrong stored value
+            corrupt = sum(1 for k in expected if k in actual and actual[k] != expected[k])
+            if missing or dangling or corrupt:
+                raise TiDBError(
+                    f"admin check table {info.name!r} index {idx.name!r} inconsistent: "
+                    f"{len(missing)} missing, {len(dangling)} dangling, "
+                    f"{corrupt} mismatched entries"
+                )
+        return ResultSet([], None)
+
+    def _admin_checksum_table(self, tn) -> ResultSet:
+        """ADMIN CHECKSUM TABLE (ref: executor/checksum.go — a 64-bit
+        XOR-of-per-kv-digests over the table's kv pairs at a consistent
+        snapshot; order-independent like the reference's crc64 xor)."""
+        import hashlib
+
+        info = self.infoschema().table(tn.db or self.current_db, tn.name)
+        snap = self.store.snapshot()
+        pfx = tablecodec.table_prefix(info.id)
+        crc = 0
+        total_kvs = 0
+        total_bytes = 0
+        for k, v in snap.scan(pfx, tablecodec.table_prefix(info.id + 1)):
+            h = hashlib.blake2b(k + b"\x00" + v, digest_size=8).digest()
+            crc ^= int.from_bytes(h, "big")
+            total_kvs += 1
+            total_bytes += len(k) + len(v)
+        return ResultSet.message_row(
+            ["Db_name", "Table_name", "Checksum_crc64_xor", "Total_kvs", "Total_bytes"],
+            [info.db_name, info.name, str(crc), str(total_kvs), str(total_bytes)],
+        )
 
     def _admin_show_ddl_jobs(self) -> ResultSet:
         """ADMIN SHOW DDL JOBS (ref: executor ShowDDLJobsExec)."""
@@ -1062,13 +1123,18 @@ class Session:
         if txn.pessimistic and all_datums:
             self._lock_insert_keys(tbl, txn, all_datums)
         affected = 0
+        delta = 0  # net row-count change (upserts affect 2 but add 0)
+        on_dup_cache: dict = {}  # per-statement compiled ON DUP assignments
         for datums in all_datums:
-            affected += self._insert_row(tbl, txn, datums, stmt)
+            a, d = self._insert_row(tbl, txn, datums, stmt, on_dup_cache)
+            affected += a
+            delta += d
         self.cop.tiles.invalidate_table(info.id)
-        self._note_delta(info.id, affected, affected)
+        self._note_delta(info.id, affected, delta)
         return ResultSet([], None, affected=affected, last_insert_id=self.last_insert_id)
 
-    def _insert_row(self, tbl: Table, txn, datums: list[Datum], stmt) -> int:
+    def _insert_row(self, tbl: Table, txn, datums: list[Datum], stmt, on_dup_cache: dict) -> tuple[int, int]:
+        """Insert one row; returns (affected_rows, net_row_delta)."""
         info = tbl.info
         # handle: clustered int pk or auto rowid
         handle = None
@@ -1087,20 +1153,24 @@ class Session:
                 raise TiDBError(f"Column '{c.name}' cannot be null")
         conflicts = self._conflicting_handles(tbl, txn, datums, handle)
         if conflicts:
+            if getattr(stmt, "on_dup", None):
+                return self._on_dup_update(tbl, txn, stmt, datums, conflicts[0], handle, on_dup_cache)
             if getattr(stmt, "replace", False):
                 # REPLACE deletes EVERY row that conflicts on pk or any
                 # unique index, then inserts (MySQL semantics)
+                removed = 0
                 for h in conflicts:
                     old = self._row_by_handle(tbl, txn, h)
                     if old is not None:
                         tbl.remove_record(txn, h, old)
+                        removed += 1
                 tbl.add_record(txn, datums, handle, check_dup=False)
-                return 1 + len(conflicts)
+                return 1 + len(conflicts), 1 - removed
             if getattr(stmt, "ignore", False):
-                return 0
+                return 0, 0
             raise DuplicateEntry(f"Duplicate entry in '{info.name}'")
         tbl.add_record(txn, datums, handle)
-        return 1
+        return 1, 1
 
     def _lock_insert_keys(self, tbl: Table, txn, rows: list[list[Datum]]) -> None:
         """Pessimistic INSERT locks, batched per statement: explicit-pk
@@ -1132,6 +1202,92 @@ class Session:
         if txn.pessimistic:
             return self.store.snapshot(txn.for_update_ts).get(key)
         return txn.snapshot.get(key)
+
+    def _on_dup_update(
+        self, tbl: Table, txn, stmt, new_datums, handle: int, new_handle: int, cache: dict
+    ) -> tuple[int, int]:
+        """INSERT ... ON DUPLICATE KEY UPDATE (ref: executor/insert.go
+        onDuplicateUpdate): assignments evaluate over the EXISTING row,
+        with VALUES(col) resolving to the would-be inserted value.
+        Affected rows: 2 if changed, 0 if set to current values.
+
+        Assignment expressions compile ONCE per statement (`cache`):
+        VALUES(col) rewrites to a pseudo-column appended after the table's
+        columns, so the same compiled expr evaluates every duplicate row;
+        user '?' placeholders resolve normally from _exec_params."""
+        from ..planner.plans import PlanCol
+
+        info = tbl.info
+        old = self._row_by_handle(tbl, txn, handle)
+        if old is None and txn.pessimistic:
+            # the conflict was found by a current read; fetch the row there
+            raw = self._read_for_write(txn, tbl.record_key(handle))
+            if raw is not None:
+                old = tbl.decode_record(raw)
+        if old is None:
+            # conflicting row vanished underneath us: plain insert, under
+            # the NEW row's own handle (the stale conflicting handle may
+            # come from a dangling unique entry and must not be reused);
+            # check_dup=False lets the write reclaim that dangling entry
+            tbl.add_record(txn, new_datums, new_handle, check_dup=False)
+            return 1, 1
+        visible = info.visible_columns()
+        if "exprs" not in cache:
+            vpfx = "__values__"
+            scope = NameScope(
+                [PlanCol(c.name, c.ft, info.name) for c in visible]
+                + [PlanCol(vpfx + c.name, c.ft, info.name) for c in visible]
+            )
+
+            def subst(node):
+                if isinstance(node, ast.Call):
+                    if (
+                        node.name.lower() == "values"
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                    ):
+                        col = info.col_by_name(node.args[0].column)
+                        return ast.Name((vpfx + col.name,))
+                    return ast.Call(node.name, [subst(a) for a in node.args], node.distinct)
+                if isinstance(node, ast.CaseWhen):
+                    return ast.CaseWhen(
+                        subst(node.operand) if node.operand is not None else None,
+                        [(subst(c), subst(r)) for c, r in node.whens],
+                        subst(node.else_) if node.else_ is not None else None,
+                    )
+                if isinstance(node, ast.Cast):
+                    import copy as _copy
+
+                    n2 = _copy.copy(node)
+                    n2.expr = subst(node.expr)
+                    return n2
+                if isinstance(node, ast.Interval):
+                    return ast.Interval(subst(node.expr), node.unit)
+                return node
+
+            cache["exprs"] = [
+                (info.col_by_name(cname), self._builder().to_expr(subst(e_ast), scope))
+                for cname, e_ast in stmt.on_dup
+            ]
+        fts = [c.ft for c in visible] * 2
+        updated = list(old)
+        changed = False
+        for col, e in cache["exprs"]:
+            # MySQL evaluates assignments left-to-right: later ones see
+            # earlier updated values
+            row = [updated[c.offset] for c in visible] + [new_datums[c.offset] for c in visible]
+            chunk = Chunk.from_datum_rows(fts, [row])
+            d, v = e.eval(chunk)
+            d = np.atleast_1d(np.asarray(d))
+            v = np.atleast_1d(np.asarray(v))
+            nv = self._cast_datum(Column(e.ret_type, d[:1], v[:1]).get_datum(0), col.ft) if v[0] else Datum.null()
+            if repr(nv) != repr(updated[col.offset]):
+                changed = True
+            updated[col.offset] = nv
+        if changed:
+            tbl.update_record(txn, handle, old, updated)
+            return 2, 0
+        return 0, 0
 
     def _conflicting_handles(self, tbl: Table, txn, datums, handle: int) -> list[int]:
         """Handles of existing rows this insert collides with (pk + every
