@@ -1,0 +1,297 @@
+"""Partitioned tables: HASH/RANGE creation, row routing, pruning, DML
+moves, admin ops, backup/restore (ref: table/tables/partition.go,
+planner/core/rule_partition_processor.go behaviors)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("SET tidb_engine = 'host'")
+    return sess
+
+
+class TestCreate:
+    def test_hash_metadata(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 4")
+        info = s.infoschema().table("test", "h")
+        assert info.partition.type == "hash"
+        assert len(info.partition.defs) == 4
+        assert len(set(info.physical_ids())) == 4
+        assert all(pid != info.id for pid in info.physical_ids())
+
+    def test_range_metadata(self, s):
+        s.execute(
+            "CREATE TABLE r (id INT PRIMARY KEY) PARTITION BY RANGE (id) ("
+            "PARTITION p0 VALUES LESS THAN (10),"
+            "PARTITION p1 VALUES LESS THAN (100),"
+            "PARTITION pm VALUES LESS THAN MAXVALUE)"
+        )
+        part = s.infoschema().table("test", "r").partition
+        assert [d.name for d in part.defs] == ["p0", "p1", "pm"]
+        assert [d.less_than for d in part.defs] == [10, 100, None]
+
+    def test_unique_must_include_partition_col(self, s):
+        with pytest.raises(TiDBError, match="partitioning function"):
+            s.execute(
+                "CREATE TABLE bad (id INT PRIMARY KEY, k INT, UNIQUE KEY uk (k)) "
+                "PARTITION BY HASH(id) PARTITIONS 2"
+            )
+
+    def test_range_bounds_must_ascend(self, s):
+        with pytest.raises(TiDBError, match="increasing"):
+            s.execute(
+                "CREATE TABLE bad (id INT PRIMARY KEY) PARTITION BY RANGE (id) ("
+                "PARTITION p0 VALUES LESS THAN (10), PARTITION p1 VALUES LESS THAN (5))"
+            )
+
+    def test_non_int_partition_col_rejected(self, s):
+        with pytest.raises(TiDBError, match="integer"):
+            s.execute(
+                "CREATE TABLE bad (id INT PRIMARY KEY, n VARCHAR(8)) "
+                "PARTITION BY HASH(n) PARTITIONS 2"
+            )
+
+
+class TestRoutingAndRead:
+    def test_rows_route_and_union_read(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 4")
+        s.execute("INSERT INTO h VALUES " + ",".join(f"({i},{i*10})" for i in range(20)))
+        info = s.infoschema().table("test", "h")
+        # rows land in their hash partition's keyspace
+        from tidb_tpu.codec import tablecodec
+
+        snap = s.store.snapshot()
+        per_part = []
+        for pid in info.physical_ids():
+            pfx = tablecodec.record_prefix(pid)
+            per_part.append(len(snap.scan(pfx, pfx + b"\xff")))
+        assert sum(per_part) == 20 and all(n == 5 for n in per_part)
+        # logical keyspace holds nothing
+        pfx = tablecodec.record_prefix(info.id)
+        assert snap.scan(pfx, pfx + b"\xff") == []
+        # reads union every partition
+        assert s.must_query("SELECT COUNT(*), SUM(v) FROM h") == [("20", str(sum(i * 10 for i in range(20))))]
+        assert sorted(int(r[0]) for r in s.must_query("SELECT id FROM h")) == list(range(20))
+
+    def test_range_routing_and_overflow(self, s):
+        s.execute(
+            "CREATE TABLE r (id INT PRIMARY KEY) PARTITION BY RANGE (id) ("
+            "PARTITION p0 VALUES LESS THAN (10), PARTITION p1 VALUES LESS THAN (100))"
+        )
+        s.execute("INSERT INTO r VALUES (5), (50), (99)")
+        with pytest.raises(TiDBError, match="no partition"):
+            s.execute("INSERT INTO r VALUES (100)")
+        assert s.must_query("SELECT COUNT(*) FROM r") == [("3",)]
+
+    def test_agg_pushdown_over_partitions_tpu_shape(self, s):
+        # group-by whose groups straddle partitions: partial merge must be
+        # exact across partition reads
+        s.execute(
+            "CREATE TABLE g (id INT PRIMARY KEY, grp INT, v INT) PARTITION BY HASH(id) PARTITIONS 3"
+        )
+        rows = [(i, i % 4, i) for i in range(60)]
+        s.execute("INSERT INTO g VALUES " + ",".join(map(str, rows)))
+        got = {r[0]: (r[1], r[2]) for r in s.must_query("SELECT grp, COUNT(*), SUM(v) FROM g GROUP BY grp")}
+        for grp in range(4):
+            vs = [v for _, gg, v in rows if gg == grp]
+            assert got[str(grp)] == (str(len(vs)), str(sum(vs)))
+
+
+class TestPruning:
+    def _parts_read(self, s, sql):
+        from tidb_tpu.parser import parse_one
+        from tidb_tpu.planner.optimizer import optimize
+
+        plan = optimize(s._builder().build_select(parse_one(sql)), stats=s.store.stats.cache)
+        found = []
+
+        def walk(p):
+            from tidb_tpu.planner.plans import DataSource
+
+            if isinstance(p, DataSource) and getattr(p, "pruned_parts", None) is not None:
+                found.append(p.pruned_parts)
+            for c in p.children:
+                walk(c)
+
+        walk(plan)
+        return found[0] if found else None
+
+    def test_range_eq_prunes_to_one(self, s):
+        s.execute(
+            "CREATE TABLE r (id INT PRIMARY KEY, v INT) PARTITION BY RANGE (id) ("
+            "PARTITION p0 VALUES LESS THAN (10), PARTITION p1 VALUES LESS THAN (100),"
+            "PARTITION pm VALUES LESS THAN MAXVALUE)"
+        )
+        s.execute("INSERT INTO r VALUES (5, 1), (50, 2), (500, 3)")
+        info = s.infoschema().table("test", "r")
+        assert [p.name for p in info.partition.prune(eq_values=[50])] == ["p1"]
+        assert [p.name for p in info.partition.prune(lo=20, hi=99)] == ["p1"]
+        assert [p.name for p in info.partition.prune(lo=20, hi=None)] == ["p1", "pm"]
+        assert [p.name for p in info.partition.prune(lo=None, hi=5)] == ["p0"]
+        # behavioral: the pruned query still answers correctly
+        assert s.must_query("SELECT v FROM r WHERE id = 50") == [("2",)]
+        assert s.must_query("SELECT COUNT(*) FROM r WHERE id >= 20 AND id < 100") == [("1",)]
+
+    def test_hash_eq_prunes(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 4")
+        info = s.infoschema().table("test", "h")
+        assert [p.id for p in info.partition.prune(eq_values=[7])] == [info.partition.defs[3].id]
+        # IN list across two partitions
+        assert len(info.partition.prune(eq_values=[1, 5])) == 1  # both % 4 == 1
+        assert len(info.partition.prune(eq_values=[1, 6])) == 2
+
+    def test_planner_sets_pruned_parts(self, s):
+        s.execute(
+            "CREATE TABLE pr (id INT PRIMARY KEY, v INT) PARTITION BY RANGE (id) ("
+            "PARTITION p0 VALUES LESS THAN (10), PARTITION p1 VALUES LESS THAN (100))"
+        )
+        s.execute("INSERT INTO pr VALUES (1,1),(50,2)")
+        parts = self._parts_read(s, "SELECT v FROM pr WHERE id = 50")
+        assert parts is not None and [p.name for p in parts] == ["p1"]
+        assert s.must_query("SELECT v FROM pr WHERE id = 50") == [("2",)]
+
+
+class TestDML:
+    def test_update_moves_row_across_partitions(self, s):
+        s.execute(
+            "CREATE TABLE r (id INT PRIMARY KEY, v INT) PARTITION BY RANGE (id) ("
+            "PARTITION p0 VALUES LESS THAN (10), PARTITION p1 VALUES LESS THAN (100))"
+        )
+        s.execute("INSERT INTO r VALUES (5, 1)")
+        s.execute("UPDATE r SET id = 50 WHERE id = 5")
+        info = s.infoschema().table("test", "r")
+        from tidb_tpu.codec import tablecodec
+
+        snap = s.store.snapshot()
+        p0, p1 = info.partition.defs
+        pfx0 = tablecodec.record_prefix(p0.id)
+        pfx1 = tablecodec.record_prefix(p1.id)
+        assert snap.scan(pfx0, pfx0 + b"\xff") == []
+        assert len(snap.scan(pfx1, pfx1 + b"\xff")) == 1
+        assert s.must_query("SELECT id, v FROM r") == [("50", "1")]
+
+    def test_pk_change_rekeys_record(self, s):
+        # applies to partitioned AND plain tables: the record key must
+        # follow the clustered pk
+        for ddl, name in [
+            ("CREATE TABLE pk1 (a INT PRIMARY KEY, b INT)", "pk1"),
+            ("CREATE TABLE pk2 (a INT PRIMARY KEY, b INT) PARTITION BY HASH(a) PARTITIONS 4", "pk2"),
+        ]:
+            s.execute(ddl)
+            s.execute(f"INSERT INTO {name} VALUES (1, 10)")
+            s.execute(f"UPDATE {name} SET a = 11 WHERE a = 1")
+            assert s.must_query(f"SELECT b FROM {name} WHERE a = 11") == [("10",)]
+            from tidb_tpu.errors import DuplicateEntry
+
+            with pytest.raises(DuplicateEntry):
+                s.execute(f"INSERT INTO {name} VALUES (11, 99)")
+            s.execute(f"ADMIN CHECK TABLE {name}")
+
+    def test_update_delete_within_partition(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 2")
+        s.execute("INSERT INTO h VALUES (1, 10), (2, 20), (3, 30)")
+        s.execute("UPDATE h SET v = v + 1 WHERE v > 15")
+        assert sorted(s.must_query("SELECT v FROM h")) == [("10",), ("21",), ("31",)]
+        s.execute("DELETE FROM h WHERE id = 2")
+        assert s.must_query("SELECT COUNT(*) FROM h") == [("2",)]
+
+    def test_on_dup_and_replace(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 3")
+        s.execute("INSERT INTO h VALUES (1, 10)")
+        s.execute("INSERT INTO h VALUES (1, 5) ON DUPLICATE KEY UPDATE v = v + VALUES(v)")
+        assert s.must_query("SELECT v FROM h WHERE id = 1") == [("15",)]
+        s.execute("REPLACE INTO h VALUES (1, 99)")
+        assert s.must_query("SELECT v FROM h WHERE id = 1") == [("99",)]
+
+    def test_pessimistic_dml(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 2")
+        s.execute("INSERT INTO h VALUES (1, 10), (2, 20)")
+        s.execute("BEGIN PESSIMISTIC")
+        s.execute("UPDATE h SET v = v * 2 WHERE id = 2")
+        s.execute("COMMIT")
+        assert s.must_query("SELECT v FROM h WHERE id = 2") == [("40",)]
+
+
+class TestAdminAndLifecycle:
+    def test_admin_check_and_checksum(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT, KEY iv (id, v)) PARTITION BY HASH(id) PARTITIONS 2")
+        s.execute("INSERT INTO h VALUES (1, 10), (2, 20)")
+        s.execute("ADMIN CHECK TABLE h")
+        r1 = s.must_query("ADMIN CHECKSUM TABLE h")
+        assert int(r1[0][3]) >= 4  # record + index kvs across partitions
+        s.execute("UPDATE h SET v = 11 WHERE id = 1")
+        assert s.must_query("ADMIN CHECKSUM TABLE h")[0][2] != r1[0][2]
+
+    def test_analyze_counts_all_partitions(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 4")
+        s.execute("INSERT INTO h VALUES " + ",".join(f"({i},{i})" for i in range(40)))
+        s.execute("ANALYZE TABLE h")
+        ts = s.store.stats.cache[s.infoschema().table("test", "h").id]
+        assert ts.row_count == 40
+
+    def test_truncate_and_drop(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 2")
+        s.execute("INSERT INTO h VALUES (1, 1), (2, 2)")
+        s.execute("TRUNCATE TABLE h")
+        assert s.must_query("SELECT COUNT(*) FROM h") == [("0",)]
+        s.execute("INSERT INTO h VALUES (3, 3)")
+        s.execute("DROP TABLE h")
+        from tidb_tpu.errors import UnknownTable
+
+        with pytest.raises(UnknownTable):
+            s.execute("SELECT * FROM h")
+
+    def test_add_index_rejected(self, s):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 2")
+        with pytest.raises(TiDBError, match="partitioned"):
+            s.execute("CREATE INDEX iv ON h (v)")
+        with pytest.raises(TiDBError, match="partitioned"):
+            s.execute("ALTER TABLE h ADD INDEX iv (v)")
+
+    def test_drop_partition_column_rejected(self, s):
+        s.execute("CREATE TABLE h (id INT, v INT) PARTITION BY HASH(id) PARTITIONS 2")
+        with pytest.raises(TiDBError, match="partitioning column"):
+            s.execute("ALTER TABLE h DROP COLUMN id")
+
+    def test_drop_database_destroys_partition_keyspaces(self, s):
+        s.execute("CREATE DATABASE pdb")
+        s.execute("CREATE TABLE pdb.h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 2")
+        s.execute("INSERT INTO pdb.h VALUES (1, 1), (2, 2)")
+        pids = s.infoschema().table("pdb", "h").physical_ids()
+        s.execute("DROP DATABASE pdb")
+        from tidb_tpu.codec import tablecodec
+
+        snap = s.store.snapshot()
+        for pid in pids:
+            pfx = tablecodec.table_prefix(pid)
+            assert snap.scan(pfx, tablecodec.table_prefix(pid + 1)) == []
+
+    def test_show_create_round_trips_partition(self, s):
+        s.execute(
+            "CREATE TABLE r (id INT PRIMARY KEY) PARTITION BY RANGE (id) ("
+            "PARTITION p0 VALUES LESS THAN (10), PARTITION pm VALUES LESS THAN MAXVALUE)"
+        )
+        ddl = s.must_query("SHOW CREATE TABLE r")[0][1]
+        assert "PARTITION BY RANGE" in ddl and "MAXVALUE" in ddl
+        s.execute("DROP TABLE r")
+        s.execute(ddl)  # round-trip re-creates a partitioned table
+        assert s.infoschema().table("test", "r").partition is not None
+
+    def test_backup_restore_partitioned(self, s, tmp_path):
+        s.execute("CREATE TABLE h (id INT PRIMARY KEY, v INT) PARTITION BY HASH(id) PARTITIONS 3")
+        s.execute("INSERT INTO h VALUES " + ",".join(f"({i},{i})" for i in range(9)))
+        dest = str(tmp_path / "bk")
+        s.execute(f"BACKUP DATABASE test TO '{dest}'")
+        s.execute("DROP TABLE h")
+        s.execute(f"RESTORE DATABASE test FROM '{dest}'")
+        assert s.must_query("SELECT COUNT(*), SUM(v) FROM h") == [("9", "36")]
+        info = s.infoschema().table("test", "h")
+        assert len(info.physical_ids()) == 3
+        # restored rows really live in the NEW partition keyspaces
+        s.execute("INSERT INTO h VALUES (100, 100)")
+        assert s.must_query("SELECT COUNT(*) FROM h") == [("10",)]

@@ -62,15 +62,22 @@ def run_backup(session, stmt):
     for t in sorted(is_.tables.values(), key=lambda x: x.id):
         if t.db_name.lower() not in dbs:
             continue
-        pairs = snap.scan(tablecodec.table_prefix(t.id), tablecodec.table_prefix(t.id + 1))
-        payload = _pack_pairs(pairs)
-        fname = f"t{t.id}.sst"
-        w.snap_write(os.path.join(path, fname), payload)
-        manifest["tables"].append(
-            {"db": t.db_name, "schema": t.to_json(), "file": fname, "kvs": len(pairs)}
-        )
-        total_kvs += len(pairs)
-        total_bytes += len(payload)
+        ent = {"db": t.db_name, "schema": t.to_json(), "kvs": 0}
+        # one file per physical keyspace (partitions back up separately so
+        # restore can remap each to a fresh partition id)
+        files = []
+        for pid in t.physical_ids():
+            pairs = snap.scan(tablecodec.table_prefix(pid), tablecodec.table_prefix(pid + 1))
+            payload = _pack_pairs(pairs)
+            fname = f"t{pid}.sst"
+            w.snap_write(os.path.join(path, fname), payload)
+            files.append({"pid": pid, "file": fname, "kvs": len(pairs)})
+            ent["kvs"] += len(pairs)
+            total_kvs += len(pairs)
+            total_bytes += len(payload)
+        ent["file"] = files[0]["file"] if t.partition is None else None
+        ent["parts"] = files
+        manifest["tables"].append(ent)
     w.snap_write(os.path.join(path, "manifest.bin"), json.dumps(manifest).encode())
     return ResultSet.message_row(
         ["Destination", "Size", "BackupTS", "Queue Time", "Execution Time"],
@@ -116,21 +123,38 @@ def run_restore(session, stmt):
         new_id = m.alloc_id()
         schema.id = new_id
         schema.db_name = ent["db"]
+        # remap each old physical id (partition or the table itself) to a
+        # freshly allocated keyspace
+        id_map = {}
+        parts = ent.get("parts") or [{"pid": ent["schema"]["id"], "file": ent["file"]}]
+        if schema.partition is not None:
+            for pd in schema.partition.defs:
+                new_pid = m.alloc_id()
+                id_map[pd.id] = new_pid
+                pd.id = new_pid
+        else:
+            id_map[parts[0]["pid"]] = new_id
         m.put_table(schema)
         dbi.table_ids.append(new_id)
         m.put_db(dbi)
         m.bump_schema_version()
         txn.commit()
 
-        payload = w.snap_read(os.path.join(path, ent["file"]))
-        if payload is None:
-            raise TiDBError(f"backup file {ent['file']} missing/corrupt")
-        pairs = [(_rewrite_key(k, new_id), v) for k, v in _unpack_pairs(payload)]
-        commit_ts = store.tso.next()
-        store.mvcc.ingest(pairs, commit_ts)
-        store.bump_version([p[0] for p in pairs[:1]])
-        session.cop.tiles.invalidate_table(new_id)
-        total_kvs += len(pairs)
+        for part in parts:
+            payload = w.snap_read(os.path.join(path, part["file"]))
+            if payload is None:
+                raise TiDBError(f"backup file {part['file']} missing/corrupt")
+            dst = id_map.get(part["pid"])
+            if dst is None:
+                raise TiDBError(f"backup partition {part['pid']} has no schema entry")
+            pairs = [(_rewrite_key(k, dst), v) for k, v in _unpack_pairs(payload)]
+            if not pairs:
+                continue
+            commit_ts = store.tso.next()
+            store.mvcc.ingest(pairs, commit_ts)
+            store.bump_version([pairs[0][0]])
+            session.cop.tiles.invalidate_table(dst)
+            total_kvs += len(pairs)
     session._is_cache = None
     return ResultSet.message_row(
         ["Destination", "Size", "BackupTS", "Queue Time", "Execution Time"],

@@ -87,7 +87,8 @@ def run_load_data(session, stmt):
                     handle = datums[pk.col_offsets[0]].to_int()
                 else:
                     handle = session.alloc_auto_id(info, 1)
-                tbl.add_record(txn, datums, handle)
+                t = session._phys_table(info, datums) if info.partition else tbl
+                t.add_record(txn, datums, handle)
                 affected += 1
             txn.commit()
         except Exception:
@@ -98,6 +99,6 @@ def run_load_data(session, stmt):
             f.write(json.dumps({"table": f"{db}.{info.name}".lower(), "rows_done": lo + len(batch)}))
     if os.path.exists(ckpt_path):
         os.unlink(ckpt_path)
-    session.cop.tiles.invalidate_table(info.id)
+    session._invalidate_tiles(info)
     session.store.stats.report_delta(info.id, affected, affected)
     return ResultSet([], None, affected=affected)

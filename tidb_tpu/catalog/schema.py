@@ -69,6 +69,84 @@ class IndexInfo:
 
 
 @dataclass
+class PartitionDef:
+    """One partition: its own physical keyspace id (ref: model
+    PartitionDefinition — each partition is a physical table)."""
+
+    id: int
+    name: str
+    less_than: int | None = None  # RANGE bound; None = MAXVALUE / hash
+
+    def to_json(self):
+        return {"id": self.id, "name": self.name, "less_than": self.less_than}
+
+    @staticmethod
+    def from_json(d):
+        return PartitionDef(d["id"], d["name"], d.get("less_than"))
+
+
+@dataclass
+class PartitionInfo:
+    """HASH / RANGE partitioning over one integer column (ref: model
+    PartitionInfo + table/tables/partition.go locatePartition)."""
+
+    type: str  # 'hash' | 'range'
+    col: str  # partitioning column name
+    defs: list[PartitionDef] = field(default_factory=list)
+
+    def locate(self, v) -> PartitionDef:
+        """Partition for one (non-null) partition-column value. NULLs go
+        to partition 0 for hash, the first range partition for range
+        (MySQL: NULL sorts below every bound)."""
+        if v is None:
+            return self.defs[0]
+        v = int(v)
+        if self.type == "hash":
+            return self.defs[v % len(self.defs)]
+        for pd in self.defs:
+            if pd.less_than is None or v < pd.less_than:
+                return pd
+        from ..errors import TiDBError
+
+        raise TiDBError(f"Table has no partition for value {v}")
+
+    def prune(self, eq_values=None, lo=None, hi=None) -> list[PartitionDef]:
+        """Partitions that can contain rows matching the constraint:
+        either an equality value set, or a [lo, hi] closed interval on the
+        partition column (range partitioning only for intervals)."""
+        if eq_values is not None:
+            out, seen = [], set()
+            for v in eq_values:
+                try:
+                    pd = self.locate(v)
+                except Exception:  # value beyond the last range bound
+                    continue
+                if pd.id not in seen:
+                    seen.add(pd.id)
+                    out.append(pd)
+            return out
+        if self.type == "range" and (lo is not None or hi is not None):
+            out = []
+            prev_bound = None
+            for pd in self.defs:
+                # partition covers [prev_bound, less_than)
+                if hi is not None and prev_bound is not None and hi < prev_bound:
+                    break
+                if lo is None or pd.less_than is None or lo < pd.less_than:
+                    out.append(pd)
+                prev_bound = pd.less_than
+            return out
+        return list(self.defs)
+
+    def to_json(self):
+        return {"type": self.type, "col": self.col, "defs": [d.to_json() for d in self.defs]}
+
+    @staticmethod
+    def from_json(d):
+        return PartitionInfo(d["type"], d["col"], [PartitionDef.from_json(x) for x in d["defs"]])
+
+
+@dataclass
 class TableInfo:
     id: int
     name: str
@@ -78,6 +156,7 @@ class TableInfo:
     auto_inc_id: int = 1
     state: str = "public"
     db_name: str = ""
+    partition: PartitionInfo | None = None
 
     def col_by_name(self, name: str) -> ColumnInfo:
         lname = name.lower()
@@ -100,6 +179,27 @@ class TableInfo:
         lname = name.lower()
         return next((i for i in self.indexes if i.name.lower() == lname), None)
 
+    def physical_ids(self) -> list[int]:
+        """Keyspace ids holding this table's rows (partition ids, or the
+        table's own id when unpartitioned)."""
+        if self.partition is not None:
+            return [pd.id for pd in self.partition.defs]
+        return [self.id]
+
+    def partition_physical(self, pid: int) -> "TableInfo":
+        """Physical TableInfo for one partition: identical schema, the
+        partition's keyspace id (ref: tables/partition.go
+        GetPartition)."""
+        cache = self.__dict__.setdefault("_phys_cache", {})
+        t = cache.get(pid)
+        if t is None:
+            t = TableInfo(
+                pid, self.name, self.columns, self.indexes, self.pk_is_handle,
+                self.auto_inc_id, self.state, self.db_name,
+            )
+            cache[pid] = t
+        return t
+
     def to_json(self):
         return {
             "id": self.id,
@@ -110,6 +210,7 @@ class TableInfo:
             "auto_inc_id": self.auto_inc_id,
             "state": self.state,
             "db_name": self.db_name,
+            "partition": self.partition.to_json() if self.partition else None,
         }
 
     @staticmethod
@@ -119,6 +220,7 @@ class TableInfo:
             [ColumnInfo.from_json(c) for c in d["columns"]],
             [IndexInfo.from_json(i) for i in d["indexes"]],
             d["pk_is_handle"], d.get("auto_inc_id", 1), d.get("state", "public"), d.get("db_name", ""),
+            PartitionInfo.from_json(d["partition"]) if d.get("partition") else None,
         )
 
 

@@ -216,11 +216,18 @@ class DDLWorker:
         m.put_table(t)
         m.bump_schema_version()
         txn.commit()
-        self.storage.mvcc.unsafe_destroy_range(
-            tablecodec.index_prefix(job.table_id, job.args["index_id"]),
-            tablecodec.index_prefix(job.table_id, job.args["index_id"] + 1),
-        )
+        self._destroy_index_ranges(t, job.args["index_id"])
         self._finish(job, JOB_ROLLBACK, error=f"Duplicate entry for key {job.args.get('index_name')!r}")
+
+    def _destroy_index_ranges(self, t, index_id: int) -> None:
+        """Deferred index data removal over EVERY physical keyspace —
+        partition-local index entries live under the partition ids
+        (ref: ddl/delete_range.go insertJobIntoDeleteRangeTable)."""
+        for pid in t.physical_ids():
+            self.storage.mvcc.unsafe_destroy_range(
+                tablecodec.index_prefix(pid, index_id),
+                tablecodec.index_prefix(pid, index_id + 1),
+            )
 
     # --- DROP INDEX --------------------------------------------------------
 
@@ -243,9 +250,6 @@ class DDLWorker:
             m.bump_schema_version()
             txn.commit()
             # deferred data removal (ref: ddl/delete_range.go)
-            self.storage.mvcc.unsafe_destroy_range(
-                tablecodec.index_prefix(job.table_id, job.args["index_id"]),
-                tablecodec.index_prefix(job.table_id, job.args["index_id"] + 1),
-            )
+            self._destroy_index_ranges(t, job.args["index_id"])
             self._fire("state:none", job)
             self._finish(job, JOB_DONE)

@@ -176,6 +176,11 @@ def _build_reader(ds: DataSource, ctx: ExecContext) -> "TableReaderExec":
     dag = DAGRequest(scan)
     if ds.pushed_conds:
         dag.selection = SelectionNode(ds.pushed_conds)
+    if ds.table.partition is not None:
+        parts = getattr(ds, "pruned_parts", None)
+        if parts is None:
+            parts = ds.table.partition.defs
+        return PartitionReaderExec(ds.table, dag, ctx, parts)
     path = getattr(ds, "path", "table")
     if path == "point":
         return PointGetExec(ds.table, dag, ctx, ds.point_handles)
@@ -328,6 +333,37 @@ class TableReaderExec(Executor):
         if self._iter is None:
             self.open()
         return next(self._iter, None)
+
+
+class PartitionReaderExec(TableReaderExec):
+    """Union of per-partition cop reads sharing ONE DAG shape (ref:
+    PartitionUnion + tables/partition.go GetPartition): each partition is
+    a physical keyspace; partial-agg/TopN chunks from every partition
+    merge at the host final exactly like multi-region partials do."""
+
+    def __init__(self, table, dag: DAGRequest, ctx: ExecContext, parts):
+        super().__init__(table, dag, ctx, None)
+        self.parts = parts
+
+    def open(self):
+        import dataclasses
+        import itertools
+
+        conc = int(self.ctx.vars.get("tidb_distsql_scan_concurrency", "15"))
+        results = []
+        for pd in self.parts:
+            phys = self.table.partition_physical(pd.id)
+            dag = dataclasses.replace(
+                self.dag, scan=dataclasses.replace(self.dag.scan, table_id=phys.id)
+            )
+            results.append(
+                self.ctx.cop.send(
+                    phys, dag, None, self.ctx.read_ts, self.ctx.engine,
+                    txn=self.ctx.txn, concurrency=conc,
+                )
+            )
+        self._results = results
+        self._iter = itertools.chain.from_iterable(results)
 
 
 class IndexReaderExec(TableReaderExec):

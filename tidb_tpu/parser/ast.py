@@ -238,12 +238,21 @@ class IndexDef:
 
 
 @dataclass
+class PartitionSpec:
+    type: str  # 'hash' | 'range'
+    col: str
+    count: int = 0  # hash partition count
+    defs: list = field(default_factory=list)  # [(name, bound_int | None)]
+
+
+@dataclass
 class CreateTable:
     table: TableName
     columns: list  # [ColumnDef]
     indexes: list  # [IndexDef]
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)
+    partition: PartitionSpec | None = None
 
 
 @dataclass

@@ -1031,8 +1031,10 @@ class Parser:
             if not self.try_op(","):
                 break
         self.expect_op(")")
+        if self.at_kw("PARTITION"):
+            node.partition = self._partition_spec()
         # table options
-        while self.tok.kind == "ident" and not self.at_op(";"):
+        while self.tok.kind == "ident" and not self.at_op(";") and not self.at_kw("PARTITION"):
             opt = self.ident().lower()
             if self.try_op("="):
                 pass
@@ -1040,7 +1042,46 @@ class Parser:
                 node.options[opt] = self.next().text
             else:
                 break
+        if self.at_kw("PARTITION"):
+            node.partition = self._partition_spec()
         return node
+
+    def _partition_spec(self):
+        """PARTITION BY HASH(col) PARTITIONS n
+        | PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n|MAXVALUE), ...)"""
+        self.expect_kw("PARTITION")
+        self.expect_kw("BY")
+        if self.try_kw("HASH"):
+            self.expect_op("(")
+            col = self.ident()
+            self.expect_op(")")
+            self.expect_kw("PARTITIONS")
+            n = int(self.next().text)
+            return ast.PartitionSpec("hash", col, count=n)
+        self.expect_kw("RANGE")
+        self.expect_op("(")
+        col = self.ident()
+        self.expect_op(")")
+        self.expect_op("(")
+        defs = []
+        while True:
+            self.expect_kw("PARTITION")
+            name = self.ident()
+            self.expect_kw("VALUES")
+            self.expect_kw("LESS")
+            self.expect_kw("THAN")
+            if self.try_kw("MAXVALUE"):
+                defs.append((name, None))
+            else:
+                self.expect_op("(")
+                neg = bool(self.try_op("-"))
+                bound = int(self.next().text)
+                defs.append((name, -bound if neg else bound))
+                self.expect_op(")")
+            if not self.try_op(","):
+                break
+        self.expect_op(")")
+        return ast.PartitionSpec("range", col, defs=defs)
 
     def _key_part_list(self):
         cols = []

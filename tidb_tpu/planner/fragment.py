@@ -103,6 +103,8 @@ def _plain_scan(ds: DataSource) -> bool:
     consumed conditions into key_ranges (PK handle ranges, index paths)
     must stay on the host readers or rows filtered by ranges would leak
     back in."""
+    if ds.table.partition is not None:
+        return False  # partitioned rows live in per-partition keyspaces
     return getattr(ds, "path", "table") == "table" and getattr(ds, "key_ranges", None) is None
 
 

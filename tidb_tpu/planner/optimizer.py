@@ -238,6 +238,32 @@ def choose_access_paths(root: LogicalPlan, stats=None) -> None:
     walk(root)
 
 
+def _prune_partitions(table, conds, vis_by_off):
+    """Partitions that can match the pushed conds' constraint on the
+    partition column, or None = all (ref: partition_prune.go, simplified
+    to eq/IN + one interval)."""
+    from . import ranger
+
+    part = table.partition
+    pcol = table.col_by_name(part.col)
+    pvis = vis_by_off.get(pcol.offset)
+    if pvis is None or not conds:
+        return None
+    acc = ranger.collect_col_access(conds, {pvis: pcol.ft}).get(pvis)
+    if acc is None:
+        return None
+    if acc.eq_seen:
+        return part.prune(eq_values=[None if d.is_null else d.to_int() for d in acc.eq])
+    lo = hi = None
+    if acc.lo is not None:
+        lo = acc.lo[0].to_int() + (0 if acc.lo[1] else 1)
+    if acc.hi is not None:
+        hi = acc.hi[0].to_int() - (0 if acc.hi[1] else 1)
+    if lo is None and hi is None:
+        return None
+    return part.prune(lo=lo, hi=hi)
+
+
 def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
     from . import ranger
 
@@ -250,6 +276,14 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
     ds.point_handles = None
     conds = ds.pushed_conds
     tstats = stats.get(table.id) if stats is not None else None
+
+    if table.partition is not None:
+        # Partitioned table: table-scan path over (pruned) partitions.
+        # Index/point paths stay off in v1 — indexes are partition-local
+        # and handles don't identify a partition. Conds are NOT dropped:
+        # pruning bounds which partitions are read, the filter still runs.
+        ds.pruned_parts = _prune_partitions(table, conds, vis_by_off)
+        return
 
     # 1. clustered pk → point handles / record ranges
     pk_vis = None

@@ -23,6 +23,7 @@ from ..errors import (
     RetryableError,
     TableExists,
     TiDBError,
+    UnknownColumn,
     UnknownDatabase,
     UnknownTable,
     WriteConflict,
@@ -709,9 +710,14 @@ class Session:
         public index (ref: executor/admin.go CheckTableExec + executor.go
         CheckTableExec). Raises on any dangling or missing entry."""
         info = self.infoschema().table(tn.db or self.current_db, tn.name)
-        tbl = Table(info)
         snap = self.store.snapshot()
-        prefix = tablecodec.record_prefix(info.id)
+        for pid in info.physical_ids():
+            tbl = Table(info.partition_physical(pid)) if info.partition else Table(info)
+            self._check_physical(snap, info, tbl, pid)
+        return ResultSet([], None)
+
+    def _check_physical(self, snap, info, tbl, pid: int) -> None:
+        prefix = tablecodec.record_prefix(pid)
         decoded = [
             (tablecodec.decode_record_handle(k), tbl.decode_record(v))
             for k, v in snap.scan(prefix, prefix + b"\xff")
@@ -723,7 +729,7 @@ class Session:
             for handle, datums in decoded:
                 key, val, _ = tbl.index_value_key(idx, tbl.row_datums_with_hidden(datums, handle), handle)
                 expected[key] = val
-            ipfx = tablecodec.index_prefix(info.id, idx.id)
+            ipfx = tablecodec.index_prefix(pid, idx.id)
             actual = dict(snap.scan(ipfx, ipfx + b"\xff"))
             missing = set(expected) - set(actual)
             dangling = set(actual) - set(expected)
@@ -736,7 +742,6 @@ class Session:
                     f"{len(missing)} missing, {len(dangling)} dangling, "
                     f"{corrupt} mismatched entries"
                 )
-        return ResultSet([], None)
 
     def _admin_checksum_table(self, tn) -> ResultSet:
         """ADMIN CHECKSUM TABLE (ref: executor/checksum.go — a 64-bit
@@ -746,15 +751,15 @@ class Session:
 
         info = self.infoschema().table(tn.db or self.current_db, tn.name)
         snap = self.store.snapshot()
-        pfx = tablecodec.table_prefix(info.id)
         crc = 0
         total_kvs = 0
         total_bytes = 0
-        for k, v in snap.scan(pfx, tablecodec.table_prefix(info.id + 1)):
-            h = hashlib.blake2b(k + b"\x00" + v, digest_size=8).digest()
-            crc ^= int.from_bytes(h, "big")
-            total_kvs += 1
-            total_bytes += len(k) + len(v)
+        for pid in info.physical_ids():
+            for k, v in snap.scan(tablecodec.table_prefix(pid), tablecodec.table_prefix(pid + 1)):
+                h = hashlib.blake2b(k + b"\x00" + v, digest_size=8).digest()
+                crc ^= int.from_bytes(h, "big")
+                total_kvs += 1
+                total_bytes += len(k) + len(v)
         return ResultSet.message_row(
             ["Db_name", "Table_name", "Checksum_crc64_xor", "Total_kvs", "Total_bytes"],
             [info.db_name, info.name, str(crc), str(total_kvs), str(total_bytes)],
@@ -1129,7 +1134,7 @@ class Session:
             a, d = self._insert_row(tbl, txn, datums, stmt, on_dup_cache)
             affected += a
             delta += d
-        self.cop.tiles.invalidate_table(info.id)
+        self._invalidate_tiles(info)
         self._note_delta(info.id, affected, delta)
         return ResultSet([], None, affected=affected, last_insert_id=self.last_insert_id)
 
@@ -1151,10 +1156,12 @@ class Session:
         for c in info.visible_columns():
             if c.ft.not_null and datums[c.offset].is_null:
                 raise TiDBError(f"Column '{c.name}' cannot be null")
+        if info.partition is not None:
+            tbl = self._phys_table(info, datums)  # partition keyspace
         conflicts = self._conflicting_handles(tbl, txn, datums, handle)
         if conflicts:
             if getattr(stmt, "on_dup", None):
-                return self._on_dup_update(tbl, txn, stmt, datums, conflicts[0], handle, on_dup_cache)
+                return self._on_dup_update(tbl, txn, stmt, datums, conflicts[0], handle, on_dup_cache, info)
             if getattr(stmt, "replace", False):
                 # REPLACE deletes EVERY row that conflicts on pk or any
                 # unique index, then inserts (MySQL semantics)
@@ -1181,16 +1188,48 @@ class Session:
         pk = next((i for i in info.indexes if i.primary), None) if info.pk_is_handle else None
         keys: list[bytes] = []
         for datums in rows:
+            t = self._phys_table(info, datums) if info.partition is not None else tbl
             if pk is not None and not datums[pk.col_offsets[0]].is_null:
-                keys.append(tbl.record_key(datums[pk.col_offsets[0]].to_int()))
-            full = tbl.row_datums_with_hidden(datums, 0)
+                keys.append(t.record_key(datums[pk.col_offsets[0]].to_int()))
+            full = t.row_datums_with_hidden(datums, 0)
             for idx in info.indexes:
                 if not idx.unique or (info.pk_is_handle and idx.primary) or idx.state != "public":
                     continue
-                key, _, distinct = tbl.index_value_key(idx, full, None)
+                key, _, distinct = t.index_value_key(idx, full, None)
                 if distinct:
                     keys.append(key)
         txn.lock_keys_for_update(keys)
+
+    def _phys_table(self, info: TableInfo, datums) -> Table:
+        """Physical Table for one row: the located partition's keyspace,
+        or the table itself (ref: tables/partition.go locatePartition)."""
+        if info.partition is None:
+            return Table(info)
+        pcol = info.col_by_name(info.partition.col)
+        d = datums[pcol.offset]
+        pd = info.partition.locate(None if d.is_null else d.to_int())
+        return Table(info.partition_physical(pd.id))
+
+    def _rewrite_row(self, info: TableInfo, txn, ptbl: Table, handle: int, old, new) -> None:
+        """Apply an UPDATE to one row, re-keying the record when the
+        clustered pk (== handle) or the target partition changed — an
+        in-place overwrite would leave the row under a key encoding the
+        OLD pk (ref: executor/update.go updateRecord's handle-changed
+        remove+add path)."""
+        new_handle = handle
+        if info.pk_is_handle:
+            pk = next(i for i in info.indexes if i.primary)
+            new_handle = new[pk.col_offsets[0]].to_int()
+        dst = self._phys_table(info, new) if info.partition is not None else ptbl
+        if new_handle == handle and dst.info.id == ptbl.info.id:
+            ptbl.update_record(txn, handle, old, new)
+            return
+        ptbl.remove_record(txn, handle, old)
+        dst.add_record(txn, new, new_handle)  # check_dup guards the new key
+
+    def _invalidate_tiles(self, info: TableInfo) -> None:
+        for pid in info.physical_ids():
+            self.cop.tiles.invalidate_table(pid)
 
     def _read_for_write(self, txn, key: bytes):
         """Existence read for write-conflict checks: pessimistic txns must
@@ -1204,7 +1243,8 @@ class Session:
         return txn.snapshot.get(key)
 
     def _on_dup_update(
-        self, tbl: Table, txn, stmt, new_datums, handle: int, new_handle: int, cache: dict
+        self, tbl: Table, txn, stmt, new_datums, handle: int, new_handle: int, cache: dict,
+        linfo: TableInfo | None = None,
     ) -> tuple[int, int]:
         """INSERT ... ON DUPLICATE KEY UPDATE (ref: executor/insert.go
         onDuplicateUpdate): assignments evaluate over the EXISTING row,
@@ -1285,7 +1325,7 @@ class Session:
                 changed = True
             updated[col.offset] = nv
         if changed:
-            tbl.update_record(txn, handle, old, updated)
+            self._rewrite_row(linfo or tbl.info, txn, tbl, handle, old, updated)
             return 2, 0
         return 0, 0
 
@@ -1322,14 +1362,18 @@ class Session:
         info = self.infoschema().table(stmt_table.db or self.current_db, stmt_table.name)
         tbl = Table(info)
         txn = self._active_txn()
-        prefix = tablecodec.record_prefix(info.id)
-        if txn.pessimistic:
-            # pessimistic DML scans with a CURRENT read (fresh
-            # for_update_ts) so rows that started matching after start_ts
-            # are found and locked, not just re-filtered
-            kvs = txn.scan_current(prefix, prefix + b"\xff")
-        else:
-            kvs = txn.scan(prefix, prefix + b"\xff")
+        kvs = []  # (phys_tbl, key, value) across every partition keyspace
+        for pid in info.physical_ids():
+            ptbl = Table(info.partition_physical(pid)) if info.partition else tbl
+            prefix = tablecodec.record_prefix(pid)
+            if txn.pessimistic:
+                # pessimistic DML scans with a CURRENT read (fresh
+                # for_update_ts) so rows that started matching after
+                # start_ts are found and locked, not just re-filtered
+                part = txn.scan_current(prefix, prefix + b"\xff")
+            else:
+                part = txn.scan(prefix, prefix + b"\xff")
+            kvs.extend((ptbl, k, v) for k, v in part)
         rows = []
         builder = self._builder()
         cond = None
@@ -1350,23 +1394,23 @@ class Session:
             d, valid = cond.eval(chunk)
             return bool(valid[0] and d[0] != 0)
 
-        for k, v in kvs:
+        for ptbl, k, v in kvs:
             handle = tablecodec.decode_record_handle(k)
-            datums = tbl.decode_record(v)
+            datums = ptbl.decode_record(v)
             if matches(datums):
-                rows.append((handle, datums))
+                rows.append((ptbl, handle, datums))
 
         if txn.pessimistic and rows:
             # pessimistic "current read" (ref: executor/adapter.go:588
             # handlePessimisticDML + client-go for_update_ts): lock the
             # matched rows, then recompute from the LATEST committed values
             # so concurrent committed updates are not lost
-            keys = [tbl.record_key(h) for h, _ in rows]
+            keys = [t.record_key(h) for t, h, _ in rows]
             txn.lock_keys_for_update(keys)
             snap = self.store.snapshot(txn.for_update_ts)
             fresh = snap.batch_get([k for k in keys if k not in txn.membuf])
             cur_rows = []
-            for (h, _), k in zip(rows, keys):
+            for (t, h, _), k in zip(rows, keys):
                 if k in txn.membuf:
                     v = txn.membuf[k]
                     if v == TOMBSTONE:
@@ -1375,9 +1419,9 @@ class Session:
                     v = fresh.get(k)
                     if v is None:
                         continue  # deleted underneath us
-                datums = tbl.decode_record(v)
+                datums = t.decode_record(v)
                 if matches(datums):  # re-filter on current values
-                    cur_rows.append((h, datums))
+                    cur_rows.append((t, h, datums))
             rows = cur_rows
         return info, tbl, txn, rows
 
@@ -1395,7 +1439,7 @@ class Session:
             sets.append((col, builder.to_expr(expr, scope)))
         affected = 0
         vis = info.visible_columns()
-        for handle, datums in rows:
+        for ptbl, handle, datums in rows:
             visible_vals = [datums[c.offset] for c in vis]
             chunk = Chunk.from_datum_rows([c.ft for c in vis], [visible_vals])
             new = list(datums)
@@ -1408,9 +1452,9 @@ class Session:
                     changed = True
                 new[col.offset] = nv
             if changed:
-                tbl.update_record(txn, handle, datums, new)
+                self._rewrite_row(info, txn, ptbl, handle, datums, new)
                 affected += 1
-        self.cop.tiles.invalidate_table(info.id)
+        self._invalidate_tiles(info)
         self._note_delta(info.id, affected, 0)
         return ResultSet([], None, affected=affected)
 
@@ -1418,9 +1462,9 @@ class Session:
         if not isinstance(stmt.table, ast.TableName):
             raise TiDBError("multi-table DELETE not supported yet")
         info, tbl, txn, rows = self._scan_matching_rows(stmt.table, stmt.where)
-        for handle, datums in rows:
-            tbl.remove_record(txn, handle, datums)
-        self.cop.tiles.invalidate_table(info.id)
+        for ptbl, handle, datums in rows:
+            ptbl.remove_record(txn, handle, datums)
+        self._invalidate_tiles(info)
         self._note_delta(info.id, len(rows), -len(rows))
         return ResultSet([], None, affected=len(rows))
 
@@ -1451,13 +1495,17 @@ class Session:
             if stmt.if_exists:
                 return ResultSet([], None)
             raise UnknownDatabase(f"unknown database {stmt.name!r}")
+        phys: list[int] = []
         for tid in db.table_ids:
+            t = m.table(tid)
+            phys.extend(t.physical_ids() if t else [tid])
             m.drop_table(tid)
         m.drop_db(stmt.name)
         m.bump_schema_version()
         txn.commit()
-        for tid in db.table_ids:
-            self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(tid), tablecodec.table_prefix(tid + 1))
+        for pid in phys:
+            self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(pid), tablecodec.table_prefix(pid + 1))
+            self.cop.tiles.invalidate_table(pid)
         return ResultSet([], None)
 
     def _ddl_create_table(self, stmt: ast.CreateTable) -> ResultSet:
@@ -1519,12 +1567,50 @@ class Session:
             rid = ColumnInfo(m.alloc_id(), "_tidb_rowid", ft_longlong(), len(cols), hidden=True)
             cols.append(rid)
         info = TableInfo(tid, stmt.table.name, cols, final_idx, pk_is_handle, db_name=db)
+        if stmt.partition is not None:
+            info.partition = self._build_partition_info(m, stmt.partition, cols, final_idx)
         m.put_table(info)
         dbi.table_ids.append(tid)
         m.put_db(dbi)
         m.bump_schema_version()
         txn.commit()
         return ResultSet([], None)
+
+    def _build_partition_info(self, m, spec, cols, indexes):
+        """Validate + materialize a PARTITION BY clause (ref: ddl/ddl_api.go
+        buildTablePartitionInfo + checkPartitionKeysConstraint): integer
+        partition column, present in every unique key, ascending range
+        bounds; each partition gets its own physical keyspace id."""
+        from ..catalog.schema import PartitionDef, PartitionInfo
+
+        pcol = next((c for c in cols if c.name.lower() == spec.col.lower()), None)
+        if pcol is None:
+            raise UnknownColumn(f"unknown partitioning column {spec.col!r}")
+        if not pcol.ft.is_int():
+            raise TiDBError("partitioning column must be an integer type")
+        for idx in indexes:
+            if idx.unique and pcol.offset not in idx.col_offsets:
+                raise TiDBError(
+                    "A PRIMARY KEY/UNIQUE INDEX must include all columns in the "
+                    "table's partitioning function"
+                )
+        if spec.type == "hash":
+            if spec.count < 1:
+                raise TiDBError("at least one partition required")
+            defs = [PartitionDef(m.alloc_id(), f"p{i}") for i in range(spec.count)]
+        else:
+            if not spec.defs:
+                raise TiDBError("at least one partition required")
+            defs = []
+            prev = None
+            for i, (name, bound) in enumerate(spec.defs):
+                if bound is None and i != len(spec.defs) - 1:
+                    raise TiDBError("MAXVALUE can only be used in the last partition")
+                if bound is not None and prev is not None and bound <= prev:
+                    raise TiDBError("VALUES LESS THAN values must be strictly increasing")
+                prev = bound if bound is not None else prev
+                defs.append(PartitionDef(m.alloc_id(), name, bound))
+        return PartitionInfo(spec.type, pcol.name, defs)
 
     def _ddl_drop_table(self, stmt: ast.DropTable) -> ResultSet:
         for tn in stmt.tables:
@@ -1549,13 +1635,15 @@ class Session:
             m.drop_table(target.id)
             m.bump_schema_version()
             txn.commit()
-            self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(target.id), tablecodec.table_prefix(target.id + 1))
-            self.cop.tiles.invalidate_table(target.id)
+            for pid in target.physical_ids():
+                self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(pid), tablecodec.table_prefix(pid + 1))
+                self.cop.tiles.invalidate_table(pid)
         return ResultSet([], None)
 
     def _ddl_truncate(self, stmt: ast.TruncateTable) -> ResultSet:
         info = self.infoschema().table(stmt.table.db or self.current_db, stmt.table.name)
-        self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(info.id), tablecodec.table_prefix(info.id + 1))
+        for pid in info.physical_ids():
+            self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(pid), tablecodec.table_prefix(pid + 1))
         txn = self._ddl_txn()
         m = Meta(txn)
         t = m.table(info.id)
@@ -1563,8 +1651,8 @@ class Session:
         m.put_table(t)
         m.bump_schema_version()
         txn.commit()
-        self.store.bump_version([tablecodec.record_prefix(info.id)])
-        self.cop.tiles.invalidate_table(info.id)
+        self.store.bump_version([tablecodec.record_prefix(pid) for pid in info.physical_ids()])
+        self._invalidate_tiles(info)
         return ResultSet([], None)
 
     def _ddl_create_index(self, stmt: ast.CreateIndex) -> ResultSet:
@@ -1577,6 +1665,8 @@ class Session:
         delete_only→write_only→write_reorg→public with a resumable
         backfill. This session waits for completion (doDDLJob loop)."""
         db = tn.db or self.current_db
+        if self.infoschema().table(db, tn.name).partition is not None:
+            raise TiDBError("online ADD INDEX on a partitioned table is not supported yet")
         txn = self._ddl_txn()
         m = Meta(txn)
         info = self.infoschema().table(db, tn.name)
@@ -1653,7 +1743,7 @@ class Session:
         m.put_table(t)
         m.bump_schema_version()
         txn.commit()
-        self.cop.tiles.invalidate_table(info.id)
+        self._invalidate_tiles(info)
 
     def _alter_drop_column(self, tn: ast.TableName, name: str):
         db = tn.db or self.current_db
@@ -1662,6 +1752,9 @@ class Session:
         m = Meta(txn)
         t = m.table(info.id)
         col = t.col_by_name(name)
+        if t.partition is not None and col.name.lower() == t.partition.col.lower():
+            txn.rollback()
+            raise TiDBError(f"cannot drop partitioning column {name!r}")
         for idx in t.indexes:
             if col.offset in idx.col_offsets:
                 txn.rollback()
@@ -1675,7 +1768,7 @@ class Session:
         m.put_table(t)
         m.bump_schema_version()
         txn.commit()
-        self.cop.tiles.invalidate_table(info.id)
+        self._invalidate_tiles(info)
 
     def _alter_rename(self, tn: ast.TableName, new: ast.TableName):
         db = tn.db or self.current_db
@@ -1838,7 +1931,19 @@ class Session:
             else:
                 lines.append(f"  KEY `{idx.name}` ({cols})")
         body = ",\n".join(lines)
-        return f"CREATE TABLE `{info.name}` (\n{body}\n) ENGINE=tpu"
+        out = f"CREATE TABLE `{info.name}` (\n{body}\n) ENGINE=tpu"
+        part = info.partition
+        if part is not None:
+            if part.type == "hash":
+                out += f"\nPARTITION BY HASH (`{part.col}`) PARTITIONS {len(part.defs)}"
+            else:
+                defs = ", ".join(
+                    f"PARTITION `{d.name}` VALUES LESS THAN "
+                    + ("MAXVALUE" if d.less_than is None else f"({d.less_than})")
+                    for d in part.defs
+                )
+                out += f"\nPARTITION BY RANGE (`{part.col}`) ({defs})"
+        return out
 
     # --------------------------------------------------------------- EXPLAIN
 

@@ -48,10 +48,12 @@ class StatsHandle:
         (ref: executor/analyze.go pushing sample collection to the store)."""
         read_ts = session.store.tso.next()
         cop = session.cop
-        prefix = tablecodec.record_prefix(info.id)
         batches = []
-        for region, s, e in session.store.regions.split_ranges(prefix, prefix + b"\xff"):
-            batches.append(cop.tiles.get_batch(info, s, e, read_ts))
+        for pid in info.physical_ids():
+            phys = info.partition_physical(pid) if info.partition else info
+            prefix = tablecodec.record_prefix(pid)
+            for region, s, e in session.store.regions.split_ranges(prefix, prefix + b"\xff"):
+                batches.append(cop.tiles.get_batch(phys, s, e, read_ts))
         ts = build_table_stats(info, batches, read_ts)
         self.save(ts, session)
         return ts
