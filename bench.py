@@ -10,7 +10,9 @@ closure executor (BASELINE.md: "≥10× unistore cop throughput" is the
 north star; the Go engine isn't runnable in this image, so the ratio is
 reported against the strongest CPU path available).
 
-Env knobs: BENCH_ROWS (default 2,000,000), BENCH_QUERY (q1|q6|topn).
+Env knobs: BENCH_ROWS (default 8,000,000 — ~TPC-H SF1.3 lineitem; large
+enough that the per-dispatch tunnel round-trip (~100ms fixed, measured) is
+amortized and the number reflects engine throughput), BENCH_QUERY (q1|q6|topn).
 """
 
 import json
@@ -28,7 +30,7 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
-    rows = int(os.environ.get("BENCH_ROWS", "2000000"))
+    rows = int(os.environ.get("BENCH_ROWS", "8000000"))
     which = os.environ.get("BENCH_QUERY", "q1")
     reps = int(os.environ.get("BENCH_REPS", "11"))
 
@@ -60,7 +62,7 @@ def main():
         print(f"WARNING: tpu engine fell back {s.cop.tpu.fallbacks}x", file=sys.stderr)
     assert host_res.rows() == tpu_res.rows(), "engine results diverge"
 
-    _, host_best, host_med = run("host", max(reps // 2, 2))
+    _, host_best, host_med = run("host", min(3, max(reps // 2, 2)))
     _, tpu_best, tpu_med = run("tpu", reps)
 
     value = rows / tpu_med

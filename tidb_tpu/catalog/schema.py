@@ -7,6 +7,7 @@ TableInfo/ColumnInfo/IndexInfo serialize to JSON into the meta KV layout
 from __future__ import annotations
 
 import json
+import math
 from dataclasses import dataclass, field
 
 from ..errors import UnknownColumn, UnknownTable, UnknownDatabase
@@ -102,7 +103,9 @@ class PartitionInfo:
             return self.defs[0]
         v = int(v)
         if self.type == "hash":
-            return self.defs[v % len(self.defs)]
+            # MySQL/TiDB use truncated modulo then abs (locateHashPartition,
+            # ref table/tables/partition.go): -1 % 4 → p1, not Python's p3.
+            return self.defs[abs(int(math.fmod(v, len(self.defs))))]
         for pd in self.defs:
             if pd.less_than is None or v < pd.less_than:
                 return pd

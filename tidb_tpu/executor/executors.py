@@ -346,19 +346,19 @@ class PartitionReaderExec(TableReaderExec):
         self.parts = parts
 
     def open(self):
-        import dataclasses
         import itertools
 
         conc = int(self.ctx.vars.get("tidb_distsql_scan_concurrency", "15"))
         results = []
         for pd in self.parts:
             phys = self.table.partition_physical(pd.id)
-            dag = dataclasses.replace(
-                self.dag, scan=dataclasses.replace(self.dag.scan, table_id=phys.id)
-            )
+            # One shared DAG for every partition: the cop client keys tasks
+            # and decode off the `phys` table argument, and the DAG digest
+            # feeds the XLA program cache — per-partition digests would
+            # compile one identical program per partition.
             results.append(
                 self.ctx.cop.send(
-                    phys, dag, None, self.ctx.read_ts, self.ctx.engine,
+                    phys, self.dag, None, self.ctx.read_ts, self.ctx.engine,
                     txn=self.ctx.txn, concurrency=conc,
                 )
             )

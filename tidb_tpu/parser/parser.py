@@ -1031,9 +1031,7 @@ class Parser:
             if not self.try_op(","):
                 break
         self.expect_op(")")
-        if self.at_kw("PARTITION"):
-            node.partition = self._partition_spec()
-        # table options
+        # table options (the loop refuses PARTITION, parsed after it)
         while self.tok.kind == "ident" and not self.at_op(";") and not self.at_kw("PARTITION"):
             opt = self.ident().lower()
             if self.try_op("="):
