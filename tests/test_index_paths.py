@@ -142,3 +142,67 @@ def test_empty_eq_intersection_stays_empty(s):
     assert s.must_query("select id from t where id = 1 and id = 2 and id = 2") == []
     got = s.must_query("select id from t where a in (1, 2) and a in (2, 3)")
     assert sorted(got) == [("12",), ("2",), ("22",), ("32",), ("42",)]
+
+
+class TestIndexMerge:
+    """Union-of-index-paths for OR predicates (ref:
+    executor/index_merge_reader.go:67, planner indexmerge_path.go)."""
+
+    def test_or_two_indexes(self, s):
+        sql = "select c from t where a = 3 or b = 8"
+        got = s.must_query(sql)
+        # a==3 -> ids 3,13,23,33,43 ; b==8 -> id 4
+        want = sorted(f"v{i}" for i in (3, 13, 23, 33, 43, 4))
+        assert sorted(r[0] for r in got) == want
+        assert "IndexMerge(ia, ib)" in _plan(s, sql)
+
+    def test_or_index_and_pk_points(self, s):
+        sql = "select c from t where id = 7 or a = 9"
+        got = s.must_query(sql)
+        want = sorted(f"v{i}" for i in (7, 9, 19, 29, 39, 49))
+        assert sorted(r[0] for r in got) == want
+        assert "IndexMerge(" in _plan(s, sql)
+
+    def test_overlapping_disjuncts_dedup(self, s):
+        # id 6 satisfies both a=6 and b=12: must appear once
+        sql = "select id from t where a = 6 or b = 12"
+        got = s.must_query(sql)
+        assert sorted(got, key=lambda r: int(r[0])) == [("6",), ("16",), ("26",), ("36",), ("46",)]
+
+    def test_unsargable_disjunct_falls_back(self, s):
+        # c has no index: whole OR must stay a filtered table scan
+        sql = "select id from t where a = 3 or c = 'v11'"
+        got = s.must_query(sql)
+        assert sorted(got, key=lambda r: int(r[0])) == [
+            ("3",), ("11",), ("13",), ("23",), ("33",), ("43",)]
+        assert "IndexMerge" not in _plan(s, sql)
+
+    def test_range_disjunct(self, s):
+        sql = "select id from t where b < 4 or a = 9"
+        got = s.must_query(sql)
+        want = sorted([0, 1, 9, 19, 29, 39, 49])
+        assert sorted(int(r[0]) for r in got) == want
+        assert "IndexMerge(ib, ia)" in _plan(s, sql)
+
+    def test_filter_reapplied_with_residual_conjunct(self, s):
+        # each disjunct sargable, plus a pk-range residual conjunct
+        sql = "select id from t where (a = 3 or b = 8) and id >= 10"
+        got = s.must_query(sql)
+        assert sorted(int(r[0]) for r in got) == [13, 23, 33, 43]
+
+    def test_unindexed_like_residual_conjunct(self, s):
+        # residual over an unindexed column must filter the merged rows
+        sql = "select id from t where (a = 3 or b = 8) and c like 'v1%'"
+        got = s.must_query(sql)
+        assert sorted(int(r[0]) for r in got) == [13]
+
+    def test_ignore_index_hint_blocks_merge(self, s):
+        sql = "select /*+ IGNORE_INDEX(t, ia, ib) */ c from t where a = 3 or b = 8"
+        got = s.must_query(sql)
+        assert sorted(r[0] for r in got) == sorted(f"v{i}" for i in (3, 4, 13, 23, 33, 43))
+        assert "IndexMerge" not in _plan(s, sql)
+
+    def test_update_through_index_merge(self, s):
+        s.execute("update t set c = 'zz' where a = 3 or b = 8")
+        got = s.must_query("select id from t where c = 'zz'")
+        assert sorted(int(r[0]) for r in got) == [3, 4, 13, 23, 33, 43]

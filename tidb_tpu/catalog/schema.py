@@ -7,7 +7,6 @@ TableInfo/ColumnInfo/IndexInfo serialize to JSON into the meta KV layout
 from __future__ import annotations
 
 import json
-import math
 from dataclasses import dataclass, field
 
 from ..errors import UnknownColumn, UnknownTable, UnknownDatabase
@@ -105,7 +104,8 @@ class PartitionInfo:
         if self.type == "hash":
             # MySQL/TiDB use truncated modulo then abs (locateHashPartition,
             # ref table/tables/partition.go): -1 % 4 → p1, not Python's p3.
-            return self.defs[abs(int(math.fmod(v, len(self.defs))))]
+            # abs(v) % n IS truncated-mod-then-abs in exact int arithmetic.
+            return self.defs[abs(v) % len(self.defs)]
         for pd in self.defs:
             if pd.less_than is None or v < pd.less_than:
                 return pd

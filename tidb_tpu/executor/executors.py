@@ -188,6 +188,8 @@ def _build_reader(ds: DataSource, ctx: ExecContext) -> "TableReaderExec":
         return IndexReaderExec(ds.table, dag, ctx, ds.index, ds.key_ranges)
     if path == "index_lookup":
         return IndexLookUpExec(ds.table, dag, ctx, ds.index, ds.key_ranges)
+    if path == "index_merge":
+        return IndexMergeReaderExec(ds.table, dag, ctx, ds.merge_branches)
     return TableReaderExec(ds.table, dag, ctx, ranges=getattr(ds, "key_ranges", None))
 
 
@@ -398,6 +400,35 @@ class IndexLookUpExec(TableReaderExec):
         handles = [h for _, h in entries]
         self._results = self.ctx.cop.send_handles(
             self.table, self.dag, handles, self.ctx.read_ts, self.ctx.engine, txn=self.ctx.txn
+        )
+        self._iter = iter(self._results)
+
+
+class IndexMergeReaderExec(TableReaderExec):
+    """Union of index paths for an OR predicate: each branch scans one
+    index (or is a pk point set), handles are unioned + deduped, then one
+    double read fetches the rows with the full filter DAG re-applied, so
+    per-branch over-approximation is safe (ref: executor/
+    index_merge_reader.go:67 IndexMergeReaderExecutor, union mode)."""
+
+    def __init__(self, table, dag: DAGRequest, ctx: ExecContext, branches):
+        super().__init__(table, dag, ctx, None)
+        self.branches = branches
+
+    def open(self):
+        handles: set[int] = set()
+        for b in self.branches:
+            if b[0] == "points":
+                handles.update(b[1])
+            else:
+                _, index, ranges = b
+                entries = self.ctx.cop.index_entries(
+                    self.table, index, ranges or [], self.ctx.read_ts, txn=self.ctx.txn
+                )
+                handles.update(h for _, h in entries)
+        self._results = self.ctx.cop.send_handles(
+            self.table, self.dag, sorted(handles), self.ctx.read_ts,
+            self.ctx.engine, txn=self.ctx.txn,
         )
         self._iter = iter(self._results)
 

@@ -379,6 +379,93 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
         ds.path = "table"
         ds.key_ranges = ha.ranges
         _drop_conds(ds, ha.access_conds)
+        return
+
+    # 4. index merge: a top-level OR whose every disjunct is sargable on
+    # some index (or is a pk point set) becomes a union of index reads +
+    # one double read; the OR stays as a filter so each branch may
+    # over-approximate its disjunct (ref: planner/core
+    # indexmerge_path.go generateIndexMergeOrPaths, union type only).
+    _try_index_merge(ds, conds, table, visible, vis_by_off, pk_vis, tstats)
+
+
+def _split_dnf(e) -> list:
+    from ..expr.expression import ScalarFunc
+
+    if isinstance(e, ScalarFunc) and e.sig.name == "or":
+        return _split_dnf(e.args[0]) + _split_dnf(e.args[1])
+    return [e]
+
+
+def _split_cnf(e) -> list:
+    from ..expr.expression import ScalarFunc
+
+    if isinstance(e, ScalarFunc) and e.sig.name == "and":
+        return _split_cnf(e.args[0]) + _split_cnf(e.args[1])
+    return [e]
+
+
+def _try_index_merge(ds, conds, table, visible, vis_by_off, pk_vis, tstats) -> None:
+    from . import ranger
+
+    or_cond = None
+    for c in conds:
+        if _split_dnf(c) != [c]:
+            or_cond = c
+            break
+    if or_cond is None:
+        return
+    disjuncts = _split_dnf(or_cond)
+    use_hint = getattr(ds, "hint_use_index", None)
+    ignore_hint = getattr(ds, "hint_ignore_index", None) or ()
+    indexes = []
+    for idx in table.indexes:
+        if idx.state != "public" or (table.pk_is_handle and idx.primary):
+            continue
+        lname = idx.name.lower()
+        if use_hint is not None and lname not in use_hint:
+            continue
+        if lname in ignore_hint:
+            continue
+        col_vis, col_fts, ok = [], [], True
+        for off in idx.col_offsets:
+            if off not in vis_by_off:
+                ok = False
+                break
+            col_vis.append(vis_by_off[off])
+            col_fts.append(table.columns[off].ft)
+        if ok:
+            indexes.append((idx, col_vis, col_fts))
+
+    branches = []  # ("index", idx, ranges) | ("points", handles)
+    est_rows = 0.0
+    for d in disjuncts:
+        cnf = _split_cnf(d)
+        best = None
+        if pk_vis is not None:
+            ha = ranger.detach_handle_conditions(cnf, table.id, pk_vis)
+            if ha is not None and ha.point_handles is not None:
+                best = ("points", ha.point_handles)
+        if best is None:
+            best_eq = -1
+            for idx, col_vis, col_fts in indexes:
+                ia = ranger.detach_index_conditions(cnf, table.id, idx.id, col_vis, col_fts)
+                if ia is None or ia.eq_count == 0 and not ia.has_range:
+                    continue
+                if ia.eq_count > best_eq:
+                    best_eq = ia.eq_count
+                    best = ("index", idx, ia.ranges)
+        if best is None:
+            return  # one unsargable disjunct sinks the whole union
+        if tstats is not None and tstats.row_count > 0:
+            from ..statistics.selectivity import estimate_conds
+
+            est_rows += estimate_conds(tstats, cnf, visible) * float(tstats.row_count)
+        branches.append(best)
+    if tstats is not None and tstats.row_count > 0 and est_rows > 0.5 * tstats.row_count:
+        return  # union would read most of the table: plain scan is cheaper
+    ds.path = "index_merge"
+    ds.merge_branches = branches
 
 
 def _drop_conds(ds: DataSource, consumed: list) -> None:

@@ -57,6 +57,9 @@ class DataSource(LogicalPlan):
         elif path in ("index", "index_lookup"):
             kind = "IndexReader" if path == "index" else "IndexLookUp"
             s += f" {kind}({self.index.name}, {len(self.key_ranges)} ranges)"
+        elif path == "index_merge":
+            names = [b[1].name if b[0] == "index" else "pk" for b in self.merge_branches]
+            s += f" IndexMerge({', '.join(names)})"
         elif getattr(self, "key_ranges", None) is not None:
             s += f" handle_ranges:{len(self.key_ranges)}"
         if self.pushed_conds:
