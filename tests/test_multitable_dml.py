@@ -1,0 +1,150 @@
+"""Multi-table UPDATE/DELETE (ref: executor/update.go, executor/delete.go
+multi-table paths; planner/core/planbuilder.go buildUpdate/buildDelete
+extend the join schema with per-table handle columns)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create database d")
+    s.execute("use d")
+    s.execute("create table emp (id int primary key, name varchar(20), dept_id int, pay int)")
+    s.execute("create table dept (id int primary key, dname varchar(20), raise_pct int)")
+    s.execute(
+        "insert into emp values (1,'a',10,100),(2,'b',10,200),(3,'c',20,300),(4,'d',99,400)"
+    )
+    s.execute("insert into dept values (10,'eng',5),(20,'ops',7)")
+    return s
+
+
+class TestMultiUpdate:
+    def test_cross_table_set(self, s):
+        r = s.execute(
+            "update emp join dept on emp.dept_id = dept.id "
+            "set emp.pay = emp.pay + dept.raise_pct, dept.raise_pct = 0"
+        )
+        assert r.affected == 5  # 3 emp rows + 2 dept rows
+        assert s.must_query("select id, pay from emp order by id") == [
+            ("1", "105"), ("2", "205"), ("3", "307"), ("4", "400")]
+        assert s.must_query("select raise_pct from dept") == [("0",), ("0",)]
+
+    def test_left_join_miss_skipped(self, s):
+        # dept_id=99 has no dept row: dept-side handle is NULL, no write
+        r = s.execute(
+            "update emp left join dept on emp.dept_id = dept.id "
+            "set emp.pay = 1, dept.raise_pct = 1"
+        )
+        assert s.must_query("select pay from emp where id = 4") == [("1",)]
+
+    def test_duplicate_match_updates_once(self, s):
+        s.execute("create table m (k int primary key, v int)")
+        s.execute("insert into m values (1, 0)")
+        s.execute("create table many (k int primary key, mk int, add_v int)")
+        s.execute("insert into many values (1,1,5),(2,1,9)")
+        s.execute("update m join many on m.k = many.mk set m.v = m.v + many.add_v")
+        # first joined match wins; +5 applied once, never +14
+        assert s.must_query("select v from m") == [("5",)]
+
+    def test_ambiguous_bare_column_rejected(self, s):
+        s.execute("create table a1 (id int primary key, v int)")
+        s.execute("create table a2 (id int primary key, v int)")
+        with pytest.raises(TiDBError):
+            s.execute("update a1 join a2 on a1.id = a2.id set v = 1")
+
+    def test_where_filters_join(self, s):
+        s.execute(
+            "update emp join dept on emp.dept_id = dept.id set pay = 0 where dname = 'ops'"
+        )
+        assert s.must_query("select id from emp where pay = 0") == [("3",)]
+
+    def test_in_explicit_txn_rollback(self, s):
+        s.execute("begin")
+        s.execute("update emp join dept on emp.dept_id = dept.id set pay = 0")
+        assert s.must_query("select pay from emp where id = 1") == [("0",)]
+        s.execute("rollback")
+        assert s.must_query("select pay from emp where id = 1") == [("100",)]
+
+
+class TestMultiDelete:
+    def test_targets_before_from(self, s):
+        r = s.execute("delete emp from emp join dept on emp.dept_id = dept.id where dname = 'eng'")
+        assert r.affected == 2
+        assert s.must_query("select id from emp order by id") == [("3",), ("4",)]
+        assert s.must_query("select count(*) from dept") == [("2",)]
+
+    def test_both_targets(self, s):
+        s.execute("delete emp, dept from emp join dept on emp.dept_id = dept.id where dept.id = 20")
+        assert s.must_query("select id from emp order by id") == [("1",), ("2",), ("4",)]
+        assert s.must_query("select id from dept") == [("10",)]
+
+    def test_using_form(self, s):
+        s.execute("delete from emp using emp join dept on emp.dept_id = dept.id")
+        assert s.must_query("select id from emp") == [("4",)]
+
+    def test_star_suffix_target(self, s):
+        s.execute("delete emp.* from emp join dept on emp.dept_id = dept.id where dept.id = 10")
+        assert s.must_query("select id from emp order by id") == [("3",), ("4",)]
+
+    def test_hidden_rowid_table(self, s):
+        s.execute("create table h (x int, y int)")
+        s.execute("insert into h values (1,1),(2,2),(3,3),(2,4)")
+        s.execute("create table k (x int primary key)")
+        s.execute("insert into k values (2)")
+        r = s.execute("delete h from h join k on h.x = k.x")
+        assert r.affected == 2  # both x=2 rows, distinct hidden handles
+        assert s.must_query("select x from h order by x") == [("1",), ("3",)]
+
+    def test_unknown_target_rejected(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("delete nosuch from emp join dept on emp.dept_id = dept.id")
+
+    def test_order_by_limit_rejected(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("delete emp from emp join dept on emp.dept_id = dept.id limit 2")
+        with pytest.raises(TiDBError):
+            s.execute(
+                "update emp join dept on emp.dept_id = dept.id set pay = 0 order by emp.id limit 1"
+            )
+
+    def test_reserved_column_name_rejected(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("create table bad (id int primary key, _tidb_rowid int)")
+        s.execute("create table ok2 (id int primary key)")
+        with pytest.raises(TiDBError):
+            s.execute("alter table ok2 add column _tidb_x int")
+
+
+class TestMultiDMLPessimistic:
+    def test_current_read_sees_concurrent_commit(self, s):
+        """A row committed by another session after the pessimistic txn
+        began must be seen (current read) by multi-table DML."""
+        s2 = Session(s.store)
+        s2.execute("use d")
+        s.execute("set tidb_txn_mode = 'pessimistic'")
+        s.execute("begin")
+        # concurrent session commits a new matching emp row after begin
+        s2.execute("insert into emp values (9,'z',10,900)")
+        r = s.execute(
+            "update emp join dept on emp.dept_id = dept.id set pay = pay + 1 where dept.id = 10"
+        )
+        s.execute("commit")
+        assert s.must_query("select pay from emp where id = 9") == [("901",)]
+
+    def test_set_value_from_current_version(self, s):
+        """SET t1.x = t2.y must read t2.y at for_update_ts, not start_ts."""
+        s2 = Session(s.store)
+        s2.execute("use d")
+        s.execute("set tidb_txn_mode = 'pessimistic'")
+        s.execute("begin")
+        s2.execute("update dept set raise_pct = 50 where id = 10")
+        s.execute(
+            "update emp join dept on emp.dept_id = dept.id set emp.pay = dept.raise_pct "
+            "where dept.id = 10"
+        )
+        s.execute("commit")
+        assert s.must_query("select pay from emp where id = 1") == [("50",)]

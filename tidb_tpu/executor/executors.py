@@ -166,7 +166,13 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
 
 
 def _build_reader(ds: DataSource, ctx: ExecContext) -> "TableReaderExec":
-    visible = ds.table.visible_columns()
+    visible = list(ds.table.visible_columns())
+    hidden_offs = {c.offset: c for c in ds.table.columns if c.hidden}
+    for pc in ds.out_cols:
+        if pc.orig_offset in hidden_offs:
+            # multi-table DML exposed the hidden handle column: scan emits
+            # it as a trailing lane (decode fills it from the record key)
+            visible.append(hidden_offs[pc.orig_offset])
     scan = ScanNode(
         ds.table.id,
         [c.offset for c in visible],

@@ -212,6 +212,7 @@ class Delete:
     where: Any = None
     order_by: list = field(default_factory=list)
     limit: Any = None
+    targets: list | None = None  # multi-table: names/aliases to delete from
 
 
 @dataclass

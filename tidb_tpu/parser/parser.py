@@ -877,9 +877,31 @@ class Parser:
 
     def delete_stmt(self):
         self.expect_kw("DELETE")
+        targets = None
+        if not self.at_kw("FROM"):
+            # multi-table form 1: DELETE t1[.*], t2[.*] FROM <table_refs>
+            targets = [self._delete_target()]
+            while self.try_op(","):
+                targets.append(self._delete_target())
         self.expect_kw("FROM")
         tbl = self.table_refs()
-        node = ast.Delete(tbl)
+        if self.at_kw("USING"):
+            # multi-table form 2: DELETE FROM t1[, t2] USING <table_refs>
+            if targets is not None:
+                self.fail("USING not allowed after DELETE <tables> FROM")
+            targets = []
+            def leaves(n):
+                if isinstance(n, ast.Join):
+                    leaves(n.left)
+                    leaves(n.right)
+                elif isinstance(n, ast.TableName):
+                    targets.append(n.name)
+                else:
+                    self.fail("expected table names before USING")
+            leaves(tbl)
+            self.next()
+            tbl = self.table_refs()
+        node = ast.Delete(tbl, targets=targets)
         if self.try_kw("WHERE"):
             node.where = self.expr()
         if self.try_kw("ORDER"):
@@ -888,6 +910,13 @@ class Parser:
         if self.try_kw("LIMIT"):
             node.limit, _ = self.limit_clause()
         return node
+
+    def _delete_target(self) -> str:
+        """One DELETE target: name or name.* (qualifier form)."""
+        name = self.ident()
+        if self.try_op("."):
+            self.expect_op("*")
+        return name
 
     # --- DDL ---------------------------------------------------------------
 

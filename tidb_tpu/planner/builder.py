@@ -128,9 +128,12 @@ class PlanBuilder:
         c = scope.cols[idx]
         return ECol(idx, c.ft, c.name)
 
-    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None, context_info=None, hints=None):
+    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None, context_info=None, hints=None, expose_rowid=None):
         self.is_ = infoschema
         self.db = current_db
+        # aliases whose hidden `_tidb_rowid` must be addressable (multi-
+        # table DML projects per-target handles through the join)
+        self.expose_rowid = expose_rowid or set()
         self.run_subquery = run_subquery  # callable(Select ast) -> list[Datum rows]
         self.params = params  # EXECUTE-bound Constants for '?' placeholders
         self.memtable_rows = memtable_rows  # callable(name) -> rows (info schema)
@@ -264,6 +267,10 @@ class PlanBuilder:
             for c in info.columns
             if not c.hidden
         ]
+        if (tn.alias or tn.name).lower() in self.expose_rowid:
+            rid = next((c for c in info.columns if c.hidden and c.name == "_tidb_rowid"), None)
+            if rid is not None:
+                cols.append(PlanCol(rid.name, rid.ft, tn.alias or tn.name, rid.offset))
         ds = DataSource(info, tn.alias or tn.name, cols)
         # an aliased table is addressable ONLY by its alias (TiDB rule)
         name = (tn.alias or tn.name).lower()

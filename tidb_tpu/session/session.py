@@ -811,13 +811,14 @@ class Session:
 
     # ---------------------------------------------------------------- SELECT
 
-    def _builder(self) -> PlanBuilder:
+    def _builder(self, expose_rowid=None) -> PlanBuilder:
         return PlanBuilder(
             self.infoschema(), self.current_db,
             run_subquery=self._run_subquery, params=self._exec_params,
             memtable_rows=self._memtable_rows,
             context_info={"user": self.user, "conn_id": self.conn_id},
             hints=getattr(self, "_cur_hints", None),
+            expose_rowid=expose_rowid,
         )
 
     @property
@@ -1425,9 +1426,249 @@ class Session:
             rows = cur_rows
         return info, tbl, txn, rows
 
+    # ------------------------------------------------- multi-table DML
+
+    @staticmethod
+    def _dml_leaves(node) -> dict:
+        """alias(lower) → ast.TableName for every base-table leaf of a
+        FROM tree (subquery sources are joinable but not DML targets)."""
+        leaves: dict = {}
+
+        def walk(n):
+            if isinstance(n, ast.Join):
+                walk(n.left)
+                walk(n.right)
+            elif isinstance(n, ast.TableName):
+                leaves[(n.alias or n.name).lower()] = n
+
+        walk(node)
+        return leaves
+
+    def _dml_join_select(self, from_ast, where, fields, expose: set, read_ts: int):
+        """Run the DML row-collection join: SELECT <fields> FROM <refs>
+        WHERE <cond> with hidden handles exposed; returns the Chunk (ref:
+        the reference plans multi-table DML as a select whose schema is
+        extended with per-table handle columns — planbuilder.go
+        buildUpdate/buildDelete)."""
+        sel = ast.Select(fields=fields, from_=from_ast, where=where)
+        builder = self._builder(expose_rowid=expose)
+        plan = builder.build_select(sel)
+        plan = optimize(plan, self.store.stats)
+        ctx = ExecContext(
+            self.cop, read_ts, engine="host", vars=self.vars, txn=self.txn
+        )
+        return drain(build_executor(plan, ctx))
+
+    def _dml_collect(self, stmt, fields, expose: set, txn, keys_of):
+        """Collection pass for multi-table DML. Optimistic: one snapshot
+        read at start_ts. Pessimistic: current read at a fresh
+        for_update_ts, lock the identified row keys, and re-collect until
+        no new keys appear — so WHERE/join and SET values are evaluated
+        on the locked, current versions (the multi-table analog of the
+        single-table scan_current + lock + re-filter loop; ref:
+        executor/adapter.go handlePessimisticDML retry on lock error)."""
+        if txn is None or not txn.pessimistic:
+            return self._dml_join_select(stmt.table, stmt.where, fields, expose, self.read_ts())
+        locked: set[bytes] = set()
+        chunk = None
+        for _ in range(4):
+            txn.for_update_ts = self.store.tso.next()
+            chunk = self._dml_join_select(
+                stmt.table, stmt.where, fields, expose, txn.for_update_ts
+            )
+            keys = set(keys_of(chunk))
+            if not (keys - locked):
+                break
+            txn.lock_keys_for_update(keys)
+            locked |= keys
+        return chunk
+
+    def _dml_fetch_current(self, txn, tbl: Table, keys: list[bytes]) -> dict:
+        """key → raw row value for DML writes. Pessimistic txns lock the
+        keys (no-op for already-locked) and read at for_update_ts;
+        optimistic reads through the txn view."""
+        if txn.pessimistic and keys:
+            txn.lock_keys_for_update(keys)
+            snap = self.store.snapshot(txn.for_update_ts)
+            fresh = snap.batch_get([k for k in keys if k not in txn.membuf])
+            out = {}
+            for k in keys:
+                if k in txn.membuf:
+                    v = txn.membuf[k]
+                    if v != TOMBSTONE:
+                        out[k] = v
+                elif fresh.get(k) is not None:
+                    out[k] = fresh[k]
+            return out
+        return {k: v for k in keys if (v := txn.get(k)) is not None}
+
+    def _run_update_multi(self, stmt: ast.Update) -> ResultSet:
+        leaves = self._dml_leaves(stmt.table)
+        if not leaves:
+            raise TiDBError("UPDATE requires at least one base table")
+        infos = {
+            a: self.infoschema().table(tn.db or self.current_db, tn.name)
+            for a, tn in leaves.items()
+        }
+        # SET targets: qualified names pick their table; bare names must
+        # be unambiguous across the joined tables (MySQL resolution rule)
+        sets: dict[str, list] = {}
+        for name, expr in stmt.sets:
+            if name.table is not None:
+                alias = name.table.lower()
+                if alias not in infos:
+                    raise UnknownTable(f"unknown table {name.table!r} in UPDATE")
+            else:
+                hits = [
+                    a for a, info in infos.items()
+                    if any(c.name.lower() == name.column.lower() for c in info.visible_columns())
+                ]
+                if not hits:
+                    raise UnknownColumn(f"unknown column {name.column!r}")
+                if len(hits) > 1:
+                    raise TiDBError(f"column {name.column!r} in SET is ambiguous")
+                alias = hits[0]
+            col = infos[alias].col_by_name(name.column)
+            sets.setdefault(alias, []).append((col, expr))
+        if stmt.order_by or stmt.limit is not None:
+            # MySQL rejects these on the multi-table form (syntax error);
+            # silently dropping them would unbound a bounded statement
+            raise TiDBError("multi-table UPDATE does not allow ORDER BY or LIMIT")
+        order = sorted(sets)
+        for a in order:
+            if infos[a].partition is not None:
+                raise TiDBError("multi-table UPDATE on a partitioned table is not supported")
+        expose = {a for a in order if infos[a].handle_col().hidden}
+        fields = []
+        for a in order:
+            fields.append(ast.SelectField(ast.Name((a, infos[a].handle_col().name))))
+            fields.extend(ast.SelectField(e) for _, e in sets[a])
+        txn = self._active_txn()
+        tbls = {a: Table(infos[a]) for a in order}
+
+        def keys_of(chunk):
+            out = []
+            p = 0
+            for a in order:
+                hcol = chunk.columns[p]
+                p += 1 + len(sets[a])
+                for i in range(chunk.num_rows):
+                    hd = hcol.get_datum(i)
+                    if not hd.is_null:
+                        out.append(tbls[a].record_key(hd.to_int()))
+            return out
+
+        chunk = self._dml_collect(stmt, fields, expose, txn, keys_of)
+        affected = 0
+        pos = 0
+        n = chunk.num_rows if chunk is not None else 0
+        for a in order:
+            info = infos[a]
+            tbl = tbls[a]
+            hcol = chunk.columns[pos]
+            vcols = chunk.columns[pos + 1 : pos + 1 + len(sets[a])]
+            pos += 1 + len(sets[a])
+            new_vals: dict[int, list] = {}
+            for i in range(n):
+                hd = hcol.get_datum(i)
+                if hd.is_null:
+                    continue  # outer-join miss: nothing to update
+                h = hd.to_int()
+                if h not in new_vals:  # first joined match wins
+                    new_vals[h] = [c.get_datum(i) for c in vcols]
+            keys = [tbl.record_key(h) for h in new_vals]
+            cur = self._dml_fetch_current(txn, tbl, keys)
+            changed_rows = 0
+            for h, vals in new_vals.items():
+                raw = cur.get(tbl.record_key(h))
+                if raw is None:
+                    continue  # deleted underneath us
+                datums = tbl.decode_record(raw)
+                new = list(datums)
+                changed = False
+                for (col, _), vd in zip(sets[a], vals):
+                    nv = self._cast_datum(vd, col.ft) if not vd.is_null else Datum.null()
+                    if repr(nv) != repr(datums[col.offset]):
+                        changed = True
+                    new[col.offset] = nv
+                if changed:
+                    self._rewrite_row(info, txn, tbl, h, datums, new)
+                    changed_rows += 1
+            if changed_rows:
+                self._invalidate_tiles(info)
+                self._note_delta(info.id, changed_rows, 0)
+            affected += changed_rows
+        return ResultSet([], None, affected=affected)
+
+    def _run_delete_multi(self, stmt: ast.Delete) -> ResultSet:
+        leaves = self._dml_leaves(stmt.table)
+        targets = [t.lower() for t in (stmt.targets or [])]
+        if not targets:
+            raise TiDBError("multi-table DELETE requires explicit target tables")
+        for t in targets:
+            if t not in leaves:
+                raise UnknownTable(f"unknown table {t!r} in MULTI DELETE")
+        infos = {
+            a: self.infoschema().table(leaves[a].db or self.current_db, leaves[a].name)
+            for a in targets
+        }
+        if stmt.order_by or stmt.limit is not None:
+            raise TiDBError("multi-table DELETE does not allow ORDER BY or LIMIT")
+        for a in targets:
+            if infos[a].partition is not None:
+                raise TiDBError("multi-table DELETE on a partitioned table is not supported")
+        expose = {a for a in targets if infos[a].handle_col().hidden}
+        fields = [
+            ast.SelectField(ast.Name((a, infos[a].handle_col().name))) for a in targets
+        ]
+        txn = self._active_txn()
+        tbls = {a: Table(infos[a]) for a in targets}
+
+        def keys_of(chunk):
+            out = []
+            for j, a in enumerate(targets):
+                hcol = chunk.columns[j]
+                for i in range(chunk.num_rows):
+                    hd = hcol.get_datum(i)
+                    if not hd.is_null:
+                        out.append(tbls[a].record_key(hd.to_int()))
+            return out
+
+        chunk = self._dml_collect(stmt, fields, expose, txn, keys_of)
+        n = chunk.num_rows if chunk is not None else 0
+        affected = 0
+        for j, a in enumerate(targets):
+            info = infos[a]
+            tbl = tbls[a]
+            hcol = chunk.columns[j]
+            handles = []
+            seen = set()
+            for i in range(n):
+                hd = hcol.get_datum(i)
+                if hd.is_null:
+                    continue
+                h = hd.to_int()
+                if h not in seen:
+                    seen.add(h)
+                    handles.append(h)
+            keys = [tbl.record_key(h) for h in handles]
+            cur = self._dml_fetch_current(txn, tbl, keys)
+            removed = 0
+            for h in handles:
+                raw = cur.get(tbl.record_key(h))
+                if raw is None:
+                    continue
+                tbl.remove_record(txn, h, tbl.decode_record(raw))
+                removed += 1
+            if removed:
+                self._invalidate_tiles(info)
+                self._note_delta(info.id, removed, -removed)
+            affected += removed
+        return ResultSet([], None, affected=affected)
+
     def _run_update(self, stmt: ast.Update) -> ResultSet:
         if not isinstance(stmt.table, ast.TableName):
-            raise TiDBError("multi-table UPDATE not supported yet")
+            return self._run_update_multi(stmt)
         info, tbl, txn, rows = self._scan_matching_rows(stmt.table, stmt.where)
         sets = []
         from ..planner.plans import PlanCol
@@ -1459,8 +1700,8 @@ class Session:
         return ResultSet([], None, affected=affected)
 
     def _run_delete(self, stmt: ast.Delete) -> ResultSet:
-        if not isinstance(stmt.table, ast.TableName):
-            raise TiDBError("multi-table DELETE not supported yet")
+        if not isinstance(stmt.table, ast.TableName) or stmt.targets is not None:
+            return self._run_delete_multi(stmt)
         info, tbl, txn, rows = self._scan_matching_rows(stmt.table, stmt.where)
         for ptbl, handle, datums in rows:
             ptbl.remove_record(txn, handle, datums)
@@ -1528,6 +1769,9 @@ class Session:
         cols: list[ColumnInfo] = []
         indexes: list[IndexInfo] = []
         for i, cd in enumerate(stmt.columns):
+            if cd.name.lower().startswith("_tidb_"):
+                txn.rollback()
+                raise TiDBError(f"column name {cd.name!r} is reserved")
             ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems)
             if cd.not_null or cd.primary_key:
                 ft.flag |= NOT_NULL_FLAG
@@ -1719,6 +1963,8 @@ class Session:
         return ResultSet([], None)
 
     def _alter_add_column(self, tn: ast.TableName, cd: ast.ColumnDef):
+        if cd.name.lower().startswith("_tidb_"):
+            raise TiDBError(f"column name {cd.name!r} is reserved")
         db = tn.db or self.current_db
         info = self.infoschema().table(db, tn.name)
         txn = self._ddl_txn()
