@@ -104,6 +104,120 @@ class MiniMySQLClient:
         self._write_packet(b"\x0e")
         return self._read_packet()[0] == 0x00
 
+    # --- binary protocol (COM_STMT_*) -------------------------------------
+
+    def stmt_prepare(self, sql: str) -> tuple[int, int]:
+        """→ (stmt_id, n_params)."""
+        self.seq = 0
+        self._write_packet(b"\x16" + sql.encode())
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            raise RuntimeError(first[9:].decode("utf8", "replace"))
+        stmt_id = struct.unpack_from("<I", first, 1)[0]
+        ncols = struct.unpack_from("<H", first, 5)[0]
+        nparams = struct.unpack_from("<H", first, 7)[0]
+        for _ in range(nparams):
+            self._read_packet()  # param defs
+        if nparams:
+            assert self._read_packet()[0] == 0xFE
+        for _ in range(ncols):
+            self._read_packet()
+        if ncols:
+            assert self._read_packet()[0] == 0xFE
+        return stmt_id, nparams
+
+    def stmt_execute(self, stmt_id: int, params: list, send_types: bool = True):
+        """Binary execute; params: None/int/float/str. Returns like query().
+        send_types=False mimics C clients that bind types only on the
+        first execute (new-params-bound-flag = 0)."""
+        self.seq = 0
+        payload = b"\x17" + struct.pack("<IBI", stmt_id, 0, 1)
+        n = len(params)
+        if n:
+            nb = bytearray((n + 7) // 8)
+            types = b""
+            vals = b""
+            for i, v in enumerate(params):
+                if v is None:
+                    nb[i // 8] |= 1 << (i % 8)
+                    types += bytes([6, 0])
+                elif isinstance(v, int):
+                    types += bytes([8, 0])
+                    vals += struct.pack("<q", v)
+                elif isinstance(v, float):
+                    types += bytes([5, 0])
+                    vals += struct.pack("<d", v)
+                else:
+                    b = str(v).encode()
+                    types += bytes([0xFE, 0])
+                    vals += bytes([len(b)]) + b  # lenc (short strings)
+            if send_types:
+                payload += bytes(nb) + b"\x01" + types + vals
+            else:
+                payload += bytes(nb) + b"\x00" + vals
+        self._write_packet(payload)
+        first = self._read_packet()
+        if first[0] == 0x00:
+            affected, _ = self._lenc(first, 1)
+            return ("ok", affected)
+        if first[0] == 0xFF:
+            raise RuntimeError(first[9:].decode("utf8", "replace"))
+        ncols, _ = self._lenc(first, 0)
+        fts = []
+        for _ in range(ncols):
+            cdef = self._read_packet()
+            # walk 6 lenc strings, then 0x0c, charset u16, len u32, type u8
+            pos = 0
+            for _ in range(6):
+                ln, pos = self._lenc(cdef, pos)
+                pos += ln
+            fts.append(cdef[pos + 7])
+        assert self._read_packet()[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            rows.append(self._parse_binary_row(pkt, fts))
+        return ("rows", rows)
+
+    def _parse_binary_row(self, pkt: bytes, fts: list[int]):
+        n = len(fts)
+        nb_len = (n + 7 + 2) // 8
+        null_bitmap = pkt[1 : 1 + nb_len]
+        pos = 1 + nb_len
+        row = []
+        for i, t in enumerate(fts):
+            bit = i + 2
+            if null_bitmap[bit // 8] & (1 << (bit % 8)):
+                row.append(None)
+                continue
+            if t in (1, 2, 3, 8, 9, 13):
+                size = {1: 1, 2: 2, 3: 4, 8: 8, 9: 4, 13: 2}[t]
+                row.append(int.from_bytes(pkt[pos : pos + size], "little", signed=t != 13))
+                pos += size
+            elif t == 4:
+                row.append(struct.unpack_from("<f", pkt, pos)[0]); pos += 4
+            elif t == 5:
+                row.append(struct.unpack_from("<d", pkt, pos)[0]); pos += 8
+            elif t in (7, 10, 12):
+                ln = pkt[pos]; pos += 1
+                raw = pkt[pos : pos + ln]; pos += ln
+                row.append(("dt", raw))
+            elif t == 11:
+                ln = pkt[pos]; pos += 1
+                raw = pkt[pos : pos + ln]; pos += ln
+                row.append(("time", raw))
+            else:
+                ln, pos = self._lenc(pkt, pos)
+                row.append(pkt[pos : pos + ln].decode("utf8"))
+                pos += ln
+        return tuple(row)
+
+    def stmt_close(self, stmt_id: int) -> None:
+        self.seq = 0
+        self._write_packet(b"\x19" + struct.pack("<I", stmt_id))
+
     def close(self):
         try:
             self.seq = 0
@@ -192,3 +306,84 @@ class TestWireProtocol:
         with pytest.raises((ConnectionError, OSError)):
             for _ in range(5):
                 victim.query("SELECT 1")
+
+
+class TestBinaryProtocol:
+    """COM_STMT_PREPARE/EXECUTE/CLOSE with binary rows and params
+    (ref: server/conn_stmt.go, util.go dumpBinaryRow)."""
+
+    def test_prepare_execute_select(self, client):
+        client.query("create database if not exists bp")
+        client.query("use bp")
+        client.query("create table t (id int primary key, v varchar(20), f double)")
+        client.query("insert into t values (1,'a',1.5),(2,'b',2.5),(3,null,null)")
+        sid, nparams = client.stmt_prepare("select id, v, f from t where id >= ? order by id")
+        assert nparams == 1
+        kind, rows = client.stmt_execute(sid, [2])
+        assert kind == "rows"
+        assert rows == [(2, "b", 2.5), (3, None, None)]
+        client.stmt_close(sid)
+
+    def test_execute_dml_with_params(self, client):
+        client.query("create database if not exists bp2")
+        client.query("use bp2")
+        client.query("create table u (id int primary key, name varchar(30))")
+        sid, nparams = client.stmt_prepare("insert into u values (?, ?)")
+        assert nparams == 2
+        kind, affected = client.stmt_execute(sid, [10, "hello"])
+        assert (kind, affected) == ("ok", 1)
+        kind, affected = client.stmt_execute(sid, [11, None])
+        assert (kind, affected) == ("ok", 1)
+        client.stmt_close(sid)
+        kind, rows = client.query("select id, name from u order by id")
+        assert rows == [("10", "hello"), ("11", None)]
+
+    def test_reexecute_uses_new_params(self, client):
+        client.query("create database if not exists bp3")
+        client.query("use bp3")
+        client.query("create table r (id int primary key)")
+        client.query("insert into r values (1),(2),(3),(4)")
+        sid, _ = client.stmt_prepare("select count(*) from r where id <= ?")
+        assert client.stmt_execute(sid, [2])[1] == [(2,)]
+        assert client.stmt_execute(sid, [4])[1] == [(4,)]
+        client.stmt_close(sid)
+
+    def test_unknown_stmt_id_errors(self, client):
+        with pytest.raises(RuntimeError):
+            client.stmt_execute(99999, [])
+
+    def test_binary_datetime_roundtrip(self, client):
+        client.query("create database if not exists bp4")
+        client.query("use bp4")
+        client.query("create table d (id int primary key, ts datetime)")
+        client.query("insert into d values (1, '2024-03-15 10:30:45')")
+        sid, _ = client.stmt_prepare("select ts from d where id = ?")
+        kind, rows = client.stmt_execute(sid, [1])
+        tag, raw = rows[0][0]
+        assert tag == "dt" and len(raw) in (7, 11)
+        import struct as _s
+        y, mo, day = _s.unpack_from("<HBB", raw, 0)
+        assert (y, mo, day) == (2024, 3, 15)
+        client.stmt_close(sid)
+
+    def test_reexecute_without_type_rebind(self, client):
+        """C clients send param types only on the first execute."""
+        client.query("create database if not exists bp5")
+        client.query("use bp5")
+        client.query("create table w (id int primary key, v int)")
+        client.query("insert into w values (1,10),(2,20),(3,30)")
+        sid, _ = client.stmt_prepare("select v from w where id = ?")
+        assert client.stmt_execute(sid, [1])[1] == [(10,)]
+        assert client.stmt_execute(sid, [3], send_types=False)[1] == [(30,)]
+        client.stmt_close(sid)
+
+    def test_unsigned_bigint_binary_row(self, client):
+        client.query("create database if not exists bp6")
+        client.query("use bp6")
+        client.query("create table ub (id int primary key, u bigint unsigned)")
+        client.query("insert into ub values (1, 18446744073709551615)")
+        sid, _ = client.stmt_prepare("select u from ub where id = ?")
+        kind, rows = client.stmt_execute(sid, [1])
+        # client parses as signed longlong: raw bytes are all 0xff
+        assert rows[0][0] & 0xFFFFFFFFFFFFFFFF == 18446744073709551615
+        client.stmt_close(sid)

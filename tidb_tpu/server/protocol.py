@@ -47,6 +47,12 @@ COM_INIT_DB = 0x02
 COM_QUERY = 0x03
 COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_SEND_LONG_DATA = 0x18
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
+COM_STMT_FETCH = 0x1C
 
 # MySQL column types
 MYSQL_TYPE = {
@@ -218,3 +224,167 @@ def text_row(values: list[str | None]) -> bytes:
         else:
             out += lenc_str(v.encode("utf8", "replace"))
     return out
+
+
+# --- binary protocol (COM_STMT_*; ref: server/conn_stmt.go, util.go
+# dumpBinaryRow / parseExecArgs) -------------------------------------------
+
+def stmt_prepare_ok(stmt_id: int, num_cols: int, num_params: int) -> bytes:
+    return (
+        b"\x00"
+        + struct.pack("<I", stmt_id)
+        + struct.pack("<H", num_cols)
+        + struct.pack("<H", num_params)
+        + b"\x00"
+        + struct.pack("<H", 0)  # warnings
+    )
+
+
+def _encode_binary_datetime(s: str) -> bytes:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' → binary date/datetime value."""
+    date, _, clock = s.partition(" ")
+    y, mo, d = (int(x) for x in date.split("-"))
+    if not clock:
+        return bytes([4]) + struct.pack("<HBB", y, mo, d)
+    hms, _, frac = clock.partition(".")
+    h, mi, sec = (int(x) for x in hms.split(":"))
+    if frac:
+        micro = int(frac.ljust(6, "0")[:6])
+        return bytes([11]) + struct.pack("<HBBBBBI", y, mo, d, h, mi, sec, micro)
+    return bytes([7]) + struct.pack("<HBBBBB", y, mo, d, h, mi, sec)
+
+
+def _encode_binary_duration(s: str) -> bytes:
+    """'[-]HHH:MM:SS[.ffffff]' → binary TIME value."""
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    hms, _, frac = s.partition(".")
+    h, mi, sec = (int(x) for x in hms.split(":"))
+    days, h = divmod(h, 24)
+    if frac:
+        micro = int(frac.ljust(6, "0")[:6])
+        return bytes([12, 1 if neg else 0]) + struct.pack("<IBBBI", days, h, mi, sec, micro)
+    return bytes([8, 1 if neg else 0]) + struct.pack("<IBBB", days, h, mi, sec)
+
+
+_INT_SIZES = {1: "<b", 2: "<h", 3: "<i", 8: "<q", 9: "<i", 13: "<H"}
+_UINT_SIZES = {1: "<B", 2: "<H", 3: "<I", 8: "<Q", 9: "<I", 13: "<H"}
+
+
+def binary_row(values: list[str | None], fts: list[FieldType]) -> bytes:
+    """One binary-protocol resultset row from display values + types
+    (ref: util.go dumpBinaryRow). Ints/floats are fixed-width, temporal
+    types use the packed binary layouts, the rest are length-encoded."""
+    n = len(values)
+    null_bitmap = bytearray((n + 7 + 2) // 8)
+    body = b""
+    for i, (v, ft) in enumerate(zip(values, fts)):
+        if v is None:
+            pos = i + 2  # binary-row null bitmap has a 2-bit offset
+            null_bitmap[pos // 8] |= 1 << (pos % 8)
+            continue
+        mtype = MYSQL_TYPE.get(ft.tp, 253)
+        if mtype in _INT_SIZES:
+            fmt = _UINT_SIZES[mtype] if ft.is_unsigned else _INT_SIZES[mtype]
+            body += struct.pack(fmt, int(v))
+        elif mtype == 4:
+            body += struct.pack("<f", float(v))
+        elif mtype == 5:
+            body += struct.pack("<d", float(v))
+        elif mtype in (7, 10, 12):  # timestamp/date/datetime
+            body += _encode_binary_datetime(v)
+        elif mtype == 11:  # time
+            body += _encode_binary_duration(v)
+        else:  # decimals, strings, blobs, json → length-encoded
+            body += lenc_str(v.encode("utf8", "replace"))
+    return b"\x00" + bytes(null_bitmap) + body
+
+
+def parse_exec_args(data: bytes, n_params: int, long_data: dict | None = None,
+                    prev_types: list | None = None):
+    """COM_STMT_EXECUTE payload after stmt_id → (values, types).
+
+    Returns python values (None/int/float/str/bytes) for each parameter
+    (ref: conn_stmt.go parseExecArgs). `long_data` holds accumulated
+    COM_STMT_SEND_LONG_DATA buffers keyed by param index. `prev_types`
+    are the types bound by an earlier execute — the C clients send types
+    only once (new-params-bound-flag=0 afterwards); the caller persists
+    the returned types and passes them back."""
+    pos = 0
+    flags = data[pos]; pos += 1  # noqa: E702 — cursor flags unused (no cursors)
+    pos += 4  # iteration count, always 1
+    if n_params == 0:
+        return [], None
+    nb_len = (n_params + 7) // 8
+    null_bitmap = data[pos : pos + nb_len]
+    pos += nb_len
+    new_params_bound = data[pos]; pos += 1  # noqa: E702
+    types = prev_types
+    if new_params_bound:
+        types = []
+        for _ in range(n_params):
+            t, flag = data[pos], data[pos + 1]
+            types.append((t, bool(flag & 0x80)))
+            pos += 2
+    values = []
+    long_data = long_data or {}
+    for i in range(n_params):
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            values.append(None)
+            continue
+        if i in long_data:
+            values.append(bytes(long_data[i]))
+            continue
+        t, unsigned = types[i] if types else (0xFE, False)
+        if t == 6:  # NULL type
+            values.append(None)
+        elif t in (1, 2, 3, 8, 9):
+            size = {1: 1, 2: 2, 3: 4, 8: 8, 9: 4}[t]
+            raw = data[pos : pos + size]
+            pos += size
+            values.append(int.from_bytes(raw, "little", signed=not unsigned))
+        elif t == 4:
+            values.append(struct.unpack_from("<f", data, pos)[0]); pos += 4  # noqa: E702
+        elif t == 5:
+            values.append(struct.unpack_from("<d", data, pos)[0]); pos += 8  # noqa: E702
+        elif t in (7, 10, 12, 14):  # binary date/datetime/timestamp
+            ln = data[pos]; pos += 1  # noqa: E702
+            raw = data[pos : pos + ln]; pos += ln  # noqa: E702
+            values.append(_decode_binary_datetime(raw))
+        elif t == 11:  # binary time
+            ln = data[pos]; pos += 1  # noqa: E702
+            raw = data[pos : pos + ln]; pos += ln  # noqa: E702
+            values.append(_decode_binary_duration(raw))
+        else:  # varchar/string/blob/decimal/json → length-encoded bytes
+            n, pos = read_lenc_int(data, pos)
+            raw = data[pos : pos + n]
+            pos += n
+            values.append(raw.decode("utf8", "replace") if t != 0xFC else bytes(raw))
+    return values, types
+
+
+def _decode_binary_datetime(raw: bytes) -> str:
+    if len(raw) == 0:
+        return "0000-00-00 00:00:00"
+    y, mo, d = struct.unpack_from("<HBB", raw, 0)
+    if len(raw) == 4:
+        return f"{y:04d}-{mo:02d}-{d:02d}"
+    h, mi, s = struct.unpack_from("<BBB", raw, 4)
+    if len(raw) == 7:
+        return f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}"
+    micro = struct.unpack_from("<I", raw, 7)[0]
+    return f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}.{micro:06d}"
+
+
+def _decode_binary_duration(raw: bytes) -> str:
+    if len(raw) == 0:
+        return "00:00:00"
+    neg = raw[0] == 1
+    days, h, mi, s = struct.unpack_from("<IBBB", raw, 1)
+    total_h = days * 24 + h
+    out = f"{total_h:02d}:{mi:02d}:{s:02d}"
+    if len(raw) == 12:
+        micro = struct.unpack_from("<I", raw, 8)[0]
+        out += f".{micro:06d}"
+    return ("-" if neg else "") + out

@@ -22,6 +22,25 @@ from . import protocol as p
 log = logging.getLogger("tidb_tpu.server")
 
 
+def _py_to_constant(v):
+    """Decoded wire parameter → typed Constant for the planner."""
+    from ..expr.expression import Constant
+    from ..mysqltypes.datum import Datum
+    from ..mysqltypes.field_type import ft_double, ft_longlong, ft_varchar
+
+    if v is None:
+        return Constant(Datum.null(), ft_varchar())
+    if isinstance(v, bool):
+        return Constant(Datum.i(int(v)), ft_longlong())
+    if isinstance(v, int):
+        return Constant(Datum.i(v), ft_longlong())
+    if isinstance(v, float):
+        return Constant(Datum.f(v), ft_double())
+    if isinstance(v, bytes):
+        return Constant(Datum.s(v.decode("utf8", "replace")), ft_varchar())
+    return Constant(Datum.s(str(v)), ft_varchar())
+
+
 class ClientConn:
     def __init__(self, server: "Server", sock, conn_id: int):
         self.server = server
@@ -32,6 +51,9 @@ class ClientConn:
         self.session = Session(server.storage, cop_client=server.cop)
         self.user = ""
         self.alive = True
+        # wire prepared statements: stmt_id → (parsed ast, n_params, long_data)
+        self.stmts: dict[int, list] = {}
+        self._next_stmt_id = 1
 
     def _status(self) -> int:
         st = p.SERVER_STATUS_AUTOCOMMIT
@@ -97,7 +119,84 @@ class ClientConn:
         if cmd == p.COM_FIELD_LIST:
             self.pkt.write_packet(p.eof_packet())
             return
+        if cmd == p.COM_STMT_PREPARE:
+            return self.handle_stmt_prepare(data.decode("utf8", "replace"))
+        if cmd == p.COM_STMT_EXECUTE:
+            return self.handle_stmt_execute(data)
+        if cmd == p.COM_STMT_SEND_LONG_DATA:
+            return self.handle_stmt_long_data(data)
+        if cmd == p.COM_STMT_CLOSE:
+            self.stmts.pop(int.from_bytes(data[:4], "little"), None)
+            return  # no response by spec
+        if cmd == p.COM_STMT_RESET:
+            sid = int.from_bytes(data[:4], "little")
+            ent = self.stmts.get(sid)
+            if ent is not None:
+                ent[2].clear()
+            self.pkt.write_packet(p.ok_packet(status=self._status()))
+            return
         self.pkt.write_packet(p.err_packet(1047, f"unsupported command {cmd:#x}"))
+
+    # --- binary prepared statements (ref: server/conn_stmt.go) -------------
+
+    def handle_stmt_prepare(self, sql: str) -> None:
+        from ..parser import parse_one
+
+        try:
+            parsed = parse_one(sql)
+        except TiDBError as e:
+            self.pkt.write_packet(p.err_packet(1064, str(e), "42000"))
+            return
+        n_params = Session._count_params(parsed)
+        sid = self._next_stmt_id
+        self._next_stmt_id += 1
+        self.stmts[sid] = [parsed, n_params, {}, None]  # [.., long_data, bound types]
+        # column count 0: the execute response carries the real resultset
+        # header, which every connector reads anyway
+        self.pkt.write_packet(p.stmt_prepare_ok(sid, 0, n_params))
+        for i in range(n_params):
+            from ..mysqltypes.field_type import ft_varchar
+
+            self.pkt.write_packet(p.column_def(f"?{i}", ft_varchar()))
+        if n_params:
+            self.pkt.write_packet(p.eof_packet(status=self._status()))
+
+    def handle_stmt_execute(self, data: bytes) -> None:
+        sid = int.from_bytes(data[:4], "little")
+        ent = self.stmts.get(sid)
+        if ent is None:
+            self.pkt.write_packet(p.err_packet(1243, f"Unknown prepared statement handler ({sid})"))
+            return
+        parsed, n_params, long_data, bound_types = ent
+        import struct as _struct
+
+        try:
+            values, types = p.parse_exec_args(data[4:], n_params, long_data, bound_types)
+        except (IndexError, ValueError, _struct.error) as e:
+            self.pkt.write_packet(p.err_packet(1210, f"Incorrect arguments to EXECUTE: {e}"))
+            return
+        ent[3] = types  # C clients send types only on the first execute
+        long_data.clear()
+        params = [_py_to_constant(v) for v in values]
+        try:
+            rs = self.session.execute_prepared_ast(parsed, params)
+        except TiDBError as e:
+            self.pkt.write_packet(p.err_packet(1105, str(e)))
+            return
+        except Exception as e:  # noqa: BLE001 — surface as SQL error, keep conn
+            log.exception("stmt execute failed")
+            self.pkt.write_packet(p.err_packet(1105, f"internal error: {e}"))
+            return
+        self.write_resultset(rs, binary=True)
+
+    def handle_stmt_long_data(self, data: bytes) -> None:
+        """COM_STMT_SEND_LONG_DATA: append chunk to a param buffer; no
+        response (ref: conn_stmt.go handleStmtSendLongData)."""
+        sid = int.from_bytes(data[:4], "little")
+        param = int.from_bytes(data[4:6], "little")
+        ent = self.stmts.get(sid)
+        if ent is not None:
+            ent[2].setdefault(param, bytearray()).extend(data[6:])
 
     def handle_query(self, sql: str) -> None:
         """COM_QUERY → execute → OK or text resultset
@@ -111,6 +210,9 @@ class ClientConn:
             log.exception("query failed: %s", sql)
             self.pkt.write_packet(p.err_packet(1105, f"internal error: {e}"))
             return
+        self.write_resultset(rs)
+
+    def write_resultset(self, rs, binary: bool = False) -> None:
         if not rs.names:
             self.pkt.write_packet(p.ok_packet(rs.affected, rs.last_insert_id, status=self._status()))
             return
@@ -120,7 +222,10 @@ class ClientConn:
             self.pkt.write_packet(p.column_def(name, ft))
         self.pkt.write_packet(p.eof_packet(status=self._status()))
         for row in rs.rows():
-            self.pkt.write_packet(p.text_row(list(row)))
+            if binary:
+                self.pkt.write_packet(p.binary_row(list(row), fts))
+            else:
+                self.pkt.write_packet(p.text_row(list(row)))
         self.pkt.write_packet(p.eof_packet(status=self._status()))
 
 

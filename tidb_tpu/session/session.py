@@ -982,6 +982,16 @@ class Session:
         finally:
             self._exec_params = None
 
+    def execute_prepared_ast(self, parsed, params: list) -> ResultSet:
+        """Wire-protocol COM_STMT_EXECUTE entry: run a pre-parsed
+        statement with bound Constant parameters (ref: conn_stmt.go
+        handleStmtExecute → session ExecutePreparedStmt)."""
+        self._exec_params = params
+        try:
+            return self._execute_stmt(parsed)
+        finally:
+            self._exec_params = None
+
     def _run_subquery(self, select_ast):
         rs = self.run_select(select_ast)
         rows = [rs.chunk.get_row(i) for i in range(rs.chunk.num_rows)]
