@@ -387,3 +387,12 @@ class TestBinaryProtocol:
         # client parses as signed longlong: raw bytes are all 0xff
         assert rows[0][0] & 0xFFFFFFFFFFFFFFFF == 18446744073709551615
         client.stmt_close(sid)
+
+    def test_first_execute_without_types_rejected(self, client):
+        client.query("create database if not exists bp7")
+        client.query("use bp7")
+        client.query("create table z (id int primary key)")
+        sid, _ = client.stmt_prepare("select * from z where id = ?")
+        with pytest.raises(RuntimeError):
+            client.stmt_execute(sid, [1], send_types=False)
+        client.stmt_close(sid)

@@ -179,3 +179,121 @@ class TestMergeComparator:
                 pass
         assert glob.glob(str(tmp_path / "tidbtpu-spill-*")) == []
         tempfile.tempdir = None
+
+
+class TestHashJoinSpill:
+    """Grace hash join under tidb_mem_quota_query (ref:
+    executor/hash_table.go spillable hashRowContainer)."""
+
+    N = 3000
+
+    def _mk(self, s):
+        s.execute("create table jl (id int primary key, k int, pad varchar(80))")
+        s.execute("create table jr (id int primary key, k int, pad varchar(80))")
+        for lo in range(0, self.N, 500):
+            vals = ",".join(f"({i},{i % 37},'L{'x' * 60}{i}')" for i in range(lo, lo + 500))
+            s.execute(f"insert into jl values {vals}")
+            vals = ",".join(f"({i},{i % 37},'R{'y' * 60}{i}')" for i in range(lo, lo + 500))
+            s.execute(f"insert into jr values {vals}")
+
+    def _oracle(self, s, sql):
+        """Narrow-output queries: build side (~250KB) blows the 64KB
+        quota and must spill; the projected output stays under it.
+        MPP off so the host HashJoinExec (the spilling operator) runs."""
+        s.vars["tidb_allow_mpp"] = "OFF"
+        s.vars["tidb_mem_quota_query"] = "0"
+        want = sorted(s.must_query(sql), key=repr)
+        s.vars["tidb_mem_quota_query"] = str(64 * 1024)
+        got = sorted(s.must_query(sql), key=repr)
+        s.vars["tidb_mem_quota_query"] = "0"
+        s.vars["tidb_allow_mpp"] = "ON"
+        return got, want
+
+    def test_inner_join_spill_matches_memory(self, s):
+        self._mk(s)
+        got, want = self._oracle(
+            s, "select jl.id, jr.id from jl join jr on jl.k = jr.k and jl.id = jr.id")
+        assert got == want and len(got) == self.N
+
+    def test_left_join_spill_matches_memory(self, s):
+        self._mk(s)
+        s.execute(f"delete from jr where id >= {self.N // 2}")
+        got, want = self._oracle(
+            s, "select jl.id, jr.id from jl left join jr on jl.id = jr.id")
+        assert got == want and len(got) == self.N
+        assert sum(1 for _, r in got if r is None) == self.N // 2
+
+    def test_right_join_spill_matches_memory(self, s):
+        self._mk(s)
+        s.execute(f"delete from jl where id >= {self.N // 3}")
+        got, want = self._oracle(
+            s, "select jl.id, jr.id from jl right join jr on jl.id = jr.id")
+        assert got == want and len(got) == self.N
+
+    def test_spill_flag_set(self, s):
+        self._mk(s)
+        from tidb_tpu.executor.executors import HashJoinExec
+        flags = []
+        orig = HashJoinExec._grace
+        def spy(self, rchunks):
+            flags.append(True)
+            return orig(self, rchunks)
+        HashJoinExec._grace = spy
+        try:
+            s.vars["tidb_allow_mpp"] = "OFF"
+            s.vars["tidb_mem_quota_query"] = str(64 * 1024)
+            s.must_query("select jl.id from jl join jr on jl.id = jr.id")
+            s.vars["tidb_mem_quota_query"] = "0"
+            s.vars["tidb_allow_mpp"] = "ON"
+        finally:
+            HashJoinExec._grace = orig
+        assert flags, "quota did not trigger the grace path"
+
+    def test_skewed_key_recursive_partition(self, s):
+        """One hot key: recursive re-partition bottoms out at max depth
+        and still joins correctly (driven at the executor level so the
+        session tracker doesn't conflate output size with build size)."""
+        import numpy as np
+        from tidb_tpu.chunk.chunk import Chunk, Column
+        from tidb_tpu.executor.executors import ChunkSourceExec, HashJoinExec
+        from tidb_tpu.expr.expression import Column as ECol
+        from tidb_tpu.mysqltypes.field_type import ft_longlong, ft_varchar
+
+        fts = [ft_longlong(), ft_varchar(80)]
+        n_build, n_probe = 3000, 5
+        build = Chunk([
+            Column(fts[0], np.full(n_build, 7, dtype=np.int64), np.ones(n_build, bool)),
+            Column(fts[1], np.array(["b" * 70] * n_build, dtype=object), np.ones(n_build, bool)),
+        ])
+        probe = Chunk([
+            Column(fts[0], np.full(n_probe, 7, dtype=np.int64), np.ones(n_probe, bool)),
+            Column(fts[1], np.array(["a" * 70] * n_probe, dtype=object), np.ones(n_probe, bool)),
+        ])
+        ex = HashJoinExec(
+            ChunkSourceExec(probe, fts), ChunkSourceExec(build, fts), "inner",
+            [(ECol(0, fts[0], "k"), ECol(2, fts[0], "k"))], [],
+            fts + fts, spill_limit=16 * 1024,
+        )
+        ex.open()
+        total = 0
+        while (c := ex.next()) is not None:
+            total += c.num_rows
+        ex.close()
+        assert ex.spilled, "hot-key build side must have entered the grace path"
+        assert total == n_build * n_probe
+
+    def test_limit_cleans_spill_files(self, s):
+        """LIMIT stops pulling mid-grace: close() must delete temp files."""
+        import glob
+        import tempfile
+        self._mk(s)
+        s.vars["tidb_allow_mpp"] = "OFF"
+        s.vars["tidb_mem_quota_query"] = str(64 * 1024)
+        before = set(glob.glob(tempfile.gettempdir() + "/tidbtpu-spill-*"))
+        rows = s.must_query(
+            "select jl.id from jl join jr on jl.k = jr.k and jl.id = jr.id limit 3")
+        s.vars["tidb_mem_quota_query"] = "0"
+        s.vars["tidb_allow_mpp"] = "ON"
+        assert len(rows) == 3
+        after = set(glob.glob(tempfile.gettempdir() + "/tidbtpu-spill-*"))
+        assert after <= before, f"leaked spill files: {after - before}"

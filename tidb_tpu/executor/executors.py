@@ -132,6 +132,7 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
                     build_executor(plan.children[1], ctx),
                     plan.kind, plan.eq_conds, plan.other_conds, out_fts,
                 )
+        quota = int(ctx.vars.get("tidb_mem_quota_query", "0") or 0)
         return HashJoinExec(
             build_executor(plan.children[0], ctx),
             build_executor(plan.children[1], ctx),
@@ -140,6 +141,7 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
             plan.other_conds,
             out_fts,
             na_key=plan.na_key,
+            spill_limit=quota,
         )
     if isinstance(plan, MemtablePlan):
         return MemtableExec(plan)
@@ -1379,7 +1381,9 @@ class HashJoinExec(Executor):
     semi/anti variants ref joiner.go semiJoiner/antiSemiJoiner, null-aware
     NOT IN per the reference's NAAJ semantics)."""
 
-    def __init__(self, left: Executor, right: Executor, kind: str, eq_conds, other_conds, out_fts, na_key=None):
+    SPILL_PARTITIONS = 16
+
+    def __init__(self, left: Executor, right: Executor, kind: str, eq_conds, other_conds, out_fts, na_key=None, spill_limit: int = 0):
         self.left = left
         self.right = right
         self.kind = kind
@@ -1387,38 +1391,215 @@ class HashJoinExec(Executor):
         self.other_conds = other_conds
         self.out_fts = out_fts
         self.na_key = na_key
+        self.spill_limit = spill_limit
+        self.spilled = False
         self._done = False
+        self._part_iter = None
 
     def open(self):
         # children are opened by drain() in next() — see SortExec.open
         self._done = False
+        self._part_iter = None
+        self.spilled = False
 
     def next(self):
+        if self._part_iter is not None:
+            return next(self._part_iter, None)
         if self._done:
             return None
         self._done = True
+        if (
+            self.spill_limit
+            and self.eq_conds
+            and self.na_key is None
+            and self.kind in ("inner", "left", "right")
+        ):
+            self._part_iter = self._bounded()
+            return next(self._part_iter, None)
         lchunk = drain(self.left)
         rchunk = drain(self.right)
         if self.kind in ("semi", "anti"):
             return self._semi_anti(lchunk, rchunk)
+        return self._join_pair(lchunk, rchunk)
+
+    # --- grace hash join spill (ref: executor/hash_table.go spillable
+    # hashRowContainer + join.go partition-wise rebuild) --------------------
+
+    def _bounded(self):
+        """Memory-bounded flow: read the build side up to the quota; on
+        exceed, hash-partition both sides to disk and join partition
+        pairs one at a time (grace hash join)."""
+        from ..utils.memory import chunk_bytes
+
+        self.right.open()
+        rchunks, rbytes = [], 0
+        exceeded = False
+        while True:
+            c = self.right.next()
+            if c is None:
+                break
+            if c.num_rows:
+                rchunks.append(c)
+                rbytes += chunk_bytes(c)
+            if rbytes > self.spill_limit:
+                exceeded = True
+                break
+        if not exceeded:
+            self.right.close()
+            rchunk = Chunk.concat_all(rchunks) if rchunks else Chunk.empty(self.right.out_fts, 0)
+            out = self._join_pair(drain(self.left), rchunk)
+            if out is not None and out.num_rows:
+                yield out
+            return
+        yield from self._grace(rchunks)
+
+    @staticmethod
+    def _check_kill():
+        sess = _ACTIVE_SESSION.get()
+        if sess is not None and getattr(sess, "_killed", False):
+            from ..errors import QueryInterrupted
+
+            sess._killed = False
+            raise QueryInterrupted("Query execution was interrupted")
+
+    def _spill_side(self, chunk_iter, keys, parts, salt: int = 0):
+        P = len(parts)
+        for c in chunk_iter:
+            self._check_kill()
+            if not c.num_rows:
+                continue
+            lanes = [k.eval(c) for k in keys]
+            pid = np.zeros(c.num_rows, dtype=np.int64)
+            for i in range(c.num_rows):
+                kt = _key_tuple(lanes, i)
+                # NULL keys never match: any partition works (0); the salt
+                # redistributes on recursive re-partitioning
+                pid[i] = (hash((salt, kt)) % P) if kt is not None else 0
+            for p in range(P):
+                mask = pid == p
+                if mask.any():
+                    parts[p].write(c.filter(mask))
+
+    MAX_SPILL_DEPTH = 3
+
+    def _grace(self, rchunks):
+        from ..chunk.chunk_io import SpillFile
+        from ..planner.optimizer import _shift_expr
+
+        self.spilled = True
+        P = self.SPILL_PARTITIONS
+        nl = len(self.left.out_fts)
+        rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
+        lkeys = [l for l, _ in self.eq_conds]
+        self._spill_files: list = []
+
+        def new_parts():
+            parts = [SpillFile() for _ in range(P)]
+            self._spill_files.extend(parts)
+            return parts
+
+        try:
+            rparts = new_parts()
+
+            def right_rest():
+                yield from rchunks
+                while (c := self.right.next()) is not None:
+                    yield c
+
+            self._spill_side(right_rest(), rkeys, rparts)
+            self.right.close()
+            self.left.open()
+
+            def left_all():
+                while (c := self.left.next()) is not None:
+                    yield c
+
+            self._spill_side(left_all(), lkeys, lparts := new_parts())
+            self.left.close()
+            for sf in rparts + lparts:
+                sf.finish()
+            for p in range(P):
+                # rows only ever match inside their own key partition, so
+                # outer-side padding per partition pair stays correct
+                yield from self._join_partition(lparts[p], rparts[p], new_parts, depth=1)
+        finally:
+            for sf in self._spill_files:
+                sf.cleanup()
+
+    def _join_partition(self, lsf, rsf, new_parts, depth: int):
+        """Join one spilled partition pair. A build side still over the
+        quota re-partitions with a fresh hash salt (recursive grace); at
+        max depth — one hot key that cannot split — it joins materialized.
+        The probe side always streams chunk-at-a-time from disk, so probe
+        memory is one chunk regardless of partition size."""
+        from ..planner.optimizer import _shift_expr
+        from ..utils.memory import chunk_bytes
+
+        lfts = self.left.out_fts
+        rfts = self.right.out_fts
+        rcs = list(rsf.chunks(rfts))
+        if sum(chunk_bytes(c) for c in rcs) > self.spill_limit and depth < self.MAX_SPILL_DEPTH:
+            nl = len(lfts)
+            rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
+            lkeys = [l for l, _ in self.eq_conds]
+            sub_r = new_parts()
+            self._spill_side(iter(rcs), rkeys, sub_r, salt=depth)
+            del rcs
+            sub_l = new_parts()
+            self._spill_side(lsf.chunks(lfts), lkeys, sub_l, salt=depth)
+            for sf in sub_r + sub_l:
+                sf.finish()
+            for p in range(len(sub_r)):
+                yield from self._join_partition(sub_l[p], sub_r[p], new_parts, depth + 1)
+            return
+        rchunk = Chunk.concat_all(rcs)
+        if not rchunk.num_cols:
+            rchunk = Chunk.empty(rfts, 0)
+        del rcs
+        table = self._build_table(rchunk, len(lfts))
+        matched_right = np.zeros(rchunk.num_rows, dtype=bool) if self.kind == "right" else None
+        for lc in lsf.chunks(lfts):
+            self._check_kill()
+            out = self._probe_emit(lc, rchunk, table, matched_right)
+            if out is not None and out.num_rows:
+                yield out
+        if matched_right is not None:
+            pad = self._right_pad(Chunk.empty(lfts, 0), rchunk, matched_right)
+            if pad is not None and pad.num_rows:
+                yield pad
+
+    def _join_pair(self, lchunk: Chunk, rchunk: Chunk) -> Chunk:
         nl = lchunk.num_cols
 
-        lkeys = [l for l, _ in self.eq_conds]
-        rkeys = [r for _, r in self.eq_conds]
+        table = self._build_table(rchunk, nl)
+        matched_right = np.zeros(rchunk.num_rows, dtype=bool) if self.kind == "right" else None
+        out = self._probe_emit(lchunk, rchunk, table, matched_right)
+        if matched_right is not None:
+            pad = self._right_pad(lchunk, rchunk, matched_right)
+            if pad is not None:
+                out = out.concat(pad)
+        return out
+
+    def _build_table(self, rchunk: Chunk, nl: int) -> dict:
         # right-side key exprs are over the concatenated schema; shift down
         from ..planner.optimizer import _shift_expr
 
-        rkeys = [_shift_expr(r, -nl) for r in rkeys]
-
+        rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
         table: dict = {}
-        if rchunk.num_rows:
+        if rchunk.num_rows and rkeys:
             key_lanes = [k.eval(rchunk) for k in rkeys]
             for i in range(rchunk.num_rows):
                 kt = _key_tuple(key_lanes, i)
                 if kt is None:
                     continue
                 table.setdefault(kt, []).append(i)
+        return table
 
+    def _probe_emit(self, lchunk, rchunk, table, matched_right) -> Chunk:
+        """Probe one left chunk against a built table: assemble matched
+        pairs, apply other-conditions, left-pad misses, and record right
+        matches into the cross-chunk `matched_right` accumulator."""
+        lkeys = [l for l, _ in self.eq_conds]
         li_out, ri_out = [], []
         if lchunk.num_rows:
             lkey_lanes = [k.eval(lchunk) for k in lkeys]
@@ -1435,11 +1616,25 @@ class HashJoinExec(Executor):
                 if not hit and self.kind == "left":
                     li_out.append(i)
                     ri_out.append(-1)
-        return self._emit(lchunk, rchunk, li_out, ri_out)
+        out = _assemble_join(lchunk, rchunk, li_out, ri_out, self.out_fts)
+        if self.other_conds:
+            out, li_out, ri_out = self._apply_other(out, lchunk, rchunk, li_out, ri_out)
+        if matched_right is not None:
+            for j in ri_out:
+                if j >= 0:
+                    matched_right[j] = True
+        return out
+
+    def _right_pad(self, lchunk, rchunk, matched_right) -> Chunk | None:
+        """Unmatched build rows null-padded for right-outer joins; lchunk
+        only donates the left-side schema (may be empty)."""
+        extra_r = [j for j in range(rchunk.num_rows) if not matched_right[j]]
+        if not extra_r:
+            return None
+        return _assemble_join(lchunk, rchunk, [-1] * len(extra_r), extra_r, self.out_fts)
 
     def _emit(self, lchunk, rchunk, li_out, ri_out) -> Chunk:
-        """Shared tail: assemble pairs, apply other-conditions, pad
-        unmatched right rows for right-outer joins."""
+        """Assemble a fully-materialized pair result (MergeJoin path)."""
         out = _assemble_join(lchunk, rchunk, li_out, ri_out, self.out_fts)
         if self.other_conds:
             out, li_out, ri_out = self._apply_other(out, lchunk, rchunk, li_out, ri_out)
@@ -1448,9 +1643,8 @@ class HashJoinExec(Executor):
             for j in ri_out:
                 if j >= 0:
                     matched_right[j] = True
-            extra_r = [j for j in range(rchunk.num_rows) if not matched_right[j]]
-            if extra_r:
-                pad = _assemble_join(lchunk, rchunk, [-1] * len(extra_r), extra_r, self.out_fts)
+            pad = self._right_pad(lchunk, rchunk, matched_right)
+            if pad is not None:
                 out = out.concat(pad)
         return out
 
@@ -1553,6 +1747,11 @@ class HashJoinExec(Executor):
         return out2, li2, ri2
 
     def close(self):
+        if self._part_iter is not None and hasattr(self._part_iter, "close"):
+            # unwinds _grace's finally so spill files delete deterministically
+            # even when a Limit stops pulling early
+            self._part_iter.close()
+            self._part_iter = None
         self.left.close()
         self.right.close()
 
@@ -1828,6 +2027,11 @@ def _assemble_join(lchunk: Chunk, rchunk: Chunk, li: list[int], ri: list[int], o
 
     def gather(chunk: Chunk, idx_arr, col: int):
         c = chunk.columns[col]
+        if c.data.shape[0] == 0:
+            # all-padding side (e.g. right-outer pad with no probe rows)
+            data = (np.full(n, None, dtype=object) if c.data.dtype == object
+                    else np.zeros(n, dtype=c.data.dtype))
+            return data, np.zeros(n, dtype=bool)
         safe = np.where(idx_arr >= 0, idx_arr, 0)
         data = c.data[safe]
         valid = c.valid[safe] & (idx_arr >= 0)

@@ -321,6 +321,11 @@ def parse_exec_args(data: bytes, n_params: int, long_data: dict | None = None,
     pos += nb_len
     new_params_bound = data[pos]; pos += 1  # noqa: E702
     types = prev_types
+    if not new_params_bound and types is None and any(
+        not (null_bitmap[i // 8] & (1 << (i % 8))) for i in range(n_params)
+    ):
+        # MySQL rejects this: value bytes are unparseable without types
+        raise ValueError("parameter types were never bound for this statement")
     if new_params_bound:
         types = []
         for _ in range(n_params):
@@ -360,7 +365,9 @@ def parse_exec_args(data: bytes, n_params: int, long_data: dict | None = None,
             n, pos = read_lenc_int(data, pos)
             raw = data[pos : pos + n]
             pos += n
-            values.append(raw.decode("utf8", "replace") if t != 0xFC else bytes(raw))
+            # blob family stays bytes — lossy utf8 decode would corrupt
+            # binary payloads (TINY/MEDIUM/LONG_BLOB/BLOB = 0xF9-0xFC)
+            values.append(bytes(raw) if 0xF9 <= t <= 0xFC else raw.decode("utf8", "replace"))
     return values, types
 
 

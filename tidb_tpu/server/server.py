@@ -36,8 +36,10 @@ def _py_to_constant(v):
         return Constant(Datum.i(v), ft_longlong())
     if isinstance(v, float):
         return Constant(Datum.f(v), ft_double())
-    if isinstance(v, bytes):
-        return Constant(Datum.s(v.decode("utf8", "replace")), ft_varchar())
+    if isinstance(v, (bytes, bytearray)):
+        from ..mysqltypes.field_type import FieldType, TypeCode
+
+        return Constant(Datum.b(bytes(v)), FieldType(TypeCode.Blob, flen=1 << 16))
     return Constant(Datum.s(str(v)), ft_varchar())
 
 
