@@ -159,3 +159,183 @@ class TestWireAuth:
         assert resp[0] == 0xFF
         _, resp = self._connect(server.port, "ghost", "")
         assert resp[0] == 0xFF
+
+
+class TestTablePrivileges:
+    """Table-level grants via mysql.tables_priv (ref: privilege cache
+    tablesPriv, executor/grant.go table scope)."""
+
+    def test_table_grant_scopes_to_one_table(self, s):
+        s.execute("CREATE TABLE t2 (id INT PRIMARY KEY)")
+        s.execute("INSERT INTO t2 VALUES (5)")
+        s.execute("CREATE USER tab")
+        s.execute("GRANT SELECT ON test.t TO tab")
+        u = _as_user(s, "tab")
+        assert u.must_query("SELECT v FROM t") == [("10",)]
+        with pytest.raises(PrivilegeError):
+            u.execute("SELECT * FROM t2")
+        with pytest.raises(PrivilegeError):
+            u.execute("INSERT INTO t VALUES (9, 9)")
+
+    def test_table_grant_revoke(self, s):
+        s.execute("CREATE USER tr")
+        s.execute("GRANT SELECT, INSERT ON test.t TO tr")
+        u = _as_user(s, "tr")
+        u.execute("INSERT INTO t VALUES (3, 30)")
+        s.execute("REVOKE INSERT ON test.t FROM tr")
+        with pytest.raises(PrivilegeError):
+            u.execute("INSERT INTO t VALUES (4, 40)")
+        assert u.must_query("SELECT COUNT(*) FROM t") == [("2",)]
+
+    def test_show_grants_lists_table_level(self, s):
+        s.execute("CREATE USER sg")
+        s.execute("GRANT SELECT ON test.t TO sg")
+        rows = s.must_query("SHOW GRANTS FOR sg")
+        assert any("`test`.`t`" in r[0] for r in rows)
+
+    def test_grant_on_missing_table_rejected(self, s):
+        s.execute("CREATE USER mt")
+        with pytest.raises(Exception):
+            s.execute("GRANT SELECT ON test.nosuch TO mt")
+
+
+class TestDynamicPrivileges:
+    """Dynamic privileges in mysql.global_grants with SUPER fallback
+    (ref: privileges.go RequestDynamicVerification)."""
+
+    def test_backup_requires_backup_admin(self, s, tmp_path):
+        s.execute("CREATE USER op")
+        s.execute("GRANT SELECT ON test.* TO op")
+        u = _as_user(s, "op")
+        with pytest.raises(PrivilegeError):
+            u.execute(f"BACKUP DATABASE test TO '{tmp_path}/b1'")
+        s.execute("GRANT BACKUP_ADMIN ON *.* TO op")
+        u.execute(f"BACKUP DATABASE test TO '{tmp_path}/b1'")
+
+    def test_dynamic_requires_star_star(self, s):
+        s.execute("CREATE USER d2")
+        with pytest.raises(Exception):
+            s.execute("GRANT BACKUP_ADMIN ON test.* TO d2")
+
+    def test_set_global_requires_sysvar_admin(self, s):
+        s.execute("CREATE USER sv")
+        s.execute("GRANT SELECT ON test.* TO sv")
+        u = _as_user(s, "sv")
+        with pytest.raises(PrivilegeError):
+            u.execute("SET GLOBAL tidb_cop_engine = 'host'")
+        s.execute("GRANT SYSTEM_VARIABLES_ADMIN ON *.* TO sv")
+        u.execute("SET GLOBAL tidb_cop_engine = 'host'")
+
+    def test_super_falls_back(self, s):
+        s.execute("CREATE USER su")
+        s.execute("GRANT SUPER ON *.* TO su")
+        u = _as_user(s, "su")
+        u.execute("SET GLOBAL tidb_cop_engine = 'auto'")
+
+    def test_show_grants_lists_dynamic(self, s):
+        s.execute("CREATE USER dg")
+        s.execute("GRANT CONNECTION_ADMIN ON *.* TO dg")
+        rows = s.must_query("SHOW GRANTS FOR dg")
+        assert any("CONNECTION_ADMIN" in r[0] for r in rows)
+
+
+class TestLockTables:
+    """LOCK TABLES READ/WRITE bookkeeping (ref: lock/lock.go)."""
+
+    def test_read_lock_blocks_all_writes(self, s):
+        s.execute("LOCK TABLES t READ")
+        from tidb_tpu.storage.tablelock import TableLockError
+        with pytest.raises(TableLockError):
+            s.execute("INSERT INTO t VALUES (7, 70)")  # own READ lock
+        other = Session(s.store)
+        with pytest.raises(TableLockError):
+            other.execute("INSERT INTO t VALUES (7, 70)")
+        assert s.must_query("SELECT v FROM t") == [("10",)]  # reads fine
+        s.execute("UNLOCK TABLES")
+        s.execute("INSERT INTO t VALUES (7, 70)")
+
+    def test_write_lock_excludes_others(self, s):
+        from tidb_tpu.storage.tablelock import TableLockError
+        s.execute("LOCK TABLES t WRITE")
+        s.execute("INSERT INTO t VALUES (8, 80)")  # owner writes fine
+        other = Session(s.store)
+        with pytest.raises(TableLockError):
+            other.execute("SELECT * FROM t")
+        with pytest.raises(TableLockError):
+            other.execute("DELETE FROM t")
+        with pytest.raises(TableLockError):
+            other.execute("LOCK TABLES t READ")
+        s.execute("UNLOCK TABLES")
+        assert other.must_query("SELECT COUNT(*) FROM t") == [("2",)]
+
+    def test_unlocked_table_inaccessible_while_holding(self, s):
+        from tidb_tpu.storage.tablelock import TableLockError
+        s.execute("CREATE TABLE t3 (id INT PRIMARY KEY)")
+        s.execute("LOCK TABLES t READ")
+        with pytest.raises(TableLockError):
+            s.execute("SELECT * FROM t3")
+        s.execute("UNLOCK TABLES")
+
+    def test_shared_read_locks(self, s):
+        s.execute("LOCK TABLES t READ")
+        other = Session(s.store)
+        other.execute("LOCK TABLES t READ")  # shared
+        assert other.must_query("SELECT COUNT(*) FROM t") == [("1",)]
+        s.execute("UNLOCK TABLES")
+        other.execute("UNLOCK TABLES")
+
+    def test_new_lock_releases_previous(self, s):
+        s.execute("CREATE TABLE t4 (id INT PRIMARY KEY)")
+        s.execute("LOCK TABLES t WRITE")
+        s.execute("LOCK TABLES t4 WRITE")  # implicit release of t
+        other = Session(s.store)
+        assert other.must_query("SELECT COUNT(*) FROM t") == [("1",)]
+
+
+class TestPrivilegeReviewFixes:
+    def test_cte_name_does_not_shadow_sibling_table(self, s):
+        """A CTE name in one scope must not suppress checks on a real
+        same-named table elsewhere in the statement."""
+        s.execute("CREATE TABLE c (id INT PRIMARY KEY)")
+        s.execute("INSERT INTO c VALUES (1)")
+        s.execute("CREATE USER cteu")
+        u = _as_user(s, "cteu")
+        with pytest.raises(PrivilegeError):
+            u.execute("SELECT * FROM (WITH c AS (SELECT 1 AS x) SELECT * FROM c) d JOIN c ON 1=1")
+
+    def test_grant_lock_tables_parses_and_works(self, s):
+        s.execute("CREATE USER locker")
+        s.execute("GRANT SELECT, LOCK TABLES ON test.* TO locker")
+        u = _as_user(s, "locker")
+        u.execute("LOCK TABLES t READ")
+        u.execute("UNLOCK TABLES")
+        s.execute("CREATE USER nolock")
+        s.execute("GRANT SELECT ON test.* TO nolock")
+        v = _as_user(s, "nolock")
+        with pytest.raises(PrivilegeError):
+            v.execute("LOCK TABLES t READ")
+
+    def test_multi_update_needs_select_only_on_read_table(self, s):
+        s.execute("CREATE TABLE w1 (id INT PRIMARY KEY, x INT)")
+        s.execute("CREATE TABLE r1 (id INT PRIMARY KEY, y INT)")
+        s.execute("INSERT INTO w1 VALUES (1, 0)")
+        s.execute("INSERT INTO r1 VALUES (1, 5)")
+        s.execute("CREATE USER mu")
+        s.execute("GRANT UPDATE ON test.w1 TO mu")
+        s.execute("GRANT SELECT ON test.w1 TO mu")
+        s.execute("GRANT SELECT ON test.r1 TO mu")
+        u = _as_user(s, "mu")
+        u.execute("UPDATE w1 JOIN r1 ON w1.id = r1.id SET w1.x = r1.y")
+        assert s.must_query("SELECT x FROM w1") == [("5",)]
+        # but updating r1 needs UPDATE on it
+        with pytest.raises(PrivilegeError):
+            u.execute("UPDATE w1 JOIN r1 ON w1.id = r1.id SET r1.y = 0")
+
+    def test_revoke_after_drop_table(self, s):
+        s.execute("CREATE TABLE gone (id INT PRIMARY KEY)")
+        s.execute("CREATE USER rd")
+        s.execute("GRANT SELECT ON test.gone TO rd")
+        s.execute("DROP TABLE gone")
+        s.execute("REVOKE SELECT ON test.gone FROM rd")
+        rows = s.must_query("SHOW GRANTS FOR rd")
+        assert not any("gone" in r[0] for r in rows)

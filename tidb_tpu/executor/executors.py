@@ -1537,13 +1537,28 @@ class HashJoinExec(Executor):
 
         lfts = self.left.out_fts
         rfts = self.right.out_fts
-        rcs = list(rsf.chunks(rfts))
-        if sum(chunk_bytes(c) for c in rcs) > self.spill_limit and depth < self.MAX_SPILL_DEPTH:
+        # stream the build partition, keeping at most quota bytes in
+        # memory before deciding to re-partition (never materialize a
+        # whole oversized partition just to measure it)
+        rit = rsf.chunks(rfts)
+        rcs, rbytes, oversize = [], 0, False
+        for c in rit:
+            rcs.append(c)
+            rbytes += chunk_bytes(c)
+            if rbytes > self.spill_limit and depth < self.MAX_SPILL_DEPTH:
+                oversize = True
+                break
+        if oversize:
             nl = len(lfts)
             rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
             lkeys = [l for l, _ in self.eq_conds]
+
+            def build_rest():
+                yield from rcs
+                yield from rit
+
             sub_r = new_parts()
-            self._spill_side(iter(rcs), rkeys, sub_r, salt=depth)
+            self._spill_side(build_rest(), rkeys, sub_r, salt=depth)
             del rcs
             sub_l = new_parts()
             self._spill_side(lsf.chunks(lfts), lkeys, sub_l, salt=depth)

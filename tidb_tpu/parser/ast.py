@@ -369,6 +369,16 @@ class AdminStmt:
 
 
 @dataclass
+class LockTables:
+    tables: list  # [(TableName, 'READ'|'WRITE')]
+
+
+@dataclass
+class UnlockTables:
+    pass
+
+
+@dataclass
 class KillStmt:
     conn_id: int
     query_only: bool = False

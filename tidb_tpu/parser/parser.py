@@ -206,6 +206,8 @@ class Parser:
             "RESTORE": self.brie_stmt,
             "GRANT": self.grant_stmt,
             "REVOKE": self.grant_stmt,
+            "LOCK": self.lock_stmt,
+            "UNLOCK": self.unlock_stmt,
         }.get(kw)
         if fn is None:
             self.fail(f"unsupported statement {kw}")
@@ -911,6 +913,31 @@ class Parser:
             node.limit, _ = self.limit_clause()
         return node
 
+    def lock_stmt(self):
+        """LOCK TABLES t [AS alias] READ|WRITE [, ...] (ref: lock/lock.go)."""
+        self.expect_kw("LOCK")
+        self.expect_kw("TABLES") if self.at_kw("TABLES") else self.expect_kw("TABLE")
+        items = []
+        while True:
+            tn = self._table_name()
+            if self.try_kw("AS"):
+                tn.alias = self.ident()
+            if self.try_kw("READ"):
+                mode = "READ"
+            elif self.try_kw("WRITE"):
+                mode = "WRITE"
+            else:
+                self.fail("expected READ or WRITE")
+            items.append((tn, mode))
+            if not self.try_op(","):
+                break
+        return ast.LockTables(items)
+
+    def unlock_stmt(self):
+        self.expect_kw("UNLOCK")
+        self.expect_kw("TABLES") if self.at_kw("TABLES") else self.expect_kw("TABLE")
+        return ast.UnlockTables()
+
     def _delete_target(self) -> str:
         """One DELETE target: name or name.* (qualifier form)."""
         name = self.ident()
@@ -954,7 +981,10 @@ class Parser:
             privs = ["ALL"]
         else:
             while True:
-                privs.append(self.ident().upper())
+                p = self.ident().upper()
+                if p == "LOCK" and self.try_kw("TABLES"):
+                    p = "LOCK TABLES"
+                privs.append(p)
                 if not self.try_op(","):
                     break
         self.expect_kw("ON")

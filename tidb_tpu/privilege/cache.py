@@ -14,7 +14,15 @@ from ..errors import TiDBError
 
 PRIVS = {
     "SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
-    "ALTER", "INDEX", "PROCESS", "SUPER",
+    "ALTER", "INDEX", "PROCESS", "SUPER", "LOCK TABLES",
+}
+
+# dynamic privileges (ref: privilege/privileges/cache.go:120 dynamic
+# privs + privileges.go RequestDynamicVerification: grantable on *.*
+# only, SUPER acts as the legacy fallback for each)
+DYNAMIC_PRIVS = {
+    "BACKUP_ADMIN", "RESTORE_ADMIN", "SYSTEM_VARIABLES_ADMIN",
+    "CONNECTION_ADMIN", "ROLE_ADMIN", "BINDING_ADMIN", "DASHBOARD_CLIENT",
 }
 
 class PrivilegeError(TiDBError):
@@ -54,6 +62,8 @@ class PrivilegeCache:
         self._sys_session = None
         self._users: dict[str, dict] = {}  # user → {auth, global: set}
         self._db_privs: dict[tuple[str, str], set] = {}  # (user, db) → privs
+        self._tbl_privs: dict[tuple[str, str, str], set] = {}  # (user, db, tbl) → privs
+        self._dyn_privs: dict[str, set] = {}  # user → dynamic privs
 
     def bump_version(self) -> None:
         with self._lock:
@@ -88,8 +98,21 @@ class PrivilegeCache:
             ):
                 pset = set() if not privs else set(privs.split(","))
                 db_privs[((user or "").lower(), (db or "").lower())] = pset
+            tbl_privs: dict[tuple[str, str, str], set] = {}
+            for host, user, db, tbl, privs in sess._sql_internal(
+                "SELECT host, user, db, table_name, privs FROM mysql.tables_priv"
+            ):
+                pset = set() if not privs else set(privs.split(","))
+                tbl_privs[((user or "").lower(), (db or "").lower(), (tbl or "").lower())] = pset
+            dyn: dict[str, set] = {}
+            for user, priv in sess._sql_internal(
+                "SELECT user, priv FROM mysql.global_grants"
+            ):
+                dyn.setdefault((user or "").lower(), set()).add(priv)
             self._users = users
             self._db_privs = db_privs
+            self._tbl_privs = tbl_privs
+            self._dyn_privs = dyn
             self._version = v
 
     # --- checks ------------------------------------------------------------
@@ -105,7 +128,9 @@ class PrivilegeCache:
             return False
         return verify_native_password(rec["auth"], salt, scramble)
 
-    def check(self, session, user: str, db: str, priv: str) -> bool:
+    def check(self, session, user: str, db: str, priv: str, table: str | None = None) -> bool:
+        """Global → db → table level, most general wins (ref:
+        privileges.go RequestVerification)."""
         self._ensure(session)
         rec = self._users.get(user.lower())
         if rec is None:
@@ -114,12 +139,36 @@ class PrivilegeCache:
         if "ALL" in g or priv in g:
             return True
         d = self._db_privs.get((user.lower(), db.lower()), set())
-        return "ALL" in d or priv in d
+        if "ALL" in d or priv in d:
+            return True
+        if table:
+            t = self._tbl_privs.get((user.lower(), db.lower(), table.lower()), set())
+            return "ALL" in t or priv in t
+        return False
 
-    def require(self, session, user: str, db: str, priv: str) -> None:
-        if not self.check(session, user, db, priv):
+    def require(self, session, user: str, db: str, priv: str, table: str | None = None) -> None:
+        if not self.check(session, user, db, priv, table):
             raise PrivilegeError(
                 f"{priv} command denied to user '{user}'@'%' for database '{db}'"
+            )
+
+    def check_dynamic(self, session, user: str, priv: str) -> bool:
+        """Dynamic privilege, with SUPER as the legacy fallback (ref:
+        privileges.go RequestDynamicVerification grantableAtGlobalLevel)."""
+        self._ensure(session)
+        rec = self._users.get(user.lower())
+        if rec is None:
+            return False
+        if priv in self._dyn_privs.get(user.lower(), set()):
+            return True
+        g = rec["global"]
+        return "ALL" in g or "SUPER" in g
+
+    def require_dynamic(self, session, user: str, priv: str) -> None:
+        if not self.check_dynamic(session, user, priv):
+            raise PrivilegeError(
+                f"Access denied; you need (at least one of) the {priv} or SUPER "
+                f"privilege(s) for this operation"
             )
 
     def grants_for(self, session, user: str) -> list[str]:
@@ -138,4 +187,11 @@ class PrivilegeCache:
             if u == user.lower() and privs:
                 ps = "ALL PRIVILEGES" if "ALL" in privs else ", ".join(sorted(privs))
                 out.append(f"GRANT {ps} ON `{db}`.* TO '{user}'@'%'")
+        for (u, db, tbl), privs in sorted(self._tbl_privs.items()):
+            if u == user.lower() and privs:
+                ps = "ALL PRIVILEGES" if "ALL" in privs else ", ".join(sorted(privs))
+                out.append(f"GRANT {ps} ON `{db}`.`{tbl}` TO '{user}'@'%'")
+        dyn = self._dyn_privs.get(user.lower(), set())
+        if dyn:
+            out.append(f"GRANT {', '.join(sorted(dyn))} ON *.* TO '{user}'@'%'")
         return out

@@ -97,6 +97,10 @@ class ClientConn:
         except Exception:  # noqa: BLE001 — connection thread must not leak exceptions
             log.exception("connection %d aborted", self.conn_id)
         finally:
+            try:
+                self.session.release_table_locks()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
             self.server.deregister(self.conn_id)
             try:
                 self.pkt.sock.close()

@@ -153,6 +153,20 @@ class Session:
                 )
             finally:
                 self._in_bootstrap = False
+        try:
+            self.infoschema().table("mysql", "tables_priv")
+        except UnknownTable:
+            self._in_bootstrap = True
+            try:
+                self.execute(
+                    "CREATE TABLE mysql.tables_priv (host VARCHAR(64), user VARCHAR(32), "
+                    "db VARCHAR(64), table_name VARCHAR(64), privs VARCHAR(512))"
+                )
+                self.execute(
+                    "CREATE TABLE mysql.global_grants (user VARCHAR(32), priv VARCHAR(64))"
+                )
+            finally:
+                self._in_bootstrap = False
 
     def _sql_internal(self, sql: str) -> list[tuple]:
         """Run SQL as the internal superuser (privilege checks suspended —
@@ -298,6 +312,70 @@ class Session:
     # --------------------------------------------------------- privileges
 
     @property
+    def tlocks(self):
+        if getattr(self.store, "_table_locks", None) is None:
+            from ..storage.tablelock import TableLocks
+
+            self.store._table_locks = TableLocks()
+        return self.store._table_locks
+
+    def _run_lock_tables(self, stmt: ast.LockTables) -> ResultSet:
+        """LOCK TABLES implicitly commits and replaces any held locks
+        (ref: lock/lock.go + MySQL LOCK TABLES semantics)."""
+        self._implicit_commit()
+        items = []
+        for tn, mode in stmt.tables:
+            info = self.infoschema().table(tn.db or self.current_db, tn.name)
+            self.priv.require(self, self.user, (tn.db or self.current_db).lower(),
+                              "LOCK TABLES", tn.name.lower())
+            items.append((info.id, info.name, mode))
+        self.tlocks.release_all(self.conn_id)
+        self._locked_ids = {}
+        self.tlocks.acquire(self.conn_id, items)
+        self._locked_ids = {tid: mode for tid, _, mode in items}
+        return ResultSet([], None)
+
+    def _run_unlock_tables(self) -> ResultSet:
+        self._implicit_commit()
+        self.tlocks.release_all(self.conn_id)
+        self._locked_ids = {}
+        return ResultSet([], None)
+
+    def release_table_locks(self) -> None:
+        """Connection teardown hook (server deregister)."""
+        if getattr(self, "_locked_ids", None):
+            self.tlocks.release_all(self.conn_id)
+            self._locked_ids = {}
+
+    def _tlock_read(self, info) -> None:
+        if getattr(self, "_locked_ids", None) and info.db_name.lower() != "mysql":
+            if info.id not in self._locked_ids:
+                from ..storage.tablelock import TableLockError
+
+                raise TableLockError(
+                    f"Table '{info.name}' was not locked with LOCK TABLES"
+                )
+        self.tlocks.check_read(info.id, info.name, self.conn_id)
+
+    def _tlock_write(self, info) -> None:
+        if getattr(self, "_locked_ids", None) and info.db_name.lower() != "mysql":
+            if info.id not in self._locked_ids:
+                from ..storage.tablelock import TableLockError
+
+                raise TableLockError(
+                    f"Table '{info.name}' was not locked with LOCK TABLES"
+                )
+        self.tlocks.check_write(info.id, info.name, self.conn_id)
+
+    def _check_plan_locks(self, plan) -> None:
+        """Reads under LOCK TABLES: every base-table DataSource in the
+        plan must be readable by this connection."""
+        if isinstance(plan, DataSource):
+            self._tlock_read(plan.table)
+        for c in plan.children:
+            self._check_plan_locks(c)
+
+    @property
     def priv(self):
         if getattr(self.store, "_priv_cache", None) is None:
             from ..privilege import PrivilegeCache
@@ -305,50 +383,61 @@ class Session:
             self.store._priv_cache = PrivilegeCache(self.store)
         return self.store._priv_cache
 
-    def _stmt_privileges(self, stmt) -> list[tuple[str, str]]:
-        """→ [(priv, db)] required by this statement (ref: the reference's
-        visitInfo collection in planbuilder.go)."""
+    def _stmt_privileges(self, stmt) -> list[tuple]:
+        """→ [(priv, db[, table])] required by this statement (ref: the
+        reference's visitInfo collection in planbuilder.go); the table
+        element enables tables_priv-level grants."""
 
-        def from_dbs(node, out):
+        def from_dbs(node, out, ctes=frozenset()):
             if isinstance(node, ast.TableName):
-                out.add((node.db or self.current_db).lower())
+                if node.db is None and node.name.lower() in ctes:
+                    return  # CTE reference in this scope, not a base table
+                out.add(((node.db or self.current_db).lower(), node.name.lower()))
             elif isinstance(node, ast.Join):
-                from_dbs(node.left, out)
-                from_dbs(node.right, out)
+                from_dbs(node.left, out, ctes)
+                from_dbs(node.right, out, ctes)
             elif isinstance(node, ast.SubqueryTable):
-                sel_dbs(node.select, out)
+                sel_dbs(node.select, out, ctes)
 
-        def expr_dbs(e, out):
+        def expr_dbs(e, out, ctes=frozenset()):
             if isinstance(e, ast.SubqueryExpr):
-                sel_dbs(e.select, out)
+                sel_dbs(e.select, out, ctes)
             elif isinstance(e, ast.Call):
                 for a in e.args:
-                    expr_dbs(a, out)
+                    expr_dbs(a, out, ctes)
             elif isinstance(e, ast.CaseWhen):
                 for pair in e.whens:
-                    expr_dbs(pair[0], out)
-                    expr_dbs(pair[1], out)
+                    expr_dbs(pair[0], out, ctes)
+                    expr_dbs(pair[1], out, ctes)
                 if e.operand is not None:
-                    expr_dbs(e.operand, out)
+                    expr_dbs(e.operand, out, ctes)
                 if e.else_ is not None:
-                    expr_dbs(e.else_, out)
+                    expr_dbs(e.else_, out, ctes)
             elif isinstance(e, ast.Cast):
-                expr_dbs(e.expr, out)
+                expr_dbs(e.expr, out, ctes)
 
-        def sel_dbs(sel, out):
+        def sel_dbs(sel, out, ctes=frozenset()):
+            # `ctes` is scoped: names bind in THIS select and below, never
+            # in sibling or enclosing scopes (a leaked name would suppress
+            # privilege checks on a same-named real table)
             if isinstance(sel, ast.SetOpSelect):
                 for s in sel.selects:
-                    sel_dbs(s, out)
+                    sel_dbs(s, out, ctes)
                 return
             wf = getattr(sel, "with_", None)
             if wf is not None:
+                inner = set(ctes)
                 for cte in wf.ctes:
-                    sel_dbs(cte.select, out)
+                    # WITH RECURSIVE: the name binds inside its own body
+                    body = inner | {cte.name.lower()} if wf.recursive else inner
+                    sel_dbs(cte.select, out, frozenset(body))
+                    inner.add(cte.name.lower())
+                ctes = frozenset(inner)
             if sel.from_ is not None:
-                from_dbs(sel.from_, out)
+                from_dbs(sel.from_, out, ctes)
             for e in [sel.where, sel.having] + [f.expr for f in sel.fields if not isinstance(f, ast.Star)]:
                 if e is not None:
-                    expr_dbs(e, out)
+                    expr_dbs(e, out, ctes)
 
         def order_group_dbs(sel, out):
             if isinstance(sel, ast.SetOpSelect):
@@ -361,13 +450,13 @@ class Session:
                 expr_dbs(g, out)
 
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
-            dbs: set[str] = set()
+            dbs: set = set()
             sel_dbs(stmt, dbs)
             order_group_dbs(stmt, dbs)
-            return [("SELECT", d) for d in dbs]
+            return [("SELECT", d, t) for d, t in dbs]
         if isinstance(stmt, ast.Insert):
-            out = [("INSERT", (stmt.table.db or self.current_db).lower())]
-            dbs: set[str] = set()
+            out = [("INSERT", (stmt.table.db or self.current_db).lower(), stmt.table.name.lower())]
+            dbs: set = set()
             if stmt.select is not None:  # INSERT ... SELECT reads too
                 sel_dbs(stmt.select, dbs)
             for row in stmt.values:
@@ -376,24 +465,56 @@ class Session:
                         expr_dbs(v, dbs)
             for _, e in stmt.on_dup:
                 expr_dbs(e, dbs)
-            out += [("SELECT", d) for d in dbs]
+            out += [("SELECT", d, t) for d, t in dbs]
             return out
         if isinstance(stmt, ast.LoadData):
-            return [("INSERT", (stmt.table.db or self.current_db).lower())]
+            return [("INSERT", (stmt.table.db or self.current_db).lower(), stmt.table.name.lower())]
         if isinstance(stmt, ast.Update):
-            db = (stmt.table.db or self.current_db).lower() if isinstance(stmt.table, ast.TableName) else self.current_db
-            dbs: set[str] = set()
+            dbs: set = set()
             if stmt.where is not None:
                 expr_dbs(stmt.where, dbs)
             for _, e in stmt.sets:
                 expr_dbs(e, dbs)
-            return [("UPDATE", db)] + [("SELECT", d) for d in dbs]
+            reads = [("SELECT", d, t) for d, t in dbs]
+            if isinstance(stmt.table, ast.TableName):
+                db = (stmt.table.db or self.current_db).lower()
+                return [("UPDATE", db, stmt.table.name.lower())] + reads
+            # multi-table: UPDATE only on assigned tables, SELECT on the
+            # rest (MySQL resolution; an unqualified SET column can't be
+            # attributed without the schema → UPDATE everywhere, safe side)
+            alias_map: dict[str, tuple[str, str]] = {}
+
+            def collect_aliases(n):
+                if isinstance(n, ast.Join):
+                    collect_aliases(n.left)
+                    collect_aliases(n.right)
+                elif isinstance(n, ast.TableName):
+                    alias_map[(n.alias or n.name).lower()] = (
+                        (n.db or self.current_db).lower(), n.name.lower())
+
+            collect_aliases(stmt.table)
+            set_aliases = {name.table.lower() for name, _ in stmt.sets if name.table}
+            bare = any(name.table is None for name, _ in stmt.sets)
+            out = []
+            for alias, (d, t) in alias_map.items():
+                writes = bare or alias in set_aliases
+                out.append(("UPDATE" if writes else "SELECT", d, t))
+            return out + reads
         if isinstance(stmt, ast.Delete):
-            db = (stmt.table.db or self.current_db).lower() if isinstance(stmt.table, ast.TableName) else self.current_db
-            dbs: set[str] = set()
+            dbs: set = set()
             if stmt.where is not None:
                 expr_dbs(stmt.where, dbs)
-            return [("DELETE", db)] + [("SELECT", d) for d in dbs]
+            reads = [("SELECT", d, t) for d, t in dbs]
+            if isinstance(stmt.table, ast.TableName) and stmt.targets is None:
+                db = (stmt.table.db or self.current_db).lower()
+                return [("DELETE", db, stmt.table.name.lower())] + reads
+            refs: set = set()
+            from_dbs(stmt.table, refs)
+            targets = {t.lower() for t in (stmt.targets or ())}
+            out = []
+            for d, t in refs:
+                out.append(("DELETE" if t in targets else "SELECT", d, t))
+            return out + reads
         if isinstance(stmt, (ast.CreateTable, ast.CreateDatabase)):
             db = getattr(getattr(stmt, "table", None), "db", None) or getattr(stmt, "name", None) or self.current_db
             return [("CREATE", db.lower())]
@@ -409,8 +530,15 @@ class Session:
             return [("DROP", (stmt.table.db or self.current_db).lower())]
         if isinstance(stmt, ast.AlterTable):
             return [("ALTER", (stmt.table.db or self.current_db).lower())]
+        if isinstance(stmt, ast.BRIEStmt):
+            # BACKUP/RESTORE gate on their dynamic privileges (ref:
+            # planbuilder.go visitInfo for BRIE + SUPER fallback)
+            kind = getattr(stmt, "kind", "backup").lower()
+            return [("RESTORE_ADMIN" if kind == "restore" else "BACKUP_ADMIN", "*")]
+        if isinstance(stmt, ast.KillStmt):
+            return [("CONNECTION_ADMIN", "*")]
         if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.Grant, ast.Revoke,
-                             ast.BRIEStmt, ast.AdminStmt, ast.KillStmt)):
+                             ast.AdminStmt)):
             return [("SUPER", "*")]
         if isinstance(stmt, (ast.CreateBinding, ast.DropBinding)):
             # global bindings steer every session's plans; session-scoped
@@ -421,10 +549,16 @@ class Session:
     def _check_privileges(self, stmt) -> None:
         if self._in_bootstrap:
             return
-        for priv, db in self._stmt_privileges(stmt):
+        for entry in self._stmt_privileges(stmt):
+            priv, db = entry[0], entry[1]
+            table = entry[2] if len(entry) > 2 else None
             if db in ("information_schema", "performance_schema"):
                 continue
-            self.priv.require(self, self.user, db, priv)
+            if priv in ("BACKUP_ADMIN", "RESTORE_ADMIN", "CONNECTION_ADMIN",
+                        "SYSTEM_VARIABLES_ADMIN"):
+                self.priv.require_dynamic(self, self.user, priv)
+                continue
+            self.priv.require(self, self.user, db, priv, table)
 
     def _execute_stmt(self, stmt, sql: str | None = None) -> ResultSet:
         self._check_privileges(stmt)
@@ -484,8 +618,14 @@ class Session:
                 if name.startswith("@") and not name.startswith("@@"):
                     self.user_vars[name.lower()] = c  # typed, for EXECUTE USING
                 else:
+                    if scope == "global" and not self._in_bootstrap:
+                        self.priv.require_dynamic(self, self.user, "SYSTEM_VARIABLES_ADMIN")
                     self.vars[name] = c.value.render(c.ret_type)
             return ResultSet([], None)
+        if isinstance(stmt, ast.LockTables):
+            return self._run_lock_tables(stmt)
+        if isinstance(stmt, ast.UnlockTables):
+            return self._run_unlock_tables()
         if isinstance(stmt, ast.Prepare):
             return self._run_prepare(stmt)
         if isinstance(stmt, ast.Execute):
@@ -583,18 +723,35 @@ class Session:
         return ResultSet([], None)
 
     def _run_grant_revoke(self, stmt) -> ResultSet:
-        from ..privilege.cache import PRIVS, PrivilegeError
+        from ..privilege.cache import DYNAMIC_PRIVS, PRIVS, PrivilegeError
 
         self._implicit_commit()
         grant = isinstance(stmt, ast.Grant)
         privs = set(p.upper() for p in stmt.privs)
+        dynamic = privs & DYNAMIC_PRIVS
+        privs -= dynamic
         unknown = privs - PRIVS - {"ALL"}
         if unknown:
             raise TiDBError(f"unknown privilege(s): {', '.join(sorted(unknown))}")
+        if dynamic and (stmt.db != "*" or stmt.table != "*"):
+            raise TiDBError("Illegal privilege level specified for dynamic privilege (use *.*)")
         for spec in stmt.users:
             if not self.priv.user_exists(self, spec.user):
                 raise PrivilegeError(f"there is no such user '{spec.user}'")
             u = self._q(spec.user)
+            for dp in sorted(dynamic):
+                self._sql_internal(
+                    f"DELETE FROM mysql.global_grants WHERE user = '{u}' AND priv = '{dp}'"
+                )
+                if grant:
+                    self._sql_internal(
+                        f"INSERT INTO mysql.global_grants VALUES ('{u}', '{dp}')"
+                    )
+            if not privs:
+                continue
+            if stmt.db != "*" and stmt.table != "*":
+                self._grant_revoke_table(stmt, spec, privs, grant)
+                continue
             if stmt.db == "*":
                 rows = self._sql_internal(f"SELECT privs FROM mysql.user WHERE user = '{u}'")
                 cur = set((rows[0][0] or "").split(",")) - {""}
@@ -625,6 +782,40 @@ class Session:
                     )
         self.priv.bump_version()
         return ResultSet([], None)
+
+    def _grant_revoke_table(self, stmt, spec, privs: set, grant: bool) -> None:
+        """Table-level grant bookkeeping in mysql.tables_priv (ref:
+        privilege cache tablesPriv + executor/grant.go table scope)."""
+        from ..privilege.cache import PrivilegeError
+
+        if grant:
+            # the table must exist on GRANT; REVOKE must still work for
+            # grants whose table was since dropped
+            self.infoschema().table(stmt.db, stmt.table)
+        u = self._q(spec.user)
+        d = self._q(stmt.db)
+        t = self._q(stmt.table)
+        rows = self._sql_internal(
+            f"SELECT privs FROM mysql.tables_priv WHERE user = '{u}' "
+            f"AND db = '{d}' AND table_name = '{t}'"
+        )
+        if not rows and not grant:
+            raise PrivilegeError(
+                f"there is no such grant defined for user '{spec.user}' on "
+                f"'{stmt.db}.{stmt.table}'"
+            )
+        cur = set((rows[0][0] or "").split(",")) - {""} if rows else set()
+        new = self._apply_priv_change(cur, privs, grant)
+        if rows:
+            self._sql_internal(
+                f"UPDATE mysql.tables_priv SET privs = '{','.join(sorted(new))}' "
+                f"WHERE user = '{u}' AND db = '{d}' AND table_name = '{t}'"
+            )
+        else:
+            self._sql_internal(
+                f"INSERT INTO mysql.tables_priv VALUES ('{self._q(spec.host)}', "
+                f"'{u}', '{d}', '{t}', '{','.join(sorted(new))}')"
+            )
 
     @staticmethod
     def _apply_priv_change(cur: set, privs: set, grant: bool) -> set:
@@ -916,6 +1107,9 @@ class Session:
             vars=exec_vars,
             txn=self.txn,
         )
+        tl = getattr(self.store, "_table_locks", None)
+        if (tl is not None and tl._locks) or getattr(self, "_locked_ids", None):
+            self._check_plan_locks(plan)
         ex = build_executor(plan, ctx)
         chunk = drain(ex)
         names = [c.name for c in plan.out_cols]
@@ -1108,6 +1302,7 @@ class Session:
 
     def _run_insert(self, stmt: ast.Insert) -> ResultSet:
         info = self.infoschema().table(stmt.table.db or self.current_db, stmt.table.name)
+        self._tlock_write(info)
         tbl = Table(info)
         txn = self._active_txn()
         visible = info.visible_columns()
@@ -1371,6 +1566,7 @@ class Session:
         """Shared UPDATE/DELETE row collection: full scan + filter via the
         SELECT machinery, returning (table, [(handle, datums)])."""
         info = self.infoschema().table(stmt_table.db or self.current_db, stmt_table.name)
+        self._tlock_write(info)
         tbl = Table(info)
         txn = self._active_txn()
         kvs = []  # (phys_tbl, key, value) across every partition keyspace
@@ -1546,6 +1742,7 @@ class Session:
             raise TiDBError("multi-table UPDATE does not allow ORDER BY or LIMIT")
         order = sorted(sets)
         for a in order:
+            self._tlock_write(infos[a])
             if infos[a].partition is not None:
                 raise TiDBError("multi-table UPDATE on a partitioned table is not supported")
         expose = {a for a in order if infos[a].handle_col().hidden}
@@ -1625,6 +1822,7 @@ class Session:
         if stmt.order_by or stmt.limit is not None:
             raise TiDBError("multi-table DELETE does not allow ORDER BY or LIMIT")
         for a in targets:
+            self._tlock_write(infos[a])
             if infos[a].partition is not None:
                 raise TiDBError("multi-table DELETE on a partitioned table is not supported")
         expose = {a for a in targets if infos[a].handle_col().hidden}

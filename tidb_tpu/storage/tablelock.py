@@ -1,0 +1,92 @@
+"""Table-lock bookkeeping — LOCK TABLES ... READ|WRITE
+(ref: lock/lock.go Checker + table lock state on TableInfo; single
+process, so the registry lives in memory on the Storage and conflicts
+answer immediately with the MySQL error instead of queueing).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..errors import TiDBError
+
+
+class TableLockError(TiDBError):
+    pass
+
+
+class TableLocks:
+    """table_id → (mode, {conn_id}); WRITE holds exactly one owner."""
+
+    def __init__(self):
+        self._locks: dict[int, tuple[str, set[int]]] = {}
+        self._names: dict[int, str] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, conn: int, items: list[tuple[int, str, str]]) -> None:
+        """Atomically take [(table_id, name, READ|WRITE)]; all-or-nothing
+        (MySQL acquires the whole LOCK TABLES list or fails)."""
+        with self._lock:
+            for tid, name, mode in items:
+                cur = self._locks.get(tid)
+                if cur is None:
+                    continue
+                cmode, owners = cur
+                others = owners - {conn}
+                if others and (mode == "WRITE" or cmode == "WRITE"):
+                    raise TableLockError(
+                        f"Table '{name}' was locked in {cmode} by session {min(others)}"
+                    )
+            for tid, name, mode in items:
+                cmode, owners = self._locks.get(tid, (mode, set()))
+                if owners == {conn} or not owners:
+                    self._locks[tid] = (mode, {conn})
+                else:
+                    self._locks[tid] = (cmode, owners | {conn})
+                self._names[tid] = name
+
+    def release_all(self, conn: int) -> None:
+        with self._lock:
+            for tid in list(self._locks):
+                mode, owners = self._locks[tid]
+                owners.discard(conn)
+                if not owners:
+                    del self._locks[tid]
+                    self._names.pop(tid, None)
+
+    def held_by(self, conn: int) -> dict[int, str]:
+        with self._lock:
+            return {tid: m for tid, (m, owners) in self._locks.items() if conn in owners}
+
+    def check_read(self, tid: int, name: str, conn: int) -> None:
+        """Reads fail only against another session's WRITE lock."""
+        with self._lock:
+            cur = self._locks.get(tid)
+            if cur is None:
+                return
+            mode, owners = cur
+            if mode == "WRITE" and conn not in owners:
+                raise TableLockError(
+                    f"Table '{name}' was locked in WRITE by session {min(owners)}"
+                )
+
+    def check_write(self, tid: int, name: str, conn: int) -> None:
+        """Writes fail against any READ lock (even the caller's own) and
+        against another session's WRITE lock."""
+        with self._lock:
+            cur = self._locks.get(tid)
+            if cur is None:
+                return
+            mode, owners = cur
+            if mode == "READ":
+                if conn in owners:
+                    raise TableLockError(
+                        f"Table '{name}' was locked with a READ lock and can't be updated"
+                    )
+                raise TableLockError(
+                    f"Table '{name}' was locked in READ by session {min(owners)}"
+                )
+            if conn not in owners:
+                raise TableLockError(
+                    f"Table '{name}' was locked in WRITE by session {min(owners)}"
+                )
