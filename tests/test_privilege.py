@@ -339,3 +339,18 @@ class TestPrivilegeReviewFixes:
         s.execute("REVOKE SELECT ON test.gone FROM rd")
         rows = s.must_query("SHOW GRANTS FOR rd")
         assert not any("gone" in r[0] for r in rows)
+
+    def test_aliased_delete_target_requires_delete(self, s):
+        s.execute("CREATE USER ad")
+        s.execute("GRANT SELECT ON test.t TO ad")
+        u = _as_user(s, "ad")
+        with pytest.raises(PrivilegeError):
+            u.execute("DELETE a FROM t AS a WHERE a.id = 1")
+        assert s.must_query("SELECT COUNT(*) FROM t") == [("1",)]
+
+    def test_star_dot_table_grant_rejected(self, s):
+        s.execute("CREATE USER sdt")
+        with pytest.raises(Exception):
+            s.execute("GRANT SELECT ON *.t TO sdt")
+        rows = s.must_query("SHOW GRANTS FOR sdt")
+        assert rows == [("GRANT USAGE ON *.* TO 'sdt'@'%'",)]

@@ -609,3 +609,5 @@ def _json_contains(doc, cand, *path):
 
 
 register(_multi_str(_json_contains, infer=lambda fts: ft_longlong(), name="json_contains", arity=(2, 3)))
+
+from . import builtins_ext2  # noqa: E402,F401  (registration side effects)

@@ -681,6 +681,21 @@ class Parser:
     def func_call(self):
         fname = self.ident().lower()
         self.expect_op("(")
+        # unit-keyword first arguments (ref: parser.y TimestampDiff/Extract)
+        if fname in ("timestampdiff", "timestampadd"):
+            unit = self.ident().lower()
+            self.expect_op(",")
+            args = [ast.Lit(unit, "str"), self.expr()]
+            self.expect_op(",")
+            args.append(self.expr())
+            self.expect_op(")")
+            return ast.Call(fname, args)
+        if fname == "extract":
+            unit = self.ident().lower()
+            self.expect_kw("FROM")
+            args = [ast.Lit(unit, "str"), self.expr()]
+            self.expect_op(")")
+            return ast.Call(fname, args)
         distinct = False
         if self.try_kw("DISTINCT"):
             distinct = True

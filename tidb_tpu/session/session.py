@@ -508,12 +508,24 @@ class Session:
             if isinstance(stmt.table, ast.TableName) and stmt.targets is None:
                 db = (stmt.table.db or self.current_db).lower()
                 return [("DELETE", db, stmt.table.name.lower())] + reads
-            refs: set = set()
-            from_dbs(stmt.table, refs)
+            # multi-table: targets name ALIASES, so resolve through the
+            # alias map (comparing base names would let `DELETE a FROM t
+            # AS a` slip through with SELECT only)
+            alias_map: dict[str, tuple[str, str]] = {}
+
+            def collect_aliases(n):
+                if isinstance(n, ast.Join):
+                    collect_aliases(n.left)
+                    collect_aliases(n.right)
+                elif isinstance(n, ast.TableName):
+                    alias_map[(n.alias or n.name).lower()] = (
+                        (n.db or self.current_db).lower(), n.name.lower())
+
+            collect_aliases(stmt.table)
             targets = {t.lower() for t in (stmt.targets or ())}
             out = []
-            for d, t in refs:
-                out.append(("DELETE" if t in targets else "SELECT", d, t))
+            for alias, (d, t) in alias_map.items():
+                out.append(("DELETE" if alias in targets else "SELECT", d, t))
             return out + reads
         if isinstance(stmt, (ast.CreateTable, ast.CreateDatabase)):
             db = getattr(getattr(stmt, "table", None), "db", None) or getattr(stmt, "name", None) or self.current_db
@@ -554,8 +566,9 @@ class Session:
             table = entry[2] if len(entry) > 2 else None
             if db in ("information_schema", "performance_schema"):
                 continue
-            if priv in ("BACKUP_ADMIN", "RESTORE_ADMIN", "CONNECTION_ADMIN",
-                        "SYSTEM_VARIABLES_ADMIN"):
+            from ..privilege.cache import DYNAMIC_PRIVS
+
+            if priv in DYNAMIC_PRIVS:
                 self.priv.require_dynamic(self, self.user, priv)
                 continue
             self.priv.require(self, self.user, db, priv, table)
@@ -735,6 +748,8 @@ class Session:
             raise TiDBError(f"unknown privilege(s): {', '.join(sorted(unknown))}")
         if dynamic and (stmt.db != "*" or stmt.table != "*"):
             raise TiDBError("Illegal privilege level specified for dynamic privilege (use *.*)")
+        if stmt.db == "*" and stmt.table != "*":
+            raise TiDBError("Incorrect use of DB GRANT and table-level privileges (*.<table>)")
         for spec in stmt.users:
             if not self.priv.user_exists(self, spec.user):
                 raise PrivilegeError(f"there is no such user '{spec.user}'")
