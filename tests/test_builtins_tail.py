@@ -137,3 +137,32 @@ class TestReviewFixes:
         s.execute("insert into dtc values (1, '2024-06-30 23:59:59')")
         got = s.execute("select addtime(ts, '00:00:01') from dtc").rows()[0][0]
         assert got == "2024-07-01 00:00:00"
+
+    def test_time_column_duration_lanes(self, s):
+        s.execute("create table tmc (id int primary key, d time)")
+        s.execute("insert into tmc values (1, '01:00:00'), (2, '10:30:00')")
+        assert s.execute("select addtime(d, '00:30:00') from tmc where id = 1").rows()[0][0] == "01:30:00"
+        assert s.execute("select timediff(d, '00:30:00') from tmc where id = 2").rows()[0][0] == "10:00:00"
+
+    def test_to_days_mysql_epoch(self, s):
+        assert s.execute("select to_days('1970-01-01')").rows()[0][0] == "719528"
+        assert s.execute("select from_days(719528)").rows()[0][0] == "1970-01-01"
+        assert s.execute("select to_days('2007-10-07')").rows()[0][0] == "733321"
+
+    def test_make_set_char_skip_nulls(self, s):
+        # MySQL doc example: the NULL occupies bit 2, so only 'hello' emits
+        assert s.execute("select make_set(1 | 4, 'hello', 'nice', null, 'world')").rows()[0][0] == "hello"
+        assert s.execute("select char(77, null, 121)").rows()[0][0] == "My"
+        assert s.execute("select make_set(null, 'a')").rows()[0][0] is None
+
+    def test_yearweek_default_mode0(self, s):
+        assert s.execute("select yearweek('2008-02-20')").rows()[0][0] == "200807"
+        assert s.execute("select yearweek('2008-02-20', 1)").rows()[0][0] == "200808"
+        assert s.execute("select yearweek('1987-01-01')").rows()[0][0] == "198652"
+
+    def test_bad_partition_bound_is_parse_error(self, s):
+        from tidb_tpu.errors import ParseError
+        s.execute("create table pb (id int primary key) partition by range (id) "
+                  "(partition p0 values less than (10))")
+        with pytest.raises(ParseError):
+            s.execute("alter table pb add partition (partition p1 values less than ('abc'))")

@@ -295,3 +295,65 @@ class TestAdminAndLifecycle:
         # restored rows really live in the NEW partition keyspaces
         s.execute("INSERT INTO h VALUES (100, 100)")
         assert s.must_query("SELECT COUNT(*) FROM h") == [("10",)]
+
+
+class TestPartitionDDL:
+    """ALTER TABLE ADD/DROP/TRUNCATE PARTITION (ref: ddl/partition.go
+    onAddTablePartition, onDropTablePartition, onTruncateTablePartition)."""
+
+    def _mk_range(self, s):
+        s.execute(
+            "create table r (id int primary key, v int) partition by range (id) ("
+            "partition p0 values less than (100), partition p1 values less than (200))"
+        )
+        s.execute("insert into r values (50, 1), (150, 2)")
+
+    def test_add_partition_and_insert(self, s):
+        self._mk_range(s)
+        with pytest.raises(TiDBError):
+            s.execute("insert into r values (250, 3)")  # beyond last bound
+        s.execute("alter table r add partition (partition p2 values less than (300))")
+        s.execute("insert into r values (250, 3)")
+        assert s.must_query("select id from r order by id") == [("50",), ("150",), ("250",)]
+
+    def test_add_partition_validations(self, s):
+        self._mk_range(s)
+        with pytest.raises(TiDBError):  # non-increasing bound
+            s.execute("alter table r add partition (partition bad values less than (150))")
+        with pytest.raises(TiDBError):  # duplicate name
+            s.execute("alter table r add partition (partition p1 values less than (500))")
+        s.execute("alter table r add partition (partition pmax values less than maxvalue)")
+        with pytest.raises(TiDBError):  # nothing after MAXVALUE
+            s.execute("alter table r add partition (partition p9 values less than (900))")
+
+    def test_drop_partition_removes_rows(self, s):
+        self._mk_range(s)
+        s.execute("alter table r drop partition p0")
+        assert s.must_query("select id from r") == [("150",)]
+        # MySQL: p1's range extends downward after the drop
+        s.execute("insert into r values (50, 9)")
+        assert s.must_query("select count(*) from r") == [("2",)]
+        with pytest.raises(TiDBError):  # can't drop every partition
+            s.execute("alter table r drop partition p1")
+
+    def test_drop_partition_hash_rejected(self, s):
+        s.execute("create table h (id int primary key) partition by hash(id) partitions 4")
+        with pytest.raises(TiDBError):
+            s.execute("alter table h drop partition p0")
+
+    def test_truncate_partition_keeps_def(self, s):
+        self._mk_range(s)
+        s.execute("alter table r truncate partition p0")
+        assert s.must_query("select id from r") == [("150",)]
+        s.execute("insert into r values (60, 5)")  # range still exists
+        assert s.must_query("select id from r order by id") == [("60",), ("150",)]
+
+    def test_truncate_multiple_partitions(self, s):
+        self._mk_range(s)
+        s.execute("alter table r truncate partition p0, p1")
+        assert s.must_query("select count(*) from r") == [("0",)]
+
+    def test_unknown_partition_errors(self, s):
+        self._mk_range(s)
+        with pytest.raises(TiDBError):
+            s.execute("alter table r drop partition nosuch")

@@ -126,13 +126,38 @@ register(FuncSig("find_in_set", lambda fts: ft_longlong(), _obj_map(
     pushable=False, arity=2))
 
 
+def _nullable_args(fn, infer, name, arity):
+    """Kernel passing per-row python values with None for NULL args —
+    for functions that SKIP null arguments rather than return NULL
+    (MAKE_SET, CHAR; ref: builtin_string.go)."""
+
+    def kernel(xp, avals, fts, ret_ft):
+        datas = [np.asarray(d).reshape(-1) for d, _ in avals]
+        vs = [np.asarray(v).reshape(-1) for _, v in avals]
+        n = max((len(d) for d in datas), default=1)
+        out = np.empty(n, dtype=object)
+        valid = np.ones(n, dtype=bool)
+        for i in range(n):
+            args = [d[i % len(d)] if len(vv) and vv[i % len(vv)] else None
+                    for d, vv in zip(datas, vs)]
+            try:
+                out[i] = fn(*args)
+            except Exception:  # noqa: BLE001 — malformed input → SQL NULL
+                valid[i] = False
+        return out, valid
+
+    return FuncSig(name, infer, kernel, pushable=False, arity=arity)
+
+
 def _make_set(bits, *strs):
+    if bits is None:
+        _null()
     bits = int(bits)
     return ",".join(_as_str(s) for i, s in enumerate(strs)
                     if s is not None and bits & (1 << i))
 
 
-register(_multi_str(_make_set, name="make_set", arity=(2, None)))
+register(_nullable_args(_make_set, lambda fts: ft_varchar(), "make_set", (2, None)))
 register(FuncSig("quote", lambda fts: ft_varchar(), _obj_map(
     lambda s: "'" + _as_str(s).replace("\\", "\\\\").replace("'", "\\'")
     .replace("\x00", "\\0").replace("\x1a", "\\Z") + "'"), pushable=False, arity=1))
@@ -182,7 +207,9 @@ def _insert_str(s, pos, ln, new):
 register(FuncSig("insert", lambda fts: ft_varchar(), _obj_map(_insert_str), pushable=False, arity=4))
 register(FuncSig("bit_length", lambda fts: ft_longlong(), _obj_map(lambda s: len(_as_bytes(s)) * 8), pushable=False, arity=1))
 register(FuncSig("ord", lambda fts: ft_longlong(), _obj_map(lambda s: ord(_as_str(s)[0]) if _as_str(s) else 0), pushable=False, arity=1))
-register(_multi_str(lambda *xs: "".join(chr(int(x) & 0xFF) if int(x) < 256 else chr(int(x)) for x in xs if x is not None), name="char", arity=(1, None)))
+register(_nullable_args(
+    lambda *xs: "".join(chr(int(x) & 0xFF) if int(x) < 256 else chr(int(x)) for x in xs if x is not None),
+    lambda fts: ft_varchar(), "char", (1, None)))
 
 
 def _format_kernel(xp, avals, fts, ret_ft):
@@ -368,8 +395,25 @@ def _addtime_like(sign):
     return fn
 
 
-register(FuncSig("addtime", lambda fts: ft_varchar(32), _obj_map(_addtime_like(+1)), pushable=False, arity=2))
-register(FuncSig("subtime", lambda fts: ft_varchar(32), _obj_map(_addtime_like(-1)), pushable=False, arity=2))
+def _temporal_obj(fn):
+    """_obj_map with duration-typed int lanes rendered to 'HH:MM:SS'
+    strings first — a TIME column's microsecond lane must not be read as
+    a packed datetime."""
+
+    def kernel(xp, avals, fts, ret_ft):
+        conv = []
+        for (d, v), ft in zip(avals, fts):
+            dd = np.asarray(d).reshape(-1)
+            if dd.dtype != object and ft is not None and ft.tp == TypeCode.Duration:
+                dd = np.array([_fmt_duration(int(x)) for x in dd], dtype=object)
+            conv.append((dd, v))
+        return _obj_map(fn)(xp, conv, fts, ret_ft)
+
+    return kernel
+
+
+register(FuncSig("addtime", lambda fts: ft_varchar(32), _temporal_obj(_addtime_like(+1)), pushable=False, arity=2))
+register(FuncSig("subtime", lambda fts: ft_varchar(32), _temporal_obj(_addtime_like(-1)), pushable=False, arity=2))
 
 
 def _timediff(a, b):
@@ -385,7 +429,7 @@ def _timediff(a, b):
     return _fmt_duration(_parse_duration_us(a) - _parse_duration_us(b))
 
 
-register(FuncSig("timediff", lambda fts: ft_varchar(32), _obj_map(_timediff), pushable=False, arity=2))
+register(FuncSig("timediff", lambda fts: ft_varchar(32), _temporal_obj(_timediff), pushable=False, arity=2))
 register(FuncSig("maketime", lambda fts: ft_varchar(32), _obj_map(
     lambda h, m, s: _fmt_duration(int(((abs(int(h)) * 60 + int(m)) * 60 + float(s)) * _US) * (-1 if int(h) < 0 else 1)) if 0 <= int(m) < 60 and 0 <= float(s) < 60 else _null()),
     pushable=False, arity=3))
@@ -420,11 +464,15 @@ def _to_date(v):
     return t
 
 
-register(FuncSig("to_days", lambda fts: ft_longlong(), _obj_map(lambda v: _to_date(v).toordinal()), pushable=False, arity=1))
+# MySQL day numbers count from year 0 — 365 days before Python's
+# proleptic ordinal epoch (0001-01-01): TO_DAYS('1970-01-01') = 719528
+_MYSQL_DAY0 = 365
+
+register(FuncSig("to_days", lambda fts: ft_longlong(), _obj_map(lambda v: _to_date(v).toordinal() + _MYSQL_DAY0), pushable=False, arity=1))
 register(FuncSig("from_days", lambda fts: ft_varchar(10), _obj_map(
-    lambda n: _dt.date.fromordinal(int(n)).strftime("%Y-%m-%d") if int(n) > 365 else _null()), pushable=False, arity=1))
+    lambda n: _dt.date.fromordinal(int(n) - _MYSQL_DAY0).strftime("%Y-%m-%d") if int(n) > 730 else _null()), pushable=False, arity=1))
 register(FuncSig("to_seconds", lambda fts: ft_longlong(), _obj_map(
-    lambda v: (lambda t: t.toordinal() * 86400 + t.hour * 3600 + t.minute * 60 + t.second)(_to_date(v))), pushable=False, arity=1))
+    lambda v: (lambda t: (t.toordinal() + _MYSQL_DAY0) * 86400 + t.hour * 3600 + t.minute * 60 + t.second)(_to_date(v))), pushable=False, arity=1))
 
 
 def _period_to_months(p):
@@ -446,8 +494,26 @@ register(FuncSig("period_add", lambda fts: ft_longlong(), _obj_map(
     lambda p, n: _months_to_period(_period_to_months(p) + int(n))), pushable=False, arity=2))
 register(FuncSig("period_diff", lambda fts: ft_longlong(), _obj_map(
     lambda a, b: _period_to_months(a) - _period_to_months(b)), pushable=False, arity=2))
-register(FuncSig("yearweek", lambda fts: ft_longlong(), _obj_map(
-    lambda v, *mode: (lambda t: t.isocalendar()[0] * 100 + t.isocalendar()[1])(_to_date(v))), pushable=False, arity=(1, 2)))
+def _yearweek_mode0(d: _dt.date) -> int:
+    """MySQL mode 0: Sunday-start weeks; days before the year's first
+    Sunday belong to the previous year's last week."""
+    jan1 = _dt.date(d.year, 1, 1)
+    first_sunday = jan1 + _dt.timedelta(days=(6 - jan1.weekday()) % 7)
+    if d < first_sunday:
+        return _yearweek_mode0(_dt.date(d.year - 1, 12, 31))
+    return d.year * 100 + (d - first_sunday).days // 7 + 1
+
+
+def _yearweek(v, *mode):
+    t = _to_date(v)
+    m = int(mode[0]) if mode and mode[0] is not None else 0
+    if m % 2:  # Monday-start modes → ISO weeks
+        iso = t.isocalendar()
+        return iso[0] * 100 + iso[1]
+    return _yearweek_mode0(t.date() if isinstance(t, _dt.datetime) else t)
+
+
+register(FuncSig("yearweek", lambda fts: ft_longlong(), _obj_map(_yearweek), pushable=False, arity=(1, 2)))
 register(FuncSig("weekofyear", lambda fts: ft_longlong(), _obj_map(
     lambda v: _to_date(v).isocalendar()[1]), pushable=False, arity=1))
 register(_multi_str(lambda: _dt.datetime.utcnow().strftime("%Y-%m-%d"), name="utc_date", arity=0))
@@ -466,7 +532,7 @@ def _time_of(v):
     return _fmt_duration(_parse_duration_us(v))
 
 
-register(FuncSig("time", lambda fts: ft_varchar(32), _obj_map(_time_of), pushable=False, arity=1))
+register(FuncSig("time", lambda fts: ft_varchar(32), _temporal_obj(_time_of), pushable=False, arity=1))
 
 _STRPTIME = {
     "%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%m", "%d": "%d", "%e": "%d",

@@ -1146,9 +1146,7 @@ class Parser:
                 defs.append((name, None))
             else:
                 self.expect_op("(")
-                neg = bool(self.try_op("-"))
-                bound = int(self.next().text)
-                defs.append((name, -bound if neg else bound))
+                defs.append((name, self._int_bound()))
                 self.expect_op(")")
             if not self.try_op(","):
                 break
@@ -1256,7 +1254,27 @@ class Parser:
         actions = []
         while True:
             if self.try_kw("ADD"):
-                if self.try_kw("INDEX") or self.try_kw("KEY"):
+                if self.at_kw("PARTITION"):
+                    self.next()
+                    self.expect_op("(")
+                    defs = []
+                    while True:
+                        self.expect_kw("PARTITION")
+                        pname = self.ident()
+                        self.expect_kw("VALUES")
+                        self.expect_kw("LESS")
+                        self.expect_kw("THAN")
+                        if self.try_kw("MAXVALUE"):
+                            defs.append((pname, None))
+                        else:
+                            self.expect_op("(")
+                            defs.append((pname, self._int_bound()))
+                            self.expect_op(")")
+                        if not self.try_op(","):
+                            break
+                    self.expect_op(")")
+                    actions.append(("add_partition", defs))
+                elif self.try_kw("INDEX") or self.try_kw("KEY"):
                     iname = self.ident() if not self.at_op("(") else ""
                     self.expect_op("(")
                     cols = self._key_part_list()
@@ -1279,7 +1297,10 @@ class Parser:
                     self.try_kw("COLUMN")
                     actions.append(("add_column", self.column_def()))
             elif self.try_kw("DROP"):
-                if self.try_kw("INDEX") or self.try_kw("KEY"):
+                if self.at_kw("PARTITION"):
+                    self.next()
+                    actions.append(("drop_partition", self._partition_name_list()))
+                elif self.try_kw("INDEX") or self.try_kw("KEY"):
                     actions.append(("drop_index", self.ident()))
                 elif self.try_kw("PRIMARY"):
                     self.expect_kw("KEY")
@@ -1287,6 +1308,10 @@ class Parser:
                 else:
                     self.try_kw("COLUMN")
                     actions.append(("drop_column", self.ident()))
+            elif self.at_kw("TRUNCATE"):
+                self.next()
+                self.expect_kw("PARTITION")
+                actions.append(("truncate_partition", self._partition_name_list()))
             elif self.try_kw("MODIFY"):
                 self.try_kw("COLUMN")
                 actions.append(("modify_column", self.column_def()))
@@ -1298,6 +1323,27 @@ class Parser:
             if not self.try_op(","):
                 break
         return ast.AlterTable(tbl, actions)
+
+    def _int_bound(self) -> int:
+        """Integer partition bound; non-integer bounds are a parse error,
+        not a Python exception."""
+        neg = bool(self.try_op("-"))
+        t = self.tok
+        if t.kind != "num" or not t.text.lstrip("-").isdigit():
+            self.fail("expected integer partition bound")
+        self.next()
+        return -int(t.text) if neg else int(t.text)
+
+    _ALTER_ACTION_KWS = {"ADD", "DROP", "MODIFY", "RENAME", "TRUNCATE", "CHANGE"}
+
+    def _partition_name_list(self) -> list[str]:
+        """Partition idents; a comma followed by an action keyword ends
+        the list (the actions loop owns that comma)."""
+        names = [self.ident()]
+        while self.at_op(",") and self.peek().kind == "ident" and self.peek().upper not in self._ALTER_ACTION_KWS:
+            self.next()
+            names.append(self.ident())
+        return names
 
     def truncate_stmt(self):
         self.expect_kw("TRUNCATE")

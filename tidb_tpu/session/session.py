@@ -482,17 +482,7 @@ class Session:
             # multi-table: UPDATE only on assigned tables, SELECT on the
             # rest (MySQL resolution; an unqualified SET column can't be
             # attributed without the schema → UPDATE everywhere, safe side)
-            alias_map: dict[str, tuple[str, str]] = {}
-
-            def collect_aliases(n):
-                if isinstance(n, ast.Join):
-                    collect_aliases(n.left)
-                    collect_aliases(n.right)
-                elif isinstance(n, ast.TableName):
-                    alias_map[(n.alias or n.name).lower()] = (
-                        (n.db or self.current_db).lower(), n.name.lower())
-
-            collect_aliases(stmt.table)
+            alias_map = self._dml_alias_map(stmt.table)
             set_aliases = {name.table.lower() for name, _ in stmt.sets if name.table}
             bare = any(name.table is None for name, _ in stmt.sets)
             out = []
@@ -511,17 +501,7 @@ class Session:
             # multi-table: targets name ALIASES, so resolve through the
             # alias map (comparing base names would let `DELETE a FROM t
             # AS a` slip through with SELECT only)
-            alias_map: dict[str, tuple[str, str]] = {}
-
-            def collect_aliases(n):
-                if isinstance(n, ast.Join):
-                    collect_aliases(n.left)
-                    collect_aliases(n.right)
-                elif isinstance(n, ast.TableName):
-                    alias_map[(n.alias or n.name).lower()] = (
-                        (n.db or self.current_db).lower(), n.name.lower())
-
-            collect_aliases(stmt.table)
+            alias_map = self._dml_alias_map(stmt.table)
             targets = {t.lower() for t in (stmt.targets or ())}
             out = []
             for alias, (d, t) in alias_map.items():
@@ -557,6 +537,14 @@ class Session:
             # ones only affect the caller
             return [("SUPER", "*")] if stmt.global_ else []
         return []  # SET/SHOW/USE/txn control etc. need no table privilege
+
+    def _dml_alias_map(self, from_ast) -> dict[str, tuple[str, str]]:
+        """alias(lower) → (db, table) for privilege attribution — one
+        walk shared with the executor's _dml_leaves."""
+        return {
+            a: ((tn.db or self.current_db).lower(), tn.name.lower())
+            for a, tn in self._dml_leaves(from_ast).items()
+        }
 
     def _check_privileges(self, stmt) -> None:
         if self._in_bootstrap:
@@ -2181,9 +2169,87 @@ class Session:
                 self._alter_drop_column(stmt.table, payload)
             elif action == "rename":
                 self._alter_rename(stmt.table, payload)
+            elif action == "add_partition":
+                self._alter_add_partition(stmt.table, payload)
+            elif action == "drop_partition":
+                self._alter_drop_partition(stmt.table, payload, truncate=False)
+            elif action == "truncate_partition":
+                self._alter_drop_partition(stmt.table, payload, truncate=True)
             else:
                 raise TiDBError(f"unsupported ALTER action {action}")
         return ResultSet([], None)
+
+    def _alter_add_partition(self, tn: ast.TableName, defs: list) -> None:
+        """ALTER TABLE ... ADD PARTITION for RANGE tables (ref:
+        ddl/partition.go onAddTablePartition): new bounds must ascend
+        strictly above the current maximum."""
+        from ..catalog.schema import PartitionDef
+
+        db = tn.db or self.current_db
+        info = self.infoschema().table(db, tn.name)
+        if info.partition is None or info.partition.type != "range":
+            raise TiDBError("ADD PARTITION requires a RANGE-partitioned table")
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        t = m.table(info.id)
+        cur = t.partition.defs
+        if cur and cur[-1].less_than is None:
+            txn.rollback()
+            raise TiDBError("MAXVALUE can only be used in last partition definition")
+        prev = cur[-1].less_than if cur else None
+        names = {d.name.lower() for d in cur}
+        for name, bound in defs:
+            if name.lower() in names:
+                txn.rollback()
+                raise TiDBError(f"Duplicate partition name {name}")
+            if bound is not None and prev is not None and bound <= prev:
+                txn.rollback()
+                raise TiDBError("VALUES LESS THAN value must be strictly increasing for each partition")
+            if prev is None and cur:
+                txn.rollback()
+                raise TiDBError("MAXVALUE can only be used in last partition definition")
+            t.partition.defs.append(PartitionDef(m.alloc_id(), name, bound))
+            names.add(name.lower())
+            prev = bound
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+
+    def _alter_drop_partition(self, tn: ast.TableName, names: list, truncate: bool) -> None:
+        """DROP PARTITION (range only, removes defs + rows) / TRUNCATE
+        PARTITION (any type, keeps defs) — ref: ddl/partition.go
+        onDropTablePartition/onTruncateTablePartition + delete_range."""
+        db = tn.db or self.current_db
+        info = self.infoschema().table(db, tn.name)
+        if info.partition is None:
+            raise TiDBError(f"table {tn.name!r} is not partitioned")
+        if not truncate and info.partition.type != "range":
+            raise TiDBError("DROP PARTITION can only be used on RANGE partitions")
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        t = m.table(info.id)
+        by_name = {d.name.lower(): d for d in t.partition.defs}
+        wanted = []
+        for n in names:
+            pd = by_name.get(n.lower())
+            if pd is None:
+                txn.rollback()
+                raise TiDBError(f"Unknown partition {n!r} in table {tn.name!r}")
+            wanted.append(pd)
+        if not truncate and len(wanted) == len(t.partition.defs):
+            txn.rollback()
+            raise TiDBError("Cannot remove all partitions, use DROP TABLE instead")
+        if not truncate:
+            drop_ids = {pd.id for pd in wanted}
+            t.partition.defs = [d for d in t.partition.defs if d.id not in drop_ids]
+        m.put_table(t)
+        m.bump_schema_version()
+        txn.commit()
+        for pd in wanted:
+            self.store.mvcc.unsafe_destroy_range(
+                tablecodec.table_prefix(pd.id), tablecodec.table_prefix(pd.id + 1)
+            )
+            self.cop.tiles.invalidate_table(pd.id)
 
     def _alter_add_column(self, tn: ast.TableName, cd: ast.ColumnDef):
         if cd.name.lower().startswith("_tidb_"):
