@@ -121,3 +121,60 @@ class TestIndexLookupJoin:
             "SELECT b.id FROM (SELECT 2 AS k) a JOIN t b ON a.k = b.g ORDER BY b.id"
         )
         assert rows == [("3",), ("4",)]
+
+
+class TestJoinReorder:
+    """Greedy join reorder (ref: planner/core/rule_join_reorder.go)."""
+
+    def _mk(self, s):
+        s.execute("create table jb (id int primary key, m int)")
+        s.execute("create table jm (id int primary key, s int)")
+        s.execute("create table js (id int primary key, t varchar(8))")
+        s.execute("insert into jb values " + ",".join(f"({i},{i % 50})" for i in range(1000)))
+        s.execute("insert into jm values " + ",".join(f"({i},{i % 5})" for i in range(50)))
+        s.execute("insert into js values " + ",".join(f"({i},'x{i}')" for i in range(5)))
+        for t in ("jb", "jm", "js"):
+            s.execute(f"analyze table {t}")
+
+    def test_small_table_becomes_build_root(self, s):
+        self._mk(s)
+        plan = "\n".join(r[0] for r in s.must_query(
+            "explain select count(*) from jb join jm on jb.m = jm.id join js on jm.s = js.id"))
+        # the smallest leaf (js) must be joined before the biggest (jb)
+        assert plan.index("DataSource(js)") < plan.index("DataSource(jb)")
+
+    def test_results_unchanged_by_reorder(self, s):
+        self._mk(s)
+        q = ("select js.t, count(*) c from jb join jm on jb.m = jm.id "
+             "join js on jm.s = js.id where js.id >= 1 group by js.t order by js.t")
+        got = s.must_query(q)
+        assert got == [("x1", "200"), ("x2", "200"), ("x3", "200"), ("x4", "200")]
+
+    def test_outer_join_not_reordered_through(self, s):
+        self._mk(s)
+        # left join is a reorder barrier; results must stay correct
+        q = ("select count(*) from js left join jm on js.id = jm.s "
+             "join jb on jb.m = jm.id")
+        assert s.must_query(q) == [("1000",)]
+
+    def test_cross_member_joins_last(self, s):
+        self._mk(s)
+        q = "select count(*) from jb join jm on jb.m = jm.id, js"
+        assert s.must_query(q) == [("5000",)]
+
+    def test_constant_on_condition(self, s):
+        self._mk(s)
+        q = "select count(*) from jb join jm on jb.m = jm.id join js on 1 = 1"
+        assert s.must_query(q) == [("5000",)]
+
+    def test_four_table_maximal_group(self, s):
+        self._mk(s)
+        s.execute("create table jt (id int primary key)")
+        s.execute("insert into jt values (0),(1)")
+        s.execute("analyze table jt")
+        q = ("select count(*) from jb join jm on jb.m = jm.id "
+             "join js on jm.s = js.id join jt on js.id = jt.id")
+        plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+        # the tiniest table must lead the whole 4-way group, not just a trio
+        assert plan.index("DataSource(jt)") < plan.index("DataSource(jb)")
+        assert s.must_query(q) == [("400",)]

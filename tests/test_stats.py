@@ -178,3 +178,111 @@ class TestRegressions:
         assert s.must_query("select o_custkey from orders where o_orderkey = 7") == [("2",)]
         assert s.must_query("select o_custkey from orders where o_orderkey = 55") == [("3",)]
         assert s.must_query("select count(*) from orders") == [("3",)]
+
+
+class TestStatsDumpLoad:
+    """JSON stats dump/load (ref: statistics/handle/dump.go)."""
+
+    def test_dump_load_roundtrip(self, tmp_path):
+        import json
+        from tidb_tpu.session import Session
+
+        a = Session()
+        a.execute("create table t (id int primary key, g int)")
+        a.execute("insert into t values " + ",".join(f"({i},{i%13})" for i in range(500)))
+        a.execute("analyze table t")
+        dump = a.store.stats.dump(a, a.infoschema().table("test", "t"))
+        assert dump["table_name"] == "t" and dump["stats"]["row_count"] == 500
+        p = tmp_path / "t_stats.json"
+        p.write_text(json.dumps(dump))
+
+        # fresh store: same schema, no stats; LOAD STATS installs them
+        b = Session()
+        b.execute("create table t (id int primary key, g int)")
+        assert b.store.stats.get(b.infoschema().table("test", "t").id) is None
+        b.execute(f"load stats '{p}'")
+        ts = b.store.stats.get(b.infoschema().table("test", "t").id)
+        assert ts is not None and ts.row_count == 500
+        g_col = b.infoschema().table("test", "t").col_by_name("g")
+        assert ts.col(g_col.id) is not None and ts.col(g_col.id).ndv >= 12
+
+    def test_load_remaps_column_ids_by_name(self, tmp_path):
+        import json
+        from tidb_tpu.session import Session
+
+        a = Session()
+        a.execute("create table r (id int primary key, x int, y varchar(10))")
+        a.execute("insert into r values (1, 5, 'a'), (2, 9, 'b')")
+        a.execute("analyze table r")
+        dump = a.store.stats.dump(a, a.infoschema().table("test", "r"))
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(dump))
+
+        b = Session()
+        # different creation order → different column ids
+        b.execute("create table scratch (q int primary key)")
+        b.execute("create table r (id int primary key, x int, y varchar(10))")
+        b.execute(f"load stats '{p}'")
+        info = b.infoschema().table("test", "r")
+        ts = b.store.stats.get(info.id)
+        assert ts.col(info.col_by_name("x").id) is not None
+
+    def test_http_dump_endpoint(self):
+        import json
+        import urllib.request
+        from tidb_tpu.server import Server
+
+        srv = Server(port=0, status_port=0)
+        srv.start()
+        try:
+            s = __import__("tidb_tpu.session", fromlist=["Session"]).Session(srv.storage)
+            s.execute("create table h (id int primary key)")
+            s.execute("insert into h values (1),(2),(3)")
+            s.execute("analyze table h")
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/stats/dump/test/h", timeout=10
+            ) as r:
+                d = json.loads(r.read())
+            assert d["stats"]["row_count"] == 3
+        finally:
+            srv.close()
+
+    def test_load_skips_dropped_columns(self, tmp_path):
+        import json
+        from tidb_tpu.session import Session
+
+        a = Session()
+        a.execute("create table r (id int primary key, b int)")
+        a.execute("insert into r values (1, 5)")
+        a.execute("analyze table r")
+        dump = a.store.stats.dump(a, a.infoschema().table("test", "r"))
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(dump))
+        b = Session()
+        b.execute("create table r (id int primary key, c int)")  # b is gone
+        b.execute(f"load stats '{p}'")
+        info = b.infoschema().table("test", "r")
+        ts = b.store.stats.get(info.id)
+        assert ts.col(info.col_by_name("c").id) is None  # never misattached
+        assert ts.col(info.col_by_name("id").id) is not None
+
+    def test_http_dump_missing_stats_404(self):
+        import urllib.error
+        import urllib.request
+        from tidb_tpu.server import Server
+        from tidb_tpu.session import Session
+
+        srv = Server(port=0, status_port=0)
+        srv.start()
+        try:
+            s = Session(srv.storage)
+            s.execute("create table nh (id int primary key)")
+            for path, code in [("/stats/dump/test/nh", 404), ("/stats/dump/test/zz", 404)]:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.status_port}{path}", timeout=10)
+                    raise AssertionError("expected HTTPError")
+                except urllib.error.HTTPError as e:
+                    assert e.code == code
+        finally:
+            srv.close()

@@ -369,6 +369,11 @@ class AdminStmt:
 
 
 @dataclass
+class LoadStats:
+    path: str
+
+
+@dataclass
 class LockTables:
     tables: list  # [(TableName, 'READ'|'WRITE')]
 

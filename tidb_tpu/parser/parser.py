@@ -1584,6 +1584,12 @@ class Parser:
 
     def load_stmt(self):
         self.expect_kw("LOAD")
+        if self.try_kw("STATS"):
+            # LOAD STATS 'dump.json' (ref: executor/load_stats.go)
+            t = self.next()
+            if t.kind != "str":
+                self.fail("expected stats dump path string")
+            return ast.LoadStats(t.text)
         self.expect_kw("DATA")
         self.try_kw("LOCAL")
         self.expect_kw("INFILE")

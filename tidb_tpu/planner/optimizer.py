@@ -8,7 +8,7 @@ decides cop vs root in the task model).
 
 from __future__ import annotations
 
-from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc
+from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc, make_func
 from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, Projection, Selection, SetOp, Sort, Window
 
 
@@ -19,8 +19,176 @@ def optimize(plan: LogicalPlan, stats=None) -> LogicalPlan:
     # lanes referenced by DAG expressions. The usage analysis below serves
     # index-covering decisions.
     plan = push_down_predicates(plan)
+    plan = reorder_joins(plan, stats)
     choose_access_paths(plan, stats)
     return plan
+
+
+# ------------------------------------------------------------- join reorder
+
+
+def _remap_expr(e: Expression, mapping: dict) -> Expression:
+    if isinstance(e, ECol):
+        return ECol(mapping[e.idx], e.ret_type, e.name)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.sig, [_remap_expr(a, mapping) for a in e.args], e.ret_type)
+    return e
+
+
+def _reorderable(n) -> bool:
+    return (
+        isinstance(n, Join)
+        and n.kind in ("inner", "cross")
+        and n.na_key is None
+        and all(isinstance(c, (DataSource, Join)) for c in n.children)
+    )
+
+
+def reorder_joins(root: LogicalPlan, stats=None) -> LogicalPlan:
+    """Greedy join reorder for inner-join groups over base tables (ref:
+    planner/core/rule_join_reorder.go joinReorderGreedySolver): start
+    from the smallest estimated leaf, repeatedly join the connected leaf
+    with the smallest estimate (cartesian members last). The rebuilt tree
+    is wrapped in a Projection restoring the original column order, so
+    parents are unaffected."""
+
+    def walk(n: LogicalPlan) -> LogicalPlan:
+        # top-down: the MAXIMAL inner-join group must be flattened as one
+        # unit — a bottom-up walk would rewrite the inner trio first and
+        # hide the outer tables behind the restoring Projection
+        if _reorderable(n) and any(_reorderable(c) for c in n.children):
+            out = _reorder_group(n, stats)
+            if out is not None:
+                # the group's leaves were not visited yet; a second pass
+                # over the rebuilt tree is a no-op for the group itself
+                # (greedy is deterministic) and descends into the leaves
+                out.children = [walk(c) for c in out.children]
+                return out
+        n.children = [walk(c) for c in n.children]
+        return n
+
+    return walk(root)
+
+
+def _leaf_estimate(ds, stats) -> float:
+    if not isinstance(ds, DataSource):
+        return 1000.0
+    tstats = stats.get(ds.table.id) if stats is not None else None
+    if tstats is None or tstats.row_count <= 0:
+        return 1000.0
+    from ..statistics.selectivity import estimate_conds
+
+    total = float(tstats.row_count)
+    if not ds.pushed_conds:
+        return total
+    return max(estimate_conds(tstats, ds.pushed_conds, ds.table.visible_columns()) * total, 1.0)
+
+
+def _reorder_group(root: Join, stats):
+    # 1. flatten the maximal inner-join subtree into leaves + global conds
+    leaves: list = []  # (node, old_offset, width)
+    eq_conds: list = []  # (l_expr, r_expr) in OLD global coordinates
+    other_conds: list = []
+
+    def flatten(n, offset) -> int:
+        if _reorderable(n):
+            wl = flatten(n.children[0], offset)
+            wr = flatten(n.children[1], offset + wl)
+            for l, r in n.eq_conds:
+                # l is over the left child schema (== global already for a
+                # left-edge subtree at `offset`), r over the concat schema
+                eq_conds.append((_shift_expr(l, offset), _shift_expr(r, offset)))
+            for c in n.other_conds:
+                other_conds.append(_shift_expr(c, offset))
+            return wl + wr
+        leaves.append((n, offset, len(n.out_cols)))
+        return len(n.out_cols)
+
+    total = flatten(root, 0)
+    if len(leaves) < 3:
+        return None
+
+    # 2. leaf connectivity via eq conds + estimates
+    def owner(idx: int) -> int:
+        for i, (_, off, w) in enumerate(leaves):
+            if off <= idx < off + w:
+                return i
+        return -1
+
+    est = [_leaf_estimate(n, stats) for n, _, _ in leaves]
+    edges: list = []  # (leaf_a, leaf_b) per eq cond
+    for l, r in eq_conds:
+        ls = {owner(i) for i in _cols_of(l)}
+        rs = {owner(i) for i in _cols_of(r)}
+        if len(ls) == 1 and len(rs) == 1 and ls != rs:
+            edges.append((next(iter(ls)), next(iter(rs))))
+
+    # 3. greedy order
+    order = [min(range(len(leaves)), key=lambda i: est[i])]
+    chosen = set(order)
+    while len(order) < len(leaves):
+        connected = [
+            i for i in range(len(leaves)) if i not in chosen
+            and any((a in chosen) != (b in chosen) and i in (a, b) for a, b in edges)
+        ]
+        pool = connected or [i for i in range(len(leaves)) if i not in chosen]
+        nxt = min(pool, key=lambda i: est[i])
+        order.append(nxt)
+        chosen.add(nxt)
+    if order == list(range(len(leaves))):
+        return None  # already optimal order: keep the original tree
+
+    # 4. old→new global index mapping
+    new_off = {}
+    pos = 0
+    for i in order:
+        new_off[i] = pos
+        pos += leaves[i][2]
+    mapping = {}
+    for i, (n, old, w) in enumerate(leaves):
+        for k in range(w):
+            mapping[old + k] = new_off[i] + k
+
+    # 5. rebuild left-deep in the new order, attaching conds at the first
+    # node where all their columns are bound
+    pending_eq = [(_remap_expr(l, mapping), _remap_expr(r, mapping)) for l, r in eq_conds]
+    pending_other = [_remap_expr(c, mapping) for c in other_conds]
+    acc = leaves[order[0]][0]
+    width = leaves[order[0]][2]
+    for i in order[1:]:
+        leaf, _, w = leaves[i]
+        width += w
+        take_eq, take_other = [], []
+        rest_eq = []
+        for l, r in pending_eq:
+            lc, rc = _cols_of(l), _cols_of(r)
+            # column-less sides (ON 1=1) bind immediately
+            if max(lc | rc, default=-1) < width:
+                lw = width - w
+                if lc and rc and max(lc) < lw and min(rc) >= lw:
+                    take_eq.append((l, r))
+                elif lc and rc and max(rc) < lw and min(lc) >= lw:
+                    take_eq.append((r, l))
+                else:  # both sides inside one child / constant → filter
+                    take_other.append(make_func("eq", l, r))
+            else:
+                rest_eq.append((l, r))
+        pending_eq = rest_eq
+        rest_other = []
+        for c in pending_other:
+            if max(_cols_of(c), default=-1) < width:
+                take_other.append(c)
+            else:
+                rest_other.append(c)
+        pending_other = rest_other
+        cols = list(acc.out_cols) + list(leaf.out_cols)
+        acc = Join(acc, leaf, "inner" if take_eq or take_other else "cross", take_eq, take_other, cols)
+
+    # 6. restore the original column order for the parent
+    exprs = [
+        ECol(mapping[i], root.out_cols[i].ft, root.out_cols[i].name) for i in range(total)
+    ]
+    return Projection(acc, exprs, list(root.out_cols))
 
 
 # --------------------------------------------------------------- predicates

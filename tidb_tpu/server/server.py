@@ -285,6 +285,38 @@ class Server:
 
                     body = REGISTRY.render().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/stats/dump/"):
+                    # /stats/dump/{db}/{table} (ref: statistics_handler.go)
+                    parts = self.path.split("/")
+                    if len(parts) != 5:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    from ..errors import TiDBError
+                    from ..session import Session as _S
+
+                    try:
+                        sess = _S(server.storage)
+                        info = sess.infoschema().table(parts[3], parts[4])
+                    except TiDBError:
+                        self.send_response(404)
+                        self.end_headers()
+                        self.wfile.write(b"unknown table")
+                        return
+                    try:
+                        d = server.storage.stats.dump(sess, info)
+                    except Exception:  # noqa: BLE001 — HTTP surface
+                        log.exception("stats dump failed")
+                        self.send_response(500)
+                        self.end_headers()
+                        return
+                    if d is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        self.wfile.write(b"no statistics; run ANALYZE first")
+                        return
+                    body = json.dumps(d).encode()
+                    ctype = "application/json"
                 elif self.path == "/status":
                     with server._lock:
                         conns = len(server._conns)

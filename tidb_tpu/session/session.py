@@ -623,6 +623,13 @@ class Session:
                         self.priv.require_dynamic(self, self.user, "SYSTEM_VARIABLES_ADMIN")
                     self.vars[name] = c.value.render(c.ret_type)
             return ResultSet([], None)
+        if isinstance(stmt, ast.LoadStats):
+            import json as _json
+
+            with open(stmt.path, "r", encoding="utf8") as f:
+                self.store.stats.load_dump(self, _json.load(f))
+            self._plan_cache.clear()
+            return ResultSet([], None)
         if isinstance(stmt, ast.LockTables):
             return self._run_lock_tables(stmt)
         if isinstance(stmt, ast.UnlockTables):

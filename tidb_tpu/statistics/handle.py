@@ -65,6 +65,41 @@ class StatsHandle:
         txn.put(_stats_key(ts.table_id), json.dumps(ts.to_json()).encode())
         txn.commit()
 
+    def dump(self, session, info, build_if_missing: bool = False) -> dict | None:
+        """JSON stats dump for one table (ref: statistics/handle/dump.go
+        DumpStatsToJSON; column ids are carried with their names so a
+        load can remap onto a re-created table). Returns None when no
+        stats exist unless build_if_missing — HTTP GETs must not trigger
+        a full ANALYZE as a side effect."""
+        ts = self.get(info.id)
+        if ts is None:
+            if not build_if_missing:
+                return None
+            ts = self.analyze_table(session, info)
+        return {
+            "database_name": info.db_name,
+            "table_name": info.name,
+            "stats": ts.to_json(),
+            "col_names": {str(c.id): c.name for c in info.columns},
+        }
+
+    def load_dump(self, session, d: dict) -> None:
+        """Install a dumped stats JSON onto the current schema's table of
+        the same name, remapping column ids by column NAME (ref:
+        handle/dump.go LoadStatsFromJSON)."""
+        info = session.infoschema().table(d["database_name"], d["table_name"])
+        ts = TableStats.from_json(d["stats"])
+        name_by_old = {int(k): v for k, v in d.get("col_names", {}).items()}
+        cur_by_name = {c.name.lower(): c.id for c in info.columns}
+        cols = {}
+        for old_id, cs in ts.columns.items():
+            new_id = cur_by_name.get((name_by_old.get(old_id) or "").lower())
+            if new_id is not None:  # dropped/renamed columns are skipped,
+                cols[new_id] = cs   # never attached to an unrelated id
+        ts.columns = cols
+        ts.table_id = info.id
+        self.save(ts, session)
+
     def drop_table(self, table_id: int, session) -> None:
         self.cache.pop(table_id, None)
         txn = session.store.begin()
