@@ -178,3 +178,11 @@ class TestJoinReorder:
         # the tiniest table must lead the whole 4-way group, not just a trio
         assert plan.index("DataSource(jt)") < plan.index("DataSource(jb)")
         assert s.must_query(q) == [("400",)]
+
+    def test_straight_join_pins_order(self, s):
+        self._mk(s)
+        q = ("select count(*) from jb straight_join jm on jb.m = jm.id "
+             "straight_join js on jm.s = js.id")
+        plan = "\n".join(r[0] for r in s.must_query("explain " + q))
+        assert plan.index("DataSource(jb)") < plan.index("DataSource(js)")
+        assert s.must_query(q) == [("1000",)]

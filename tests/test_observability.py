@@ -95,3 +95,41 @@ class TestStatusHTTP:
             assert "tidb-tpu" in status
         finally:
             srv.close()
+
+
+class TestInspectionMemtables:
+    """Inspection/cluster memtables (ref: executor/inspection_result.go,
+    infoschema/cluster.go, metrics_schema.go)."""
+
+    def test_cluster_info(self, s):
+        rows = s.must_query("select type, version from information_schema.cluster_info")
+        assert rows == [("tidb", "8.0.11-tidb-tpu")]
+
+    def test_metrics_summary_aggregates(self, s):
+        s.must_query("select 1")  # ensure some query metrics exist
+        rows = s.must_query(
+            "select metrics_name, sum_value from information_schema.metrics_summary "
+            "where metrics_name = 'tidb_query_total'")
+        assert len(rows) == 1 and float(rows[0][1]) >= 1
+
+    def test_inspection_result_baseline_rules(self, s):
+        rules = {r[0] for r in s.must_query("select rule from information_schema.inspection_result")}
+        assert {"plan-cache", "region"} <= rules
+
+    def test_inspection_flags_slow_queries(self, s):
+        s.vars["tidb_slow_log_threshold"] = "0"
+        s.must_query("select 1")
+        s.vars["tidb_slow_log_threshold"] = "300"
+        rows = s.must_query(
+            "select severity from information_schema.inspection_result where rule = 'slow-query'")
+        assert rows == [("warning",)]
+
+    def test_processlist_shows_self(self, s):
+        rows = s.must_query("select user, command from information_schema.processlist")
+        assert ("root", "Query") in rows
+
+    def test_tidb_regions(self, s):
+        s.execute("create table reg (id int primary key)")
+        rows = s.must_query(
+            "select region_id from information_schema.tidb_regions")
+        assert len(rows) >= 1

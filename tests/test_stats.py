@@ -286,3 +286,22 @@ class TestStatsDumpLoad:
                     assert e.code == code
         finally:
             srv.close()
+
+    def test_load_stats_requires_super_and_clean_errors(self, tmp_path):
+        import pytest
+        from tidb_tpu.errors import TiDBError
+        from tidb_tpu.privilege.cache import PrivilegeError
+        from tidb_tpu.session import Session
+
+        s = Session()
+        s.execute("create user pleb")
+        u = Session(s.store)
+        u.user = "pleb"
+        with pytest.raises(PrivilegeError):
+            u.execute("load stats '/tmp/nope.json'")
+        with pytest.raises(TiDBError):
+            s.execute("load stats '/definitely/missing.json'")
+        bad = tmp_path / "bad.json"
+        bad.write_text("not json at all")
+        with pytest.raises(TiDBError):
+            s.execute(f"load stats '{bad}'")

@@ -36,6 +36,29 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         ["TABLE_SCHEMA", "TABLE_NAME", "KEY_NAME", "COLUMN_NAMES", "NON_UNIQUE", "STATE"],
         [ft_varchar(64), ft_varchar(64), ft_varchar(64), ft_varchar(256), ft_longlong(), ft_varchar(16)],
     ),
+    "processlist": (
+        ["ID", "USER", "HOST", "DB", "COMMAND", "TIME", "STATE", "INFO"],
+        [ft_longlong(), ft_varchar(32), ft_varchar(64), ft_varchar(64),
+         ft_varchar(16), ft_longlong(), ft_varchar(16), ft_varchar(512)],
+    ),
+    "tidb_regions": (
+        ["REGION_ID", "START_KEY", "END_KEY", "TABLE_ID", "IS_INDEX"],
+        [ft_longlong(), ft_varchar(64), ft_varchar(64), ft_longlong(), ft_longlong()],
+    ),
+    "metrics_summary": (
+        ["METRICS_NAME", "INSTANCES", "SUM_VALUE", "AVG_VALUE", "MIN_VALUE", "MAX_VALUE"],
+        [ft_varchar(64), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_double()],
+    ),
+    "inspection_result": (
+        ["RULE", "ITEM", "TYPE", "VALUE", "REFERENCE", "SEVERITY", "DETAILS"],
+        [ft_varchar(32), ft_varchar(64), ft_varchar(16), ft_varchar(64),
+         ft_varchar(64), ft_varchar(16), ft_varchar(256)],
+    ),
+    "cluster_info": (
+        ["TYPE", "INSTANCE", "VERSION", "GIT_HASH", "START_TIME", "UPTIME"],
+        [ft_varchar(16), ft_varchar(64), ft_varchar(32), ft_varchar(40),
+         ft_varchar(32), ft_varchar(32)],
+    ),
 }
 
 
@@ -86,6 +109,64 @@ def rows_for(session, name: str) -> list[list[Datum]]:
         from ..utils.metrics import REGISTRY
 
         return [[Datum.s(n), Datum.s(l), Datum.f(v)] for n, l, v in REGISTRY.rows()]
+    if name == "processlist":
+        import time as _time
+
+        now = _time.time()
+        out = []
+        for cid, info in session.store.process_snapshot():
+            out.append([
+                Datum.i(cid), Datum.s(info["user"]), Datum.s("127.0.0.1"),
+                Datum.s(info["db"]), Datum.s("Query" if info["sql"] else "Sleep"),
+                Datum.i(int(now - info["start"])), Datum.s("autocommit"),
+                Datum.s(info["sql"]) if info["sql"] else Datum.null(),
+            ])
+        return out
+    if name == "tidb_regions":
+        from ..codec import tablecodec
+
+        out = []
+        for r in session.store.regions.regions:
+            tid = -1
+            is_index = 0
+            if len(r.start) >= 9 and r.start[:1] == b"t":
+                try:
+                    tid = tablecodec.decode_table_id(r.start)
+                except Exception:  # noqa: BLE001 — raw boundary keys
+                    tid = -1
+                # auto-split keys can land inside the index keyspace
+                is_index = 1 if r.start[9:11] == b"_i" else 0
+            out.append([
+                Datum.i(r.id), Datum.s(r.start.hex()), Datum.s(r.end.hex()),
+                Datum.i(tid), Datum.i(is_index),
+            ])
+        return out
+    if name == "metrics_summary":
+        from ..utils.metrics import REGISTRY
+
+        agg: dict[str, list[float]] = {}
+        for n, _l, v in REGISTRY.rows():
+            agg.setdefault(n, []).append(float(v))
+        out = []
+        for n in sorted(agg):
+            vs = agg[n]
+            out.append([
+                Datum.s(n), Datum.i(len(vs)), Datum.f(sum(vs)),
+                Datum.f(sum(vs) / len(vs)), Datum.f(min(vs)), Datum.f(max(vs)),
+            ])
+        return out
+    if name == "inspection_result":
+        return _inspection_rows(session)
+    if name == "cluster_info":
+        import time as _time
+
+        start = getattr(session.store, "start_time", None) or _time.time()
+        up = int(_time.time() - start)
+        started = datetime.datetime.fromtimestamp(start).strftime("%Y-%m-%d %H:%M:%S")
+        return [[
+            Datum.s("tidb"), Datum.s("127.0.0.1:4000"), Datum.s("8.0.11-tidb-tpu"),
+            Datum.s("tpu-native"), Datum.s(started), Datum.s(f"{up}s"),
+        ]]
     if name == "tidb_indexes":
         is_ = session.infoschema()
         out = []
@@ -98,3 +179,42 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 ])
         return out
     raise KeyError(name)
+
+
+def _inspection_rows(session) -> list:
+    """Self-diagnosis rules over internal counters (ref:
+    executor/inspection_result.go — the reference fans out over cluster
+    metrics; single process, so the rules read in-memory state)."""
+    rows: list = []
+
+    def add(rule, item, value, reference, severity, details):
+        rows.append([
+            Datum.s(rule), Datum.s(item), Datum.s("tidb"), Datum.s(str(value)),
+            Datum.s(reference), Datum.s(severity), Datum.s(details),
+        ])
+
+    fallbacks = getattr(getattr(session.cop, "tpu", None), "fallbacks", 0)
+    if fallbacks:
+        add("engine", "tpu-fallback-count", fallbacks, "0", "warning",
+            "queries fell back from the device engine to the host engine")
+    hits = getattr(session, "plan_cache_hits", 0)
+    size = len(getattr(session, "_plan_cache", ()))
+    add("plan-cache", "entries", size, "-", "info", f"hits this session: {hits}")
+    slow = len(session.store.stmt_stats.slow)
+    if slow:
+        add("slow-query", "count", slow, "0", "warning",
+            "statements over the slow-log threshold (information_schema.slow_query)")
+    errs = sum(st["errors"] for st in session.store.stmt_stats.summary.values())
+    if errs:
+        add("statement", "error-count", errs, "0", "warning",
+            "failed statements recorded in statements_summary")
+    pending = [
+        t.name for t in session.infoschema().tables.values()
+        if session.store.stats.needs_analyze(t.id)
+    ]
+    if pending:
+        add("stats", "auto-analyze-pending", len(pending), "0", "info",
+            "tables past the modify ratio: " + ",".join(sorted(pending)[:8]))
+    nregions = len(session.store.regions.regions)
+    add("region", "count", nregions, "-", "info", "regions in the keyspace map")
+    return rows

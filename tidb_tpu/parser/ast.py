@@ -123,6 +123,7 @@ class Join:
     kind: str  # 'inner' | 'left' | 'right' | 'cross'
     on: Any = None
     using: list = field(default_factory=list)
+    straight: bool = False  # STRAIGHT_JOIN: written order is pinned
 
 
 # --- statements ------------------------------------------------------------

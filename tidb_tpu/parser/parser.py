@@ -381,6 +381,7 @@ class Parser:
                 continue
             if self.at_kw("JOIN", "INNER", "CROSS", "STRAIGHT_JOIN"):
                 kind = "inner"
+                straight = self.tok.upper == "STRAIGHT_JOIN"
                 if self.tok.upper == "CROSS":
                     kind = "cross"
                 if self.tok.upper in ("INNER", "CROSS"):
@@ -388,6 +389,7 @@ class Parser:
                 self.expect_kw("JOIN") if self.at_kw("JOIN") else self.next()
                 right = self.table_factor()
                 j = ast.Join(left, right, kind)
+                j.straight = straight
                 self._join_cond(j, natural)
                 left = j
                 continue

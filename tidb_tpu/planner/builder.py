@@ -309,6 +309,7 @@ class PlanBuilder:
         left = self.build_from(j.left)
         right = self.build_from(j.right)
         kind = j.kind
+        straight = getattr(j, "straight", False)
         cols = list(left.out_cols) + list(right.out_cols)
         scope = NameScope(cols)
         conds = []
@@ -335,7 +336,9 @@ class PlanBuilder:
                 other.append(c)
         if kind == "cross":
             kind = "inner"
-        return Join(left, right, kind, eq, other, cols)
+        jn = Join(left, right, kind, eq, other, cols)
+        jn.straight = straight
+        return jn
 
     @staticmethod
     def _as_eq_pair(c: Expression, nl: int):

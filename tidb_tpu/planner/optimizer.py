@@ -40,6 +40,7 @@ def _reorderable(n) -> bool:
         isinstance(n, Join)
         and n.kind in ("inner", "cross")
         and n.na_key is None
+        and not getattr(n, "straight", False)  # STRAIGHT_JOIN pins order
         and all(isinstance(c, (DataSource, Join)) for c in n.children)
     )
 

@@ -530,7 +530,9 @@ class Session:
         if isinstance(stmt, ast.KillStmt):
             return [("CONNECTION_ADMIN", "*")]
         if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.Grant, ast.Revoke,
-                             ast.AdminStmt)):
+                             ast.AdminStmt, ast.LoadStats)):
+            # LoadStats reads server-side files and rewrites shared
+            # statistics that steer every session's plans
             return [("SUPER", "*")]
         if isinstance(stmt, (ast.CreateBinding, ast.DropBinding)):
             # global bindings steer every session's plans; session-scoped
@@ -626,8 +628,13 @@ class Session:
         if isinstance(stmt, ast.LoadStats):
             import json as _json
 
-            with open(stmt.path, "r", encoding="utf8") as f:
-                self.store.stats.load_dump(self, _json.load(f))
+            try:
+                with open(stmt.path, "r", encoding="utf8") as f:
+                    self.store.stats.load_dump(self, _json.load(f))
+            except OSError as e:
+                raise TiDBError(f"Load Stats: open file {stmt.path!r} failed: {e.strerror}")
+            except (_json.JSONDecodeError, KeyError, TypeError) as e:
+                raise TiDBError(f"Load Stats: invalid stats dump: {e}")
             self._plan_cache.clear()
             return ResultSet([], None)
         if isinstance(stmt, ast.LockTables):

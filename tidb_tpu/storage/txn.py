@@ -296,6 +296,7 @@ class Storage:
         self.mvcc = MVCCStore(self.kv)
         self.tso = TSO()
         self.data_dir = data_dir
+        self.start_time = time.time()  # cluster_info uptime
         self.wal = None
         self._wal_epoch = 0
         self.regions = RegionMap()
