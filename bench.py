@@ -10,7 +10,7 @@ closure executor (BASELINE.md: "≥10× unistore cop throughput" is the
 north star; the Go engine isn't runnable in this image, so the ratio is
 reported against the strongest CPU path available).
 
-Env knobs: BENCH_ROWS (default 8,000,000 — ~TPC-H SF1.3 lineitem; large
+Env knobs: BENCH_ROWS (default 16,000,000 — ~TPC-H SF2.7 lineitem; large
 enough that the per-dispatch tunnel round-trip (~100ms fixed, measured) is
 amortized and the number reflects engine throughput), BENCH_QUERY (q1|q6|topn).
 """
@@ -30,7 +30,7 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
-    rows = int(os.environ.get("BENCH_ROWS", "8000000"))
+    rows = int(os.environ.get("BENCH_ROWS", "16000000"))
     which = os.environ.get("BENCH_QUERY", "q1")
     reps = int(os.environ.get("BENCH_REPS", "11"))
 

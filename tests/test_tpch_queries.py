@@ -1,0 +1,98 @@
+"""TPC-H query-shape suite over the 3-table mini schema: every query
+runs on both engines and must agree; Q3 is additionally checked against
+a pure-numpy oracle (ref: the explaintest/benchdb role — SURVEY §4.3/§6:
+identical data + plans through both the TPU cop path and the host
+oracle)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.models import tpch
+from tidb_tpu.session import Session
+
+N = 24_000
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = Session()
+    tpch.setup_tpch(sess, N)
+    return sess
+
+
+def both_engines(s, q):
+    outs = []
+    for eng in ("host", "tpu"):
+        s.vars["tidb_cop_engine"] = eng
+        outs.append(s.execute(q).rows())
+    s.vars["tidb_cop_engine"] = "auto"
+    assert outs[0] == outs[1], "host and tpu engines diverge"
+    return outs[0]
+
+
+class TestTPCHQueries:
+    def test_q1(self, s):
+        rows = both_engines(s, tpch.Q1)
+        assert 1 <= len(rows) <= 6
+        assert sum(int(r[-1]) for r in rows) <= N
+
+    def test_q3_vs_numpy_oracle(self, s):
+        rows = both_engines(s, tpch.Q3)
+        # oracle straight from the generators
+        li = tpch.gen_lineitem(N)
+        orders = tpch.gen_orders(max(N // 4, 2), max(N // 40, 2), 43)
+        cust = tpch.gen_customer(max(N // 40, 2), 44)
+        seg_ok = set(cust["c_custkey"][cust["c_mktsegment"] == "BUILDING"].tolist())
+        cutoff = None
+        from tidb_tpu.mysqltypes.coretime import parse_datetime
+
+        cutoff = parse_datetime("1995-03-15")
+        o_ok = {
+            int(k): int(d)
+            for k, c, d in zip(orders["o_orderkey"], orders["o_custkey"], orders["o_orderdate"])
+            if int(c) in seg_ok and int(d) < cutoff
+        }
+        rev: dict[int, int] = {}
+        for k, p, disc, sd in zip(li["l_orderkey"], li["l_extendedprice"], li["l_discount"], li["l_shipdate"]):
+            k = int(k)
+            if k in o_ok and int(sd) > cutoff:
+                rev[k] = rev.get(k, 0) + int(p) * (100 - int(disc))
+        # revenue decimals: price scale 2 × (1-disc) scale 2 → scale 4
+        want = sorted(((v, -k) for k, v in rev.items()), reverse=True)[:10]
+        got = [(int(r[0]), int(r[1].replace(".", ""))) for r in rows]
+        assert got == [(-nk, v) for v, nk in want]
+
+    def test_q4_exists_decorrelation(self, s):
+        rows = both_engines(s, tpch.Q4)
+        assert 1 <= len(rows) <= 5
+        assert [r[0] for r in rows] == sorted(r[0] for r in rows)
+
+    def test_q6(self, s):
+        rows = both_engines(s, tpch.Q6)
+        assert len(rows) == 1 and rows[0][0] is not None
+
+    def test_q10_top_customers(self, s):
+        rows = both_engines(s, tpch.Q10)
+        assert len(rows) == 20
+        revs = [float(r[2]) for r in rows]
+        assert revs == sorted(revs, reverse=True)
+        assert rows[0][1].startswith("Customer#")
+
+    def test_q18_having(self, s):
+        rows = both_engines(s, tpch.Q18)
+        assert 0 < len(rows) <= 10
+        assert all(float(r[1]) > 100 for r in rows)
+
+    def test_topn(self, s):
+        rows = both_engines(s, tpch.TOPN)
+        assert len(rows) == 100
+        prices = [float(r[1]) for r in rows]
+        assert prices == sorted(prices, reverse=True)
+
+    def test_no_tpu_fallbacks_on_scan_queries(self, s):
+        s.cop.tpu.fallbacks = 0
+        s.vars["tidb_cop_engine"] = "tpu"
+        s.execute(tpch.Q1)
+        s.execute(tpch.Q6)
+        s.vars["tidb_cop_engine"] = "auto"
+        assert s.cop.tpu.fallbacks == 0

@@ -309,7 +309,11 @@ register(
 
 register(FuncSig("ascii", lambda fts: ft_longlong(), _obj_map(lambda s: ord(_as_str(s)[0]) if _as_str(s) else 0), pushable=False, arity=1))
 register(FuncSig("space", lambda fts: ft_varchar(255), _obj_map(lambda n: " " * max(int(n), 0)), pushable=False, arity=1))
-register(FuncSig("hex", lambda fts: ft_varchar(255), _obj_map(lambda s: (_as_str(s).encode().hex().upper() if isinstance(s, (str, bytes)) else format(int(s), "X"))), pushable=False, arity=1))
+register(FuncSig("hex", lambda fts: ft_varchar(255), _obj_map(
+    lambda s: (bytes(s).hex().upper() if isinstance(s, (bytes, bytearray))
+               else s.encode("utf8").hex().upper() if isinstance(s, str)
+               # MySQL: negative ints hex as two's-complement uint64
+               else format(int(s) & ((1 << 64) - 1), "X"))), pushable=False, arity=1))
 register(FuncSig("unhex", lambda fts: ft_varchar(255), _obj_map(lambda s: bytes.fromhex(_as_str(s))), pushable=False, arity=1))
 register(FuncSig("lcase", lambda fts: ft_varchar(255), _obj_map(lambda s: _as_str(s).lower()), pushable=False, arity=1))
 register(FuncSig("ucase", lambda fts: ft_varchar(255), _obj_map(lambda s: _as_str(s).upper()), pushable=False, arity=1))

@@ -108,6 +108,46 @@ register(FuncSig("uncompressed_length", lambda fts: ft_longlong(), _obj_map(lamb
 register(FuncSig("random_bytes", lambda fts: ft_varchar(), _obj_map(lambda n: _os.urandom(int(n)) if 0 < int(n) <= 1024 else _null()), pushable=False, arity=1))
 
 
+def _mysql_aes_key(key: bytes, bits: int = 128) -> bytes:
+    """MySQL's key folding: XOR key bytes cyclically into the key buffer."""
+    n = bits // 8
+    out = bytearray(n)
+    for i, b in enumerate(key):
+        out[i % n] ^= b
+    return bytes(out)
+
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+def _aes_encrypt(data, key):
+    raw = _as_bytes(data)
+    pad = 16 - len(raw) % 16
+    raw += bytes([pad]) * pad  # PKCS7, always padded (MySQL semantics)
+    enc = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key))), modes.ECB()).encryptor()
+    return enc.update(raw) + enc.finalize()
+
+
+def _aes_decrypt(data, key):
+    raw = _as_bytes(data)
+    if not raw or len(raw) % 16:
+        _null()
+    dec = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key))), modes.ECB()).decryptor()
+    out = dec.update(raw) + dec.finalize()
+    pad = out[-1]
+    if not 1 <= pad <= 16 or out[-pad:] != bytes([pad]) * pad:
+        _null()  # wrong key → invalid padding → NULL (MySQL)
+    out = out[:-pad]
+    try:
+        return out.decode("utf8")
+    except UnicodeDecodeError:
+        return out
+
+
+register(FuncSig("aes_encrypt", lambda fts: ft_varchar(), _obj_map(_aes_encrypt), pushable=False, arity=2))
+register(FuncSig("aes_decrypt", lambda fts: ft_varchar(), _obj_map(_aes_decrypt), pushable=False, arity=2))
+
+
 def _password(s):
     from ..privilege.cache import mysql_native_hash
 

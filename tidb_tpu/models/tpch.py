@@ -117,6 +117,64 @@ def gen_lineitem(n_rows: int, seed: int = 42) -> dict[str, np.ndarray]:
 
 
 
+def gen_orders(n_orders: int, n_cust: int, seed: int = 43) -> dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    prios = np.array(["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"], dtype=object)
+    return {
+        "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_cust + 1, n_orders),
+        "o_orderstatus": np.where(rng.random(n_orders) < 0.5, "O", "F").astype(object),
+        "o_totalprice": rng.integers(90000, 50000000, n_orders),
+        "o_orderdate": _rand_dates(rng, n_orders),
+        "o_orderpriority": rng.choice(prios, n_orders),
+        "o_shippriority": np.zeros(n_orders, dtype=np.int64),
+    }
+
+
+def gen_customer(n_cust: int, seed: int = 44) -> dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    segs = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"], dtype=object)
+    return {
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n_cust + 1)], dtype=object),
+        "c_mktsegment": rng.choice(segs, n_cust),
+        "c_acctbal": rng.integers(-99999, 999999, n_cust),
+    }
+
+
+def setup_tpch(session, n_lineitem: int, seed: int = 42) -> None:
+    """Load lineitem + orders + customer at a consistent mini scale:
+    orderkeys correlate across lineitem/orders, custkeys across
+    orders/customer (dbgen's referential shape)."""
+    setup_lineitem(session, n_lineitem, seed)
+    n_orders = max(n_lineitem // 4, 2)
+    n_cust = max(n_orders // 10, 2)
+    session.execute("DROP TABLE IF EXISTS orders")
+    session.execute("DROP TABLE IF EXISTS customer")
+    session.execute(ORDERS_DDL)
+    session.execute(CUSTOMER_DDL)
+    bulk_load(session, "orders", gen_orders(n_orders, n_cust, seed + 1))
+    bulk_load(session, "customer", gen_customer(n_cust, seed + 2))
+
+
+Q4 = """SELECT o_orderpriority, COUNT(*) AS order_count
+FROM orders
+WHERE o_orderdate >= '1995-01-01' AND o_orderdate < '1996-01-01'
+AND EXISTS (SELECT 1 FROM lineitem WHERE l_orderkey = o_orderkey AND l_commitdate < l_receiptdate)
+GROUP BY o_orderpriority ORDER BY o_orderpriority"""
+
+Q10 = """SELECT c.c_custkey, c.c_name, SUM(l.l_extendedprice * (1 - l.l_discount)) AS revenue
+FROM customer c JOIN orders o ON c.c_custkey = o.o_custkey
+JOIN lineitem l ON l.l_orderkey = o.o_orderkey
+WHERE l.l_returnflag = 'R'
+GROUP BY c.c_custkey, c.c_name ORDER BY revenue DESC, c.c_custkey LIMIT 20"""
+
+Q18 = """SELECT o.o_orderkey, SUM(l.l_quantity) AS total_qty
+FROM orders o JOIN lineitem l ON l.l_orderkey = o.o_orderkey
+GROUP BY o.o_orderkey HAVING SUM(l.l_quantity) > 100
+ORDER BY total_qty DESC, o.o_orderkey LIMIT 10"""
+
+
 def _kind_of(ft) -> int:
     if ft.is_decimal():
         return K_DEC
