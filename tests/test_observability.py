@@ -133,3 +133,50 @@ class TestInspectionMemtables:
         rows = s.must_query(
             "select region_id from information_schema.tidb_regions")
         assert len(rows) >= 1
+
+
+class TestTopSQLAndDeadlocks:
+    """Top-SQL CPU attribution + deadlock history memtables (ref:
+    util/topsql, util/deadlockhistory)."""
+
+    def test_top_sql_records_cpu(self, s):
+        for _ in range(3):
+            s.must_query("select count(*) from information_schema.tables")
+        rows = s.must_query(
+            "select sql_digest, exec_count, sum_cpu_time from information_schema.top_sql")
+        assert rows, "top_sql is empty"
+        assert any(int(r[1]) >= 3 and float(r[2]) > 0 for r in rows)
+
+    def test_deadlock_history(self, s):
+        import threading
+        from tidb_tpu.session import Session
+
+        s.execute("create table dl (id int primary key, v int)")
+        s.execute("insert into dl values (1, 0), (2, 0)")
+        a = Session(s.store)
+        b = Session(s.store)
+        for x in (a, b):
+            x.execute("use test")
+            x.execute("set tidb_txn_mode = 'pessimistic'")
+        a.execute("begin")
+        b.execute("begin")
+        a.execute("update dl set v = 1 where id = 1")
+        b.execute("update dl set v = 2 where id = 2")
+        errors = []
+
+        def cross(sess, target):
+            try:
+                sess.execute(f"update dl set v = 9 where id = {target}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(type(e).__name__)
+
+        t = threading.Thread(target=cross, args=(a, 2))
+        t.start()
+        cross(b, 1)
+        t.join()
+        a.execute("rollback")
+        b.execute("rollback")
+        assert "DeadlockError" in errors
+        rows = s.must_query(
+            "select deadlock_id, try_lock_trx_id from information_schema.deadlocks")
+        assert rows, "deadlock history is empty"

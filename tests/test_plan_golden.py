@@ -1,0 +1,99 @@
+"""Golden-file plan tests — the cmd/explaintest + planner/core/testdata
+analog (ref: SURVEY §4.3): EXPLAIN output for a fixed schema/stats setup
+is pinned in tests/testdata/plans.json. A plan change is a deliberate
+act: regenerate with
+
+    REGENERATE_PLANS=1 python -m pytest tests/test_plan_golden.py
+
+and review the diff like the reference reviews .result files."""
+
+import json
+import os
+import pathlib
+
+import pytest
+
+from tidb_tpu.session import Session
+
+GOLDEN = pathlib.Path(__file__).parent / "testdata" / "plans.json"
+
+QUERIES = [
+    # scans + access paths
+    "select * from t where id = 7",
+    "select * from t where id in (1, 2, 3)",
+    "select id from t where id between 10 and 20",
+    "select id from t where a = 3",
+    "select c from t where a = 3",
+    "select c from t where a = 3 and b > 100",
+    "select c from t where a = 3 or b = 8",
+    "select /*+ USE_INDEX(t, ia) */ id from t where a > 1",
+    "select /*+ IGNORE_INDEX(t, ia) */ id from t where a = 3",
+    # filters + projections
+    "select id + 1, upper(c) from t where a < 5 and c like 'v%'",
+    # aggregation shapes
+    "select a, count(*), sum(b) from t group by a",
+    "select count(distinct a) from t",
+    "select a, sum(b) from t where b > 0 group by a having sum(b) > 10",
+    # topn / limit
+    "select * from t order by b desc limit 5",
+    "select * from t limit 10",
+    # joins (reorder: small s before big t)
+    "select count(*) from t join s on t.a = s.id",
+    "select count(*) from t join s on t.a = s.id join u on s.id = u.id",
+    "select count(*) from t straight_join s on t.a = s.id",
+    "select t.id from t left join s on t.a = s.id where s.id is null",
+    # subqueries
+    "select id from t where a in (select id from s)",
+    "select id from t where not exists (select 1 from s where s.id = t.a)",
+    # window
+    "select id, sum(b) over (partition by a) from t",
+    # partitioned table pruning
+    "select * from p where k = 150",
+    "select * from p where k < 100",
+    # union
+    "select id from t where a = 1 union select id from s",
+]
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = Session()
+    sess.execute(
+        "create table t (id int primary key, a int, b int, c varchar(20), "
+        "key ia (a), unique key ib (b))"
+    )
+    sess.execute(
+        "insert into t values "
+        + ",".join(f"({i},{i % 10},{i * 2},'v{i}')" for i in range(200))
+    )
+    sess.execute("create table s (id int primary key, x int)")
+    sess.execute("insert into s values " + ",".join(f"({i},{i})" for i in range(10)))
+    sess.execute("create table u (id int primary key)")
+    sess.execute("insert into u values (1),(2)")
+    sess.execute(
+        "create table p (k int primary key, v int) partition by range (k) ("
+        "partition p0 values less than (100), partition p1 values less than (300))"
+    )
+    sess.execute("insert into p values (50, 1), (150, 2)")
+    for tbl in ("t", "s", "u"):
+        sess.execute(f"analyze table {tbl}")
+    return sess
+
+
+def _plan(s, q) -> list[str]:
+    return [r[0] for r in s.must_query("explain " + q)]
+
+
+def test_plans_match_golden(s):
+    plans = {q: _plan(s, q) for q in QUERIES}
+    if os.environ.get("REGENERATE_PLANS"):
+        GOLDEN.parent.mkdir(exist_ok=True)
+        GOLDEN.write_text(json.dumps(plans, indent=1))
+        pytest.skip("golden plans regenerated")
+    assert GOLDEN.exists(), "run REGENERATE_PLANS=1 pytest tests/test_plan_golden.py once"
+    want = json.loads(GOLDEN.read_text())
+    assert set(want) == set(plans), "query list changed: regenerate the golden file"
+    diffs = {q: (want[q], plans[q]) for q in QUERIES if want[q] != plans[q]}
+    assert not diffs, "plans changed:\n" + "\n".join(
+        f"--- {q}\n  golden: {w}\n  actual: {g}" for q, (w, g) in diffs.items()
+    )

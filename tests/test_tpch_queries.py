@@ -39,13 +39,10 @@ class TestTPCHQueries:
     def test_q3_vs_numpy_oracle(self, s):
         rows = both_engines(s, tpch.Q3)
         # oracle straight from the generators
-        li = tpch.gen_lineitem(N)
-        orders = tpch.gen_orders(max(N // 4, 2), max(N // 40, 2), 43)
-        cust = tpch.gen_customer(max(N // 40, 2), 44)
-        seg_ok = set(cust["c_custkey"][cust["c_mktsegment"] == "BUILDING"].tolist())
-        cutoff = None
+        li, orders, cust = tpch.generated_columns(N)
         from tidb_tpu.mysqltypes.coretime import parse_datetime
 
+        seg_ok = set(cust["c_custkey"][cust["c_mktsegment"] == "BUILDING"].tolist())
         cutoff = parse_datetime("1995-03-15")
         o_ok = {
             int(k): int(d)

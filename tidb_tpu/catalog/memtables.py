@@ -59,6 +59,14 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         [ft_varchar(16), ft_varchar(64), ft_varchar(32), ft_varchar(40),
          ft_varchar(32), ft_varchar(32)],
     ),
+    "deadlocks": (
+        ["DEADLOCK_ID", "OCCUR_TIME", "TRY_LOCK_TRX_ID", "TRX_HOLDING_LOCK"],
+        [ft_longlong(), ft_varchar(32), ft_longlong(), ft_longlong()],
+    ),
+    "top_sql": (
+        ["SQL_DIGEST", "EXEC_COUNT", "SUM_CPU_TIME", "AVG_CPU_TIME", "SAMPLE_SQL"],
+        [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_varchar(256)],
+    ),
 }
 
 
@@ -97,7 +105,10 @@ def rows_for(session, name: str) -> list[list[Datum]]:
         return out
     if name == "statements_summary":
         out = []
-        for st in session.store.stmt_stats.summary.values():
+        ss = session.store.stmt_stats
+        with ss._lock:
+            snap = [dict(st) for st in ss.summary.values()]
+        for st in snap:
             avg = st["sum_latency_s"] / st["exec_count"] if st["exec_count"] else 0.0
             out.append([
                 Datum.s(st["digest"]), Datum.i(st["exec_count"]),
@@ -153,6 +164,34 @@ def rows_for(session, name: str) -> list[list[Datum]]:
             out.append([
                 Datum.s(n), Datum.i(len(vs)), Datum.f(sum(vs)),
                 Datum.f(sum(vs) / len(vs)), Datum.f(min(vs)), Datum.f(max(vs)),
+            ])
+        return out
+    if name == "deadlocks":
+        out = []
+        det = session.store.detector
+        with det._lock:
+            hist = list(det.history)
+        for d in hist:
+            ts = datetime.datetime.fromtimestamp(d["time"]).strftime("%Y-%m-%d %H:%M:%S")
+            out.append([
+                Datum.i(d["id"]), Datum.s(ts),
+                Datum.i(d["try_lock_trx"]), Datum.i(d["holding_trx"]),
+            ])
+        return out
+    if name == "top_sql":
+        ss = session.store.stmt_stats
+        with ss._lock:  # concurrent record() must not mutate mid-sort
+            snap = [dict(st) for st in ss.summary.values()]
+        entries = sorted(
+            snap, key=lambda st: st.get("sum_cpu_s", 0.0), reverse=True,
+        )[:32]
+        out = []
+        for st in entries:
+            cpu = st.get("sum_cpu_s", 0.0)
+            avg = cpu / st["exec_count"] if st["exec_count"] else 0.0
+            out.append([
+                Datum.s(st["digest"]), Datum.i(st["exec_count"]),
+                Datum.f(cpu), Datum.f(avg), Datum.s(st["sample_sql"]),
             ])
         return out
     if name == "inspection_result":

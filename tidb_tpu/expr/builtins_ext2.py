@@ -117,10 +117,15 @@ def _mysql_aes_key(key: bytes, bits: int = 128) -> bytes:
     return bytes(out)
 
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:  # optional dependency: only AES_ENCRYPT/DECRYPT need it
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+except ImportError:  # pragma: no cover — baked into this image
+    Cipher = None
 
 
 def _aes_encrypt(data, key):
+    if Cipher is None:
+        raise TypeError("AES functions require the 'cryptography' package")
     raw = _as_bytes(data)
     pad = 16 - len(raw) % 16
     raw += bytes([pad]) * pad  # PKCS7, always padded (MySQL semantics)
@@ -129,6 +134,8 @@ def _aes_encrypt(data, key):
 
 
 def _aes_decrypt(data, key):
+    if Cipher is None:
+        raise TypeError("AES functions require the 'cryptography' package")
     raw = _as_bytes(data)
     if not raw or len(raw) % 16:
         _null()

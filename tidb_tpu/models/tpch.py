@@ -142,19 +142,32 @@ def gen_customer(n_cust: int, seed: int = 44) -> dict[str, np.ndarray]:
     }
 
 
+def generated_columns(n_lineitem: int, seed: int = 42):
+    """The exact (lineitem, orders, customer) column dicts setup_tpch
+    loads — single source of truth for test oracles."""
+    n_orders = max(n_lineitem // 4, 2)
+    n_cust = max(n_orders // 10, 2)
+    return (
+        gen_lineitem(n_lineitem, seed),
+        gen_orders(n_orders, n_cust, seed + 1),
+        gen_customer(n_cust, seed + 2),
+    )
+
+
 def setup_tpch(session, n_lineitem: int, seed: int = 42) -> None:
     """Load lineitem + orders + customer at a consistent mini scale:
     orderkeys correlate across lineitem/orders, custkeys across
     orders/customer (dbgen's referential shape)."""
-    setup_lineitem(session, n_lineitem, seed)
-    n_orders = max(n_lineitem // 4, 2)
-    n_cust = max(n_orders // 10, 2)
+    li, orders, cust = generated_columns(n_lineitem, seed)
+    session.execute("DROP TABLE IF EXISTS lineitem")
     session.execute("DROP TABLE IF EXISTS orders")
     session.execute("DROP TABLE IF EXISTS customer")
+    session.execute(LINEITEM_DDL)
     session.execute(ORDERS_DDL)
     session.execute(CUSTOMER_DDL)
-    bulk_load(session, "orders", gen_orders(n_orders, n_cust, seed + 1))
-    bulk_load(session, "customer", gen_customer(n_cust, seed + 2))
+    bulk_load(session, "lineitem", li)
+    bulk_load(session, "orders", orders)
+    bulk_load(session, "customer", cust)
 
 
 Q4 = """SELECT o_orderpriority, COUNT(*) AS order_count

@@ -277,6 +277,7 @@ class Session:
                 "session": weakref.ref(self),
             })
         t0 = time.perf_counter()
+        c0 = time.thread_time()  # Top-SQL CPU attribution by digest
         ok = True
         try:
             rs = self._execute_stmt(stmt, sql=sql)
@@ -292,6 +293,7 @@ class Session:
             _ACTIVE_TRACKER.reset(token)
             _ACTIVE_SESSION.reset(stok)
             dur = time.perf_counter() - t0
+            cpu = time.thread_time() - c0
             if not self._in_bootstrap:
                 self.store.clear_process(self.conn_id)
                 self.store.plugins.fire("on_query", self.user, self.current_db, sql, ok, dur)
@@ -303,7 +305,7 @@ class Session:
                     # redacts user-admin statements from logs)
                     sql = f"<redacted {type(stmt).__name__}>"
                 self.store.stmt_stats.record(
-                    sql, dur, self.user, self.current_db, ok, threshold
+                    sql, dur, self.user, self.current_db, ok, threshold, cpu_s=cpu
                 )
 
     def must_query(self, sql: str) -> list[tuple]:

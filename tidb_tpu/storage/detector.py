@@ -10,15 +10,21 @@ first-waiter victim policy.
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from threading import Lock
 
 from ..errors import DeadlockError
 
 
 class DeadlockDetector:
-    def __init__(self):
+    def __init__(self, history_capacity: int = 64):
         self._lock = Lock()
         self._wait_for: dict[int, int] = {}  # waiter start_ts → holder start_ts
+        # recent deadlocks for information_schema.deadlocks
+        # (ref: util/deadlockhistory)
+        self.history: deque = deque(maxlen=history_capacity)
+        self._next_id = 1
 
     def register(self, waiter: int, holder: int) -> None:
         """Record waiter→holder; raises DeadlockError if it closes a cycle."""
@@ -26,6 +32,13 @@ class DeadlockDetector:
             cur = holder
             for _ in range(len(self._wait_for) + 1):
                 if cur == waiter:
+                    self.history.append({
+                        "id": self._next_id,
+                        "time": time.time(),
+                        "try_lock_trx": waiter,
+                        "holding_trx": holder,
+                    })
+                    self._next_id += 1
                     raise DeadlockError(
                         f"Deadlock found when trying to get lock: txn {waiter} waits for {holder}"
                     )

@@ -44,7 +44,7 @@ class StmtStats:
         self.summary_capacity = summary_capacity
         self._lock = threading.Lock()
 
-    def record(self, sql: str, dur_s: float, user: str, db: str, ok: bool, slow_threshold_s: float) -> None:
+    def record(self, sql: str, dur_s: float, user: str, db: str, ok: bool, slow_threshold_s: float, cpu_s: float = 0.0) -> None:
         digest = sql_digest(sql)
         now = time.time()
         with self._lock:
@@ -60,12 +60,14 @@ class StmtStats:
                     "exec_count": 0,
                     "sum_latency_s": 0.0,
                     "max_latency_s": 0.0,
+                    "sum_cpu_s": 0.0,
                     "errors": 0,
                 }
                 self.summary[digest] = st
             st["exec_count"] += 1
             st["sum_latency_s"] += dur_s
             st["max_latency_s"] = max(st["max_latency_s"], dur_s)
+            st["sum_cpu_s"] = st.get("sum_cpu_s", 0.0) + cpu_s
             if not ok:
                 st["errors"] += 1
             if dur_s >= slow_threshold_s:
