@@ -27,6 +27,7 @@ P_DB = b"m:db:"
 P_TBL = b"m:tbl:"
 P_JOB = b"m:job:"  # queued/running DDL jobs (ref: meta job queues, ddl_worker.go:67)
 P_JOB_HIST = b"m:jobh:"  # finished jobs (ADMIN SHOW DDL JOBS)
+P_SEQ = b"m:seq:"  # sequences (ref: ddl sequence objects, meta/autoid SequenceAllocator)
 
 
 class Meta:
@@ -87,6 +88,25 @@ class Meta:
         for _, v in self.txn.scan(P_TBL, P_TBL + b"\xff"):
             out.append(TableInfo.from_json(json.loads(v)))
         return out
+
+    # --- sequences (ref: 2020-04-17-sql-sequence.md; cached allocation) ----
+
+    @staticmethod
+    def _seq_key(db: str, name: str) -> bytes:
+        return P_SEQ + f"{db.lower()}.{name.lower()}".encode()
+
+    def sequence(self, db: str, name: str) -> dict | None:
+        raw = self.txn.get(self._seq_key(db, name))
+        return json.loads(raw) if raw else None
+
+    def put_sequence(self, d: dict) -> None:
+        self.txn.put(self._seq_key(d["db"], d["name"]), json.dumps(d).encode())
+
+    def drop_sequence(self, db: str, name: str) -> None:
+        self.txn.delete(self._seq_key(db, name))
+
+    def list_sequences(self) -> list[dict]:
+        return [json.loads(v) for _, v in self.txn.scan(P_SEQ, P_SEQ + b"\xff")]
 
     # --- DDL job queue (ref: ddl.go:535 doDDLJob, meta job lists) ----------
 

@@ -370,6 +370,24 @@ class AdminStmt:
 
 
 @dataclass
+class CreateSequence:
+    table: Any  # TableName (sequences share the table namespace)
+    start: int = 1
+    increment: int = 1
+    cache: int = 1000
+    maxvalue: int | None = None
+    minvalue: int | None = None
+    cycle: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence:
+    names: list  # [TableName]
+    if_exists: bool = False
+
+
+@dataclass
 class LoadStats:
     path: str
 

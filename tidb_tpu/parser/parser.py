@@ -1042,6 +1042,40 @@ class Parser:
         if self.try_kw("USER"):
             ine = self._if_not_exists()
             return ast.CreateUser(self._user_spec_list(), ine)
+        if self.try_kw("SEQUENCE"):
+            ine = self._if_not_exists()
+            tn = self._table_name()
+            node = ast.CreateSequence(tn, if_not_exists=ine)
+            while self.tok.kind == "ident":
+                up = self.tok.upper
+                if up == "START":
+                    self.next()
+                    self.try_kw("WITH")
+                    node.start = self._int_bound()
+                elif up == "INCREMENT":
+                    self.next()
+                    self.try_kw("BY")
+                    node.increment = self._int_bound()
+                elif up == "CACHE":
+                    self.next()
+                    node.cache = self._int_bound()
+                elif up == "MAXVALUE":
+                    self.next()
+                    node.maxvalue = self._int_bound()
+                elif up == "MINVALUE":
+                    self.next()
+                    node.minvalue = self._int_bound()
+                elif up == "NOCACHE":
+                    self.next()
+                    node.cache = 1
+                elif up == "CYCLE":
+                    self.next()
+                    node.cycle = True
+                elif up in ("NOCYCLE", "NOMAXVALUE", "NOMINVALUE"):
+                    self.next()
+                else:
+                    break
+            return node
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ine = self._if_not_exists()
@@ -1228,6 +1262,12 @@ class Parser:
         if self.try_kw("USER"):
             ie = self._if_exists()
             return ast.DropUser(self._user_spec_list(), ie)
+        if self.try_kw("SEQUENCE"):
+            ie = self._if_exists()
+            names = [self._table_name()]
+            while self.try_op(","):
+                names.append(self._table_name())
+            return ast.DropSequence(names, ie)
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ie = self._if_exists()

@@ -128,9 +128,10 @@ class PlanBuilder:
         c = scope.cols[idx]
         return ECol(idx, c.ft, c.name)
 
-    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None, context_info=None, hints=None, expose_rowid=None):
+    def __init__(self, infoschema, current_db: str, run_subquery=None, params=None, memtable_rows=None, context_info=None, hints=None, expose_rowid=None, seq_hook=None):
         self.is_ = infoschema
         self.db = current_db
+        self.seq_hook = seq_hook  # session.sequence_op for NEXTVAL/LASTVAL/SETVAL
         # aliases whose hidden `_tidb_rowid` must be addressable (multi-
         # table DML projects per-target handles through the join)
         self.expose_rowid = expose_rowid or set()
@@ -390,6 +391,8 @@ class PlanBuilder:
                 return agg_ctx.add_agg(node, scope)
             if lname == "in_subquery":
                 return self._in_subquery(node, scope, agg_ctx)
+            if lname in ("nextval", "next_value", "lastval", "setval") and self.seq_hook is not None:
+                return self._sequence_expr(lname, node, scope, agg_ctx)
             if lname in ("date_add", "date_sub", "adddate", "subdate") and len(node.args) == 2 \
                     and isinstance(node.args[1], ast.Interval):
                 iv = node.args[1]
@@ -433,6 +436,25 @@ class PlanBuilder:
         if isinstance(node, ast.Star):
             raise TiDBError("* not allowed in this context")
         raise TiDBError(f"unsupported expression {type(node).__name__}")
+
+    def _sequence_expr(self, lname: str, node, scope, agg_ctx):
+        """NEXTVAL(seq)/LASTVAL(seq)/SETVAL(seq, n): the first argument is
+        a sequence IDENTIFIER, not a column (parser sees a Name)."""
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            raise TiDBError(f"{lname} requires a sequence name argument")
+        sn = node.args[0]
+        db = sn.parts[0] if len(sn.parts) >= 2 else self.db
+        name = sn.parts[-1]
+        op = "nextval" if lname == "next_value" else lname
+        arg = None
+        if op == "setval":
+            if len(node.args) != 2:
+                raise TiDBError("SETVAL requires (sequence, value)")
+            arg = self.to_expr(node.args[1], scope, agg_ctx)
+        elif len(node.args) != 1:
+            raise TiDBError(f"{lname} takes exactly one argument")
+        self.used_eager_subquery = True  # stateful: keep out of the plan cache
+        return _SeqExpr(op, db, name, self.seq_hook, arg)
 
     def _info_func(self, lname: str, node) -> Constant | None:
         """Session/time information functions evaluated at plan time
@@ -1130,6 +1152,50 @@ def sel_has_agg(sel) -> bool:
         return False  # SubqueryExpr: nested aggs belong to the inner scope
 
     return any(walk(f.expr) for f in sel.fields if not isinstance(f, ast.Star))
+
+
+class _SeqExpr(Expression):
+    """NEXTVAL/LASTVAL/SETVAL over a sequence — evaluated per ROW at
+    runtime through the session hook (ref: expression/builtin_other.go
+    nextVal/lastVal/setVal; a cached batch makes per-row calls cheap)."""
+
+    def __init__(self, op: str, db: str, name: str, hook, arg: Expression | None = None):
+        self.op = op
+        self.db = db
+        self.name = name
+        self.hook = hook
+        self.arg = arg
+        self.ret_type = ft_longlong()
+
+    def collect_columns(self, out):
+        if self.arg is not None:
+            self.arg.collect_columns(out)
+
+    def pushable(self) -> bool:
+        return False  # stateful: never ships to the device engine
+
+    def eval(self, chunk):
+        import numpy as np
+
+        n = max(chunk.num_rows, 1)
+        if self.op == "lastval":
+            v = self.hook("lastval", self.db, self.name)
+            if v is None:
+                return np.zeros(n, np.int64), np.zeros(n, bool)
+            return np.full(n, v, np.int64), np.ones(n, bool)
+        if self.op == "setval":
+            d, valid = self.arg.eval(chunk)
+            if not np.asarray(valid).reshape(-1)[0]:
+                return np.zeros(n, np.int64), np.zeros(n, bool)  # SETVAL(s, NULL) → NULL
+            v = self.hook("setval", self.db, self.name, int(np.asarray(d).reshape(-1)[0]))
+            return np.full(n, v, np.int64), np.ones(n, bool)
+        out = np.fromiter(
+            (self.hook("nextval", self.db, self.name) for _ in range(n)), np.int64, n
+        )
+        return out, np.ones(n, bool)
+
+    def __repr__(self):
+        return f"{self.op}({self.db}.{self.name})"
 
 
 class _CorrRef(Expression):
