@@ -149,3 +149,21 @@ class TestSequenceReviewFixes:
         # cache=1: interleaved sessions get strictly sequential values
         vals = [int(x.must_query("select nextval(nc)")[0][0]) for x in (s, a, s, a)]
         assert vals == [1, 2, 3, 4]
+
+    def test_drop_invalidates_other_sessions_cache(self, s):
+        s.execute("create sequence sq cache 100")
+        a = Session(s.store); a.execute("use test")
+        assert a.must_query("select nextval(sq)") == [("1",)]  # a caches 1..100
+        s.execute("drop sequence sq")
+        with pytest.raises(TiDBError):
+            a.execute("select nextval(sq)")
+        s.execute("create sequence sq start with 500")
+        assert a.must_query("select nextval(sq)") == [("500",)]
+
+    def test_setval_per_row(self, s):
+        s.execute("create sequence sq")
+        s.execute("create table sv (x int primary key)")
+        s.execute("insert into sv values (10),(20),(30)")
+        rows = s.must_query("select setval(sq, x) from sv order by x")
+        assert [r[0] for r in rows] == ["10", "20", "30"]
+        assert int(s.must_query("select nextval(sq)")[0][0]) == 31

@@ -30,6 +30,7 @@ class MiniMySQLClient:
         self._write_packet(payload)
         ok = self._read_packet()
         assert ok[0] == 0x00, f"auth failed: {ok!r}"
+        self._cursor_fts: dict[int, list] = {}
 
     # --- framing ----------------------------------------------------------
 
@@ -126,12 +127,14 @@ class MiniMySQLClient:
             assert self._read_packet()[0] == 0xFE
         return stmt_id, nparams
 
-    def stmt_execute(self, stmt_id: int, params: list, send_types: bool = True):
+    def stmt_execute(self, stmt_id: int, params: list, send_types: bool = True,
+                     cursor: bool = False):
         """Binary execute; params: None/int/float/str. Returns like query().
         send_types=False mimics C clients that bind types only on the
-        first execute (new-params-bound-flag = 0)."""
+        first execute (new-params-bound-flag = 0). cursor=True requests a
+        read-only server-side cursor."""
         self.seq = 0
-        payload = b"\x17" + struct.pack("<IBI", stmt_id, 0, 1)
+        payload = b"\x17" + struct.pack("<IBI", stmt_id, 1 if cursor else 0, 1)
         n = len(params)
         if n:
             nb = bytearray((n + 7) // 8)
@@ -172,7 +175,12 @@ class MiniMySQLClient:
                 ln, pos = self._lenc(cdef, pos)
                 pos += ln
             fts.append(cdef[pos + 7])
-        assert self._read_packet()[0] == 0xFE
+        eof = self._read_packet()
+        assert eof[0] == 0xFE
+        status = struct.unpack_from("<H", eof, 3)[0]
+        if status & 0x40:  # SERVER_STATUS_CURSOR_EXISTS: no inline rows
+            self._cursor_fts[stmt_id] = fts
+            return ("cursor", status)
         rows = []
         while True:
             pkt = self._read_packet()
@@ -213,6 +221,19 @@ class MiniMySQLClient:
                 row.append(pkt[pos : pos + ln].decode("utf8"))
                 pos += ln
         return tuple(row)
+
+    def stmt_fetch(self, stmt_id: int, n: int):
+        """→ (rows, done) pulled from a server-side cursor."""
+        self.seq = 0
+        self._write_packet(b"\x1c" + struct.pack("<II", stmt_id, n))
+        fts = self._cursor_fts[stmt_id]
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                status = struct.unpack_from("<H", pkt, 3)[0]
+                return rows, bool(status & 0x80)
+            rows.append(self._parse_binary_row(pkt, fts))
 
     def stmt_close(self, stmt_id: int) -> None:
         self.seq = 0
@@ -395,4 +416,64 @@ class TestBinaryProtocol:
         sid, _ = client.stmt_prepare("select * from z where id = ?")
         with pytest.raises(RuntimeError):
             client.stmt_execute(sid, [1], send_types=False)
+        client.stmt_close(sid)
+
+
+class TestServerSideCursors:
+    """COM_STMT_EXECUTE with CURSOR_TYPE_READ_ONLY + COM_STMT_FETCH
+    (ref: server/conn_stmt.go:156 useCursor, handleStmtFetch)."""
+
+    def test_fetch_in_batches(self, client):
+        client.query("create database if not exists cur")
+        client.query("use cur")
+        client.query("create table c (id int primary key)")
+        client.query("insert into c values " + ",".join(f"({i})" for i in range(10)))
+        sid, _ = client.stmt_prepare("select id from c order by id")
+        kind, status = client.stmt_execute(sid, [], cursor=True)
+        assert kind == "cursor"
+        rows1, done1 = client.stmt_fetch(sid, 4)
+        assert [r[0] for r in rows1] == [0, 1, 2, 3] and not done1
+        rows2, done2 = client.stmt_fetch(sid, 4)
+        assert [r[0] for r in rows2] == [4, 5, 6, 7] and not done2
+        rows3, done3 = client.stmt_fetch(sid, 10)
+        assert [r[0] for r in rows3] == [8, 9] and done3
+        client.stmt_close(sid)
+
+    def test_fetch_without_cursor_errors(self, client):
+        client.query("create database if not exists cur2")
+        client.query("use cur2")
+        client.query("create table c2 (id int primary key)")
+        sid, _ = client.stmt_prepare("select id from c2")
+        kind, _ = client.stmt_execute(sid, [])  # plain execute, no cursor
+        with pytest.raises(KeyError):
+            client.stmt_fetch(sid, 1)  # client has no cursor fts either
+        client.stmt_close(sid)
+
+    def test_reexecute_resets_cursor(self, client):
+        client.query("create database if not exists cur3")
+        client.query("use cur3")
+        client.query("create table c3 (id int primary key)")
+        client.query("insert into c3 values (1),(2),(3)")
+        sid, _ = client.stmt_prepare("select id from c3 order by id")
+        client.stmt_execute(sid, [], cursor=True)
+        client.stmt_fetch(sid, 1)
+        client.stmt_execute(sid, [], cursor=True)  # restart
+        rows, done = client.stmt_fetch(sid, 10)
+        assert [r[0] for r in rows] == [1, 2, 3] and done
+        client.stmt_close(sid)
+
+    def test_plain_reexecute_closes_cursor(self, client):
+        client.query("create database if not exists cur4")
+        client.query("use cur4")
+        client.query("create table c4 (id int primary key)")
+        client.query("insert into c4 values (1),(2),(3),(4),(5)")
+        sid, _ = client.stmt_prepare("select id from c4 order by id")
+        client.stmt_execute(sid, [], cursor=True)
+        client.stmt_fetch(sid, 2)
+        client.stmt_execute(sid, [])  # plain execute: cursor must close
+        import struct as _s
+        client.seq = 0
+        client._write_packet(b"\x1c" + _s.pack("<II", sid, 2))
+        pkt = client._read_packet()
+        assert pkt[0] == 0xFF, "fetch after plain re-execute must error"
         client.stmt_close(sid)

@@ -1185,10 +1185,16 @@ class _SeqExpr(Expression):
             return np.full(n, v, np.int64), np.ones(n, bool)
         if self.op == "setval":
             d, valid = self.arg.eval(chunk)
-            if not np.asarray(valid).reshape(-1)[0]:
-                return np.zeros(n, np.int64), np.zeros(n, bool)  # SETVAL(s, NULL) → NULL
-            v = self.hook("setval", self.db, self.name, int(np.asarray(d).reshape(-1)[0]))
-            return np.full(n, v, np.int64), np.ones(n, bool)
+            d = np.asarray(d).reshape(-1)
+            valid = np.asarray(valid).reshape(-1)
+            out = np.zeros(n, np.int64)
+            ok = np.zeros(n, bool)
+            for i in range(n):
+                di, vi = d[i % len(d)], valid[i % len(valid)]
+                if vi:  # SETVAL(s, NULL) → NULL for that row
+                    out[i] = self.hook("setval", self.db, self.name, int(di))
+                    ok[i] = True
+            return out, ok
         out = np.fromiter(
             (self.hook("nextval", self.db, self.name) for _ in range(n)), np.int64, n
         )

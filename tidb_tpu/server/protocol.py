@@ -40,6 +40,10 @@ SERVER_CAPABILITIES = (
 
 SERVER_STATUS_IN_TRANS = 0x1
 SERVER_STATUS_AUTOCOMMIT = 0x2
+SERVER_STATUS_CURSOR_EXISTS = 0x40
+SERVER_STATUS_LAST_ROW_SENT = 0x80
+
+CURSOR_TYPE_READ_ONLY = 0x1
 
 # commands (ref: dispatch, server/conn.go:1112)
 COM_QUIT = 0x01

@@ -55,6 +55,9 @@ class ClientConn:
         self.alive = True
         # wire prepared statements: stmt_id → (parsed ast, n_params, long_data)
         self.stmts: dict[int, list] = {}
+        # server-side cursors: stmt_id → (pending rows, fts)
+        # (ref: conn_stmt.go useCursor + OnFetchReturned)
+        self.cursors: dict[int, list] = {}
         self._next_stmt_id = 1
 
     def _status(self) -> int:
@@ -132,15 +135,20 @@ class ClientConn:
         if cmd == p.COM_STMT_SEND_LONG_DATA:
             return self.handle_stmt_long_data(data)
         if cmd == p.COM_STMT_CLOSE:
-            self.stmts.pop(int.from_bytes(data[:4], "little"), None)
+            sid = int.from_bytes(data[:4], "little")
+            self.stmts.pop(sid, None)
+            self.cursors.pop(sid, None)
             return  # no response by spec
         if cmd == p.COM_STMT_RESET:
             sid = int.from_bytes(data[:4], "little")
             ent = self.stmts.get(sid)
             if ent is not None:
                 ent[2].clear()
+                self.cursors.pop(sid, None)
             self.pkt.write_packet(p.ok_packet(status=self._status()))
             return
+        if cmd == p.COM_STMT_FETCH:
+            return self.handle_stmt_fetch(data)
         self.pkt.write_packet(p.err_packet(1047, f"unsupported command {cmd:#x}"))
 
     # --- binary prepared statements (ref: server/conn_stmt.go) -------------
@@ -173,6 +181,7 @@ class ClientConn:
         if ent is None:
             self.pkt.write_packet(p.err_packet(1243, f"Unknown prepared statement handler ({sid})"))
             return
+        use_cursor = len(data) > 4 and bool(data[4] & p.CURSOR_TYPE_READ_ONLY)
         parsed, n_params, long_data, bound_types = ent
         import struct as _struct
 
@@ -193,7 +202,40 @@ class ClientConn:
             log.exception("stmt execute failed")
             self.pkt.write_packet(p.err_packet(1105, f"internal error: {e}"))
             return
+        # MySQL: re-execute implicitly closes any previous cursor
+        self.cursors.pop(sid, None)
+        if use_cursor and rs.names:
+            # cursor mode: column defs now, rows held for COM_STMT_FETCH
+            fts = rs.chunk.field_types() if rs.chunk is not None else []
+            self.cursors[sid] = [list(rs.rows()), fts]
+            self.pkt.write_packet(p.lenc_int(len(rs.names)))
+            for name, ft in zip(rs.names, fts):
+                self.pkt.write_packet(p.column_def(name, ft))
+            self.pkt.write_packet(
+                p.eof_packet(status=self._status() | p.SERVER_STATUS_CURSOR_EXISTS)
+            )
+            return
         self.write_resultset(rs, binary=True)
+
+    def handle_stmt_fetch(self, data: bytes) -> None:
+        """COM_STMT_FETCH: stream the next n cursor rows; the final batch
+        carries SERVER_STATUS_LAST_ROW_SENT (ref: conn_stmt.go
+        handleStmtFetch)."""
+        sid = int.from_bytes(data[:4], "little")
+        n = int.from_bytes(data[4:8], "little") or 1
+        cur = self.cursors.get(sid)
+        if cur is None:
+            self.pkt.write_packet(p.err_packet(1243, f"statement {sid} has no open cursor"))
+            return
+        rows, fts = cur
+        batch, cur[0] = rows[:n], rows[n:]
+        for row in batch:
+            self.pkt.write_packet(p.binary_row(list(row), fts))
+        status = self._status() | p.SERVER_STATUS_CURSOR_EXISTS
+        if not cur[0]:
+            status |= p.SERVER_STATUS_LAST_ROW_SENT
+            del self.cursors[sid]
+        self.pkt.write_packet(p.eof_packet(status=status))
 
     def handle_stmt_long_data(self, data: bytes) -> None:
         """COM_STMT_SEND_LONG_DATA: append chunk to a param buffer; no

@@ -90,6 +90,10 @@ class Session:
 
         self._plan_cache: OrderedDict = OrderedDict()
         self.plan_cache_hits = 0
+        # sequence batch cache + LASTVAL memory (ref: meta/autoid
+        # SequenceAllocator; entries [cur, end, inc, store generation])
+        self._seq_cache: dict = {}
+        self._seq_last: dict = {}
         # authenticated identity (set by the wire handshake; in-process
         # sessions run as root, the bootstrap superuser)
         self.user = "root"
@@ -1267,21 +1271,35 @@ class Session:
             m.drop_sequence(db, tn.name)
             txn.commit()
             self._seq_cache.pop((db.lower(), tn.name.lower()), None)
+            self._bump_seq_gen()
         return ResultSet([], None)
 
-    @property
-    def _seq_cache(self) -> dict:
-        c = getattr(self, "_seq_cache_d", None)
-        if c is None:
-            c = self._seq_cache_d = {}
-        return c
+    def _retry_meta_txn(self, fn, what: str):
+        """Run fn(txn, meta) in its own small txn, retrying on write
+        conflicts (the shared idiom under auto-id and sequence
+        allocation; ref: meta/autoid)."""
+        for _ in range(8):
+            txn = self.store.begin()
+            try:
+                out = fn(txn, Meta(txn))
+                txn.commit()
+                return out
+            except (WriteConflict, RetryableError):
+                continue
+            except Exception:
+                txn.rollback()
+                raise
+        raise RetryableError(f"{what} kept conflicting")
 
     @property
-    def _seq_last(self) -> dict:
-        c = getattr(self, "_seq_last_d", None)
-        if c is None:
-            c = self._seq_last_d = {}
-        return c
+    def _seq_gen(self) -> int:
+        return getattr(self.store, "seq_generation", 0)
+
+    def _bump_seq_gen(self) -> None:
+        """Invalidate EVERY session's cached sequence batches (drops and
+        drop-database must not let other sessions keep serving values
+        from a dropped or recreated sequence)."""
+        self.store.seq_generation = self._seq_gen + 1
 
     def sequence_op(self, op: str, db: str, name: str, arg: int | None = None):
         """NEXTVAL/LASTVAL/SETVAL runtime hook. NEXTVAL serves from a
@@ -1292,26 +1310,26 @@ class Session:
         if op == "lastval":
             return self._seq_last.get(key)
         if op == "setval":
-            for _ in range(8):
-                txn = self.store.begin()
-                try:
-                    m = Meta(txn)
-                    d = m.sequence(db, name)
-                    if d is None:
-                        txn.rollback()
-                        raise TiDBError(f"Unknown SEQUENCE: '{db}.{name}'")
-                    d["next"] = int(arg) + d["increment"]
-                    m.put_sequence(d)
-                    txn.commit()
-                    self._seq_cache.pop(key, None)
-                    return int(arg)
-                except (WriteConflict, RetryableError):
-                    continue
-            raise RetryableError("SETVAL kept conflicting")
+            def do(txn, m):
+                d = m.sequence(db, name)
+                if d is None:
+                    raise TiDBError(f"Unknown SEQUENCE: '{db}.{name}'")
+                d["next"] = int(arg) + d["increment"]
+                m.put_sequence(d)
+                return int(arg)
+
+            out = self._retry_meta_txn(do, "SETVAL")
+            self._seq_cache.pop(key, None)
+            return out
         cache = self._seq_cache.get(key)
         # exhaustion must be >= / <= — a MAXVALUE-clamped batch end need
-        # not land exactly on the increment stride
-        if cache is None or (cache[0] >= cache[1] if cache[2] > 0 else cache[0] <= cache[1]):
+        # not land exactly on the increment stride; a stale generation
+        # means some session dropped/recreated a sequence
+        if (
+            cache is None
+            or cache[3] != self._seq_gen
+            or (cache[0] >= cache[1] if cache[2] > 0 else cache[0] <= cache[1])
+        ):
             cache = self._seq_claim_batch(db, name, key)
         v = cache[0]
         cache[0] += cache[2]
@@ -1319,51 +1337,42 @@ class Session:
         return v
 
     def _seq_claim_batch(self, db: str, name: str, key) -> list:
-        for _ in range(8):
-            txn = self.store.begin()
-            try:
-                m = Meta(txn)
-                d = m.sequence(db, name)
-                if d is None:
-                    txn.rollback()
-                    raise TiDBError(f"Unknown SEQUENCE: '{db}.{name}'")
-                inc = d["increment"]
-                first = d["next"]
-                bound = d.get("maxvalue") if inc > 0 else d.get("minvalue")
-                if bound is not None and (first > bound if inc > 0 else first < bound):
-                    txn.rollback()
-                    raise TiDBError(f"Sequence '{db}.{name}' has run out")
-                n_vals = d["cache"]
-                if bound is not None:
-                    # stride-aligned clamp: only whole steps up to the bound
-                    n_vals = min(n_vals, abs(bound - first) // abs(inc) + 1)
-                end = first + inc * n_vals
-                d["next"] = end
-                m.put_sequence(d)
-                txn.commit()
-                cache = [first, end, inc]
-                self._seq_cache[key] = cache
-                return cache
-            except (WriteConflict, RetryableError):
-                continue
-        raise RetryableError("sequence allocation kept conflicting")
+        gen = self._seq_gen
+
+        def do(txn, m):
+            d = m.sequence(db, name)
+            if d is None:
+                raise TiDBError(f"Unknown SEQUENCE: '{db}.{name}'")
+            inc = d["increment"]
+            first = d["next"]
+            bound = d.get("maxvalue") if inc > 0 else d.get("minvalue")
+            if bound is not None and (first > bound if inc > 0 else first < bound):
+                raise TiDBError(f"Sequence '{db}.{name}' has run out")
+            n_vals = d["cache"]
+            if bound is not None:
+                # stride-aligned clamp: only whole steps up to the bound
+                n_vals = min(n_vals, abs(bound - first) // abs(inc) + 1)
+            end = first + inc * n_vals
+            d["next"] = end
+            m.put_sequence(d)
+            return [first, end, inc, gen]
+
+        cache = self._retry_meta_txn(do, "sequence allocation")
+        self._seq_cache[key] = cache
+        return cache
 
     def alloc_auto_id(self, tinfo: TableInfo, n: int) -> int:
         """Batched auto-id allocation in its own small txn (ref: meta/autoid)."""
-        for _ in range(8):
-            txn = self.store.begin()
-            try:
-                m = Meta(txn)
-                t = m.table(tinfo.id)
-                first = t.auto_inc_id
-                t.auto_inc_id += n
-                m.put_table(t)
-                txn.commit()
-                tinfo.auto_inc_id = t.auto_inc_id
-                return first
-            except (WriteConflict, RetryableError):
-                continue
-        raise RetryableError("auto-id allocation kept conflicting")
+
+        def do(txn, m):
+            t = m.table(tinfo.id)
+            first = t.auto_inc_id
+            t.auto_inc_id += n
+            m.put_table(t)
+            tinfo.auto_inc_id = t.auto_inc_id
+            return first
+
+        return self._retry_meta_txn(do, "auto-id allocation")
 
     def _eval_insert_value(self, node, col: ColumnInfo) -> Datum:
         if isinstance(node, ast.Default) or node is None:
@@ -2104,10 +2113,14 @@ class Session:
             t = m.table(tid)
             phys.extend(t.physical_ids() if t else [tid])
             m.drop_table(tid)
+        dropped_seq = False
         for sq in m.list_sequences():
             if sq["db"] == stmt.name.lower():
                 m.drop_sequence(sq["db"], sq["name"])
                 self._seq_cache.pop((sq["db"], sq["name"]), None)
+                dropped_seq = True
+        if dropped_seq:
+            self._bump_seq_gen()
         m.drop_db(stmt.name)
         m.bump_schema_version()
         txn.commit()
