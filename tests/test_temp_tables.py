@@ -1,0 +1,115 @@
+"""Session-local temporary tables (ref: the reference's local temporary
+tables — session.go:575 temp-table commit handling, infoschema temp
+attachment; here temp TableInfos overlay the shared snapshot and rows
+live in a private keyspace)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("create table perm (id int primary key, v int)")
+    sess.execute("insert into perm values (1, 100)")
+    return sess
+
+
+class TestTempTables:
+    def test_basic_dml(self, s):
+        s.execute("create temporary table tt (id int primary key, v varchar(10))")
+        s.execute("insert into tt values (1, 'a'), (2, 'b')")
+        s.execute("update tt set v = 'z' where id = 2")
+        s.execute("delete from tt where id = 1")
+        assert s.must_query("select id, v from tt") == [("2", "z")]
+
+    def test_invisible_to_other_sessions(self, s):
+        s.execute("create temporary table tt (id int primary key)")
+        other = Session(s.store)
+        other.execute("use test")
+        with pytest.raises(TiDBError):
+            other.execute("select * from tt")
+        # and the other session can create its own same-named temp table
+        other.execute("create temporary table tt (x varchar(5) , y int)")
+        other.execute("insert into tt values ('q', 1)")
+        assert other.must_query("select x from tt") == [("q",)]
+        assert s.must_query("select count(*) from tt") == [("0",)]
+
+    def test_shadows_permanent_table(self, s):
+        s.execute("create temporary table perm (id int primary key, note varchar(10))")
+        s.execute("insert into perm values (9, 'shadow')")
+        assert s.must_query("select id, note from perm") == [("9", "shadow")]
+        # DROP removes the temp one first; the permanent survives
+        s.execute("drop table perm")
+        assert s.must_query("select id, v from perm") == [("1", "100")]
+
+    def test_join_temp_with_permanent(self, s):
+        s.execute("create temporary table tt (id int primary key, mul int)")
+        s.execute("insert into tt values (1, 7)")
+        got = s.must_query("select perm.v * tt.mul from perm join tt on perm.id = tt.id")
+        assert got == [("700",)]
+
+    def test_disconnect_cleanup(self, s):
+        s.execute("create temporary table tt (id int primary key)")
+        s.execute("insert into tt values (5)")
+        tid = s.infoschema().table("test", "tt").id
+        from tidb_tpu.codec import tablecodec
+
+        s.drop_temp_tables()
+        with pytest.raises(TiDBError):
+            s.execute("select * from tt")
+        # keyspace destroyed, not just hidden
+        snap = s.store.snapshot(s.store.tso.next())
+        prefix = tablecodec.table_prefix(tid)
+        assert not list(snap.scan(prefix, prefix + b"\xff"))
+
+    def test_temp_table_in_explicit_txn(self, s):
+        s.execute("create temporary table tt (id int primary key)")
+        s.execute("begin")
+        s.execute("insert into tt values (1)")
+        s.execute("insert into perm values (2, 200)")
+        s.execute("rollback")
+        assert s.must_query("select count(*) from tt") == [("0",)]
+        assert s.must_query("select count(*) from perm") == [("1",)]
+
+    def test_if_not_exists_and_partition_rejected(self, s):
+        s.execute("create temporary table tt (id int primary key)")
+        with pytest.raises(TiDBError):
+            s.execute("create temporary table tt (id int primary key)")
+        s.execute("create temporary table if not exists tt (id int primary key)")
+        with pytest.raises(TiDBError):
+            s.execute(
+                "create temporary table pp (id int primary key) "
+                "partition by hash(id) partitions 2"
+            )
+
+    def test_show_tables_lists_own_temps(self, s):
+        s.execute("create temporary table tt (id int primary key)")
+        names = {r[1] for r in s.must_query(
+            "select table_schema, table_name from information_schema.tables")}
+        assert "tt" in names
+
+    def test_truncate_temp_table(self, s):
+        s.execute("create temporary table tt (id int primary key, v int)")
+        s.execute("insert into tt values (1, 1), (2, 2)")
+        s.execute("truncate table tt")
+        assert s.must_query("select count(*) from tt") == [("0",)]
+        s.execute("insert into tt values (3, 3)")
+        assert s.must_query("select id from tt") == [("3",)]
+
+    def test_meta_ddl_rejected_cleanly(self, s):
+        s.execute("create temporary table tt (id int primary key, v int)")
+        s.execute("insert into tt values (1, 1)")
+        for q in (
+            "alter table tt add index iv (v)",
+            "alter table tt add column w int",
+            "create index iv on tt (v)",
+            "drop index iv on tt",
+            "rename table tt to zz",
+        ):
+            with pytest.raises(TiDBError):
+                s.execute(q)
+        # data untouched by the rejections
+        assert s.must_query("select count(*) from tt") == [("1",)]

@@ -255,6 +255,7 @@ class CreateTable:
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)
     partition: PartitionSpec | None = None
+    temporary: bool = False  # session-local, shadows permanent names
 
 
 @dataclass

@@ -1092,10 +1092,11 @@ class Parser:
             cols = self.name_list()
             self.expect_op(")")
             return ast.CreateIndex(ast.IndexDef(iname, cols, unique=unique), tbl)
+        temporary = self.try_kw("TEMPORARY")
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         tbl = self._table_name()
-        node = ast.CreateTable(tbl, [], [], if_not_exists=ine)
+        node = ast.CreateTable(tbl, [], [], if_not_exists=ine, temporary=temporary)
         if self.try_kw("LIKE"):
             node.options["like"] = self._table_name()
             return node

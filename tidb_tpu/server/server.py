@@ -100,10 +100,15 @@ class ClientConn:
         except Exception:  # noqa: BLE001 — connection thread must not leak exceptions
             log.exception("connection %d aborted", self.conn_id)
         finally:
+            # independent teardown steps: one failing must not skip the rest
             try:
                 self.session.release_table_locks()
             except Exception:  # noqa: BLE001 — teardown must not raise
-                pass
+                log.exception("lock release failed during teardown")
+            try:
+                self.session.drop_temp_tables()
+            except Exception:  # noqa: BLE001
+                log.exception("temp-table cleanup failed during teardown")
             self.server.deregister(self.conn_id)
             try:
                 self.pkt.sock.close()

@@ -94,6 +94,9 @@ class Session:
         # SequenceAllocator; entries [cur, end, inc, store generation])
         self._seq_cache: dict = {}
         self._seq_last: dict = {}
+        # session-local temporary tables: (db, name) → TableInfo
+        self._temp_tables: dict = {}
+        self._temp_epoch = 0
         # authenticated identity (set by the wire handshake; in-process
         # sessions run as root, the bootstrap superuser)
         self.user = "root"
@@ -193,13 +196,19 @@ class Session:
         txn = self.store.begin()
         m = Meta(txn)
         ver = m.schema_version()
-        if self._is_cache is not None and self._is_cache.version == ver:
+        key = (ver, self._temp_epoch)
+        if self._is_cache is not None and getattr(self._is_cache, "_cache_key", None) == key:
             txn.rollback()
             return self._is_cache
         dbs = {d.name: d for d in m.list_dbs()}
         tables = {t.id: t for t in m.list_tables()}
         txn.rollback()
+        if self._temp_tables:
+            # temp tables merge LAST so the constructor's insertion-order
+            # _by_name loop shadows same-named permanent tables
+            tables = {**tables, **{t.id: t for t in self._temp_tables.values()}}
         self._is_cache = InfoSchema(ver, dbs, tables)
+        self._is_cache._cache_key = key
         return self._is_cache
 
     # ------------------------------------------------------------------- txn
@@ -1079,6 +1088,7 @@ class Session:
             sql,
             self.current_db,
             self.infoschema().version,
+            self._temp_epoch,  # temp tables shadow names per-session
             self.store.stats.generation,
             self.vars.get("tidb_cop_engine", ""),
             repr(getattr(self, "_cur_hints", None) or []),
@@ -1363,6 +1373,11 @@ class Session:
 
     def alloc_auto_id(self, tinfo: TableInfo, n: int) -> int:
         """Batched auto-id allocation in its own small txn (ref: meta/autoid)."""
+        if getattr(tinfo, "temporary", False):
+            # session-private object: no cross-session contention to guard
+            first = tinfo.auto_inc_id
+            tinfo.auto_inc_id += n
+            return first
 
         def do(txn, m):
             t = m.table(tinfo.id)
@@ -2130,6 +2145,8 @@ class Session:
         return ResultSet([], None)
 
     def _ddl_create_table(self, stmt: ast.CreateTable) -> ResultSet:
+        if stmt.temporary:
+            return self._ddl_create_temp_table(stmt)
         db = stmt.table.db or self.current_db
         txn = self._ddl_txn()
         m = Meta(txn)
@@ -2150,12 +2167,27 @@ class Session:
                 f"a sequence named {stmt.table.name!r} already exists (shared namespace)"
             )
 
+        try:
+            info = self._build_table_info(stmt, m, db)
+        except TiDBError:
+            txn.rollback()
+            raise
+        m.put_table(info)
+        dbi.table_ids.append(info.id)
+        m.put_db(dbi)
+        m.bump_schema_version()
+        txn.commit()
+        return ResultSet([], None)
+
+    def _build_table_info(self, stmt: ast.CreateTable, m: Meta, db: str) -> TableInfo:
+        """Columns/indexes/partition construction shared by permanent and
+        temporary CREATE TABLE (ids come from the meta allocator either
+        way, so temp keyspaces never collide with real tables)."""
         tid = m.alloc_id()
         cols: list[ColumnInfo] = []
         indexes: list[IndexInfo] = []
         for i, cd in enumerate(stmt.columns):
             if cd.name.lower().startswith("_tidb_"):
-                txn.rollback()
                 raise TiDBError(f"column name {cd.name!r} is reserved")
             ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems)
             if cd.not_null or cd.primary_key:
@@ -2198,12 +2230,43 @@ class Session:
         info = TableInfo(tid, stmt.table.name, cols, final_idx, pk_is_handle, db_name=db)
         if stmt.partition is not None:
             info.partition = self._build_partition_info(m, stmt.partition, cols, final_idx)
-        m.put_table(info)
-        dbi.table_ids.append(tid)
-        m.put_db(dbi)
-        m.bump_schema_version()
-        txn.commit()
+        return info
+
+    def _ddl_create_temp_table(self, stmt: ast.CreateTable) -> ResultSet:
+        """CREATE TEMPORARY TABLE: session-local, shadows a same-named
+        permanent table, vanishes on disconnect (ref: the local temporary
+        tables the session layer merges at commit — session.go:575; here
+        rows live in a private keyspace under normal MVCC)."""
+        db = stmt.table.db or self.current_db
+        key = (db.lower(), stmt.table.name.lower())
+        if key in self._temp_tables:
+            if stmt.if_not_exists:
+                return ResultSet([], None)
+            raise TableExists(f"table {stmt.table.name!r} already exists")
+        if stmt.partition is not None:
+            raise TiDBError("temporary tables cannot be partitioned")
+        if not self.infoschema().has_db(db):
+            raise UnknownDatabase(f"unknown database {db!r}")
+
+        info = self._retry_meta_txn(
+            lambda txn, m: self._build_table_info(stmt, m, db), "temp-table id allocation"
+        )
+        info.temporary = True
+        self._temp_tables[key] = info
+        self._temp_epoch += 1
+        self._is_cache = None
         return ResultSet([], None)
+
+    def drop_temp_tables(self) -> None:
+        """Connection teardown: destroy every temp table's keyspace."""
+        for info in self._temp_tables.values():
+            self.store.mvcc.unsafe_destroy_range(
+                tablecodec.table_prefix(info.id), tablecodec.table_prefix(info.id + 1)
+            )
+            self.cop.tiles.invalidate_table(info.id)
+        self._temp_tables.clear()
+        self._temp_epoch += 1
+        self._is_cache = None
 
     def _build_partition_info(self, m, spec, cols, indexes):
         """Validate + materialize a PARTITION BY clause (ref: ddl/ddl_api.go
@@ -2244,6 +2307,17 @@ class Session:
     def _ddl_drop_table(self, stmt: ast.DropTable) -> ResultSet:
         for tn in stmt.tables:
             db = tn.db or self.current_db
+            tkey = (db.lower(), tn.name.lower())
+            if tkey in self._temp_tables:
+                # MySQL: DROP TABLE removes the temp table first
+                info = self._temp_tables.pop(tkey)
+                self.store.mvcc.unsafe_destroy_range(
+                    tablecodec.table_prefix(info.id), tablecodec.table_prefix(info.id + 1)
+                )
+                self.cop.tiles.invalidate_table(info.id)
+                self._temp_epoch += 1
+                self._is_cache = None
+                continue
             txn = self._ddl_txn()
             m = Meta(txn)
             dbi = m.db(db)
@@ -2269,7 +2343,22 @@ class Session:
                 self.cop.tiles.invalidate_table(pid)
         return ResultSet([], None)
 
+    def _temp_info(self, tn: ast.TableName):
+        return self._temp_tables.get(((tn.db or self.current_db).lower(), tn.name.lower()))
+
+    def _reject_temp_ddl(self, tn: ast.TableName, what: str) -> None:
+        if self._temp_info(tn) is not None:
+            raise TiDBError(f"{what} is not supported on temporary tables")
+
     def _ddl_truncate(self, stmt: ast.TruncateTable) -> ResultSet:
+        tinfo = self._temp_info(stmt.table)
+        if tinfo is not None:
+            self.store.mvcc.unsafe_destroy_range(
+                tablecodec.table_prefix(tinfo.id), tablecodec.table_prefix(tinfo.id + 1)
+            )
+            tinfo.auto_inc_id = 1
+            self._invalidate_tiles(tinfo)
+            return ResultSet([], None)
         info = self.infoschema().table(stmt.table.db or self.current_db, stmt.table.name)
         for pid in info.physical_ids():
             self.store.mvcc.unsafe_destroy_range(tablecodec.table_prefix(pid), tablecodec.table_prefix(pid + 1))
@@ -2293,6 +2382,7 @@ class Session:
         'none', a DDL job is enqueued, and the worker drives
         delete_only→write_only→write_reorg→public with a resumable
         backfill. This session waits for completion (doDDLJob loop)."""
+        self._reject_temp_ddl(tn, "ADD INDEX")
         db = tn.db or self.current_db
         if self.infoschema().table(db, tn.name).partition is not None:
             raise TiDBError("online ADD INDEX on a partitioned table is not supported yet")
@@ -2316,6 +2406,7 @@ class Session:
         return ResultSet([], None)
 
     def _ddl_drop_index(self, stmt: ast.DropIndex) -> ResultSet:
+        self._reject_temp_ddl(stmt.table, "DROP INDEX")
         db = stmt.table.db or self.current_db
         info = self.infoschema().table(db, stmt.table.name)
         txn = self._ddl_txn()
@@ -2332,6 +2423,7 @@ class Session:
         return ResultSet([], None)
 
     def _ddl_alter(self, stmt: ast.AlterTable) -> ResultSet:
+        self._reject_temp_ddl(stmt.table, "ALTER TABLE")
         for action, payload in stmt.actions:
             if action == "add_index":
                 self._add_index(stmt.table, payload)
