@@ -1,0 +1,123 @@
+"""ADMIN RECOVER/CLEANUP INDEX, SELECT INTO OUTFILE, SHOW TABLE STATUS."""
+import os
+import pytest
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.privilege.cache import PrivilegeError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("create table t (id int primary key, a int, key ia (a))")
+    sess.execute("insert into t values " + ",".join(f"({i},{i % 5})" for i in range(20)))
+    return sess
+
+
+class TestAdminRecoverCleanup:
+    def _idx_prefix(self, s):
+        from tidb_tpu.codec import tablecodec
+        info = s.infoschema().table("test", "t")
+        idx = info.index_by_name("ia")
+        return tablecodec.index_prefix(info.id, idx.id)
+
+    def test_recover_missing_entries(self, s):
+        ipfx = self._idx_prefix(s)
+        # vandalize: delete some index entries directly
+        txn = s.store.begin()
+        keys = [k for k, _ in txn.scan(ipfx, ipfx + b"\xff")][:4]
+        for k in keys:
+            txn.delete(k)
+        txn.commit()
+        with pytest.raises(TiDBError):
+            s.execute("admin check table t")
+        rows = s.must_query("admin recover index t ia")
+        assert rows == [("4", "20")]
+        s.execute("admin check table t")  # green again
+
+    def test_cleanup_dangling_entries(self, s):
+        from tidb_tpu.codec import tablecodec
+        ipfx = self._idx_prefix(s)
+        txn = s.store.begin()
+        txn.put(ipfx + b"\x03\x80\x00\x00\x00\x00\x00\x00\x63" + b"\x03\x80\x00\x00\x00\x00\x00\x27\x10", b"")
+        txn.commit()
+        with pytest.raises(TiDBError):
+            s.execute("admin check table t")
+        rows = s.must_query("admin cleanup index t ia")
+        assert rows[0][0] == "1"
+        s.execute("admin check table t")
+
+    def test_unknown_index_rejected(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("admin recover index t nosuch")
+
+
+class TestSelectIntoOutfile:
+    def test_writes_tsv(self, s, tmp_path):
+        p = tmp_path / "out.tsv"
+        r = s.execute(f"select id, a from t where id < 3 order by id into outfile '{p}'")
+        assert r.affected == 3
+        assert p.read_text() == "0\t0\n1\t1\n2\t2\n"
+
+    def test_null_and_custom_seps(self, s, tmp_path):
+        s.execute("create table n (id int primary key, v varchar(5))")
+        s.execute("insert into n values (1, null)")
+        p = tmp_path / "n.csv"
+        s.execute(f"select id, v from n into outfile '{p}' fields terminated by ','")
+        assert p.read_text() == "1,\\N\n"
+
+    def test_existing_file_rejected(self, s, tmp_path):
+        p = tmp_path / "dup.tsv"
+        p.write_text("x")
+        with pytest.raises(TiDBError):
+            s.execute(f"select id from t into outfile '{p}'")
+
+    def test_requires_file_priv(self, s, tmp_path):
+        s.execute("create user scribe")
+        s.execute("grant select on test.* to scribe")
+        u = Session(s.store)
+        u.user = "scribe"
+        with pytest.raises(PrivilegeError):
+            u.execute(f"select id from t into outfile '{tmp_path}/x.tsv'")
+        s.execute("grant file on *.* to scribe")
+        u.execute(f"select id from t limit 1 into outfile '{tmp_path}/x.tsv'")
+
+
+class TestShowTableStatus:
+    def test_lists_tables_with_rows(self, s):
+        s.execute("analyze table t")
+        rows = s.must_query("show table status")
+        by_name = {r[0]: r for r in rows}
+        assert by_name["t"][1] == "tpu" and int(by_name["t"][2]) == 20
+
+
+class TestOutfileReviewFixes:
+    def test_union_into_outfile(self, s, tmp_path):
+        p = tmp_path / "u.tsv"
+        r = s.execute(f"select id from t where id = 1 union select 99 into outfile '{p}'")
+        assert r.affected == 2
+        assert sorted(p.read_text().splitlines()) == ["1", "99"]
+
+    def test_separator_and_backslash_escaping(self, s, tmp_path):
+        s.execute(r"create table esc (id int primary key, v varchar(20))")
+        s.execute("insert into esc values (1, concat('a', char(9), 'b'))")
+        s.execute(r"insert into esc values (2, '\\N')")
+        p = tmp_path / "esc.tsv"
+        s.execute(f"select v from esc order by id into outfile '{p}'")
+        lines = p.read_text().split("\n")
+        assert lines[0] == "a\\\tb"       # embedded tab escaped
+        assert lines[1] == "\\\\N"         # literal backslash-N != NULL marker
+        s.execute("insert into esc values (3, null)")
+        p2 = tmp_path / "esc2.tsv"
+        s.execute(f"select v from esc where id = 3 into outfile '{p2}'")
+        assert p2.read_text() == "\\N\n"
+
+    def test_show_table_status_like(self, s):
+        s.execute("create table zz_only (id int primary key)")
+        rows = s.must_query("show table status like 'zz%'")
+        assert [r[0] for r in rows] == ["zz_only"]
+
+    def test_bad_separator_token_is_parse_error(self, s, tmp_path):
+        from tidb_tpu.errors import ParseError
+        with pytest.raises(ParseError):
+            s.execute(f"select id from t into outfile '{tmp_path}/q' fields terminated by 7")

@@ -173,6 +173,9 @@ class Select:
     setop: Any = None  # ('union'|'union all'|..., Select) chained
     with_: Any = None  # WithClause
     hints: list = field(default_factory=list)  # [(NAME, [args])]
+    into_outfile: str | None = None  # SELECT ... INTO OUTFILE
+    outfile_fsep: str = "\t"
+    outfile_lsep: str = "\n"
 
 
 @dataclass
@@ -185,6 +188,9 @@ class SetOpSelect:
     limit: Any = None
     offset: Any = None
     with_: Any = None  # WithClause
+    into_outfile: str | None = None  # hoisted from the last branch
+    outfile_fsep: str = "\t"
+    outfile_lsep: str = "\n"
 
 
 @dataclass

@@ -268,6 +268,10 @@ class Parser:
         if isinstance(last, ast.Select):
             setop.order_by, last.order_by = last.order_by, []
             setop.limit, setop.offset, last.limit, last.offset = last.limit, last.offset, None, None
+            if last.into_outfile is not None:  # INTO OUTFILE hoists too
+                setop.into_outfile, last.into_outfile = last.into_outfile, None
+                setop.outfile_fsep = last.outfile_fsep
+                setop.outfile_lsep = last.outfile_lsep
         if self.try_kw("ORDER"):
             self.expect_kw("BY")
             setop.order_by = self.by_items()
@@ -305,6 +309,21 @@ class Parser:
             sel.order_by = self.by_items()
         if self.try_kw("LIMIT"):
             sel.limit, sel.offset = self.limit_clause()
+        if self.try_kw("INTO"):
+            # SELECT ... INTO OUTFILE 'path' (ref: executor/select_into.go)
+            self.expect_kw("OUTFILE")
+            t = self.next()
+            if t.kind != "str":
+                self.fail("expected OUTFILE path string")
+            sel.into_outfile = t.text
+            if self.try_kw("FIELDS") or self.try_kw("COLUMNS"):
+                self.expect_kw("TERMINATED")
+                self.expect_kw("BY")
+                sel.outfile_fsep = self._str_lit("field separator")
+            if self.try_kw("LINES"):
+                self.expect_kw("TERMINATED")
+                self.expect_kw("BY")
+                sel.outfile_lsep = self._str_lit("line separator")
         if self.try_kw("FOR"):
             self.expect_kw("UPDATE")
             sel.for_update = True
@@ -1367,6 +1386,13 @@ class Parser:
                 break
         return ast.AlterTable(tbl, actions)
 
+    def _str_lit(self, what: str) -> str:
+        t = self.tok
+        if t.kind != "str":
+            self.fail(f"expected {what} string literal")
+        self.next()
+        return t.text
+
     def _int_bound(self) -> int:
         """Integer partition bound; non-integer bounds are a parse error,
         not a Python exception."""
@@ -1610,6 +1636,11 @@ class Parser:
             tbl = self._table_name()
             idx = self.ident()
             return ast.AdminStmt("recover_index", (tbl, idx))
+        if self.try_kw("CLEANUP"):
+            self.expect_kw("INDEX")
+            tbl = self._table_name()
+            idx = self.ident()
+            return ast.AdminStmt("cleanup_index", (tbl, idx))
         self.fail("unsupported ADMIN")
 
     def kill_stmt(self):

@@ -14,7 +14,7 @@ from ..errors import TiDBError
 
 PRIVS = {
     "SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
-    "ALTER", "INDEX", "PROCESS", "SUPER", "LOCK TABLES",
+    "ALTER", "INDEX", "PROCESS", "SUPER", "LOCK TABLES", "FILE",
 }
 
 # dynamic privileges (ref: privilege/privileges/cache.go:120 dynamic

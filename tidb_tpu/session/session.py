@@ -468,7 +468,10 @@ class Session:
             dbs: set = set()
             sel_dbs(stmt, dbs)
             order_group_dbs(stmt, dbs)
-            return [("SELECT", d, t) for d, t in dbs]
+            out = [("SELECT", d, t) for d, t in dbs]
+            if getattr(stmt, "into_outfile", None) is not None:
+                out.append(("FILE", "*"))  # writes server-side files
+            return out
         if isinstance(stmt, ast.Insert):
             out = [("INSERT", (stmt.table.db or self.current_db).lower(), stmt.table.name.lower())]
             dbs: set = set()
@@ -688,6 +691,10 @@ class Session:
                 return self._admin_check_table(stmt.target)
             if stmt.kind == "checksum_table":
                 return self._admin_checksum_table(stmt.target)
+            if stmt.kind == "recover_index":
+                return self._admin_recover_cleanup_index(*stmt.target, recover=True)
+            if stmt.kind == "cleanup_index":
+                return self._admin_recover_cleanup_index(*stmt.target, recover=False)
         if isinstance(stmt, ast.CreateBinding):
             return self._run_create_binding(stmt)
         if isinstance(stmt, ast.DropBinding):
@@ -970,6 +977,47 @@ class Session:
                     f"{corrupt} mismatched entries"
                 )
 
+    def _admin_recover_cleanup_index(self, tn, idx_name: str, recover: bool) -> ResultSet:
+        """ADMIN RECOVER INDEX (write missing entries back) / ADMIN
+        CLEANUP INDEX (delete dangling entries) — ref: executor/admin.go
+        RecoverIndexExec:180, CleanupIndexExec:524."""
+        info = self.infoschema().table(tn.db or self.current_db, tn.name)
+        idx = info.index_by_name(idx_name)
+        if idx is None or idx.state != "public":
+            raise TiDBError(f"index {idx_name!r} does not exist in table {tn.name!r}")
+        if info.pk_is_handle and idx.primary:
+            raise TiDBError("the clustered PRIMARY key has no separate index keyspace")
+        txn = self._active_txn()
+        snap = self.store.snapshot(self.read_ts())
+        fixed = scanned = 0
+        for pid in info.physical_ids():
+            tbl = Table(info.partition_physical(pid)) if info.partition else Table(info)
+            prefix = tablecodec.record_prefix(pid)
+            expected = {}
+            for k, v in snap.scan(prefix, prefix + b"\xff"):
+                handle = tablecodec.decode_record_handle(k)
+                datums = tbl.decode_record(v)
+                key, val, _ = tbl.index_value_key(
+                    idx, tbl.row_datums_with_hidden(datums, handle), handle
+                )
+                expected[key] = val
+                scanned += 1
+            ipfx = tablecodec.index_prefix(pid, idx.id)
+            actual = dict(snap.scan(ipfx, ipfx + b"\xff"))
+            if recover:
+                for k in set(expected) - set(actual):
+                    txn.put(k, expected[k])
+                    fixed += 1
+            else:
+                for k in set(actual) - set(expected):
+                    txn.delete(k)
+                    fixed += 1
+        name = "ADDED_COUNT" if recover else "REMOVED_COUNT"
+        chk = Chunk.from_datum_rows(
+            [ft_longlong(), ft_longlong()], [[Datum.i(fixed), Datum.i(scanned)]]
+        )
+        return ResultSet([name, "SCAN_COUNT"], chk)
+
     def _admin_checksum_table(self, tn) -> ResultSet:
         """ADMIN CHECKSUM TABLE (ref: executor/checksum.go — a 64-bit
         XOR-of-per-kv-digests over the table's kv pairs at a consistent
@@ -1151,7 +1199,39 @@ class Session:
         ex = build_executor(plan, ctx)
         chunk = drain(ex)
         names = [c.name for c in plan.out_cols]
-        return ResultSet(names, chunk)
+        rs = ResultSet(names, chunk)
+        outfile = getattr(stmt, "into_outfile", None)
+        if outfile is not None:
+            return self._write_outfile(rs, stmt)
+        return rs
+
+    def _write_outfile(self, rs: ResultSet, stmt) -> ResultSet:
+        """SELECT INTO OUTFILE (ref: executor/select_into.go): tab/newline
+        separated, NULL as \\N, file must not already exist."""
+        import os
+
+        path = stmt.into_outfile
+        if os.path.exists(path):
+            raise TiDBError(f"File {path!r} already exists")
+        fsep, lsep = stmt.outfile_fsep, stmt.outfile_lsep
+
+        def esc(v: str) -> str:
+            # ESCAPED BY '\\' defaults: backslash first, then separators,
+            # so a literal "\N" can never collide with the NULL marker
+            v = v.replace("\\", "\\\\")
+            if fsep:
+                v = v.replace(fsep, "\\" + fsep)
+            if lsep:
+                v = v.replace(lsep, "\\" + lsep)
+            return v
+
+        n = 0
+        with open(path, "w", encoding="utf8") as f:
+            for row in rs.rows():
+                f.write(fsep.join("\\N" if v is None else esc(v) for v in row))
+                f.write(lsep)
+                n += 1
+        return ResultSet([], None, affected=n)
 
     # --------------------------------------------------- prepared statements
 
@@ -2257,13 +2337,16 @@ class Session:
         self._is_cache = None
         return ResultSet([], None)
 
+    def _destroy_temp_keyspace(self, info) -> None:
+        self.store.mvcc.unsafe_destroy_range(
+            tablecodec.table_prefix(info.id), tablecodec.table_prefix(info.id + 1)
+        )
+        self.cop.tiles.invalidate_table(info.id)
+
     def drop_temp_tables(self) -> None:
         """Connection teardown: destroy every temp table's keyspace."""
         for info in self._temp_tables.values():
-            self.store.mvcc.unsafe_destroy_range(
-                tablecodec.table_prefix(info.id), tablecodec.table_prefix(info.id + 1)
-            )
-            self.cop.tiles.invalidate_table(info.id)
+            self._destroy_temp_keyspace(info)
         self._temp_tables.clear()
         self._temp_epoch += 1
         self._is_cache = None
@@ -2310,11 +2393,7 @@ class Session:
             tkey = (db.lower(), tn.name.lower())
             if tkey in self._temp_tables:
                 # MySQL: DROP TABLE removes the temp table first
-                info = self._temp_tables.pop(tkey)
-                self.store.mvcc.unsafe_destroy_range(
-                    tablecodec.table_prefix(info.id), tablecodec.table_prefix(info.id + 1)
-                )
-                self.cop.tiles.invalidate_table(info.id)
+                self._destroy_temp_keyspace(self._temp_tables.pop(tkey))
                 self._temp_epoch += 1
                 self._is_cache = None
                 continue
@@ -2353,11 +2432,8 @@ class Session:
     def _ddl_truncate(self, stmt: ast.TruncateTable) -> ResultSet:
         tinfo = self._temp_info(stmt.table)
         if tinfo is not None:
-            self.store.mvcc.unsafe_destroy_range(
-                tablecodec.table_prefix(tinfo.id), tablecodec.table_prefix(tinfo.id + 1)
-            )
+            self._destroy_temp_keyspace(tinfo)
             tinfo.auto_inc_id = 1
-            self._invalidate_tiles(tinfo)
             return ResultSet([], None)
         info = self.infoschema().table(stmt.table.db or self.current_db, stmt.table.name)
         for pid in info.physical_ids():
@@ -2598,6 +2674,26 @@ class Session:
                 [ft_longlong(), ft_varchar(), ft_varchar(), ft_longlong(), ft_varchar()], rows
             )
             return ResultSet(["Id", "User", "db", "Time", "Info"], chk)
+        if stmt.kind == "table_status":
+            pat = None
+            if stmt.like is not None and isinstance(stmt.like, ast.Lit):
+                from ..expr.builtins import like_to_regex
+
+                pat = like_to_regex(stmt.like.value)
+            rows = []
+            for t in is_.tables_in_db(self.current_db):
+                if pat is not None and not pat.match(t.name):
+                    continue
+                st = self.store.stats.get(t.id)
+                nrows = st.row_count if st is not None else 0
+                rows.append([
+                    Datum.s(t.name), Datum.s("tpu"), Datum.i(int(nrows)),
+                    Datum.s("Fixed"), Datum.s(""),
+                ])
+            chk = Chunk.from_datum_rows(
+                [ft_varchar(), ft_varchar(), ft_longlong(), ft_varchar(), ft_varchar()], rows
+            )
+            return ResultSet(["Name", "Engine", "Rows", "Row_format", "Comment"], chk)
         if stmt.kind == "bindings":
             rows = self._sql_internal(
                 "SELECT original_sql, bind_sql, status FROM mysql.bind_info"
