@@ -1,0 +1,137 @@
+"""Views (ref: ddl/ddl_api.go CreateView + planner
+logical_plan_builder.go BuildDataSourceFromView: definitions stored as
+SQL text, re-planned at reference time against the current schema)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.privilege.cache import PrivilegeError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("create table t (id int primary key, g int, v int)")
+    sess.execute("insert into t values " + ",".join(f"({i},{i % 3},{i * 10})" for i in range(9)))
+    return sess
+
+
+class TestViews:
+    def test_basic_select_and_aggregation_over_view(self, s):
+        s.execute("create view agg_v (grp, total) as select g, sum(v) from t group by g")
+        assert s.must_query("select grp, total from agg_v order by grp") == [
+            ("0", "90"), ("1", "120"), ("2", "150")]
+        assert s.must_query("select sum(total) from agg_v") == [("360",)]
+
+    def test_view_over_view_and_joins(self, s):
+        s.execute("create view base_v as select id, g from t where v >= 30")
+        s.execute("create view top_v as select g, count(*) c from base_v group by g")
+        assert s.must_query("select c from top_v order by g") == [("2",), ("2",), ("2",)]
+        got = s.must_query(
+            "select count(*) from base_v a join base_v b on a.g = b.g")
+        assert got == [("12",)]
+
+    def test_view_sees_current_schema_data(self, s):
+        s.execute("create view live as select count(*) n from t")
+        assert s.must_query("select n from live") == [("9",)]
+        s.execute("insert into t values (100, 0, 0)")
+        assert s.must_query("select n from live") == [("10",)]
+
+    def test_or_replace_and_duplicate(self, s):
+        s.execute("create view v as select 1 as a")
+        with pytest.raises(TiDBError):
+            s.execute("create view v as select 2 as a")
+        s.execute("create or replace view v as select 2 as a")
+        assert s.must_query("select a from v") == [("2",)]
+
+    def test_name_clash_with_table(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("create view t as select 1")
+        s.execute("create view vc as select 1")
+        with pytest.raises(TiDBError):
+            s.execute("create table vc (id int primary key)")
+
+    def test_broken_definition_fails_at_create(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("create view bad as select nosuch from t")
+
+    def test_column_list_mismatch(self, s):
+        with pytest.raises(TiDBError):
+            s.execute("create view m (a, b, c) as select id, g from t")
+
+    def test_drop_view_and_drop_database(self, s):
+        s.execute("create view v1 as select 1")
+        s.execute("drop view v1")
+        with pytest.raises(TiDBError):
+            s.execute("drop view v1")
+        s.execute("drop view if exists v1")
+        s.execute("create database vd")
+        s.execute("create view vd.vv as select 1")
+        s.execute("drop database vd")
+        s.execute("create database vd")
+        s.execute("create view vd.vv as select 1")  # name is free again
+
+    def test_show_surfaces(self, s):
+        s.execute("create view sv as select id from t")
+        assert ("sv",) in s.must_query("show tables")
+        rows = s.must_query("show create table sv")
+        assert rows[0][1].startswith("CREATE VIEW `sv`")
+
+    def test_view_privileges(self, s):
+        s.execute("create view pv as select id from t")
+        s.execute("create user viewer")
+        u = Session(s.store)
+        u.user = "viewer"
+        with pytest.raises(PrivilegeError):
+            u.execute("select * from pv")
+        # table-scope grant on the VIEW name works
+        s.execute("grant select on test.pv to viewer")
+        with pytest.raises(PrivilegeError):
+            u.execute("select * from t")  # underlying table still denied? no —
+        # NOTE: definer-rights semantics — the view's own reference to t is
+        # checked against the INVOKER here (simplification); grant it too
+        s.execute("grant select on test.t to viewer")
+        assert u.must_query("select count(*) from pv") == [("9",)]
+
+    def test_view_in_explain(self, s):
+        s.execute("create view ev as select g, sum(v) s from t group by g")
+        plan = "\n".join(r[0] for r in s.must_query("explain select * from ev"))
+        assert "Aggregation" in plan and "DataSource(t)" in plan
+
+
+class TestViewScoping:
+    """Views are independent name scopes (ref:
+    BuildDataSourceFromView: definitions plan in the view's db with no
+    caller CTE/hint leakage)."""
+
+    def test_cross_database_view_resolves_in_own_db(self, s):
+        s.execute("create database d1")
+        s.execute("create database d2")
+        s.execute("create table d1.t (a int primary key)")
+        s.execute("insert into d1.t values (1)")
+        s.execute("create table d2.t (a int primary key)")
+        s.execute("insert into d2.t values (777)")
+        s.execute("create view d1.v as select a from t")  # binds to d1.t
+        s.execute("use d2")
+        assert s.must_query("select a from d1.v") == [("1",)]
+
+    def test_caller_cte_does_not_shadow_view_internals(self, s):
+        s.execute("create view v as select id from t where id = 1")
+        got = s.must_query("with t as (select 99 as id) select id from v")
+        assert got == [("1",)]
+
+    def test_view_sequence_namespace(self, s):
+        s.execute("create sequence sq")
+        with pytest.raises(TiDBError):
+            s.execute("create view sq as select 1")
+        s.execute("create view vv as select 1")
+        with pytest.raises(TiDBError):
+            s.execute("create sequence vv")
+
+    def test_show_tables_sorted_merge(self, s):
+        s.execute("create table aaa (id int primary key)")
+        s.execute("create table zzz (id int primary key)")
+        s.execute("create view mmm as select 1")
+        names = [r[0] for r in s.must_query("show tables")]
+        assert names == sorted(names)

@@ -28,6 +28,7 @@ P_TBL = b"m:tbl:"
 P_JOB = b"m:job:"  # queued/running DDL jobs (ref: meta job queues, ddl_worker.go:67)
 P_JOB_HIST = b"m:jobh:"  # finished jobs (ADMIN SHOW DDL JOBS)
 P_SEQ = b"m:seq:"  # sequences (ref: ddl sequence objects, meta/autoid SequenceAllocator)
+P_VIEW = b"m:view:"  # view definitions (stored SELECT text)
 
 
 class Meta:
@@ -107,6 +108,25 @@ class Meta:
 
     def list_sequences(self) -> list[dict]:
         return [json.loads(v) for _, v in self.txn.scan(P_SEQ, P_SEQ + b"\xff")]
+
+    # --- views (ref: ddl_api.go CreateView; definition stored as text) -----
+
+    @staticmethod
+    def _view_key(db: str, name: str) -> bytes:
+        return P_VIEW + f"{db.lower()}.{name.lower()}".encode()
+
+    def view(self, db: str, name: str) -> dict | None:
+        raw = self.txn.get(self._view_key(db, name))
+        return json.loads(raw) if raw else None
+
+    def put_view(self, d: dict) -> None:
+        self.txn.put(self._view_key(d["db"], d["name"]), json.dumps(d).encode())
+
+    def drop_view(self, db: str, name: str) -> None:
+        self.txn.delete(self._view_key(db, name))
+
+    def list_views(self) -> list[dict]:
+        return [json.loads(v) for _, v in self.txn.scan(P_VIEW, P_VIEW + b"\xff")]
 
     # --- DDL job queue (ref: ddl.go:535 doDDLJob, meta job lists) ----------
 

@@ -244,10 +244,11 @@ class InfoSchema:
     """Immutable snapshot of the full schema at one version
     (ref: infoschema/infoschema.go)."""
 
-    def __init__(self, version: int, dbs: dict[str, DBInfo], tables: dict[int, TableInfo]):
+    def __init__(self, version: int, dbs: dict[str, DBInfo], tables: dict[int, TableInfo], views: dict | None = None):
         self.version = version
         self.dbs = {k.lower(): v for k, v in dbs.items()}
         self.tables = tables
+        self.views = views or {}  # (db, name) → {"db","name","cols","sql"}
         self._by_name: dict[tuple[str, str], TableInfo] = {}
         for t in tables.values():
             self._by_name[(t.db_name.lower(), t.name.lower())] = t

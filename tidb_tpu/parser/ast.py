@@ -377,6 +377,20 @@ class AdminStmt:
 
 
 @dataclass
+class CreateView:
+    table: Any  # TableName
+    cols: list  # optional explicit column names
+    select_sql: str  # stored definition text
+    or_replace: bool = False
+
+
+@dataclass
+class DropView:
+    names: list  # [TableName]
+    if_exists: bool = False
+
+
+@dataclass
 class CreateSequence:
     table: Any  # TableName (sequences share the table namespace)
     start: int = 1

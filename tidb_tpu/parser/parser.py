@@ -1053,6 +1053,12 @@ class Parser:
 
     def create_stmt(self):
         self.expect_kw("CREATE")
+        if self.at_kw("OR") and self.peek().upper == "REPLACE":
+            self.next(); self.next()
+            self.expect_kw("VIEW")
+            return self._create_view(or_replace=True)
+        if self.try_kw("VIEW"):
+            return self._create_view(or_replace=False)
         g = self.try_kw("GLOBAL")
         if not g:
             self.try_kw("SESSION")
@@ -1288,6 +1294,12 @@ class Parser:
             while self.try_op(","):
                 names.append(self._table_name())
             return ast.DropSequence(names, ie)
+        if self.try_kw("VIEW"):
+            ie = self._if_exists()
+            names = [self._table_name()]
+            while self.try_op(","):
+                names.append(self._table_name())
+            return ast.DropView(names, ie)
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ie = self._if_exists()
@@ -1385,6 +1397,21 @@ class Parser:
             if not self.try_op(","):
                 break
         return ast.AlterTable(tbl, actions)
+
+    def _create_view(self, or_replace: bool):
+        """CREATE [OR REPLACE] VIEW v [(cols)] AS <select> — the SELECT is
+        stored as SQL text and re-planned at reference time (ref:
+        ddl_api.go CreateView; plans always see the current schema)."""
+        tn = self._table_name()
+        cols = []
+        if self.try_op("("):
+            cols = self.name_list()
+            self.expect_op(")")
+        self.expect_kw("AS")
+        start = self.tok.pos
+        self.select_stmt()  # validate + advance
+        end = self.tok.pos if not self.at("eof") else len(self.sql)
+        return ast.CreateView(tn, cols, self.sql[start:end].strip(), or_replace)
 
     def _str_lit(self, what: str) -> str:
         t = self.tok

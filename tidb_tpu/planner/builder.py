@@ -262,6 +262,9 @@ class PlanBuilder:
 
                 return Memtable(name, lambda: provider(name), cols)
         db = tn.db or self.db
+        vdef = self.is_.views.get(((tn.db or self.db).lower(), tn.name.lower()))
+        if vdef is not None:
+            return self._build_view(tn, vdef)
         info = self.is_.table(db, tn.name)
         cols = [
             PlanCol(c.name, c.ft, tn.alias or tn.name, c.offset)
@@ -290,6 +293,40 @@ class PlanBuilder:
                 cur = getattr(ds, attr, None) or set()
                 setattr(ds, attr, cur | wanted)
         return ds
+
+    MAX_VIEW_DEPTH = 16
+
+    def _build_view(self, tn: ast.TableName, vdef: dict) -> LogicalPlan:
+        """Expand a view reference: re-plan the stored SELECT against the
+        current schema, then re-alias through a Projection barrier (ref:
+        planner/core/logical_plan_builder.go BuildDataSourceFromView)."""
+        self._view_depth = getattr(self, "_view_depth", 0) + 1
+        # a view definition is an INDEPENDENT name scope planned in the
+        # view's own database: the caller's db, CTE names, hints, and
+        # outer scopes must not leak in
+        saved = (self.db, self._cte_frames, self._outer_scopes, self.hints)
+        self.db = vdef["db"]
+        self._cte_frames = []
+        self._outer_scopes = []
+        self.hints = []
+        try:
+            if self._view_depth > self.MAX_VIEW_DEPTH:
+                raise TiDBError(f"view {tn.name!r} nests too deeply (cycle?)")
+            from ..parser import parse_one
+
+            sub = self.build_select(parse_one(vdef["sql"]))
+            names = vdef.get("cols") or [c.name for c in sub.out_cols]
+            if len(names) != len(sub.out_cols):
+                raise TiDBError(
+                    f"view {tn.name!r} column list does not match its definition"
+                )
+            alias = tn.alias or tn.name
+            cols = [PlanCol(n, c.ft, alias) for n, c in zip(names, sub.out_cols)]
+            exprs = [ECol(i, c.ft, c.name) for i, c in enumerate(sub.out_cols)]
+            return Projection(sub, exprs, cols)
+        finally:
+            self._view_depth -= 1
+            self.db, self._cte_frames, self._outer_scopes, self.hints = saved
 
     def build_from(self, node) -> LogicalPlan:
         if node is None:

@@ -202,12 +202,13 @@ class Session:
             return self._is_cache
         dbs = {d.name: d for d in m.list_dbs()}
         tables = {t.id: t for t in m.list_tables()}
+        views = {(v["db"], v["name"]): v for v in m.list_views()}
         txn.rollback()
         if self._temp_tables:
             # temp tables merge LAST so the constructor's insertion-order
             # _by_name loop shadows same-named permanent tables
             tables = {**tables, **{t.id: t for t in self._temp_tables.values()}}
-        self._is_cache = InfoSchema(ver, dbs, tables)
+        self._is_cache = InfoSchema(ver, dbs, tables, views)
         self._is_cache._cache_key = key
         return self._is_cache
 
@@ -647,6 +648,10 @@ class Session:
             return self._ddl_create_sequence(stmt)
         if isinstance(stmt, ast.DropSequence):
             return self._ddl_drop_sequence(stmt)
+        if isinstance(stmt, ast.CreateView):
+            return self._ddl_create_view(stmt)
+        if isinstance(stmt, ast.DropView):
+            return self._ddl_drop_view(stmt)
         if isinstance(stmt, ast.LoadStats):
             import json as _json
 
@@ -832,9 +837,11 @@ class Session:
         from ..privilege.cache import PrivilegeError
 
         if grant:
-            # the table must exist on GRANT; REVOKE must still work for
-            # grants whose table was since dropped
-            self.infoschema().table(stmt.db, stmt.table)
+            # the object must exist on GRANT (table OR view); REVOKE must
+            # still work for grants whose object was since dropped
+            is_ = self.infoschema()
+            if (stmt.db.lower(), stmt.table.lower()) not in is_.views:
+                is_.table(stmt.db, stmt.table)
         u = self._q(spec.user)
         d = self._q(stmt.db)
         t = self._q(stmt.table)
@@ -1333,6 +1340,9 @@ class Session:
                 return ResultSet([], None)
             raise TiDBError(f"sequence {stmt.table.name!r} already exists")
         # sequences share the table namespace (ErrTableExists behavior)
+        if m.view(db, stmt.table.name) is not None:
+            txn.rollback()
+            raise TableExists(f"a view named {stmt.table.name!r} already exists (shared namespace)")
         try:
             self.infoschema().table(db, stmt.table.name)
             txn.rollback()
@@ -1380,6 +1390,65 @@ class Session:
                 txn.rollback()
                 raise
         raise RetryableError(f"{what} kept conflicting")
+
+    # --------------------------------------------------------------- views
+
+    def _ddl_create_view(self, stmt: ast.CreateView) -> ResultSet:
+        """CREATE [OR REPLACE] VIEW: the definition is stored as SQL text
+        and re-planned at reference time against the CURRENT schema (ref:
+        ddl/ddl_api.go CreateView; TiDB stores the select as ViewInfo)."""
+        db = stmt.table.db or self.current_db
+        # the definition must plan NOW so broken views fail at CREATE —
+        # in the VIEW's own database — and an explicit column list must
+        # match its arity
+        vbuilder = self._builder()
+        vbuilder.db = db
+        plan = optimize(vbuilder.build_select(parse_one(stmt.select_sql)), self.store.stats)
+        if stmt.cols and len(stmt.cols) != len(plan.out_cols):
+            raise TiDBError(
+                f"view {stmt.table.name!r} column list does not match its definition")
+        txn = self._ddl_txn()
+        m = Meta(txn)
+        dbi = m.db(db)
+        if dbi is None:
+            txn.rollback()
+            raise UnknownDatabase(f"unknown database {db!r}")
+        if m.view(db, stmt.table.name) is not None and not stmt.or_replace:
+            txn.rollback()
+            raise TableExists(f"view {stmt.table.name!r} already exists")
+        # table/sequence clash checks run INSIDE the DDL txn so a racing
+        # CREATE TABLE conflicts instead of slipping past a stale snapshot
+        for tid in dbi.table_ids:
+            t = m.table(tid)
+            if t and t.name.lower() == stmt.table.name.lower():
+                txn.rollback()
+                raise TableExists(f"table {stmt.table.name!r} already exists")
+        if m.sequence(db, stmt.table.name) is not None:
+            txn.rollback()
+            raise TableExists(
+                f"a sequence named {stmt.table.name!r} already exists (shared namespace)")
+        m.put_view({
+            "db": db.lower(), "name": stmt.table.name.lower(),
+            "cols": list(stmt.cols), "sql": stmt.select_sql,
+        })
+        m.bump_schema_version()
+        txn.commit()
+        return ResultSet([], None)
+
+    def _ddl_drop_view(self, stmt: ast.DropView) -> ResultSet:
+        for tn in stmt.names:
+            db = tn.db or self.current_db
+            txn = self._ddl_txn()
+            m = Meta(txn)
+            if m.view(db, tn.name) is None:
+                txn.rollback()
+                if stmt.if_exists:
+                    continue
+                raise UnknownTable(f"view {db}.{tn.name} doesn't exist")
+            m.drop_view(db, tn.name)
+            m.bump_schema_version()
+            txn.commit()
+        return ResultSet([], None)
 
     @property
     def _seq_gen(self) -> int:
@@ -2208,6 +2277,9 @@ class Session:
             t = m.table(tid)
             phys.extend(t.physical_ids() if t else [tid])
             m.drop_table(tid)
+        for vw in m.list_views():
+            if vw["db"] == stmt.name.lower():
+                m.drop_view(vw["db"], vw["name"])
         dropped_seq = False
         for sq in m.list_sequences():
             if sq["db"] == stmt.name.lower():
@@ -2245,6 +2317,11 @@ class Session:
             txn.rollback()
             raise TableExists(
                 f"a sequence named {stmt.table.name!r} already exists (shared namespace)"
+            )
+        if m.view(db, stmt.table.name) is not None:
+            txn.rollback()
+            raise TableExists(
+                f"a view named {stmt.table.name!r} already exists (shared namespace)"
             )
 
         try:
@@ -2714,7 +2791,10 @@ class Session:
             return ResultSet(["Database"], chk)
         if stmt.kind == "tables":
             db = stmt.target or self.current_db
-            tbls = [t.name for t in is_.tables_in_db(db)]
+            tbls = sorted(
+                [t.name for t in is_.tables_in_db(db)]
+                + [n for d, n in is_.views if d == db.lower()]
+            )
             chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(n)] for n in tbls])
             return ResultSet([f"Tables_in_{db}"], chk)
         if stmt.kind == "columns":
@@ -2776,6 +2856,14 @@ class Session:
             chk = Chunk.from_datum_rows([ft_varchar()] * 6, rows)
             return ResultSet(["Db_name", "Table_name", "Column_name", "Distinct_count", "Null_count", "Buckets"], chk)
         if stmt.kind == "create_table":
+            vdef = is_.views.get(
+                ((stmt.target.db or self.current_db).lower(), stmt.target.name.lower()))
+            if vdef is not None:
+                cols = f"({', '.join(vdef['cols'])}) " if vdef.get("cols") else ""
+                ddl = f"CREATE VIEW `{vdef['name']}` {cols}AS {vdef['sql']}"
+                chk = Chunk.from_datum_rows(
+                    [ft_varchar(), ft_varchar()], [[Datum.s(vdef["name"]), Datum.s(ddl)]])
+                return ResultSet(["View", "Create View"], chk)
             info = is_.table(stmt.target.db or self.current_db, stmt.target.name)
             chk = Chunk.from_datum_rows(
                 [ft_varchar(), ft_varchar()],
