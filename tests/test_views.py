@@ -85,14 +85,13 @@ class TestViews:
         u.user = "viewer"
         with pytest.raises(PrivilegeError):
             u.execute("select * from pv")
-        # table-scope grant on the VIEW name works
+        # table-scope grant on the VIEW name suffices: the stored
+        # definition runs with definer-style rights (the underlying
+        # table needs no separate grant), while direct reads of t stay denied
         s.execute("grant select on test.pv to viewer")
-        with pytest.raises(PrivilegeError):
-            u.execute("select * from t")  # underlying table still denied? no —
-        # NOTE: definer-rights semantics — the view's own reference to t is
-        # checked against the INVOKER here (simplification); grant it too
-        s.execute("grant select on test.t to viewer")
         assert u.must_query("select count(*) from pv") == [("9",)]
+        with pytest.raises(PrivilegeError):
+            u.execute("select * from t")
 
     def test_view_in_explain(self, s):
         s.execute("create view ev as select g, sum(v) s from t group by g")
@@ -135,3 +134,43 @@ class TestViewScoping:
         s.execute("create view mmm as select 1")
         names = [r[0] for r in s.must_query("show tables")]
         assert names == sorted(names)
+
+    def test_information_schema_views(self, s):
+        s.execute("create view isv as select id from t")
+        rows = s.must_query(
+            "select table_schema, table_name, view_definition from information_schema.views")
+        assert ("test", "isv", "select id from t") in rows
+
+    def test_create_drop_view_need_privileges(self, s):
+        s.execute("create view gp as select 1")
+        s.execute("create user nob")
+        u = Session(s.store)
+        u.user = "nob"
+        with pytest.raises(PrivilegeError):
+            u.execute("create or replace view gp as select 42")
+        with pytest.raises(PrivilegeError):
+            u.execute("drop view gp")
+
+    def test_temp_table_shadows_view(self, s):
+        s.execute("create view shv as select 1 as a")
+        s.execute("create temporary table shv (a int primary key)")
+        s.execute("insert into shv values (999)")
+        assert s.must_query("select a from shv") == [("999",)]  # temp wins
+        s.execute("drop table shv")
+        assert s.must_query("select a from shv") == [("1",)]  # view again
+
+    def test_caller_recursive_cte_does_not_leak_into_view(self, s):
+        s.execute("create table x (a int primary key)")
+        s.execute("insert into x values (5)")
+        s.execute("create view vx as select a from x")
+        got = s.must_query(
+            "with recursive x as (select 1 as n union all "
+            "select n + (select max(a) from vx) from x where n < 20) "
+            "select max(n) from x")
+        assert got == [("21",)]
+
+    def test_information_schema_tables_lists_views(self, s):
+        s.execute("create view itv as select 1")
+        rows = s.must_query(
+            "select table_name from information_schema.tables where table_schema = 'test'")
+        assert ("itv",) in rows

@@ -59,6 +59,10 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         [ft_varchar(16), ft_varchar(64), ft_varchar(32), ft_varchar(40),
          ft_varchar(32), ft_varchar(32)],
     ),
+    "views": (
+        ["TABLE_SCHEMA", "TABLE_NAME", "VIEW_DEFINITION"],
+        [ft_varchar(64), ft_varchar(64), ft_varchar(1024)],
+    ),
     "deadlocks": (
         ["DEADLOCK_ID", "OCCUR_TIME", "TRY_LOCK_TRX_ID", "TRX_HOLDING_LOCK"],
         [ft_longlong(), ft_varchar(32), ft_longlong(), ft_longlong()],
@@ -82,6 +86,8 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.s(t.db_name), Datum.s(t.name), Datum.i(t.id),
                 Datum.i(int(rows)), Datum.i(1 if t.pk_is_handle else 0),
             ])
+        for (d, n) in sorted(is_.views):
+            out.append([Datum.s(d), Datum.s(n), Datum.i(-1), Datum.i(0), Datum.i(0)])
         return out
     if name == "columns":
         is_ = session.infoschema()
@@ -166,6 +172,11 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.f(sum(vs) / len(vs)), Datum.f(min(vs)), Datum.f(max(vs)),
             ])
         return out
+    if name == "views":
+        return [
+            [Datum.s(d), Datum.s(n), Datum.s(v["sql"])]
+            for (d, n), v in sorted(session.infoschema().views.items())
+        ]
     if name == "deadlocks":
         out = []
         det = session.store.detector

@@ -234,10 +234,14 @@ class PlanBuilder:
         return node
 
     @staticmethod
-    def _alias_barrier(sub: LogicalPlan, cte: ast.CTEDef, alias: str) -> LogicalPlan:
-        names = cte.cols or [c.name for c in sub.out_cols]
+    def _alias_barrier(sub: LogicalPlan, obj, alias: str, what: str = "CTE") -> LogicalPlan:
+        """Re-alias a subplan through a Projection: explicit column list
+        (CTE/view) or the subplan's own names (shared by CTEs, derived
+        tables, and views)."""
+        declared = obj.cols if obj is not None else []
+        names = declared or [c.name for c in sub.out_cols]
         if len(names) != len(sub.out_cols):
-            raise TiDBError("CTE column list length mismatch")
+            raise TiDBError(f"{what} column list length mismatch")
         cols = [PlanCol(nm, c.ft, alias) for nm, c in zip(names, sub.out_cols)]
         exprs = [ECol(i, c.ft, c.name) for i, c in enumerate(sub.out_cols)]
         return Projection(sub, exprs, cols)
@@ -262,8 +266,12 @@ class PlanBuilder:
 
                 return Memtable(name, lambda: provider(name), cols)
         db = tn.db or self.db
-        vdef = self.is_.views.get(((tn.db or self.db).lower(), tn.name.lower()))
-        if vdef is not None:
+        key = ((tn.db or self.db).lower(), tn.name.lower())
+        vdef = self.is_.views.get(key)
+        shadow = self.is_._by_name.get(key)
+        # a session temp table shadows a same-named view (temp wins over
+        # everything, matching the temp-shadows-permanent rule)
+        if vdef is not None and not getattr(shadow, "temporary", False):
             return self._build_view(tn, vdef)
         info = self.is_.table(db, tn.name)
         cols = [
@@ -304,29 +312,25 @@ class PlanBuilder:
         # a view definition is an INDEPENDENT name scope planned in the
         # view's own database: the caller's db, CTE names, hints, and
         # outer scopes must not leak in
-        saved = (self.db, self._cte_frames, self._outer_scopes, self.hints)
+        saved = (self.db, self._cte_frames, self._outer_scopes, self.hints,
+                 getattr(self, "_rec_bindings", {}))
         self.db = vdef["db"]
         self._cte_frames = []
         self._outer_scopes = []
         self.hints = []
+        self._rec_bindings = {}
         try:
             if self._view_depth > self.MAX_VIEW_DEPTH:
                 raise TiDBError(f"view {tn.name!r} nests too deeply (cycle?)")
             from ..parser import parse_one
 
             sub = self.build_select(parse_one(vdef["sql"]))
-            names = vdef.get("cols") or [c.name for c in sub.out_cols]
-            if len(names) != len(sub.out_cols):
-                raise TiDBError(
-                    f"view {tn.name!r} column list does not match its definition"
-                )
-            alias = tn.alias or tn.name
-            cols = [PlanCol(n, c.ft, alias) for n, c in zip(names, sub.out_cols)]
-            exprs = [ECol(i, c.ft, c.name) for i, c in enumerate(sub.out_cols)]
-            return Projection(sub, exprs, cols)
+            holder = type("V", (), {"cols": vdef.get("cols") or []})()
+            return self._alias_barrier(sub, holder, tn.alias or tn.name, what=f"view {tn.name!r}")
         finally:
             self._view_depth -= 1
-            self.db, self._cte_frames, self._outer_scopes, self.hints = saved
+            (self.db, self._cte_frames, self._outer_scopes, self.hints,
+             self._rec_bindings) = saved
 
     def build_from(self, node) -> LogicalPlan:
         if node is None:

@@ -526,6 +526,10 @@ class Session:
             for alias, (d, t) in alias_map.items():
                 out.append(("DELETE" if alias in targets else "SELECT", d, t))
             return out + reads
+        if isinstance(stmt, ast.CreateView):
+            return [("CREATE", (stmt.table.db or self.current_db).lower())]
+        if isinstance(stmt, ast.DropView):
+            return [("DROP", (tn.db or self.current_db).lower()) for tn in stmt.names]
         if isinstance(stmt, (ast.CreateTable, ast.CreateDatabase)):
             db = getattr(getattr(stmt, "table", None), "db", None) or getattr(stmt, "name", None) or self.current_db
             return [("CREATE", db.lower())]
