@@ -174,3 +174,31 @@ class TestViewScoping:
         rows = s.must_query(
             "select table_name from information_schema.tables where table_schema = 'test'")
         assert ("itv",) in rows
+
+    def test_desc_view(self, s):
+        s.execute("create view dv (k, nxt) as select id, id + 1 from t")
+        rows = s.must_query("desc dv")
+        assert [r[0] for r in rows] == ["k", "nxt"]
+        rows2 = s.must_query("show columns from dv")
+        assert rows == rows2
+
+    def test_desc_view_scope_and_shadow(self, s):
+        s.execute("create database dd")
+        s.execute("create table dd.t2 (a int primary key)")
+        s.execute("create view dd.v2 as select a from t2")
+        # DESC from another db plans in the view's own db
+        assert [r[0] for r in s.must_query("desc dd.v2")] == ["a"]
+        # temp table shadows the view in DESC as in SELECT
+        s.execute("create view shd as select 1 as a")
+        s.execute("create temporary table shd (b int primary key)")
+        assert [r[0] for r in s.must_query("desc shd")] == ["b"]
+
+    def test_or_replace_requires_drop_priv(self, s):
+        s.execute("create view orv as select 1")
+        s.execute("create user maker")
+        s.execute("grant create on test.* to maker")
+        u = Session(s.store)
+        u.user = "maker"
+        u.execute("create view maker_own as select 1")  # plain create ok
+        with pytest.raises(PrivilegeError):
+            u.execute("create or replace view orv as select 42")

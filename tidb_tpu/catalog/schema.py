@@ -259,6 +259,10 @@ class InfoSchema:
     def has_db(self, db: str) -> bool:
         return db.lower() in self.dbs
 
+    def table_or_none(self, db: str, name: str) -> TableInfo | None:
+        """Public lookup without raising (planner shadow checks)."""
+        return self._by_name.get((db.lower(), name.lower()))
+
     def table(self, db: str, name: str) -> TableInfo:
         t = self._by_name.get((db.lower(), name.lower()))
         if t is None:

@@ -200,7 +200,7 @@ class PlanBuilder:
                     if frame.get(cte.name.lower()) == ("building", cte):
                         frame[cte.name.lower()] = entry
                         break
-            return self._alias_barrier(sub, cte, alias)
+            return self._alias_barrier(sub, cte.cols, alias)
         # recursive CTE: split seed vs recursive branches
         sel = cte.select
         if not isinstance(sel, ast.SetOpSelect) or len(sel.selects) != 2:
@@ -234,11 +234,10 @@ class PlanBuilder:
         return node
 
     @staticmethod
-    def _alias_barrier(sub: LogicalPlan, obj, alias: str, what: str = "CTE") -> LogicalPlan:
+    def _alias_barrier(sub: LogicalPlan, declared: list, alias: str, what: str = "CTE") -> LogicalPlan:
         """Re-alias a subplan through a Projection: explicit column list
         (CTE/view) or the subplan's own names (shared by CTEs, derived
         tables, and views)."""
-        declared = obj.cols if obj is not None else []
         names = declared or [c.name for c in sub.out_cols]
         if len(names) != len(sub.out_cols):
             raise TiDBError(f"{what} column list length mismatch")
@@ -268,7 +267,7 @@ class PlanBuilder:
         db = tn.db or self.db
         key = ((tn.db or self.db).lower(), tn.name.lower())
         vdef = self.is_.views.get(key)
-        shadow = self.is_._by_name.get(key)
+        shadow = self.is_.table_or_none(*key)
         # a session temp table shadows a same-named view (temp wins over
         # everything, matching the temp-shadows-permanent rule)
         if vdef is not None and not getattr(shadow, "temporary", False):
@@ -325,8 +324,7 @@ class PlanBuilder:
             from ..parser import parse_one
 
             sub = self.build_select(parse_one(vdef["sql"]))
-            holder = type("V", (), {"cols": vdef.get("cols") or []})()
-            return self._alias_barrier(sub, holder, tn.alias or tn.name, what=f"view {tn.name!r}")
+            return self._alias_barrier(sub, vdef.get("cols") or [], tn.alias or tn.name, what=f"view {tn.name!r}")
         finally:
             self._view_depth -= 1
             (self.db, self._cte_frames, self._outer_scopes, self.hints,

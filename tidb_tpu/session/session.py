@@ -527,7 +527,9 @@ class Session:
                 out.append(("DELETE" if alias in targets else "SELECT", d, t))
             return out + reads
         if isinstance(stmt, ast.CreateView):
-            return [("CREATE", (stmt.table.db or self.current_db).lower())]
+            db = (stmt.table.db or self.current_db).lower()
+            # OR REPLACE can destroy an existing definition: DROP too
+            return [("CREATE", db)] + ([("DROP", db)] if stmt.or_replace else [])
         if isinstance(stmt, ast.DropView):
             return [("DROP", (tn.db or self.current_db).lower()) for tn in stmt.names]
         if isinstance(stmt, (ast.CreateTable, ast.CreateDatabase)):
@@ -2802,6 +2804,26 @@ class Session:
             chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(n)] for n in tbls])
             return ResultSet([f"Tables_in_{db}"], chk)
         if stmt.kind == "columns":
+            vkey = ((stmt.target.db or self.current_db).lower(), stmt.target.name.lower())
+            vdef = is_.views.get(vkey)
+            # a session temp table shadows a same-named view (same rule as
+            # the planner's name resolution)
+            shadow = is_.table_or_none(*vkey)
+            if vdef is not None and not getattr(shadow, "temporary", False):
+                # DESC on a view: plan the definition in the VIEW's OWN
+                # database (no caller db/temp leakage — mirror _build_view)
+                vbuilder = self._builder()
+                vbuilder.db = vdef["db"]
+                plan = optimize(vbuilder.build_select(parse_one(vdef["sql"])), self.store.stats)
+                names = vdef.get("cols") or [c.name for c in plan.out_cols]
+                rows = [
+                    [Datum.s(n), Datum.s(c.ft.type_name()),
+                     Datum.s("NO" if c.ft.not_null else "YES"),
+                     Datum.s(""), Datum.null(), Datum.s("")]
+                    for n, c in zip(names, plan.out_cols)
+                ]
+                chk = Chunk.from_datum_rows([ft_varchar()] * 6, rows)
+                return ResultSet(["Field", "Type", "Null", "Key", "Default", "Extra"], chk)
             info = is_.table(stmt.target.db or self.current_db, stmt.target.name)
             rows = []
             for c in info.visible_columns():
