@@ -180,3 +180,37 @@ class TestTopSQLAndDeadlocks:
         rows = s.must_query(
             "select deadlock_id, try_lock_trx_id from information_schema.deadlocks")
         assert rows, "deadlock history is empty"
+
+
+class TestTrace:
+    """TRACE <sql> span rows (ref: executor/trace.go, util/tracing)."""
+
+    def test_trace_select(self, s):
+        s.execute("create table tr (id int primary key, v int)")
+        s.execute("insert into tr values (1,1),(2,2),(3,3)")
+        rows = s.must_query("trace select sum(v) from tr where id > 1")
+        ops = [r[0] for r in rows]
+        assert ops[0] == "session.execute"
+        assert any("executor." in o for o in ops)
+        assert all(r[2].endswith("ms") for r in rows)
+
+    def test_trace_dml_and_format(self, s):
+        s.execute("create table tw (id int primary key)")
+        rows = s.must_query("trace format = 'row' insert into tw values (9)")
+        assert rows[0][0] == "session.execute"
+        assert s.must_query("select id from tw") == [("9",)]
+
+    def test_trace_applies_gates(self, s):
+        from tidb_tpu.errors import ParseError
+        from tidb_tpu.privilege.cache import PrivilegeError
+        from tidb_tpu.session import Session
+
+        s.execute("create table sec (id int primary key)")
+        s.execute("create user peek")
+        u = Session(s.store)
+        u.user = "peek"
+        import pytest as _pt
+        with _pt.raises(PrivilegeError):
+            u.execute("trace select * from sec")
+        with _pt.raises(ParseError):
+            s.execute("trace format = 'json' select 1")

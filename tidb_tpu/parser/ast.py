@@ -424,6 +424,11 @@ class UnlockTables:
 
 
 @dataclass
+class TraceStmt:
+    stmt: Any  # traced inner statement
+
+
+@dataclass
 class KillStmt:
     conn_id: int
     query_only: bool = False

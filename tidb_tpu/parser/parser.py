@@ -208,6 +208,7 @@ class Parser:
             "REVOKE": self.grant_stmt,
             "LOCK": self.lock_stmt,
             "UNLOCK": self.unlock_stmt,
+            "TRACE": self.trace_stmt,
         }.get(kw)
         if fn is None:
             self.fail(f"unsupported statement {kw}")
@@ -1572,6 +1573,17 @@ class Parser:
         elif self.try_kw("WHERE"):
             node.where = self.expr()
         return node
+
+    def trace_stmt(self):
+        """TRACE [FORMAT = 'row'] <stmt> (ref: executor/trace.go TraceExec:
+        renders the statement's span tree as rows)."""
+        self.expect_kw("TRACE")
+        if self.try_kw("FORMAT"):
+            self.expect_op("=")
+            fmt = self._str_lit("trace format")
+            if fmt.lower() != "row":
+                self.fail(f"unsupported TRACE format {fmt!r} (only 'row')")
+        return ast.TraceStmt(self.statement())
 
     def explain_stmt(self):
         self.next()

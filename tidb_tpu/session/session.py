@@ -526,6 +526,8 @@ class Session:
             for alias, (d, t) in alias_map.items():
                 out.append(("DELETE" if alias in targets else "SELECT", d, t))
             return out + reads
+        if isinstance(stmt, ast.TraceStmt):
+            return self._stmt_privileges(stmt.stmt)
         if isinstance(stmt, ast.CreateView):
             db = (stmt.table.db or self.current_db).lower()
             # OR REPLACE can destroy an existing definition: DROP too
@@ -654,6 +656,8 @@ class Session:
             return self._ddl_create_sequence(stmt)
         if isinstance(stmt, ast.DropSequence):
             return self._ddl_drop_sequence(stmt)
+        if isinstance(stmt, ast.TraceStmt):
+            return self._run_trace(stmt)
         if isinstance(stmt, ast.CreateView):
             return self._ddl_create_view(stmt)
         if isinstance(stmt, ast.DropView):
@@ -1210,6 +1214,12 @@ class Session:
         if (tl is not None and tl._locks) or getattr(self, "_locked_ids", None):
             self._check_plan_locks(plan)
         ex = build_executor(plan, ctx)
+        if getattr(self, "_trace_collect", False):
+            # TRACE hook: instrument THIS (fully gated) execution rather
+            # than re-running the select outside the normal path
+            from ..executor.runtime_stats import attach_runtime_stats
+
+            self._trace_result = (ex, attach_runtime_stats(ex))
         chunk = drain(ex)
         names = [c.name for c in plan.out_cols]
         rs = ResultSet(names, chunk)
@@ -2980,6 +2990,48 @@ class Session:
         lines = plan.pretty().split("\n")
         chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(l)] for l in lines])
         return ResultSet(["plan"], chk)
+
+    def _run_trace(self, stmt: ast.TraceStmt) -> ResultSet:
+        """TRACE <sql>: span rows (operation, startTS, duration) from the
+        instrumented run (ref: executor/trace.go + util/tracing; spans
+        come from the same per-operator runtime stats EXPLAIN ANALYZE
+        uses — no separate tracer needed in-process)."""
+        from ..executor.runtime_stats import child_execs
+
+        inner = stmt.stmt
+        spans: list[tuple[str, float, float]] = []  # (op, start_ms, dur_ms)
+        t_base = time.perf_counter_ns()
+        # the inner statement runs through _execute_stmt so EVERY gate
+        # (privileges, table locks, hints, outfile, ...) applies exactly
+        # as it would un-traced; run_select stores the instrumented tree
+        self._trace_collect = True
+        self._trace_result = None
+        try:
+            self._execute_stmt(inner)
+        finally:
+            self._trace_collect = False
+        t_done = time.perf_counter_ns()
+        spans.append(("session.execute", 0.0, (t_done - t_base) / 1e6))
+        if self._trace_result is not None:
+            ex, stats = self._trace_result
+            self._trace_result = None
+
+            def rec(e, depth):
+                st = stats.get(id(e), {"time_ns": 0, "rows": 0})
+                spans.append((
+                    f"{'.' * depth}executor.{type(e).__name__}",
+                    0.0, st["time_ns"] / 1e6,
+                ))
+                for ch in child_execs(e):
+                    rec(ch, depth + 1)
+
+            rec(ex, 0)
+        rows = [
+            [Datum.s(op), Datum.s(f"{start:.3f}ms"), Datum.s(f"{dur:.3f}ms")]
+            for op, start, dur in spans
+        ]
+        chk = Chunk.from_datum_rows([ft_varchar()] * 3, rows)
+        return ResultSet(["operation", "startTS", "duration"], chk)
 
     def _run_explain_analyze(self, plan) -> ResultSet:
         """Execute with per-operator runtime stats + cop-layer counters
