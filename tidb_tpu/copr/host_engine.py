@@ -126,8 +126,12 @@ def _lex_argsort(keys, n: int) -> np.ndarray:
             strs = np.where(v, d, "").astype("U")
             x = np.unique(strs, return_inverse=True)[1].astype(np.int64)
         else:
-            x = d.astype(np.float64) if d.dtype != np.float64 else d
-        idx = np.argsort((-x if desc else x)[order], kind="stable")
+            x = d
+        # DESC int lanes flip via ~x (monotone decreasing, exact for the
+        # full int64 range — a float64 negate would lose >2^53 keys)
+        if desc:
+            x = -x if x.dtype == np.float64 else ~x
+        idx = np.argsort(x[order], kind="stable")
         order = order[idx]
         # NULLs first asc / last desc (boolean selection is stable)
         nulls = ~v[order]

@@ -156,6 +156,7 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
             plan.order_by,
             plan.funcs,
             [c.ft for c in plan.out_cols],
+            ctx,
         )
     if isinstance(plan, Sort):
         quota = int(ctx.vars.get("tidb_mem_quota_query", "0") or 0)
@@ -555,6 +556,10 @@ class LimitExec(Executor):
         self.child.close()
 
 
+class _NotOnDevice(Exception):
+    """Window func/lane without a device form — reason for EXPLAIN ANALYZE."""
+
+
 class WindowExec(Executor):
     """Window functions for one (PARTITION BY, ORDER BY) spec (ref:
     executor/window.go:31, pipelined_window.go:37, aggfuncs window funcs).
@@ -566,13 +571,16 @@ class WindowExec(Executor):
     back to input row order. Only min/max accumulation and decimal AVG
     walk partitions/peers in Python; everything else is numpy."""
 
-    def __init__(self, child: Executor, part_by, order_by, funcs, out_fts):
+    def __init__(self, child: Executor, part_by, order_by, funcs, out_fts, ctx=None):
         self.child = child
         self.part_by = part_by
         self.order_by = order_by
         self.funcs = funcs
         self.out_fts = out_fts
+        self.ctx = ctx
         self._done = False
+        self.last_engine = "host"  # surfaced by EXPLAIN ANALYZE
+        self.fallback_reason = ""
 
     def open(self):
         self._done = False
@@ -628,10 +636,13 @@ class WindowExec(Executor):
                 else:
                     data, valid = self._avg_from_sums(f, ft, s, cnt, pid)
             else:  # min / max
-                init = (np.inf if f.name == "min" else -np.inf) if d.dtype == np.float64 else (
-                    np.iinfo(np.int64).max if f.name == "min" else np.iinfo(np.int64).min
-                )
-                acc = np.full(G, init, dtype=d.dtype if d.dtype == np.float64 else np.int64)
+                if d.dtype == np.float64:
+                    init = np.inf if f.name == "min" else -np.inf
+                    acc_dt = np.float64
+                else:  # keep the lane's own int dtype (uint64 lanes wrap in int64)
+                    acc_dt = d.dtype
+                    init = np.iinfo(acc_dt).max if f.name == "min" else np.iinfo(acc_dt).min
+                acc = np.full(G, init, dtype=acc_dt)
                 fn = np.minimum if f.name == "min" else np.maximum
                 fn.at(acc, pid, np.where(v, d, init))
                 data, valid = acc[pid], cnt[pid] > 0
@@ -658,6 +669,129 @@ class WindowExec(Executor):
                     qv[g] = True
         return qs[pid], qv[pid]
 
+    def _try_device(self, c: Chunk, n: int):
+        """Route the window onto the device (sort + segmented scans in one
+        XLA program — window_device.py) when the engine allows and every
+        func/lane has a device form. Returns the output Chunk or None."""
+        from .window_device import MIN_DEVICE_ROWS
+
+        eng = getattr(self.ctx, "engine", "auto") if self.ctx is not None else "auto"
+        if eng == "host" or (eng != "tpu" and n < MIN_DEVICE_ROWS):
+            return None
+        try:
+            fspecs = self._device_fspecs(c, n)
+        except _NotOnDevice as e:
+            self.fallback_reason = str(e)
+            return None
+        from .window_device import encode_obj, run_device_window
+
+        def key_lane(e):
+            d, v = self._lane(e, c, n)
+            if d.dtype == object:
+                d = encode_obj(d, v)[0]
+            return d, v
+
+        part = [key_lane(e) for e in self.part_by]
+        order = [(key_lane(e), desc) for e, desc in self.order_by]
+        try:
+            results = run_device_window(part, order, fspecs, n)
+        except Exception as e:  # noqa: BLE001 — device route is best-effort
+            if eng == "tpu":
+                raise  # forced device: surface the real failure
+            self.fallback_reason = f"device window failed: {type(e).__name__}: {e}"
+            return None
+        self.last_engine = "tpu"
+        cols = list(c.columns)
+        nbase = len(cols)
+        for i, (data, valid) in enumerate(results):
+            cols.append(Column(self.out_fts[nbase + i], data, valid))
+        return Chunk(cols)
+
+    def _device_fspecs(self, c: Chunk, n: int):
+        """Build window_device fspecs; raises _NotOnDevice when some func
+        has no device form (the reason lands in EXPLAIN ANALYZE)."""
+        from .window_device import SUPPORTED, encode_obj
+
+        fspecs = []
+        for f in self.funcs:
+            if f.name not in SUPPORTED:
+                raise _NotOnDevice(f"window func {f.name} has no device kernel")
+
+            def const_int(e, what):
+                if not isinstance(e, Constant):
+                    raise _NotOnDevice(f"non-constant {what} for {f.name}")
+                return e.value.to_int()
+
+            name = f.name
+            spec = {"name": name, "args": [], "post": None}
+            if name == "ntile":
+                spec["static"] = ("ntile", const_int(f.args[0], "bucket count"))
+            elif name in ("row_number", "rank", "dense_rank", "cume_dist", "percent_rank"):
+                spec["static"] = (name,)
+            elif name in ("lead", "lag"):
+                off = const_int(f.args[1], "offset") if len(f.args) > 1 else 1
+                has_default = len(f.args) > 2
+                d, v = self._lane(f.args[0], c, n)
+                if has_default:
+                    dd, dv = self._lane(f.args[2], c, n)
+                    if (d.dtype == object) != (dd.dtype == object):
+                        raise _NotOnDevice("lead/lag default type mismatch")
+                    if d.dtype == object:
+                        # one vocab covers arg + default so codes compare
+                        d, vocab, dd = encode_obj(d, v, extra=np.where(dv, dd, ""))
+                        spec["post"] = ("decode", vocab)
+                    elif d.dtype != dd.dtype:
+                        d = d.astype(np.float64)
+                        dd = dd.astype(np.float64)
+                    spec["args"] = [(d, v), (dd, dv)]
+                else:
+                    if d.dtype == object:
+                        codes, vocab, _ = encode_obj(d, v)
+                        d = codes
+                        spec["post"] = ("decode", vocab)
+                    spec["args"] = [(d, v)]
+                spec["static"] = (name, off, has_default)
+            elif name in ("first_value", "last_value", "nth_value", "min", "max"):
+                d, v = self._lane(f.args[0], c, n)
+                if d.dtype == object:
+                    codes, vocab, _ = encode_obj(d, v)
+                    d = codes
+                    spec["post"] = ("decode", vocab)
+                spec["args"] = [(d, v)]
+                if name == "nth_value":
+                    spec["static"] = (name, const_int(f.args[1], "n"))
+                else:
+                    spec["static"] = (name,)
+            elif name == "count":
+                if f.args:
+                    d, v = self._lane(f.args[0], c, n)
+                    if d.dtype == object:
+                        d = np.zeros(n, dtype=np.int64)  # only validity matters
+                    spec["args"] = [(d, v)]
+                    spec["static"] = ("count", True)
+                else:
+                    spec["static"] = ("count", False)
+            elif name in ("sum", "avg"):
+                d, v = self._lane(f.args[0], c, n)
+                if d.dtype == object:
+                    raise _NotOnDevice(f"window {name} over string operands")
+                spec["args"] = [(d, v)]
+                if name == "sum":
+                    spec["static"] = ("sum", True)
+                elif d.dtype == np.float64 or f.ret_type.is_float():
+                    spec["static"] = ("avg", True, "f")
+                else:
+                    arg_scale = (
+                        max(f.args[0].ret_type.decimal, 0)
+                        if f.args[0].ret_type.is_decimal()
+                        else 0
+                    )
+                    out_scale = max(f.ret_type.decimal, 0)
+                    spec["static"] = ("avg", True, "dec")
+                    spec["post"] = ("avg_dec", arg_scale, out_scale)
+            fspecs.append(spec)
+        return fspecs
+
     def next(self):
         if self._done:
             return None
@@ -666,9 +800,20 @@ class WindowExec(Executor):
         n = c.num_rows
         if n == 0:
             return Chunk.empty(self.out_fts, 0)
+        eng = getattr(self.ctx, "engine", "auto") if self.ctx is not None else "auto"
+        if eng == "tpu":
+            # forced device: only fall to host when no device form exists
+            dev = self._try_device(c, n)
+            if dev is not None:
+                return dev
         fast = self._whole_partition_fast_path(c, n)
         if fast is not None:
+            # the O(n) bincount shape beats a device round-trip under 'auto'
             return fast
+        if eng != "tpu":
+            dev = self._try_device(c, n)
+            if dev is not None:
+                return dev
         from ..copr.host_engine import _lex_argsort
 
         part_lanes = [self._lane(e, c, n) for e in self.part_by]
@@ -843,7 +988,7 @@ class WindowExec(Executor):
         else:
             ufunc = np.minimum if name == "min" else np.maximum
             fill = (np.inf if name == "min" else -np.inf) if sd.dtype == np.float64 else (
-                np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
+                np.iinfo(sd.dtype).max if name == "min" else np.iinfo(sd.dtype).min
             )
             masked = np.where(sv, sd, fill)
             vcnt = np.cumsum(sv.astype(np.int64))

@@ -74,6 +74,14 @@ def render_tree(root: Executor, stats: dict[int, dict]) -> list[str]:
                 parts.append("limit")
             if parts:
                 extra = f" cop:[{'+'.join(parts)}]"
+        # which engine ran (tpu|host) and, on fallback, why — set by
+        # executors with a device path (WindowExec, cop readers)
+        eng = getattr(e, "last_engine", "")
+        if eng:
+            extra += f" engine:{eng}"
+            reason = getattr(e, "fallback_reason", "")
+            if reason:
+                extra += f" fallback:[{reason}]"
         lines.append(
             f"{'  ' * depth}{type(e).__name__}{extra} "
             f"rows:{st['rows']} loops:{st['loops']} time:{st['time_ns'] / 1e6:.3f}ms"

@@ -1,0 +1,328 @@
+"""Device window kernels — one `lax.sort` + segmented scans per window spec.
+
+The reference parallelizes windows by hash-sharding partitions across a
+worker fleet (executor/shuffle.go:77) and pipelining within a partition
+(executor/pipelined_window.go:37). On TPU the same work maps onto ONE
+fused XLA program over the whole chunk:
+
+    lexicographic `lax.sort` by (partition, order, row-id) keys
+      -> partition/peer boundary flags (vectorized compares)
+      -> cumulative / segmented scans (cumsum, cummax, associative_scan)
+      -> gathers at frame ends
+      -> scatter back to input row order via the carried row-id operand
+
+Every function the host `WindowExec` supports for MySQL's default frame
+(RANGE UNBOUNDED PRECEDING..CURRENT ROW) has a device form here; the sort
+order, NULL placement (first asc / last desc) and tie-breaks reproduce
+`host_engine._lex_argsort` exactly, so outputs are bit-identical to the
+host oracle for integer/decimal/string lanes (floats match up to summation
+order).
+
+Strings never reach the device: lanes are dict-encoded to sorted-vocab
+codes (binary-collation order preserved), computed in code space, decoded
+on the way out — the tpu_engine string story applied to windows.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, reduce
+
+import numpy as np
+
+from ..jaxenv import jax, jnp
+from ..mysqltypes.mydecimal import DIV_FRAC_INCR, MAX_SCALE, Dec, pow10
+
+# Below this many rows the ~100ms device dispatch dominates; 'auto' stays
+# on host. 'tpu' forces the device path (tests, EXPLAIN).
+MIN_DEVICE_ROWS = 1 << 15
+
+# func names with a device kernel (everything WindowExec supports)
+SUPPORTED = {
+    "row_number", "rank", "dense_rank", "ntile", "cume_dist", "percent_rank",
+    "lead", "lag", "first_value", "last_value", "nth_value",
+    "count", "sum", "avg", "min", "max",
+}
+
+# funcs whose output is a value drawn from the argument lane (decode via
+# the argument's vocab when the lane was dict-encoded)
+_PASSTHROUGH = {"lead", "lag", "first_value", "last_value", "nth_value", "min", "max"}
+
+
+def _bucket(n: int) -> int:
+    """Pad to a power of two so recompiles are bounded (tpu_engine TILE rule)."""
+    p = 1024
+    while p < n:
+        p <<= 1
+    return p
+
+
+def encode_obj(d: np.ndarray, v: np.ndarray, extra=None):
+    """Dict-encode an object lane to sorted-vocab codes.
+
+    Mirrors `_lex_argsort`'s np.unique trick, so code order == the host's
+    binary sort order. `extra` values (lead/lag defaults) share the vocab."""
+    strs = np.where(v, d, "").astype("U")
+    pool = strs if extra is None else np.concatenate([strs, np.atleast_1d(extra).astype("U")])
+    vocab, inv = np.unique(pool, return_inverse=True)
+    codes = inv[: len(strs)].astype(np.int64)
+    extra_codes = inv[len(strs):].astype(np.int64) if extra is not None else None
+    return codes, vocab, extra_codes
+
+
+@lru_cache(maxsize=256)
+def _build_kernel(spec):
+    """spec = (npart, order_descs, funcspecs) — all static, hashable."""
+    npart, order_descs, funcspecs = spec
+    descs = (False,) * npart + tuple(order_descs)
+
+    def kernel(keys, fargs, padflag):
+        P = padflag.shape[0]
+        iota = jnp.arange(P, dtype=jnp.int64)
+        ops = [padflag.astype(jnp.int32)]
+        for (d, v), desc in zip(keys, descs):
+            # NULLs first asc / last desc (host _lex_argsort contract)
+            nullkey = jnp.where(v, 0, 1) if desc else jnp.where(v, 1, 0)
+            dd = jnp.where(v, d, jnp.zeros((), d.dtype))
+            if desc:
+                dd = -dd if jnp.issubdtype(d.dtype, jnp.floating) else ~dd
+            ops += [nullkey.astype(jnp.int32), dd]
+        ops.append(iota)
+        nko = len(ops)
+        vals = []
+        for fa in fargs:
+            for (d, v) in fa:
+                vals += [d, v]
+        srt = jax.lax.sort(tuple(ops) + tuple(vals), num_keys=nko)
+        s_ops, perm, s_vals = srt[: nko - 1], srt[nko - 1], list(srt[nko:])
+
+        def chg(idxs):
+            if not idxs:
+                return jnp.zeros(P, dtype=bool).at[0].set(True)
+            c = reduce(
+                jnp.logical_or, [s_ops[i][1:] != s_ops[i][:-1] for i in idxs]
+            )
+            return jnp.concatenate([jnp.ones(1, dtype=bool), c])
+
+        part_idx = [0] + [1 + 2 * k + j for k in range(npart) for j in (0, 1)]
+        order_idx = [1 + 2 * k + j for k in range(npart, len(descs)) for j in (0, 1)]
+        pstart = chg(part_idx)
+        ostart = chg(part_idx + order_idx)
+        pfirst = jax.lax.cummax(jnp.where(pstart, iota, 0))
+        peer_first = jax.lax.cummax(jnp.where(ostart, iota, 0))
+
+        def seg_last(starts):
+            nxt = jnp.concatenate(
+                [jnp.where(starts, iota, P)[1:], jnp.full(1, P, dtype=jnp.int64)]
+            )
+            return jnp.flip(jax.lax.cummin(jnp.flip(nxt))) - 1
+
+        plast = seg_last(pstart)
+        peer_last = seg_last(ostart)
+        # default-frame end: current peer group (== partition end w/o ORDER BY)
+        fe = peer_last
+        pid = jnp.cumsum(pstart) - 1
+        psize = plast - pfirst + 1
+        rn = iota - pfirst
+        ones = jnp.ones(P, dtype=bool)
+
+        def scat(x):
+            return jnp.zeros(P, dtype=x.dtype).at[perm].set(x)
+
+        def frame_cnt_of(sv):
+            cs = jnp.cumsum(sv.astype(jnp.int64))
+            before = jnp.where(pfirst > 0, cs[jnp.maximum(pfirst - 1, 0)], 0)
+            return cs[fe] - before
+
+        def frame_sum_of(sd, sv):
+            zero = jnp.zeros((), dtype=sd.dtype)
+            cs = jnp.cumsum(jnp.where(sv, sd, zero))
+            before = jnp.where(pfirst > 0, cs[jnp.maximum(pfirst - 1, 0)], zero)
+            return cs[fe] - before
+
+        outs = []
+        vi = 0
+
+        def take_arg():
+            nonlocal vi
+            d, v = s_vals[vi], s_vals[vi + 1]
+            vi += 2
+            return d, v
+
+        for fs in funcspecs:
+            name = fs[0]
+            if name == "row_number":
+                sd, sv = rn + 1, ones
+            elif name == "rank":
+                sd, sv = peer_first - pfirst + 1, ones
+            elif name == "dense_rank":
+                dcs = jnp.cumsum(ostart.astype(jnp.int64))
+                sd, sv = dcs - dcs[pfirst] + 1, ones
+            elif name == "ntile":
+                k = fs[1]
+                big, rem = psize // k, psize % k
+                cut = rem * (big + 1)
+                sd = jnp.where(
+                    big > 0,
+                    jnp.where(
+                        rn < cut,
+                        rn // jnp.maximum(big + 1, 1),
+                        rem + (rn - cut) // jnp.maximum(big, 1),
+                    ),
+                    rn,
+                ) + 1
+                sv = ones
+            elif name == "cume_dist":
+                sd, sv = (peer_last - pfirst + 1) / psize, ones
+            elif name == "percent_rank":
+                rank = peer_first - pfirst + 1
+                sd = jnp.where(psize > 1, (rank - 1) / jnp.maximum(psize - 1, 1), 0.0)
+                sv = ones
+            elif name in ("lead", "lag"):
+                off, has_default = fs[1], fs[2]
+                sd0, sv0 = take_arg()
+                tgt = iota + (off if name == "lead" else -off)
+                tgt_c = jnp.clip(tgt, 0, P - 1)
+                ok = (tgt >= 0) & (tgt < P) & (pid[tgt_c] == pid)
+                if has_default:
+                    dd, dv = take_arg()
+                else:
+                    dd = jnp.zeros(P, dtype=sd0.dtype)
+                    dv = jnp.zeros(P, dtype=bool)
+                sd = jnp.where(ok, sd0[tgt_c], dd)
+                sv = jnp.where(ok, sv0[tgt_c], dv)
+            elif name in ("first_value", "last_value", "nth_value"):
+                sd0, sv0 = take_arg()
+                if name == "first_value":
+                    pos, ok = pfirst, ones
+                elif name == "last_value":
+                    pos, ok = fe, ones
+                else:
+                    pos = pfirst + fs[1] - 1
+                    ok = pos <= fe
+                    pos = jnp.minimum(pos, P - 1)
+                sd, sv = sd0[pos], sv0[pos] & ok
+            elif name == "count":
+                if fs[1]:
+                    _, sv0 = take_arg()
+                else:
+                    sv0 = ones
+                sd, sv = frame_cnt_of(sv0), ones
+            elif name in ("sum", "avg"):
+                if fs[1]:
+                    sd0, sv0 = take_arg()
+                else:
+                    sd0, sv0 = jnp.ones(P, dtype=jnp.int64), ones
+                fcnt = frame_cnt_of(sv0)
+                fsum = frame_sum_of(sd0, sv0)
+                if name == "sum":
+                    sd, sv = fsum, fcnt > 0
+                elif fs[2] == "dec":
+                    # exact finish happens on host from (sum, cnt)
+                    outs.append((scat(fsum), scat(fcnt)))
+                    continue
+                else:
+                    sd = jnp.where(fcnt > 0, fsum / jnp.maximum(fcnt, 1), 0.0)
+                    sv = fcnt > 0
+            elif name in ("min", "max"):
+                sd0, sv0 = take_arg()
+                is_f = jnp.issubdtype(sd0.dtype, jnp.floating)
+                if name == "min":
+                    fill = jnp.inf if is_f else np.iinfo(np.dtype(sd0.dtype)).max
+                    op = jnp.minimum
+                else:
+                    fill = -jnp.inf if is_f else np.iinfo(np.dtype(sd0.dtype)).min
+                    op = jnp.maximum
+                masked = jnp.where(sv0, sd0, jnp.asarray(fill, dtype=sd0.dtype))
+
+                def comb(a, b, _op=op):
+                    af, av = a
+                    bf, bv = b
+                    return af | bf, jnp.where(bf, bv, _op(av, bv))
+
+                _, acc = jax.lax.associative_scan(comb, (pstart, masked))
+                sd, sv = acc[fe], frame_cnt_of(sv0) > 0
+            else:  # pragma: no cover — guarded by SUPPORTED
+                raise AssertionError(name)
+            outs.append((scat(sd), scat(sv.astype(jnp.bool_))))
+        return outs
+
+    return jax.jit(kernel)
+
+
+def _avg_dec_finish(s: np.ndarray, cnt: np.ndarray, arg_scale: int, out_scale: int):
+    """Exact AVG(decimal) from int64 (sum, count): replicates
+    Dec.div(Dec(cnt,0)).rescale(out_scale) — including the double rounding
+    (round-half-away at scale+DIV_FRAC_INCR, then again at out_scale)."""
+    sdiv = min(arg_scale + DIV_FRAC_INCR, MAX_SCALE)
+    p1 = pow10(sdiv - arg_scale)
+    valid = cnt > 0
+    c = np.maximum(cnt, 1)
+    amax = int(np.abs(s).max()) if s.size else 0
+    if amax > (1 << 62) // max(p1, 1):
+        # int64 headroom exhausted — exact big-int per row
+        qs = np.zeros_like(s)
+        for i in range(len(s)):
+            if valid[i]:
+                q = Dec(int(s[i]), arg_scale).div(Dec(int(cnt[i]), 0))
+                qs[i] = q.rescale(out_scale).value if q is not None else 0
+        return qs, valid
+    num = np.abs(s) * p1
+    q = num // c
+    q += (num - q * c) * 2 >= c
+    if sdiv > out_scale:
+        p2 = pow10(sdiv - out_scale)
+        q2 = q // p2
+        q2 += (q - q2 * p2) * 2 >= p2
+        q = q2
+    elif out_scale > sdiv:
+        q = q * pow10(out_scale - sdiv)
+    return np.where(s < 0, -q, q).astype(np.int64), valid
+
+
+def run_device_window(part_lanes, order_lanes, fspecs, n: int):
+    """Execute a window spec on device; returns [(data, valid), ...] per func
+    in input row order (numpy, length n).
+
+    part_lanes: [(d, v)] int64/float64 (pre-encoded strings)
+    order_lanes: [((d, v), desc)]
+    fspecs: per func dict — {name, static, args: [(d, v), ...], post}
+      post: ('decode', vocab) | ('avg_dec', arg_scale, out_scale) | None
+    """
+    P = _bucket(n)
+
+    def pad(d, v):
+        dd = np.zeros(P, dtype=d.dtype)
+        vv = np.zeros(P, dtype=bool)
+        dd[:n], vv[:n] = d, v
+        return jnp.asarray(dd), jnp.asarray(vv)
+
+    keys = tuple(pad(d, v) for d, v in part_lanes) + tuple(
+        pad(d, v) for (d, v), _ in order_lanes
+    )
+    descs = tuple(bool(desc) for _, desc in order_lanes)
+    funcspecs = tuple(f["static"] for f in fspecs)
+    fargs = tuple(tuple(pad(d, v) for d, v in f["args"]) for f in fspecs)
+    padflag = jnp.asarray((np.arange(P) >= n).astype(np.int32))
+
+    kernel = _build_kernel((len(part_lanes), descs, funcspecs))
+    outs = kernel(keys, fargs, padflag)
+
+    results = []
+    for f, (a, b) in zip(fspecs, outs):
+        a = np.asarray(a)[:n]
+        b = np.asarray(b)[:n]
+        post = f.get("post")
+        if post is None:
+            results.append((a, b.astype(bool)))
+        elif post[0] == "decode":
+            vocab = post[1]
+            v = b.astype(bool)
+            code = np.clip(a, 0, max(len(vocab) - 1, 0))
+            data = np.empty(n, dtype=object)
+            data[:] = vocab[code] if len(vocab) else ""
+            results.append((data, v))
+        else:  # avg_dec: a=frame_sum, b=frame_cnt (int64)
+            _, arg_scale, out_scale = post
+            qs, valid = _avg_dec_finish(a, b.astype(np.int64), arg_scale, out_scale)
+            results.append((qs, valid))
+    return results
