@@ -728,6 +728,9 @@ class WindowExec(Executor):
                 spec["static"] = ("ntile", const_int(f.args[0], "bucket count"))
             elif name in ("row_number", "rank", "dense_rank", "cume_dist", "percent_rank"):
                 spec["static"] = (name,)
+                if name in ("cume_dist", "percent_rank"):
+                    # device returns int num/den; host does the f64 division
+                    spec["post"] = (name,)
             elif name in ("lead", "lag"):
                 off = const_int(f.args[1], "offset") if len(f.args) > 1 else 1
                 has_default = len(f.args) > 2
@@ -780,6 +783,7 @@ class WindowExec(Executor):
                     spec["static"] = ("sum", True)
                 elif d.dtype == np.float64 or f.ret_type.is_float():
                     spec["static"] = ("avg", True, "f")
+                    spec["post"] = ("avg_f",)
                 else:
                     arg_scale = (
                         max(f.args[0].ret_type.decimal, 0)

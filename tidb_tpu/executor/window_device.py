@@ -172,11 +172,14 @@ def _build_kernel(spec):
                 ) + 1
                 sv = ones
             elif name == "cume_dist":
-                sd, sv = (peer_last - pfirst + 1) / psize, ones
+                # ratio of small ints: divide on HOST — TPU f64 division is
+                # emulated and not correctly rounded (parity with the oracle)
+                outs.append((scat(peer_last - pfirst + 1), scat(psize)))
+                continue
             elif name == "percent_rank":
                 rank = peer_first - pfirst + 1
-                sd = jnp.where(psize > 1, (rank - 1) / jnp.maximum(psize - 1, 1), 0.0)
-                sv = ones
+                outs.append((scat(rank - 1), scat(psize - 1)))
+                continue
             elif name in ("lead", "lag"):
                 off, has_default = fs[1], fs[2]
                 sd0, sv0 = take_arg()
@@ -208,21 +211,17 @@ def _build_kernel(spec):
                     sv0 = ones
                 sd, sv = frame_cnt_of(sv0), ones
             elif name in ("sum", "avg"):
-                if fs[1]:
-                    sd0, sv0 = take_arg()
-                else:
-                    sd0, sv0 = jnp.ones(P, dtype=jnp.int64), ones
+                sd0, sv0 = take_arg()
                 fcnt = frame_cnt_of(sv0)
                 fsum = frame_sum_of(sd0, sv0)
                 if name == "sum":
                     sd, sv = fsum, fcnt > 0
-                elif fs[2] == "dec":
-                    # exact finish happens on host from (sum, cnt)
+                else:
+                    # both avg kinds finish on host from (sum, cnt): 'dec'
+                    # for exact Dec rounding, 'f' because TPU f64 division
+                    # is not correctly rounded
                     outs.append((scat(fsum), scat(fcnt)))
                     continue
-                else:
-                    sd = jnp.where(fcnt > 0, fsum / jnp.maximum(fcnt, 1), 0.0)
-                    sv = fcnt > 0
             elif name in ("min", "max"):
                 sd0, sv0 = take_arg()
                 is_f = jnp.issubdtype(sd0.dtype, jnp.floating)
@@ -321,6 +320,15 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int):
             data = np.empty(n, dtype=object)
             data[:] = vocab[code] if len(vocab) else ""
             results.append((data, v))
+        elif post[0] == "cume_dist":  # a=frame rows, b=psize (>=1)
+            results.append((a / np.maximum(b, 1), np.ones(n, dtype=bool)))
+        elif post[0] == "percent_rank":  # a=rank-1, b=psize-1
+            data = np.where(b > 0, a / np.maximum(b, 1), 0.0)
+            results.append((data, np.ones(n, dtype=bool)))
+        elif post[0] == "avg_f":  # a=frame_sum(f64), b=frame_cnt
+            cnt = b.astype(np.int64)
+            data = np.where(cnt > 0, a / np.maximum(cnt, 1), 0.0)
+            results.append((data, cnt > 0))
         else:  # avg_dec: a=frame_sum, b=frame_cnt (int64)
             _, arg_scale, out_scale = post
             qs, valid = _avg_dec_finish(a, b.astype(np.int64), arg_scale, out_scale)

@@ -24,17 +24,48 @@ class GCWorker:
         self.removed_total = 0
 
     def compute_safe_point(self, now_ms: int | None = None) -> int:
+        """now - gc_life_time, clamped below the oldest active transaction
+        so its snapshot stays readable (ref: gc_worker.go:397
+        calcSafePointByMinStartTS)."""
         now_ms = int(time.time() * 1000) if now_ms is None else now_ms
-        return max(0, now_ms - self.life_ms) << TSO.LOGICAL_BITS
+        sp = max(0, now_ms - self.life_ms) << TSO.LOGICAL_BITS
+        min_start = self.storage.min_active_start_ts()
+        if min_start is not None:
+            sp = min(sp, min_start - 1)
+        return max(0, sp)
+
+    def _resolve_orphan_locks(self, sp: int, now_ms: int) -> int:
+        """Clear pre-safepoint locks via their primaries before compaction
+        (ref: gc_worker.go:616 runGCJob -> resolveLocks). Live txns never
+        hold locks below sp — sp is clamped under min active start-ts —
+        so everything found here belongs to dead transactions."""
+        from .mvcc import Lock as LockRec
+
+        mvcc = self.storage.mvcc
+        stale = []
+        with mvcc.kv.lock:
+            for k, v in mvcc.kv.iter_from(b"l"):
+                if not k.startswith(b"l"):
+                    break
+                lock = LockRec.decode(v)
+                if lock.start_ts <= sp:
+                    stale.append((k[1:], lock))
+        resolved = 0
+        for key, lock in stale:
+            if mvcc.resolve_lock(key, lock, now_ms):
+                resolved += 1
+        return resolved
 
     def tick(self, now_ms: int | None = None) -> int:
         """One GC round; returns versions removed. Skips when the
         safepoint hasn't advanced (gc_worker leaderTick behavior)."""
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
         sp = self.compute_safe_point(now_ms)
         if sp <= self.last_safe_point:
             return 0
         self.last_safe_point = sp
         self.runs += 1
+        self._resolve_orphan_locks(sp, now_ms)
         removed = self.storage.mvcc.gc(sp)
         self.removed_total += removed
         return removed

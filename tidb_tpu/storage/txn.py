@@ -12,6 +12,7 @@ lock-resolution retries (ref: unistore tikv/server.go:331,353 semantics).
 from __future__ import annotations
 
 import time
+from threading import Lock
 
 from ..errors import DeadlockError, LockedError, RetryableError, TiDBError, TxnAborted, WriteConflict
 from ..utils.failpoint import inject as _fp
@@ -84,6 +85,7 @@ class Txn:
         self.for_update_ts = start_ts
         self._pess_keys: set[bytes] = set()
         self._pess_primary: bytes | None = None
+        store._txn_started(start_ts)
 
     def lock_keys_for_update(self, keys) -> None:
         """Pessimistic DML lock acquisition with deadlock detection and a
@@ -208,6 +210,7 @@ class Txn:
             raise TxnAborted("transaction already committed")
         if not self.membuf and not self._locked_keys and not self._pess_keys:
             self.committed = True
+            self.store._txn_done(self.start_ts)
             return self.start_ts
         muts = []
         for k, v in self.membuf.items():
@@ -268,6 +271,7 @@ class Txn:
         if secondaries:
             mvcc.commit(secondaries, self.start_ts, self.commit_ts)
         self.committed = True
+        self.store._txn_done(self.start_ts)
         self.store.bump_version([m.key for m in muts])
         self.store.wal_sync()  # group-commit durability point
         return self.commit_ts
@@ -280,6 +284,7 @@ class Txn:
         self.membuf.clear()
         self._locked_keys.clear()
         self.committed = True
+        self.store._txn_done(self.start_ts)
 
 
 class Storage:
@@ -313,6 +318,11 @@ class Storage:
 
         self.detector = DeadlockDetector()
         self._gc_worker = None
+        # active-txn registry: GC clamps its safepoint to the oldest live
+        # start_ts so long transactions keep their snapshot readable
+        # (ref: store/gcworker/gc_worker.go:397 min-start-ts calculation)
+        self._active_starts: dict[int, float] = {}
+        self._active_lock = Lock()
         import threading as _threading
 
         self._processes: dict = {}
@@ -519,6 +529,29 @@ class Storage:
 
             self._stmt_stats = StmtStats()
         return self._stmt_stats
+
+    # --- active-txn registry (GC safepoint clamp) --------------------------
+
+    MAX_TXN_PIN_S = 3600.0  # leaked/abandoned txns stop blocking GC after this
+
+    def _txn_started(self, start_ts: int) -> None:
+        with self._active_lock:
+            self._active_starts[start_ts] = time.time()
+
+    def _txn_done(self, start_ts: int) -> None:
+        with self._active_lock:
+            self._active_starts.pop(start_ts, None)
+
+    def min_active_start_ts(self) -> int | None:
+        """Oldest live transaction start-ts, or None. Entries pinned longer
+        than MAX_TXN_PIN_S are dropped as leaks (the reference bounds this
+        via txn max TTL + the session manager's process list)."""
+        horizon = time.time() - self.MAX_TXN_PIN_S
+        with self._active_lock:
+            for ts, t0 in list(self._active_starts.items()):
+                if t0 < horizon:
+                    del self._active_starts[ts]
+            return min(self._active_starts) if self._active_starts else None
 
     @property
     def gc_worker(self):
