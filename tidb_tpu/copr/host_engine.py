@@ -216,9 +216,10 @@ def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.nda
                     out[g] = dv[i]
                     out_valid[g] = True
         else:
-            init = np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
             if dv.dtype == np.float64:
                 init = np.inf if name == "min" else -np.inf
+            else:  # the lane's own int dtype (uint64 must not wrap)
+                init = np.iinfo(dv.dtype).max if name == "min" else np.iinfo(dv.dtype).min
             out = np.full(G, init, dtype=dv.dtype)
             fn = np.minimum if name == "min" else np.maximum
             fn.at(out, inv, np.where(vv, dv, init))

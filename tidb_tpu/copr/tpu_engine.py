@@ -37,6 +37,7 @@ from ..chunk.chunk import Chunk, Column
 from ..expr.expression import Column as ExprCol, Constant, Expression, ScalarFunc
 from ..mysqltypes.datum import Datum, K_STR, K_BYTES
 from ..mysqltypes.field_type import ft_longlong
+from ..mysqltypes.mydecimal import pow10
 from .dag import DAGRequest
 from .host_engine import execute_dag_host
 from .tilecache import ColumnBatch
@@ -302,7 +303,12 @@ class TPUEngine:
                 if v is None:
                     z = jnp.zeros((), dtype=jnp.int64)
                     return z, jnp.zeros((), dtype=bool)
-                dt = jnp.float64 if x.ret_type.is_float() else jnp.int64
+                if x.ret_type.is_float():
+                    dt = jnp.float64
+                elif isinstance(v, int) and v > np.iinfo(np.int64).max:
+                    dt = jnp.uint64  # literals above 2^63-1 (BIGINT UNSIGNED)
+                else:
+                    dt = jnp.int64
                 return jnp.asarray(v, dtype=dt), jnp.asarray(True)
             avals = [rec(a) for a in x.args]
             return x.eval_xp(jnp, avals)
@@ -362,18 +368,22 @@ class TPUEngine:
     def _lower_agg(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
         agg = dag.agg
         gb = agg.group_by
-        # group keys must be plain columns; float keys stay on host (bit
-        # equality vs MySQL value equality is not worth the hazard) and so
-        # do uint64 keys (values >= 2^63 would wrap in the int64 sort lanes)
+        # group keys must be plain columns; float/uint64 keys group by
+        # canonicalized bit pattern in the sorted path (never direct)
+        wide_keys = False
         for g in gb:
             if not isinstance(g, ExprCol):
                 return None
             if g.idx not in vocabs:
                 d = dev.batch.data[dag.scan.col_offsets[g.idx]]
                 if d.dtype == np.float64 or d.dtype == np.uint64:
-                    return None
+                    wide_keys = True
         for a in agg.aggs:
-            if a.name not in ("count", "sum", "avg", "min", "max", "first_row"):
+            if a.name not in (
+                "count", "sum", "avg", "min", "max", "first_row",
+                "stddev_pop", "stddev_samp", "var_pop", "var_samp",
+                "bit_and", "bit_or", "bit_xor",
+            ):
                 return None
             r_args = [self._rewrite(x, vocabs) if not (isinstance(x, ExprCol) and x.idx in vocabs) else (x if a.name in ("min", "max", "first_row", "count") else None) for x in a.args]
             if any(x is None for x in r_args):
@@ -384,8 +394,10 @@ class TPUEngine:
         # anything else routes to the sort-based segment path
         domains = []
         key_cols = []
-        direct = True
+        direct = not wide_keys
         for g in gb:
+            if not direct:
+                break
             if g.idx in vocabs:
                 domains.append(max(len(vocabs[g.idx]), 1))
             else:
@@ -492,8 +504,19 @@ class TPUEngine:
                     vf = v.reshape(-1)
                     ops.append((~vf).astype(jnp.int32))
                     # zero data under NULL so residual bytes can't split
-                    # the NULL group (direct path normalizes the same way)
-                    ops.append(jnp.where(vf, d.reshape(-1).astype(jnp.int64), 0))
+                    # the NULL group (direct path normalizes the same way).
+                    # float/uint64 keys group by canonical bit pattern:
+                    # equality (all GROUP BY needs) survives the bitcast,
+                    # with -0.0 folded into +0.0 first
+                    dr = d.reshape(-1)
+                    if jnp.issubdtype(dr.dtype, jnp.floating):
+                        dr = jnp.where(dr == 0.0, 0.0, dr.astype(jnp.float64))
+                        dr = jax.lax.bitcast_convert_type(dr, jnp.int64)
+                    elif dr.dtype == jnp.uint64:
+                        dr = jax.lax.bitcast_convert_type(dr, jnp.int64)
+                    else:
+                        dr = dr.astype(jnp.int64)
+                    ops.append(jnp.where(vf, dr, 0))
                 iota = jnp.arange(n, dtype=jnp.int32)
                 res = jax.lax.sort(tuple(ops) + (iota,), num_keys=len(ops))
                 perm = res[-1]
@@ -556,8 +579,17 @@ class TPUEngine:
                     c = int(kval[j])
                     data[j] = vocab[c] if valid[j] and 0 <= c < len(vocab) else None
             else:
+                # undo the kernel's bit-pattern canonicalization
+                src_dt = dev.batch.data[dag.scan.col_offsets[ki]].dtype
                 data = kval.astype(np.int64)
-                data[~valid] = 0
+                if src_dt == np.float64:
+                    data = data.view(np.float64).copy()
+                    data[~valid] = 0.0
+                elif src_dt == np.uint64:
+                    data = data.view(np.uint64).copy()
+                    data[~valid] = 0
+                else:
+                    data[~valid] = 0
             cols.append(Column(ft, data, valid))
             pos += 2
             oi += 1
@@ -636,6 +668,41 @@ class TPUEngine:
             idx = jnp.arange(seg.shape[0]) if index_lane is None else index_lane
             first = _seg_min(jnp.where(ok, idx, seg.shape[0]), seg, nseg, jnp.asarray(seg.shape[0]))
             return [first]
+        if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            # (cnt, sum, sumsq) float partials, mirroring the host cop form
+            arg_ft = a.args[0].ret_type
+            if arg_ft.is_decimal():
+                x = d.astype(jnp.float64) / float(pow10(max(arg_ft.decimal, 0)))
+            else:
+                x = d.astype(jnp.float64)
+            x = jnp.where(ok, x, 0.0)
+            cnt = _seg_sum(ok.astype(jnp.int64), seg, nseg)
+            return [cnt, _seg_sum(x, seg, nseg), _seg_sum(x * x, seg, nseg)]
+        if name in ("bit_and", "bit_or", "bit_xor"):
+            # bitwise reductions decompose per bit: segment min/max/sum-mod-2
+            # over a [n, 64] bit matrix, recombined by shifts (two's
+            # complement places bit 63 via the int64 wrap)
+            arg_ft = a.args[0].ret_type
+            if arg_ft.is_decimal():
+                xf = d.astype(jnp.float64) / float(pow10(max(arg_ft.decimal, 0)))
+                x = jnp.rint(xf).astype(jnp.int64)
+            elif jnp.issubdtype(d.dtype, jnp.floating):
+                x = jnp.rint(d).astype(jnp.int64)
+            else:
+                x = d.astype(jnp.int64)
+            shifts = jnp.arange(64, dtype=jnp.int64)
+            bits = ((x[:, None] >> shifts[None, :]) & 1).astype(jnp.int32)
+            if name == "bit_and":
+                bits = jnp.where(ok[:, None], bits, 1)
+                red = jax.ops.segment_min(bits, seg, num_segments=nseg + 1)[:nseg]
+            elif name == "bit_or":
+                bits = jnp.where(ok[:, None], bits, 0)
+                red = jax.ops.segment_max(bits, seg, num_segments=nseg + 1)[:nseg]
+            else:
+                bits = jnp.where(ok[:, None], bits, 0)
+                red = jax.ops.segment_sum(bits, seg, num_segments=nseg + 1)[:nseg] % 2
+            out = ((red & 1).astype(jnp.int64) << shifts[None, :]).sum(axis=1)
+            return [out]
         raise NotImplementedError(name)
 
     def _agg_outputs_to_chunk(self, dag, dev, outs, domains, key_cols, vocabs, nseg):
@@ -714,6 +781,21 @@ class TPUEngine:
                 cols.append(Column(ft, data, has))
                 pos += 2
                 oi += 1
+            elif a.name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+                ones = np.ones(G, dtype=bool)
+                cnt = np.asarray(outs[pos])[present].astype(np.int64)
+                s = np.asarray(outs[pos + 1])[present]
+                sq = np.asarray(outs[pos + 2])[present]
+                cols.append(Column(out_fts[oi], cnt, ones))
+                cols.append(Column(out_fts[oi + 1], s, ones))
+                cols.append(Column(out_fts[oi + 2], sq, ones))
+                pos += 3
+                oi += 3
+            elif a.name in ("bit_and", "bit_or", "bit_xor"):
+                val = np.asarray(outs[pos])[present].astype(np.int64)
+                cols.append(Column(out_fts[oi], val, np.ones(G, dtype=bool)))
+                pos += 1
+                oi += 1
             elif a.name == "first_row":
                 firsts = np.asarray(outs[pos])[present]
                 ft = out_fts[oi]
@@ -739,7 +821,7 @@ class TPUEngine:
     def _lower_topn(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
         by = dag.topn.by
         if len(by) != 1:
-            return None  # multi-key topn → host
+            return self._lower_topn_multi(dag, dev, lanes, vocabs, r_conds)
         e, desc = by[0]
         r_e = self._rewrite(e, vocabs)
         if r_e is None:
@@ -779,5 +861,49 @@ class TPUEngine:
             idx = idx[m[idx]]  # drop indices pointing at masked rows
             chunk = dev.batch.to_chunk(dag.scan.col_offsets)
             return chunk.take(idx[: dag.topn.n])
+
+        return run
+
+    def _lower_topn_multi(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
+        """Multi-key TopN: one multi-operand lax.sort over (mask, per-key
+        NULL-flag + data, row-id), take the first n sorted row-ids (the
+        window-kernel sort recipe; ref closure_exec.go topN heap — the TPU
+        form is a full sort, exact and still one fused program)."""
+        by = dag.topn.by
+        r_by = []
+        for e, desc in by:
+            r_e = self._rewrite(e, vocabs)
+            if r_e is None:
+                return None
+            r_by.append((r_e, desc))
+        n = dag.topn.n
+        key = ("topn_multi", repr(r_conds), repr(r_by), n, dev.t)
+        arrs, order = self._flatten_lanes(lanes)
+
+        def kernel(flat, row_valid):
+            l = self._unflatten(flat, order)
+            mask = self._mask(r_conds, l, row_valid).reshape(-1)
+            rows = mask.shape[0]
+            ops = [(~mask).astype(jnp.int32)]  # masked rows last
+            for r_e, desc in r_by:
+                d, v = self._eval_device(r_e, l)
+                d = jnp.full((rows,), d) if d.ndim == 0 else d.reshape(-1)
+                v = jnp.full((rows,), v) if v.ndim == 0 else v.reshape(-1)
+                # NULLs first asc / last desc (host _lex_argsort contract)
+                nullkey = jnp.where(v, 0, 1) if desc else jnp.where(v, 1, 0)
+                dd = jnp.where(v, d, jnp.zeros((), d.dtype))
+                if desc:
+                    dd = -dd if jnp.issubdtype(d.dtype, jnp.floating) else ~dd
+                ops += [nullkey.astype(jnp.int32), dd]
+            iota = jnp.arange(rows, dtype=jnp.int32)
+            res = jax.lax.sort(tuple(ops) + (iota,), num_keys=len(ops))
+            return res[-1][: min(n, rows)], res[0][: min(n, rows)] == 0
+
+        fn = self._program(key, kernel)
+
+        def run():
+            idx, ok = jax.device_get(fn(arrs, dev.row_valid))
+            chunk = dev.batch.to_chunk(dag.scan.col_offsets)
+            return chunk.take(idx[ok][: dag.topn.n])
 
         return run

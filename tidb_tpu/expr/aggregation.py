@@ -28,7 +28,12 @@ MODE_COMPLETE = "complete"
 MODE_PARTIAL = "partial"
 MODE_FINAL = "final"
 
-PUSHABLE_AGGS = ("count", "sum", "avg", "min", "max", "first_row")
+PUSHABLE_AGGS = (
+    "count", "sum", "avg", "min", "max", "first_row",
+    # (cnt, sum, sumsq) / bitwise partials merge exactly at the root final
+    "stddev_pop", "stddev_samp", "var_pop", "var_samp",
+    "bit_and", "bit_or", "bit_xor",
+)
 AGG_FUNCS = PUSHABLE_AGGS + (
     "group_concat",
     "stddev_pop", "stddev_samp", "std", "stddev",

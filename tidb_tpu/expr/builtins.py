@@ -28,6 +28,7 @@ from .expression import (
     lane_as_float,
     lane_as_decimal,
     numeric_common,
+    int2_as_float,
     all_valid,
 )
 
@@ -98,7 +99,9 @@ def merge_types(fts: list[FieldType]) -> FieldType:
         return ft_double()
     if any(ft.is_decimal() for ft in fts):
         return ft_decimal(30, max(_scale(ft) for ft in fts))
-    return ft_longlong()
+    # unsignedness survives only when every branch is unsigned (MySQL
+    # MergeFieldType flag semantics)
+    return ft_longlong(unsigned=all(ft.is_unsigned for ft in fts))
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +162,9 @@ def _div_kernel(xp, avals, fts, ret_ft):
 def _intdiv_kernel(xp, avals, fts, ret_ft):
     valid = all_valid(xp, avals)
     kind, (a, b) = numeric_common(xp, avals, fts)
+    if kind == "int2":  # mixed sign domain: float64 approximation
+        a, b = int2_as_float(xp, a), int2_as_float(xp, b)
+        kind = "float"
     if kind == "float":
         valid = valid & (b != 0)
         q = a / xp.where(b == 0, 1.0, b)
@@ -209,11 +215,29 @@ register(FuncSig("unaryminus", infer_arith("plus"), _unary_minus_kernel, arity=1
 # ---------------------------------------------------------------------------
 
 
+def _int2_cmp(op, a, b):
+    """Lexicographic compare of (class, lo) pairs — exact across the full
+    signed+unsigned BIGINT value range."""
+    (ha, la), (hb, lb) = a, b
+    eq = (ha == hb) & (la == lb)
+    lt = (ha < hb) | ((ha == hb) & (la < lb))
+    return {
+        "eq": lambda: eq,
+        "ne": lambda: ~eq,
+        "lt": lambda: lt,
+        "le": lambda: lt | eq,
+        "gt": lambda: ~(lt | eq),
+        "ge": lambda: ~lt,
+    }[op]()
+
+
 def _cmp_kernel(op: str):
     def kernel(xp, avals, fts, ret_ft):
         valid = all_valid(xp, avals)
         kind, lanes = numeric_common(xp, avals, fts)
         a, b = lanes
+        if kind == "int2":
+            return _int2_cmp(op, a, b).astype(xp.int64), valid
         if kind == "str":
             # numpy-only path; device compares dictionary codes instead
             a = np.where(avals[0][1], a, "")
@@ -238,10 +262,14 @@ for _op in ("eq", "ne", "lt", "le", "gt", "ge"):
 def _nulleq_kernel(xp, avals, fts, ret_ft):
     va, vb = avals[0][1], avals[1][1]
     kind, (a, b) = numeric_common(xp, avals, fts)
-    if kind == "str":
-        a = np.where(va, a, "")
-        b = np.where(vb, b, "")
-    eq = (a == b) & va & vb | (~va & ~vb)
+    if kind == "int2":
+        same = _int2_cmp("eq", a, b)
+    else:
+        if kind == "str":
+            a = np.where(va, a, "")
+            b = np.where(vb, b, "")
+        same = a == b
+    eq = same & va & vb | (~va & ~vb)
     return eq.astype(xp.int64), xp.ones_like(va)
 
 
@@ -258,8 +286,11 @@ def _in_kernel(xp, avals, fts, ret_ft):
     hit = None
     any_null = ~valid0
     for (d, v), lane in zip(avals[1:], lanes[1:]):
-        b = np.where(v, lane, "") if kind == "str" else lane
-        e = (a == b) & v
+        if kind == "int2":
+            e = _int2_cmp("eq", a, lane) & v
+        else:
+            b = np.where(v, lane, "") if kind == "str" else lane
+            e = (a == b) & v
         hit = e if hit is None else (hit | e)
         any_null = any_null | ~v
     valid = valid0 & (hit | ~any_null)
@@ -576,6 +607,8 @@ register(FuncSig("power", lambda fts: ft_double(), _pow_kernel, arity=2))
 
 def _minmax_lanes(xp, avals, fts):
     kind, lanes = numeric_common(xp, avals, fts)
+    if kind == "int2":
+        lanes = [int2_as_float(xp, p) for p in lanes]
     if kind == "str":
         # mask NULL slots so object-lane comparison never sees None
         lanes = [np.where(v, l, "") for (_, v), l in zip(avals, lanes)]

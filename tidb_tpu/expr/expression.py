@@ -90,6 +90,8 @@ class Constant(Expression):
             data = np.empty(n, dtype=object)
             data[:] = v
         else:
+            if dt is np.int64 and isinstance(v, int) and v > np.iinfo(np.int64).max:
+                dt = np.uint64  # np.full would silently wrap the literal
             data = np.full(n, v, dtype=dt)
         return data, np.ones(n, dtype=bool)
 
@@ -239,7 +241,35 @@ def numeric_common(xp, avals, fts):
     if any(ft.is_decimal() for ft in fts):
         scale = max(max(ft.decimal, 0) for ft in fts if ft.is_decimal())
         return f"dec:{scale}", [lane_as_decimal(xp, d, ft, scale) for (d, _), ft in zip(avals, fts)]
-    return "int", [d.astype(xp.int64) for d, _ in avals]
+    lanes = [d for d, _ in avals]
+    if any(str(getattr(l, "dtype", "")) == "uint64" for l in lanes):
+        if all(str(getattr(l, "dtype", "")) == "uint64" for l in lanes):
+            return "uint", lanes
+        # mixed signed/unsigned BIGINT: value-correct without widening
+        # (ref: expression/builtin_compare.go CompareInt's sign-aware
+        # branches). Each value maps to a lexicographic (class, lo) pair:
+        #   class -1: negative signed            lo = x
+        #   class  0: [0, 2^63) from either side lo = value
+        #   class +1: unsigned >= 2^63           lo = u - 2^64 (monotone)
+        # int64 wrap of the high uint half is order-preserving per class.
+        return "int2", [int2_pair(xp, l) for l in lanes]
+    return "int", [l.astype(xp.int64) for l in lanes]
+
+
+def int2_pair(xp, lane):
+    """(class, lo) encoding for exact mixed signed/unsigned comparison."""
+    if str(lane.dtype) == "uint64":
+        hi = (lane > xp.asarray(np.iinfo(np.int64).max, dtype=lane.dtype)).astype(xp.int64)
+        return hi, lane.astype(xp.int64)
+    lo = lane.astype(xp.int64)
+    return -(lo < 0).astype(xp.int64), lo
+
+
+def int2_as_float(xp, pair):
+    """Approximate scalar value of an int2 pair (for arithmetic domains
+    where exactness above 2^53 is not contractual)."""
+    hi, lo = pair
+    return lo.astype(xp.float64) + (hi == 1) * np.float64(2.0**64)
 
 
 def all_valid(xp, avals):

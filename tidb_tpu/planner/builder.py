@@ -42,7 +42,9 @@ def lit_to_constant(l: ast.Lit) -> Constant:
     if l.kind == "null":
         return Constant(Datum.null(), FieldType(TypeCode.Null))
     if l.kind == "int":
-        return Constant(Datum.i(v), ft_longlong())
+        # literals above 2^63-1 are BIGINT UNSIGNED (MySQL literal typing);
+        # a signed ft would silently wrap the int64 lane
+        return Constant(Datum.i(v), ft_longlong(unsigned=v > 0x7FFFFFFFFFFFFFFF))
     if l.kind == "bool":
         return Constant(Datum.i(1 if v else 0), ft_longlong())
     if l.kind == "dec":
