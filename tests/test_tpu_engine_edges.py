@@ -85,6 +85,30 @@ class TestDeviceAggPartials:
     def test_variance_over_decimal(self, s):
         both(s, "SELECT g, VAR_POP(d) FROM t GROUP BY g")
 
+    def test_variance_over_wide_decimal(self, s):
+        # scaled-int sum-of-squares exceeds int64: the wrap+estimate
+        # reconstruction must stay exact AND engine-identical
+        s.execute("CREATE TABLE wd (g INT, d DECIMAL(12,3))")
+        rng = np.random.default_rng(5)
+        vals = ",".join(
+            f"({i % 3}, {int(rng.integers(-10**9, 10**9)) / 1000.0:.3f})" for i in range(4000)
+        )
+        s.execute("INSERT INTO wd VALUES " + vals)
+        rows = both(s, "SELECT g, VAR_POP(d), STDDEV_SAMP(d) FROM wd GROUP BY g")
+        # sanity vs exact big-int oracle recomputed through SQL data
+        s.execute("SET tidb_cop_engine = 'host'")
+        raw = s.must_query("SELECT g, d FROM wd")
+        from collections import defaultdict
+
+        groups = defaultdict(list)
+        for g, d in raw:
+            groups[g].append(round(float(d) * 1000))
+        for g, var, _ in rows:
+            xs = groups[g]
+            n = len(xs)
+            exact = (sum(x * x for x in xs) / 1e6 - (sum(xs) / 1e3) ** 2 / n) / n
+            assert abs(float(var) - exact) < 1e-6 * max(1.0, abs(exact)), (g, var, exact)
+
     def test_bit_aggs(self, s):
         both(s, "SELECT g, BIT_AND(v), BIT_OR(v), BIT_XOR(v) FROM t GROUP BY g")
 

@@ -13,8 +13,51 @@ from ..chunk.chunk import Chunk, Column, col_numpy_dtype, VARLEN
 from ..expr.aggregation import AggDesc, MODE_PARTIAL
 from ..expr.expression import Expression
 from ..mysqltypes.field_type import FieldType
+from ..mysqltypes.mydecimal import pow10
 from .dag import DAGRequest
 from .tilecache import ColumnBatch
+
+
+_2_64 = 18446744073709551616
+_2_32 = 4294967296
+
+
+def _exact_sum64_ints(wrap: np.ndarray, est: np.ndarray) -> list:
+    """Exact Python-int sums of int64 terms, from the order-independent
+    int64 wrap-sum (exact mod 2^64) plus any float64 estimate with
+    |error| < 2^63. Estimate error is ~n·(running sum)·2^-53, so the
+    precondition holds for any per-task segment under ~10^7 rows."""
+    out = []
+    for i in range(len(wrap)):
+        w = int(wrap[i])
+        k = round((float(est[i]) - float(w)) / _2_64)
+        out.append(w + k * _2_64)
+    return out
+
+
+def exact_sum64(wrap: np.ndarray, est: np.ndarray) -> np.ndarray:
+    """float64 of _exact_sum64_ints, with a vectorized fast path for the
+    common case (no wrap, |sum| < 2^53). Makes decimal variance partials
+    identical across cop engines regardless of summation order."""
+    wf = wrap.astype(np.float64)
+    if len(wrap) and not np.rint((est - wf) / _2_64).any() and np.all(np.abs(wf) < 2**53):
+        return wf
+    return np.array([float(v) for v in _exact_sum64_ints(wrap, est)], dtype=np.float64)
+
+
+def exact_sumsq64(wA, eA, wB, eB, wC, eC) -> np.ndarray:
+    """Exact Σx² from 32-bit limb sums: with x = a·2^32 + b (arithmetic
+    shift; b in [0,2^32)), Σx² = ΣA·2^64 + 2·ΣB·2^32 + ΣC for A=a², B=a·b,
+    C=b². Each limb product fits the wrap+estimate reconstruction envelope
+    (per-term float error ≤ 2^10), so the result is exact — and therefore
+    engine-order-independent — far beyond where float64(x²) loses 2^63."""
+    A = _exact_sum64_ints(wA, eA)
+    B = _exact_sum64_ints(wB, eB)
+    C = _exact_sum64_ints(wC, eC)
+    return np.array(
+        [float(a * _2_64 + 2 * b * _2_32 + c) for a, b, c in zip(A, B, C)],
+        dtype=np.float64,
+    )
 
 
 def _eval_mask(conds: list[Expression], chunk: Chunk) -> np.ndarray:
@@ -247,10 +290,35 @@ def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.nda
     if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
         from ..expr.expression import lane_as_float
 
-        x = np.where(vv, lane_as_float(np, dv, a.args[0].ret_type), 0.0)
         cnt = seg_sum(vv.astype(np.float64)).astype(np.int64)
-        s = seg_sum(x)
-        sq = seg_sum(x * x)
+        arg_ft = a.args[0].ret_type
+        if arg_ft.is_decimal():
+            # exact sums of the SCALED ints, reconstructed from order-
+            # independent int64 wrap-sums + float estimates (sumsq via
+            # 32-bit limbs) — both cop engines land on the identical exact
+            # integer whatever their summation order
+            # (tpu_engine._agg_partials_device is the device twin)
+            xi = np.where(vv, dv.astype(np.int64), 0)
+            ai = xi >> 32
+            bi = xi - (ai << 32)
+            af, bf = ai.astype(np.float64), bi.astype(np.float64)
+
+            def wrap_at(vals):
+                w = np.zeros(G, dtype=np.int64)
+                np.add.at(w, inv, vals)
+                return w
+
+            scale = float(pow10(max(arg_ft.decimal, 0)))
+            s = exact_sum64(wrap_at(xi), seg_sum(xi.astype(np.float64))) / scale
+            sq = exact_sumsq64(
+                wrap_at(ai * ai), seg_sum(af * af),
+                wrap_at(ai * bi), seg_sum(af * bf),
+                wrap_at(bi * bi), seg_sum(bf * bf),
+            ) / (scale * scale)
+        else:
+            x = np.where(vv, lane_as_float(np, dv, arg_ft), 0.0)
+            s = seg_sum(x)
+            sq = seg_sum(x * x)
         ones = np.ones(G, dtype=bool)
         yield Column(out_fts[oi], cnt, ones)
         yield Column(out_fts[oi + 1], s, ones)

@@ -39,7 +39,7 @@ from ..mysqltypes.datum import Datum, K_STR, K_BYTES
 from ..mysqltypes.field_type import ft_longlong
 from ..mysqltypes.mydecimal import pow10
 from .dag import DAGRequest
-from .host_engine import execute_dag_host
+from .host_engine import exact_sum64, exact_sumsq64, execute_dag_host
 from .tilecache import ColumnBatch
 
 TILE_ROWS = 1 << 16
@@ -656,12 +656,24 @@ class TPUEngine:
             cnt = _seg_sum(ok.astype(jnp.int64), seg, nseg)
             return [s, cnt]
         if name in ("min", "max"):
+            # sentinels in the lane's OWN dtype: an int64 sentinel written
+            # into a uint64 lane both mis-orders values >= 2^63 and
+            # overflows the decode (BIGINT UNSIGNED)
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                big, small = jnp.asarray(jnp.inf, d.dtype), jnp.asarray(-jnp.inf, d.dtype)
+            elif d.dtype == jnp.uint64:
+                big = jnp.asarray(np.iinfo(np.uint64).max, jnp.uint64)
+                small = jnp.asarray(0, jnp.uint64)
+            else:
+                big = jnp.asarray(np.iinfo(np.int64).max)
+                small = jnp.asarray(np.iinfo(np.int64).min)
             if name == "min":
-                big = jnp.asarray(np.iinfo(np.int64).max) if d.dtype != jnp.float64 else jnp.inf
                 s = _seg_min(jnp.where(ok, d, big), seg, nseg, big)
             else:
-                small = jnp.asarray(np.iinfo(np.int64).min) if d.dtype != jnp.float64 else -jnp.inf
                 s = _seg_max(jnp.where(ok, d, small), seg, nseg, small)
+            if s.dtype == jnp.uint64:
+                # packed transport is int64; undone by view(uint64) at decode
+                s = jax.lax.bitcast_convert_type(s, jnp.int64)
             cnt = _seg_sum(ok.astype(jnp.int64), seg, nseg)
             return [s, cnt]
         if name == "first_row":
@@ -669,14 +681,24 @@ class TPUEngine:
             first = _seg_min(jnp.where(ok, idx, seg.shape[0]), seg, nseg, jnp.asarray(seg.shape[0]))
             return [first]
         if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
-            # (cnt, sum, sumsq) float partials, mirroring the host cop form
+            # (cnt, sum, sumsq) partials, mirroring the host cop form.
+            # Decimals ship (int64 wrap-sum, float estimate) pairs of the
+            # SCALED ints; decode reconstructs the exact integer sums
+            # (order-independent) and does the single float division —
+            # bit-identical to host_engine whatever the summation order.
             arg_ft = a.args[0].ret_type
-            if arg_ft.is_decimal():
-                x = d.astype(jnp.float64) / float(pow10(max(arg_ft.decimal, 0)))
-            else:
-                x = d.astype(jnp.float64)
-            x = jnp.where(ok, x, 0.0)
             cnt = _seg_sum(ok.astype(jnp.int64), seg, nseg)
+            if arg_ft.is_decimal():
+                xi = jnp.where(ok, d.astype(jnp.int64), 0)
+                ai = xi >> 32  # arithmetic shift: hi limb keeps the sign
+                bi = xi - (ai << 32)  # lo limb in [0, 2^32)
+                af, bf = ai.astype(jnp.float64), bi.astype(jnp.float64)
+                return [cnt,
+                        _seg_sum(xi, seg, nseg), _seg_sum(xi.astype(jnp.float64), seg, nseg),
+                        _seg_sum(ai * ai, seg, nseg), _seg_sum(af * af, seg, nseg),
+                        _seg_sum(ai * bi, seg, nseg), _seg_sum(af * bf, seg, nseg),
+                        _seg_sum(bi * bi, seg, nseg), _seg_sum(bf * bf, seg, nseg)]
+            x = jnp.where(ok, d.astype(jnp.float64), 0.0)
             return [cnt, _seg_sum(x, seg, nseg), _seg_sum(x * x, seg, nseg)]
         if name in ("bit_and", "bit_or", "bit_xor"):
             # bitwise reductions decompose per bit: segment min/max/sum-mod-2
@@ -774,22 +796,37 @@ class TPUEngine:
                     data = np.empty(G, dtype=object)
                     for j in range(G):
                         data[j] = vocab[int(s[j])] if has[j] and 0 <= int(s[j]) < len(vocab) else None
+                elif ft.is_float():
+                    data = s
+                elif ft.is_int() and ft.is_unsigned:
+                    # undo the kernel's uint64→int64 transport bitcast
+                    data = s.astype(np.int64).view(np.uint64).copy()
+                    data[~has] = 0
                 else:
-                    data = s.astype(np.int64) if not ft.is_float() else s
-                    if not ft.is_float():
-                        data = np.where(has, data, 0)
+                    data = np.where(has, s.astype(np.int64), 0)
                 cols.append(Column(ft, data, has))
                 pos += 2
                 oi += 1
             elif a.name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
                 ones = np.ones(G, dtype=bool)
                 cnt = np.asarray(outs[pos])[present].astype(np.int64)
-                s = np.asarray(outs[pos + 1])[present]
-                sq = np.asarray(outs[pos + 2])[present]
+                arg_ft = a.args[0].ret_type
+                if arg_ft.is_decimal():
+                    # (wrap, estimate) pairs → exact scaled-int sums
+                    # (sumsq via 32-bit limbs), then the single float
+                    # division happens here on host
+                    o = [np.asarray(outs[pos + j])[present] for j in range(1, 9)]
+                    scale = float(pow10(max(arg_ft.decimal, 0)))
+                    s = exact_sum64(o[0], o[1]) / scale
+                    sq = exact_sumsq64(o[2], o[3], o[4], o[5], o[6], o[7]) / (scale * scale)
+                    pos += 9
+                else:
+                    s = np.asarray(outs[pos + 1])[present]
+                    sq = np.asarray(outs[pos + 2])[present]
+                    pos += 3
                 cols.append(Column(out_fts[oi], cnt, ones))
                 cols.append(Column(out_fts[oi + 1], s, ones))
                 cols.append(Column(out_fts[oi + 2], sq, ones))
-                pos += 3
                 oi += 3
             elif a.name in ("bit_and", "bit_or", "bit_xor"):
                 val = np.asarray(outs[pos])[present].astype(np.int64)
