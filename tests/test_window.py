@@ -192,9 +192,11 @@ class TestWindowPlanning:
         )
         assert [r[1] for r in rows] == ["100", "300", "500", "650", "950", "1070"]
 
-    def test_explicit_rows_frame_rejected(self, s):
-        with pytest.raises(Exception):
-            s.execute("SELECT SUM(sal) OVER (ORDER BY id ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM emp")
+    def test_explicit_rows_frame_runs(self, s):
+        rows = s.must_query(
+            "SELECT SUM(sal) OVER (ORDER BY id ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM emp ORDER BY 1"
+        )
+        assert len(rows) == 6 and all(r[0] is not None for r in rows)
 
     def test_explain_shows_window(self, s):
         rows = s.must_query("EXPLAIN SELECT ROW_NUMBER() OVER (ORDER BY id) FROM emp")

@@ -17,7 +17,7 @@ from ..errors import TiDBError
 from ..expr.aggregation import AggDesc
 from ..expr.expression import Column as ECol, Constant, Expression
 from ..mysqltypes.datum import Datum, compare_datum
-from ..mysqltypes.field_type import FieldType, ft_longlong
+from ..mysqltypes.field_type import FieldType, TypeCode, ft_longlong
 from ..mysqltypes.mydecimal import Dec, pow10
 from ..planner.plans import (
     Aggregation,
@@ -261,10 +261,13 @@ def _pushable_reader(e: Executor) -> "TableReaderExec | None":
 
 
 def _build_agg(plan: Aggregation, ctx: ExecContext) -> Executor:
+    from ..expr.aggregation import PUSHABLE_AGGS
+
     child = build_executor(plan.children[0], ctx)
-    if any(a.distinct for a in plan.aggs):
-        # DISTINCT aggregates cannot split into partial/final across
-        # chunks — complete mode over raw rows (ref: AggFuncMode Complete)
+    if any(a.distinct or a.name not in PUSHABLE_AGGS and a.name != "group_concat" for a in plan.aggs):
+        # DISTINCT and complete-only aggregates (percentile, json_*agg)
+        # cannot split into partial/final across chunks — complete mode
+        # over raw rows (ref: AggFuncMode Complete)
         return CompleteAggExec(child, plan.group_by, plan.aggs, [c.ft for c in plan.out_cols])
     reader = _pushable_reader(child)
     pushable = (
@@ -602,7 +605,7 @@ class WindowExec(Executor):
         and the inverse permutation entirely."""
         if self.order_by or not self.part_by:
             return None
-        if any(f.name not in self._AGG_FUNCS for f in self.funcs):
+        if any(f.name not in self._AGG_FUNCS or f.frame is not None for f in self.funcs):
             return None
         part_lanes = [self._lane(e, c, n) for e in self.part_by]
         arg_lanes = []
@@ -712,10 +715,28 @@ class WindowExec(Executor):
         has no device form (the reason lands in EXPLAIN ANALYZE)."""
         from .window_device import SUPPORTED, encode_obj
 
+        from .window_device import MAX_DEVICE_FRAME_W, frame_width
+
         fspecs = []
         for f in self.funcs:
             if f.name not in SUPPORTED:
                 raise _NotOnDevice(f"window func {f.name} has no device kernel")
+            frame = None
+            if f.frame is not None and f.name in (
+                "first_value", "last_value", "nth_value", "count", "sum", "avg", "min", "max",
+            ):
+                fr = f.frame
+                if fr.unit == "range" and (
+                    fr.start_kind in ("pre", "fol") or fr.end_kind in ("pre", "fol")
+                ):
+                    raise _NotOnDevice("RANGE offset frame has no device kernel")
+                frame = fr.key()
+                if f.name in ("min", "max") and fr.start_kind != "up" and fr.end_kind != "uf":
+                    # both-bounded: device needs a static sparse table
+                    if fr.unit != "rows":
+                        raise _NotOnDevice("peer-bounded MIN/MAX frame has no device kernel")
+                    if frame_width(frame) > MAX_DEVICE_FRAME_W:
+                        raise _NotOnDevice("ROWS frame too wide for the device sparse table")
 
             def const_int(e, what):
                 if not isinstance(e, Constant):
@@ -723,7 +744,7 @@ class WindowExec(Executor):
                 return e.value.to_int()
 
             name = f.name
-            spec = {"name": name, "args": [], "post": None}
+            spec = {"name": name, "args": [], "post": None, "frame": frame}
             if name == "ntile":
                 spec["static"] = ("ntile", const_int(f.args[0], "bucket count"))
             elif name in ("row_number", "rank", "dense_rank", "cume_dist", "percent_rank"):
@@ -858,7 +879,7 @@ class WindowExec(Executor):
             n=n, order=order, pid=pid, pidx=pidx, pend=pend,
             pfirst=pfirst_row, plast=plast_row, psize=psize, rn=rn,
             peer_id=peer_id, oidx=oidx, oend=oend_arr, peer_last=peer_last,
-            frame_end=frame_end,
+            frame_end=frame_end, order_lanes=order_lanes,
         )
         cols = list(c.columns)
         nbase = len(cols)
@@ -871,6 +892,82 @@ class WindowExec(Executor):
             valid[order] = sv
             cols.append(Column(ft, data, valid))
         return Chunk(cols)
+
+    # -- frame bounds over the sorted domain --------------------------------
+
+    def _frame_bounds(self, f, env):
+        """Per-row frame [fs, fe] (sorted-row indices, clipped to the
+        partition) + non-empty mask for window func `f` (ref:
+        executor/pipelined_window.go getStart/getEnd, planner WindowFrame).
+        `None` frame keeps MySQL default semantics."""
+        n = env["n"]
+        ones = np.ones(n, dtype=bool)
+        fr = f.frame
+        if fr is None:
+            return env["pfirst"], env["frame_end"], ones
+        pfirst, plast = env["pfirst"], env["plast"]
+        if fr.unit == "rows":
+            iota = np.arange(n)
+
+            def pos(kind, off, cur):
+                if kind == "up":
+                    return pfirst
+                if kind == "uf":
+                    return plast
+                if kind == "cur":
+                    return cur
+                return iota - off if kind == "pre" else iota + off
+
+            fs_raw = pos(fr.start_kind, fr.start_off, iota)
+            fe_raw = pos(fr.end_kind, fr.end_off, iota)
+        else:
+            fs_raw, fe_raw = self._range_bounds(fr, env)
+        ne = (fs_raw <= fe_raw) & (fs_raw <= plast) & (fe_raw >= pfirst)
+        return np.clip(fs_raw, pfirst, plast), np.clip(fe_raw, pfirst, plast), ne
+
+    def _range_bounds(self, fr, env):
+        """RANGE frame edges: UNBOUNDED/CURRENT resolve to partition/peer
+        ends; offset bounds binary-search the single numeric ORDER BY key
+        per partition (keys ascend within a partition after the lex sort;
+        DESC keys are negated into ascending space). NULL-key rows frame
+        their peer (NULL) block on offset sides."""
+        peer_first = env["oidx"][env["peer_id"]]
+        peer_last = env["peer_last"]
+        pfirst, plast = env["pfirst"], env["plast"]
+        simple = {"up": pfirst, "uf": plast}
+        need_search = fr.start_kind in ("pre", "fol") or fr.end_kind in ("pre", "fol")
+        fs = simple.get(fr.start_kind, peer_first)
+        fe = simple.get(fr.end_kind, peer_last)
+        if not need_search:
+            return fs, fe
+        n = env["n"]
+        (d, v), desc = env["order_lanes"][0]
+        order = env["order"]
+        sd, sv = d[order], v[order]
+        kk = sd
+        off_s, off_e = fr.start_off, fr.end_off
+        if kk.dtype == np.uint64 or isinstance(off_s, float) or isinstance(off_e, float):
+            kk = kk.astype(np.float64)
+        if desc:
+            kk = -kk  # descending keys → ascending space; offsets flip with it
+        fs = np.array(np.broadcast_to(fs, n), dtype=np.int64)
+        fe = np.array(np.broadcast_to(fe, n), dtype=np.int64)
+        for p0, p1 in zip(env["pidx"], env["pend"]):
+            sl = slice(p0, p1 + 1)
+            kv, vv = kk[sl], sv[sl]
+            vpos = np.nonzero(vv)[0]
+            if len(vpos) == 0:
+                continue  # all-NULL partition: peers already in place
+            vlo, vhi = vpos[0], vpos[-1]
+            vkeys = kv[vlo : vhi + 1]
+            rows = vpos  # only valid-key rows get value-based bounds
+            if fr.start_kind in ("pre", "fol"):
+                tgt = kv[rows] - off_s if fr.start_kind == "pre" else kv[rows] + off_s
+                fs[p0 + rows] = p0 + vlo + np.searchsorted(vkeys, tgt, side="left")
+            if fr.end_kind in ("pre", "fol"):
+                tgt = kv[rows] - off_e if fr.end_kind == "pre" else kv[rows] + off_e
+                fe[p0 + rows] = p0 + vlo + np.searchsorted(vkeys, tgt, side="right") - 1
+        return fs, fe
 
     # -- per-function kernels over the sorted domain ------------------------
 
@@ -919,16 +1016,15 @@ class WindowExec(Executor):
         if name in ("first_value", "last_value", "nth_value"):
             d, v = self._lane(f.args[0], c, n)
             sd, sv = d[order], v[order]
+            fs_, fe_, ne_ = self._frame_bounds(f, env)
             if name == "first_value":
-                pos = env["pfirst"]
-                ok = ones
+                pos, ok = fs_, ne_
             elif name == "last_value":
-                pos = env["frame_end"]
-                ok = ones
+                pos, ok = fe_, ne_
             else:
                 k = f.args[1].value.to_int()
-                pos = env["pfirst"] + k - 1
-                ok = pos <= env["frame_end"]
+                pos = fs_ + k - 1
+                ok = ne_ & (pos <= fe_)
                 pos = np.minimum(pos, n - 1)
             return sd[pos], sv[pos] & ok
         if name in ("count", "sum", "avg", "min", "max"):
@@ -938,7 +1034,7 @@ class WindowExec(Executor):
     def _compute_agg(self, f, c, env):
         n, order = env["n"], env["order"]
         name = f.name
-        fe, pfirst = env["frame_end"], env["pfirst"]
+        fs_, fe_, ne_ = self._frame_bounds(f, env)
         if f.args:
             d, v = self._lane(f.args[0], c, n)
             sd, sv = d[order], v[order]
@@ -947,60 +1043,101 @@ class WindowExec(Executor):
         if sd.dtype == object and name in ("sum", "avg"):
             raise TiDBError(f"window {name} over string operands is not supported")
         cnt_cs = np.cumsum(sv.astype(np.int64))
-        before = np.where(pfirst > 0, cnt_cs[np.maximum(pfirst - 1, 0)], 0)
-        frame_cnt = cnt_cs[fe] - before
+        before = np.where(fs_ > 0, cnt_cs[np.maximum(fs_ - 1, 0)], 0)
+        frame_cnt = np.where(ne_, cnt_cs[fe_] - before, 0)
         if name == "count":
             return frame_cnt, np.ones(n, dtype=bool)
         if name in ("sum", "avg"):
             is_f = sd.dtype == np.float64
             vals = np.where(sv, sd, 0.0 if is_f else 0)
             val_cs = np.cumsum(vals)
-            vbefore = np.where(pfirst > 0, val_cs[np.maximum(pfirst - 1, 0)], 0)
-            frame_sum = val_cs[fe] - vbefore
+            vbefore = np.where(fs_ > 0, val_cs[np.maximum(fs_ - 1, 0)], 0)
+            frame_sum = np.where(ne_, val_cs[fe_] - vbefore, 0)
             if name == "sum":
                 return frame_sum, frame_cnt > 0
             if is_f or f.ret_type.is_float():
                 with np.errstate(divide="ignore", invalid="ignore"):
                     return np.where(frame_cnt > 0, frame_sum / np.maximum(frame_cnt, 1), 0.0), frame_cnt > 0
-            # decimal AVG: exact Dec division at peer granularity
+            # decimal AVG: exact Dec division at peer granularity for the
+            # default frame; explicit frames vary per row
             arg_scale = max(f.args[0].ret_type.decimal, 0) if f.args[0].ret_type.is_decimal() else 0
             out_scale = max(f.ret_type.decimal, 0)
-            oidx = env["oidx"]
-            qs = np.zeros(len(oidx), dtype=np.int64)
-            qv = np.zeros(len(oidx), dtype=bool)
-            for g, p in enumerate(oidx):
+            rows = env["oidx"] if f.frame is None else np.arange(n)
+            qs = np.zeros(len(rows), dtype=np.int64)
+            qv = np.zeros(len(rows), dtype=bool)
+            for g, p in enumerate(rows):
                 s_, c_ = int(frame_sum[p]), int(frame_cnt[p])
                 if c_ > 0:
                     q = Dec(s_, arg_scale).div(Dec(c_, 0))
                     if q is not None:
                         qs[g] = q.rescale(out_scale).value
                         qv[g] = True
-            return qs[env["peer_id"]], qv[env["peer_id"]]
-        # min / max: accumulate within partitions (python over partitions)
-        pidx, pend_arr = env["pidx"], env["pend"]
+            if f.frame is None:
+                return qs[env["peer_id"]], qv[env["peer_id"]]
+            return qs, qv
+        return self._compute_minmax(f, env, sd, sv, fs_, fe_, ne_, frame_cnt)
+
+    def _compute_minmax(self, f, env, sd, sv, fs_, fe_, ne_, frame_cnt):
+        n = env["n"]
+        name = f.name
+        valid = (frame_cnt > 0) & ne_
         is_obj = sd.dtype == object
-        acc = np.empty(n, dtype=object) if is_obj else np.empty_like(sd)
-        accv = np.zeros(n, dtype=bool)
         better = (lambda a, b: a < b) if name == "min" else (lambda a, b: a > b)
         if is_obj:
-            for p0, p1 in zip(pidx, pend_arr):
+            if f.frame is None:
+                return self._minmax_obj_default(env, sd, sv, fe_, better)
+            # explicit frame over a string lane: per-row scan (host-only path)
+            out = np.empty(n, dtype=object)
+            outv = np.zeros(n, dtype=bool)
+            for i in range(n):
+                if not ne_[i]:
+                    continue
                 cur, curv = None, False
-                for i in range(p0, p1 + 1):
-                    if sv[i] and (not curv or better(sd[i], cur)):
-                        cur, curv = sd[i], True
-                    acc[i], accv[i] = cur, curv
-        else:
-            ufunc = np.minimum if name == "min" else np.maximum
-            fill = (np.inf if name == "min" else -np.inf) if sd.dtype == np.float64 else (
-                np.iinfo(sd.dtype).max if name == "min" else np.iinfo(sd.dtype).min
-            )
-            masked = np.where(sv, sd, fill)
-            vcnt = np.cumsum(sv.astype(np.int64))
-            for p0, p1 in zip(pidx, pend_arr):
+                for j in range(fs_[i], fe_[i] + 1):
+                    if sv[j] and (not curv or better(sd[j], cur)):
+                        cur, curv = sd[j], True
+                out[i], outv[i] = cur, curv
+            return out, outv
+        ufunc = np.minimum if name == "min" else np.maximum
+        fill = (np.inf if name == "min" else -np.inf) if sd.dtype == np.float64 else (
+            np.iinfo(sd.dtype).max if name == "min" else np.iinfo(sd.dtype).min
+        )
+        masked = np.where(sv, sd, fill)
+        fr = f.frame
+        starts_at_pfirst = fr is None or (fr.start_kind == "up")
+        if starts_at_pfirst:
+            # growing frame: running accumulate per partition, read at fe
+            acc = np.empty_like(masked)
+            for p0, p1 in zip(env["pidx"], env["pend"]):
                 acc[p0 : p1 + 1] = ufunc.accumulate(masked[p0 : p1 + 1])
-                base = vcnt[p0 - 1] if p0 > 0 else 0
-                accv[p0 : p1 + 1] = (vcnt[p0 : p1 + 1] - base) > 0
-        return acc[env["frame_end"]], accv[env["frame_end"]]
+            return acc[fe_], valid
+        # sliding frame: sparse table (range-min-query) over the masked
+        # lane — queries never cross a partition (fs/fe are clipped)
+        w = np.maximum(fe_ - fs_ + 1, 1)
+        L = max(1, int(np.max(w)).bit_length())
+        levels = [masked]
+        for k in range(1, L):
+            h = 1 << (k - 1)
+            prev = levels[-1]
+            shifted = np.concatenate([prev[h:], np.full(h, fill, dtype=prev.dtype)])
+            levels.append(ufunc(prev, shifted))
+        stk = np.stack(levels)
+        k = (np.frexp(w.astype(np.float64))[1] - 1).astype(np.int64)  # floor(log2 w), exact
+        half = np.left_shift(np.int64(1), k)
+        res = ufunc(stk[k, fs_], stk[k, np.maximum(fe_ - half + 1, 0)])
+        return res, valid
+
+    def _minmax_obj_default(self, env, sd, sv, fe_, better):
+        n = env["n"]
+        acc = np.empty(n, dtype=object)
+        accv = np.zeros(n, dtype=bool)
+        for p0, p1 in zip(env["pidx"], env["pend"]):
+            cur, curv = None, False
+            for i in range(p0, p1 + 1):
+                if sv[i] and (not curv or better(sd[i], cur)):
+                    cur, curv = sd[i], True
+                acc[i], accv[i] = cur, curv
+        return acc[fe_], accv[fe_]
 
 
 SPILL_COUNT = 0  # process-wide spill events (observability + tests)
@@ -1252,12 +1389,20 @@ class CompleteAggExec(Executor):
         self._done = True
         c = drain(self.child)
         n = c.num_rows
+        from ..expr.aggregation import NULL_KEEPING_AGGS
+
         key_lanes = [_broadcast_lane(*g.eval(c), n) for g in self.group_by]
         arg_lanes = []
         for a in self.aggs:
             if a.args:
-                d, v = _broadcast_lane(*a.args[0].eval(c), n)
-                arg_lanes.append(Column(a.args[0].ret_type, d, v))
+                # multi-lane aggs (JSON_OBJECTAGG) evaluate every non-const
+                # argument; constant tail args (percentile) read at final
+                lanes = []
+                nlanes = 2 if a.name == "json_objectagg" else 1
+                for x in a.args[:nlanes]:
+                    d, v = _broadcast_lane(*x.eval(c), n)
+                    lanes.append(Column(x.ret_type, d, v))
+                arg_lanes.append(lanes)
             else:
                 arg_lanes.append(None)
         key_cols = [Column(g.ret_type, d, v) for g, (d, v) in zip(self.group_by, key_lanes)]
@@ -1272,11 +1417,13 @@ class CompleteAggExec(Executor):
                 st = (i, [[] for _ in self.aggs])
                 groups[key] = st
                 order.append(key)
-            for k, col in enumerate(arg_lanes):
-                if col is None:
+            for k, (a, cols) in enumerate(zip(self.aggs, arg_lanes)):
+                if cols is None:
                     st[1][k].append(Datum.i(1))
-                elif col.valid[i]:
-                    st[1][k].append(col.get_datum(i))
+                elif len(cols) > 1:
+                    st[1][k].append(tuple(col.get_datum(i) for col in cols))
+                elif cols[0].valid[i] or a.name in NULL_KEEPING_AGGS:
+                    st[1][k].append(cols[0].get_datum(i))
         if not groups and not self.group_by:
             groups[()] = (0, [[] for _ in self.aggs])
             order.append(())
@@ -1304,10 +1451,35 @@ class CompleteAggExec(Executor):
         name = a.name
         if name == "count":
             return Datum.i(len(vals))
+        if name == "approx_count_distinct":
+            return Datum.i(len({(d.kind, d.val) for d in vals}))
+        if name == "json_arrayagg":
+            import json as _j
+
+            if not vals:
+                return Datum.null()
+            return Datum.s(_j.dumps([_datum_to_json(d, a.args[0].ret_type) for d in vals]))
+        if name == "json_objectagg":
+            import json as _j
+
+            if not vals:
+                return Datum.null()
+            obj = {}
+            for kd, vd in vals:
+                if kd.is_null:
+                    raise TiDBError("JSON documents may not contain NULL member names")
+                obj[kd.render(a.args[0].ret_type)] = _datum_to_json(vd, a.args[1].ret_type)
+            return Datum.s(_j.dumps(obj))
         if not vals:
             return Datum.null() if name not in ("bit_and", "bit_or", "bit_xor") else (
                 Datum.u(0xFFFFFFFFFFFFFFFF) if name == "bit_and" else Datum.u(0)
             )
+        if name == "approx_percentile":
+            p = a.args[1].value.to_int()
+            svals = sorted(vals, key=_cmp_key)
+            # nearest-rank percentile (ref: aggfuncs percentileOriginal*)
+            idx = max((p * len(svals) + 99) // 100, 1) - 1
+            return svals[min(idx, len(svals) - 1)]
         if name in ("sum", "avg"):
             from ..mysqltypes.datum import K_FLOAT
 
@@ -1351,6 +1523,36 @@ class CompleteAggExec(Executor):
                 acc = acc & v if name == "bit_and" else (acc | v if name == "bit_or" else acc ^ v)
             return Datum.u(acc & 0xFFFFFFFFFFFFFFFF)
         raise TiDBError(f"unsupported complete aggregate {name}")
+
+
+def _datum_to_json(d: Datum, ft) -> object:
+    """Datum → python JSON value (ref: types/json CreateBinary paths)."""
+    if d.is_null:
+        return None
+    if ft is not None and ft.is_decimal():
+        return float(d.to_dec().to_float())
+    from ..mysqltypes.datum import K_FLOAT, K_INT, K_UINT
+
+    if d.kind == K_FLOAT:
+        return float(d.val)
+    if d.kind in (K_INT, K_UINT):
+        return d.to_int()
+    s = d.render(ft) if ft is not None else str(d.val)
+    # JSON-typed operands embed as documents, not strings
+    if ft is not None and ft.tp == TypeCode.JSON:
+        import json as _j
+
+        try:
+            return _j.loads(s)
+        except ValueError:
+            return s
+    return s
+
+
+def _cmp_key(d: Datum):
+    import functools
+
+    return functools.cmp_to_key(compare_datum)(d)
 
 
 class FinalHashAggExec(Executor):
@@ -1464,6 +1666,17 @@ class FinalHashAggExec(Executor):
             if name == "bit_or":
                 return state | v
             return state ^ v
+        if name == "approx_count_distinct":
+            from ..statistics.fmsketch import FMSketch
+
+            if vals[0].is_null:
+                return state
+            b = vals[0].val
+            sk = FMSketch.deserialize(b if isinstance(b, (bytes, bytearray)) else str(b).encode("latin-1"))
+            if state is None:
+                return sk
+            state.merge(sk)
+            return state
         raise NotImplementedError(name)
 
     @staticmethod
@@ -1508,6 +1721,8 @@ class FinalHashAggExec(Executor):
             ident = -1 if name == "bit_and" else 0
             v = state if state is not None else ident
             return Datum.u(v & 0xFFFFFFFFFFFFFFFF)
+        if name == "approx_count_distinct":
+            return Datum.i(state.ndv() if state is not None else 0)
         raise NotImplementedError(name)
 
 

@@ -69,10 +69,24 @@ def encode_obj(d: np.ndarray, v: np.ndarray, extra=None):
     return codes, vocab, extra_codes
 
 
+# largest static ROWS window lowered via the on-device sparse table; wider
+# sliding frames stay on host (memory: log2(w) extra lanes of length P)
+MAX_DEVICE_FRAME_W = 1 << 16
+
+
+def frame_width(frkey) -> int:
+    """Static max width of a both-bounded ROWS frame key; <=0 == always
+    empty."""
+    shift = {"pre": -1, "cur": 0, "fol": 1}
+    _, sk, so, ek, eo = frkey
+    return (shift[ek] * eo if ek in shift else 0) - (shift[sk] * so if sk in shift else 0) + 1
+
+
 @lru_cache(maxsize=256)
 def _build_kernel(spec):
-    """spec = (npart, order_descs, funcspecs) — all static, hashable."""
-    npart, order_descs, funcspecs = spec
+    """spec = (npart, order_descs, funcspecs, framespecs) — all static,
+    hashable. framespecs[i] is None (default frame) or Frame.key()."""
+    npart, order_descs, funcspecs, framespecs = spec
     descs = (False,) * npart + tuple(order_descs)
 
     def kernel(keys, fargs, padflag):
@@ -128,16 +142,42 @@ def _build_kernel(spec):
         def scat(x):
             return jnp.zeros(P, dtype=x.dtype).at[perm].set(x)
 
-        def frame_cnt_of(sv):
-            cs = jnp.cumsum(sv.astype(jnp.int64))
-            before = jnp.where(pfirst > 0, cs[jnp.maximum(pfirst - 1, 0)], 0)
-            return cs[fe] - before
+        def frame_of(frkey):
+            """frame key → (fs, fe, nonempty) over sorted rows (the host
+            WindowExec._frame_bounds twin; RANGE offset bounds never reach
+            the device)."""
+            if frkey is None:
+                return pfirst, fe, ones
+            unit, sk, so, ek, eo = frkey
+            cur_s = iota if unit == "rows" else peer_first
+            cur_e = iota if unit == "rows" else peer_last
 
-        def frame_sum_of(sd, sv):
+            def pos(kind, off, cur):
+                if kind == "up":
+                    return pfirst
+                if kind == "uf":
+                    return plast
+                if kind == "cur":
+                    return cur
+                return iota - off if kind == "pre" else iota + off
+
+            fs_raw = pos(sk, so, cur_s)
+            fe_raw = pos(ek, eo, cur_e)
+            ne = (fs_raw <= fe_raw) & (fs_raw <= plast) & (fe_raw >= pfirst)
+            return jnp.clip(fs_raw, pfirst, plast), jnp.clip(fe_raw, pfirst, plast), ne
+
+        def frame_cnt_of(sv, fb):
+            fs_, fe_, ne_ = fb
+            cs = jnp.cumsum(sv.astype(jnp.int64))
+            before = jnp.where(fs_ > 0, cs[jnp.maximum(fs_ - 1, 0)], 0)
+            return jnp.where(ne_, cs[fe_] - before, 0)
+
+        def frame_sum_of(sd, sv, fb):
+            fs_, fe_, ne_ = fb
             zero = jnp.zeros((), dtype=sd.dtype)
             cs = jnp.cumsum(jnp.where(sv, sd, zero))
-            before = jnp.where(pfirst > 0, cs[jnp.maximum(pfirst - 1, 0)], zero)
-            return cs[fe] - before
+            before = jnp.where(fs_ > 0, cs[jnp.maximum(fs_ - 1, 0)], zero)
+            return jnp.where(ne_, cs[fe_] - before, zero)
 
         outs = []
         vi = 0
@@ -148,8 +188,9 @@ def _build_kernel(spec):
             vi += 2
             return d, v
 
-        for fs in funcspecs:
+        for fs, frkey in zip(funcspecs, framespecs):
             name = fs[0]
+            fb = frame_of(frkey)
             if name == "row_number":
                 sd, sv = rn + 1, ones
             elif name == "rank":
@@ -195,25 +236,26 @@ def _build_kernel(spec):
                 sv = jnp.where(ok, sv0[tgt_c], dv)
             elif name in ("first_value", "last_value", "nth_value"):
                 sd0, sv0 = take_arg()
+                fs_, fe_, ne_ = fb
                 if name == "first_value":
-                    pos, ok = pfirst, ones
+                    pos, ok = fs_, ne_
                 elif name == "last_value":
-                    pos, ok = fe, ones
+                    pos, ok = fe_, ne_
                 else:
-                    pos = pfirst + fs[1] - 1
-                    ok = pos <= fe
-                    pos = jnp.minimum(pos, P - 1)
+                    pos = fs_ + fs[1] - 1
+                    ok = ne_ & (pos <= fe_)
+                    pos = jnp.clip(pos, 0, P - 1)
                 sd, sv = sd0[pos], sv0[pos] & ok
             elif name == "count":
                 if fs[1]:
                     _, sv0 = take_arg()
                 else:
                     sv0 = ones
-                sd, sv = frame_cnt_of(sv0), ones
+                sd, sv = frame_cnt_of(sv0, fb), ones
             elif name in ("sum", "avg"):
                 sd0, sv0 = take_arg()
-                fcnt = frame_cnt_of(sv0)
-                fsum = frame_sum_of(sd0, sv0)
+                fcnt = frame_cnt_of(sv0, fb)
+                fsum = frame_sum_of(sd0, sv0, fb)
                 if name == "sum":
                     sd, sv = fsum, fcnt > 0
                 else:
@@ -232,14 +274,46 @@ def _build_kernel(spec):
                     fill = -jnp.inf if is_f else np.iinfo(np.dtype(sd0.dtype)).min
                     op = jnp.maximum
                 masked = jnp.where(sv0, sd0, jnp.asarray(fill, dtype=sd0.dtype))
+                fs_, fe_, ne_ = fb
 
                 def comb(a, b, _op=op):
                     af, av = a
                     bf, bv = b
                     return af | bf, jnp.where(bf, bv, _op(av, bv))
 
-                _, acc = jax.lax.associative_scan(comb, (pstart, masked))
-                sd, sv = acc[fe], frame_cnt_of(sv0) > 0
+                if frkey is None or frkey[1] == "up":
+                    # growing frame: prefix scan per partition, read at fe
+                    _, acc = jax.lax.associative_scan(comb, (pstart, masked))
+                    sd = acc[fe_]
+                elif frkey[3] == "uf":
+                    # shrinking frame: suffix scan (reversed prefix), read at fs
+                    plastflag = iota == plast
+                    _, acc_r = jax.lax.associative_scan(
+                        comb, (jnp.flip(plastflag), jnp.flip(masked))
+                    )
+                    sd = jnp.flip(acc_r)[fs_]
+                else:
+                    # both-bounded ROWS frame: static-depth sparse table
+                    # (range-min-query); frame never crosses a partition
+                    L = max(1, frame_width(frkey).bit_length())
+                    levels = [masked]
+                    for k in range(1, L):
+                        h = 1 << (k - 1)
+                        prev = levels[-1]
+                        shifted = jnp.concatenate(
+                            [prev[h:], jnp.full(h, fill, dtype=prev.dtype)]
+                        )
+                        levels.append(op(prev, shifted))
+                    stk = jnp.stack(levels)
+                    w = jnp.maximum(fe_ - fs_ + 1, 1)
+                    # floor(log2 w) via a static comparison ladder — frexp
+                    # lowers to an s64 bitcast the TPU X64 rewrite rejects
+                    lk = jnp.zeros(P, dtype=jnp.int64)
+                    for j in range(1, L):
+                        lk = lk + (w >= (1 << j)).astype(jnp.int64)
+                    half = jnp.left_shift(jnp.asarray(1, jnp.int64), lk)
+                    sd = op(stk[lk, fs_], stk[lk, jnp.maximum(fe_ - half + 1, 0)])
+                sv = frame_cnt_of(sv0, fb) > 0
             else:  # pragma: no cover — guarded by SUPPORTED
                 raise AssertionError(name)
             outs.append((scat(sd), scat(sv.astype(jnp.bool_))))
@@ -300,10 +374,11 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int):
     )
     descs = tuple(bool(desc) for _, desc in order_lanes)
     funcspecs = tuple(f["static"] for f in fspecs)
+    framespecs = tuple(f.get("frame") for f in fspecs)
     fargs = tuple(tuple(pad(d, v) for d, v in f["args"]) for f in fspecs)
     padflag = jnp.asarray((np.arange(P) >= n).astype(np.int32))
 
-    kernel = _build_kernel((len(part_lanes), descs, funcspecs))
+    kernel = _build_kernel((len(part_lanes), descs, funcspecs, framespecs))
     outs = kernel(keys, fargs, padflag)
 
     results = []

@@ -33,15 +33,25 @@ PUSHABLE_AGGS = (
     # (cnt, sum, sumsq) / bitwise partials merge exactly at the root final
     "stddev_pop", "stddev_samp", "var_pop", "var_samp",
     "bit_and", "bit_or", "bit_xor",
+    # FM-sketch partials union exactly at the root final (ref:
+    # aggfuncs approxCountDistinctPartial1/Final, statistics/fmsketch.go)
+    "approx_count_distinct",
 )
 AGG_FUNCS = PUSHABLE_AGGS + (
     "group_concat",
     "stddev_pop", "stddev_samp", "std", "stddev",
     "var_pop", "var_samp", "variance",
     "bit_and", "bit_or", "bit_xor",
+    # complete-mode only (ref: aggfuncs.go:45-53 percentileOriginal*,
+    # jsonArrayagg/jsonObjectagg)
+    "approx_percentile", "json_arrayagg", "json_objectagg",
 )
 # aliases normalize at construction (ref: MySQL STD/STDDEV/VARIANCE)
 _AGG_ALIAS = {"std": "stddev_pop", "stddev": "stddev_pop", "variance": "var_pop"}
+# aggs that take other than exactly one argument
+_AGG_ARITY = {"approx_percentile": 2, "json_objectagg": 2, "count": (0, 1)}
+# aggs that keep NULL argument rows (JSON aggregation includes nulls)
+NULL_KEEPING_AGGS = ("json_arrayagg", "json_objectagg")
 GROUP_CONCAT_MAX_LEN = 1024  # MySQL group_concat_max_len default
 
 
@@ -64,6 +74,14 @@ def agg_ret_type(name: str, arg_ft: FieldType | None) -> FieldType:
 
         ft.flag |= UNSIGNED_FLAG
         return ft
+    if name == "approx_count_distinct":
+        return ft_longlong()
+    if name in ("json_arrayagg", "json_objectagg"):
+        from ..mysqltypes.field_type import TypeCode
+
+        return FieldType(TypeCode.JSON, flen=-1)
+    if name == "approx_percentile":
+        return arg_ft.clone()
     if name == "sum":
         if arg_ft.is_float() or arg_ft.is_string():
             return ft_double()
@@ -89,13 +107,27 @@ class AggDesc:
 
     @staticmethod
     def make(name: str, args: list[Expression], distinct: bool = False) -> "AggDesc":
+        from ..errors import TiDBError
+
         name = _AGG_ALIAS.get(name.lower(), name.lower())
         if name not in AGG_FUNCS:
             raise ValueError(f"unknown aggregate {name}")
-        if len(args) > 1:
-            from ..errors import TiDBError
+        want = _AGG_ARITY.get(name, 1)
+        lo, hi = want if isinstance(want, tuple) else (want, want)
+        if not (lo <= len(args) <= hi):
+            raise TiDBError(f"aggregate {name.upper()} takes {want} argument(s)")
+        if name == "approx_percentile":
+            from .expression import Constant
 
-            raise TiDBError(f"aggregate {name.upper()} supports a single argument here")
+            p = args[1]
+            ok = isinstance(p, Constant) and not p.value.is_null
+            try:
+                f = p.value.to_float()
+                ok = ok and f == int(f) and 1 <= int(f) <= 100
+            except Exception:
+                ok = False
+            if not ok:
+                raise TiDBError("Percentage value must be a constant integer in [1, 100]")
         arg_ft = args[0].ret_type if args else None
         return AggDesc(name, args, distinct, MODE_COMPLETE, agg_ret_type(name, arg_ft))
 
@@ -120,6 +152,10 @@ class AggDesc:
             return [("concat", self.ret_type)]
         if self.name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
             return [("count", ft_longlong()), ("sum", ft_double()), ("sumsq", ft_double())]
+        if self.name == "approx_count_distinct":
+            from ..mysqltypes.field_type import ft_varchar
+
+            return [("sketch", ft_varchar(-1))]  # serialized FMSketch bytes
         return [(self.name, self.ret_type)]
 
     def __repr__(self):
@@ -145,6 +181,25 @@ WINDOW_FUNCS = (
 )
 
 
+@dataclass(frozen=True)
+class Frame:
+    """Normalized window frame (ref: planner/core WindowFrame). Bound
+    kinds: 'up'|'pre'|'cur'|'fol'|'uf'; offsets are validated non-negative
+    numbers (ROWS: ints; RANGE: numbers in the ORDER BY key's own space —
+    decimal keys carry the offset pre-scaled to the key's scaled-int
+    form). `None` frame == MySQL default (RANGE UNBOUNDED PRECEDING ..
+    CURRENT ROW with ORDER BY, whole partition without)."""
+
+    unit: str  # 'rows' | 'range'
+    start_kind: str
+    start_off: object = 0  # int | float
+    end_kind: str = "cur"
+    end_off: object = 0
+
+    def key(self):
+        return (self.unit, self.start_kind, self.start_off, self.end_kind, self.end_off)
+
+
 @dataclass
 class WinDesc:
     """One window function over a (PARTITION BY, ORDER BY) spec
@@ -155,9 +210,11 @@ class WinDesc:
     part_by: list[Expression]
     order_by: list  # [(Expression, desc: bool)]
     ret_type: FieldType = field(default_factory=ft_longlong)
+    frame: Frame | None = None  # None == default frame semantics
 
     def spec_key(self) -> str:
         return f"part={self.part_by!r}|order={[(repr(e), d) for e, d in self.order_by]!r}"
 
     def __repr__(self):
-        return f"{self.name}({', '.join(map(repr, self.args))}) over({self.spec_key()})"
+        fr = f" frame={self.frame.key()}" if self.frame is not None else ""
+        return f"{self.name}({', '.join(map(repr, self.args))}) over({self.spec_key()}{fr})"

@@ -42,12 +42,31 @@ class Star:
 
 
 @dataclass
+class FrameBound:
+    """One window frame edge (ref: parser ast FrameBound).
+    kind: 'up' UNBOUNDED PRECEDING | 'pre' n PRECEDING | 'cur' CURRENT ROW
+        | 'fol' n FOLLOWING | 'uf' UNBOUNDED FOLLOWING."""
+
+    kind: str
+    offset: Any = None  # expr for 'pre'/'fol'
+
+
+@dataclass
+class FrameSpec:
+    """ROWS/RANGE frame clause (ref: parser ast FrameClause)."""
+
+    unit: str  # 'rows' | 'range'
+    start: FrameBound
+    end: FrameBound
+
+
+@dataclass
 class WindowSpec:
-    """OVER (...) clause (ref: parser ast WindowSpec; frames beyond the
-    default RANGE UNBOUNDED PRECEDING..CURRENT ROW are rejected upstream)."""
+    """OVER (...) clause (ref: parser ast WindowSpec)."""
 
     partition_by: list
     order_by: list  # ByItem
+    frame: FrameSpec | None = None
 
 
 @dataclass

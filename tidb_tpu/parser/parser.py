@@ -675,6 +675,26 @@ class Parser:
                 self.expect_op(")")
                 return ast.SubqueryExpr(sub, "exists")
             if up == "INTERVAL":
+                # INTERVAL(n, n1, n2, ...) the comparison function vs
+                # INTERVAL <expr> <unit> date arithmetic — disambiguated
+                # by a top-level comma inside the parens (MySQL grammar)
+                if self.peek().kind == "op" and self.peek().text == "(":
+                    depth, j = 0, self.i + 1
+                    is_call = False
+                    while j < len(self.toks):
+                        t = self.toks[j]
+                        if t.kind == "op" and t.text == "(":
+                            depth += 1
+                        elif t.kind == "op" and t.text == ")":
+                            depth -= 1
+                            if depth == 0:
+                                break
+                        elif t.kind == "op" and t.text == "," and depth == 1:
+                            is_call = True
+                            break
+                        j += 1
+                    if is_call:
+                        return self.func_call()
                 self.next()
                 e = self.expr()
                 unit = self.ident().lower()
@@ -740,8 +760,9 @@ class Parser:
         return self._maybe_over(call)
 
     def _maybe_over(self, call: ast.Call) -> ast.Call:
-        """OVER ([PARTITION BY ...] [ORDER BY ...] [frame]) — only the
-        default-equivalent frame is accepted (ref: ast WindowSpec)."""
+        """OVER ([PARTITION BY ...] [ORDER BY ...] [frame]) with full
+        ROWS/RANGE BETWEEN frame clauses (ref: parser.y WindowFrameClause,
+        executor/pipelined_window.go:37)."""
         if not self.at_kw("OVER"):
             return call
         self.next()
@@ -755,21 +776,36 @@ class Parser:
         if self.try_kw("ORDER"):
             self.expect_kw("BY")
             order = self.by_items()
+        frame = None
         if self.at_kw("ROWS", "RANGE"):
-            unit = self.next().upper
-            # accept only the default frame: <unit> BETWEEN UNBOUNDED
-            # PRECEDING AND CURRENT ROW (and RANGE must have ORDER BY)
-            ok = True
+            unit = self.next().upper.lower()
             if self.try_kw("BETWEEN"):
-                ok = self.try_kw("UNBOUNDED") and self.try_kw("PRECEDING") \
-                    and self.try_kw("AND") and self.try_kw("CURRENT") and self.try_kw("ROW")
+                start = self._frame_bound()
+                self.expect_kw("AND")
+                end = self._frame_bound()
             else:
-                ok = self.try_kw("UNBOUNDED") and self.try_kw("PRECEDING")
-            if not ok or unit == "ROWS":
-                self.fail("only the default window frame (RANGE UNBOUNDED PRECEDING .. CURRENT ROW) is supported")
+                # single-bound form: <bound> .. CURRENT ROW
+                start = self._frame_bound()
+                end = ast.FrameBound("cur")
+            frame = ast.FrameSpec(unit, start, end)
         self.expect_op(")")
-        call.over = ast.WindowSpec(part, order)
+        call.over = ast.WindowSpec(part, order, frame)
         return call
+
+    def _frame_bound(self) -> ast.FrameBound:
+        if self.try_kw("UNBOUNDED"):
+            if self.try_kw("PRECEDING"):
+                return ast.FrameBound("up")
+            self.expect_kw("FOLLOWING")
+            return ast.FrameBound("uf")
+        if self.try_kw("CURRENT"):
+            self.expect_kw("ROW")
+            return ast.FrameBound("cur")
+        e = self.expr()
+        if self.try_kw("PRECEDING"):
+            return ast.FrameBound("pre", e)
+        self.expect_kw("FOLLOWING")
+        return ast.FrameBound("fol", e)
 
     def case_expr(self):
         self.expect_kw("CASE")

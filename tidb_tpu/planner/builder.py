@@ -11,7 +11,7 @@ Non-aggregated bare columns under GROUP BY become first_row aggregates
 from __future__ import annotations
 
 from ..errors import AmbiguousColumn, TiDBError, UnknownColumn
-from ..expr.aggregation import AGG_FUNCS, WINDOW_FUNCS, AggDesc, WinDesc, agg_ret_type
+from ..expr.aggregation import AGG_FUNCS, WINDOW_FUNCS, AggDesc, Frame, WinDesc, agg_ret_type
 from ..expr.builtins import CAST_SIG
 from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc, make_func
 from ..mysqltypes.datum import Datum
@@ -602,7 +602,72 @@ class PlanBuilder:
             ft = args[0].ret_type.clone()
         else:
             raise TiDBError(f"{lname} cannot be used as a window function")
-        return _WindowFuncExpr(WinDesc(lname, args, part, order, ft))
+        frame = None
+        if node.over.frame is not None and lname not in self._FRAME_IGNORING:
+            frame = self._build_frame(node.over.frame, order, scope, agg_ctx)
+        return _WindowFuncExpr(WinDesc(lname, args, part, order, ft, frame))
+
+    _BOUND_RANK = {"up": 0, "pre": 1, "cur": 2, "fol": 3, "uf": 4}
+
+    def _build_frame(self, fr, order, scope, agg_ctx) -> Frame:
+        """ast.FrameSpec → validated normalized Frame (ref:
+        planner/core/logical_plan_builder.go buildWindowFunctionFrame +
+        checkFrameBound). RANGE offsets land pre-scaled for decimal keys."""
+        if fr.start.kind == "uf":
+            raise TiDBError("frame start cannot be UNBOUNDED FOLLOWING")
+        if fr.end.kind == "up":
+            raise TiDBError("frame end cannot be UNBOUNDED PRECEDING")
+        if self._BOUND_RANK[fr.start.kind] > self._BOUND_RANK[fr.end.kind]:
+            raise TiDBError("window frame start cannot be after frame end")
+
+        def bound_off(b, what):
+            if b.kind not in ("pre", "fol"):
+                return 0
+            e = self.to_expr(b.offset, scope, agg_ctx)
+            if not isinstance(e, Constant) or e.value.is_null:
+                raise TiDBError(f"window frame {what} offset must be a constant")
+            if fr.unit == "rows":
+                try:
+                    off = e.value.to_int()
+                except Exception:
+                    off = -1
+                if off < 0:
+                    raise TiDBError("ROWS frame offset must be a non-negative integer")
+                return off
+            # RANGE: numeric offset, compared in the ORDER BY key's space
+            if len(order) != 1:
+                raise TiDBError("RANGE frame with offset requires exactly one ORDER BY expression")
+            kft = order[0][0].ret_type
+            if not (kft.is_int() or kft.is_decimal() or kft.is_float()):
+                raise TiDBError("RANGE frame with offset requires a numeric ORDER BY expression")
+            d = e.value
+            if kft.is_decimal():
+                # pre-scale exactly into the key lane's scaled-int form
+                off = d.to_dec().rescale(max(kft.decimal, 0)).value
+            elif kft.is_float():
+                off = d.to_float()
+            else:
+                f = d.to_float()
+                off = d.to_int() if float(int(f)) == f else f
+            if (off if isinstance(off, (int, float)) else 0) < 0:
+                raise TiDBError("RANGE frame offset must be non-negative")
+            return off
+
+        so, eo = bound_off(fr.start, "start"), bound_off(fr.end, "end")
+        # same-kind offset ordering: (3 FOLLOWING .. 1 FOLLOWING) and
+        # (2 PRECEDING .. 5 PRECEDING) are errors, not empty frames
+        # (ref: MySQL ER_WINDOW_FRAME_START_ILLEGAL 3586)
+        if (fr.start.kind == fr.end.kind == "fol" and so > eo) or (
+            fr.start.kind == fr.end.kind == "pre" and so < eo
+        ):
+            raise TiDBError("window frame start cannot move after frame end")
+        return Frame(fr.unit, fr.start.kind, so, fr.end.kind, eo)
+
+    # frame clauses are accepted but ignored for these (SQL standard /
+    # ref planner: needFrame==false funcs always use the whole partition)
+    _FRAME_IGNORING = frozenset(
+        ("row_number", "rank", "dense_rank", "cume_dist", "percent_rank", "ntile", "lead", "lag")
+    )
 
     @staticmethod
     def _const_pos_int(c: Constant) -> bool:
