@@ -79,3 +79,80 @@ class TestBitAggregates:
     def test_empty_identities(self, s):
         rows = s.must_query("SELECT BIT_AND(v), BIT_OR(v), BIT_XOR(v) FROM t WHERE id > 999")
         assert rows == [(str(2**64 - 1), "0", "0")]
+
+
+class TestAdvancedAggregates:
+    """approx_count_distinct / approx_percentile / json_*agg (ref:
+    executor/aggfuncs/aggfuncs.go:45-53, statistics/fmsketch.go)."""
+
+    @pytest.fixture()
+    def t2(self):
+        sess = Session()
+        sess.execute("CREATE TABLE a2 (id INT PRIMARY KEY, g INT, v INT, s VARCHAR(10), d DECIMAL(6,2))")
+        rows = [
+            f"({i}, {i % 3}, {'NULL' if i % 17 == 0 else i % 29}, 'k{i % 7}', {i % 11}.25)"
+            for i in range(1500)
+        ]
+        sess.execute("INSERT INTO a2 VALUES " + ",".join(rows))
+        return sess
+
+    def test_approx_count_distinct_matches_exact(self, t2):
+        got = t2.must_query(
+            "SELECT g, COUNT(DISTINCT v), APPROX_COUNT_DISTINCT(v) FROM a2 GROUP BY g ORDER BY g"
+        )
+        for _, exact, approx in got:
+            assert exact == approx  # sketch is exact below its hashset cap
+
+    def test_approx_count_distinct_survives_region_split(self, t2):
+        from tidb_tpu.codec import tablecodec
+
+        before = t2.must_query("SELECT APPROX_COUNT_DISTINCT(s) FROM a2")
+        info = t2.infoschema().table("test", "a2")
+        t2.store.regions.split_many([tablecodec.record_key(info.id, h) for h in (500, 1000)])
+        assert t2.must_query("SELECT APPROX_COUNT_DISTINCT(s) FROM a2") == before
+
+    def test_approx_percentile(self, t2):
+        rows = t2.must_query("SELECT APPROX_PERCENTILE(v, 50), APPROX_PERCENTILE(v, 100) FROM a2")
+        assert rows[0][1] == "28"  # max of 0..28
+        p50 = int(rows[0][0])
+        assert 12 <= p50 <= 16
+        # decimal keeps the argument type/scale
+        assert t2.must_query("SELECT APPROX_PERCENTILE(d, 1) FROM a2")[0][0] == "0.25"
+
+    def test_approx_percentile_validation(self, t2):
+        import pytest as _pt
+
+        from tidb_tpu.errors import TiDBError
+
+        with _pt.raises(TiDBError):
+            t2.must_query("SELECT APPROX_PERCENTILE(v, 0) FROM a2")
+        with _pt.raises(TiDBError):
+            t2.must_query("SELECT APPROX_PERCENTILE(v, v) FROM a2")
+
+    def test_json_arrayagg(self, t2):
+        import json
+
+        got = t2.must_query("SELECT JSON_ARRAYAGG(v) FROM a2 WHERE id < 40 AND g = 0")
+        arr = json.loads(got[0][0])
+        want = [i % 29 if i % 17 else None for i in range(0, 40, 3)]
+        assert arr == want  # NULLs kept, order preserved
+        assert t2.must_query("SELECT JSON_ARRAYAGG(v) FROM a2 WHERE id < 0") == [(None,)]
+
+    def test_json_objectagg(self, t2):
+        import json
+
+        got = t2.must_query("SELECT JSON_OBJECTAGG(s, v) FROM a2 WHERE id BETWEEN 18 AND 24")
+        obj = json.loads(got[0][0])
+        assert obj["k4"] == 18  # id=18 → key k4, v=18
+        assert set(obj) == {f"k{i % 7}" for i in range(18, 25)}
+
+    def test_json_agg_in_group_by(self, t2):
+        import json
+
+        rows = t2.must_query(
+            "SELECT g, JSON_ARRAYAGG(s) FROM a2 WHERE id < 9 GROUP BY g ORDER BY g"
+        )
+        assert len(rows) == 3
+        for g, arr in rows:
+            vals = json.loads(arr)
+            assert vals == [f"k{i % 7}" for i in range(9) if i % 3 == int(g)]

@@ -1,0 +1,176 @@
+"""Builtin coverage vs the reference's ~279 function classes
+(ref: expression/builtin.go:599 `funcs` map) plus functional checks for
+the round-4 additions (JSON modify family, session info, user locks)."""
+
+import os
+import re
+
+import pytest
+
+from tidb_tpu.expr.expression import FUNCS
+from tidb_tpu.session import Session
+
+# Go ast.X identifier (lowercased) → SQL name, where CamelCase squashing
+# loses the underscores; identity for single-word names.
+GO_TO_SQL = {
+    "aesdecrypt": "aes_decrypt", "aesencrypt": "aes_encrypt", "anyvalue": "any_value",
+    "bintouuid": "bin_to_uuid", "bitcount": "bit_count", "bitlength": "bit_length",
+    "characterlength": "character_length", "charfunc": "char", "charlength": "char_length",
+    "concatws": "concat_ws", "connectionid": "connection_id", "converttz": "convert_tz",
+    "currentdate": "current_date", "currentrole": "current_role",
+    "currenttime": "current_time", "currenttimestamp": "current_timestamp",
+    "currentuser": "current_user", "dateadd": "date_add", "dateformat": "date_format",
+    "datesub": "date_sub", "defaultfunc": "default", "desdecrypt": "des_decrypt",
+    "desencrypt": "des_encrypt", "exportset": "export_set", "findinset": "find_in_set",
+    "formatbytes": "format_bytes", "formatnanotime": "format_nanotime",
+    "foundrows": "found_rows", "frombase64": "from_base64", "fromdays": "from_days",
+    "fromunixtime": "from_unixtime", "getformat": "get_format", "getlock": "get_lock",
+    "getparam": "getparam", "inet6aton": "inet6_aton", "inet6ntoa": "inet6_ntoa",
+    "inetaton": "inet_aton", "inetntoa": "inet_ntoa", "insertfunc": "insert",
+    "isfalsity": "isfalse", "isfreelock": "is_free_lock", "isipv4": "is_ipv4",
+    "isipv4compat": "is_ipv4_compat", "isipv4mapped": "is_ipv4_mapped",
+    "isipv6": "is_ipv6", "istruthwithnull": "istrue", "istruthwithoutnull": "istrue",
+    "isusedlock": "is_used_lock", "jsonarray": "json_array",
+    "jsonarrayappend": "json_array_append", "jsonarrayinsert": "json_array_insert",
+    "jsoncontains": "json_contains", "jsoncontainspath": "json_contains_path",
+    "jsondepth": "json_depth", "jsonextract": "json_extract", "jsoninsert": "json_insert",
+    "jsonkeys": "json_keys", "jsonlength": "json_length", "jsonmerge": "json_merge",
+    "jsonmergepatch": "json_merge_patch", "jsonmergepreserve": "json_merge_preserve",
+    "jsonobject": "json_object", "jsonpretty": "json_pretty", "jsonquote": "json_quote",
+    "jsonremove": "json_remove", "jsonreplace": "json_replace",
+    "jsonsearch": "json_search", "jsonset": "json_set",
+    "jsonstoragesize": "json_storage_size", "jsontype": "json_type",
+    "jsonunquote": "json_unquote", "jsonvalid": "json_valid", "lastday": "last_day",
+    "lastinsertid": "last_insert_id", "leftshift": "lshift", "loadfile": "load_file",
+    "logicand": "and", "logicor": "or", "logicxor": "xor", "makeset": "make_set",
+    "masterposwait": "master_pos_wait", "nameconst": "name_const",
+    "octetlength": "octet_length", "oldpassword": "old_password",
+    "passwordfunc": "password", "periodadd": "period_add", "perioddiff": "period_diff",
+    "randombytes": "random_bytes", "releasealllocks": "release_all_locks",
+    "releaselock": "release_lock", "rightshift": "rshift", "rowcount": "row_count",
+    "rowfunc": "row", "sectotime": "sec_to_time", "sessionuser": "session_user",
+    "strtodate": "str_to_date", "substringindex": "substring_index",
+    "systemuser": "system_user", "tidbboundedstaleness": "tidb_bounded_staleness",
+    "tidbdecodekey": "tidb_decode_key", "tidbdecodeplan": "tidb_decode_plan",
+    "tidbdecodesqldigests": "tidb_decode_sql_digests",
+    "tidbisddlowner": "tidb_is_ddl_owner", "tidbparsetso": "tidb_parse_tso",
+    "tidbversion": "tidb_version", "timeformat": "time_format",
+    "timetosec": "time_to_sec", "tobase64": "to_base64", "todays": "to_days",
+    "toseconds": "to_seconds", "uncompressedlength": "uncompressed_length",
+    "unixtimestamp": "unix_timestamp", "unarynot": "not", "utcdate": "utc_date",
+    "utctime": "utc_time", "utctimestamp": "utc_timestamp", "uuidshort": "uuid_short",
+    "uuidtobin": "uuid_to_bin",
+    "validatepasswordstrength": "validate_password_strength",
+    "vitesshash": "vitess_hash", "weightstring": "weight_string",
+}
+
+# surfaces covered outside the scalar-function registry: dedicated parser/
+# planner paths (CAST family, DEFAULT, sequences, row constructors, typed
+# literals, @var assignment) — present, just not FUNCS entries
+NON_REGISTRY = {
+    "convert": "parser cast_expr", "default": "parser ast.Default",
+    "nextval": "planner _SeqExpr", "lastval": "planner _SeqExpr",
+    "setval": "planner _SeqExpr", "row": "row constructor in comparisons",
+    "dateliteral": "parser DATE 'x'", "timeliteral": "parser TIME 'x'",
+    "timestampliteral": "parser TIMESTAMP 'x'", "setvar": "@var := parser",
+    "getparam": "prepared-stmt params",
+}
+
+# decided gaps (deprecated in MySQL 8 / need replication or DES infra):
+# documented here so coverage arithmetic is explicit, not silent
+DECIDED_OUT = {
+    "des_decrypt", "des_encrypt", "encrypt", "old_password", "master_pos_wait",
+    "vitess_hash", "tidb_decode_plan", "tidb_decode_sql_digests", "benchmark",
+}
+
+
+def reference_names():
+    path = "/root/reference/expression/builtin.go"
+    if not os.path.exists(path):
+        pytest.skip("reference tree not mounted")
+    src = open(path).read()
+    m = re.search(r"var funcs = map\[string\]functionClass\{(.*?)\n\}", src, re.S)
+    idents = re.findall(r"ast\.(\w+):", m.group(1))
+    return sorted({GO_TO_SQL.get(i.lower(), i.lower()) for i in idents})
+
+
+def test_registry_reaches_250():
+    assert len(FUNCS) >= 250, f"registry has {len(FUNCS)} builtins, target >= 250"
+
+
+def test_reference_list_coverage():
+    ref = reference_names()
+    missing = [
+        n for n in ref
+        if n not in FUNCS and n not in NON_REGISTRY and n not in DECIDED_OUT
+    ]
+    covered = len(ref) - len(missing)
+    assert covered >= 250, (
+        f"cover {covered}/{len(ref)} of the reference list; missing: {missing}"
+    )
+    # the remainder should be small and enumerable — fail if it regresses
+    assert len(missing) <= 10, missing
+
+
+class TestNewBuiltinsFunctional:
+    @pytest.fixture()
+    def s(self):
+        return Session()
+
+    def test_json_modify_family(self, s):
+        q = s.must_query
+        assert q("""SELECT JSON_SET('{"a":1}', '$.b', 2)""")[0][0] == '{"a": 1, "b": 2}'
+        assert q("""SELECT JSON_INSERT('{"a":1}', '$.a', 9)""")[0][0] == '{"a": 1}'
+        assert q("""SELECT JSON_REPLACE('{"a":1}', '$.b', 9)""")[0][0] == '{"a": 1}'
+        assert q("""SELECT JSON_REMOVE('{"a":1,"b":2}', '$.a')""")[0][0] == '{"b": 2}'
+        assert q("SELECT JSON_ARRAY_APPEND('[1]', '$', 2)")[0][0] == "[1, 2]"
+        assert q("SELECT JSON_ARRAY_INSERT('[1,3]', '$[1]', 2)")[0][0] == "[1, 2, 3]"
+        assert q("""SELECT JSON_MERGE_PATCH('{"a":1}', '{"a":null,"b":2}')""")[0][0] == '{"b": 2}'
+        assert q("SELECT JSON_MERGE('[1]', '2')")[0][0] == "[1, 2]"
+        assert q("""SELECT JSON_CONTAINS_PATH('{"a":1}', 'all', '$.a', '$.b')""")[0][0] == "0"
+        assert q("""SELECT JSON_DEPTH('{"a":[1]}')""")[0][0] == "3"
+        assert q("""SELECT JSON_SEARCH('["ab","cd"]', 'one', 'a%')""")[0][0] == '"$[0]"'
+        assert q("SELECT JSON_STORAGE_SIZE('[1,2]')")[0][0] == "6"
+
+    def test_info_functions(self, s):
+        q = s.must_query
+        assert q("SELECT VERSION()")[0][0].startswith("8.0.11")
+        assert "TPU" in q("SELECT TIDB_VERSION()")[0][0]
+        assert q("SELECT DATABASE()")[0][0] == "test"
+        assert q("SELECT CURRENT_USER()")[0][0] == "root@%"
+        assert int(q("SELECT CONNECTION_ID()")[0][0]) >= 0
+        s.execute("CREATE TABLE rc (a INT)")
+        s.execute("INSERT INTO rc VALUES (1),(2)")
+        assert q("SELECT ROW_COUNT()")[0][0] == "2"
+        s.must_query("SELECT * FROM rc")
+        assert q("SELECT FOUND_ROWS()")[0][0] == "2"
+
+    def test_user_locks(self, s):
+        q = s.must_query
+        assert q("SELECT GET_LOCK('lk', 0)")[0][0] == "1"
+        assert q("SELECT GET_LOCK('lk', 0)")[0][0] == "1"  # reentrant
+        assert q("SELECT IS_FREE_LOCK('lk')")[0][0] == "0"
+        assert q("SELECT IS_USED_LOCK('lk')")[0][0] == str(s.conn_id)
+        s2 = Session(s.store)
+        assert s2.must_query("SELECT GET_LOCK('lk', 0)")[0][0] == "0"  # held elsewhere
+        assert q("SELECT RELEASE_LOCK('lk')")[0][0] == "1"
+        assert q("SELECT RELEASE_LOCK('lk')")[0][0] == "1"
+        assert q("SELECT IS_FREE_LOCK('lk')")[0][0] == "1"
+        assert q("SELECT RELEASE_LOCK('nope')")[0][0] is None
+
+    def test_misc_tail(self, s):
+        q = s.must_query
+        assert q("SELECT BIT_COUNT(255)")[0][0] == "8"
+        assert q("SELECT MID('abcdef', 2, 3)")[0][0] == "bcd"
+        assert q("SELECT OCTET_LENGTH('héllo'), CHARACTER_LENGTH('héllo')")[0] == ("6", "5")
+        assert q("SELECT TRANSLATE('12345', '143', 'ax')")[0][0] == "a2x5"
+        assert q("SELECT INTERVAL(23, 1, 15, 17, 30, 44, 200)")[0][0] == "3"
+        # parenthesized date-arithmetic INTERVAL must still disambiguate
+        assert q("SELECT DATE_ADD('2024-01-01', INTERVAL (2) DAY)")[0][0].startswith("2024-01-03")
+        u = "6ccd780c-baba-1026-9564-5b8c656024db"
+        assert q(f"SELECT BIN_TO_UUID(UUID_TO_BIN('{u}'))")[0][0] == u
+        assert q("SELECT FORMAT_BYTES(1024)")[0][0] == "1.00 KiB"
+        assert q("SELECT DECODE(ENCODE('abc', 'k'), 'k')")[0][0] == "abc"
+        assert q("SELECT 'abcd' REGEXP 'b.d'")[0][0] == "1"
+        assert q("SELECT TIDB_PARSE_TSO(424020151386112000)")[0][0].startswith("20")
+        assert q("SELECT GET_FORMAT('TIME', 'EUR')")[0][0] == "%H.%i.%s"

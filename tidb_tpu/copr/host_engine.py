@@ -324,6 +324,21 @@ def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.nda
         yield Column(out_fts[oi + 1], s, ones)
         yield Column(out_fts[oi + 2], sq, ones)
         return
+    if name == "approx_count_distinct":
+        # per-group FM sketch, shipped serialized; the root final unions
+        # them (ref: aggfuncs approxCountDistinctPartial1, fmsketch.go)
+        from ..statistics.cmsketch import hash_values
+        from ..statistics.fmsketch import FMSketch
+
+        hashes = hash_values(dv)
+        out = np.empty(G, dtype=object)
+        for g in range(G):
+            sel_g = (inv == g) & vv
+            sk = FMSketch()
+            sk.insert_hashes(np.asarray(hashes[sel_g], dtype=np.uint64))
+            out[g] = sk.serialize()
+        yield Column(out_fts[oi], out, np.ones(G, dtype=bool))
+        return
     if name in ("bit_and", "bit_or", "bit_xor"):
         if dv.dtype == object:
             from ..errors import TiDBError

@@ -448,16 +448,16 @@ def _json_parse(s):
         return None
 
 
-def _json_path_get(doc, path: str):
-    """Subset of JSON path: $, .key, ."quoted", [i], [*]. Returns a list of
-    matches (for [*]) or a single value wrapped in a list."""
+def _json_path_tokens(path: str):
+    """Tokenize a JSON path: $, .key, ."quoted", [i], [*] →
+    [('key', k) | ('idx', i) | ('wild',)] — the ONE path scanner shared by
+    the read (json_extract) and modify (json_set/remove/...) families."""
     from ..errors import TiDBError
 
     if not path.startswith("$"):
         raise TiDBError(f"Invalid JSON path expression {path!r}")
-    cur = [doc]
-    i = 1
-    n = len(path)
+    toks = []
+    i, n = 1, len(path)
     while i < n:
         c = path[i]
         if c == ".":
@@ -466,15 +466,16 @@ def _json_path_get(doc, path: str):
                 j = path.find('"', i + 1)
                 if j < 0:
                     raise TiDBError(f"Invalid JSON path expression {path!r}")
-                key = path[i + 1 : j]
+                toks.append(("key", path[i + 1 : j]))
                 i = j + 1
             else:
                 j = i
                 while j < n and (path[j].isalnum() or path[j] == "_"):
                     j += 1
-                key = path[i:j]
+                if j == i:
+                    raise TiDBError(f"Invalid JSON path expression {path!r}")
+                toks.append(("key", path[i:j]))
                 i = j
-            cur = [d[key] for d in cur if isinstance(d, dict) and key in d]
         elif c == "[":
             j = path.find("]", i)
             if j < 0:
@@ -482,19 +483,34 @@ def _json_path_get(doc, path: str):
             tok = path[i + 1 : j].strip()
             i = j + 1
             if tok == "*":
-                nxt = []
-                for d in cur:
-                    if isinstance(d, list):
-                        nxt.extend(d)
-                cur = nxt
+                toks.append(("wild",))
             else:
                 try:
-                    idx = int(tok)
+                    toks.append(("idx", int(tok)))
                 except ValueError:
                     raise TiDBError(f"Invalid JSON path expression {path!r}")
-                cur = [d[idx] for d in cur if isinstance(d, list) and -len(d) <= idx < len(d)]
         else:
             raise TiDBError(f"Invalid JSON path expression {path!r}")
+    return toks
+
+
+def _json_path_get(doc, path: str):
+    """Subset of JSON path: $, .key, ."quoted", [i], [*]. Returns a list of
+    matches (for [*]) or a single value wrapped in a list."""
+    cur = [doc]
+    for t in _json_path_tokens(path):
+        if t[0] == "key":
+            key = t[1]
+            cur = [d[key] for d in cur if isinstance(d, dict) and key in d]
+        elif t[0] == "idx":
+            idx = t[1]
+            cur = [d[idx] for d in cur if isinstance(d, list) and -len(d) <= idx < len(d)]
+        else:
+            nxt = []
+            for d in cur:
+                if isinstance(d, list):
+                    nxt.extend(d)
+            cur = nxt
     return cur
 
 

@@ -304,6 +304,9 @@ def _re_compile(pat):
 
 register(FuncSig("regexp_like", lambda fts: ft_longlong(), _obj_map(
     lambda s, p: 1 if _re_compile(p).search(_as_str(s)) else 0), pushable=False, arity=2))
+# the REGEXP/RLIKE operator desugars to the same kernel (ref: builtin.go ast.Regexp)
+register(FuncSig("regexp", lambda fts: ft_longlong(), _obj_map(
+    lambda s, p: 1 if _re_compile(p).search(_as_str(s)) else 0), pushable=False, arity=2))
 register(FuncSig("regexp_replace", lambda fts: ft_varchar(), _obj_map(
     lambda s, p, r: _re_compile(p).sub(_as_str(r), _as_str(s))), pushable=False, arity=3))
 
@@ -676,3 +679,5 @@ def _extract(unit, v):
 
 
 register(FuncSig("extract", lambda fts: ft_longlong(), _obj_map(_extract), pushable=False, arity=2))
+
+from . import builtins_ext3  # noqa: E402,F401  (registration side effects)

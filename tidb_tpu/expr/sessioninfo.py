@@ -1,0 +1,21 @@
+"""Per-session info visible to builtin kernels (ref: sessionctx.Context
+reaching builtin_info.go via the expression EvalContext).
+
+The Session publishes a mutable dict through a contextvar at construction
+and keeps it current per statement; info builtins (USER(), FOUND_ROWS(),
+GET_LOCK(), ...) read it at eval time. Defaults keep the kernels usable
+outside a session (tests, direct expression eval)."""
+
+from __future__ import annotations
+
+import contextvars
+
+CURRENT: contextvars.ContextVar[dict] = contextvars.ContextVar("tidb_session_info")
+
+
+def get(key: str, default=None):
+    try:
+        info = CURRENT.get()
+    except LookupError:
+        return default
+    return info.get(key, default)

@@ -105,6 +105,12 @@ class Session:
 
         self.conn_id = next(Session._conn_counter)
         self._in_bootstrap = False
+        # info published to builtin kernels (USER(), FOUND_ROWS(), ...)
+        # via the expr.sessioninfo contextvar (ref: builtin_info.go)
+        self._info = {
+            "user": self.user, "conn_id": self.conn_id, "db": self.current_db,
+            "found_rows": 0, "row_count": -1, "last_insert_id": 0,
+        }
         self._bootstrap()
 
     _conn_counter = __import__("itertools").count(1)
@@ -290,12 +296,22 @@ class Session:
                 "start": time.time(),
                 "session": weakref.ref(self),
             })
+        from ..expr import sessioninfo as _si
+
+        self._info.update(user=self.user, conn_id=self.conn_id, db=self.current_db)
+        itok = _si.CURRENT.set(self._info)
         t0 = time.perf_counter()
         c0 = time.thread_time()  # Top-SQL CPU attribution by digest
         ok = True
         try:
             rs = self._execute_stmt(stmt, sql=sql)
             self._finish_stmt()
+            if rs.chunk is not None and rs.names:
+                self._info["found_rows"] = rs.chunk.num_rows
+                self._info["row_count"] = -1
+            else:
+                self._info["row_count"] = rs.affected
+            self._info["last_insert_id"] = self.last_insert_id
             return rs
         except Exception:
             ok = False
@@ -306,6 +322,7 @@ class Session:
         finally:
             _ACTIVE_TRACKER.reset(token)
             _ACTIVE_SESSION.reset(stok)
+            _si.CURRENT.reset(itok)
             dur = time.perf_counter() - t0
             cpu = time.thread_time() - c0
             if not self._in_bootstrap:

@@ -35,3 +35,21 @@ class FMSketch:
         while len(self.hashset) > self.max_size:
             self.mask = np.uint64((int(self.mask) << 1) | 1)
             self.hashset = {x for x in self.hashset if x & int(self.mask) == 0}
+
+    def serialize(self) -> bytes:
+        """Wire form for APPROX_COUNT_DISTINCT partial transport: little-
+        endian mask then the hash set (ref: aggfuncs approx_count_distinct
+        partial encoding)."""
+        import struct
+
+        hs = np.array(sorted(self.hashset), dtype=np.uint64)
+        return struct.pack("<Q", int(self.mask)) + hs.tobytes()
+
+    @staticmethod
+    def deserialize(b: bytes, max_size: int = 10000) -> "FMSketch":
+        import struct
+
+        sk = FMSketch(max_size)
+        sk.mask = np.uint64(struct.unpack_from("<Q", b)[0])
+        sk.hashset = set(np.frombuffer(b[8:], dtype=np.uint64).tolist())
+        return sk
