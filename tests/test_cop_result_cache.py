@@ -1,0 +1,105 @@
+"""Coprocessor result cache (ref: store/copr/coprocessor_cache.go:31,60):
+repeated identical (DAG, range) reads serve from memory; any committed
+write to the table (bump_version) invalidates; historic snapshots below
+the last commit never hit; admission rejects tiny scans and huge results."""
+
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, v INT)")
+    rows = ",".join(f"({i}, {i % 5}, {i % 97})" for i in range(10000))
+    sess.execute(f"INSERT INTO t VALUES {rows}")
+    return sess
+
+
+AGG = "SELECT g, COUNT(*), SUM(v) FROM t GROUP BY g ORDER BY g"
+
+
+def test_repeat_read_hits_cache(s):
+    rc = s.cop.results
+    first = s.must_query(AGG)
+    h0 = rc.hits
+    for _ in range(3):
+        assert s.must_query(AGG) == first
+    assert rc.hits >= h0 + 3
+
+
+def test_write_invalidates(s):
+    rc = s.cop.results
+    before = s.must_query(AGG)
+    s.must_query(AGG)
+    assert rc.hits > 0
+    s.execute("INSERT INTO t VALUES (10000, 0, 1)")
+    h = rc.hits
+    after = s.must_query(AGG)
+    assert rc.hits == h  # version bumped: recompute, no hit
+    assert after != before
+    # and the NEW result caches again
+    assert s.must_query(AGG) == after
+    assert rc.hits == h + 1
+
+
+def test_update_and_delete_invalidate(s):
+    base = s.must_query(AGG)
+    s.must_query(AGG)
+    s.execute("UPDATE t SET v = v + 1 WHERE id = 7")
+    a = s.must_query(AGG)
+    assert a != base
+    s.execute("DELETE FROM t WHERE id = 7")
+    b = s.must_query(AGG)
+    assert b != a
+
+
+def test_historic_snapshot_does_not_hit(s):
+    rc = s.cop.results
+    s.must_query(AGG)
+    s.must_query(AGG)
+    # a txn pinned BEFORE a later write must not see the later cache entry
+    s.execute("BEGIN")
+    old = s.must_query(AGG)
+    s2 = Session(s.store)
+    s2.execute("INSERT INTO t VALUES (20000, 0, 50)")
+    h = rc.hits
+    again = s.must_query(AGG)  # read_ts < new last_commit: rebuild
+    assert again == old
+    s.execute("COMMIT")
+    fresh = s.must_query(AGG)
+    assert fresh != old
+    assert rc.hits >= h  # no wrong-hit crash; correctness is the assert above
+
+
+def test_admission_rejects_small_scans(s):
+    rc = s.cop.results
+    s.execute("CREATE TABLE tiny (a INT)")
+    s.execute("INSERT INTO tiny VALUES (1),(2),(3)")
+    s.must_query("SELECT SUM(a) FROM tiny")
+    h = rc.hits
+    s.must_query("SELECT SUM(a) FROM tiny")
+    assert rc.hits == h  # 3-row scan is below the admission floor
+
+
+def test_engines_cache_separately(s):
+    rc = s.cop.results
+    s.execute("SET tidb_cop_engine = 'host'")
+    host = s.must_query(AGG)
+    s.execute("SET tidb_cop_engine = 'tpu'")
+    h = rc.hits
+    dev = s.must_query(AGG)  # must COMPUTE on device, not reuse host entry
+    assert rc.hits == h
+    assert dev == host
+    s.execute("SET tidb_cop_engine = 'auto'")
+
+
+def test_disable_via_sysvar(s):
+    rc = s.cop.results
+    s.execute("SET tidb_enable_cop_result_cache = 'OFF'")
+    s.must_query(AGG)
+    h = rc.hits
+    s.must_query(AGG)
+    assert rc.hits == h
+    s.execute("SET tidb_enable_cop_result_cache = 'ON'")

@@ -45,10 +45,54 @@ class CopTask:
 MAX_REGION_RETRY = 4
 
 
+class CopResultCache:
+    """Per-task result cache (ref: store/copr/coprocessor_cache.go:31,60
+    — ristretto LRU with admission rules, redesigned over this store's
+    version counters). Keyed (DAG digest, table, range); an entry is
+    valid while the table's data version is unchanged and the read
+    timestamp is at/after the version's commit (the tile-cache snapshot
+    rule), so `bump_version` on any committed write invalidates it.
+    Admission mirrors the reference's min-process-time / max-result-size
+    gates with row counts: only tasks that scanned enough rows AND
+    produced a small result are worth pinning."""
+
+    CAPACITY = 256
+    ADMIT_MIN_SCAN_ROWS = 4096  # the admission-min-process-time analog
+    ADMIT_MAX_RESULT_ROWS = 20480  # the admission-max-result-bytes analog
+
+    def __init__(self):
+        from collections import OrderedDict
+
+        self._od: "OrderedDict" = OrderedDict()
+        self._lock = Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key, ver, read_ts):
+        with self._lock:
+            e = self._od.get(key)
+            if e is None or e[1] != ver or read_ts < e[2]:
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            self.hits += 1
+            return e[0]
+
+    def put(self, key, chunk, ver, min_valid_ts, scan_rows: int):
+        if scan_rows < self.ADMIT_MIN_SCAN_ROWS or chunk.num_rows > self.ADMIT_MAX_RESULT_ROWS:
+            return
+        with self._lock:
+            self._od[key] = (chunk, ver, min_valid_ts)
+            self._od.move_to_end(key)
+            while len(self._od) > self.CAPACITY:
+                self._od.popitem(last=False)
+
+
 class CopClient:
     def __init__(self, storage):
         self.storage = storage
         self.tiles = TileCache(storage)
+        self.results = CopResultCache()
         self._tpu = None
         self._pool = None
         self._lock = Lock()  # guards lazy singletons + stats counters
@@ -118,6 +162,7 @@ class CopClient:
         txn=None,
         concurrency: int = 1,
         keep_order: bool = True,
+        result_cache: bool = True,
     ):
         """Execute the DAG over all tasks; yields per-task partial chunks
         lazily (the selectResult/copIterator stream analog — caller
@@ -150,14 +195,14 @@ class CopClient:
                 out.append(self._run_engines(dag, batch, engine))
             return out
         if concurrency <= 1 or len(tasks) <= 1:
-            return self._send_serial(table, dag, tasks, read_ts, engine)
-        return self._send_parallel(table, dag, tasks, read_ts, engine, concurrency, keep_order)
+            return self._send_serial(table, dag, tasks, read_ts, engine, result_cache)
+        return self._send_parallel(table, dag, tasks, read_ts, engine, concurrency, keep_order, result_cache)
 
-    def _send_serial(self, table, dag, tasks, read_ts, engine):
+    def _send_serial(self, table, dag, tasks, read_ts, engine, result_cache=True):
         for t in tasks:
-            yield from self._run_task(table, dag, t, read_ts, engine)
+            yield from self._run_task(table, dag, t, read_ts, engine, cache=result_cache)
 
-    def _send_parallel(self, table, dag, tasks, read_ts, engine, concurrency, keep_order):
+    def _send_parallel(self, table, dag, tasks, read_ts, engine, concurrency, keep_order, result_cache=True):
         """Bounded in-flight window (the copIterator concurrency semantic):
         at most `concurrency` tasks run/buffer ahead of the consumer, new
         tasks are submitted as results drain, and abandoning the stream
@@ -168,7 +213,9 @@ class CopClient:
         def submit_next():
             t = next(it, None)
             if t is not None:
-                futs.append(self.pool.submit(self._run_task, table, dag, t, read_ts, engine))
+                futs.append(
+                    self.pool.submit(self._run_task, table, dag, t, read_ts, engine, cache=result_cache)
+                )
 
         for _ in range(min(concurrency, len(tasks))):
             submit_next()
@@ -186,9 +233,11 @@ class CopClient:
             for f in futs:
                 f.cancel()
 
-    def _run_task(self, table, dag, t: CopTask, read_ts, engine, depth: int = 0) -> list[Chunk]:
+    def _run_task(self, table, dag, t: CopTask, read_ts, engine, depth: int = 0, cache: bool = True) -> list[Chunk]:
         """Execute one cop task, re-splitting on region epoch change
-        (ref: handleCopResponse region-error path, coprocessor.go:1025)."""
+        (ref: handleCopResponse region-error path, coprocessor.go:1025);
+        repeated identical (DAG, range) reads serve from the result cache
+        while the table version holds (ref: coprocessor_cache.go)."""
         _fp("cop/before-task")
         region = self.storage.regions.locate(t.start)
         stale = (
@@ -202,12 +251,22 @@ class CopClient:
                 raise RuntimeError(f"cop task {t} exceeded region retry budget")
             out = []
             for sub in self.build_tasks(None, [(t.start, t.end)]):
-                out.extend(self._run_task(table, dag, sub, read_ts, engine, depth + 1))
+                out.extend(self._run_task(table, dag, sub, read_ts, engine, depth + 1, cache=cache))
             return out
+        ckey = ver = last_commit = None
+        if cache:
+            ver, last_commit = self.storage.data_version(tablecodec.table_prefix(table.id))
+            ckey = (dag.digest(), table.id, t.start, t.end, engine != "host")
+            hit = self.results.get(ckey, ver, read_ts)
+            if hit is not None:
+                return [hit]
         batch = self.tiles.get_batch(table, t.start, t.end, read_ts)
         if batch.n_rows == 0:
             return []
-        return [self._run_engines(dag, batch, engine)]
+        chunk = self._run_engines(dag, batch, engine)
+        if cache and read_ts >= last_commit:
+            self.results.put(ckey, chunk, ver, last_commit, batch.n_rows)
+        return [chunk]
 
     # --- engine dispatch over an arbitrary batch --------------------------
 

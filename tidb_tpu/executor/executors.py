@@ -337,9 +337,10 @@ class TableReaderExec(Executor):
 
     def open(self):
         conc = int(self.ctx.vars.get("tidb_distsql_scan_concurrency", "15"))
+        rcache = self.ctx.vars.get("tidb_enable_cop_result_cache", "ON") in ("ON", "1", 1)
         self._results = self.ctx.cop.send(
             self.table, self.dag, self.ranges, self.ctx.read_ts, self.ctx.engine,
-            txn=self.ctx.txn, concurrency=conc,
+            txn=self.ctx.txn, concurrency=conc, result_cache=rcache,
         )
         self._iter = iter(self._results)
 
@@ -363,6 +364,7 @@ class PartitionReaderExec(TableReaderExec):
         import itertools
 
         conc = int(self.ctx.vars.get("tidb_distsql_scan_concurrency", "15"))
+        rcache = self.ctx.vars.get("tidb_enable_cop_result_cache", "ON") in ("ON", "1", 1)
         results = []
         for pd in self.parts:
             phys = self.table.partition_physical(pd.id)
@@ -373,7 +375,7 @@ class PartitionReaderExec(TableReaderExec):
             results.append(
                 self.ctx.cop.send(
                     phys, self.dag, None, self.ctx.read_ts, self.ctx.engine,
-                    txn=self.ctx.txn, concurrency=conc,
+                    txn=self.ctx.txn, concurrency=conc, result_cache=rcache,
                 )
             )
         self._results = results
@@ -1935,11 +1937,11 @@ class HashJoinExec(Executor):
         if not rchunk.num_cols:
             rchunk = Chunk.empty(rfts, 0)
         del rcs
-        table = self._build_table(rchunk, len(lfts))
         matched_right = np.zeros(rchunk.num_rows, dtype=bool) if self.kind == "right" else None
+        build = self._build_vec(rchunk, len(lfts))  # factorize build ONCE
         for lc in lsf.chunks(lfts):
             self._check_kill()
-            out = self._probe_emit(lc, rchunk, table, matched_right)
+            out = self._probe_pair_vec(lc, rchunk, matched_right, build=build)
             if out is not None and out.num_rows:
                 yield out
         if matched_right is not None:
@@ -1949,14 +1951,148 @@ class HashJoinExec(Executor):
 
     def _join_pair(self, lchunk: Chunk, rchunk: Chunk) -> Chunk:
         nl = lchunk.num_cols
-
-        table = self._build_table(rchunk, nl)
         matched_right = np.zeros(rchunk.num_rows, dtype=bool) if self.kind == "right" else None
-        out = self._probe_emit(lchunk, rchunk, table, matched_right)
+        if self.eq_conds:
+            out = self._probe_pair_vec(lchunk, rchunk, matched_right)
+        else:
+            table = self._build_table(rchunk, nl)
+            out = self._probe_emit(lchunk, rchunk, table, matched_right)
         if matched_right is not None:
             pad = self._right_pad(lchunk, rchunk, matched_right)
             if pad is not None:
                 out = out.concat(pad)
+        return out
+
+    # --- vectorized equi-join core (replaces the per-row python build/
+    # probe; the reference parallelizes the same loops with worker fleets,
+    # join.go:413 — numpy lanes are the idiomatic host equivalent) --------
+
+    def _encode_join_keys(self, lchunk: Chunk, rchunk: Chunk):
+        """Joint factorization of the eq-key lanes of BOTH sides into one
+        code space → (lcodes, lvalid, rcodes, rvalid); equal values get
+        equal int64 codes, NULLs are invalid (never match)."""
+        from ..copr.host_engine import _lane_codes
+        from ..planner.optimizer import _shift_expr
+
+        nl = lchunk.num_cols
+        nL, nR = lchunk.num_rows, rchunk.num_rows
+        lanes = []
+        valid = np.ones(nL + nR, dtype=bool)
+        for l_e, r_e in self.eq_conds:
+            ld, lv = _broadcast_lane(*l_e.eval(lchunk), nL)
+            rd, rv = _broadcast_lane(*_shift_expr(r_e, -nl).eval(rchunk), nR)
+            if (ld.dtype == object) != (rd.dtype == object):
+                ld, rd = ld.astype(object), rd.astype(object)
+            both = np.concatenate([ld, rd])
+            bv = np.concatenate([lv, rv])
+            codes = _lane_codes(both, bv)
+            lanes.append(codes)
+            valid &= codes > 0
+        packed = np.zeros(nL + nR, dtype=np.int64)
+        total, ok = 1, True
+        for lane in lanes:
+            rng = int(lane.max()) + 1 if len(lane) else 1
+            if total > (1 << 62) // max(rng, 1):
+                ok = False
+                break
+            packed = packed * rng + lane
+            total *= rng
+        if not ok:  # range-product overflow: lexicographic unique instead
+            _, inv = np.unique(np.stack(lanes), axis=1, return_inverse=True)
+            packed = inv.astype(np.int64) + 1
+        return packed[:nL], valid[:nL], packed[nL:], valid[nL:]
+
+    def _build_vec(self, rchunk: Chunk, nl: int):
+        """Hoistable build-side factorization for streamed probing (the
+        grace path): per-lane sorted uniques + packed sorted build codes.
+        Returns None for object lanes or radix overflow — the caller then
+        falls back to per-chunk joint encoding."""
+        from ..planner.optimizer import _shift_expr
+
+        nR = rchunk.num_rows
+        lanes = []
+        packed = np.zeros(nR, dtype=np.int64)
+        valid = np.ones(nR, dtype=bool)
+        total = 1
+        for _, r_e in self.eq_conds:
+            rd, rv = _broadcast_lane(*_shift_expr(r_e, -nl).eval(rchunk), nR)
+            if rd.dtype == object:
+                return None
+            uniq = np.unique(rd[rv])
+            rng = len(uniq) + 1
+            if total > (1 << 62) // max(rng, 1):
+                return None
+            code = np.where(rv, np.searchsorted(uniq, rd) + 1, 0)
+            valid &= code > 0
+            packed = packed * rng + code
+            total *= rng
+            lanes.append(uniq)
+        rk_eff = np.where(valid, packed, -1)
+        order = np.argsort(rk_eff, kind="stable")
+        return lanes, rk_eff[order], order
+
+    def _probe_codes(self, build, lchunk: Chunk):
+        """Map one probe chunk into a hoisted build's code space; probe
+        values absent from the build get the no-match sentinel."""
+        lanes, _, _ = build
+        nL = lchunk.num_rows
+        lk = np.zeros(nL, dtype=np.int64)
+        match = np.ones(nL, dtype=bool)
+        for (l_e, _), uniq in zip(self.eq_conds, lanes):
+            ld, lv = _broadcast_lane(*l_e.eval(lchunk), nL)
+            if ld.dtype == object:
+                return None
+            nu = len(uniq)
+            pos = np.searchsorted(uniq, ld)
+            posc = np.minimum(pos, max(nu - 1, 0))
+            hit = lv & (pos < nu) & ((uniq[posc] == ld) if nu else False)
+            match &= hit
+            lk = lk * (nu + 1) + np.where(hit, pos + 1, 0)
+        return np.where(match, lk, -2)
+
+    def _probe_pair_vec(self, lchunk: Chunk, rchunk: Chunk, matched_right, build=None) -> Chunk:
+        """Sort-probe equi-join of one (probe chunk, build chunk) pair:
+        argsort the build codes, searchsorted the probe codes, expand the
+        hit ranges with repeat arithmetic. Emission order matches the
+        per-row reference loop (probe order, build rows ascending,
+        left-outer misses interleaved in place)."""
+        nL, nR = lchunk.num_rows, rchunk.num_rows
+        lk_eff = self._probe_codes(build, lchunk) if build is not None else None
+        if lk_eff is not None:
+            _, rs, order = build
+        else:
+            lk, lval, rk, rval = self._encode_join_keys(lchunk, rchunk)
+            order = np.argsort(np.where(rval, rk, -1), kind="stable")
+            rs = np.where(rval, rk, -1)[order]
+            lk_eff = np.where(lval, lk, -2)  # NULL probes match nothing
+        starts = np.searchsorted(rs, lk_eff, side="left")
+        ends = np.searchsorted(rs, lk_eff, side="right")
+        counts = ends - starts
+        miss = counts == 0
+        if self.kind == "left":
+            counts_eff = np.where(miss, 1, counts)
+        else:
+            counts_eff = counts
+            miss = np.zeros(nL, dtype=bool)
+        total = int(counts_eff.sum())
+        li_arr = np.repeat(np.arange(nL, dtype=np.int64), counts_eff)
+        cum = np.zeros(nL, dtype=np.int64)
+        if nL:
+            np.cumsum(counts_eff[:-1], out=cum[1:])
+        within = np.arange(total, dtype=np.int64) - np.repeat(cum, counts_eff)
+        pos = np.repeat(starts, counts_eff) + within
+        if nR:
+            ri_arr = order[np.minimum(pos, nR - 1)]
+        else:
+            ri_arr = np.zeros(total, dtype=np.int64)
+        ri_arr = np.where(np.repeat(miss, counts_eff), -1, ri_arr)
+        li_out, ri_out = li_arr.tolist(), ri_arr.tolist()
+        out = _assemble_join(lchunk, rchunk, li_out, ri_out, self.out_fts)
+        if self.other_conds:
+            out, li_out, ri_out = self._apply_other(out, lchunk, rchunk, li_out, ri_out)
+            ri_arr = np.asarray(ri_out, dtype=np.int64)
+        if matched_right is not None and len(ri_arr):
+            matched_right[ri_arr[ri_arr >= 0]] = True
         return out
 
     def _build_table(self, rchunk: Chunk, nl: int) -> dict:
@@ -2034,6 +2170,16 @@ class HashJoinExec(Executor):
         from ..planner.optimizer import _shift_expr
 
         nl = lchunk.num_cols
+        n = lchunk.num_rows
+        if n == 0:
+            return lchunk
+        if self.eq_conds and self.na_key is None and not self.other_conds:
+            # vectorized EXISTS/NOT EXISTS: hit = any equal build key
+            lk, lval, rk, rval = self._encode_join_keys(lchunk, rchunk)
+            rs = np.sort(np.where(rval, rk, -1))
+            lk_eff = np.where(lval, lk, -2)
+            hit = np.searchsorted(rs, lk_eff, "right") > np.searchsorted(rs, lk_eff, "left")
+            return lchunk.filter(hit if self.kind == "semi" else ~hit)
         lkeys = [l for l, _ in self.eq_conds]
         rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
         table: dict = {}
@@ -2043,9 +2189,6 @@ class HashJoinExec(Executor):
                 kt = _key_tuple(key_lanes, j)
                 if kt is not None:
                     table.setdefault(kt, []).append(j)
-        n = lchunk.num_rows
-        if n == 0:
-            return lchunk
         lkey_lanes = [k.eval(lchunk) for k in lkeys]
         na_l = na_r = None
         if self.na_key is not None:

@@ -279,6 +279,27 @@ register(
 )
 
 
+def _json_subdocs(doc, path: str):
+    """[(path string, value)] for every node a (possibly wildcarded)
+    path matches — the path-tracking twin of _json_path_get."""
+    cur = [("$", doc)]
+    for t in _json_path_tokens(path):
+        nxt = []
+        for p, d in cur:
+            if t[0] == "key":
+                if isinstance(d, dict) and t[1] in d:
+                    k = t[1]
+                    nxt.append((f'{p}."{k}"' if not k.isalnum() else f"{p}.{k}", d[k]))
+            elif t[0] == "idx":
+                if isinstance(d, list) and -len(d) <= t[1] < len(d):
+                    nxt.append((f"{p}[{t[1] % len(d)}]", d[t[1]]))
+            else:  # wildcard
+                if isinstance(d, list):
+                    nxt.extend((f"{p}[{i}]", x) for i, x in enumerate(d))
+        cur = nxt
+    return cur
+
+
 def _json_search(doc, one_or_all, pat, *rest):
     import fnmatch
 
@@ -288,15 +309,26 @@ def _json_search(doc, one_or_all, pat, *rest):
     mode = _as_str(one_or_all).lower()
     if mode not in ("one", "all"):
         return None
-    # rest: [escape_char [, path...]] — default escape, whole doc search
+    # rest: [escape_char [, path...]] (MySQL: NULL escape means default \)
+    esc = "\\"
+    if rest and rest[0] is not None and _as_str(rest[0]) != "":
+        esc = _as_str(rest[0])
+        if len(esc) != 1:
+            return None
     pattern = _as_str(pat)
 
     def like(s):
-        # SQL LIKE: % any run, _ one char (translate to fnmatch)
-        trans = pattern.replace("\\%", "\0").replace("\\_", "\1")
+        # SQL LIKE: % any run, _ one char, honoring the escape character
+        trans = pattern.replace(esc + "%", "\0").replace(esc + "_", "\1")
         trans = trans.replace("%", "*").replace("_", "?")
         trans = trans.replace("\0", "%").replace("\1", "_")
         return fnmatch.fnmatchcase(s, trans)
+
+    roots = [("$", d)]
+    if len(rest) > 1:
+        roots = []
+        for p in rest[1:]:
+            roots.extend(_json_subdocs(d, _as_str(p)))
 
     out = []
 
@@ -310,7 +342,10 @@ def _json_search(doc, one_or_all, pat, *rest):
             for i, x in enumerate(v):
                 walk(x, f"{path}[{i}]")
 
-    walk(d, "$")
+    for base, sub in roots:
+        walk(sub, base)
+    seen = set()
+    out = [p for p in out if not (p in seen or seen.add(p))]
     if not out:
         return None
     if mode == "one":
@@ -318,7 +353,39 @@ def _json_search(doc, one_or_all, pat, *rest):
     return _json.dumps(out if len(out) > 1 else out[0])
 
 
-register(_multi_str(_json_search, infer=lambda fts: _ft_json(), name="json_search", arity=(3, None)))
+def _json_search_kernel(xp, avals, fts, ret_ft):
+    """Custom lane kernel: only (doc, one_or_all, pattern) are required
+    non-NULL; a NULL escape/path argument reaches _json_search as None
+    (MySQL treats a NULL escape as the default backslash)."""
+    from ..errors import TiDBError
+
+    cols = [np.asarray(d).reshape(-1) for d, _ in avals]
+    vlds = [np.asarray(v).reshape(-1) for _, v in avals]
+    n = max(len(c) for c in cols)
+    req = np.ones(n, dtype=bool)
+    for v in vlds[:3]:
+        req &= v
+    out = np.empty(n, dtype=object)
+    valid = req.copy()
+    for i in np.nonzero(req)[0]:
+        args = [
+            c[i if len(c) > 1 else 0] if bool(v[i if len(v) > 1 else 0]) else None
+            for c, v in zip(cols, vlds)
+        ]
+        try:
+            r = _json_search(*args)
+        except TiDBError:
+            raise
+        except Exception:  # noqa: BLE001 — malformed input → SQL NULL
+            r = None
+        if r is None:
+            valid[i] = False
+        else:
+            out[i] = r
+    return out, valid
+
+
+register(FuncSig("json_search", lambda fts: _ft_json(), _json_search_kernel, pushable=False, arity=(3, None)))
 
 
 # ---------------------------------------------------------------------------

@@ -7,6 +7,8 @@ DEFAULT_VARS = {
     "tidb_cop_engine": "auto",
     "tidb_executor_concurrency": "5",
     "tidb_distsql_scan_concurrency": "15",
+    # per-task cop result cache (ref: coprocessor_cache.go; see CopResultCache)
+    "tidb_enable_cop_result_cache": "ON",
     "tidb_mem_quota_query": str(1 << 30),
     "tidb_slow_log_threshold": "300",
     "tidb_enable_chunk_rpc": "ON",
