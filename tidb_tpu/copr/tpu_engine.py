@@ -75,6 +75,22 @@ def _seg_max(vals, seg, nseg, fill):
         return jnp.max(jnp.where(_seg_ids(seg, nseg), vals[None, :], fill), axis=1)
     return jax.ops.segment_max(vals, seg, num_segments=nseg + 1)[:nseg]
 
+def lex_sort_perm(ops, iota_dtype=jnp.int32):
+    """Lexicographic sort permutation over significance-ordered key
+    operands (most significant FIRST); ties break by row id.
+
+    Emulates one multi-key `lax.sort` with successive single-key STABLE
+    sorts (np.lexsort's recipe): the TPU backend's x64 comparator rewrite
+    makes >=3-key sorts with int64 operands explode — measured on axon:
+    76s compile at 3 keys, compiler SIGSEGV at 4 — while single-key
+    sorts compile in well under a second each."""
+    P = ops[0].shape[0]
+    perm = jnp.arange(P, dtype=iota_dtype)
+    for k in reversed(ops):
+        _, perm = jax.lax.sort((k[perm], perm), num_keys=1)
+    return perm
+
+
 _CMP_SWAP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
 
 
@@ -517,11 +533,10 @@ class TPUEngine:
                     else:
                         dr = dr.astype(jnp.int64)
                     ops.append(jnp.where(vf, dr, 0))
-                iota = jnp.arange(n, dtype=jnp.int32)
-                res = jax.lax.sort(tuple(ops) + (iota,), num_keys=len(ops))
-                perm = res[-1]
+                perm = lex_sort_perm(ops)
+                res = [o[perm] for o in ops]
                 s_mask = res[0] == 0
-                s_keys = res[1:-1]
+                s_keys = res[1:]
                 diff = jnp.zeros(n, dtype=bool).at[0].set(True)
                 one = jnp.ones(1, dtype=bool)
                 for k in s_keys:
@@ -932,9 +947,8 @@ class TPUEngine:
                 if desc:
                     dd = -dd if jnp.issubdtype(d.dtype, jnp.floating) else ~dd
                 ops += [nullkey.astype(jnp.int32), dd]
-            iota = jnp.arange(rows, dtype=jnp.int32)
-            res = jax.lax.sort(tuple(ops) + (iota,), num_keys=len(ops))
-            return res[-1][: min(n, rows)], res[0][: min(n, rows)] == 0
+            perm = lex_sort_perm(ops)
+            return perm[: min(n, rows)], ops[0][perm][: min(n, rows)] == 0
 
         fn = self._program(key, kernel)
 

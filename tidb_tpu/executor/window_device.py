@@ -29,6 +29,7 @@ from functools import lru_cache, reduce
 
 import numpy as np
 
+from ..copr.tpu_engine import lex_sort_perm
 from ..jaxenv import jax, jnp
 from ..mysqltypes.mydecimal import DIV_FRAC_INCR, MAX_SCALE, Dec, pow10
 
@@ -100,14 +101,17 @@ def _build_kernel(spec):
             if desc:
                 dd = -dd if jnp.issubdtype(d.dtype, jnp.floating) else ~dd
             ops += [nullkey.astype(jnp.int32), dd]
-        ops.append(iota)
-        nko = len(ops)
         vals = []
         for fa in fargs:
             for (d, v) in fa:
                 vals += [d, v]
-        srt = jax.lax.sort(tuple(ops) + tuple(vals), num_keys=nko)
-        s_ops, perm, s_vals = srt[: nko - 1], srt[nko - 1], list(srt[nko:])
+        # successive single-key stable sorts, NOT one multi-key sort: the
+        # TPU x64 comparator rewrite explodes beyond 2 int64 sort keys
+        # (see tpu_engine.lex_sort_perm); the ascending initial perm IS
+        # the row-id tie-break the old iota operand provided
+        perm = lex_sort_perm(ops, iota_dtype=jnp.int64)
+        s_ops = [o[perm] for o in ops]
+        s_vals = [v[perm] for v in vals]
 
         def chg(idxs):
             if not idxs:
