@@ -1,0 +1,147 @@
+"""Collation weight tables end-to-end (ref: util/collate/,
+expression/collation.go): utf8mb4_general_ci / utf8mb4_unicode_ci drive
+compare, ORDER BY, GROUP BY, DISTINCT, joins, MIN/MAX, and the device
+dict-encoding (sorted-vocab order follows the collation) — non-ASCII
+fixtures must agree across both cop engines."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute(
+        "CREATE TABLE ci (id INT PRIMARY KEY, g VARCHAR(20) COLLATE utf8mb4_general_ci,"
+        " u VARCHAR(20) COLLATE utf8mb4_unicode_ci, b VARCHAR(20), n INT)"
+    )
+    rows = [
+        (1, "'Apple'", "'Apple'", "'Apple'", 1),
+        (2, "'apple'", "'apple'", "'apple'", 2),
+        (3, "'APPLE'", "'APPLE'", "'APPLE'", 3),
+        (4, "'Äpfel'", "'Äpfel'", "'Äpfel'", 4),
+        (5, "'äpfel'", "'äpfel'", "'äpfel'", 5),
+        (6, "'banana'", "'banana'", "'banana'", 6),
+        (7, "'Cherry'", "'Cherry'", "'Cherry'", 7),
+        (8, "NULL", "NULL", "NULL", 8),
+        (9, "'école'", "'école'", "'école'", 9),
+        (10, "'Ecole'", "'Ecole'", "'Ecole'", 10),
+    ]
+    sess.execute(
+        "INSERT INTO ci VALUES " + ",".join(f"({i},{a},{b},{c},{n})" for i, a, b, c, n in rows)
+    )
+    return sess
+
+
+def both(s, sql, sort=True):
+    s.execute("SET tidb_cop_engine = 'host'")
+    host = s.must_query(sql)
+    s.execute("SET tidb_cop_engine = 'tpu'")
+    dev = s.must_query(sql)
+    s.execute("SET tidb_cop_engine = 'auto'")
+    if sort:
+        host, dev = sorted(host, key=repr), sorted(dev, key=repr)
+    assert dev == host, sql
+    return host
+
+
+class TestCompare:
+    def test_ci_equality(self, s):
+        rows = both(s, "SELECT id FROM ci WHERE g = 'APPLE'")
+        assert {r[0] for r in rows} == {"1", "2", "3"}
+        # accent-insensitive under general_ci
+        rows = both(s, "SELECT id FROM ci WHERE g = 'apfel'")
+        assert {r[0] for r in rows} == {"4", "5"}
+        rows = both(s, "SELECT id FROM ci WHERE u = 'ECOLE'")
+        assert {r[0] for r in rows} == {"9", "10"}
+
+    def test_bin_stays_exact(self, s):
+        rows = both(s, "SELECT id FROM ci WHERE b = 'APPLE'")
+        assert {r[0] for r in rows} == {"3"}
+
+    def test_ci_range(self, s):
+        # 'b*' > every a-class word regardless of case under ci
+        rows = both(s, "SELECT id FROM ci WHERE g < 'B'")
+        assert {r[0] for r in rows} == {"1", "2", "3", "4", "5"}
+
+    def test_in_list(self, s):
+        rows = both(s, "SELECT id FROM ci WHERE g IN ('apple', 'CHERRY')")
+        assert {r[0] for r in rows} == {"1", "2", "3", "7"}
+
+
+class TestGroupSort:
+    def test_group_by_folds_case(self, s):
+        rows = both(s, "SELECT COUNT(*) FROM ci WHERE g IS NOT NULL GROUP BY g")
+        counts = sorted(int(r[0]) for r in rows)
+        assert counts == [1, 1, 2, 2, 3]  # apple*3, äpfel*2, ecole*2, banana, cherry
+
+    def test_group_by_bin_does_not(self, s):
+        rows = both(s, "SELECT COUNT(*) FROM ci WHERE b IS NOT NULL GROUP BY b")
+        assert sorted(int(r[0]) for r in rows) == [1] * 9
+
+    def test_distinct(self, s):
+        rows = both(s, "SELECT DISTINCT g FROM ci WHERE g IS NOT NULL")
+        assert len(rows) == 5
+
+    def test_count_distinct(self, s):
+        rows = both(s, "SELECT COUNT(DISTINCT g), COUNT(DISTINCT b) FROM ci")
+        assert rows == [("5", "9")]
+
+    def test_order_by_ci(self, s):
+        s.execute("SET tidb_cop_engine = 'host'")
+        rows = s.must_query("SELECT id FROM ci WHERE n <= 7 AND g IS NOT NULL ORDER BY g, id")
+        # äpfel folds to APFEL < APPLE: äpfel-class (4,5), apple-class
+        # (1,2,3), banana, cherry
+        assert [r[0] for r in rows] == ["4", "5", "1", "2", "3", "6", "7"]
+        s.execute("SET tidb_cop_engine = 'auto'")
+
+    def test_min_max_ci(self, s):
+        rows = both(s, "SELECT MIN(g), MAX(g) FROM ci")
+        # min weight class = äpfel→APFEL, max class = école→ECOLE; equal-
+        # weight ties keep the FIRST-encountered value on both engines
+        assert rows == [("Äpfel", "école")]
+
+    def test_window_over_ci_partition(self, s):
+        rows = both(
+            s,
+            "SELECT id, COUNT(*) OVER (PARTITION BY g) FROM ci WHERE g IS NOT NULL ORDER BY id",
+            sort=False,
+        )
+        by_id = dict(rows)
+        assert by_id["1"] == by_id["2"] == by_id["3"] == "3"
+        assert by_id["4"] == by_id["5"] == "2"
+
+
+class TestJoin:
+    def test_ci_join_keys(self, s):
+        s.execute("CREATE TABLE r (k VARCHAR(20) COLLATE utf8mb4_general_ci, tag INT)")
+        s.execute("INSERT INTO r VALUES ('APPLE', 100), ('Äpfel', 200)")
+        rows = both(
+            s,
+            "SELECT ci.id, r.tag FROM ci JOIN r ON ci.g = r.k ORDER BY ci.id",
+            sort=False,
+        )
+        assert [(r[0], r[1]) for r in rows] == [
+            ("1", "100"), ("2", "100"), ("3", "100"), ("4", "200"), ("5", "200"),
+        ]
+
+
+class TestDDL:
+    def test_unknown_collation_rejected(self, s):
+        with pytest.raises((TiDBError, ValueError)):
+            s.execute("CREATE TABLE bad (x VARCHAR(5) COLLATE klingon_ci)")
+
+    def test_show_keeps_collation(self, s):
+        info = s.infoschema().table("test", "ci")
+        assert info.columns[1].ft.collate == "utf8mb4_general_ci"
+        assert info.columns[3].ft.collate == "utf8mb4_bin"
+
+
+class TestUnicodeCi:
+    def test_sharp_s(self, s):
+        s.execute("CREATE TABLE de (x VARCHAR(10) COLLATE utf8mb4_unicode_ci)")
+        s.execute("INSERT INTO de VALUES ('Straße'), ('STRASSE'), ('strasse')")
+        rows = both(s, "SELECT COUNT(*) FROM de GROUP BY x")
+        assert [r[0] for r in rows] == ["3"]  # ß == ss at primary strength

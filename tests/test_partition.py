@@ -11,7 +11,7 @@ from tidb_tpu.session import Session
 @pytest.fixture()
 def s():
     sess = Session()
-    sess.execute("SET tidb_engine = 'host'")
+    sess.execute("SET tidb_cop_engine = 'host'")
     return sess
 
 

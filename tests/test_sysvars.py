@@ -1,0 +1,112 @@
+"""Sysvar registry: scope/validation, warn-on-inert SET, and the newly
+wired consumers (ref: sessionctx/variable/sysvar.go)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+from tidb_tpu.session.vars import SYSVARS
+
+
+@pytest.fixture()
+def s():
+    return Session()
+
+
+def test_registry_breadth():
+    assert len(SYSVARS) >= 140
+    assert sum(1 for v in SYSVARS.values() if v.consumed) >= 25
+
+
+def test_unknown_var_rejected(s):
+    with pytest.raises(TiDBError):
+        s.execute("SET not_a_real_variable = 1")
+
+
+def test_validation(s):
+    with pytest.raises(TiDBError):
+        s.execute("SET tidb_cop_engine = 'warp_drive'")
+    with pytest.raises(TiDBError):
+        s.execute("SET autocommit = 'maybe'")
+    s.execute("SET tidb_executor_concurrency = 100000")  # clamped
+    assert s.vars["tidb_executor_concurrency"] == "256"
+    s.execute("SET autocommit = 1")
+    assert s.vars["autocommit"] == "ON"
+
+
+def test_inert_set_warns(s):
+    s.execute("SET tidb_hash_join_concurrency = 8")
+    assert any("no effect" in w for w in s.warnings)
+
+
+def test_consumed_set_does_not_warn(s):
+    s.execute("SET tidb_cop_engine = 'host'")
+    assert not any("no effect" in w for w in s.warnings)
+    s.execute("SET tidb_cop_engine = 'auto'")
+
+
+def test_group_concat_max_len(s):
+    s.execute("CREATE TABLE g (v VARCHAR(10))")
+    s.execute("INSERT INTO g VALUES ('aaaa'),('bbbb'),('cccc')")
+    full = s.must_query("SELECT GROUP_CONCAT(v) FROM g")[0][0]
+    assert len(full) == 14
+    s.execute("SET group_concat_max_len = 6")
+    cut = s.must_query("SELECT GROUP_CONCAT(v) FROM g")[0][0]
+    assert len(cut) == 6
+    s.execute("SET group_concat_max_len = 1024")
+
+
+def test_sql_select_limit(s):
+    s.execute("CREATE TABLE sl (a INT)")
+    s.execute("INSERT INTO sl VALUES (1),(2),(3),(4),(5)")
+    s.execute("SET sql_select_limit = 2")
+    assert len(s.must_query("SELECT a FROM sl")) == 2
+    # explicit LIMIT wins over sql_select_limit
+    assert len(s.must_query("SELECT a FROM sl LIMIT 4")) == 4
+    s.execute("SET sql_select_limit = 18446744073709551615")
+    assert len(s.must_query("SELECT a FROM sl")) == 5
+
+
+def test_max_execution_time(s):
+    import numpy as np
+
+    s.execute("CREATE TABLE met (a INT, b INT)")
+    rows = ",".join(f"({i % 1000}, {i % 7})" for i in range(20000))
+    s.execute(f"INSERT INTO met VALUES {rows}")
+    s.execute("SET max_execution_time = 1")  # 1ms: join below cannot finish
+    from tidb_tpu.errors import QueryInterrupted
+
+    with pytest.raises((QueryInterrupted, TiDBError)):
+        for _ in range(5):  # deadline is checked at chunk boundaries
+            s.execute(
+                "SELECT COUNT(*) FROM met x JOIN met y ON x.a = y.a JOIN met z ON y.a = z.a"
+            )
+    s.execute("SET max_execution_time = 0")
+
+
+def test_window_function_gate(s):
+    s.execute("CREATE TABLE w (a INT)")
+    s.execute("INSERT INTO w VALUES (1)")
+    s.execute("SET tidb_enable_window_function = 'OFF'")
+    with pytest.raises(TiDBError):
+        s.must_query("SELECT ROW_NUMBER() OVER (ORDER BY a) FROM w")
+    s.execute("SET tidb_enable_window_function = 'ON'")
+    assert s.must_query("SELECT ROW_NUMBER() OVER (ORDER BY a) FROM w") == [("1",)]
+
+
+def test_tidb_snapshot_historic_read(s):
+    import time
+
+    s.execute("CREATE TABLE h (a INT)")
+    s.execute("INSERT INTO h VALUES (1)")
+    time.sleep(0.05)
+    import datetime
+
+    cut = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S.%f")
+    time.sleep(0.05)
+    s.execute("INSERT INTO h VALUES (2)")
+    assert len(s.must_query("SELECT a FROM h")) == 2
+    s.execute(f"SET tidb_snapshot = '{cut}'")
+    assert s.must_query("SELECT a FROM h") == [("1",)]
+    s.execute("SET tidb_snapshot = ''")
+    assert len(s.must_query("SELECT a FROM h")) == 2

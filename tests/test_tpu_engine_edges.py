@@ -147,3 +147,12 @@ def test_no_fallbacks_on_edge_battery(s):
     s.must_query("SELECT u, COUNT(*) FROM t GROUP BY u")
     s.must_query("SELECT g, VAR_POP(v), BIT_XOR(v) FROM t GROUP BY g")
     assert eng.fallbacks == before, "device engine fell back on an edge query"
+
+
+class TestStringMinMaxWithNulls:
+    def test_min_string_with_nulls_and_filter(self, s):
+        # regression: the int64 sentinel used to truncate into the int32
+        # dict-code lane (-1), turning MIN over strings NULL whenever any
+        # row was masked
+        both(s, "SELECT MIN(s), MAX(s) FROM t")
+        both(s, "SELECT g, MIN(s), MAX(s) FROM t WHERE v > 0 GROUP BY g")

@@ -34,6 +34,7 @@ class ColumnInfo:
             "flen": self.ft.flen,
             "decimal": self.ft.decimal,
             "elems": list(self.ft.elems),
+            "collate": self.ft.collate,
             "offset": self.offset,
             "default": self.default,
             "has_default": self.has_default,
@@ -45,6 +46,7 @@ class ColumnInfo:
     @staticmethod
     def from_json(d):
         ft = FieldType(TypeCode(d["tp"]), d["flag"], d["flen"], d["decimal"], elems=tuple(d.get("elems", ())))
+        ft.collate = d.get("collate", "utf8mb4_bin")
         return ColumnInfo(
             d["id"], d["name"], ft, d["offset"], d.get("default"), d.get("has_default", False),
             d.get("auto_increment", False), d.get("hidden", False), d.get("comment", ""),

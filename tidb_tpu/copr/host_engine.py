@@ -145,10 +145,12 @@ def execute_dag_host(dag: DAGRequest, batch: ColumnBatch) -> Chunk:
         return _exec_agg(dag, chunk, mask)
 
     if dag.topn is not None:
+        from ..expr.expression import collation_key_lane
+
         keys = []
         for e, desc in dag.topn.by:
             d, v = e.eval(chunk)
-            keys.append((d, v, desc))
+            keys.append((collation_key_lane(d, e.ret_type), v, desc))
         order = _lex_argsort(keys, chunk.num_rows)
         order = order[: dag.topn.n]
         chunk = chunk.take(order)
@@ -192,7 +194,12 @@ def _exec_agg(dag: DAGRequest, chunk: Chunk, mask: np.ndarray | None) -> Chunk:
     out_fts = dag.output_types()
     gb = dag.agg.group_by
     if gb:
-        keyvals = [e.eval(chunk) for e in gb]
+        from ..expr.expression import collation_key_lane
+
+        keyvals = []
+        for e in gb:
+            d, v = e.eval(chunk)
+            keyvals.append((collation_key_lane(d, e.ret_type), v))
         inv, first_row, G = _group_codes_masked(keyvals, mask)
     else:
         G = 1
@@ -251,12 +258,27 @@ def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.nda
         ft = out_fts[oi]
         out_valid = np.zeros(G, dtype=bool)
         if dv.dtype == object:
+            from ..expr.expression import collation_key_lane
+
+            kv = collation_key_lane(dv, a.args[0].ret_type if a.args else None)
             out = np.empty(G, dtype=object)
+            outk = np.empty(G, dtype=object)
             for i, g in enumerate(inv):
                 if not vv[i]:
                     continue
-                if not out_valid[g] or (name == "min" and dv[i] < out[g]) or (name == "max" and dv[i] > out[g]):
+                # ci collation orders by WEIGHT; equal-weight ties keep
+                # the FIRST-encountered value, the same representative the
+                # device dict-code path decodes to
+                w = kv[i]
+                if not out_valid[g]:
+                    better = True
+                elif w == outk[g]:
+                    better = False
+                else:
+                    better = (w < outk[g]) if name == "min" else (w > outk[g])
+                if better:
                     out[g] = dv[i]
+                    outk[g] = w
                     out_valid[g] = True
         else:
             if dv.dtype == np.float64:
@@ -273,8 +295,6 @@ def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.nda
         from ..chunk.chunk import Column as _C
 
         argc = _C(a.args[0].ret_type, dv, vv)
-        from ..expr.aggregation import GROUP_CONCAT_MAX_LEN
-
         parts: list[list[str]] = [[] for _ in range(G)]
         for i, g in enumerate(inv):
             if vv[i]:
@@ -283,7 +303,7 @@ def _agg_partial_columns(a: AggDesc, chunk: Chunk, mask: np.ndarray, inv: np.nda
         out_valid = np.zeros(G, dtype=bool)
         for g in range(G):
             if parts[g]:
-                out[g] = a.sep.join(parts[g])[:GROUP_CONCAT_MAX_LEN]
+                out[g] = a.sep.join(parts[g])[: a.max_len]
                 out_valid[g] = True
         yield Column(out_fts[oi], out, out_valid)
         return

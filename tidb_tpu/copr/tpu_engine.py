@@ -94,27 +94,65 @@ def lex_sort_perm(ops, iota_dtype=jnp.int32):
 _CMP_SWAP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
 
 
-def _dict_encode_lane(d: np.ndarray, v: np.ndarray):
+class Vocab(list):
+    """Sorted dict-encode vocabulary: ORIGINAL values in code order, plus
+    the lookup keys codes were assigned by (weight strings under a ci
+    collation, the values themselves under binary)."""
+
+    def __init__(self, originals, keys=None, coll="utf8mb4_bin"):
+        super().__init__(originals)
+        self.keys = list(self) if keys is None else keys
+        self.coll = coll
+
+    def lookup(self, s: str):
+        """(insertion position, exact-present) for a constant under this
+        vocab's collation — the bisect behind code-space compare/IN."""
+        from ..mysqltypes import collate as _c
+
+        k = _c.weight(s, self.coll) if _c.is_ci(self.coll) else s
+        i = bisect.bisect_left(self.keys, k)
+        return i, i < len(self.keys) and self.keys[i] == k
+
+
+def _dict_encode_lane(d: np.ndarray, v: np.ndarray, coll: str = "utf8mb4_bin"):
     """Vectorized sorted-dict encoding of an object lane → (int32 codes,
-    vocab list). Handles str lanes (numpy 'U' fast path) and bytes lanes
+    Vocab). Handles str lanes (numpy 'U' fast path) and bytes lanes
     (latin-1 view: byte order == code-point order, so code order stays
-    binary-collation order); mixed lanes take the generic python path."""
+    binary-collation order); mixed lanes take the generic python path.
+    Under a ci collation codes follow WEIGHT order — equal-weight values
+    share one code whose vocab entry is the binary-min original (the same
+    representative the host paths resolve ties to)."""
+    from ..mysqltypes import collate as _coll
+
     if not v.any():
-        return np.zeros(len(d), np.int32), []
+        return np.zeros(len(d), np.int32), Vocab([], coll=coll)
     present = d[v]
     kinds = {type(x) for x in present.tolist()}
+    if _coll.is_ci(coll) and kinds <= {str}:
+        raw = np.where(v, d, "")
+        wa = _coll.weight_lane(raw, coll).astype("U")
+        sel = np.nonzero(v)[0]
+        # representative per weight class = FIRST occurrence in row order,
+        # matching the host engines' first-row group output and the
+        # first-wins tie rule of min/max
+        uniqw, first = np.unique(wa[sel], return_index=True)
+        reps = [d[i] for i in sel[first]]
+        codes = np.searchsorted(uniqw, wa).astype(np.int32)
+        codes[~v] = 0
+        return codes, Vocab(reps, keys=uniqw.tolist(), coll=coll)
     if kinds <= {str}:
         vals = np.where(v, d, "").astype("U")
         vocab_arr = np.unique(vals[v])
         codes = np.searchsorted(vocab_arr, vals).astype(np.int32)
         codes[~v] = 0
-        return codes, vocab_arr.tolist()
+        return codes, Vocab(vocab_arr.tolist())
     if kinds <= {bytes}:
         as_str = np.array([x.decode("latin-1") for x in present.tolist()], dtype="U")
         vocab_arr = np.unique(as_str)
         codes = np.zeros(len(d), np.int32)
         codes[v] = np.searchsorted(vocab_arr, as_str).astype(np.int32)
-        return codes, [s.encode("latin-1") for s in vocab_arr.tolist()]
+        orig = [s.encode("latin-1") for s in vocab_arr.tolist()]
+        return codes, Vocab(orig, keys=vocab_arr.tolist())
     # mixed str/bytes/other: generic exact path
     vocab = sorted({x if isinstance(x, str) else x.decode("latin-1") for x in present.tolist()})
     code_of = {s: i for i, s in enumerate(vocab)}
@@ -122,7 +160,7 @@ def _dict_encode_lane(d: np.ndarray, v: np.ndarray):
     for i in np.nonzero(v)[0]:
         x = d[i]
         codes[i] = code_of[x if isinstance(x, str) else x.decode("latin-1")]
-    return codes, vocab
+    return codes, Vocab(vocab)
 
 
 class DeviceBatch:
@@ -152,7 +190,8 @@ class DeviceBatch:
             d = self.batch.data[off]
             v = self.batch.valid[off]
             if d.dtype == object:
-                codes, vocab = _dict_encode_lane(d, v)
+                coll = getattr(self.batch.table.columns[off].ft, "collate", "utf8mb4_bin")
+                codes, vocab = _dict_encode_lane(d, v, coll)
                 self.vocabs[off] = vocab
                 d = codes
             self._data[off] = jnp.asarray(self._pad2d(d))
@@ -263,9 +302,8 @@ class TPUEngine:
             for c in e.args[1:]:
                 if not isinstance(c, Constant) or c.value.kind not in (K_STR, K_BYTES):
                     return None
-                s = c.value.to_str()
-                i = bisect.bisect_left(vocab, s)
-                codes.append(i if i < len(vocab) and vocab[i] == s else -1)
+                i, present = vocab.lookup(c.value.to_str())
+                codes.append(i if present else -1)
             col = ExprCol(e.args[0].idx, ft_longlong(), e.args[0].name)
             from ..expr.expression import make_func
 
@@ -279,13 +317,12 @@ class TPUEngine:
             return None
         return ScalarFunc(e.sig, new_args, e.ret_type)
 
-    def _code_cmp(self, op: str, col: ExprCol, const: Constant, vocab: list):
-        """col <op> 'str' → code-space comparison via sorted-vocab bisect."""
+    def _code_cmp(self, op: str, col: ExprCol, const: Constant, vocab: "Vocab"):
+        """col <op> 'str' → code-space comparison via sorted-vocab bisect
+        (weight-space under a ci collation)."""
         from ..expr.expression import make_func
 
-        s = const.value.to_str()
-        pos = bisect.bisect_left(vocab, s)
-        present = pos < len(vocab) and vocab[pos] == s
+        pos, present = vocab.lookup(const.value.to_str())
         icol = ExprCol(col.idx, ft_longlong(), col.name)
 
         def c(v):
@@ -394,12 +431,24 @@ class TPUEngine:
                 d = dev.batch.data[dag.scan.col_offsets[g.idx]]
                 if d.dtype == np.float64 or d.dtype == np.uint64:
                     wide_keys = True
+        from ..mysqltypes import collate as _coll
+
         for a in agg.aggs:
             if a.name not in (
                 "count", "sum", "avg", "min", "max", "first_row",
                 "stddev_pop", "stddev_samp", "var_pop", "var_samp",
                 "bit_and", "bit_or", "bit_xor",
             ):
+                return None
+            if (
+                a.name in ("min", "max")
+                and a.args
+                and a.args[0].ret_type.is_string()
+                and _coll.is_ci(getattr(a.args[0].ret_type, "collate", None))
+            ):
+                # dict codes collapse a ci weight class to ONE vocab
+                # representative chosen batch-wide (pre-filter), which can
+                # surface a value outside the qualifying rows — host path
                 return None
             r_args = [self._rewrite(x, vocabs) if not (isinstance(x, ExprCol) and x.idx in vocabs) else (x if a.name in ("min", "max", "first_row", "count") else None) for x in a.args]
             if any(x is None for x in r_args):
@@ -676,12 +725,13 @@ class TPUEngine:
             # overflows the decode (BIGINT UNSIGNED)
             if jnp.issubdtype(d.dtype, jnp.floating):
                 big, small = jnp.asarray(jnp.inf, d.dtype), jnp.asarray(-jnp.inf, d.dtype)
-            elif d.dtype == jnp.uint64:
-                big = jnp.asarray(np.iinfo(np.uint64).max, jnp.uint64)
-                small = jnp.asarray(0, jnp.uint64)
             else:
-                big = jnp.asarray(np.iinfo(np.int64).max)
-                small = jnp.asarray(np.iinfo(np.int64).min)
+                # sentinels in the lane's OWN dtype: jnp.where silently
+                # TRUNCATES a wider sentinel into the lane dtype (int64
+                # max → int32 -1), poisoning MIN over dict-code lanes
+                info = np.iinfo(np.dtype(str(d.dtype)))
+                big = jnp.asarray(info.max, d.dtype)
+                small = jnp.asarray(info.min, d.dtype)
             if name == "min":
                 s = _seg_min(jnp.where(ok, d, big), seg, nseg, big)
             else:

@@ -69,6 +69,8 @@ class Executor:
 
 
 def drain(e: Executor) -> Chunk:
+    import time as _time
+
     tracker = _ACTIVE_TRACKER.get()
     sess = _ACTIVE_SESSION.get()
     e.open()
@@ -79,6 +81,13 @@ def drain(e: Executor) -> Chunk:
 
             sess._killed = False
             raise QueryInterrupted("Query execution was interrupted")
+        dl = getattr(sess, "_deadline", None) if sess is not None else None
+        if dl is not None and _time.monotonic() > dl:
+            from ..errors import QueryInterrupted
+
+            # max_execution_time exceeded (ref: expensivequery +
+            # MAX_EXECUTION_TIME kill, server.go Kill)
+            raise QueryInterrupted("Query execution was interrupted, maximum statement execution time exceeded")
         c = e.next()
         if c is None:
             break
@@ -609,7 +618,12 @@ class WindowExec(Executor):
             return None
         if any(f.name not in self._AGG_FUNCS or f.frame is not None for f in self.funcs):
             return None
-        part_lanes = [self._lane(e, c, n) for e in self.part_by]
+        from ..expr.expression import collation_key_lane
+
+        part_lanes = []
+        for e in self.part_by:
+            d, v = self._lane(e, c, n)
+            part_lanes.append((collation_key_lane(d, e.ret_type), v))
         arg_lanes = []
         for f in self.funcs:
             if f.args:
@@ -681,7 +695,10 @@ class WindowExec(Executor):
         from .window_device import MIN_DEVICE_ROWS
 
         eng = getattr(self.ctx, "engine", "auto") if self.ctx is not None else "auto"
-        if eng == "host" or (eng != "tpu" and n < MIN_DEVICE_ROWS):
+        min_rows = MIN_DEVICE_ROWS
+        if self.ctx is not None and getattr(self.ctx, "vars", None):
+            min_rows = int(self.ctx.vars.get("tidb_window_device_min_rows", MIN_DEVICE_ROWS))
+        if eng == "host" or (eng != "tpu" and n < min_rows):
             return None
         try:
             fspecs = self._device_fspecs(c, n)
@@ -691,9 +708,12 @@ class WindowExec(Executor):
         from .window_device import encode_obj, run_device_window
 
         def key_lane(e):
+            from ..expr.expression import collation_key_lane
+
             d, v = self._lane(e, c, n)
             if d.dtype == object:
-                d = encode_obj(d, v)[0]
+                # ci keys sort/group by WEIGHT; key codes never decode back
+                d = encode_obj(collation_key_lane(d, e.ret_type), v)[0]
             return d, v
 
         part = [key_lane(e) for e in self.part_by]
@@ -778,6 +798,14 @@ class WindowExec(Executor):
                     spec["args"] = [(d, v)]
                 spec["static"] = (name, off, has_default)
             elif name in ("first_value", "last_value", "nth_value", "min", "max"):
+                from ..mysqltypes import collate as _coll
+
+                if name in ("min", "max") and _coll.is_ci(
+                    getattr(f.args[0].ret_type, "collate", None)
+                ):
+                    # window encode_obj codes are binary-ordered; ci
+                    # MIN/MAX needs weight order → host path
+                    raise _NotOnDevice(f"window {name} over ci-collated strings")
                 d, v = self._lane(f.args[0], c, n)
                 if d.dtype == object:
                     codes, vocab, _ = encode_obj(d, v)
@@ -842,9 +870,14 @@ class WindowExec(Executor):
             if dev is not None:
                 return dev
         from ..copr.host_engine import _lex_argsort
+        from ..expr.expression import collation_key_lane
 
-        part_lanes = [self._lane(e, c, n) for e in self.part_by]
-        order_lanes = [(self._lane(e, c, n), desc) for e, desc in self.order_by]
+        def cmp_lane(e):
+            d, v = self._lane(e, c, n)
+            return collation_key_lane(d, e.ret_type), v
+
+        part_lanes = [cmp_lane(e) for e in self.part_by]
+        order_lanes = [(cmp_lane(e), desc) for e, desc in self.order_by]
         keys = [(d, v, False) for d, v in part_lanes]
         keys += [(d, v, desc) for (d, v), desc in order_lanes]
         order = _lex_argsort(keys, n) if keys else np.arange(n)
@@ -1084,20 +1117,29 @@ class WindowExec(Executor):
         name = f.name
         valid = (frame_cnt > 0) & ne_
         is_obj = sd.dtype == object
-        better = (lambda a, b: a < b) if name == "min" else (lambda a, b: a > b)
         if is_obj:
+            from ..expr.expression import collation_key_lane
+
+            ks = collation_key_lane(sd, f.args[0].ret_type if f.args else None)
+
+            def better(j, cur_k, cur_raw):
+                # weight orders; equal weights keep the first value
+                if ks[j] == cur_k:
+                    return False
+                return (ks[j] < cur_k) if name == "min" else (ks[j] > cur_k)
+
             if f.frame is None:
-                return self._minmax_obj_default(env, sd, sv, fe_, better)
+                return self._minmax_obj_default(env, sd, sv, fe_, ks, better)
             # explicit frame over a string lane: per-row scan (host-only path)
             out = np.empty(n, dtype=object)
             outv = np.zeros(n, dtype=bool)
             for i in range(n):
                 if not ne_[i]:
                     continue
-                cur, curv = None, False
+                cur, curk, curv = None, None, False
                 for j in range(fs_[i], fe_[i] + 1):
-                    if sv[j] and (not curv or better(sd[j], cur)):
-                        cur, curv = sd[j], True
+                    if sv[j] and (not curv or better(j, curk, cur)):
+                        cur, curk, curv = sd[j], ks[j], True
                 out[i], outv[i] = cur, curv
             return out, outv
         ufunc = np.minimum if name == "min" else np.maximum
@@ -1129,15 +1171,15 @@ class WindowExec(Executor):
         res = ufunc(stk[k, fs_], stk[k, np.maximum(fe_ - half + 1, 0)])
         return res, valid
 
-    def _minmax_obj_default(self, env, sd, sv, fe_, better):
+    def _minmax_obj_default(self, env, sd, sv, fe_, ks, better):
         n = env["n"]
         acc = np.empty(n, dtype=object)
         accv = np.zeros(n, dtype=bool)
         for p0, p1 in zip(env["pidx"], env["pend"]):
-            cur, curv = None, False
+            cur, curk, curv = None, None, False
             for i in range(p0, p1 + 1):
-                if sv[i] and (not curv or better(sd[i], cur)):
-                    cur, curv = sd[i], True
+                if sv[i] and (not curv or better(i, curk, cur)):
+                    cur, curk, curv = sd[i], ks[i], True
                 acc[i], accv[i] = cur, curv
         return acc[fe_], accv[fe_]
 
@@ -1193,11 +1235,12 @@ class SortExec(Executor):
 
     def _sort_in_mem(self, all_: Chunk) -> Chunk:
         from ..copr.host_engine import _lex_argsort
+        from ..expr.expression import collation_key_lane
 
         keys = []
         for e, desc in self.by:
             d, v = _broadcast_lane(*e.eval(all_), all_.num_rows)
-            keys.append((d, v, desc))
+            keys.append((collation_key_lane(d, e.ret_type), v, desc))
         order = _lex_argsort(keys, all_.num_rows)
         return all_.take(order)
 
@@ -1408,11 +1451,18 @@ class CompleteAggExec(Executor):
             else:
                 arg_lanes.append(None)
         key_cols = [Column(g.ret_type, d, v) for g, (d, v) in zip(self.group_by, key_lanes)]
+        from ..expr.expression import collation_key_lane
+
+        wkey_lanes = [
+            collation_key_lane(col.data, g.ret_type)
+            for g, col in zip(self.group_by, key_cols)
+        ]
         groups: dict = {}
         order: list = []
         for i in range(n):
             key = tuple(
-                (col.valid[i], col.data[i] if col.valid[i] else None) for col in key_cols
+                (col.valid[i], wl[i] if col.valid[i] else None)
+                for col, wl in zip(key_cols, wkey_lanes)
             )
             st = groups.get(key)
             if st is None:
@@ -1441,12 +1491,22 @@ class CompleteAggExec(Executor):
 
     @staticmethod
     def _final(a: AggDesc, datums: list) -> Datum:
+        from ..expr.expression import datum_sort_key
+        from ..mysqltypes.datum import K_STR as _KS
+
+        arg_ft = a.args[0].ret_type if a.args else None
+
+        def dedup_key(d):
+            if d.kind == _KS:
+                return (d.kind, datum_sort_key(d, arg_ft)[0])
+            return (d.kind, d.val)
+
         vals = datums
         if a.distinct:
             seen = set()
             vals = []
             for d in datums:
-                key = (d.kind, d.val)
+                key = dedup_key(d)
                 if key not in seen:
                     seen.add(key)
                     vals.append(d)
@@ -1454,7 +1514,7 @@ class CompleteAggExec(Executor):
         if name == "count":
             return Datum.i(len(vals))
         if name == "approx_count_distinct":
-            return Datum.i(len({(d.kind, d.val) for d in vals}))
+            return Datum.i(len({dedup_key(d) for d in vals}))
         if name == "json_arrayagg":
             import json as _j
 
@@ -1498,16 +1558,21 @@ class CompleteAggExec(Executor):
         if name in ("min", "max"):
             best = vals[0]
             for d in vals[1:]:
-                cmp = compare_datum(d, best)
+                if d.kind == _KS:
+                    kd, kb = datum_sort_key(d, arg_ft), datum_sort_key(best, arg_ft)
+                    if kd[0] == kb[0]:
+                        cmp = 0  # equal-weight ties keep the first value
+                    else:
+                        cmp = -1 if kd[0] < kb[0] else 1
+                else:
+                    cmp = compare_datum(d, best)
                 if (name == "min" and cmp < 0) or (name == "max" and cmp > 0):
                     best = d
             return best
         if name == "first_row":
             return vals[0]
         if name == "group_concat":
-            from ..expr.aggregation import GROUP_CONCAT_MAX_LEN
-
-            return Datum.s(a.sep.join(d.render(a.args[0].ret_type) for d in vals)[:GROUP_CONCAT_MAX_LEN])
+            return Datum.s(a.sep.join(d.render(a.args[0].ret_type) for d in vals)[: a.max_len])
         if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
             import math as _math
 
@@ -1576,29 +1641,47 @@ class FinalHashAggExec(Executor):
         if self._done:
             return None
         self._done = True
+        from ..expr.expression import datum_sort_key
+        from ..mysqltypes.datum import K_STR as _KS
+
         ngroup = len(self.group_by)
+
+        def gkey(key):
+            # partials from different tasks carry case-variant ci keys
+            # that must merge into ONE group (weight identity)
+            out = []
+            for d, g in zip(key, self.group_by):
+                if not d.is_null and d.kind == _KS:
+                    out.append((False, datum_sort_key(d, g.ret_type)[0]))
+                else:
+                    out.append((d.is_null, None if d.is_null else d.val))
+            return tuple(out)
+
         groups: dict = {}
+        firsts: dict = {}
         order: list = []
         while True:
             c = self.child.next()
             if c is None:
                 break
             for row in c.iter_rows():
-                key = tuple(row[:ngroup])
+                key = gkey(row[:ngroup])
                 st = groups.get(key)
                 if st is None:
                     st = [None] * len(self.aggs)
                     groups[key] = st
+                    firsts[key] = tuple(row[:ngroup])
                     order.append(key)
                 self._merge_row(st, row[ngroup:])
         if not groups and not self.group_by:
             # global aggregate over empty input: one row of "empty" values
             groups[()] = [None] * len(self.aggs)
+            firsts[()] = ()
             order.append(())
         out = Chunk.empty(self.out_fts, len(groups))
         for r, key in enumerate(order):
             st = groups[key]
-            for i, d in enumerate(key):
+            for i, d in enumerate(firsts[key]):
                 out.columns[i].set_datum(r, d)
             for i, a in enumerate(self.aggs):
                 out.columns[ngroup + i].set_datum(r, self._final_value(a, st[i], self.out_fts[ngroup + i]))
@@ -1639,6 +1722,17 @@ class FinalHashAggExec(Executor):
                 return state
             if state is None:
                 return v
+            from ..mysqltypes.datum import K_STR as _KS
+
+            if v.kind == _KS and state.kind == _KS:
+                from ..expr.expression import datum_sort_key
+
+                ft = a.args[0].ret_type if a.args else None
+                kv, ks = datum_sort_key(v, ft), datum_sort_key(state, ft)
+                if kv[0] == ks[0]:
+                    return state  # equal-weight ties keep the first value
+                better = kv[0] < ks[0] if name == "min" else kv[0] > ks[0]
+                return v if better else state
             c = compare_datum(v, state)
             return v if (c < 0 if name == "min" else c > 0) else state
         if name == "first_row":
@@ -1702,9 +1796,7 @@ class FinalHashAggExec(Executor):
         if name in ("min", "max", "first_row"):
             return state if state is not None else Datum.null()
         if name == "group_concat":
-            from ..expr.aggregation import GROUP_CONCAT_MAX_LEN
-
-            return Datum.s(state[:GROUP_CONCAT_MAX_LEN]) if state is not None else Datum.null()
+            return Datum.s(state[: a.max_len]) if state is not None else Datum.null()
         if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
             import math as _math
 
@@ -1978,11 +2070,19 @@ class HashJoinExec(Executor):
         nL, nR = lchunk.num_rows, rchunk.num_rows
         lanes = []
         valid = np.ones(nL + nR, dtype=bool)
+        from ..expr.expression import collation_key_lane
+        from ..mysqltypes import collate as _coll
+
         for l_e, r_e in self.eq_conds:
             ld, lv = _broadcast_lane(*l_e.eval(lchunk), nL)
             rd, rv = _broadcast_lane(*_shift_expr(r_e, -nl).eval(rchunk), nR)
             if (ld.dtype == object) != (rd.dtype == object):
                 ld, rd = ld.astype(object), rd.astype(object)
+            if ld.dtype == object:
+                cc = _coll.resolve([l_e.ret_type, r_e.ret_type])
+                if _coll.is_ci(cc):
+                    ld = _coll.weight_lane(ld, cc)
+                    rd = _coll.weight_lane(rd, cc)
             both = np.concatenate([ld, rd])
             bv = np.concatenate([lv, rv])
             codes = _lane_codes(both, bv)
@@ -2297,8 +2397,25 @@ class MergeJoinExec(HashJoinExec):
         rkeys = [_shift_expr(r, -nl) for _, r in self.eq_conds]
         if not lkeys:
             raise TiDBError("merge join requires equality join keys")
-        ll = [_broadcast_lane(*k.eval(lchunk), lchunk.num_rows) for k in lkeys]
-        rl = [_broadcast_lane(*k.eval(rchunk), rchunk.num_rows) for k in rkeys]
+        from ..mysqltypes import collate as _coll
+
+        # one collation per key PAIR, resolved across both sides (the
+        # HashJoin rule): weighting only one side would never match
+        pair_colls = [
+            _coll.resolve([l.ret_type, r.ret_type]) for l, r in zip(lkeys, rkeys)
+        ]
+
+        def ci_lanes(keys, chunk):
+            out = []
+            for k, cc in zip(keys, pair_colls):
+                d, v = _broadcast_lane(*k.eval(chunk), chunk.num_rows)
+                if _coll.is_ci(cc) and getattr(d, "dtype", None) == object:
+                    d = _coll.weight_lane(d, cc)
+                out.append((d, v))
+            return out
+
+        ll = ci_lanes(lkeys, lchunk)
+        rl = ci_lanes(rkeys, rchunk)
         lorder = _lex_argsort([(d, v, False) for d, v in ll], lchunk.num_rows)
         rorder = _lex_argsort([(d, v, False) for d, v in rl], rchunk.num_rows)
         # key tuples materialized once per row (None = NULL key, never matches)

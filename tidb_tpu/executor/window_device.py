@@ -83,34 +83,95 @@ def frame_width(frkey) -> int:
     return (shift[ek] * eo if ek in shift else 0) - (shift[sk] * so if sk in shift else 0) + 1
 
 
+def _canon_key_items(d: np.ndarray, v: np.ndarray, desc: bool):
+    """One key lane → [(codes, rng)] of non-negative order codes with NULL
+    placement (first asc / last desc, the host _lex_argsort contract) and
+    direction folded in, ready for radix packing. Wide-span lanes that
+    cannot shift return two items: a 2-range NULL word and a full-range
+    canonical int64 word (rng None = standalone)."""
+    if d.dtype == np.float64:
+        # order-preserving bitcast (sign-flip trick); -0.0 folds into +0.0
+        b = np.where(d == 0.0, 0.0, d).view(np.int64)
+        key = np.where(b < 0, ~b, b ^ np.int64(-0x8000000000000000))
+    elif d.dtype == np.uint64:
+        key = (d ^ np.uint64(0x8000000000000000)).view(np.int64)
+    else:
+        key = d.astype(np.int64)
+    vals = key[v]
+    if len(vals) == 0:
+        return [(np.where(v, 1, 0 if not desc else 2).astype(np.int64), 3)]
+    mn, mx = int(vals.min()), int(vals.max())
+    span = mx - mn
+    if span < (1 << 61):
+        if desc:
+            shifted = (mx - key) + 1
+        else:
+            shifted = (key - mn) + 1
+        codes = np.where(v, shifted, 0 if not desc else span + 2)
+        return [(codes.astype(np.int64), span + 3)]
+    # full-range lane: separate NULL word + canonical value word
+    nullw = np.where(v, 1, 0 if not desc else 2).astype(np.int64)
+    vw = np.where(v, ~key if desc else key, 0)  # ~ reverses int64 order
+    return [(nullw, 3), (vw, None)]
+
+
+def _pack_words(items, n: int, P: int):
+    """Radix-pack [(codes, rng)] (most significant first) into as few
+    device sort words as possible; pad rows [n:P] get a sentinel ABOVE
+    every real code so they sort last and form their own partition.
+    Words whose packed range fits int32 ship narrow (native TPU sorts)."""
+    words: list[np.ndarray] = []
+    cur, cur_rng = None, 1
+
+    def flush():
+        nonlocal cur, cur_rng
+        if cur is None:
+            return
+        pad_val = cur_rng
+        w = np.full(P, pad_val, dtype=np.int64)
+        w[:n] = cur
+        words.append(w.astype(np.int32) if cur_rng < (1 << 31) - 1 else w)
+        cur, cur_rng = None, 1
+
+    for codes, rng in items:
+        if rng is None:  # standalone full-range word
+            flush()
+            w = np.full(P, np.iinfo(np.int64).max, dtype=np.int64)
+            w[:n] = codes
+            words.append(w)
+            continue
+        if cur is not None and cur_rng <= (1 << 61) // rng:
+            cur = cur * rng + codes
+            cur_rng *= rng
+        else:
+            flush()
+            cur, cur_rng = codes.copy(), rng
+    flush()
+    return words
+
+
 @lru_cache(maxsize=256)
 def _build_kernel(spec):
-    """spec = (npart, order_descs, funcspecs, framespecs) — all static,
-    hashable. framespecs[i] is None (default frame) or Frame.key()."""
-    npart, order_descs, funcspecs, framespecs = spec
-    descs = (False,) * npart + tuple(order_descs)
+    """spec = (n_part_words, n_order_words, funcspecs, framespecs) — all
+    static, hashable. Key canonicalization/packing happened on HOST
+    (_canon_key_items/_pack_words); the kernel only sorts the few packed
+    words. framespecs[i] is None (default frame) or Frame.key()."""
+    npw, now, funcspecs, framespecs = spec
 
-    def kernel(keys, fargs, padflag):
-        P = padflag.shape[0]
+    def kernel(words, fargs):
+        P = words[0].shape[0]
         iota = jnp.arange(P, dtype=jnp.int64)
-        ops = [padflag.astype(jnp.int32)]
-        for (d, v), desc in zip(keys, descs):
-            # NULLs first asc / last desc (host _lex_argsort contract)
-            nullkey = jnp.where(v, 0, 1) if desc else jnp.where(v, 1, 0)
-            dd = jnp.where(v, d, jnp.zeros((), d.dtype))
-            if desc:
-                dd = -dd if jnp.issubdtype(d.dtype, jnp.floating) else ~dd
-            ops += [nullkey.astype(jnp.int32), dd]
         vals = []
         for fa in fargs:
             for (d, v) in fa:
                 vals += [d, v]
         # successive single-key stable sorts, NOT one multi-key sort: the
-        # TPU x64 comparator rewrite explodes beyond 2 int64 sort keys
-        # (see tpu_engine.lex_sort_perm); the ascending initial perm IS
-        # the row-id tie-break the old iota operand provided
-        perm = lex_sort_perm(ops, iota_dtype=jnp.int64)
-        s_ops = [o[perm] for o in ops]
+        # TPU comparator inlining explodes beyond 2 sort keys (294s
+        # compile for one 7-key int32 sort vs 22s for the pass form —
+        # measured on axon); the ascending initial perm IS the row-id
+        # tie-break
+        perm = lex_sort_perm(list(words), iota_dtype=jnp.int32)
+        s_ops = [o[perm] for o in words]
         s_vals = [v[perm] for v in vals]
 
         def chg(idxs):
@@ -121,10 +182,8 @@ def _build_kernel(spec):
             )
             return jnp.concatenate([jnp.ones(1, dtype=bool), c])
 
-        part_idx = [0] + [1 + 2 * k + j for k in range(npart) for j in (0, 1)]
-        order_idx = [1 + 2 * k + j for k in range(npart, len(descs)) for j in (0, 1)]
-        pstart = chg(part_idx)
-        ostart = chg(part_idx + order_idx)
+        pstart = chg(list(range(npw)))
+        ostart = chg(list(range(npw + now)))
         pfirst = jax.lax.cummax(jnp.where(pstart, iota, 0))
         peer_first = jax.lax.cummax(jnp.where(ostart, iota, 0))
 
@@ -373,17 +432,24 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int):
         dd[:n], vv[:n] = d, v
         return jnp.asarray(dd), jnp.asarray(vv)
 
-    keys = tuple(pad(d, v) for d, v in part_lanes) + tuple(
-        pad(d, v) for (d, v), _ in order_lanes
-    )
-    descs = tuple(bool(desc) for _, desc in order_lanes)
+    part_items = []
+    for d, v in part_lanes:
+        part_items += _canon_key_items(np.asarray(d), np.asarray(v), False)
+    if not part_items:
+        # no PARTITION BY: one trivial word still separates the pad block
+        part_items = [(np.zeros(n, dtype=np.int64), 1)]
+    order_items = []
+    for (d, v), desc in order_lanes:
+        order_items += _canon_key_items(np.asarray(d), np.asarray(v), bool(desc))
+    pwords = _pack_words(part_items, n, P)
+    owords = _pack_words(order_items, n, P)
+    words = tuple(jnp.asarray(w) for w in pwords + owords)
     funcspecs = tuple(f["static"] for f in fspecs)
     framespecs = tuple(f.get("frame") for f in fspecs)
     fargs = tuple(tuple(pad(d, v) for d, v in f["args"]) for f in fspecs)
-    padflag = jnp.asarray((np.arange(P) >= n).astype(np.int32))
 
-    kernel = _build_kernel((len(part_lanes), descs, funcspecs, framespecs))
-    outs = kernel(keys, fargs, padflag)
+    kernel = _build_kernel((len(pwords), len(owords), funcspecs, framespecs))
+    outs = kernel(words, fargs)
 
     results = []
     for f, (a, b) in zip(fspecs, outs):

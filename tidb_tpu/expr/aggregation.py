@@ -104,6 +104,7 @@ class AggDesc:
     ret_type: FieldType = field(default_factory=ft_longlong)
 
     sep: str = ","  # GROUP_CONCAT separator
+    max_len: int = GROUP_CONCAT_MAX_LEN  # group_concat_max_len sysvar
 
     @staticmethod
     def make(name: str, args: list[Expression], distinct: bool = False) -> "AggDesc":
@@ -161,6 +162,8 @@ class AggDesc:
     def __repr__(self):
         d = "distinct " if self.distinct else ""
         s = f" sep={self.sep!r}" if self.name == "group_concat" and self.sep != "," else ""
+        if self.name == "group_concat" and self.max_len != GROUP_CONCAT_MAX_LEN:
+            s += f" maxlen={self.max_len}"  # digest/plan-cache key material
         return f"{self.name}({d}{', '.join(map(repr, self.args))}{s})"
 
 

@@ -231,6 +231,21 @@ def _int2_cmp(op, a, b):
     }[op]()
 
 
+def _ci_weight1(a, fts):
+    """Collation weights for one string lane when the operands' derived
+    collation is case-insensitive (ref: expression/collation.go)."""
+    from ..mysqltypes import collate as _coll
+
+    c = _coll.resolve(fts)
+    if _coll.is_ci(c):
+        return _coll.weight_lane(np.atleast_1d(np.asarray(a, dtype=object)), c)
+    return a
+
+
+def _ci_weights(a, b, fts):
+    return _ci_weight1(a, fts), _ci_weight1(b, fts)
+
+
 def _cmp_kernel(op: str):
     def kernel(xp, avals, fts, ret_ft):
         valid = all_valid(xp, avals)
@@ -242,6 +257,7 @@ def _cmp_kernel(op: str):
             # numpy-only path; device compares dictionary codes instead
             a = np.where(avals[0][1], a, "")
             b = np.where(avals[1][1], b, "")
+            a, b = _ci_weights(a, b, fts)
         data = {
             "eq": lambda: a == b,
             "ne": lambda: a != b,
@@ -268,6 +284,7 @@ def _nulleq_kernel(xp, avals, fts, ret_ft):
         if kind == "str":
             a = np.where(va, a, "")
             b = np.where(vb, b, "")
+            a, b = _ci_weights(a, b, fts)
         same = a == b
     eq = same & va & vb | (~va & ~vb)
     return eq.astype(xp.int64), xp.ones_like(va)
@@ -283,13 +300,18 @@ def _in_kernel(xp, avals, fts, ret_ft):
     a = lanes[0]
     if kind == "str":
         a = np.where(valid0, a, "")
+        a = _ci_weight1(a, fts)
     hit = None
     any_null = ~valid0
     for (d, v), lane in zip(avals[1:], lanes[1:]):
         if kind == "int2":
             e = _int2_cmp("eq", a, lane) & v
         else:
-            b = np.where(v, lane, "") if kind == "str" else lane
+            if kind == "str":
+                b = np.where(v, lane, "")
+                b = _ci_weight1(b, fts)
+            else:
+                b = lane
             e = (a == b) & v
         hit = e if hit is None else (hit | e)
         any_null = any_null | ~v

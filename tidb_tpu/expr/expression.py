@@ -187,6 +187,35 @@ def eval_expr_np(expr: Expression, chunk: Chunk):
 # ---------------------------------------------------------------------------
 
 
+def collation_key_lane(d, ft: FieldType | None):
+    """Sort/group/join KEY form of a lane: weight strings when `ft` is a
+    case-insensitive-collated string column, the lane itself otherwise
+    (ref: util/collate — every comparison surface keys on weights)."""
+    from ..mysqltypes import collate as _c
+
+    if (
+        ft is not None
+        and ft.is_string()
+        and _c.is_ci(getattr(ft, "collate", None))
+        and getattr(d, "dtype", None) == object
+    ):
+        return _c.weight_lane(d, ft.collate)
+    return d
+
+
+def datum_sort_key(dat, ft: FieldType | None):
+    """Collation-aware comparable for one string datum: (weight, raw) —
+    weight orders, raw breaks ties deterministically (binary-min wins)."""
+    from ..mysqltypes import collate as _c
+
+    s = dat.val if isinstance(dat.val, str) else (
+        bytes(dat.val).decode("latin-1") if isinstance(dat.val, (bytes, bytearray)) else str(dat.val)
+    )
+    if ft is not None and ft.is_string() and _c.is_ci(getattr(ft, "collate", None)):
+        return (_c.weight(s, ft.collate), s)
+    return (s, s)
+
+
 def lane_as_float(xp, data, ft: FieldType):
     """Coerce a lane to float64 honoring decimal scale."""
     if ft.is_decimal():

@@ -198,12 +198,18 @@ _NAME_TO_TYPE = {
 }
 
 
-def parse_type_name(name: str, args=(), unsigned=False, elems=()) -> FieldType:
+def parse_type_name(name: str, args=(), unsigned=False, elems=(), collate="") -> FieldType:
     """Map a SQL type name + length args to a FieldType (used by the DDL parser)."""
     tp = _NAME_TO_TYPE.get(name.lower())
     if tp is None:
         raise ValueError(f"unknown type {name!r}")
     ft = FieldType(tp)
+    if collate:
+        from .collate import is_supported
+
+        if not is_supported(collate):
+            raise ValueError(f"Unknown collation: '{collate}'")
+        ft.collate = collate
     if unsigned:
         ft.flag |= UNSIGNED_FLAG
     if tp == TypeCode.NewDecimal:

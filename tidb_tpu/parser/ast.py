@@ -254,6 +254,7 @@ class ColumnDef:
     unique: bool = False
     comment: str = ""
     elems: tuple = ()
+    collate: str = ""
 
 
 @dataclass

@@ -832,7 +832,7 @@ class Parser:
             self.expect_kw("AS")
         else:
             self.expect_op(",")
-        tname, targs, unsigned, _ = self.type_spec(cast_ctx=True)
+        tname, targs, unsigned, _, _ = self.type_spec(cast_ctx=True)
         self.expect_op(")")
         return ast.Cast(e, tname, targs, unsigned)
 
@@ -862,12 +862,13 @@ class Parser:
             if self.tok.upper == "UNSIGNED":
                 unsigned = True
             self.next()
+        collate = ""
         if self.try_kw("CHARACTER"):
             self.expect_kw("SET")
             self.ident()
         if self.try_kw("COLLATE"):
-            self.ident()
-        return name, args, unsigned, elems
+            collate = self.ident().lower()
+        return name, args, unsigned, elems, collate
 
     # --- DML ---------------------------------------------------------------
 
@@ -1267,8 +1268,8 @@ class Parser:
 
     def column_def(self) -> ast.ColumnDef:
         name = self.ident()
-        tname, targs, unsigned, elems = self.type_spec()
-        col = ast.ColumnDef(name, tname, targs, unsigned, elems=elems)
+        tname, targs, unsigned, elems, collate = self.type_spec()
+        col = ast.ColumnDef(name, tname, targs, unsigned, elems=elems, collate=collate)
         while True:
             if self.try_kw("NOT"):
                 self.expect_kw("NULL")
@@ -1298,7 +1299,9 @@ class Parser:
             elif self.at_kw("COLLATE", "CHARACTER"):
                 if self.next().upper == "CHARACTER":
                     self.expect_kw("SET")
-                self.ident()
+                    self.ident()
+                else:
+                    col.collate = self.ident().lower()
             elif self.try_kw("ON"):
                 self.expect_kw("UPDATE")
                 self.unary()

@@ -541,6 +541,11 @@ class PlanBuilder:
         """ast window call → placeholder expression lifted later by
         _build_windows (ref: logical_plan_builder.go buildWindowFunctions)."""
         lname = node.name.lower()
+        svars = self.context_info.get("vars") or {}
+        if svars.get("tidb_enable_window_function", "ON") != "ON":
+            raise TiDBError(
+                f"window function {lname} is disabled (tidb_enable_window_function=OFF)"
+            )
         if node.distinct:
             raise TiDBError(f"DISTINCT is not supported in window function {lname}")
         args = []
@@ -1162,6 +1167,9 @@ class AggContext:
         desc = AggDesc.make(name, args, distinct=node.distinct)
         if getattr(node, "sep", None) is not None:
             desc.sep = node.sep
+        if desc.name == "group_concat":
+            svars = self.builder.context_info.get("vars") or {}
+            desc.max_len = int(svars.get("group_concat_max_len", desc.max_len))
         # dedup identical aggregates
         for i, existing in enumerate(self.aggs):
             if repr(existing) == repr(desc):

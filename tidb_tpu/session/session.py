@@ -9,9 +9,12 @@ optimize, execute via the cop client (TPU or host engine).
 
 from __future__ import annotations
 
+import logging
 import time
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from ..catalog.meta import Meta
 from ..catalog.schema import ColumnInfo, DBInfo, IndexInfo, InfoSchema, TableInfo
@@ -263,6 +266,21 @@ class Session:
     def read_ts(self) -> int:
         if self.txn is not None:
             return self.txn.start_ts
+        snap = self.vars.get("tidb_snapshot", "")
+        if snap:
+            # historic read at the snapshot's wall time (ref:
+            # sessionctx/variable tidb_snapshot + MVCC read path)
+            from ..mysqltypes.coretime import parse_datetime, unpack_time
+
+            p = parse_datetime(str(snap))
+            if p is None:
+                raise TiDBError(f"invalid tidb_snapshot value {snap!r}")
+            y, mo, d, h, mi, s, us = unpack_time(p)
+            # local wall time → epoch; mktime with isdst=-1 resolves the
+            # zone's actual DST state at that date (not just whether the
+            # zone defines DST)
+            ms = int(time.mktime((y, mo, d, h, mi, s, 0, 0, -1)) * 1000 + us // 1000)
+            return ms << 18
         return self.store.tso.next()
 
     # ---------------------------------------------------------------- execute
@@ -300,6 +318,10 @@ class Session:
 
         self._info.update(user=self.user, conn_id=self.conn_id, db=self.current_db)
         itok = _si.CURRENT.set(self._info)
+        met = int(self.vars.get("max_execution_time", "0") or 0)
+        self._deadline = (time.monotonic() + met / 1000.0) if met > 0 else None
+        if self.vars.get("tidb_general_log", "OFF") == "ON" and not self._in_bootstrap:
+            log.info("GENERAL_LOG conn=%s user=%s db=%s sql=%s", self.conn_id, self.user, self.current_db, sql[:512])
         t0 = time.perf_counter()
         c0 = time.thread_time()  # Top-SQL CPU attribution by digest
         ok = True
@@ -667,7 +689,17 @@ class Session:
                 else:
                     if scope == "global" and not self._in_bootstrap:
                         self.priv.require_dynamic(self, self.user, "SYSTEM_VARIABLES_ADMIN")
-                    self.vars[name] = c.value.render(c.ret_type)
+                    from .vars import set_var
+
+                    try:
+                        self.vars[name] = set_var(
+                            name, c.value.render(c.ret_type), self.warnings
+                        )
+                    except ValueError as e:
+                        raise TiDBError(str(e))
+                    # plan-time knobs (group_concat_max_len, sql_mode, ...)
+                    # bake into cached plans — never serve a stale one
+                    self._plan_cache.clear()
             return ResultSet([], None)
         if isinstance(stmt, ast.CreateSequence):
             return self._ddl_create_sequence(stmt)
@@ -1125,7 +1157,7 @@ class Session:
             self.infoschema(), self.current_db,
             run_subquery=self._run_subquery, params=self._exec_params,
             memtable_rows=self._memtable_rows,
-            context_info={"user": self.user, "conn_id": self.conn_id},
+            context_info={"user": self.user, "conn_id": self.conn_id, "vars": self.vars},
             hints=getattr(self, "_cur_hints", None),
             expose_rowid=expose_rowid,
             seq_hook=self.sequence_op,
@@ -1230,6 +1262,14 @@ class Session:
         tl = getattr(self.store, "_table_locks", None)
         if (tl is not None and tl._locks) or getattr(self, "_locked_ids", None):
             self._check_plan_locks(plan)
+        sel_limit = int(self.vars.get("sql_select_limit", 2**64 - 1) or 2**64 - 1)
+        if sel_limit < 2**64 - 1 and getattr(stmt, "limit", None) is None:
+            # plant a real Limit node so execution stops early instead of
+            # materializing the full result and slicing (ref: planbuilder
+            # sql_select_limit handling)
+            from ..planner.plans import Limit as _LimitPlan
+
+            plan = _LimitPlan(plan, sel_limit)
         ex = build_executor(plan, ctx)
         if getattr(self, "_trace_collect", False):
             # TRACE hook: instrument THIS (fully gated) execution rather
@@ -2379,7 +2419,7 @@ class Session:
         for i, cd in enumerate(stmt.columns):
             if cd.name.lower().startswith("_tidb_"):
                 raise TiDBError(f"column name {cd.name!r} is reserved")
-            ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems)
+            ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems, getattr(cd, "collate", ""))
             if cd.not_null or cd.primary_key:
                 ft.flag |= NOT_NULL_FLAG
             if cd.auto_increment:
@@ -2711,7 +2751,7 @@ class Session:
         txn = self._ddl_txn()
         m = Meta(txn)
         t = m.table(info.id)
-        ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems)
+        ft = parse_type_name(cd.type_name, cd.type_args, cd.unsigned, cd.elems, getattr(cd, "collate", ""))
         if cd.not_null:
             ft.flag |= NOT_NULL_FLAG
         default = None

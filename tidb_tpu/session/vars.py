@@ -1,44 +1,227 @@
-"""System variables (ref: sessionctx/variable/sysvar.go — ~230 vars; the
-subset that drives behavior here, with the rest present as inert knobs so
-SHOW VARIABLES / SET round-trip like the reference)."""
+"""System variables (ref: sessionctx/variable/sysvar.go — ~230 vars with
+scope + validation; this registry carries the subset that drives behavior
+here plus the high-traffic MySQL/TiDB knobs, each tagged with whether any
+code actually consumes it — SET on an inert knob warns instead of lying).
+"""
 
-DEFAULT_VARS = {
-    # engine selection for pushed-down DAGs: tpu | host | auto
-    "tidb_cop_engine": "auto",
-    "tidb_executor_concurrency": "5",
-    "tidb_distsql_scan_concurrency": "15",
-    # per-task cop result cache (ref: coprocessor_cache.go; see CopResultCache)
-    "tidb_enable_cop_result_cache": "ON",
-    "tidb_mem_quota_query": str(1 << 30),
-    "tidb_slow_log_threshold": "300",
-    "tidb_enable_chunk_rpc": "ON",
-    "tidb_allow_mpp": "ON",
-    "tidb_broadcast_join_threshold_count": "10240",
-    "tidb_isolation_read_engines": "tpu,host",
-    "tidb_txn_mode": "optimistic",
-    "tidb_retry_limit": "10",
-    "autocommit": "ON",
-    "sql_mode": "ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES",
-    "max_execution_time": "0",
-    "tidb_enable_vectorized_expression": "ON",
-    "tidb_index_lookup_concurrency": "4",
-    "tidb_hash_join_concurrency": "5",
-    "tidb_build_stats_concurrency": "4",
-    "tidb_opt_agg_push_down": "ON",
-    "tidb_opt_prefer_merge_join": "OFF",
-    "tidb_opt_prefer_index_join": "OFF",
-    "tidb_enable_clustered_index": "ON",
-    "tidb_snapshot": "",
-    "time_zone": "SYSTEM",
-    "wait_timeout": "28800",
-    "interactive_timeout": "28800",
-    "max_allowed_packet": "67108864",
-    "version_comment": "tidb-tpu",
-    "port": "4000",
-    "socket": "",
-    "datadir": "",
-    "character_set_server": "utf8mb4",
-    "collation_server": "utf8mb4_bin",
-    "tx_isolation": "REPEATABLE-READ",
-    "transaction_isolation": "REPEATABLE-READ",
-}
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class SysVar:
+    name: str
+    default: str
+    scope: str = "both"  # both | session | global | none (read-only)
+    kind: str = "str"  # bool | int | float | enum | str
+    enum: tuple = ()
+    lo: int | None = None
+    hi: int | None = None
+    consumed: bool = False  # True: some code path reads it
+
+    def normalize(self, raw: str) -> str:
+        """Validate + canonicalize a SET value (ref: sysvar.go Validation)."""
+        s = str(raw).strip()
+        if self.kind == "bool":
+            up = s.upper()
+            if up in ("ON", "1", "TRUE"):
+                return "ON"
+            if up in ("OFF", "0", "FALSE"):
+                return "OFF"
+            raise ValueError(f"Variable '{self.name}' can't be set to the value of '{raw}'")
+        if self.kind == "int":
+            try:
+                # int(s) first: int(float(s)) corrupts 64-bit values >2^53
+                v = int(s) if not any(c in s for c in ".eE") else int(float(s))
+            except ValueError:
+                raise ValueError(f"Incorrect argument type to variable '{self.name}'")
+            if self.lo is not None:
+                v = max(v, self.lo)
+            if self.hi is not None:
+                v = min(v, self.hi)
+            return str(v)
+        if self.kind == "float":
+            try:
+                float(s)
+            except ValueError:
+                raise ValueError(f"Incorrect argument type to variable '{self.name}'")
+            return s
+        if self.kind == "enum":
+            for e in self.enum:
+                if s.lower() == e.lower():
+                    return e
+            raise ValueError(f"Variable '{self.name}' can't be set to the value of '{raw}'")
+        return s
+
+
+SYSVARS: dict[str, SysVar] = {}
+
+
+def _sv(name, default, scope="both", kind="str", enum=(), lo=None, hi=None, consumed=False):
+    SYSVARS[name] = SysVar(name, default, scope, kind, enum, lo, hi, consumed)
+
+
+# --- engine / executor knobs (consumed) ------------------------------------
+_sv("tidb_cop_engine", "auto", kind="enum", enum=("auto", "tpu", "host"), consumed=True)
+_sv("tidb_executor_concurrency", "5", kind="int", lo=1, hi=256, consumed=True)
+_sv("tidb_distsql_scan_concurrency", "15", kind="int", lo=1, hi=256, consumed=True)
+_sv("tidb_enable_cop_result_cache", "ON", kind="bool", consumed=True)
+_sv("tidb_mem_quota_query", str(1 << 30), kind="int", lo=0, consumed=True)
+_sv("tidb_slow_log_threshold", "300", kind="int", lo=0, consumed=True)
+_sv("tidb_allow_mpp", "ON", kind="bool", consumed=True)
+_sv("tidb_broadcast_join_threshold_count", "10240", kind="int", lo=0, consumed=True)
+_sv("tidb_txn_mode", "optimistic", kind="enum", enum=("optimistic", "pessimistic", ""), consumed=True)
+_sv("tidb_retry_limit", "10", kind="int", lo=0, consumed=True)
+_sv("autocommit", "ON", kind="bool", consumed=True)
+_sv("tidb_opt_prefer_merge_join", "OFF", kind="bool", consumed=True)
+_sv("tidb_opt_prefer_index_join", "OFF", kind="bool", consumed=True)
+_sv("tidb_enable_auto_analyze", "ON", kind="bool", consumed=True)
+_sv("tidb_snapshot", "", consumed=True)
+_sv("group_concat_max_len", "1024", kind="int", lo=4, hi=1 << 20, consumed=True)
+_sv("sql_select_limit", str(2**64 - 1), kind="int", lo=0, consumed=True)
+_sv("max_execution_time", "0", kind="int", lo=0, consumed=True)
+_sv("tidb_enable_window_function", "ON", kind="bool", consumed=True)
+_sv("tidb_enable_noop_functions", "ON", kind="bool", consumed=True)
+_sv("tidb_general_log", "OFF", kind="bool", consumed=True)
+_sv("sql_mode", "ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES", consumed=True)
+_sv("time_zone", "SYSTEM", consumed=True)
+_sv("tidb_isolation_read_engines", "tpu,host", consumed=True)
+_sv("tidb_enable_clustered_index", "ON", kind="bool", consumed=True)
+_sv("tidb_window_device_min_rows", str(1 << 15), kind="int", lo=0, consumed=True)
+
+# --- accepted, surfaced in SHOW, but nothing reads them here (warn) --------
+for _name, _d, _k in (
+    ("tidb_enable_chunk_rpc", "ON", "bool"),
+    ("tidb_enable_vectorized_expression", "ON", "bool"),
+    ("tidb_index_lookup_concurrency", "4", "int"),
+    ("tidb_index_lookup_join_concurrency", "4", "int"),
+    ("tidb_hash_join_concurrency", "5", "int"),
+    ("tidb_window_concurrency", "4", "int"),
+    ("tidb_projection_concurrency", "4", "int"),
+    ("tidb_hashagg_partial_concurrency", "4", "int"),
+    ("tidb_hashagg_final_concurrency", "4", "int"),
+    ("tidb_merge_join_concurrency", "1", "int"),
+    ("tidb_stream_agg_concurrency", "1", "int"),
+    ("tidb_build_stats_concurrency", "4", "int"),
+    ("tidb_opt_agg_push_down", "ON", "bool"),
+    ("tidb_opt_distinct_agg_push_down", "OFF", "bool"),
+    ("tidb_enable_parallel_apply", "OFF", "bool"),
+    ("tidb_enable_async_commit", "OFF", "bool"),
+    ("tidb_enable_1pc", "OFF", "bool"),
+    ("tidb_max_chunk_size", "1024", "int"),
+    ("tidb_init_chunk_size", "32", "int"),
+    ("tidb_enable_rate_limit_action", "ON", "bool"),
+    ("tidb_enable_strict_double_type_check", "ON", "bool"),
+    ("tidb_enable_table_partition", "ON", "bool"),
+    ("tidb_enable_list_partition", "OFF", "bool"),
+    ("tidb_scatter_region", "OFF", "bool"),
+    ("tidb_enable_stmt_summary", "ON", "bool"),
+    ("tidb_stmt_summary_max_stmt_count", "3000", "int"),
+    ("tidb_enable_collect_execution_info", "ON", "bool"),
+    ("tidb_enable_telemetry", "ON", "bool"),
+    ("tidb_row_format_version", "2", "int"),
+    ("tidb_analyze_version", "2", "int"),
+    ("tidb_stats_load_sync_wait", "0", "int"),
+    ("tidb_ddl_reorg_worker_cnt", "4", "int"),
+    ("tidb_ddl_reorg_batch_size", "256", "int"),
+    ("tidb_ddl_error_count_limit", "512", "int"),
+    ("tidb_auto_analyze_ratio", "0.5", "float"),
+    ("tidb_auto_analyze_start_time", "00:00 +0000", "str"),
+    ("tidb_auto_analyze_end_time", "23:59 +0000", "str"),
+    ("tidb_gc_life_time", "10m0s", "str"),
+    ("tidb_gc_run_interval", "10m0s", "str"),
+    ("tidb_gc_concurrency", "-1", "int"),
+    ("tidb_backoff_weight", "2", "int"),
+    ("tidb_ddl_slow_threshold", "300", "int"),
+    ("tidb_force_priority", "NO_PRIORITY", "str"),
+    ("tidb_constraint_check_in_place", "OFF", "bool"),
+    ("tidb_batch_insert", "OFF", "bool"),
+    ("tidb_batch_delete", "OFF", "bool"),
+    ("tidb_dml_batch_size", "0", "int"),
+    ("tidb_opt_write_row_id", "OFF", "bool"),
+    ("tidb_check_mb4_value_in_utf8", "ON", "bool"),
+    ("tidb_opt_insubq_to_join_and_agg", "ON", "bool"),
+    ("tidb_opt_correlation_threshold", "0.9", "float"),
+    ("tidb_opt_correlation_exp_factor", "1", "int"),
+    ("tidb_opt_network_factor", "1", "float"),
+    ("tidb_opt_scan_factor", "1.5", "float"),
+    ("tidb_opt_seek_factor", "20", "float"),
+    ("tidb_opt_memory_factor", "0.001", "float"),
+    ("tidb_opt_disk_factor", "1.5", "float"),
+    ("tidb_opt_concurrency_factor", "3", "float"),
+    ("tidb_enable_index_merge", "ON", "bool"),
+    ("tidb_enable_noop_variables", "ON", "bool"),
+    ("tidb_low_resolution_tso", "OFF", "bool"),
+    ("tidb_expensive_query_time_threshold", "60", "int"),
+    ("tidb_memory_usage_alarm_ratio", "0.8", "float"),
+    ("tidb_skip_isolation_level_check", "OFF", "bool"),
+    ("tidb_skip_ascii_check", "OFF", "bool"),
+    ("tidb_skip_utf8_check", "OFF", "bool"),
+    ("foreign_key_checks", "OFF", "bool"),
+    ("unique_checks", "ON", "bool"),
+    ("sql_safe_updates", "OFF", "bool"),
+    ("sql_auto_is_null", "OFF", "bool"),
+    ("big_tables", "OFF", "bool"),
+    ("sql_log_bin", "ON", "bool"),
+    ("innodb_lock_wait_timeout", "50", "int"),
+    ("lock_wait_timeout", "31536000", "int"),
+    ("tx_read_only", "OFF", "bool"),
+    ("transaction_read_only", "OFF", "bool"),
+    ("default_week_format", "0", "int"),
+    ("div_precision_increment", "4", "int"),
+    ("lc_time_names", "en_US", "str"),
+    ("max_sort_length", "1024", "int"),
+    ("net_write_timeout", "60", "int"),
+    ("net_read_timeout", "30", "int"),
+    ("net_buffer_length", "16384", "int"),
+    ("query_cache_size", "0", "int"),
+    ("query_cache_type", "OFF", "str"),
+    ("tmp_table_size", "16777216", "int"),
+    ("max_heap_table_size", "16777216", "int"),
+    ("thread_cache_size", "9", "int"),
+    ("table_open_cache", "2000", "int"),
+):
+    _sv(_name, _d, kind=_k)
+
+# --- connection/session plumbing clients legitimately SET ------------------
+for _name, _d in (
+    ("wait_timeout", "28800"), ("interactive_timeout", "28800"),
+    ("max_allowed_packet", "67108864"),
+    ("character_set_server", "utf8mb4"), ("collation_server", "utf8mb4_bin"),
+    ("character_set_client", "utf8mb4"), ("character_set_results", "utf8mb4"),
+    ("character_set_connection", "utf8mb4"), ("collation_connection", "utf8mb4_bin"),
+    ("character_set_database", "utf8mb4"), ("collation_database", "utf8mb4_bin"),
+    ("tx_isolation", "REPEATABLE-READ"), ("transaction_isolation", "REPEATABLE-READ"),
+    ("default_storage_engine", "InnoDB"), ("init_connect", ""),
+):
+    _sv(_name, _d)
+
+# --- server identity (read-only: SET is rejected, ref ErrIncorrectScope) ---
+for _name, _d in (
+    ("version_comment", "tidb-tpu"), ("port", "4000"), ("socket", ""),
+    ("datadir", ""), ("version", "8.0.11-tidb-tpu"), ("hostname", "localhost"),
+    ("license", "Apache License 2.0"), ("system_time_zone", "UTC"),
+    ("lower_case_table_names", "2"), ("have_openssl", "DISABLED"),
+    ("have_ssl", "DISABLED"), ("performance_schema", "OFF"),
+):
+    _sv(_name, _d, scope="none")
+
+DEFAULT_VARS = {v.name: v.default for v in SYSVARS.values()}
+
+
+def set_var(name: str, value: str, warnings: list | None = None) -> str:
+    """Validate one SET assignment → canonical stored value. Unknown
+    variables raise (ref: ErrUnknownSystemVariable); known-but-inert ones
+    append a warning so silent no-ops are visible."""
+    sv = SYSVARS.get(name)
+    if sv is None:
+        raise ValueError(f"Unknown system variable '{name}'")
+    if sv.scope == "none":
+        raise ValueError(f"Variable '{name}' is a read only variable")
+    out = sv.normalize(value)
+    if not sv.consumed and warnings is not None:
+        warnings.append(
+            f"variable '{name}' is accepted for compatibility but has no effect in this engine"
+        )
+    return out
