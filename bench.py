@@ -180,7 +180,11 @@ def main():
         mpp0 = s3.cop.mpp.compile_count if hasattr(s3.cop, "mpp") else 0
         line = _throughput(s3, tpch.Q3, q3_rows, max(5, reps // 2), host_reps, "tpch_q3_mpp")
         mpp1 = s3.cop.mpp.compile_count if hasattr(s3.cop, "mpp") else 0
-        print(json.dumps({"mpp_programs_compiled": mpp1 - mpp0}), file=sys.stderr)
+        print(json.dumps({
+            "mpp_programs_compiled": mpp1 - mpp0,
+            "mpp_fallbacks": getattr(s3.cop.mpp, "fallbacks", 0),
+            "mpp_note": getattr(s3.cop.mpp, "last_fallback_reason", ""),
+        }), file=sys.stderr)
         out.append(line)
 
     for line in out:

@@ -152,7 +152,7 @@ class TestFallbacks:
     def test_extreme_multiplicity_falls_back(self, sess):
         sess.execute("create table dup2 (d_k bigint, d_v bigint)")
         sess.execute(
-            "insert into dup2 values " + ",".join(f"(1, {i})" for i in range(40))
+            "insert into dup2 values " + ",".join(f"(1, {i})" for i in range(100))
         )
         c0 = sess.cop.mpp.compile_count
         mpp, host = _both(

@@ -1,0 +1,109 @@
+"""Security-enhanced mode (ref: util/sem/sem.go) + the HTTP admin
+endpoints (/schema, /regions, /mvcc, /settings) + metrics_summary."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+from tidb_tpu.utils import sem
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    sess.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    return sess
+
+
+class TestSEM:
+    def test_restricted_variable(self, s):
+        sem.enable()
+        try:
+            with pytest.raises(TiDBError):
+                s.execute("SET tidb_general_log = 'ON'")
+        finally:
+            sem.disable()
+        s.execute("SET tidb_general_log = 'OFF'")  # fine once disabled
+
+    def test_restricted_table(self, s):
+        assert s.must_query("SELECT COUNT(*) FROM information_schema.metrics") is not None
+        sem.enable()
+        try:
+            with pytest.raises(TiDBError):
+                s.must_query("SELECT COUNT(*) FROM information_schema.metrics")
+        finally:
+            sem.disable()
+
+    def test_file_denied(self, s, tmp_path):
+        sem.enable()
+        try:
+            with pytest.raises(TiDBError):
+                s.execute(f"SELECT * FROM t INTO OUTFILE '{tmp_path}/o.txt'")
+            with pytest.raises(TiDBError):
+                s.must_query("SELECT LOAD_FILE('/etc/hostname')")
+        finally:
+            sem.disable()
+
+
+class TestMetricsSummary:
+    def test_summary_rows(self, s):
+        from tidb_tpu.utils.metrics import HISTORY
+
+        # force a distinct baseline snapshot regardless of what earlier
+        # tests left in the process-global ring
+        with HISTORY._lock:
+            HISTORY._ring.clear()
+        HISTORY.tick(now=1000.0)
+        s.must_query("SELECT id FROM t")  # post-stmt tick lands a real-time sample
+        rows = s.must_query(
+            "SELECT METRICS_NAME, SUM_VALUE, RATE_PER_SEC FROM information_schema.metrics_summary"
+            " WHERE METRICS_NAME = 'tidb_query_total'"
+        )
+        assert rows, "query counter missing from metrics_summary"
+        name, total, rate = rows[0]
+        assert float(total) > 0
+        assert float(rate) > 0  # the window saw this test's queries
+
+
+class TestHTTPEndpoints:
+    @pytest.fixture()
+    def srv(self):
+        from tidb_tpu.server.server import Server
+
+        server = Server(port=0, status_port=0)
+        server.start()
+        yield server
+        server.close()
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.status_port}{path}") as r:
+            return json.loads(r.read())
+
+    def test_schema_and_regions_and_settings(self, srv):
+        s2 = Session(srv.storage)
+        s2.execute("CREATE TABLE ht (a INT PRIMARY KEY, b INT)")
+        s2.execute("INSERT INTO ht VALUES (7, 70)")
+        dbs = self._get(srv, "/schema")
+        assert "test" in dbs
+        tables = self._get(srv, "/schema/test")
+        assert "ht" in tables
+        tinfo = self._get(srv, "/schema/test/ht")
+        assert tinfo["name"] == "ht"
+        regs = self._get(srv, "/regions")
+        assert regs and all("region_id" in r for r in regs)
+        settings = self._get(srv, "/settings")
+        assert settings.get("tidb_cop_engine") == "auto"
+
+    def test_mvcc(self, srv):
+        s2 = Session(srv.storage)
+        s2.execute("CREATE TABLE mv (a INT PRIMARY KEY, b INT)")
+        s2.execute("INSERT INTO mv VALUES (5, 1)")
+        s2.execute("UPDATE mv SET b = 2 WHERE a = 5")
+        out = self._get(srv, "/mvcc/key/test/mv/5")
+        assert len(out["versions"]) >= 2
+        ts = [v["commit_ts"] for v in out["versions"]]
+        assert ts == sorted(ts, reverse=True)

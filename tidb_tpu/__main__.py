@@ -17,7 +17,15 @@ def main(argv=None) -> int:
     ap.add_argument("-P", "--port", type=int, default=4000, help="listen port (0 = ephemeral)")
     ap.add_argument("--log-level", default="info", choices=["debug", "info", "warn", "error"])
     ap.add_argument("--gc-life-minutes", type=int, default=10, help="MVCC GC retention window")
+    ap.add_argument(
+        "--enable-sem", action="store_true",
+        help="security enhanced mode: hide restricted vars/tables, deny FILE (ref: util/sem)",
+    )
     args = ap.parse_args(argv)
+    if args.enable_sem:
+        from .utils import sem
+
+        sem.enable()
 
     logging.basicConfig(
         level={"debug": logging.DEBUG, "info": logging.INFO, "warn": logging.WARNING, "error": logging.ERROR}[args.log_level],

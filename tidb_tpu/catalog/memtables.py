@@ -46,8 +46,8 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         [ft_longlong(), ft_varchar(64), ft_varchar(64), ft_longlong(), ft_longlong()],
     ),
     "metrics_summary": (
-        ["METRICS_NAME", "INSTANCES", "SUM_VALUE", "AVG_VALUE", "MIN_VALUE", "MAX_VALUE"],
-        [ft_varchar(64), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_double()],
+        ["METRICS_NAME", "INSTANCES", "SUM_VALUE", "AVG_VALUE", "MIN_VALUE", "MAX_VALUE", "RATE_PER_SEC"],
+        [ft_varchar(64), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_double(), ft_double()],
     ),
     "inspection_result": (
         ["RULE", "ITEM", "TYPE", "VALUE", "REFERENCE", "SEVERITY", "DETAILS"],
@@ -76,6 +76,14 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
 
 def rows_for(session, name: str) -> list[list[Datum]]:
     name = name.lower()
+    from ..utils import sem
+
+    if not sem.check_table(name):
+        from ..errors import TiDBError
+
+        raise TiDBError(
+            f"information_schema.{name} is not visible when security enhanced mode is enabled"
+        )
     if name == "tables":
         is_ = session.infoschema()
         out = []
@@ -159,17 +167,23 @@ def rows_for(session, name: str) -> list[list[Datum]]:
             ])
         return out
     if name == "metrics_summary":
-        from ..utils.metrics import REGISTRY
+        # per-base-metric aggregates over the label instances, plus the
+        # windowed per-second RATE of the summed series — the PromQL
+        # range-query analog (ref: infoschema/metric_table_def.go →
+        # utils.metrics.MetricsHistory)
+        from ..utils.metrics import HISTORY, REGISTRY
 
         agg: dict[str, list[float]] = {}
         for n, _l, v in REGISTRY.rows():
             agg.setdefault(n, []).append(float(v))
+        rates = HISTORY.base_rates()
         out = []
         for n in sorted(agg):
             vs = agg[n]
             out.append([
                 Datum.s(n), Datum.i(len(vs)), Datum.f(sum(vs)),
                 Datum.f(sum(vs) / len(vs)), Datum.f(min(vs)), Datum.f(max(vs)),
+                Datum.f(rates.get(n, 0.0)),
             ])
         return out
     if name == "views":

@@ -132,4 +132,20 @@ class MPPGatherExec(Executor):
             scan_datas.append(ScanData(sf, data, valid))
         mesh = engine._mesh if getattr(engine, "_mesh", None) is not None else make_mesh()
         engine._mesh = mesh
-        return engine.execute(self.mplan, scan_datas, mesh, self.ctx.vars)
+        res = engine.execute(self.mplan, scan_datas, mesh, self.ctx.vars)
+        if res is None:
+            return None
+        chunk, agg_done = res
+        if chunk is not None and self.mplan.agg is not None and not agg_done:
+            # the mesh joined the rows; partial aggregation finishes here
+            # (group-key domains that direct addressing can't hold)
+            from ..copr.dag import DAGRequest, ScanNode
+            from ..copr.dag import AggNode as _DagAgg
+            from ..copr.host_engine import _exec_agg
+
+            pseudo = DAGRequest(
+                ScanNode(0, list(range(chunk.num_cols)), chunk.field_types(), [])
+            )
+            pseudo.agg = _DagAgg(self.mplan.agg.group_by, self.mplan.agg.aggs)
+            chunk = _exec_agg(pseudo, chunk, None)
+        return chunk

@@ -851,6 +851,9 @@ register(_multi_str(_password_strength, infer=lambda fts: ft_longlong(), name="v
 
 
 def _load_file(p):
+    from ..utils import sem
+
+    sem.check_file_access()
     try:
         with open(_as_str(p), "rb") as f:
             return f.read()

@@ -45,7 +45,13 @@ from ..planner.fragment import BROADCAST, HASH, JoinFrag, MPPPlan, ScanFrag
 
 I64_MAX = np.iinfo(np.int64).max
 DIRECT_GROUP_MAX = 1 << 16
-MAX_BUILD_DUP = 16  # per-level probe expansion cap (shapes scale by this)
+# Per-level probe expansion cap: each probe row carries `mult` static
+# match slots, so memory scales by the build side's max key multiplicity
+# rounded to a power of two. 64 admits FK fan-outs like TPC-H
+# orders→lineitem (~Poisson(4) lines/order, max ≈ 20-30 at SF scale)
+# while the probe side of such joins stays small; truly high-duplicate
+# builds still hand over to the host hash join.
+MAX_BUILD_DUP = 64
 
 
 class ScanData:
@@ -100,6 +106,7 @@ class MPPEngine:
         self._programs: dict = {}
         self.compile_count = 0
         self.fallbacks = 0
+        self.last_fallback_reason = ""  # EXPLAIN ANALYZE / bench surface
 
     # ------------------------------------------------------------ planning
 
@@ -128,6 +135,7 @@ class MPPEngine:
                     vocabs[off] = s.vocabs[off]
             rc = [eng._rewrite(c, vocabs) for c in conds]
             if any(c is None for c in rc):
+                self.last_fallback_reason = "non-lowerable pushed condition"
                 return None
             r_pushed[id(s)] = rc
 
@@ -147,11 +155,13 @@ class MPPEngine:
                 ps, poff = scan_of_joined[pk]
                 bs, boff = scan_of_joined[bk]
                 if poff in ps.vocabs or boff in bs.vocabs:
-                    return False  # string keys: dict codes differ per table
+                    self.last_fallback_reason = "string join key"
+                    return False  # dict codes differ per table
                 vals = []
                 for sd, off in ((ps, poff), (bs, boff)):
                     d, v = sd.lane(off)
                     if d.dtype.kind == "f":
+                        self.last_fallback_reason = "float join key"
                         return False
                     if v.any():
                         vals.append((int(d[v].min()), int(d[v].max())))
@@ -169,6 +179,7 @@ class MPPEngine:
                 strides[i] = acc
                 acc *= sizes[i]
                 if acc > 1 << 62:
+                    self.last_fallback_reason = "join key domain overflow"
                     return False
             lvl = _Level(frag, los, strides)
             # build-side key multiplicity, measured on the UNFILTERED lane
@@ -178,6 +189,7 @@ class MPPEngine:
             # shapes stay sane, else host hash join takes over.
             bkeys = self._pack_host(frag.build_keys, scan_of_joined, los, strides)
             if bkeys is None:
+                self.last_fallback_reason = "unpackable build keys"
                 return False
             kv, km = bkeys
             present = kv[km]
@@ -187,6 +199,7 @@ class MPPEngine:
             else:
                 mult = 1
             if mult > MAX_BUILD_DUP:
+                self.last_fallback_reason = f"build key multiplicity {mult} > {MAX_BUILD_DUP}"
                 return False
             lvl.mult = 1 << (mult - 1).bit_length() if mult > 1 else 1
             frag.exchange = BROADCAST if bscan.n_rows <= threshold else HASH
@@ -194,6 +207,7 @@ class MPPEngine:
             # the mask model below can't express yet → host fallback
             if frag.post_conds:
                 if frag.kind != "inner":
+                    self.last_fallback_reason = "outer join with residual ON conditions"
                     return False
                 vocabs = {}
                 used = set()
@@ -206,6 +220,7 @@ class MPPEngine:
                         vocabs[j] = sd.vocabs[off]
                 lvl.r_post = [eng._rewrite(c, vocabs) for c in frag.post_conds]
                 if any(c is None for c in lvl.r_post):
+                    self.last_fallback_reason = "non-lowerable ON condition"
                     return False
             levels.append(lvl)
             return True
@@ -217,7 +232,10 @@ class MPPEngine:
         if mplan.agg is not None:
             agg_meta = self._prepare_agg(mplan, scans, scan_of_joined, eng)
             if agg_meta is None:
-                return None
+                # the JOIN still rides the mesh; the aggregation finishes
+                # on host over the joined rows (group-key domains too wide
+                # for direct addressing, e.g. raw date/orderkey keys)
+                self.last_fallback_reason = "agg on host: group-key domain too wide"
         return {
             "scan_of_joined": scan_of_joined,
             "r_pushed": r_pushed,
@@ -326,7 +344,7 @@ class MPPEngine:
                 used = set(); c.collect_columns(used)
                 for off in used:
                     need[id(s)].add(off)
-        if mplan.agg is not None:
+        if meta["agg"] is not None:
             for g in mplan.agg.group_by:
                 note(g.idx)
             for ra in meta["agg"]["r_args"]:
@@ -365,9 +383,9 @@ class MPPEngine:
             self._programs[key] = prog
             self.compile_count += 1
         outs = prog(*[jnp.asarray(a) for a in args])
-        if mplan.agg is not None:
-            return self._finalize_agg(mplan, meta, outs)
-        return self._finalize_rows(mplan, meta, scans, outs)
+        if meta["agg"] is not None:
+            return self._finalize_agg(mplan, meta, outs), True
+        return self._finalize_rows(mplan, meta, scans, outs), meta["agg"] is not None
 
     @staticmethod
     def _stream_source(frag):
@@ -407,7 +425,9 @@ class MPPEngine:
         r_pushed = meta["r_pushed"]
         levels = meta["levels"]
         agg_meta = meta["agg"]
-        agg = mplan.agg
+        # rows mode when the agg could not lower: the kernel returns the
+        # joined rows and the gather finishes the aggregation on host
+        agg = mplan.agg if agg_meta is not None else None
         scans = mplan.scans
 
         # arg unpacking plan: index into flat args per scan

@@ -98,6 +98,13 @@ def const_to_col_datum(d: Datum, ft: FieldType) -> Datum | None:
                 return Datum.f(d.to_float())
             return None
         if ft.is_string():
+            from ..mysqltypes import collate as _coll
+
+            if _coll.is_ci(getattr(ft, "collate", None)):
+                # index keys are stored in BINARY order; a ci predicate
+                # must run through the weight-aware filter path, not a
+                # binary key range (a range would drop case variants)
+                return None
             if k in (K_STR, K_BYTES):
                 return d
             return None

@@ -371,6 +371,73 @@ class Server:
                         {"connections": conns, "version": "8.0.11-tidb-tpu", "git_hash": "tpu-native"}
                     ).encode()
                     ctype = "application/json"
+                elif self.path == "/schema" or self.path.startswith("/schema/"):
+                    # /schema[/{db}[/{table}]] (ref: http_status.go /schema)
+                    from ..session import Session as _S
+
+                    sess = _S(server.storage)
+                    is_ = sess.infoschema()
+                    parts = [p for p in self.path.split("/") if p][1:]
+                    try:
+                        if not parts:
+                            out = sorted({t.db_name for t in is_.tables.values()})
+                        elif len(parts) == 1:
+                            out = sorted(
+                                t.name for t in is_.tables.values() if t.db_name == parts[0]
+                            )
+                        else:
+                            info = is_.table(parts[0], parts[1])
+                            out = info.to_json()
+                    except Exception:  # noqa: BLE001 — HTTP surface
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps(out).encode()
+                    ctype = "application/json"
+                elif self.path == "/regions":
+                    regs = [
+                        {
+                            "region_id": r.id,
+                            "start_key": r.start.hex(),
+                            "end_key": r.end.hex(),
+                            "epoch": r.epoch,
+                        }
+                        for r in list(server.storage.regions.regions)
+                    ]
+                    body = json.dumps(regs).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/mvcc/key/"):
+                    # /mvcc/key/{db}/{table}/{handle} (ref: http_status.go /mvcc)
+                    parts = [p for p in self.path.split("/") if p]
+                    if len(parts) != 5:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    from ..codec import tablecodec
+                    from ..session import Session as _S
+
+                    try:
+                        sess = _S(server.storage)
+                        info = sess.infoschema().table(parts[2], parts[3])
+                        key = tablecodec.record_key(info.id, int(parts[4]))
+                        vers = server.storage.mvcc_versions(key)
+                    except Exception:  # noqa: BLE001 — HTTP surface
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps({
+                        "key": key.hex(),
+                        "versions": [
+                            {"start_ts": s_ts, "commit_ts": c_ts, "short_value_len": ln}
+                            for s_ts, c_ts, ln in vers
+                        ],
+                    }).encode()
+                    ctype = "application/json"
+                elif self.path == "/settings":
+                    from ..session.vars import DEFAULT_VARS
+
+                    body = json.dumps(dict(sorted(DEFAULT_VARS.items()))).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()

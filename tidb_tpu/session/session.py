@@ -360,6 +360,8 @@ class Session:
                 self.store.stmt_stats.record(
                     sql, dur, self.user, self.current_db, ok, threshold, cpu_s=cpu
                 )
+                # AFTER the counters above so a snapshot sees this stmt
+                M.HISTORY.tick()  # metrics_summary window sampling
 
     def must_query(self, sql: str) -> list[tuple]:
         return self.execute(sql).rows()
@@ -632,7 +634,11 @@ class Session:
     def _execute_stmt(self, stmt, sql: str | None = None) -> ResultSet:
         self._check_privileges(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
-            return self.run_select(stmt, sql=sql)
+            return self.run_select(stmt, sql=sql, top_level=True)
+        if isinstance(stmt, (ast.Insert, ast.Update, ast.Delete)) and self.vars.get("tidb_snapshot"):
+            # a session pinned to a historic snapshot must not mutate
+            # state it cannot observe (ref: session tidb_snapshot guard)
+            raise TiDBError("can not execute write statement when 'tidb_snapshot' is set")
         if isinstance(stmt, ast.Insert):
             return self._run_insert(stmt)
         if isinstance(stmt, ast.Update):
@@ -1289,6 +1295,10 @@ class Session:
         """SELECT INTO OUTFILE (ref: executor/select_into.go): tab/newline
         separated, NULL as \\N, file must not already exist."""
         import os
+
+        from ..utils import sem
+
+        sem.check_file_access()
 
         path = stmt.into_outfile
         if os.path.exists(path):

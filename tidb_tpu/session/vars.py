@@ -214,6 +214,9 @@ def set_var(name: str, value: str, warnings: list | None = None) -> str:
     """Validate one SET assignment → canonical stored value. Unknown
     variables raise (ref: ErrUnknownSystemVariable); known-but-inert ones
     append a warning so silent no-ops are visible."""
+    from ..utils import sem
+
+    sem.check_variable(name)
     sv = SYSVARS.get(name)
     if sv is None:
         raise ValueError(f"Unknown system variable '{name}'")

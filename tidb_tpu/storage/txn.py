@@ -381,6 +381,26 @@ class Storage:
         sp = safe_point if safe_point is not None else self.tso.current()
         return self.mvcc.gc(sp)
 
+    def mvcc_versions(self, key: bytes) -> list[tuple[int, int, int]]:
+        """MVCC introspection for the HTTP /mvcc endpoint (ref:
+        http_status.go mvccTxnHandler): [(start_ts, commit_ts, value_len)]
+        newest first, across the write CF and ingest runs."""
+        from .mvcc import WriteRecord, _dk, unrev_ts
+
+        out = []
+        for k, v in self.mvcc.kv.iter_from(b"w" + key):
+            if not k.startswith(b"w" + key) or len(k) != 1 + len(key) + 8:
+                break
+            rec = WriteRecord.decode(v)
+            cts = unrev_ts(k[-8:])
+            val = self.mvcc.kv.get(_dk(key, rec.start_ts))
+            out.append((rec.start_ts, cts, len(val) if val else 0))
+        for run in reversed(self.mvcc.runs):
+            i = run.find(key)
+            if i >= 0:
+                out.append((run.commit_ts, run.commit_ts, len(run.value(i))))
+        return out
+
     # --- durability (native WAL + snapshot) --------------------------------
 
     def _wal_path(self, epoch: int) -> str:

@@ -107,6 +107,83 @@ class Registry:
 
 REGISTRY = Registry()
 
+
+class MetricsHistory:
+    """Time-windowed metric samples — the METRICS_SCHEMA stand-in for the
+    reference's PromQL range queries (ref: infoschema/metric_table_def.go,
+    metrics_schema.go). A ring of (wall ts, {series: value}) snapshots;
+    `metrics_summary` aggregates avg/min/max and counter RATES over the
+    retained window. Sampling is on-demand with a min interval (no
+    background thread to leak): every reader tick records at most one
+    snapshot per SAMPLE_EVERY seconds."""
+
+    SAMPLE_EVERY = 5.0
+    CAPACITY = 720  # ~1h at the 5s cadence
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        self._ring: list[tuple[float, dict]] = []
+        self._lock = threading.Lock()
+
+    def tick(self, now: float | None = None) -> None:
+        import time as _t
+
+        now = _t.time() if now is None else now
+        with self._lock:
+            if self._ring and now - self._ring[-1][0] < self.SAMPLE_EVERY:
+                return
+            snap = {f"{n}{{{l}}}" if l else n: v for n, l, v in self.registry.rows()}
+            self._ring.append((now, snap))
+            if len(self._ring) > self.CAPACITY:
+                del self._ring[: len(self._ring) - self.CAPACITY]
+
+    def base_rates(self) -> dict[str, float]:
+        """Per-second rate of each BASE metric (labels summed) over the
+        retained window — first→last delta / span."""
+        self.tick()
+        with self._lock:
+            ring = list(self._ring)
+        if len(ring) < 2:
+            return {}
+
+        def base_sums(snap: dict) -> dict[str, float]:
+            out: dict[str, float] = {}
+            for k, v in snap.items():
+                base = k.split("{", 1)[0]
+                out[base] = out.get(base, 0.0) + v
+            return out
+
+        first_ts, first = ring[0][0], base_sums(ring[0][1])
+        last_ts, last = ring[-1][0], base_sums(ring[-1][1])
+        span = last_ts - first_ts
+        if span <= 0:
+            return {}
+        return {k: (last.get(k, 0.0) - first.get(k, 0.0)) / span for k in last}
+
+    def summary(self) -> list[tuple[str, float, float, float, float, float]]:
+        """[(series, now_value, avg, min, max, rate_per_sec)] over the
+        retained window; rate derives from first→last counter delta."""
+        self.tick()
+        with self._lock:
+            ring = list(self._ring)
+        if not ring:
+            return []
+        series: dict[str, list[tuple[float, float]]] = {}
+        for ts, snap in ring:
+            for k, v in snap.items():
+                series.setdefault(k, []).append((ts, v))
+        out = []
+        for k in sorted(series):
+            pts = series[k]
+            vals = [v for _, v in pts]
+            span = pts[-1][0] - pts[0][0]
+            rate = (vals[-1] - vals[0]) / span if span > 0 else 0.0
+            out.append((k, vals[-1], sum(vals) / len(vals), min(vals), max(vals), rate))
+        return out
+
+
+HISTORY = MetricsHistory(REGISTRY)
+
 # core series (ref: metrics/{session,executor,distsql,ddl}.go)
 QUERY_TOTAL = REGISTRY.counter("tidb_query_total", "queries by statement type and result")
 QUERY_DURATION = REGISTRY.histogram("tidb_query_duration_seconds", "statement wall time")
