@@ -67,6 +67,27 @@ def test_sql_select_limit(s):
     assert len(s.must_query("SELECT a FROM sl")) == 5
 
 
+def test_sql_select_limit_top_level_only(s):
+    # sql_select_limit must not truncate subqueries (ref: planbuilder
+    # sql_select_limit applies to top-level queries only)
+    s.execute("CREATE TABLE slo (a INT)")
+    s.execute("INSERT INTO slo VALUES (1),(2),(3),(4),(5)")
+    s.execute("SET sql_select_limit = 2")
+    # aggregate over a derived table: the inner select must see all 5 rows
+    rows = s.must_query("SELECT COUNT(*) FROM (SELECT a FROM slo) t")
+    assert int(rows[0][0]) == 5
+    # scalar subquery in the filter sees all rows too
+    rows = s.must_query("SELECT a FROM slo WHERE a > (SELECT MIN(a) FROM slo)")
+    assert len(rows) == 2  # outer still clamped to 2
+    # INSERT ... SELECT is not top-level: must copy ALL rows, not 2
+    s.execute("CREATE TABLE slo2 (a INT)")
+    s.execute("INSERT INTO slo2 SELECT a FROM slo")
+    assert int(s.must_query("SELECT COUNT(*) FROM slo2")[0][0]) == 5
+    s.execute("SET sql_select_limit = 18446744073709551615")
+    n = s.must_query("SELECT COUNT(*) FROM (SELECT a FROM slo) t")[0][0]
+    assert int(n) == 5
+
+
 def test_max_execution_time(s):
     import numpy as np
 

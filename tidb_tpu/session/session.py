@@ -1232,7 +1232,7 @@ class Session:
         plan._uncacheable = builder.used_eager_subquery
         return plan
 
-    def run_select(self, stmt, sql: str | None = None) -> ResultSet:
+    def run_select(self, stmt, sql: str | None = None, top_level: bool = False) -> ResultSet:
         prev_hints = getattr(self, "_cur_hints", None)
         hints = self._effective_hints(stmt, sql)
         self._cur_hints = hints
@@ -1269,7 +1269,7 @@ class Session:
         if (tl is not None and tl._locks) or getattr(self, "_locked_ids", None):
             self._check_plan_locks(plan)
         sel_limit = int(self.vars.get("sql_select_limit", 2**64 - 1) or 2**64 - 1)
-        if sel_limit < 2**64 - 1 and getattr(stmt, "limit", None) is None:
+        if top_level and sel_limit < 2**64 - 1 and getattr(stmt, "limit", None) is None:
             # plant a real Limit node so execution stops early instead of
             # materializing the full result and slicing (ref: planbuilder
             # sql_select_limit handling)
