@@ -131,3 +131,233 @@ def test_tidb_snapshot_historic_read(s):
     assert s.must_query("SELECT a FROM h") == [("1",)]
     s.execute("SET tidb_snapshot = ''")
     assert len(s.must_query("SELECT a FROM h")) == 2
+
+
+# --- round 5: newly-consumed vars, one behavioral test each -----------------
+
+
+def test_registry_breadth_r5():
+    assert len(SYSVARS) >= 255
+    assert sum(1 for v in SYSVARS.values() if v.consumed) >= 55
+
+
+def test_select_sysvar(s):
+    assert s.must_query("SELECT @@version_comment") == [("tidb-tpu",)]
+    assert s.must_query("SELECT @@global.max_connections") == [("151",)]
+    assert s.must_query("SELECT @@session.autocommit") == [("ON",)]
+    with pytest.raises(TiDBError):
+        s.must_query("SELECT @@no_such_variable")
+
+
+def test_warning_error_count(s):
+    s.execute("SET tidb_hash_join_concurrency = 8")  # inert → 1 warning
+    assert s.must_query("SELECT @@warning_count") == [("1",)]
+    try:
+        s.execute("SELECT * FROM table_that_does_not_exist_xyz")
+    except TiDBError:
+        pass
+    assert s.must_query("SELECT @@error_count") == [("1",)]
+
+
+def test_warnings_reset_per_statement(s):
+    s.execute("SET tidb_hash_join_concurrency = 8")
+    assert len(s.warnings) == 1
+    s.execute("SELECT 1")
+    assert len(s.warnings) == 0  # fresh diagnostics area
+
+
+def test_cte_max_recursion_depth(s):
+    s.execute("SET cte_max_recursion_depth = 5")
+    with pytest.raises(TiDBError):
+        s.must_query(
+            "WITH RECURSIVE r(n) AS (SELECT 1 UNION ALL SELECT n+1 FROM r WHERE n < 100) SELECT COUNT(*) FROM r"
+        )
+    s.execute("SET cte_max_recursion_depth = 1000")
+    n = s.must_query(
+        "WITH RECURSIVE r(n) AS (SELECT 1 UNION ALL SELECT n+1 FROM r WHERE n < 100) SELECT COUNT(*) FROM r"
+    )[0][0]
+    assert int(n) == 100
+
+
+def test_sql_safe_updates(s):
+    s.execute("CREATE TABLE su (a INT)")
+    s.execute("INSERT INTO su VALUES (1),(2)")
+    s.execute("SET sql_safe_updates = ON")
+    with pytest.raises(TiDBError):
+        s.execute("UPDATE su SET a = 0")
+    with pytest.raises(TiDBError):
+        s.execute("DELETE FROM su")
+    s.execute("DELETE FROM su LIMIT 1")  # LIMIT satisfies safe mode
+    s.execute("UPDATE su SET a = 9 WHERE a = 2")
+    s.execute("SET sql_safe_updates = OFF")
+    s.execute("DELETE FROM su")
+
+
+def test_default_week_format(s):
+    # MySQL oracle: WEEK('2008-02-20') mode0=7, mode1=8
+    assert s.must_query("SELECT WEEK('2008-02-20')") == [("7",)]
+    s.execute("SET default_week_format = 1")
+    assert s.must_query("SELECT WEEK('2008-02-20')") == [("8",)]
+    assert s.must_query("SELECT WEEK('2008-02-20', 0)") == [("7",)]  # explicit wins
+    s.execute("SET default_week_format = 0")
+
+
+def test_week_modes_mysql_oracle(s):
+    # spot-checks against MySQL 8.0 outputs
+    rows = s.must_query(
+        "SELECT WEEK('2000-01-01',0), WEEK('2000-01-01',1), WEEK('2000-01-01',2),"
+        " WEEK('2008-12-31',1), YEARWEEK('1987-01-01'), YEARWEEK('2000-01-01',1)"
+    )
+    assert rows == [("0", "0", "52", "53", "198652", "199952")]
+
+
+def test_div_precision_increment(s):
+    assert s.must_query("SELECT 1/7") == [("0.1429",)]
+    s.execute("SET div_precision_increment = 8")
+    assert s.must_query("SELECT 1/7") == [("0.14285714",)]
+    s.execute("SET div_precision_increment = 4")
+
+
+def test_timestamp_freeze(s):
+    s.execute("SET timestamp = 1000000000")
+    one = s.must_query("SELECT NOW()")
+    import time as _t
+
+    _t.sleep(0.01)
+    assert s.must_query("SELECT NOW()") == one  # frozen clock
+    assert one[0][0].startswith("2001-09-")
+    s.execute("SET timestamp = 0")
+    assert s.must_query("SELECT YEAR(NOW())") != [("2001",)]
+
+
+def test_auto_increment_increment_offset(s):
+    s.execute("CREATE TABLE ai (id BIGINT PRIMARY KEY AUTO_INCREMENT, v INT)")
+    s.execute("SET auto_increment_increment = 10")
+    s.execute("SET auto_increment_offset = 5")
+    s.execute("INSERT INTO ai (v) VALUES (1),(2),(3)")
+    ids = [int(r[0]) for r in s.must_query("SELECT id FROM ai ORDER BY id")]
+    assert ids == [5, 15, 25]
+    assert all(i % 10 == 5 for i in ids)
+    s.execute("SET auto_increment_increment = 1")
+    s.execute("SET auto_increment_offset = 1")
+
+
+def test_last_insert_id_var(s):
+    s.execute("CREATE TABLE li (id BIGINT PRIMARY KEY AUTO_INCREMENT, v INT)")
+    s.execute("INSERT INTO li (v) VALUES (42)")
+    assert s.must_query("SELECT @@last_insert_id") == [("1",)]
+
+
+def test_multi_statement_mode(s):
+    with pytest.raises(TiDBError):
+        s.execute("SELECT 1; SELECT 2")
+    s.execute("SET tidb_multi_statement_mode = ON")
+    assert s.must_query("SELECT 1; SELECT 2") == [("2",)]
+    s.execute("SET tidb_multi_statement_mode = WARN")
+    s.execute("SELECT 1; SELECT 2")
+    assert any("multi-statement" in w for w in s.warnings)
+    s.execute("SET tidb_multi_statement_mode = OFF")
+
+
+def test_enable_index_merge_gate(s):
+    s.execute("CREATE TABLE im (a INT, b INT, c INT)")
+    s.execute("CREATE INDEX ia ON im (a)")
+    s.execute("CREATE INDEX ib ON im (b)")
+    rows = ",".join(f"({i%50},{i%70},{i})" for i in range(500))
+    s.execute(f"INSERT INTO im VALUES {rows}")
+    q = "SELECT COUNT(*) FROM im WHERE a = 3 OR b = 9"
+    on_plan = "\n".join(r[0] for r in s.must_query(f"EXPLAIN {q}"))
+    s.execute("SET tidb_enable_index_merge = OFF")
+    off_plan = "\n".join(r[0] for r in s.must_query(f"EXPLAIN {q}"))
+    s.execute("SET tidb_enable_index_merge = ON")
+    assert "IndexMerge" in on_plan
+    assert "IndexMerge" not in off_plan
+    # parity either way
+    assert s.must_query(q) == s.must_query(q)
+
+
+def test_join_reorder_threshold_dp(s):
+    from tidb_tpu.planner.optimizer import REORDER_STATS
+
+    s.execute("CREATE TABLE j1 (a INT)")
+    s.execute("CREATE TABLE j2 (a INT)")
+    s.execute("CREATE TABLE j3 (a INT)")
+    for t, n in (("j1", 40), ("j2", 20), ("j3", 10)):
+        s.execute(f"INSERT INTO {t} VALUES " + ",".join(f"({i})" for i in range(n)))
+    q = "SELECT COUNT(*) FROM j1 JOIN j2 ON j1.a = j2.a JOIN j3 ON j2.a = j3.a"
+    before = dict(REORDER_STATS)
+    greedy_n = s.must_query(q)
+    assert REORDER_STATS["greedy"] > before["greedy"]
+    s.execute("SET tidb_opt_join_reorder_threshold = 8")
+    before = dict(REORDER_STATS)
+    dp_n = s.must_query(q)
+    assert REORDER_STATS["dp"] > before["dp"]
+    assert greedy_n == dp_n  # same answer either solver
+    s.execute("SET tidb_opt_join_reorder_threshold = 0")
+
+
+def test_redact_and_stmt_summary_knobs(s):
+    s.execute("SET tidb_redact_log = ON")
+    s.execute("SET tidb_stmt_summary_max_sql_length = 32")
+    s.execute("CREATE TABLE rd (a INT)")
+    s.execute("INSERT INTO rd VALUES (31337)")
+    summ = s.store.stmt_stats.summary
+    hit = next(st for st in summ.values() if "rd" in st["sample_sql"] and "insert" in st["sample_sql"].lower())
+    assert "31337" not in hit["sample_sql"]  # literal redacted
+    assert len(hit["sample_sql"]) <= 32
+    s.execute("SET tidb_redact_log = OFF")
+    # summary gate
+    s.execute("SET tidb_enable_stmt_summary = OFF")
+    n0 = len(s.store.stmt_stats.summary)
+    s.execute("SELECT 1 + 99")
+    assert len(s.store.stmt_stats.summary) == n0
+    s.execute("SET tidb_enable_stmt_summary = ON")
+
+
+def test_gc_sysvars(s):
+    s.execute("SET GLOBAL tidb_gc_life_time = '30m'")
+    assert s.store.gc_worker.life_ms == 30 * 60 * 1000
+    s.execute("SET GLOBAL tidb_gc_run_interval = '1h'")
+    assert s.store.gc_worker.interval_ms == 60 * 60 * 1000
+    s.execute("SET GLOBAL tidb_gc_enable = OFF")
+    assert s.store.gc_worker.tick() == 0
+    s.execute("SET GLOBAL tidb_gc_enable = ON")
+    with pytest.raises(TiDBError):
+        s.execute("SET GLOBAL tidb_gc_life_time = 'not-a-duration'")
+    s.execute("SET GLOBAL tidb_gc_life_time = '10m0s'")
+
+
+def test_disable_txn_auto_retry(s):
+    # OFF enables the optimistic auto-retry: a conflicting concurrent
+    # commit must not surface WriteConflict to the client
+    from tidb_tpu.session import Session
+
+    s.execute("CREATE TABLE ar (k INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO ar VALUES (1, 0)")
+    s.execute("SET tidb_disable_txn_auto_retry = OFF")
+    s2 = Session(s.store, cop_client=s.cop)
+    s2.execute("USE test")
+    # interleave: s starts a txn implicitly, s2 commits first
+    s.execute("BEGIN")
+    s.execute("UPDATE ar SET v = v + 1 WHERE k = 1")
+    s2.execute("UPDATE ar SET v = v + 10 WHERE k = 1")
+    from tidb_tpu.errors import WriteConflict
+
+    with pytest.raises(WriteConflict):
+        s.execute("COMMIT")  # explicit txn: never auto-retried
+    s.execute("SET tidb_disable_txn_auto_retry = ON")
+
+
+def test_mem_quota_topn(s):
+    s.execute("CREATE TABLE tq (a INT, b VARCHAR(64))")
+    rows = ",".join(f"({i}, 'pad-{i:052d}')" for i in range(8000))
+    s.execute(f"INSERT INTO tq VALUES {rows}")
+    from tidb_tpu.errors import MemoryQuotaExceeded
+
+    s.execute("SET tidb_mem_quota_topn = 4096")
+    s.vars["tidb_cop_engine"] = "host"
+    with pytest.raises((MemoryQuotaExceeded, TiDBError)):
+        s.must_query("SELECT a, b FROM tq ORDER BY b DESC LIMIT 2000")
+    s.execute("SET tidb_mem_quota_topn = 34359738368")
+    assert len(s.must_query("SELECT a, b FROM tq ORDER BY b DESC LIMIT 2000")) == 2000
+    s.vars["tidb_cop_engine"] = "auto"

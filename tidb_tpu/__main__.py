@@ -47,9 +47,16 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
+    import time as _t
+
+    last_gc = _t.time()
     while not stop.is_set():
         stop.wait(30)
-        srv.storage.gc_worker.tick()  # background GC loop (gc_worker leaderTick)
+        # background GC loop honoring the LIVE tidb_gc_run_interval
+        # (leaderTick; a SET GLOBAL takes effect on the next wakeup)
+        if _t.time() - last_gc >= srv.storage.gc_worker.interval_ms / 1000.0:
+            srv.storage.gc_worker.tick()
+            last_gc = _t.time()
     return 0
 
 

@@ -171,7 +171,8 @@ class DDLWorker:
         tbl = Table(t)
         prefix = tablecodec.record_prefix(t.id)
         start = prefix if job.reorg_handle is None else tablecodec.record_key(t.id, job.reorg_handle + 1)
-        rows = txn.scan(start, prefix + b"\xff", limit=BACKFILL_BATCH)
+        batch = int(job.args.get("reorg_batch_size", BACKFILL_BATCH))
+        rows = txn.scan(start, prefix + b"\xff", limit=batch)
         last_handle = None
         for k, v in rows:
             handle = tablecodec.decode_record_handle(k)
@@ -202,7 +203,7 @@ class DDLWorker:
             return False
         if last_handle is not None:
             self._fire("backfill_batch", job)
-        return len(rows) < BACKFILL_BATCH
+        return len(rows) < batch
 
     def _rollback_add_index(self, job: DDLJob) -> None:
         """Duplicate data found mid-reorg: retract the index (reverse

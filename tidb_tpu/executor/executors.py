@@ -142,6 +142,9 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
                     plan.kind, plan.eq_conds, plan.other_conds, out_fts,
                 )
         quota = int(ctx.vars.get("tidb_mem_quota_query", "0") or 0)
+        hj_quota = int(ctx.vars.get("tidb_mem_quota_hashjoin", "0") or 0)
+        if hj_quota > 0:
+            quota = min(quota, hj_quota) if quota > 0 else hj_quota
         return HashJoinExec(
             build_executor(plan.children[0], ctx),
             build_executor(plan.children[1], ctx),
@@ -169,6 +172,9 @@ def build_executor(plan: LogicalPlan, ctx: ExecContext) -> Executor:
         )
     if isinstance(plan, Sort):
         quota = int(ctx.vars.get("tidb_mem_quota_query", "0") or 0)
+        sort_quota = int(ctx.vars.get("tidb_mem_quota_sort", "0") or 0)
+        if sort_quota > 0:
+            quota = min(quota, sort_quota) if quota > 0 else sort_quota
         return SortExec(build_executor(plan.children[0], ctx), plan.by, spill_limit=quota)
     if isinstance(plan, Limit):
         return _build_limit(plan, ctx)
@@ -1350,6 +1356,7 @@ class TopNExec(SortExec):
         if self._out is None:
             k = self.offset + self.count
             sess = _ACTIVE_SESSION.get()
+            tq = int(sess.vars.get("tidb_mem_quota_topn", "0") or 0) if sess is not None else 0
             buf: Chunk | None = None
             self.child.open()
             try:
@@ -1367,6 +1374,18 @@ class TopNExec(SortExec):
                     buf = c if buf is None else Chunk.concat_all([buf, c])
                     if buf.num_rows > max(4 * k, 4096):
                         buf = self._sort_in_mem(buf).slice(0, k)
+                    if tq > 0:
+                        # tidb_mem_quota_topn bounds the retained top-k
+                        # working set (ref: TopNExec memTracker + the
+                        # per-operator quota actions)
+                        from ..utils.memory import chunk_bytes
+
+                        if chunk_bytes(buf) > tq:
+                            from ..errors import MemoryQuotaExceeded
+
+                            raise MemoryQuotaExceeded(
+                                f"Out Of Memory Quota! [topn] working set > {tq}"
+                            )
             finally:
                 self.child.close()
             if buf is None:
@@ -1657,6 +1676,16 @@ class FinalHashAggExec(Executor):
                     out.append((d.is_null, None if d.is_null else d.val))
             return tuple(out)
 
+        # the group hash table is the aggregate's real working set; charge
+        # it to the statement tracker unless the session opted out
+        # (ref: aggregate.go memTracker + tidb_track_aggregate_memory_usage)
+        tracker = _ACTIVE_TRACKER.get()
+        sess = _ACTIVE_SESSION.get()
+        if tracker is not None and sess is not None:
+            if sess.vars.get("tidb_track_aggregate_memory_usage", "ON") != "ON":
+                tracker = None
+        group_entry_bytes = 64 + 32 * len(self.aggs)
+
         groups: dict = {}
         firsts: dict = {}
         order: list = []
@@ -1672,6 +1701,8 @@ class FinalHashAggExec(Executor):
                     groups[key] = st
                     firsts[key] = tuple(row[:ngroup])
                     order.append(key)
+                    if tracker is not None and len(order) % 4096 == 0:
+                        tracker.consume(4096 * group_entry_bytes)
                 self._merge_row(st, row[ngroup:])
         if not groups and not self.group_by:
             # global aggregate over empty input: one row of "empty" values
@@ -2523,17 +2554,26 @@ class IndexLookupJoinExec(Executor):
             encode_datum_key(buf, dat)
             enc = bytes(buf)
             ranges.append((enc, enc + b"\xff"))
+        # probe/fetch batching (ref: executor/index_lookup_join.go —
+        # tidb_index_join_batch_size outer keys per probe round,
+        # tidb_index_lookup_size handles per lookup task)
+        join_batch = max(1, int(self.ctx.vars.get("tidb_index_join_batch_size", "25000")))
+        lookup_size = max(1, int(self.ctx.vars.get("tidb_index_lookup_size", "20000")))
         handles = []
-        if ranges:
+        for i in range(0, len(ranges), join_batch):
             entries = self.ctx.cop.index_entries(
-                self.table, self.index, ranges, self.ctx.read_ts, txn=self.ctx.txn
+                self.table, self.index, ranges[i : i + join_batch],
+                self.ctx.read_ts, txn=self.ctx.txn,
             )
-            handles = [h for _, h in entries]
-        chunks = list(
-            self.ctx.cop.send_handles(
-                self.table, self.dag, handles, self.ctx.read_ts, self.ctx.engine, txn=self.ctx.txn
+            handles.extend(h for _, h in entries)
+        chunks = []
+        for i in range(0, len(handles), lookup_size):
+            chunks.extend(
+                self.ctx.cop.send_handles(
+                    self.table, self.dag, handles[i : i + lookup_size],
+                    self.ctx.read_ts, self.ctx.engine, txn=self.ctx.txn,
+                )
             )
-        )
         rchunk = Chunk.concat_all(chunks) if chunks else Chunk.empty(self.dag.output_types(), 0)
         inner = HashJoinExec(
             ChunkSourceExec(lchunk, [c.ft for c in lchunk.columns]),
@@ -2619,7 +2659,8 @@ class RecursiveCTEExec(Executor):
                 seed = seed.take(np.asarray(keep, dtype=np.int64))
         result = [seed]
         work = seed
-        for _ in range(self.MAX_ITER):
+        max_iter = int(self.ctx.vars.get("cte_max_recursion_depth", self.MAX_ITER))
+        for _ in range(max_iter):
             if work.num_rows == 0:
                 break
             self.plan.storage.chunk = work

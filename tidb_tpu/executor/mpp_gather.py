@@ -80,7 +80,17 @@ class MPPGatherExec(Executor):
         # engine declined at prepare time (non-unique build keys,
         # non-lowerable conds, ...): degrade to the host join path over
         # the original join subtree (slicing never mutated it)
-        from .executors import LocalPartialAggExec, build_executor, drain
+        from .executors import LocalPartialAggExec, _ACTIVE_SESSION, build_executor, drain
+
+        if self.ctx.vars.get("tidb_enforce_mpp", "OFF") == "ON":
+            # the user demanded MPP; surface why it degraded (ref:
+            # planner ErrInternal warnings under tidb_enforce_mpp)
+            sess = _ACTIVE_SESSION.get()
+            if sess is not None:
+                reason = getattr(self.ctx.cop.mpp, "last_fallback_reason", "") or "not supported"
+                sess.warnings.append(
+                    f"MPP mode may be blocked because: {reason} (tidb_enforce_mpp=ON)"
+                )
 
         host_ctx = ExecContext(
             self.ctx.cop, self.ctx.read_ts, engine="host",

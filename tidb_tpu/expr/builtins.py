@@ -69,10 +69,21 @@ def infer_arith(op: str):
     return infer
 
 
+def _div_frac_incr() -> int:
+    """Division scale growth — div_precision_increment when a session is
+    active (ref: expression/builtin_arithmetic.go deriveDivisionScale)."""
+    from . import sessioninfo
+
+    try:
+        return int((sessioninfo.get("vars") or {}).get("div_precision_increment", DIV_FRAC_INCR))
+    except (TypeError, ValueError):
+        return DIV_FRAC_INCR
+
+
 def infer_div(fts):
     if any(ft.is_float() or ft.is_string() for ft in fts):
         return ft_double()
-    s = max((_scale(ft) for ft in fts), default=0) + DIV_FRAC_INCR
+    s = max((_scale(ft) for ft in fts), default=0) + _div_frac_incr()
     if s > DEC_LANE_MAX_SCALE:
         return ft_double()
     return ft_decimal(30, s)

@@ -161,7 +161,7 @@ register(FuncSig("dayofweek", lambda fts: ft_longlong(), _per_row_time(lambda t:
 register(FuncSig("weekday", lambda fts: ft_longlong(), _per_row_time(lambda t: t.weekday()), pushable=False, arity=1))
 register(FuncSig("dayofyear", lambda fts: ft_longlong(), _per_row_time(lambda t: t.timetuple().tm_yday), pushable=False, arity=1))
 register(FuncSig("quarter", lambda fts: ft_longlong(), _per_row_time(lambda t: (t.month - 1) // 3 + 1), pushable=False, arity=1))
-register(FuncSig("week", lambda fts: ft_longlong(), _per_row_time(lambda t: int(t.strftime("%U"))), pushable=False, arity=1))
+# week/yearweek: mode-aware _calc_week implementations in builtins_ext2
 register(FuncSig("dayname", lambda fts: ft_varchar(16), _per_row_time(lambda t: t.strftime("%A"), "str"), pushable=False, arity=1))
 register(FuncSig("monthname", lambda fts: ft_varchar(16), _per_row_time(lambda t: t.strftime("%B"), "str"), pushable=False, arity=1))
 register(

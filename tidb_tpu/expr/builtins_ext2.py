@@ -544,26 +544,77 @@ register(FuncSig("period_add", lambda fts: ft_longlong(), _obj_map(
     lambda p, n: _months_to_period(_period_to_months(p) + int(n))), pushable=False, arity=2))
 register(FuncSig("period_diff", lambda fts: ft_longlong(), _obj_map(
     lambda a, b: _period_to_months(a) - _period_to_months(b)), pushable=False, arity=2))
-def _yearweek_mode0(d: _dt.date) -> int:
-    """MySQL mode 0: Sunday-start weeks; days before the year's first
-    Sunday belong to the previous year's last week."""
+def _days_in_year(y: int) -> int:
+    return 366 if (y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)) else 365
+
+
+def _calc_week(d: _dt.date, mode: int):
+    """MySQL's calc_week bit semantics (WEEK_MONDAY_FIRST=1, WEEK_YEAR=2,
+    WEEK_FIRST_WEEKDAY=4) — the spec behind WEEK()/YEARWEEK() modes 0-7
+    (ref: expression/builtin_time.go calcWeek)."""
+    monday_first = bool(mode & 1)
+    week_year = bool(mode & 2)
+    first_weekday = bool(mode & 4)
+    daynr = d.toordinal()
     jan1 = _dt.date(d.year, 1, 1)
-    first_sunday = jan1 + _dt.timedelta(days=(6 - jan1.weekday()) % 7)
-    if d < first_sunday:
-        return _yearweek_mode0(_dt.date(d.year - 1, 12, 31))
-    return d.year * 100 + (d - first_sunday).days // 7 + 1
+    first_daynr = jan1.toordinal()
+    wd = jan1.weekday()  # Monday=0
+    weekday = wd if monday_first else (wd + 1) % 7
+    year = d.year
+    if d.month == 1 and d.day <= 7 - weekday:
+        if not week_year and (
+            (first_weekday and weekday != 0) or (not first_weekday and weekday >= 4)
+        ):
+            return year, 0
+        week_year = True
+        year -= 1
+        diy = _days_in_year(year)
+        first_daynr -= diy
+        weekday = (weekday + 53 * 7 - diy) % 7
+    if (first_weekday and weekday != 0) or (not first_weekday and weekday >= 4):
+        days = daynr - (first_daynr + (7 - weekday))
+    else:
+        days = daynr - (first_daynr - weekday)
+    if week_year and days >= 52 * 7:
+        weekday = (weekday + _days_in_year(year)) % 7
+        if (not first_weekday and weekday < 4) or (first_weekday and weekday == 0):
+            return year + 1, 1
+    return year, days // 7 + 1
 
 
-def _yearweek(v, *mode):
+def _week_mode(mode: int) -> int:
+    mode &= 7
+    if not (mode & 1):
+        mode ^= 4
+    return mode
+
+
+def _default_week_mode() -> int:
+    from . import sessioninfo
+
+    try:
+        return int((sessioninfo.get("vars") or {}).get("default_week_format", "0"))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _week(v, *mode):
     t = _to_date(v)
-    m = int(mode[0]) if mode and mode[0] is not None else 0
-    if m % 2:  # Monday-start modes → ISO weeks
-        iso = t.isocalendar()
-        return iso[0] * 100 + iso[1]
-    return _yearweek_mode0(t.date() if isinstance(t, _dt.datetime) else t)
+    d = t.date() if isinstance(t, _dt.datetime) else t
+    m = int(mode[0]) if mode and mode[0] is not None else _default_week_mode()
+    return _calc_week(d, _week_mode(m))[1]
 
 
-register(FuncSig("yearweek", lambda fts: ft_longlong(), _obj_map(_yearweek), pushable=False, arity=(1, 2)))
+def _yearweek2(v, *mode):
+    t = _to_date(v)
+    d = t.date() if isinstance(t, _dt.datetime) else t
+    m = int(mode[0]) if mode and mode[0] is not None else _default_week_mode()
+    y, w = _calc_week(d, _week_mode(m | 2))
+    return y * 100 + w
+
+
+register(FuncSig("week", lambda fts: ft_longlong(), _obj_map(_week), pushable=False, arity=(1, 2)))
+register(FuncSig("yearweek", lambda fts: ft_longlong(), _obj_map(_yearweek2), pushable=False, arity=(1, 2)))
 register(FuncSig("weekofyear", lambda fts: ft_longlong(), _obj_map(
     lambda v: _to_date(v).isocalendar()[1]), pushable=False, arity=1))
 register(_multi_str(lambda: _dt.datetime.utcnow().strftime("%Y-%m-%d"), name="utc_date", arity=0))

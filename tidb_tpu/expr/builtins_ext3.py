@@ -450,8 +450,12 @@ register(
 # ---------------------------------------------------------------------------
 
 
+def _now_epoch():
+    return sessioninfo.now_epoch()
+
+
 def _now_packed():
-    t = _time.localtime()
+    t = _time.localtime(_now_epoch())
     return _ct.pack_time(t.tm_year, t.tm_mon, t.tm_mday, t.tm_hour, t.tm_min, t.tm_sec)
 
 
@@ -472,13 +476,13 @@ for _nm in ("now", "sysdate", "current_timestamp", "localtime", "localtimestamp"
 for _nm in ("curdate", "current_date"):
     _time_func(
         _nm,
-        lambda: _ct.pack_time(_time.localtime().tm_year, _time.localtime().tm_mon, _time.localtime().tm_mday),
+        lambda: (lambda t: _ct.pack_time(t.tm_year, t.tm_mon, t.tm_mday))(_time.localtime(_now_epoch())),
         TypeCode.Date,
     )
 
 
 def _curtime_us():
-    t = _time.localtime()
+    t = _time.localtime(_now_epoch())
     return (t.tm_hour * 3600 + t.tm_min * 60 + t.tm_sec) * _US
 
 
@@ -487,7 +491,7 @@ for _nm in ("curtime", "current_time"):
 
 
 def _utc_time_us():
-    t = _time.gmtime()
+    t = _time.gmtime(_now_epoch())
     return (t.tm_hour * 3600 + t.tm_min * 60 + t.tm_sec) * _US
 
 

@@ -19,3 +19,21 @@ def get(key: str, default=None):
     except LookupError:
         return default
     return info.get(key, default)
+
+
+def now_epoch(vars_dict: dict | None = None) -> float:
+    """NOW()'s clock: the `timestamp` sysvar freezes it when set (MySQL
+    SET timestamp=N; replication/test determinism), else wall clock.
+    Shared by plan-time constant folding and the runtime kernels so the
+    two can never disagree on freeze semantics."""
+    import time
+
+    if vars_dict is None:
+        vars_dict = get("vars") or {}
+    frozen = vars_dict.get("timestamp", "")
+    if frozen not in ("", "0", None):
+        try:
+            return float(frozen)
+        except ValueError:
+            pass
+    return time.time()

@@ -141,6 +141,9 @@ class MPPEngine:
 
         # per join level: key packing + uniqueness + exchange mode
         threshold = int(variables.get("tidb_broadcast_join_threshold_count", 10240))
+        size_threshold = int(
+            variables.get("tidb_broadcast_join_threshold_size", 100 * 1024 * 1024)
+        )
         levels: list[_Level] = []
 
         def visit(frag):
@@ -202,7 +205,15 @@ class MPPEngine:
                 self.last_fallback_reason = f"build key multiplicity {mult} > {MAX_BUILD_DUP}"
                 return False
             lvl.mult = 1 << (mult - 1).bit_length() if mult > 1 else 1
-            frag.exchange = BROADCAST if bscan.n_rows <= threshold else HASH
+            # broadcast only when the build side is small by BOTH row count
+            # and estimated bytes (ref: tidb_broadcast_join_threshold_count
+            # / _size in planner/core exhaust_physical_plans.go)
+            build_bytes = bscan.n_rows * 8 * max(1, len(bscan.frag.ds.out_cols))
+            frag.exchange = (
+                BROADCAST
+                if bscan.n_rows <= threshold and build_bytes <= size_threshold
+                else HASH
+            )
             # left join with extra ON conditions filters *matches*, which
             # the mask model below can't express yet → host fallback
             if frag.post_conds:

@@ -112,10 +112,53 @@ class NameScope:
 class PlanBuilder:
     """Builds logical plans; needs a catalog view + subquery executor hook."""
 
+    def _now_epoch(self) -> float:
+        from ..expr.sessioninfo import now_epoch
+
+        return now_epoch(self.context_info.get("vars") or {})
+
+    def _sysvar_constant(self, raw: str) -> Expression:
+        """SELECT @@x / @@global.x / @@session.x → typed constant from the
+        session registry (ref: expression/util.go GetSessionOrGlobalSystemVar;
+        connectors issue these on connect, e.g. @@version_comment)."""
+        from ..session.vars import SYSVARS
+
+        name = raw
+        for pre in ("global.", "session.", "local."):
+            if name.startswith(pre):
+                name = name[len(pre):]
+                break
+        sv = SYSVARS.get(name)
+        if sv is None:
+            raise TiDBError(f"Unknown system variable '{name}'")
+        reader = self.context_info.get("sysvar_read")
+        if reader is not None:
+            val = reader(name)
+        else:
+            val = self.context_info.get("vars", {}).get(name, sv.default)
+        # live session state must not be baked into a cached plan
+        self.used_eager_subquery = True
+        if val is None:
+            return Constant(Datum.null(), FieldType(TypeCode.Null))
+        if sv.kind == "int":
+            try:
+                return Constant(Datum.i(int(val)), ft_longlong())
+            except (TypeError, ValueError):
+                pass
+        if sv.kind == "float":
+            try:
+                return Constant(Datum.f(float(val)), ft_double())
+            except (TypeError, ValueError):
+                pass
+        s = str(val)
+        return Constant(Datum.s(s), ft_varchar(max(len(s), 1)))
+
     def _resolve_name(self, node: ast.Name, scope: NameScope) -> Expression:
         """Resolve a column name; names unknown in the local scope fall
         back to the enclosing query's scope as correlated references
         (ref: expression.CorrelatedColumn, rule_decorrelate.go)."""
+        if len(node.parts) == 1 and node.parts[0].startswith("@@"):
+            return self._sysvar_constant(node.parts[0][2:])
         try:
             idx = scope.resolve(node)
         except UnknownColumn:
@@ -523,16 +566,16 @@ class PlanBuilder:
             return Constant(Datum.i(int(self.context_info.get("conn_id", 0))), ft_longlong())
         if lname in ("now", "current_timestamp", "sysdate", "localtime", "localtimestamp"):
             self.used_eager_subquery = True
-            t = _time.localtime()
+            t = _time.localtime(self._now_epoch())
             ft = FieldType(TC.Datetime)
             return Constant(Datum.t(pack_time(t.tm_year, t.tm_mon, t.tm_mday, t.tm_hour, t.tm_min, t.tm_sec)), ft)
         if lname in ("curdate", "current_date"):
             self.used_eager_subquery = True
-            t = _time.localtime()
+            t = _time.localtime(self._now_epoch())
             return Constant(Datum.t(pack_time(t.tm_year, t.tm_mon, t.tm_mday)), FieldType(TC.Date))
         if lname in ("curtime", "current_time"):
             self.used_eager_subquery = True
-            t = _time.localtime()
+            t = _time.localtime(self._now_epoch())
             us = (t.tm_hour * 3600 + t.tm_min * 60 + t.tm_sec) * 1_000_000
             return Constant(Datum(K_DUR, us), FieldType(TC.Duration))
         return None

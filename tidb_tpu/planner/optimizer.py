@@ -12,15 +12,15 @@ from ..expr.expression import Column as ECol, Constant, Expression, ScalarFunc, 
 from .plans import Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, Projection, Selection, SetOp, Sort, Window
 
 
-def optimize(plan: LogicalPlan, stats=None) -> LogicalPlan:
+def optimize(plan: LogicalPlan, stats=None, variables=None) -> LogicalPlan:
     # Column pruning is implicit in this architecture: the tile cache holds
     # whole-table columnar batches decoded once per version, host chunks
     # reference those arrays zero-copy, and the device engine ships only
     # lanes referenced by DAG expressions. The usage analysis below serves
     # index-covering decisions.
     plan = push_down_predicates(plan)
-    plan = reorder_joins(plan, stats)
-    choose_access_paths(plan, stats)
+    plan = reorder_joins(plan, stats, variables)
+    choose_access_paths(plan, stats, variables)
     return plan
 
 
@@ -45,7 +45,7 @@ def _reorderable(n) -> bool:
     )
 
 
-def reorder_joins(root: LogicalPlan, stats=None) -> LogicalPlan:
+def reorder_joins(root: LogicalPlan, stats=None, variables=None) -> LogicalPlan:
     """Greedy join reorder for inner-join groups over base tables (ref:
     planner/core/rule_join_reorder.go joinReorderGreedySolver): start
     from the smallest estimated leaf, repeatedly join the connected leaf
@@ -58,7 +58,7 @@ def reorder_joins(root: LogicalPlan, stats=None) -> LogicalPlan:
         # unit — a bottom-up walk would rewrite the inner trio first and
         # hide the outer tables behind the restoring Projection
         if _reorderable(n) and any(_reorderable(c) for c in n.children):
-            out = _reorder_group(n, stats)
+            out = _reorder_group(n, stats, variables)
             if out is not None:
                 # the group's leaves were not visited yet; a second pass
                 # over the rebuilt tree is a no-op for the group itself
@@ -85,7 +85,40 @@ def _leaf_estimate(ds, stats) -> float:
     return max(estimate_conds(tstats, ds.pushed_conds, ds.table.visible_columns()) * total, 1.0)
 
 
-def _reorder_group(root: Join, stats):
+REORDER_STATS = {"dp": 0, "greedy": 0}  # observable algorithm choice
+
+
+def _dp_order(leaves, est, edges):
+    """Left-deep exhaustive order via subset DP minimizing the summed
+    intermediate cardinality (ref: rule_join_reorder_dp.go); eq-join
+    connectivity earns a flat reduction factor — the same signal the
+    greedy solver ranks by, applied optimally."""
+    n = len(leaves)
+    conn = [[False] * n for _ in range(n)]
+    for a, b in edges:
+        conn[a][b] = conn[b][a] = True
+    best: dict = {}
+    for i in range(n):
+        best[1 << i] = (0.0, float(est[i]), (i,))
+    for mask in range(1, 1 << n):
+        cur = best.get(mask)
+        if cur is None:
+            continue
+        cost, rows, order = cur
+        for j in range(n):
+            if mask & (1 << j):
+                continue
+            joined = rows * float(est[j])
+            if any(conn[i][j] for i in order):
+                joined *= 0.1  # eq-join selectivity proxy
+            nm = mask | (1 << j)
+            nc = cost + joined
+            if nm not in best or nc < best[nm][0]:
+                best[nm] = (nc, joined, order + (j,))
+    return list(best[(1 << n) - 1][2])
+
+
+def _reorder_group(root: Join, stats, variables=None):
     # 1. flatten the maximal inner-join subtree into leaves + global conds
     leaves: list = []  # (node, old_offset, width)
     eq_conds: list = []  # (l_expr, r_expr) in OLD global coordinates
@@ -124,18 +157,26 @@ def _reorder_group(root: Join, stats):
         if len(ls) == 1 and len(rs) == 1 and ls != rs:
             edges.append((next(iter(ls)), next(iter(rs))))
 
-    # 3. greedy order
-    order = [min(range(len(leaves)), key=lambda i: est[i])]
-    chosen = set(order)
-    while len(order) < len(leaves):
-        connected = [
-            i for i in range(len(leaves)) if i not in chosen
-            and any((a in chosen) != (b in chosen) and i in (a, b) for a, b in edges)
-        ]
-        pool = connected or [i for i in range(len(leaves)) if i not in chosen]
-        nxt = min(pool, key=lambda i: est[i])
-        order.append(nxt)
-        chosen.add(nxt)
+    # 3. join order: small groups run the exhaustive subset-DP solver,
+    # larger ones the greedy solver (ref: rule_join_reorder.go — DP when
+    # n <= tidb_opt_join_reorder_threshold, default 0 = always greedy)
+    threshold = int((variables or {}).get("tidb_opt_join_reorder_threshold", "0") or 0)
+    if 0 < len(leaves) <= min(threshold, 12):
+        order = _dp_order(leaves, est, edges)
+        REORDER_STATS["dp"] += 1
+    else:
+        order = [min(range(len(leaves)), key=lambda i: est[i])]
+        chosen = set(order)
+        while len(order) < len(leaves):
+            connected = [
+                i for i in range(len(leaves)) if i not in chosen
+                and any((a in chosen) != (b in chosen) and i in (a, b) for a, b in edges)
+            ]
+            pool = connected or [i for i in range(len(leaves)) if i not in chosen]
+            nxt = min(pool, key=lambda i: est[i])
+            order.append(nxt)
+            chosen.add(nxt)
+        REORDER_STATS["greedy"] += 1
     if order == list(range(len(leaves))):
         return None  # already optimal order: keep the original tree
 
@@ -387,7 +428,7 @@ def _analyze_usage(node: LogicalPlan, uses: dict):
     return [None] * len(node.out_cols)
 
 
-def choose_access_paths(root: LogicalPlan, stats=None) -> None:
+def choose_access_paths(root: LogicalPlan, stats=None, variables=None) -> None:
     """Pick per-DataSource access paths: PointGet / table handle ranges /
     covering IndexReader / IndexLookUp double read (ref: planner/core
     find_best_task.go skyline+cost pruning; here a deterministic heuristic
@@ -400,7 +441,7 @@ def choose_access_paths(root: LogicalPlan, stats=None) -> None:
 
     def walk(n: LogicalPlan):
         if isinstance(n, DataSource):
-            _choose_for_ds(n, uses.get(id(n), set()), stats)
+            _choose_for_ds(n, uses.get(id(n), set()), stats, variables)
         for c in n.children:
             walk(c)
 
@@ -433,7 +474,7 @@ def _prune_partitions(table, conds, vis_by_off):
     return part.prune(lo=lo, hi=hi)
 
 
-def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
+def _choose_for_ds(ds: DataSource, used: set, stats=None, variables=None) -> None:
     from . import ranger
 
     table = ds.table
@@ -555,7 +596,8 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None) -> None:
     # one double read; the OR stays as a filter so each branch may
     # over-approximate its disjunct (ref: planner/core
     # indexmerge_path.go generateIndexMergeOrPaths, union type only).
-    _try_index_merge(ds, conds, table, visible, vis_by_off, pk_vis, tstats)
+    if (variables or {}).get("tidb_enable_index_merge", "ON") == "ON":
+        _try_index_merge(ds, conds, table, visible, vis_by_off, pk_vis, tstats)
 
 
 def _split_dnf(e) -> list:

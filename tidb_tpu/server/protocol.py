@@ -113,6 +113,7 @@ class PacketIO:
     def __init__(self, sock):
         self.sock = sock
         self.seq = 0
+        self.max_allowed_packet = 64 << 20  # max_allowed_packet sysvar
 
     def read_packet(self) -> bytes:
         out = b""
@@ -121,6 +122,12 @@ class PacketIO:
             length = header[0] | (header[1] << 8) | (header[2] << 16)
             self.seq = (header[3] + 1) % 256
             out += self._read_n(length)
+            if len(out) > self.max_allowed_packet:
+                # ER_NET_PACKET_TOO_LARGE (ref: packetio.go readPacket
+                # enforcing the max_allowed_packet limit)
+                raise ConnectionError(
+                    f"packet for query is too large ({len(out)} > {self.max_allowed_packet})"
+                )
             if length < 0xFFFFFF:
                 return out  # a full-size frame implies a continuation
 

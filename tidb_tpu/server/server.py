@@ -92,6 +92,9 @@ class ClientConn:
             self.handshake()
             while self.alive and not self.server.closing:
                 self.pkt.reset_seq()
+                self.pkt.max_allowed_packet = int(
+                    self.session.vars.get("max_allowed_packet", str(64 << 20))
+                )
                 try:
                     payload = self.pkt.read_packet()
                 except ConnectionError:

@@ -79,6 +79,14 @@ class Session:
         self.in_explicit_txn = False
         self._is_cache: InfoSchema | None = None
         self.warnings: list[str] = []
+        self._prev_warnings: list[str] = []  # @@warning_count (prev stmt)
+        self._prev_error = False  # @@error_count
+        self._last_txn_info = ""  # @@tidb_last_txn_info (JSON)
+        self._last_query_info = ""  # @@tidb_last_query_info (JSON)
+        self._last_plan_from_cache = False
+        self._last_plan_from_binding = False
+        self._prev_plan_from_cache = False
+        self._prev_plan_from_binding = False
         self.last_insert_id = 0
         # stats deltas buffered per-txn, flushed only on commit
         # (ref: statistics/handle SessionStatsCollector)
@@ -113,6 +121,7 @@ class Session:
         self._info = {
             "user": self.user, "conn_id": self.conn_id, "db": self.current_db,
             "found_rows": 0, "row_count": -1, "last_insert_id": 0,
+            "vars": self.vars,  # live dict: builtins read session knobs
         }
         self._bootstrap()
 
@@ -242,10 +251,15 @@ class Session:
             self.store.stats.report_delta(tid, m, d)
         self._pending_deltas.clear()
 
-    def _txn_committed(self) -> None:
+    def _txn_committed(self, txn=None) -> None:
         """Post-commit hooks: flush stats deltas, auto-analyze trigger check
         (ref: domain autoAnalyzeWorker — ratio policy runs at commit
         boundaries, not a bg loop)."""
+        if txn is not None:
+            # @@tidb_last_txn_info (ref: sessionctx TxnInfo JSON shape)
+            self._last_txn_info = '{"start_ts":%d,"commit_ts":%d}' % (
+                txn.start_ts, getattr(txn, "commit_ts", 0)
+            )
         self._flush_deltas()
         if self.vars.get("tidb_enable_auto_analyze", "ON") == "ON":
             self.store.stats.auto_analyze(self)
@@ -253,9 +267,10 @@ class Session:
     def _finish_stmt(self):
         """Autocommit unless inside an explicit transaction."""
         if self.txn is not None and not self.in_explicit_txn:
-            self.txn.commit()
+            t = self.txn
+            t.commit()
             self.txn = None
-            self._txn_committed()
+            self._txn_committed(t)
 
     def _abort_stmt(self):
         if self.txn is not None and not self.in_explicit_txn:
@@ -286,7 +301,47 @@ class Session:
     # ---------------------------------------------------------------- execute
 
     def execute(self, sql: str) -> ResultSet:
-        stmt = parse_one(sql)
+        from ..parser.parser import parse
+
+        stmts = parse(sql)
+        if len(stmts) != 1:
+            # multi-statement text: gated like the reference (session.go
+            # ParseWithParams + tidb_multi_statement_mode; default OFF
+            # rejects to keep the injection surface closed)
+            mode = self.vars.get("tidb_multi_statement_mode", "OFF")
+            if not stmts:
+                raise TiDBError("empty statement")
+            if mode == "OFF":
+                raise TiDBError(
+                    "client has multi-statement capability disabled; "
+                    "set tidb_multi_statement_mode=ON to enable"
+                )
+            rs = ResultSet([], None)
+            for one in stmts:
+                # sql=None: sub-statements share one source string, which
+                # must not collide in the plan cache / digest surfaces
+                rs = self._execute_parsed(one, None)
+            if mode == "WARN":
+                self.warnings.append("multi-statement execution is deprecated")
+            return rs
+        return self._execute_parsed(stmts[0], sql)
+
+    def _execute_parsed(self, stmt, sql: str | None) -> ResultSet:
+        # sql=None (multi-statement sub-stmt): no per-statement source text,
+        # so the plan cache / binding digests are bypassed; logs get a tag
+        log_sql = sql if sql is not None else f"<multi-statement {type(stmt).__name__}>"
+        # diagnostics area: each statement starts fresh; the previous
+        # statement's warnings stay readable via @@warning_count and SHOW
+        # WARNINGS (which skips the reset, like MySQL's diagnostics rules)
+        if not (isinstance(stmt, ast.Show) and getattr(stmt, "kind", "") in ("warnings", "errors")):
+            self._prev_warnings = self.warnings
+            self.warnings = []
+            # @@last_plan_from_cache/_binding describe the PREVIOUS statement;
+            # snapshot before this statement's own planning overwrites them
+            self._prev_plan_from_cache = self._last_plan_from_cache
+            self._prev_plan_from_binding = self._last_plan_from_binding
+            self._last_plan_from_cache = False
+            self._last_plan_from_binding = False
         # statement-level savepoint: a failed statement inside an explicit
         # txn must not keep its partial writes (ref: session StmtRollback)
         saved = None
@@ -310,7 +365,7 @@ class Session:
             self.store.register_process(self.conn_id, {
                 "user": self.user,
                 "db": self.current_db,
-                "sql": sql[:256],
+                "sql": log_sql[:256],
                 "start": time.time(),
                 "session": weakref.ref(self),
             })
@@ -321,13 +376,50 @@ class Session:
         met = int(self.vars.get("max_execution_time", "0") or 0)
         self._deadline = (time.monotonic() + met / 1000.0) if met > 0 else None
         if self.vars.get("tidb_general_log", "OFF") == "ON" and not self._in_bootstrap:
-            log.info("GENERAL_LOG conn=%s user=%s db=%s sql=%s", self.conn_id, self.user, self.current_db, sql[:512])
+            gl = log_sql
+            if self.vars.get("tidb_redact_log", "OFF") == "ON":
+                from ..utils.stmtstats import normalize_sql
+
+                gl = normalize_sql(gl)
+            maxlen = int(self.vars.get("tidb_query_log_max_len", "4096"))
+            if maxlen >= 0:
+                gl = gl[:maxlen]
+            log.info("GENERAL_LOG conn=%s user=%s db=%s sql=%s", self.conn_id, self.user, self.current_db, gl)
         t0 = time.perf_counter()
         c0 = time.thread_time()  # Top-SQL CPU attribution by digest
         ok = True
         try:
-            rs = self._execute_stmt(stmt, sql=sql)
-            self._finish_stmt()
+            retries = 0
+            while True:
+                try:
+                    rs = self._execute_stmt(stmt, sql=sql)
+                    start_ts = self.txn.start_ts if self.txn is not None else 0
+                    self._finish_stmt()
+                    break
+                except WriteConflict:
+                    # optimistic autocommit auto-retry (ref: session.go
+                    # retryable commit under tidb_disable_txn_auto_retry=OFF
+                    # bounded by tidb_retry_limit)
+                    can_retry = (
+                        not self.in_explicit_txn
+                        and isinstance(stmt, (ast.Insert, ast.Update, ast.Delete))
+                        and self.vars.get("tidb_disable_txn_auto_retry", "ON") == "OFF"
+                        and retries < int(self.vars.get("tidb_retry_limit", "10"))
+                    )
+                    if not can_retry:
+                        raise
+                    retries += 1
+                    if self.txn is not None:
+                        try:
+                            self.txn.rollback()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        self.txn = None
+                    self._pending_deltas.clear()
+            if not self._in_bootstrap:
+                self._last_query_info = (
+                    '{"start_ts":%d,"ru_consumption":0}' % start_ts
+                )
             if rs.chunk is not None and rs.names:
                 self._info["found_rows"] = rs.chunk.num_rows
                 self._info["row_count"] = -1
@@ -342,6 +434,7 @@ class Session:
             self._abort_stmt()
             raise
         finally:
+            self._prev_error = not ok
             _ACTIVE_TRACKER.reset(token)
             _ACTIVE_SESSION.reset(stok)
             _si.CURRENT.reset(itok)
@@ -356,9 +449,14 @@ class Session:
                 if isinstance(stmt, (ast.CreateUser, ast.Grant, ast.SetStmt)):
                     # never record credential-bearing literals (MySQL
                     # redacts user-admin statements from logs)
-                    sql = f"<redacted {type(stmt).__name__}>"
+                    log_sql = f"<redacted {type(stmt).__name__}>"
                 self.store.stmt_stats.record(
-                    sql, dur, self.user, self.current_db, ok, threshold, cpu_s=cpu
+                    log_sql, dur, self.user, self.current_db, ok, threshold, cpu_s=cpu,
+                    summary_on=self.vars.get("tidb_enable_stmt_summary", "ON") == "ON",
+                    slow_log_on=self.vars.get("tidb_enable_slow_log", "ON") == "ON",
+                    max_sql_len=int(self.vars.get("tidb_stmt_summary_max_sql_length", "4096")),
+                    capacity=int(self.vars.get("tidb_stmt_summary_max_stmt_count", "3000")),
+                    redact=self.vars.get("tidb_redact_log", "OFF") == "ON",
                 )
                 # AFTER the counters above so a snapshot sees this stmt
                 M.HISTORY.tick()  # metrics_summary window sampling
@@ -674,11 +772,12 @@ class Session:
             self.in_explicit_txn = True
             return ResultSet([], None)
         if isinstance(stmt, ast.Commit):
-            if self.txn is not None:
-                self.txn.commit()
+            t = self.txn
+            if t is not None:
+                t.commit()
             self.txn = None
             self.in_explicit_txn = False
-            self._txn_committed()
+            self._txn_committed(t)
             return ResultSet([], None)
         if isinstance(stmt, ast.Rollback):
             if self.txn is not None:
@@ -689,20 +788,36 @@ class Session:
             return ResultSet([], None)
         if isinstance(stmt, ast.SetStmt):
             for scope, name, val in stmt.assignments:
-                c = self._eval_const_expr(val)
+                if (
+                    isinstance(val, ast.Name)
+                    and len(val.parts) == 1
+                    and not val.parts[0].startswith("@")
+                ):
+                    # SET var = bare_word — MySQL reads the identifier as a
+                    # string value (e.g. SET tidb_multi_statement_mode = WARN)
+                    c = Constant(Datum.s(val.parts[0]), ft_varchar(max(len(val.parts[0]), 1)))
+                else:
+                    c = self._eval_const_expr(val)
                 if name.startswith("@") and not name.startswith("@@"):
                     self.user_vars[name.lower()] = c  # typed, for EXECUTE USING
                 else:
                     if scope == "global" and not self._in_bootstrap:
                         self.priv.require_dynamic(self, self.user, "SYSTEM_VARIABLES_ADMIN")
-                    from .vars import set_var
+                    from .vars import SYSVARS, set_var
 
+                    prev = self.vars.get(name)
                     try:
                         self.vars[name] = set_var(
                             name, c.value.render(c.ret_type), self.warnings
                         )
                     except ValueError as e:
                         raise TiDBError(str(e))
+                    try:
+                        self._apply_global_sysvar(name, self.vars[name])
+                    except TiDBError:
+                        # component rejected the value: don't keep it stored
+                        self.vars[name] = prev if prev is not None else SYSVARS[name].default
+                        raise
                     # plan-time knobs (group_concat_max_len, sql_mode, ...)
                     # bake into cached plans — never serve a stale one
                     self._plan_cache.clear()
@@ -797,10 +912,11 @@ class Session:
         """User-admin/DDL statements implicitly commit any open txn
         (MySQL implicit-commit statement list)."""
         if self.txn is not None:
-            self.txn.commit()
+            t = self.txn
+            t.commit()
             self.txn = None
             self.in_explicit_txn = False
-            self._txn_committed()
+            self._txn_committed(t)
 
     def _run_create_user(self, stmt: ast.CreateUser) -> ResultSet:
         from ..privilege import mysql_native_hash
@@ -1158,12 +1274,59 @@ class Session:
 
     # ---------------------------------------------------------------- SELECT
 
+    def _apply_global_sysvar(self, name: str, val: str) -> None:
+        """Push store-level knobs into their owning component (ref:
+        gc_worker.go loading tidb_gc_* from mysql.tidb each round)."""
+        if name in ("tidb_gc_life_time", "tidb_gc_run_interval"):
+            from ..storage.gcworker import parse_go_duration_ms
+
+            ms = parse_go_duration_ms(val)
+            if ms is None:
+                raise TiDBError(f"invalid duration value for '{name}': '{val}'")
+            gw = self.store.gc_worker
+            if name == "tidb_gc_life_time":
+                gw.life_ms = ms
+            else:
+                gw.interval_ms = ms
+        elif name == "tidb_gc_enable":
+            self.store.gc_worker.enabled = val == "ON"
+
+    def _sysvar_read(self, name: str):
+        """Live value for SELECT @@name — dynamic session state for the
+        read-only status vars, stored value otherwise (ref: sessionctx
+        variable GetSessionOrGlobalSystemVar)."""
+        if name == "warning_count":
+            return len(self._prev_warnings)
+        if name == "error_count":
+            return 1 if getattr(self, "_prev_error", False) else 0
+        if name == "last_insert_id":
+            return int(self.last_insert_id or 0)
+        if name == "tidb_current_ts":
+            return int(self.txn.start_ts) if self.txn is not None else 0
+        if name == "tidb_last_txn_info":
+            return self._last_txn_info or ""
+        if name == "tidb_last_query_info":
+            return self._last_query_info or ""
+        if name == "last_plan_from_cache":
+            return "1" if getattr(self, "_prev_plan_from_cache", False) else "0"
+        if name == "last_plan_from_binding":
+            return "1" if getattr(self, "_prev_plan_from_binding", False) else "0"
+        if name == "tidb_config":
+            import json as _json
+
+            return _json.dumps({"store": "tidb-tpu", "host": "0.0.0.0"})
+        from .vars import SYSVARS
+
+        sv = SYSVARS.get(name)
+        return self.vars.get(name, sv.default if sv else "")
+
     def _builder(self, expose_rowid=None) -> PlanBuilder:
         return PlanBuilder(
             self.infoschema(), self.current_db,
             run_subquery=self._run_subquery, params=self._exec_params,
             memtable_rows=self._memtable_rows,
-            context_info={"user": self.user, "conn_id": self.conn_id, "vars": self.vars},
+            context_info={"user": self.user, "conn_id": self.conn_id, "vars": self.vars,
+                          "sysvar_read": self._sysvar_read},
             hints=getattr(self, "_cur_hints", None),
             expose_rowid=expose_rowid,
             seq_hook=self.sequence_op,
@@ -1190,8 +1353,11 @@ class Session:
         digest = sql_digest(sql)
         local = self._session_bindings.get(digest)
         if local:
+            self._last_plan_from_binding = True
             return local
-        return b.hints_for(digest)
+        out = b.hints_for(digest)
+        self._last_plan_from_binding = bool(out)
+        return out
 
     def _memtable_rows(self, name: str):
         from ..catalog.memtables import rows_for
@@ -1211,9 +1377,15 @@ class Session:
             self._temp_epoch,  # temp tables shadow names per-session
             self.store.stats.generation,
             self.vars.get("tidb_cop_engine", ""),
+            # type-inference / planning knobs baked into built plans
+            self.vars.get("div_precision_increment", "4"),
+            self.vars.get("default_week_format", "0"),
+            self.vars.get("tidb_enable_index_merge", "ON"),
+            self.vars.get("tidb_opt_join_reorder_threshold", "0"),
             repr(getattr(self, "_cur_hints", None) or []),
         )
         plan = self._plan_cache.get(key)
+        self._last_plan_from_cache = plan is not None
         if plan is not None:
             self._plan_cache.move_to_end(key)
             self.plan_cache_hits += 1
@@ -1228,7 +1400,7 @@ class Session:
     def plan_select(self, stmt):
         builder = self._builder()
         plan = builder.build_select(stmt)
-        plan = optimize(plan, self.store.stats)
+        plan = optimize(plan, self.store.stats, self.vars)
         plan._uncacheable = builder.used_eager_subquery
         return plan
 
@@ -1486,7 +1658,7 @@ class Session:
         # match its arity
         vbuilder = self._builder()
         vbuilder.db = db
-        plan = optimize(vbuilder.build_select(parse_one(stmt.select_sql)), self.store.stats)
+        plan = optimize(vbuilder.build_select(parse_one(stmt.select_sql)), self.store.stats, self.vars)
         if stmt.cols and len(stmt.cols) != len(plan.out_cols):
             raise TiDBError(
                 f"view {stmt.table.name!r} column list does not match its definition")
@@ -1618,6 +1790,32 @@ class Session:
             m.put_table(t)
             tinfo.auto_inc_id = t.auto_inc_id
             return first
+
+        return self._retry_meta_txn(do, "auto-id allocation")
+
+    @staticmethod
+    def _next_in_series(base: int, inc: int, off: int) -> int:
+        """Smallest v >= base with v ≡ offset (mod increment) — MySQL's
+        AUTO_INCREMENT series under auto_increment_increment/offset."""
+        if base <= off:
+            return off
+        return off + -((off - base) // inc) * inc
+
+    def _alloc_auto_series(self, tinfo: TableInfo, inc: int, off: int) -> int:
+        """Allocate the next id in the (offset, increment) series (ref:
+        meta/autoid + MySQL multi-master interleave semantics)."""
+        if getattr(tinfo, "temporary", False):
+            nxt = self._next_in_series(tinfo.auto_inc_id, inc, off)
+            tinfo.auto_inc_id = nxt + 1
+            return nxt
+
+        def do(txn, m):
+            t = m.table(tinfo.id)
+            nxt = self._next_in_series(t.auto_inc_id, inc, off)
+            t.auto_inc_id = nxt + 1
+            m.put_table(t)
+            tinfo.auto_inc_id = t.auto_inc_id
+            return nxt
 
         return self._retry_meta_txn(do, "auto-id allocation")
 
@@ -1762,7 +1960,12 @@ class Session:
         handle = None
         auto_col = next((c for c in info.columns if c.auto_increment), None)
         if auto_col is not None and datums[auto_col.offset].is_null:
-            v = self.alloc_auto_id(info, 1)
+            inc = int(self.vars.get("auto_increment_increment", "1"))
+            off = int(self.vars.get("auto_increment_offset", "1"))
+            if inc > 1 or off > 1:
+                v = self._alloc_auto_series(info, inc, off)
+            else:
+                v = self.alloc_auto_id(info, 1)
             datums[auto_col.offset] = Datum.i(v)
             self.last_insert_id = v
         if info.pk_is_handle:
@@ -2070,7 +2273,7 @@ class Session:
         sel = ast.Select(fields=fields, from_=from_ast, where=where)
         builder = self._builder(expose_rowid=expose)
         plan = builder.build_select(sel)
-        plan = optimize(plan, self.store.stats)
+        plan = optimize(plan, self.store.stats, self.vars)
         ctx = ExecContext(
             self.cop, read_ts, engine="host", vars=self.vars, txn=self.txn
         )
@@ -2285,7 +2488,19 @@ class Session:
             affected += removed
         return ResultSet([], None, affected=affected)
 
+    def _check_safe_updates(self, stmt) -> None:
+        """sql_safe_updates=ON rejects UPDATE/DELETE with neither a WHERE
+        clause nor a LIMIT (MySQL ER_UPDATE_WITHOUT_KEY_IN_SAFE_MODE)."""
+        if self.vars.get("sql_safe_updates", "OFF") != "ON":
+            return
+        if stmt.where is None and getattr(stmt, "limit", None) is None:
+            raise TiDBError(
+                "You are using safe update mode and you tried to update a "
+                "table without a WHERE that uses a KEY column"
+            )
+
     def _run_update(self, stmt: ast.Update) -> ResultSet:
+        self._check_safe_updates(stmt)
         if not isinstance(stmt.table, ast.TableName):
             return self._run_update_multi(stmt)
         info, tbl, txn, rows = self._scan_matching_rows(stmt.table, stmt.where)
@@ -2319,6 +2534,7 @@ class Session:
         return ResultSet([], None, affected=affected)
 
     def _run_delete(self, stmt: ast.Delete) -> ResultSet:
+        self._check_safe_updates(stmt)
         if not isinstance(stmt.table, ast.TableName) or stmt.targets is not None:
             return self._run_delete_multi(stmt)
         info, tbl, txn, rows = self._scan_matching_rows(stmt.table, stmt.where)
@@ -2636,7 +2852,10 @@ class Session:
         m.bump_schema_version()
         txn.commit()
         jid = self.store.ddl.enqueue(
-            "add_index", info.id, {"index_id": idx.id, "index_name": idx.name}
+            "add_index", info.id,
+            {"index_id": idx.id, "index_name": idx.name,
+             # reorg batch per txn (ref: tidb_ddl_reorg_batch_size)
+             "reorg_batch_size": int(self.vars.get("tidb_ddl_reorg_batch_size", "256"))},
         )
         self.store.ddl.run_until_done(jid)
         return ResultSet([], None)
@@ -2891,7 +3110,7 @@ class Session:
                 # database (no caller db/temp leakage — mirror _build_view)
                 vbuilder = self._builder()
                 vbuilder.db = vdef["db"]
-                plan = optimize(vbuilder.build_select(parse_one(vdef["sql"])), self.store.stats)
+                plan = optimize(vbuilder.build_select(parse_one(vdef["sql"])), self.store.stats, self.vars)
                 names = vdef.get("cols") or [c.name for c in plan.out_cols]
                 rows = [
                     [Datum.s(n), Datum.s(c.ft.type_name()),

@@ -89,6 +89,48 @@ _sv("time_zone", "SYSTEM", consumed=True)
 _sv("tidb_isolation_read_engines", "tpu,host", consumed=True)
 _sv("tidb_enable_clustered_index", "ON", kind="bool", consumed=True)
 _sv("tidb_window_device_min_rows", str(1 << 15), kind="int", lo=0, consumed=True)
+_sv("cte_max_recursion_depth", "1000", kind="int", lo=0, hi=4294967295, consumed=True)
+_sv("tidb_ddl_reorg_batch_size", "256", kind="int", lo=32, hi=10240, consumed=True)
+_sv("sql_safe_updates", "OFF", kind="bool", consumed=True)
+_sv("default_week_format", "0", kind="int", lo=0, hi=7, consumed=True)
+_sv("div_precision_increment", "4", kind="int", lo=0, hi=30, consumed=True)
+_sv("max_allowed_packet", "67108864", kind="int", lo=1024, hi=1 << 30, consumed=True)
+_sv("auto_increment_increment", "1", kind="int", lo=1, hi=65535, consumed=True)
+_sv("auto_increment_offset", "1", kind="int", lo=1, hi=65535, consumed=True)
+_sv("timestamp", "", consumed=True)  # SET timestamp=N freezes NOW()
+_sv("tidb_enable_index_merge", "ON", kind="bool", consumed=True)
+# agg-below-join pushdown rule doesn't exist here (cop partial/final split
+# is unconditional, like the reference's cop pushdown) — stays inert
+_sv("tidb_opt_agg_push_down", "OFF", kind="bool")
+_sv("tidb_opt_join_reorder_threshold", "0", kind="int", lo=0, hi=63, consumed=True)
+_sv("tidb_enforce_mpp", "OFF", kind="bool", consumed=True)
+_sv("tidb_broadcast_join_threshold_size", str(100 * 1024 * 1024), kind="int", lo=0, consumed=True)
+_sv("tidb_redact_log", "OFF", kind="bool", consumed=True)
+_sv("tidb_query_log_max_len", "4096", kind="int", lo=-1, consumed=True)
+_sv("tidb_stmt_summary_max_sql_length", "4096", kind="int", lo=0, consumed=True)
+_sv("tidb_enable_stmt_summary", "ON", kind="bool", consumed=True)
+_sv("tidb_enable_slow_log", "ON", kind="bool", consumed=True)
+_sv("tidb_stmt_summary_max_stmt_count", "3000", kind="int", lo=1, consumed=True)
+_sv("tidb_gc_enable", "ON", scope="global", kind="bool", consumed=True)
+_sv("tidb_gc_life_time", "10m0s", scope="global", consumed=True)
+_sv("tidb_gc_run_interval", "10m0s", scope="global", consumed=True)
+_sv("tidb_index_lookup_size", "20000", kind="int", lo=1, consumed=True)
+_sv("tidb_index_join_batch_size", "25000", kind="int", lo=1, consumed=True)
+_sv("tidb_disable_txn_auto_retry", "ON", kind="bool", consumed=True)
+_sv("tidb_multi_statement_mode", "OFF", kind="enum", enum=("OFF", "ON", "WARN"), consumed=True)
+_sv("tidb_track_aggregate_memory_usage", "ON", kind="bool", consumed=True)
+_sv("tidb_mem_quota_sort", str(32 << 30), scope="session", kind="int", lo=-1, consumed=True)
+_sv("tidb_mem_quota_topn", str(32 << 30), scope="session", kind="int", lo=-1, consumed=True)
+_sv("tidb_mem_quota_hashjoin", str(32 << 30), scope="session", kind="int", lo=-1, consumed=True)
+
+# --- read-only session state surfaced via SELECT @@x (SET is rejected;
+# values are computed live by Session._sysvar_read) ------------------------
+for _name in (
+    "last_insert_id", "warning_count", "error_count", "tidb_current_ts",
+    "tidb_last_txn_info", "tidb_last_query_info", "last_plan_from_cache",
+    "last_plan_from_binding", "tidb_config",
+):
+    _sv(_name, "", scope="none", consumed=True)
 
 # --- accepted, surfaced in SHOW, but nothing reads them here (warn) --------
 for _name, _d, _k in (
@@ -104,7 +146,6 @@ for _name, _d, _k in (
     ("tidb_merge_join_concurrency", "1", "int"),
     ("tidb_stream_agg_concurrency", "1", "int"),
     ("tidb_build_stats_concurrency", "4", "int"),
-    ("tidb_opt_agg_push_down", "ON", "bool"),
     ("tidb_opt_distinct_agg_push_down", "OFF", "bool"),
     ("tidb_enable_parallel_apply", "OFF", "bool"),
     ("tidb_enable_async_commit", "OFF", "bool"),
@@ -116,21 +157,16 @@ for _name, _d, _k in (
     ("tidb_enable_table_partition", "ON", "bool"),
     ("tidb_enable_list_partition", "OFF", "bool"),
     ("tidb_scatter_region", "OFF", "bool"),
-    ("tidb_enable_stmt_summary", "ON", "bool"),
-    ("tidb_stmt_summary_max_stmt_count", "3000", "int"),
     ("tidb_enable_collect_execution_info", "ON", "bool"),
     ("tidb_enable_telemetry", "ON", "bool"),
     ("tidb_row_format_version", "2", "int"),
     ("tidb_analyze_version", "2", "int"),
     ("tidb_stats_load_sync_wait", "0", "int"),
     ("tidb_ddl_reorg_worker_cnt", "4", "int"),
-    ("tidb_ddl_reorg_batch_size", "256", "int"),
     ("tidb_ddl_error_count_limit", "512", "int"),
     ("tidb_auto_analyze_ratio", "0.5", "float"),
     ("tidb_auto_analyze_start_time", "00:00 +0000", "str"),
     ("tidb_auto_analyze_end_time", "23:59 +0000", "str"),
-    ("tidb_gc_life_time", "10m0s", "str"),
-    ("tidb_gc_run_interval", "10m0s", "str"),
     ("tidb_gc_concurrency", "-1", "int"),
     ("tidb_backoff_weight", "2", "int"),
     ("tidb_ddl_slow_threshold", "300", "int"),
@@ -150,7 +186,6 @@ for _name, _d, _k in (
     ("tidb_opt_memory_factor", "0.001", "float"),
     ("tidb_opt_disk_factor", "1.5", "float"),
     ("tidb_opt_concurrency_factor", "3", "float"),
-    ("tidb_enable_index_merge", "ON", "bool"),
     ("tidb_enable_noop_variables", "ON", "bool"),
     ("tidb_low_resolution_tso", "OFF", "bool"),
     ("tidb_expensive_query_time_threshold", "60", "int"),
@@ -160,7 +195,6 @@ for _name, _d, _k in (
     ("tidb_skip_utf8_check", "OFF", "bool"),
     ("foreign_key_checks", "OFF", "bool"),
     ("unique_checks", "ON", "bool"),
-    ("sql_safe_updates", "OFF", "bool"),
     ("sql_auto_is_null", "OFF", "bool"),
     ("big_tables", "OFF", "bool"),
     ("sql_log_bin", "ON", "bool"),
@@ -168,8 +202,6 @@ for _name, _d, _k in (
     ("lock_wait_timeout", "31536000", "int"),
     ("tx_read_only", "OFF", "bool"),
     ("transaction_read_only", "OFF", "bool"),
-    ("default_week_format", "0", "int"),
-    ("div_precision_increment", "4", "int"),
     ("lc_time_names", "en_US", "str"),
     ("max_sort_length", "1024", "int"),
     ("net_write_timeout", "60", "int"),
@@ -184,10 +216,95 @@ for _name, _d, _k in (
 ):
     _sv(_name, _d, kind=_k)
 
+# --- remainder of the reference registry (sysvar.go) — registered with the
+# reference's scope/kind/defaults so SET/SHOW behave, inert here (warn) -----
+for _name, _d, _k in (
+    ("allow_auto_random_explicit_insert", "OFF", "bool"),
+    ("ddl_slow_threshold", "300", "int"),
+    ("block_encryption_mode", "aes-128-ecb", "str"),
+    ("tidb_allow_batch_cop", "1", "int"),
+    ("tidb_allow_fallback_to_tikv", "", "str"),
+    ("tidb_allow_remove_auto_inc", "OFF", "bool"),
+    ("tidb_backoff_lock_fast", "100", "int"),
+    ("tidb_batch_commit", "OFF", "bool"),
+    ("tidb_capture_plan_baselines", "OFF", "bool"),
+    ("tidb_checksum_table_concurrency", "4", "int"),
+    ("tidb_ddl_reorg_priority", "PRIORITY_LOW", "str"),
+    ("tidb_enable_alter_placement", "OFF", "bool"),
+    ("tidb_enable_amend_pessimistic_txn", "OFF", "bool"),
+    ("tidb_enable_auto_increment_in_generated", "OFF", "bool"),
+    ("tidb_enable_cascades_planner", "OFF", "bool"),
+    ("tidb_enable_change_multi_schema", "OFF", "bool"),
+    ("tidb_enable_exchange_partition", "OFF", "bool"),
+    ("tidb_enable_extended_stats", "OFF", "bool"),
+    ("tidb_enable_fast_analyze", "OFF", "bool"),
+    ("tidb_enable_global_temporary_table", "OFF", "bool"),
+    ("tidb_enable_index_merge_join", "OFF", "bool"),
+    ("tidb_enable_local_txn", "OFF", "bool"),
+    ("tidb_enable_ordered_result_mode", "OFF", "bool"),
+    ("tidb_enable_pipelined_window_function", "ON", "bool"),
+    ("tidb_enable_point_get_cache", "OFF", "bool"),
+    ("tidb_enable_streaming", "OFF", "bool"),
+    ("tidb_enable_top_sql", "OFF", "bool"),
+    ("tidb_evolve_plan_baselines", "OFF", "bool"),
+    ("tidb_evolve_plan_task_end_time", "23:59 +0000", "str"),
+    ("tidb_evolve_plan_task_max_time", "600", "int"),
+    ("tidb_evolve_plan_task_start_time", "00:00 +0000", "str"),
+    ("tidb_gc_scan_lock_mode", "LEGACY", "str"),
+    ("tidb_guarantee_linearizability", "ON", "bool"),
+    ("tidb_hash_exchange_with_new_collation", "ON", "bool"),
+    ("tidb_index_serial_scan_concurrency", "1", "int"),
+    ("tidb_max_delta_schema_count", "1024", "int"),
+    ("tidb_mem_quota_apply_cache", str(32 << 20), "int"),
+    ("tidb_mem_quota_indexlookupjoin", str(32 << 30), "int"),
+    ("tidb_mem_quota_indexlookupreader", str(32 << 30), "int"),
+    ("tidb_mem_quota_mergejoin", str(32 << 30), "int"),
+    ("tidb_metric_query_range_duration", "60", "int"),
+    ("tidb_metric_query_step", "60", "int"),
+    ("tidb_mpp_store_fail_ttl", "60s", "str"),
+    ("tidb_opt_broadcast_cartesian_join", "1", "int"),
+    ("tidb_opt_broadcast_join", "OFF", "bool"),
+    ("tidb_opt_copcpu_factor", "3.0", "float"),
+    ("tidb_opt_cpu_factor", "3.0", "float"),
+    ("tidb_opt_desc_factor", "3.0", "float"),
+    ("tidb_opt_enable_correlation_adjustment", "ON", "bool"),
+    ("tidb_opt_mpp_outer_join_fixed_build_side", "OFF", "bool"),
+    ("tidb_opt_prefer_range_scan", "OFF", "bool"),
+    ("tidb_opt_tiflash_concurrency_factor", "24.0", "float"),
+    ("tidb_optimizer_selectivity_level", "0", "int"),
+    ("tidb_partition_prune_mode", "static", "str"),
+    ("tidb_pprof_sql_cpu", "0", "int"),
+    ("tidb_record_plan_in_slow_log", "ON", "bool"),
+    ("tidb_replica_read", "leader", "str"),
+    ("tidb_restricted_read_only", "OFF", "bool"),
+    ("tidb_shard_allocate_step", str(2**63 - 1), "int"),
+    ("tidb_slow_log_masking", "OFF", "bool"),
+    ("tidb_slow_query_file", "", "str"),
+    ("tidb_stmt_summary_history_size", "24", "int"),
+    ("tidb_stmt_summary_internal_query", "OFF", "bool"),
+    ("tidb_stmt_summary_refresh_interval", "1800", "int"),
+    ("tidb_store_limit", "0", "int"),
+    ("tidb_streamagg_concurrency", "1", "int"),
+    ("tidb_top_sql_agent_address", "", "str"),
+    ("tidb_top_sql_max_collect", "10000", "int"),
+    ("tidb_top_sql_max_statement_count", "200", "int"),
+    ("tidb_top_sql_precision_seconds", "1", "int"),
+    ("tidb_top_sql_report_interval_seconds", "60", "int"),
+    ("tidb_use_plan_baselines", "ON", "bool"),
+    ("tidb_wait_split_region_finish", "ON", "bool"),
+    ("tidb_wait_split_region_timeout", "300", "int"),
+    ("tx_read_ts", "", "str"),
+    ("txn_scope", "global", "str"),
+    ("windowing_use_high_precision", "ON", "bool"),
+    ("max_connections", "151", "int"),
+    ("max_prepared_stmt_count", "-1", "int"),
+    ("skip_name_resolve", "OFF", "bool"),
+):
+    _sv(_name, _d, kind=_k)
+
 # --- connection/session plumbing clients legitimately SET ------------------
 for _name, _d in (
     ("wait_timeout", "28800"), ("interactive_timeout", "28800"),
-    ("max_allowed_packet", "67108864"),
     ("character_set_server", "utf8mb4"), ("collation_server", "utf8mb4_bin"),
     ("character_set_client", "utf8mb4"), ("character_set_results", "utf8mb4"),
     ("character_set_connection", "utf8mb4"), ("collation_connection", "utf8mb4_bin"),
@@ -199,6 +316,10 @@ for _name, _d in (
 
 # --- server identity (read-only: SET is rejected, ref ErrIncorrectScope) ---
 for _name, _d in (
+    ("ssl_ca", ""), ("ssl_cert", ""), ("ssl_key", ""), ("log_bin", "OFF"),
+    ("plugin_dir", ""), ("plugin_load", ""),
+    ("default_authentication_plugin", "mysql_native_password"),
+    ("tidb_enable_enhanced_security", "OFF"),
     ("version_comment", "tidb-tpu"), ("port", "4000"), ("socket", ""),
     ("datadir", ""), ("version", "8.0.11-tidb-tpu"), ("hostname", "localhost"),
     ("license", "Apache License 2.0"), ("system_time_zone", "UTC"),

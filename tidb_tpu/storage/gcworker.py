@@ -15,10 +15,31 @@ import time
 from .tso import TSO
 
 
+def parse_go_duration_ms(s: str) -> int | None:
+    """'10m0s' / '1h30m' / '90s' → milliseconds (the tidb_gc_* format,
+    ref: gc_worker.go parseDuration)."""
+    import re
+
+    s = s.strip().lower()
+    if not s:
+        return None
+    ms = 0.0
+    pos = 0
+    for m in re.finditer(r"(\d+(?:\.\d+)?)(ms|h|m|s)", s):
+        if m.start() != pos:
+            return None
+        v = float(m.group(1))
+        ms += v * {"h": 3_600_000, "m": 60_000, "s": 1_000, "ms": 1}[m.group(2)]
+        pos = m.end()
+    return int(ms) if pos == len(s) and pos else None
+
+
 class GCWorker:
     def __init__(self, storage, life_ms: int = 10 * 60 * 1000):
         self.storage = storage
         self.life_ms = life_ms  # tidb_gc_life_time analog
+        self.interval_ms = 10 * 60 * 1000  # tidb_gc_run_interval
+        self.enabled = True  # tidb_gc_enable
         self.last_safe_point = 0
         self.runs = 0
         self.removed_total = 0
@@ -59,6 +80,8 @@ class GCWorker:
     def tick(self, now_ms: int | None = None) -> int:
         """One GC round; returns versions removed. Skips when the
         safepoint hasn't advanced (gc_worker leaderTick behavior)."""
+        if not self.enabled:
+            return 0  # SET GLOBAL tidb_gc_enable = OFF
         now_ms = int(time.time() * 1000) if now_ms is None else now_ms
         sp = self.compute_safe_point(now_ms)
         if sp <= self.last_safe_point:

@@ -13,16 +13,15 @@ from collections import deque
 import functools
 
 
-@functools.lru_cache(maxsize=2048)
-def sql_digest(sql: str) -> str:
-    """Normalized statement digest: literals → '?', idents lowercased
-    (ref: parser digests used by stmtsummary/topsql)."""
+def _mask_literals(sql: str, lower: bool) -> str | None:
+    """Tokenize and replace literal tokens with '?' — the single place
+    that decides what counts as user data (digests + redaction agree)."""
     from ..parser.lexer import tokenize
 
     try:
         toks = tokenize(sql)
-    except Exception:  # noqa: BLE001 — digest must never fail the statement
-        return hashlib.sha256(sql.encode()).hexdigest()[:16]
+    except Exception:  # noqa: BLE001 — masking must never fail the statement
+        return None
     parts = []
     for t in toks:
         if t.kind in ("num", "str", "hex"):
@@ -30,9 +29,26 @@ def sql_digest(sql: str) -> str:
         elif t.kind == "eof":
             break
         else:
-            parts.append(t.text.lower())
-    norm = " ".join(parts)
+            parts.append(t.text.lower() if lower else t.text)
+    return " ".join(parts)
+
+
+@functools.lru_cache(maxsize=2048)
+def sql_digest(sql: str) -> str:
+    """Normalized statement digest: literals → '?', idents lowercased
+    (ref: parser digests used by stmtsummary/topsql)."""
+    norm = _mask_literals(sql, lower=True)
+    if norm is None:
+        return hashlib.sha256(sql.encode()).hexdigest()[:16]
     return hashlib.sha256(norm.encode()).hexdigest()[:16]
+
+
+@functools.lru_cache(maxsize=2048)
+def normalize_sql(sql: str) -> str:
+    """Literal-free statement text (tidb_redact_log: logs must carry no
+    user data; ref: errors.RedactLogEnabled + parser.Normalize)."""
+    out = _mask_literals(sql, lower=False)
+    return out if out is not None else "<redacted>"
 
 
 class StmtStats:
@@ -44,33 +60,48 @@ class StmtStats:
         self.summary_capacity = summary_capacity
         self._lock = threading.Lock()
 
-    def record(self, sql: str, dur_s: float, user: str, db: str, ok: bool, slow_threshold_s: float, cpu_s: float = 0.0) -> None:
+    def record(
+        self, sql: str, dur_s: float, user: str, db: str, ok: bool,
+        slow_threshold_s: float, cpu_s: float = 0.0, *,
+        summary_on: bool = True, slow_log_on: bool = True,
+        max_sql_len: int = 256, capacity: int | None = None,
+        redact: bool = False,
+    ) -> None:
+        """Record one statement. The keyword gates map the reference's
+        knobs: tidb_enable_stmt_summary, tidb_enable_slow_log,
+        tidb_stmt_summary_max_sql_length, tidb_stmt_summary_max_stmt_count,
+        tidb_redact_log (literals → '?' in every stored sample)."""
         digest = sql_digest(sql)
+        if redact:
+            sql = normalize_sql(sql)
         now = time.time()
         with self._lock:
-            st = self.summary.get(digest)
-            if st is None:
-                if len(self.summary) >= self.summary_capacity:
-                    # evict the least-executed entry (summary eviction)
-                    victim = min(self.summary, key=lambda k: self.summary[k]["exec_count"])
-                    del self.summary[victim]
-                st = {
-                    "digest": digest,
-                    "sample_sql": sql[:256],
-                    "exec_count": 0,
-                    "sum_latency_s": 0.0,
-                    "max_latency_s": 0.0,
-                    "sum_cpu_s": 0.0,
-                    "errors": 0,
-                }
-                self.summary[digest] = st
-            st["exec_count"] += 1
-            st["sum_latency_s"] += dur_s
-            st["max_latency_s"] = max(st["max_latency_s"], dur_s)
-            st["sum_cpu_s"] = st.get("sum_cpu_s", 0.0) + cpu_s
-            if not ok:
-                st["errors"] += 1
-            if dur_s >= slow_threshold_s:
+            if capacity is not None:
+                self.summary_capacity = capacity
+            if summary_on:
+                st = self.summary.get(digest)
+                if st is None:
+                    if len(self.summary) >= self.summary_capacity:
+                        # evict the least-executed entry (summary eviction)
+                        victim = min(self.summary, key=lambda k: self.summary[k]["exec_count"])
+                        del self.summary[victim]
+                    st = {
+                        "digest": digest,
+                        "sample_sql": sql[:max_sql_len],
+                        "exec_count": 0,
+                        "sum_latency_s": 0.0,
+                        "max_latency_s": 0.0,
+                        "sum_cpu_s": 0.0,
+                        "errors": 0,
+                    }
+                    self.summary[digest] = st
+                st["exec_count"] += 1
+                st["sum_latency_s"] += dur_s
+                st["max_latency_s"] = max(st["max_latency_s"], dur_s)
+                st["sum_cpu_s"] = st.get("sum_cpu_s", 0.0) + cpu_s
+                if not ok:
+                    st["errors"] += 1
+            if slow_log_on and dur_s >= slow_threshold_s:
                 self.slow.append(
                     {
                         "time": now,
