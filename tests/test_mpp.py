@@ -149,17 +149,45 @@ class TestFallbacks:
         assert _sorted(mpp) == _sorted(host)
         assert sess.cop.mpp.compile_count == c0 + 1, "expected the mesh path to run"
 
-    def test_extreme_multiplicity_falls_back(self, sess):
+    def test_extreme_multiplicity_on_mesh(self, sess):
+        # multiplicity-100 build keys ride the compact cumsum-offset join
+        # (round 5) instead of falling back — output capacity is bounded
+        # by the drop-guarded join output, not probe x max-multiplicity
         sess.execute("create table dup2 (d_k bigint, d_v bigint)")
         sess.execute(
             "insert into dup2 values " + ",".join(f"(1, {i})" for i in range(100))
         )
         c0 = sess.cop.mpp.compile_count
+        fb0 = sess.cop.mpp.fallbacks
         mpp, host = _both(
             sess, "select o_id, d_v from ord join dup2 on o_cust = d_k where o_cust < 20"
         )
         assert _sorted(mpp) == _sorted(host)
-        assert sess.cop.mpp.compile_count == c0, ">cap must take the host path"
+        assert sess.cop.mpp.compile_count > c0, "expected the mesh path to run"
+        assert sess.cop.mpp.fallbacks == fb0
+
+    def test_skewed_exchange_overflow_falls_back(self, sess):
+        # every row hashes to ONE device: the bounded exchange buckets
+        # overflow, the device program reports dropped rows, and execute()
+        # discards the run for the host path — results stay exact
+        sess.execute("create table skw (s_k bigint, s_v bigint)")
+        sess.execute(
+            "insert into skw values " + ",".join(f"(8, {i})" for i in range(3000))
+        )
+        sess.execute("create table skb (b_k bigint, b_x bigint)")
+        sess.execute("insert into skb values (8, 1),(16, 2)")
+        sess.vars["tidb_broadcast_join_threshold_count"] = "0"  # force HASH
+        try:
+            fb0 = sess.cop.mpp.fallbacks
+            mpp, host = _both(
+                sess, "select s_v, b_x from skw join skb on s_k = b_k"
+            )
+            assert _sorted(mpp) == _sorted(host)
+            assert len(mpp) == 3000
+            assert sess.cop.mpp.fallbacks > fb0
+            assert "overflow" in sess.cop.mpp.last_fallback_reason
+        finally:
+            sess.vars["tidb_broadcast_join_threshold_count"] = "10240"
 
     def test_txn_dirty_falls_back(self, sess):
         sess.execute("begin")
@@ -186,3 +214,40 @@ class TestFragmentExplain:
         assert mplan is not None
         txt = mplan.explain()
         assert "HashJoin" in txt and "ExchangeSender" in txt and "PartialAggregation(psum)" in txt
+
+
+class TestLaneCacheSnapshot:
+    def test_txn_snapshot_not_poisoned_by_lane_cache(self, sess):
+        # a session holding an old snapshot must not publish its stale
+        # lanes under the current version key (round-5 cache guard)
+        from tidb_tpu.session import Session
+
+        sess.execute("create table snapch (k bigint primary key, v bigint)")
+        sess.execute("insert into snapch values (1, 10), (2, 20)")
+        sess.execute("create table snapd (k bigint, x bigint)")
+        sess.execute("insert into snapd values " + ",".join(f"({i%2+1},{i})" for i in range(40)))
+        # warm: current-version lanes cached
+        q = "select count(*), sum(v) from snapch join snapd on snapch.k = snapd.k"
+        before = sess.must_query(q)
+        # writer session commits new rows (version bumps)
+        w = Session(sess.store, cop_client=sess.cop)
+        w.execute(f"use {sess.current_db}")
+        # reader pins a snapshot BEFORE the write
+        sess.execute("begin")
+        old = sess.must_query(q)
+        w.execute("insert into snapch values (3, 30)")
+        w.execute("insert into snapd values (3, 99)")
+        # reader at old snapshot: must NOT see the new rows, and must not
+        # poison the cache for the new version
+        assert sess.must_query(q) == old == before
+        sess.execute("commit")
+        # fresh read at current ts sees the new data
+        after = sess.must_query(q)
+        assert after != before
+        host = None
+        sess.vars["tidb_allow_mpp"] = "OFF"
+        sess.vars["tidb_cop_engine"] = "host"
+        host = sess.must_query(q)
+        sess.vars["tidb_allow_mpp"] = "ON"
+        sess.vars["tidb_cop_engine"] = "auto"
+        assert after == host

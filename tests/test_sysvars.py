@@ -361,3 +361,33 @@ def test_mem_quota_topn(s):
     s.execute("SET tidb_mem_quota_topn = 34359738368")
     assert len(s.must_query("SELECT a, b FROM tq ORDER BY b DESC LIMIT 2000")) == 2000
     s.vars["tidb_cop_engine"] = "auto"
+
+
+def test_global_only_var_rejects_session_set(s):
+    # MySQL ER_GLOBAL_VARIABLE: store-wide knobs only via SET GLOBAL
+    with pytest.raises(TiDBError):
+        s.execute("SET tidb_gc_enable = OFF")
+    assert s.store.gc_worker.enabled
+
+
+def test_set_global_scoping(s):
+    # SET GLOBAL must not change the current session's value, must seed
+    # new sessions, and @@global.x must read the store value
+    s.execute("SET autocommit = ON")
+    s.execute("SET GLOBAL autocommit = OFF")
+    assert s.must_query("SELECT @@autocommit") == [("ON",)]  # session keeps
+    assert s.must_query("SELECT @@global.autocommit") == [("OFF",)]
+    from tidb_tpu.session import Session
+
+    s2 = Session(s.store, cop_client=s.cop)
+    assert s2.must_query("SELECT @@autocommit") == [("OFF",)]  # seeded
+    s.execute("SET GLOBAL autocommit = ON")
+
+
+def test_error_count_survives_show_warnings(s):
+    try:
+        s.execute("SELECT * FROM no_such_table_anywhere")
+    except TiDBError:
+        pass
+    s.execute("SHOW WARNINGS")  # diagnostic: must not reset error_count
+    assert s.must_query("SELECT @@error_count") == [("1",)]

@@ -304,14 +304,61 @@ def _build_agg(plan: Aggregation, ctx: ExecContext) -> Executor:
     )
 
 
+def _mpp_topn_spec(sort_plan: Sort, inner) -> tuple | None:
+    """ORDER BY <single sum/count aggregate> over Projection?(Aggregation)
+    → (agg_idx, desc) resolved into the Aggregation's agg list, else None.
+    The device then returns only the top-k groups per device (exact: after
+    the hash exchange every group is complete on one device)."""
+    from ..expr.expression import Column as _EC
+
+    if len(sort_plan.by) != 1:
+        return None
+    e, desc = sort_plan.by[0]
+    if not isinstance(e, _EC):
+        return None
+    idx = e.idx
+    while isinstance(inner, Projection):
+        pe = inner.exprs[idx]
+        if not isinstance(pe, _EC):
+            return None
+        idx = pe.idx
+        inner = inner.children[0]
+    if not isinstance(inner, Aggregation):
+        return None
+    ng = len(inner.group_by)
+    if idx < ng:
+        return None  # ordering by a group key: host TopN handles it
+    a = inner.aggs[idx - ng]
+    if a.name not in ("sum", "count") or a.distinct:
+        return None
+    return (idx - ng, bool(desc))
+
+
+def _find_mpp_gather(ex: Executor):
+    from .mpp_gather import MPPGatherExec
+
+    seen = 0
+    while ex is not None and seen < 8:
+        if isinstance(ex, MPPGatherExec):
+            return ex
+        ex = getattr(ex, "child", None)
+        seen += 1
+    return None
+
+
 def _build_limit(plan: Limit, ctx: ExecContext) -> Executor:
     child = plan.children[0]
     n = plan.count + plan.offset
     if isinstance(child, Sort):
+        spec = _mpp_topn_spec(child, child.children[0])
         sort_child = build_executor(child.children[0], ctx)
         reader = _pushable_reader(sort_child)
         if reader is not None and all(e.pushable() for e, _ in child.by):
             reader.dag.topn = TopNNode(child.by, n)  # per-task topn
+        if spec is not None:
+            gather = _find_mpp_gather(sort_child)
+            if gather is not None:
+                gather.mplan.topn = (spec[0], spec[1], n)
         return TopNExec(sort_child, child.by, plan.count, plan.offset)
     ex = build_executor(child, ctx)
     reader = _pushable_reader(ex)

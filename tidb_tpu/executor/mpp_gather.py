@@ -124,22 +124,52 @@ class MPPGatherExec(Executor):
         for sf in self.mplan.scans:
             table = sf.ds.table
             prefix = tablecodec.record_prefix(table.id)
-            tasks = client.build_tasks(table.id, [(prefix, prefix + b"\xff")])
-            parts = [client.tiles.get_batch(table, t.start, t.end, self.ctx.read_ts) for t in tasks]
-            parts = [b for b in parts if b.n_rows]
-            data, valid = [], []
+            ver, last_commit_ts = client.tiles.storage.data_version(prefix)
+            # snapshot rule (tilecache.py get_batch): lanes built for a
+            # read BELOW the last commit describe an older snapshot than
+            # the version counter says — never cache or serve them under
+            # (table, version) identity
+            cacheable = self.ctx.read_ts >= last_commit_ts
+            if not cacheable:
+                ver = -1
+            data, valid, orig_offs = [], [], []
+            parts = None
             for pc in sf.ds.out_cols:
                 off = pc.orig_offset
-                if parts:
-                    data.append(np.concatenate([b.data[off] for b in parts]))
-                    valid.append(np.concatenate([b.valid[off] for b in parts]))
-                else:
-                    from ..chunk.chunk import col_numpy_dtype, VARLEN
+                orig_offs.append(off)
+                ck = (table.id, ver, off)
+                ent = engine._host_lane_cache.get(ck) if cacheable else None
+                if ent is None:
+                    # whole-table lane concatenation is O(table bytes) per
+                    # column: do it once per (table, version), not per
+                    # dispatch (the host twin of the device-lane cache)
+                    if parts is None:
+                        tasks = client.build_tasks(table.id, [(prefix, prefix + b"\xff")])
+                        parts = [
+                            client.tiles.get_batch(table, t.start, t.end, self.ctx.read_ts)
+                            for t in tasks
+                        ]
+                        parts = [b for b in parts if b.n_rows]
+                    if parts:
+                        ent = (
+                            np.concatenate([b.data[off] for b in parts]),
+                            np.concatenate([b.valid[off] for b in parts]),
+                        )
+                    else:
+                        from ..chunk.chunk import col_numpy_dtype, VARLEN
 
-                    dt = col_numpy_dtype(pc.ft)
-                    data.append(np.empty(0, dtype=object if dt is VARLEN else dt))
-                    valid.append(np.zeros(0, dtype=bool))
-            scan_datas.append(ScanData(sf, data, valid))
+                        dt = col_numpy_dtype(pc.ft)
+                        ent = (
+                            np.empty(0, dtype=object if dt is VARLEN else dt),
+                            np.zeros(0, dtype=bool),
+                        )
+                    if cacheable:
+                        engine._host_lane_put(ck, ent)
+                data.append(ent[0])
+                valid.append(ent[1])
+            scan_datas.append(
+                ScanData(sf, data, valid, version=ver, shared=engine, orig_offs=orig_offs)
+            )
         mesh = engine._mesh if getattr(engine, "_mesh", None) is not None else make_mesh()
         engine._mesh = mesh
         res = engine.execute(self.mplan, scan_datas, mesh, self.ctx.vars)

@@ -59,25 +59,39 @@ class ScanData:
     gather) plus dict-encoded device lanes for the columns the program
     reads. Built by the gather executor from tile-cache batches."""
 
-    def __init__(self, frag: ScanFrag, data: list[np.ndarray], valid: list[np.ndarray]):
+    def __init__(self, frag: ScanFrag, data: list[np.ndarray], valid: list[np.ndarray],
+                 version: int = -1, shared=None, orig_offs: list[int] | None = None):
         self.frag = frag
         self.data = data  # per ds.out_cols position
         self.valid = valid
         self.n_rows = len(data[0]) if data else 0
         self.vocabs: dict[int, list] = {}
         self._dev: dict[int, np.ndarray] = {}
+        # (table_id, data_version) identity for the engine's device-lane
+        # cache; -1 disables caching (unknown provenance)
+        self.version = version
+        self.shared = shared  # MPPEngine, for cross-dispatch stat caches
+        self.orig_offs = orig_offs  # table-level offsets per local position
 
     def lane(self, off: int) -> tuple[np.ndarray, np.ndarray]:
         """Device-shaped lane for a scan-local column offset (dict-encodes
-        object lanes on first use)."""
+        object lanes on first use; encodings cache per table version)."""
         if off not in self._dev:
             d, v = self.data[off], self.valid[off]
             if d.dtype == object:
                 from ..copr.tpu_engine import _dict_encode_lane
 
-                codes, vocab = _dict_encode_lane(d, v)
+                def enc(_d=d, _v=v):
+                    codes, vocab = _dict_encode_lane(_d, _v)
+                    return codes.astype(np.int64), vocab
+
+                if self.shared is not None and self.version >= 0 and self.orig_offs:
+                    d, vocab = self.shared._cached_stat(
+                        self, ("enc", self.orig_offs[off]), enc
+                    )
+                else:
+                    d, vocab = enc()
                 self.vocabs[off] = vocab
-                d = codes.astype(np.int64)
             elif d.dtype == bool:
                 d = d.astype(np.int64)
             self._dev[off] = d
@@ -98,15 +112,119 @@ class _Level:
         self.key_lo = key_lo
         self.key_stride = key_stride
         self.r_post: list[Expression] = []
-        self.mult = 1  # max build-key multiplicity (pow2-padded; 1 = unique)
+        self.mult = 1  # 1 = unique build keys, 2 = compact dup path
+        self.expected_out: int | None = None  # exact pre-filter join card
 
 
 class MPPEngine:
+    DEV_CACHE_BYTES = 4 << 30  # device-lane cache budget
+
     def __init__(self):
         self._programs: dict = {}
         self.compile_count = 0
         self.fallbacks = 0
         self.last_fallback_reason = ""  # EXPLAIN ANALYZE / bench surface
+        # device-resident input lanes keyed by (table_id, version, tag,
+        # total, sharded): re-dispatching the same fragment plan must NOT
+        # re-upload unchanged table lanes — over a remote device link the
+        # upload dwarfs the compute (the MPP analog of the cop tile cache)
+        self._dev_cache: dict = {}
+        self._dev_cache_nbytes = 0
+        # host-side analysis results (lane min/max/gcd, build multiplicity,
+        # dict encodings, concatenated lanes) keyed by (table, version, tag);
+        # byte-budgeted LRU like the device cache — a long-lived server
+        # must not pin every column of every table it ever joined
+        self._stat_cache: dict = {}
+        self._stat_cache_nbytes = 0
+        self._host_lane_cache: dict = {}
+        self._host_lane_nbytes = 0
+
+    HOST_CACHE_BYTES = 4 << 30
+    STAT_CACHE_BYTES = 1 << 30
+
+    @staticmethod
+    def _entry_nbytes(ent) -> int:
+        n = 0
+        for x in ent if isinstance(ent, (tuple, list)) else (ent,):
+            nb = getattr(x, "nbytes", None)
+            if nb is not None:
+                n += nb
+            elif isinstance(x, (list, str, bytes)):
+                n += 64 * len(x)  # vocab lists etc., rough
+            else:
+                n += 64
+        return n
+
+    def _host_lane_put(self, key, ent) -> None:
+        for k in [k for k in self._host_lane_cache
+                  if k[0] == key[0] and k[2] == key[2] and k[1] != key[1]]:
+            self._host_lane_nbytes -= self._entry_nbytes(self._host_lane_cache.pop(k))
+        self._host_lane_cache[key] = ent
+        self._host_lane_nbytes += self._entry_nbytes(ent)
+        while self._host_lane_nbytes > self.HOST_CACHE_BYTES and self._host_lane_cache:
+            k = next(iter(self._host_lane_cache))
+            self._host_lane_nbytes -= self._entry_nbytes(self._host_lane_cache.pop(k))
+
+    def _stat_key(self, sd, tag):
+        """Cache key for host analyses over a scan lane set; None when the
+        scan has no (table, version) identity."""
+        if sd.version < 0:
+            return None
+        return (sd.frag.ds.table.id, sd.version, tag)
+
+    def _cached_stat(self, sd, tag, compute):
+        key = self._stat_key(sd, tag)
+        if key is None:
+            return compute()
+        hit = self._stat_cache.get(key)
+        if hit is None:
+            hit = compute()
+            # evict stale versions of the same (table, tag)
+            for k in [k for k in self._stat_cache
+                      if k[0] == key[0] and k[2] == key[2] and k[1] != key[1]]:
+                self._stat_cache_nbytes -= self._entry_nbytes(self._stat_cache.pop(k))
+            self._stat_cache[key] = hit
+            self._stat_cache_nbytes += self._entry_nbytes(hit)
+            while self._stat_cache_nbytes > self.STAT_CACHE_BYTES and self._stat_cache:
+                k = next(iter(self._stat_cache))
+                self._stat_cache_nbytes -= self._entry_nbytes(self._stat_cache.pop(k))
+        return hit
+
+    def _lane_minmax(self, sd, off):
+        """(lo, hi) of a lane's present values, or None when empty/float —
+        cached per (table, version, offset): prepare() runs per dispatch
+        but the answer only changes when the table does."""
+        def compute():
+            d, v = sd.lane(off)
+            if d.dtype.kind == "f":
+                return "float"
+            if not v.any():
+                return None
+            return (int(d[v].min()), int(d[v].max()))
+
+        return self._cached_stat(sd, ("minmax", off), compute)
+
+    def _dev_put(self, key, build):
+        """Device array for `key`, uploading via build() on miss. Stale
+        versions of the same (table, tag) are evicted eagerly; the rest
+        LRU under DEV_CACHE_BYTES."""
+        if key is None:
+            return jnp.asarray(build())
+        hit = self._dev_cache.get(key)
+        if hit is not None:
+            self._dev_cache[key] = self._dev_cache.pop(key)  # LRU touch
+            return hit
+        tid, ver, tag = key[0], key[1], key[2]
+        for k in [k for k in self._dev_cache if k[0] == tid and k[2] == tag and k[1] != ver]:
+            self._dev_cache_nbytes -= self._dev_cache.pop(k).nbytes
+        arr = jnp.asarray(build())
+        self._dev_cache[key] = arr
+        self._dev_cache_nbytes += arr.nbytes
+        while self._dev_cache_nbytes > self.DEV_CACHE_BYTES and self._dev_cache:
+            _, old = next(iter(self._dev_cache.items()))
+            self._dev_cache_nbytes -= old.nbytes
+            del self._dev_cache[next(iter(self._dev_cache))]
+        return arr
 
     # ------------------------------------------------------------ planning
 
@@ -162,12 +280,12 @@ class MPPEngine:
                     return False  # dict codes differ per table
                 vals = []
                 for sd, off in ((ps, poff), (bs, boff)):
-                    d, v = sd.lane(off)
-                    if d.dtype.kind == "f":
+                    mm = self._lane_minmax(sd, off)
+                    if mm == "float":
                         self.last_fallback_reason = "float join key"
                         return False
-                    if v.any():
-                        vals.append((int(d[v].min()), int(d[v].max())))
+                    if mm is not None:
+                        vals.append(mm)
                 if not vals:
                     los.append(0)
                     sizes.append(1)
@@ -187,24 +305,56 @@ class MPPEngine:
             lvl = _Level(frag, los, strides)
             # build-side key multiplicity, measured on the UNFILTERED lane
             # (a safe upper bound: pushed filters only shrink groups).
-            # Unique keys (FK/PK joins) probe 1:1; duplicates expand each
-            # probe row into `mult` static slots — capped so the expanded
-            # shapes stay sane, else host hash join takes over.
-            bkeys = self._pack_host(frag.build_keys, scan_of_joined, los, strides)
-            if bkeys is None:
+            # Unique keys (FK/PK joins) probe 1:1; duplicated build keys
+            # take the compact cumsum-offset path (mult=2 is a path
+            # selector, not a fan-out factor — output capacity is bounded
+            # by the drop-guarded join output, so no multiplicity cap).
+            boffs = tuple(scan_of_joined[bk][1] for bk in frag.build_keys)
+
+            def build_mult():
+                bkeys = self._pack_host(frag.build_keys, scan_of_joined, los, strides)
+                if bkeys is None:
+                    return None
+                kv, km = bkeys
+                present = kv[km]
+                if len(present):
+                    _, counts = np.unique(present, return_counts=True)
+                    return 1 if int(counts.max()) <= 1 else 2
+                return 1
+
+            # uniqueness is a property of the build key lanes alone —
+            # cache it per (table, version, key offsets)
+            mult = self._cached_stat(bscan, ("uniq", boffs), build_mult)
+            if mult is None:
                 self.last_fallback_reason = "unpackable build keys"
                 return False
-            kv, km = bkeys
-            present = kv[km]
-            if len(present):
-                _, counts = np.unique(present, return_counts=True)
-                mult = int(counts.max())
-            else:
-                mult = 1
-            if mult > MAX_BUILD_DUP:
-                self.last_fallback_reason = f"build key multiplicity {mult} > {MAX_BUILD_DUP}"
-                return False
-            lvl.mult = 1 << (mult - 1).bit_length() if mult > 1 else 1
+            lvl.mult = mult
+
+            # exact pre-filter join cardinality (Σ over matched keys of
+            # probe-count × build-count) — sizes the compact join's output
+            # capacity tightly instead of a blanket 2×max(sides). Filters
+            # only shrink the true output, so this is a hard upper bound.
+            psds = {id(scan_of_joined[pk][0]) for pk in frag.probe_keys}
+            expected = None
+            if len(psds) == 1 and mult > 1:
+                psd = scan_of_joined[frag.probe_keys[0]][0]
+                poffs = tuple(scan_of_joined[pk][1] for pk in frag.probe_keys)
+
+                def jcard():
+                    pk = self._pack_host(frag.probe_keys, scan_of_joined, los, strides)
+                    bk = self._pack_host(frag.build_keys, scan_of_joined, los, strides)
+                    if pk is None or bk is None:
+                        return None
+                    pu, pc = np.unique(pk[0][pk[1]], return_counts=True)
+                    bu, bc = np.unique(bk[0][bk[1]], return_counts=True)
+                    ii = np.searchsorted(pu, bu)
+                    iic = np.clip(ii, 0, max(len(pu) - 1, 0))
+                    m = (ii < len(pu)) & (pu[iic] == bu) if len(pu) else np.zeros(len(bu), bool)
+                    return int(np.sum(pc[iic[m]] * bc[m])) if len(bu) else 0
+
+                tag = ("jcard", boffs, poffs, psd.frag.ds.table.id, psd.version)
+                expected = self._cached_stat(bscan, tag, jcard)
+            lvl.expected_out = expected
             # broadcast only when the build side is small by BOTH row count
             # and estimated bytes (ref: tidb_broadcast_join_threshold_count
             # / _size in planner/core exhaust_physical_plans.go)
@@ -269,35 +419,68 @@ class MPPEngine:
         return acc, mask
 
     def _prepare_agg(self, mplan: MPPPlan, scans, scan_of_joined, eng):
-        """Direct-addressed group-by over the joined schema (mirrors
-        TPUEngine._lower_agg's domain rules)."""
+        """Device aggregation metadata. Two modes (mirrors TPUEngine's
+        dense-vs-segment split):
+        - dense: direct-addressed buckets + psum when the packed key
+          domain is small (ref: cophandler closure exec hash agg);
+        - sorted: wide int key domains, only when a TopN over an agg
+          output is fused (mplan.topn) — per-device lexsort + segment
+          reduce, hash exchange by group key, final reduce, device top-k.
+          The mesh then returns k groups per device instead of shipping
+          the joined rows back over the (slow) host link."""
         agg = mplan.agg
         domains, key_meta = [], []
+        sorted_domains = []  # step-compressed (gcd) domains for wide mode
         for g in agg.group_by:
             if not isinstance(g, ExprCol):
                 return None
             sd, off = scan_of_joined[g.idx]
             d, v = sd.lane(off)
             if off in sd.vocabs:
-                domains.append(max(len(sd.vocabs[off]), 1))
-                key_meta.append(("dict", sd.vocabs[off]))
+                dom = max(len(sd.vocabs[off]), 1)
+                domains.append(dom)
+                sorted_domains.append(dom)
+                key_meta.append(("dict", sd.vocabs[off], 1))
             else:
                 if d.dtype.kind == "f" or not len(d):
                     return None
-                pres = d[v]
-                if not len(pres):
-                    lo, hi = 0, 0
-                else:
-                    lo, hi = int(pres.min()), int(pres.max())
-                if hi - lo + 1 > DIRECT_GROUP_MAX:
-                    return None
+
+                def key_stats(_sd=sd, _off=off):
+                    dd, vv = _sd.lane(_off)
+                    pres = dd[vv]
+                    if not len(pres):
+                        return (0, 0, 1)
+                    lo_, hi_ = int(pres.min()), int(pres.max())
+                    # sparse int keys (e.g. microsecond-packed DATEs step
+                    # by 86400e6) compress by their common stride so the
+                    # packed code fits int64
+                    st = int(np.gcd.reduce((pres - lo_).astype(np.int64))) or 1
+                    return (lo_, hi_, st)
+
+                lo, hi, step = self._cached_stat(sd, ("keystats", off), key_stats)
                 domains.append(hi - lo + 1)
-                key_meta.append(("int", lo))
+                sorted_domains.append((hi - lo) // step + 1)
+                key_meta.append(("int", lo, step))
         nseg = 1
+        dense_ok = True
         for s in domains:
             nseg *= s + 1
-        if nseg > DIRECT_GROUP_MAX:
-            return None
+            if nseg > DIRECT_GROUP_MAX:
+                dense_ok = False
+                break
+        mode = "dense"
+        if not dense_ok:
+            if mplan.topn is None:
+                return None
+            wide = 1
+            for s in sorted_domains:
+                wide *= s + 1
+                if wide > 1 << 62:
+                    return None  # even compressed keys overflow the code
+            agg_idx = mplan.topn[0]
+            if agg.aggs[agg_idx].name not in ("sum", "count"):
+                return None
+            mode = "sorted"
         r_args = []
         for a in agg.aggs:
             ra = []
@@ -318,7 +501,20 @@ class MPPEngine:
                     return None
                 ra.append(x)
             r_args.append(ra)
-        return {"domains": domains, "key_meta": key_meta, "nseg": nseg, "r_args": r_args}
+        meta = {"domains": domains, "key_meta": key_meta, "nseg": nseg,
+                "r_args": r_args, "mode": mode}
+        if mode == "sorted":
+            # lexicographic stride packing (NULL slot per key, radix dom+1)
+            radixes = [d + 1 for d in sorted_domains]
+            strides = [1] * len(radixes)
+            acc = 1
+            for i in range(len(radixes) - 1, -1, -1):
+                strides[i] = acc
+                acc *= radixes[i]
+            meta["strides"] = strides
+            meta["radixes"] = radixes
+            meta["topn"] = mplan.topn
+        return meta
 
     # ------------------------------------------------------------- compile
 
@@ -373,28 +569,59 @@ class MPPEngine:
             is_sharded = id(s.frag) in sharded
             n = s.n_rows
             total = max(-(-n // n_dev), 1) * n_dev if is_sharded else max(n, 1)
-            rowid = _pad(np.arange(n, dtype=np.int64), total)
-            rv = np.zeros(total, dtype=bool)
-            rv[:n] = True
+            tid = s.frag.ds.table.id
+            ver = s.version
+
+            def ck(tag, _tid=tid, _ver=ver, _tot=total, _sh=is_sharded):
+                return None if _ver < 0 else (_tid, _ver, tag, _tot, _sh)
+
             spec = P(axis) if is_sharded else P()
-            args += [rowid, rv]
+            args.append(self._dev_put(ck("rowid"),
+                                      lambda: _pad(np.arange(n, dtype=np.int64), total)))
+            def _rv():
+                rv = np.zeros(total, dtype=bool)
+                rv[:n] = True
+                return rv
+            args.append(self._dev_put(ck("rv"), _rv))
             in_specs += [spec, spec]
             for off in offs:
-                d, v = s.lane(off)
-                args.append(_pad(d, total))
-                args.append(_pad(v, total))
+                args.append(self._dev_put(
+                    ck(("d", off)), lambda _o=off: _pad(s.lane(_o)[0], total)))
+                args.append(self._dev_put(
+                    ck(("v", off)), lambda _o=off: _pad(s.lane(_o)[1], total)))
                 in_specs += [spec, spec]
             scan_arg_meta.append((id(s.frag), offs, is_sharded))
             shapes.append((total, is_sharded, offs))
 
         key = self._program_key(mplan, meta, scans, shapes, n_dev)
-        prog = self._programs.get(key)
-        if prog is None:
-            prog = self._build_program(mplan, meta, scan_arg_meta, mesh, axis, n_dev, tuple(in_specs))
-            self._programs[key] = prog
+        entry = self._programs.get(key)
+        if entry is None:
+            entry = self._build_program(mplan, meta, scan_arg_meta, mesh, axis, n_dev, tuple(in_specs))
+            self._programs[key] = entry
             self.compile_count += 1
-        outs = prog(*[jnp.asarray(a) for a in args])
+        prog, out_meta = entry
+        packed = np.asarray(prog(*[jnp.asarray(a) for a in args]))
+        # unpack the single int64 result matrix (see with_drops)
+        outs = []
+        for i, kind in enumerate(out_meta):
+            row = packed[i]
+            if kind == "f64":
+                outs.append(row.view(np.float64))
+            elif kind == "bool":
+                outs.append(row != 0)
+            else:
+                outs.append(row)
+        dropped = int(outs[-1][0])
+        outs = outs[:-1]
+        if dropped:
+            # skewed keys overflowed an exchange bucket: the run is
+            # incomplete — never surface it; host path takes over
+            self.fallbacks += 1
+            self.last_fallback_reason = f"exchange bucket overflow ({dropped} rows)"
+            return None
         if meta["agg"] is not None:
+            if meta["agg"]["mode"] == "sorted":
+                return self._finalize_topk(mplan, meta, outs), True
             return self._finalize_agg(mplan, meta, outs), True
         return self._finalize_rows(mplan, meta, scans, outs), meta["agg"] is not None
 
@@ -413,7 +640,7 @@ class MPPEngine:
                 lvl.frag.kind, lvl.frag.exchange,
                 repr(lvl.frag.probe_keys), repr(lvl.frag.build_keys),
                 repr(lvl.key_lo), repr(lvl.key_stride), repr(lvl.r_post),
-                str(lvl.mult),
+                str(lvl.mult), str(lvl.expected_out),
             ]
         if meta["agg"]:
             a = meta["agg"]
@@ -421,9 +648,10 @@ class MPPEngine:
             # cache key must carry it; dict keys are covered by kind+domain
             # (vocab only affects host decode + already-keyed r_pushed).
             parts += [repr(a["domains"]),
-                      repr([(m[0], m[1]) if m[0] == "int" else (m[0],) for m in a["key_meta"]]),
+                      repr([(m[0], m[1], m[2]) if m[0] == "int" else (m[0],) for m in a["key_meta"]]),
                       repr(a["r_args"]), repr([x.name for x in mplan.agg.aggs]),
-                      repr(mplan.agg.group_by)]
+                      repr(mplan.agg.group_by),
+                      a["mode"], repr(a.get("strides")), repr(a.get("topn"))]
         return hashlib.sha256("|".join(parts).encode()).hexdigest()
 
     # ------------------------------------------------------------- kernel
@@ -482,10 +710,28 @@ class MPPEngine:
                 kv = v if kv is None else (kv & v)
             return acc, kv
 
+        drop_acc: list = []  # per-exchange local drop counts (psum'd at end)
+
         def exchange_all(lanemap, mask, rowids, okey):
-            """all_to_all every lane, bucketed by owner = okey % n_dev."""
+            """all_to_all every lane, bucketed by owner = okey % n_dev.
+
+            Bucket capacity is bounded at ~slack×cap/n_dev (+margin), NOT
+            cap per destination: an unbounded layout would grow every
+            post-exchange array by n_dev× and the whole downstream program
+            with it — the opposite of scaling. Hash-uniform keys overflow
+            a 2× slack with negligible probability; when data is skewed
+            enough to overflow, the dropped counter (psum'd, returned as
+            the program's last output) makes execute() discard the run and
+            fall back to the host path, so results are never silently
+            wrong (the spill/fallback discipline of the reference's
+            exchange, mpp_exec.go, in static-shape form)."""
+            if n_dev == 1:
+                # single-device mesh (one real chip): every row already
+                # lives on its owner — the exchange is the identity
+                return lanemap, mask, rowids
             rows = mask.shape[0]
-            cap = rows
+            bcap = -(-rows * 2 // n_dev) + 64  # slack 2 + small-size margin
+            bcap = min(bcap, rows)
             owner = (okey % n_dev).astype(jnp.int32)
             order = jnp.argsort(jnp.where(mask, owner, n_dev))
             own_s = jnp.where(mask, owner, n_dev)[order]
@@ -495,15 +741,20 @@ class MPPEngine:
             starts = jnp.concatenate(
                 [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
             )
-            idx = jnp.arange(rows)
-            within = idx - starts[jnp.clip(own_s, 0, n_dev - 1)]
-            ok = (own_s < n_dev) & (within < cap)
-            tgt = (jnp.clip(own_s, 0, n_dev - 1), jnp.clip(within, 0, cap - 1))
+            drop_acc.append(
+                jnp.sum(counts - jnp.minimum(counts, bcap)).astype(jnp.int64)
+            )
+            # owner-sorted rows make the (n_dev, bcap) bucket layout a pure
+            # GATHER (src = starts[dev] + slot) — never a scatter, which
+            # the TPU serializes
+            src = jnp.clip(
+                starts[:, None] + jnp.arange(bcap, dtype=jnp.int32)[None, :], 0, rows - 1
+            )
+            okg = jnp.arange(bcap, dtype=jnp.int32)[None, :] < jnp.minimum(counts, bcap)[:, None]
 
             def xc(lane):
                 lane_s = lane[order]
-                buf = jnp.zeros((n_dev, cap), dtype=lane.dtype)
-                buf = buf.at[tgt].set(jnp.where(ok, lane_s, jnp.zeros((), lane.dtype)))
+                buf = jnp.where(okg, lane_s[src], jnp.zeros((), lane.dtype))
                 out = jax.lax.all_to_all(buf, axis, 0, 0, tiled=True)
                 return out.reshape(-1)
 
@@ -534,7 +785,7 @@ class MPPEngine:
             sv = bvalid[order]
             M = lvl.mult
             if M == 1:
-                pos = jnp.clip(jnp.searchsorted(sk, pkey), 0, B - 1)
+                pos = jnp.clip(jnp.searchsorted(sk, pkey, method="sort"), 0, B - 1)
                 match = pmask & pkv & sv[pos] & (sk[pos] == pkey)
                 bsel = order[pos]
                 merged = dict(pmap_)
@@ -544,31 +795,63 @@ class MPPEngine:
                 rowids[id(frag.build)] = jnp.where(match, brow[id(frag.build)][bsel], -1)
                 mask = match if frag.kind == "inner" else pmask
             else:
-                # duplicate build keys: each probe row fans into M slots
-                # reading consecutive positions of the sorted build run
+                # duplicate build keys: compact cumsum-offset join. Each
+                # probe row claims exactly its match-count output slots
+                # (exclusive cumsum → positions), instead of max-mult
+                # static fan-out — output capacity stays O(join output),
+                # not O(probe × max multiplicity), which is what lets a
+                # fact-table build side scale. Capacity overflow bumps the
+                # dropped counter → host fallback (never wrong results).
                 rows = pkey.shape[0]
-                first = jnp.searchsorted(sk, pkey)  # leftmost match
-                slots = jnp.arange(M)
-                pos = (first[:, None] + slots[None, :]).reshape(-1)
-                inb = pos < B
-                posc = jnp.clip(pos, 0, B - 1)
-                rep = lambda x: jnp.repeat(x, M, axis=0)  # noqa: E731
-                pkey_e = rep(pkey)
-                pvalid_e = rep(pmask & pkv)
-                match = pvalid_e & inb & sv[posc] & (sk[posc] == pkey_e)
-                bsel = order[posc]
-                merged = {j: (rep(d), rep(v)) for j, (d, v) in pmap_.items()}
-                for j, (d, v) in bmap.items():
-                    merged[j] = (d[bsel], v[bsel] & match)
-                rowids = {fid: rep(r) for fid, r in prow.items()}
+                exp = lvl.expected_out
+                if exp is None:
+                    C = 2 * max(int(rows), int(B)) + 64
+                elif n_dev == 1:
+                    C = exp + 64  # exact global bound
+                else:
+                    # per-device share with 2x skew slack, drop-guarded
+                    C = min(2 * (exp // n_dev) + 64 + int(rows), 2 * max(int(rows), int(B)) + 64)
+                if frag.kind != "inner":
+                    C = C + int(rows)  # unmatched probe rows also emit
+                left = jnp.searchsorted(sk, pkey, side="left", method="sort")
+                # match count per probe = run length at `left` (cummax/
+                # cummin run boundaries) — avoids the second sort-based
+                # searchsorted for side="right"
+                bidx = jnp.arange(B, dtype=jnp.int32)
+                bfirst = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+                blast = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones(1, bool)])
+                rstart = jax.lax.cummax(jnp.where(bfirst, bidx, 0))
+                rend = -jax.lax.cummax(jnp.where(blast, -bidx, -(B - 1))[::-1])[::-1]
+                run_len = rend - rstart + 1
+                leftc = jnp.clip(left, 0, B - 1)
+                hit = (left < B) & (sk[leftc] == pkey)
+                pvalid = pmask & pkv
+                cnt = jnp.where(pvalid & hit, run_len[leftc], 0).astype(jnp.int32)
+                if frag.kind != "inner":
+                    # left join: unmatched probe rows still emit one row
+                    cnt = jnp.maximum(cnt, (pmask).astype(cnt.dtype))
+                opos = (jnp.cumsum(cnt) - cnt).astype(jnp.int32)  # exclusive
+                total = jnp.sum(cnt)
+                drop_acc.append(jnp.maximum(total - C, 0).astype(jnp.int64))
+                j = jnp.arange(C, dtype=jnp.int32)
+                src = jnp.clip(jnp.searchsorted(opos, j, side="right", method="sort") - 1, 0, rows - 1)
+                slot = j - opos[src]
+                emitted = (j < total) & (slot < cnt[src])
+                matched_probe = cnt[src] > 0 if frag.kind == "inner" else (pvalid & hit)[src]
+                bpos = jnp.clip(left[src] + slot, 0, B - 1)
+                match = emitted & matched_probe & pvalid[src] & sv[bpos] & (sk[bpos] == pkey[src])
+                bsel = order[bpos]
+                merged = {}
+                for jj, (d, v) in pmap_.items():
+                    merged[jj] = (d[src], v[src] & emitted)
+                for jj, (d, v) in bmap.items():
+                    merged[jj] = (d[bsel], v[bsel] & match)
+                rowids = {fid: jnp.where(emitted, r[src], -1) for fid, r in prow.items()}
                 rowids[id(frag.build)] = jnp.where(match, brow[id(frag.build)][bsel], -1)
                 if frag.kind == "inner":
                     mask = match
                 else:
-                    # left join: slot 0 always carries the probe row (its
-                    # build lanes are already invalidated when unmatched)
-                    slot0 = (jnp.arange(rows * M) % M) == 0
-                    mask = jnp.where(slot0, rep(pmask), match)
+                    mask = emitted & pmask[src]
             for c in lvl.r_post:
                 d, v = eval_dev(c, merged)
                 d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d
@@ -576,13 +859,188 @@ class MPPEngine:
                 mask = mask & v & (d != 0)
             return merged, mask, rowids
 
+        def sorted_agg_stage(lanemap, mask):
+            """Wide-key device aggregation: lexsort+segment reduce locally,
+            hash-exchange complete groups to their owner device, final
+            reduce, then top-k by the fused ORDER BY aggregate. Output is
+            k exact group results per device — the host only merges
+            n_dev*k candidates (ref: the TiFlash partial/final agg +
+            TopN pipeline, mpp_exec.go, collapsed into one program)."""
+            strides = agg_meta["strides"]
+            code = jnp.zeros(mask.shape, jnp.int64)
+            for g, km, st in zip(agg.group_by, agg_meta["key_meta"], strides):
+                d, v = lanemap[g.idx]
+                if km[0] == "int":
+                    # gcd-compressed: (d - lo) // step + 1, NULL → 0
+                    kd = ((d.astype(jnp.int64) - km[1]) // km[2] + 1) * v
+                else:
+                    kd = (d.astype(jnp.int64) + 1) * v
+                code = code + kd * st
+            code = jnp.where(mask, code, I64_MAX)
+
+            # per-agg raw value lanes (+ count lane), zeroed off-mask
+            lanes = []  # (array, merge_op)
+            for a, ra in zip(agg.aggs, agg_meta["r_args"]):
+                if ra:
+                    d, v = eval_dev(ra[0], lanemap)
+                    d = jnp.broadcast_to(d, code.shape) if getattr(d, "ndim", 0) == 0 else d
+                    v = jnp.broadcast_to(v, code.shape) if getattr(v, "ndim", 0) == 0 else v
+                else:
+                    d = jnp.ones(code.shape, jnp.int64)
+                    v = jnp.ones(code.shape, bool)
+                ok = mask & v
+                if a.name == "count":
+                    lanes.append((ok.astype(jnp.int64), "sum"))
+                elif a.name in ("sum", "avg"):
+                    z = 0.0 if d.dtype in (jnp.float64, jnp.float32) else 0
+                    lanes.append((jnp.where(ok, d, z), "sum"))
+                    lanes.append((ok.astype(jnp.int64), "sum"))
+                elif a.name == "min":
+                    big = jnp.inf if d.dtype in (jnp.float64, jnp.float32) else I64_MAX
+                    lanes.append((jnp.where(ok, d, big), "min"))
+                    lanes.append((ok.astype(jnp.int64), "sum"))
+                else:  # max
+                    small = -jnp.inf if d.dtype in (jnp.float64, jnp.float32) else -I64_MAX - 1
+                    lanes.append((jnp.where(ok, d, small), "max"))
+                    lanes.append((ok.astype(jnp.int64), "sum"))
+
+            def _neutral(dtype, op):
+                if op == "min":
+                    return jnp.inf if dtype in (jnp.float64, jnp.float32) else I64_MAX
+                if op == "max":
+                    return -jnp.inf if dtype in (jnp.float64, jnp.float32) else -I64_MAX - 1
+                return jnp.zeros((), dtype)
+
+            def seg_reduce(key, vals, max_run: int):
+                """Scatter-free segmented reduce: sort by key, run totals
+                land on each run's FIRST slot. Sum/count lanes use one
+                cumsum + run-boundary gathers (3 vector passes); min/max
+                lanes use distance-doubling combines (log2(max_run)
+                passes). No segment_* scatters anywhere — XLA:CPU
+                serializes them and TPU pays scatter overhead."""
+                order = jnp.argsort(key)
+                sk = key[order]
+                n = int(sk.shape[0])
+                idx = jnp.arange(n, dtype=jnp.int32)
+                first = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+                last = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones(1, bool)])
+                rend = -jax.lax.cummax(jnp.where(last, -idx, -(n - 1))[::-1])[::-1]
+                arrs = []
+                need_doubling = [i for i, (_, op) in enumerate(vals) if op != "sum"]
+                for i, (arr, op) in enumerate(vals):
+                    a = arr[order]
+                    if op == "sum":
+                        c = jnp.cumsum(a)
+                        prev = jnp.concatenate([jnp.zeros(1, a.dtype), c[:-1]])
+                        # total of the run starting here = c[end] - c[start-1]
+                        a = jnp.where(first, c[rend] - prev, jnp.zeros((), a.dtype))
+                    arrs.append(a)
+                if need_doubling:
+                    d = 1
+                    while d < max_run:
+                        same = jnp.concatenate(
+                            [sk[d:] == sk[:-d], jnp.zeros((d,), bool)]
+                        )
+                        for i in need_doubling:
+                            a = arrs[i]
+                            op = vals[i][1]
+                            neut = _neutral(a.dtype, op)
+                            sh = jnp.concatenate([a[d:], jnp.full((d,), neut, a.dtype)])
+                            contrib = jnp.where(same, sh, neut)
+                            if op == "min":
+                                arrs[i] = jnp.minimum(a, contrib)
+                            else:
+                                arrs[i] = jnp.maximum(a, contrib)
+                        d *= 2
+                valid = first & (sk != I64_MAX)
+                ukey = jnp.where(valid, sk, I64_MAX)
+                return ukey, arrs, valid
+
+            def finish_topk(fkey, fvals, fvalid):
+                # device top-k on the fused ORDER BY aggregate
+                agg_idx, desc, k = agg_meta["topn"]
+                lane_pos = 0
+                for i, a in enumerate(agg.aggs):
+                    if i == agg_idx:
+                        break
+                    lane_pos += 1 if a.name == "count" else 2
+                val = fvals[lane_pos]
+                valid = fvalid
+                if val.dtype in (jnp.float64, jnp.float32):
+                    score = jnp.where(valid, val, -jnp.inf)
+                    score = score if desc else -score
+                else:
+                    score = jnp.where(valid, val, -I64_MAX)
+                    score = score if desc else jnp.where(valid, -val, -I64_MAX)
+                kk = min(k, int(score.shape[0]))
+                _, idx = jax.lax.top_k(score, kk)
+                outs = [fkey[idx], valid[idx]]
+                outs.extend(v[idx] for v in fvals)
+                return tuple(outs)
+
+            rows_local = int(code.shape[0])
+            if n_dev == 1:
+                # one device: a single reduce IS the final state
+                fkey, fvals, fvalid = seg_reduce(code, lanes, rows_local)
+                return finish_topk(fkey, fvals, fvalid)
+            # 1. local pre-reduce (shrinks exchange volume to |local groups|)
+            ukey, uvals, uvalid = seg_reduce(code, lanes, rows_local)
+            # 2. exchange whole groups to their owner device
+            pseudo = {i: (arr, uvalid) for i, arr in enumerate(uvals)}
+            pseudo[len(uvals)] = (ukey, uvalid)
+            new_map, ex_mask, _ = exchange_all(
+                pseudo, uvalid, {}, jnp.where(uvalid, ukey, 0)
+            )
+            ukey2 = jnp.where(ex_mask, new_map[len(uvals)][0], I64_MAX)
+            vals2 = []
+            for i, (_, op) in enumerate(lanes):
+                arr = new_map[i][0]
+                arr = jnp.where(ex_mask, arr, _neutral(arr.dtype, op))
+                vals2.append((arr, op))
+            # 3. final reduce: each key has at most one fragment per source
+            # device, so n_dev bounds the run length
+            fkey, fvals, fvalid = seg_reduce(ukey2, vals2, n_dev)
+            return finish_topk(fkey, fvals, fvalid)
+
+        out_meta: list = []  # host-side unpack dtypes, filled at trace time
+
         def kernel(*flat):
+            drop_acc.clear()
+            out_meta.clear()
+
+            def with_drops(outs):
+                """Pack EVERY output + the dropped counter into one int64
+                matrix: each device→host array read over a remote link
+                costs a full round-trip (~100ms measured), so the program
+                must ship exactly ONE result buffer."""
+                d = sum(drop_acc) if drop_acc else jnp.zeros((), jnp.int64)
+                d = jax.lax.psum(d, axis)
+                rows_packed = []
+                for o in outs:
+                    if o.dtype == jnp.float32:
+                        o = o.astype(jnp.float64)
+                    if o.dtype == jnp.float64:
+                        out_meta.append("f64")
+                        rows_packed.append(jax.lax.bitcast_convert_type(o, jnp.int64))
+                    elif o.dtype == jnp.bool_:
+                        out_meta.append("bool")
+                        rows_packed.append(o.astype(jnp.int64))
+                    else:
+                        out_meta.append("i64")
+                        rows_packed.append(o.astype(jnp.int64))
+                out_meta.append("i64")  # dropped row
+                L = rows_packed[0].shape[0]
+                rows_packed.append(jnp.broadcast_to(d, (L,)))
+                return jnp.stack(rows_packed)
+
             lanemap, mask, rowids = join_stage(mplan.root, flat)
             if agg is None:
                 outs = [mask]
                 for s in scans:
                     outs.append(rowids.get(id(s), jnp.full(mask.shape, -1, jnp.int64)))
-                return tuple(outs)
+                return with_drops(outs)
+            if agg_meta["mode"] == "sorted":
+                return with_drops(sorted_agg_stage(lanemap, mask))
             # fused partial aggregation + psum (exact int/scaled-decimal)
             nseg = agg_meta["nseg"]
             code = jnp.zeros(mask.shape, dtype=jnp.int32)
@@ -596,19 +1054,15 @@ class MPPEngine:
             for a, ra in zip(agg.aggs, agg_meta["r_args"]):
                 outs.extend(self._agg_partials(a, ra, lanemap, mask, seg, nseg, eval_dev))
             red = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
-            return tuple(red[op](o, axis) for o, op in outs)
+            return with_drops([red[op](o, axis) for o, op in outs])
 
-        n_scan_out = 1 + len(scans)
-        if agg is None:
-            out_specs = tuple([P(axis)] * n_scan_out)
+        if agg is not None and agg_meta["mode"] == "dense":
+            out_specs = P()  # psum'd: replicated (nout, nseg)
         else:
-            nout = 1
-            for a in agg.aggs:
-                nout += 1 if a.name == "count" else 2
-            out_specs = tuple([P()] * nout)
+            out_specs = P(None, axis)  # per-device slices concat on dim 1
 
         sm = shard_map(kernel, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs)
-        return jax.jit(sm)
+        return jax.jit(sm), out_meta
 
     @staticmethod
     def _agg_partials(a, r_args, lanemap, mask, seg, nseg, eval_dev):
@@ -700,6 +1154,77 @@ class MPPEngine:
             elif a.name in ("min", "max"):
                 s = np.asarray(outs[pos])[present]
                 cnt = np.asarray(outs[pos + 1])[present]
+                has = cnt > 0
+                ft = out_fts[oi]
+                arg = a.args[0] if a.args else None
+                if isinstance(arg, ExprCol):
+                    sd, off = soj[arg.idx]
+                    if off in sd.vocabs:
+                        vocab = sd.vocabs[off]
+                        data = np.empty(G, dtype=object)
+                        for j in range(G):
+                            data[j] = vocab[int(s[j])] if has[j] and 0 <= int(s[j]) < len(vocab) else None
+                        cols.append(Column(ft, data, has))
+                        pos += 2
+                        oi += 1
+                        continue
+                data = s if ft.is_float() else np.where(has, s.astype(np.int64), 0)
+                cols.append(Column(ft, data, has))
+                pos += 2
+                oi += 1
+        return Chunk(cols)
+
+    def _finalize_topk(self, mplan, meta, outs) -> Chunk:
+        """Per-device top-k group results → partial-layout chunk (same
+        shape _finalize_agg emits) for the host FinalHashAggExec + exact
+        TopN. n_dev*k rows total — the transfer is tiny by construction."""
+        agg = mplan.agg
+        agg_meta = meta["agg"]
+        soj = meta["scan_of_joined"]
+        codes = np.asarray(outs[0])
+        valid = np.asarray(outs[1])
+        keep = np.nonzero(valid & (codes != np.iinfo(np.int64).max))[0]
+        G = len(keep)
+        codes = codes[keep]
+        out_fts = [g.ret_type for g in agg.group_by]
+        for a in agg.aggs:
+            out_fts.extend(ft for _, ft in a.partial_final_types())
+        cols: list[Column] = []
+        oi = 0
+        for km, st, radix in zip(agg_meta["key_meta"], agg_meta["strides"], agg_meta["radixes"]):
+            comp = (codes // st) % radix
+            kvalid = comp > 0
+            ft = out_fts[oi]
+            if km[0] == "dict":
+                vocab = km[1]
+                data = np.empty(G, dtype=object)
+                for j, c in enumerate(comp):
+                    data[j] = vocab[c - 1] if c > 0 else None
+            else:
+                data = np.where(kvalid, (comp - 1) * km[2] + km[1], 0).astype(np.int64)
+            cols.append(Column(ft, data, kvalid))
+            oi += 1
+        pos = 2
+        for a, ra in zip(agg.aggs, agg_meta["r_args"]):
+            if a.name == "count":
+                cnt = np.asarray(outs[pos])[keep]
+                cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
+                pos += 1
+                oi += 1
+            elif a.name in ("sum", "avg"):
+                s = np.asarray(outs[pos])[keep]
+                cnt = np.asarray(outs[pos + 1])[keep]
+                has = cnt > 0
+                sd = s if out_fts[oi].is_float() else s.astype(np.int64)
+                cols.append(Column(out_fts[oi], sd, has))
+                oi += 1
+                if a.name == "avg":
+                    cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
+                    oi += 1
+                pos += 2
+            elif a.name in ("min", "max"):
+                s = np.asarray(outs[pos])[keep]
+                cnt = np.asarray(outs[pos + 1])[keep]
                 has = cnt > 0
                 ft = out_fts[oi]
                 arg = a.args[0] if a.args else None

@@ -124,18 +124,25 @@ class PlanBuilder:
         from ..session.vars import SYSVARS
 
         name = raw
+        want_global = False
         for pre in ("global.", "session.", "local."):
             if name.startswith(pre):
                 name = name[len(pre):]
+                want_global = pre == "global."
                 break
         sv = SYSVARS.get(name)
         if sv is None:
             raise TiDBError(f"Unknown system variable '{name}'")
-        reader = self.context_info.get("sysvar_read")
-        if reader is not None:
-            val = reader(name)
+        if want_global:
+            # @@global.x reads the STORE value, not this session's override
+            reader = self.context_info.get("sysvar_read_global")
+            val = reader(name) if reader is not None else sv.default
         else:
-            val = self.context_info.get("vars", {}).get(name, sv.default)
+            reader = self.context_info.get("sysvar_read")
+            if reader is not None:
+                val = reader(name)
+            else:
+                val = self.context_info.get("vars", {}).get(name, sv.default)
         # live session state must not be baked into a cached plan
         self.used_eager_subquery = True
         if val is None:

@@ -70,6 +70,12 @@ class MPPPlan:
     agg: Aggregation | None  # fused partial aggregation, if any
     out_cols: list  # joined schema (probe cols then build cols, leftmost first)
     join_node: Join = None  # original plan node (host fallback path)
+    # fused ORDER BY <agg output> LIMIT k (ref: pushed TopN over the MPP
+    # gather, planner/core/task.go attach2Task TopN pushdown): set by the
+    # Limit(Sort(...)) builder when the sort key is a single sum/count
+    # aggregate. Enables the sorted (wide-key) device agg mode, whose
+    # output is k groups per device instead of the joined rows.
+    topn: tuple | None = None  # (agg_idx, desc: bool, k: int)
 
     def explain(self, indent: int = 0) -> str:
         """Fragment-tree rendering for EXPLAIN (sender/receiver parity)."""

@@ -74,7 +74,10 @@ class Session:
         self.store = storage or Storage()
         self.cop = cop_client or CopClient(self.store)
         self.current_db = "test"
+        # session vars initialize from defaults overlaid with the store's
+        # SET GLOBAL values (MySQL: session scope copies global at connect)
         self.vars = dict(DEFAULT_VARS)
+        self.vars.update(getattr(self.store, "global_vars", None) or {})
         self.txn: Txn | None = None
         self.in_explicit_txn = False
         self._is_cache: InfoSchema | None = None
@@ -333,7 +336,8 @@ class Session:
         # diagnostics area: each statement starts fresh; the previous
         # statement's warnings stay readable via @@warning_count and SHOW
         # WARNINGS (which skips the reset, like MySQL's diagnostics rules)
-        if not (isinstance(stmt, ast.Show) and getattr(stmt, "kind", "") in ("warnings", "errors")):
+        is_diag = isinstance(stmt, ast.Show) and getattr(stmt, "kind", "") in ("warnings", "errors")
+        if not is_diag:
             self._prev_warnings = self.warnings
             self.warnings = []
             # @@last_plan_from_cache/_binding describe the PREVIOUS statement;
@@ -434,7 +438,8 @@ class Session:
             self._abort_stmt()
             raise
         finally:
-            self._prev_error = not ok
+            if not is_diag:
+                self._prev_error = not ok
             _ACTIVE_TRACKER.reset(token)
             _ACTIVE_SESSION.reset(stok)
             _si.CURRENT.reset(itok)
@@ -455,7 +460,6 @@ class Session:
                     summary_on=self.vars.get("tidb_enable_stmt_summary", "ON") == "ON",
                     slow_log_on=self.vars.get("tidb_enable_slow_log", "ON") == "ON",
                     max_sql_len=int(self.vars.get("tidb_stmt_summary_max_sql_length", "4096")),
-                    capacity=int(self.vars.get("tidb_stmt_summary_max_stmt_count", "3000")),
                     redact=self.vars.get("tidb_redact_log", "OFF") == "ON",
                 )
                 # AFTER the counters above so a snapshot sees this stmt
@@ -805,19 +809,37 @@ class Session:
                         self.priv.require_dynamic(self, self.user, "SYSTEM_VARIABLES_ADMIN")
                     from .vars import SYSVARS, set_var
 
-                    prev = self.vars.get(name)
                     try:
-                        self.vars[name] = set_var(
-                            name, c.value.render(c.ret_type), self.warnings
+                        out = set_var(
+                            name, c.value.render(c.ret_type), self.warnings,
+                            scope=scope,
                         )
                     except ValueError as e:
                         raise TiDBError(str(e))
-                    try:
-                        self._apply_global_sysvar(name, self.vars[name])
-                    except TiDBError:
-                        # component rejected the value: don't keep it stored
-                        self.vars[name] = prev if prev is not None else SYSVARS[name].default
-                        raise
+                    if scope == "global":
+                        # SET GLOBAL: store-wide value, visible to NEW
+                        # sessions and @@global reads; the current
+                        # session's value is unchanged unless the var is
+                        # global-only (MySQL scope rules)
+                        gv = self.store.global_vars
+                        prev_g = gv.get(name)
+                        prev_s = self.vars.get(name)
+                        gv[name] = out
+                        if SYSVARS[name].scope == "global":
+                            self.vars[name] = out
+                        try:
+                            self._apply_global_sysvar(name, out)
+                        except TiDBError:
+                            # component rejected the value: restore both
+                            if prev_g is None:
+                                gv.pop(name, None)
+                            else:
+                                gv[name] = prev_g
+                            if prev_s is not None:
+                                self.vars[name] = prev_s
+                            raise
+                    else:
+                        self.vars[name] = out
                     # plan-time knobs (group_concat_max_len, sql_mode, ...)
                     # bake into cached plans — never serve a stale one
                     self._plan_cache.clear()
@@ -1290,6 +1312,18 @@ class Session:
                 gw.interval_ms = ms
         elif name == "tidb_gc_enable":
             self.store.gc_worker.enabled = val == "ON"
+        elif name == "tidb_stmt_summary_max_stmt_count":
+            # store-wide telemetry capacity: global-only, applied once
+            # here instead of last-writer-wins through per-record calls
+            self.store.stmt_stats.summary_capacity = int(val)
+
+    def _sysvar_read_global(self, name: str):
+        """@@global.x: the store-wide value (SET GLOBAL overrides over
+        registry defaults), never this session's override."""
+        from .vars import SYSVARS
+
+        sv = SYSVARS.get(name)
+        return self.store.global_vars.get(name, sv.default if sv else "")
 
     def _sysvar_read(self, name: str):
         """Live value for SELECT @@name — dynamic session state for the
@@ -1326,7 +1360,8 @@ class Session:
             run_subquery=self._run_subquery, params=self._exec_params,
             memtable_rows=self._memtable_rows,
             context_info={"user": self.user, "conn_id": self.conn_id, "vars": self.vars,
-                          "sysvar_read": self._sysvar_read},
+                          "sysvar_read": self._sysvar_read,
+                          "sysvar_read_global": self._sysvar_read_global},
             hints=getattr(self, "_cur_hints", None),
             expose_rowid=expose_rowid,
             seq_hook=self.sequence_op,

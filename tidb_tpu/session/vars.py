@@ -110,7 +110,7 @@ _sv("tidb_query_log_max_len", "4096", kind="int", lo=-1, consumed=True)
 _sv("tidb_stmt_summary_max_sql_length", "4096", kind="int", lo=0, consumed=True)
 _sv("tidb_enable_stmt_summary", "ON", kind="bool", consumed=True)
 _sv("tidb_enable_slow_log", "ON", kind="bool", consumed=True)
-_sv("tidb_stmt_summary_max_stmt_count", "3000", kind="int", lo=1, consumed=True)
+_sv("tidb_stmt_summary_max_stmt_count", "3000", scope="global", kind="int", lo=1, consumed=True)
 _sv("tidb_gc_enable", "ON", scope="global", kind="bool", consumed=True)
 _sv("tidb_gc_life_time", "10m0s", scope="global", consumed=True)
 _sv("tidb_gc_run_interval", "10m0s", scope="global", consumed=True)
@@ -331,10 +331,14 @@ for _name, _d in (
 DEFAULT_VARS = {v.name: v.default for v in SYSVARS.values()}
 
 
-def set_var(name: str, value: str, warnings: list | None = None) -> str:
+def set_var(name: str, value: str, warnings: list | None = None,
+            scope: str | None = None) -> str:
     """Validate one SET assignment → canonical stored value. Unknown
     variables raise (ref: ErrUnknownSystemVariable); known-but-inert ones
-    append a warning so silent no-ops are visible."""
+    append a warning so silent no-ops are visible. `scope` is the
+    assignment's requested scope ("global" for SET GLOBAL) — global-only
+    variables reject plain SET (MySQL ER_GLOBAL_VARIABLE), so store-wide
+    state can never be mutated below the SET GLOBAL privilege check."""
     from ..utils import sem
 
     sem.check_variable(name)
@@ -343,6 +347,12 @@ def set_var(name: str, value: str, warnings: list | None = None) -> str:
         raise ValueError(f"Unknown system variable '{name}'")
     if sv.scope == "none":
         raise ValueError(f"Variable '{name}' is a read only variable")
+    if sv.scope == "global" and scope != "global":
+        raise ValueError(
+            f"Variable '{name}' is a GLOBAL variable and should be set with SET GLOBAL"
+        )
+    if sv.scope == "session" and scope == "global":
+        raise ValueError(f"Variable '{name}' is a SESSION variable")
     out = sv.normalize(value)
     if not sv.consumed and warnings is not None:
         warnings.append(

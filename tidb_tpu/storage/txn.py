@@ -300,6 +300,8 @@ class Storage:
         self.kv = MemKV()
         self.mvcc = MVCCStore(self.kv)
         self.tso = TSO()
+        # SET GLOBAL overrides: seed new sessions, serve @@global.x reads
+        self.global_vars: dict[str, str] = {}
         self.data_dir = data_dir
         self.start_time = time.time()  # cluster_info uptime
         self.wal = None

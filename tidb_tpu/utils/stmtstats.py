@@ -64,20 +64,18 @@ class StmtStats:
         self, sql: str, dur_s: float, user: str, db: str, ok: bool,
         slow_threshold_s: float, cpu_s: float = 0.0, *,
         summary_on: bool = True, slow_log_on: bool = True,
-        max_sql_len: int = 256, capacity: int | None = None,
-        redact: bool = False,
+        max_sql_len: int = 256, redact: bool = False,
     ) -> None:
         """Record one statement. The keyword gates map the reference's
         knobs: tidb_enable_stmt_summary, tidb_enable_slow_log,
-        tidb_stmt_summary_max_sql_length, tidb_stmt_summary_max_stmt_count,
-        tidb_redact_log (literals → '?' in every stored sample)."""
+        tidb_stmt_summary_max_sql_length, tidb_redact_log (literals →
+        '?' in every stored sample). summary_capacity is store-level,
+        applied by SET GLOBAL tidb_stmt_summary_max_stmt_count."""
         digest = sql_digest(sql)
         if redact:
             sql = normalize_sql(sql)
         now = time.time()
         with self._lock:
-            if capacity is not None:
-                self.summary_capacity = capacity
             if summary_on:
                 st = self.summary.get(digest)
                 if st is None:
