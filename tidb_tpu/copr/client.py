@@ -276,6 +276,13 @@ class CopClient:
         self._bump("tasks")
         if engine == "auto" and batch.n_rows < self.AUTO_MIN_ROWS:
             engine = "host"
+        if (engine == "auto" and dag.agg is None and dag.topn is None
+                and dag.limit is None and dag.selection is None):
+            # bare scan: the lanes already live host-side in the tile
+            # cache — a device round-trip (upload + full-row fetch over a
+            # possibly remote link) computes nothing and costs everything.
+            # 'tpu' stays forced (tests/EXPLAIN rely on that contract).
+            engine = "host"
         if engine in ("tpu", "auto"):
             try:
                 chunk = self.tpu.execute(dag, batch)

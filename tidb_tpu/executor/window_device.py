@@ -30,7 +30,7 @@ from functools import lru_cache, reduce
 import numpy as np
 
 from ..copr.tpu_engine import lex_sort_perm
-from ..jaxenv import jax, jnp
+from ..jaxenv import jax, jnp, pack_flat, unpack_flat
 from ..mysqltypes.mydecimal import DIV_FRAC_INCR, MAX_SCALE, Dec, pow10
 
 # Below this many rows the ~100ms device dispatch dominates; 'auto' stays
@@ -380,7 +380,12 @@ def _build_kernel(spec):
             else:  # pragma: no cover — guarded by SUPPORTED
                 raise AssertionError(name)
             outs.append((scat(sd), scat(sv.astype(jnp.bool_))))
-        return outs
+        # pack every (value, valid) pair into ONE flat int64 vector with
+        # in-band dtype tags and BIT-PACKED valid lanes: each device→host
+        # array read over a remote link costs a full round-trip, and for
+        # full-row window results the bool lanes would otherwise double
+        # the transferred bytes.
+        return pack_flat([o for pair in outs for o in pair])
 
     return jax.jit(kernel)
 
@@ -415,7 +420,37 @@ def _avg_dec_finish(s: np.ndarray, cnt: np.ndarray, arg_scale: int, out_scale: i
     return np.where(s < 0, -q, q).astype(np.int64), valid
 
 
-def run_device_window(part_lanes, order_lanes, fspecs, n: int):
+# Prepared device inputs (packed sort words + padded arg lanes, all
+# device-resident) keyed by (provenance, n, bucket), where provenance =
+# (store uid, table id, data version, window-spec digest) from the
+# caller. A repeated window over an unchanged table skips lane eval,
+# dict-encoding, packing AND the device-link upload. Byte-budgeted LRU.
+_INPUT_CACHE: dict = {}
+_INPUT_CACHE_BYTES = [0]
+INPUT_CACHE_BUDGET = 2 << 30
+
+
+def _input_cache_put(key, value, nbytes: int):
+    while _INPUT_CACHE and _INPUT_CACHE_BYTES[0] + nbytes > INPUT_CACHE_BUDGET:
+        k = next(iter(_INPUT_CACHE))
+        _, old_n = _INPUT_CACHE.pop(k)
+        _INPUT_CACHE_BYTES[0] -= old_n
+    _INPUT_CACHE[key] = (value, nbytes)
+    _INPUT_CACHE_BYTES[0] += nbytes
+
+
+def run_cached_window(provenance, n: int):
+    """Replay a fully-prepared window (device inputs + post metadata) for
+    a stable provenance, or None on miss. Lets the caller skip lane
+    evaluation and dict-encoding entirely on repeat executions."""
+    cached = _INPUT_CACHE.get((provenance, n, _bucket(n)))
+    if cached is None:
+        return None
+    words, fargs, pwords_n, owords_n, fspecs_meta = cached[0]
+    return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n)
+
+
+def run_device_window(part_lanes, order_lanes, fspecs, n: int, provenance=None):
     """Execute a window spec on device; returns [(data, valid), ...] per func
     in input row order (numpy, length n).
 
@@ -423,8 +458,16 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int):
     order_lanes: [((d, v), desc)]
     fspecs: per func dict — {name, static, args: [(d, v), ...], post}
       post: ('decode', vocab) | ('avg_dec', arg_scale, out_scale) | None
+    provenance: stable (table, version, spec-digest) identity from the
+      caller, or None — enables the prepared-device-input cache.
     """
     P = _bucket(n)
+
+    cache_key = (provenance, n, P) if provenance is not None else None
+    cached = _INPUT_CACHE.get(cache_key) if cache_key is not None else None
+    if cached is not None:
+        words, fargs, pwords_n, owords_n, fspecs_meta = cached[0]
+        return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n)
 
     def pad(d, v):
         dd = np.zeros(P, dtype=d.dtype)
@@ -444,12 +487,25 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int):
     pwords = _pack_words(part_items, n, P)
     owords = _pack_words(order_items, n, P)
     words = tuple(jnp.asarray(w) for w in pwords + owords)
+    fargs = tuple(tuple(pad(d, v) for d, v in f["args"]) for f in fspecs)
+    if cache_key is not None:
+        nbytes = sum(w.nbytes for w in words) + sum(
+            d.nbytes + v.nbytes for fa in fargs for d, v in fa
+        )
+        fspecs_meta = [{k: v for k, v in f.items() if k != "args"} for f in fspecs]
+        _input_cache_put(
+            cache_key,
+            (words, fargs, len(pwords), len(owords), fspecs_meta), nbytes,
+        )
+    return _run_prepared(words, fargs, len(pwords), len(owords), fspecs, n)
+
+
+def _run_prepared(words, fargs, n_pwords: int, n_owords: int, fspecs, n: int):
     funcspecs = tuple(f["static"] for f in fspecs)
     framespecs = tuple(f.get("frame") for f in fspecs)
-    fargs = tuple(tuple(pad(d, v) for d, v in f["args"]) for f in fspecs)
-
-    kernel = _build_kernel((len(pwords), len(owords), funcspecs, framespecs))
-    outs = kernel(words, fargs)
+    kernel = _build_kernel((n_pwords, n_owords, funcspecs, framespecs))
+    flat = unpack_flat(np.asarray(kernel(words, fargs)))
+    outs = [(flat[2 * i], flat[2 * i + 1]) for i in range(len(fspecs))]
 
     results = []
     for f, (a, b) in zip(fspecs, outs):

@@ -45,13 +45,6 @@ from ..planner.fragment import BROADCAST, HASH, JoinFrag, MPPPlan, ScanFrag
 
 I64_MAX = np.iinfo(np.int64).max
 DIRECT_GROUP_MAX = 1 << 16
-# Per-level probe expansion cap: each probe row carries `mult` static
-# match slots, so memory scales by the build side's max key multiplicity
-# rounded to a power of two. 64 admits FK fan-outs like TPC-H
-# orders→lineitem (~Poisson(4) lines/order, max ≈ 20-30 at SF scale)
-# while the probe side of such joins stays small; truly high-duplicate
-# builds still hand over to the host hash join.
-MAX_BUILD_DUP = 64
 
 
 class ScanData:
@@ -176,19 +169,19 @@ class MPPEngine:
         key = self._stat_key(sd, tag)
         if key is None:
             return compute()
-        hit = self._stat_cache.get(key)
-        if hit is None:
-            hit = compute()
+        ent = self._stat_cache.get(key)
+        if ent is None:  # entries are 1-tuples so a None RESULT still caches
+            ent = (compute(),)
             # evict stale versions of the same (table, tag)
             for k in [k for k in self._stat_cache
                       if k[0] == key[0] and k[2] == key[2] and k[1] != key[1]]:
                 self._stat_cache_nbytes -= self._entry_nbytes(self._stat_cache.pop(k))
-            self._stat_cache[key] = hit
-            self._stat_cache_nbytes += self._entry_nbytes(hit)
+            self._stat_cache[key] = ent
+            self._stat_cache_nbytes += self._entry_nbytes(ent)
             while self._stat_cache_nbytes > self.STAT_CACHE_BYTES and self._stat_cache:
                 k = next(iter(self._stat_cache))
                 self._stat_cache_nbytes -= self._entry_nbytes(self._stat_cache.pop(k))
-        return hit
+        return ent[0]
 
     def _lane_minmax(self, sd, off):
         """(lo, hi) of a lane's present values, or None when empty/float —
@@ -335,8 +328,20 @@ class MPPEngine:
             # capacity tightly instead of a blanket 2×max(sides). Filters
             # only shrink the true output, so this is a hard upper bound.
             psds = {id(scan_of_joined[pk][0]) for pk in frag.probe_keys}
+
+            def probe_chain_unique(f):
+                # jcard is measured on raw scan lanes: it stays an upper
+                # bound only while every join below the probe has UNIQUE
+                # build keys (each can only filter, never fan out)
+                while isinstance(f, JoinFrag):
+                    lv = next((x for x in levels if x.frag is f), None)
+                    if lv is None or lv.mult != 1:
+                        return False
+                    f = f.probe
+                return True
+
             expected = None
-            if len(psds) == 1 and mult > 1:
+            if len(psds) == 1 and mult > 1 and probe_chain_unique(frag.probe):
                 psd = scan_of_joined[frag.probe_keys[0]][0]
                 poffs = tuple(scan_of_joined[pk][1] for pk in frag.probe_keys)
 
@@ -594,23 +599,15 @@ class MPPEngine:
             shapes.append((total, is_sharded, offs))
 
         key = self._program_key(mplan, meta, scans, shapes, n_dev)
-        entry = self._programs.get(key)
-        if entry is None:
-            entry = self._build_program(mplan, meta, scan_arg_meta, mesh, axis, n_dev, tuple(in_specs))
-            self._programs[key] = entry
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._build_program(mplan, meta, scan_arg_meta, mesh, axis, n_dev, tuple(in_specs))
+            self._programs[key] = prog
             self.compile_count += 1
-        prog, out_meta = entry
+        from ..jaxenv import unpack_rows
+
         packed = np.asarray(prog(*[jnp.asarray(a) for a in args]))
-        # unpack the single int64 result matrix (see with_drops)
-        outs = []
-        for i, kind in enumerate(out_meta):
-            row = packed[i]
-            if kind == "f64":
-                outs.append(row.view(np.float64))
-            elif kind == "bool":
-                outs.append(row != 0)
-            else:
-                outs.append(row)
+        outs = unpack_rows(packed)
         dropped = int(outs[-1][0])
         outs = outs[:-1]
         if dropped:
@@ -1002,36 +999,22 @@ class MPPEngine:
             fkey, fvals, fvalid = seg_reduce(ukey2, vals2, n_dev)
             return finish_topk(fkey, fvals, fvalid)
 
-        out_meta: list = []  # host-side unpack dtypes, filled at trace time
-
         def kernel(*flat):
             drop_acc.clear()
-            out_meta.clear()
 
             def with_drops(outs):
                 """Pack EVERY output + the dropped counter into one int64
-                matrix: each device→host array read over a remote link
-                costs a full round-trip (~100ms measured), so the program
-                must ship exactly ONE result buffer."""
+                matrix (jaxenv.pack_rows, dtype tags in-band): each
+                device→host array read over a remote link costs a full
+                round-trip, so the program ships exactly ONE buffer."""
+                from ..jaxenv import pack_rows
+
                 d = sum(drop_acc) if drop_acc else jnp.zeros((), jnp.int64)
                 d = jax.lax.psum(d, axis)
-                rows_packed = []
-                for o in outs:
-                    if o.dtype == jnp.float32:
-                        o = o.astype(jnp.float64)
-                    if o.dtype == jnp.float64:
-                        out_meta.append("f64")
-                        rows_packed.append(jax.lax.bitcast_convert_type(o, jnp.int64))
-                    elif o.dtype == jnp.bool_:
-                        out_meta.append("bool")
-                        rows_packed.append(o.astype(jnp.int64))
-                    else:
-                        out_meta.append("i64")
-                        rows_packed.append(o.astype(jnp.int64))
-                out_meta.append("i64")  # dropped row
-                L = rows_packed[0].shape[0]
-                rows_packed.append(jnp.broadcast_to(d, (L,)))
-                return jnp.stack(rows_packed)
+                outs = list(outs)
+                L = outs[0].shape[0]
+                outs.append(jnp.broadcast_to(d, (L,)))
+                return pack_rows(outs)
 
             lanemap, mask, rowids = join_stage(mplan.root, flat)
             if agg is None:
@@ -1062,7 +1045,7 @@ class MPPEngine:
             out_specs = P(None, axis)  # per-device slices concat on dim 1
 
         sm = shard_map(kernel, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs)
-        return jax.jit(sm), out_meta
+        return jax.jit(sm)
 
     @staticmethod
     def _agg_partials(a, r_args, lanemap, mask, seg, nseg, eval_dev):

@@ -302,6 +302,11 @@ class Storage:
         self.tso = TSO()
         # SET GLOBAL overrides: seed new sessions, serve @@global.x reads
         self.global_vars: dict[str, str] = {}
+        # distinguishes stores in process-wide caches (table ids restart
+        # per store, so (table_id, version) alone is ambiguous)
+        import uuid as _uuid
+
+        self.store_uid = _uuid.uuid4().hex[:16]
         self.data_dir = data_dir
         self.start_time = time.time()  # cluster_info uptime
         self.wal = None

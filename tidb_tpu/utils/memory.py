@@ -38,8 +38,20 @@ def chunk_bytes(chunk) -> int:
     for col in chunk.columns:
         data = col.data
         if getattr(data, "dtype", None) is not None and data.dtype == object:
-            n += sum(len(x) if isinstance(x, (str, bytes)) else 8 for x in data if x is not None)
-            n += len(data)
+            m = len(data)
+            if m > 4096:
+                # big object lanes: estimate from a stride sample — a full
+                # per-element pass costs more than the query it guards
+                sample = data[:: max(1, m // 4096)]
+                sb = sum(
+                    len(x) if isinstance(x, (str, bytes)) else 8
+                    for x in sample
+                    if x is not None
+                )
+                n += int(sb * (m / max(len(sample), 1))) + m
+            else:
+                n += sum(len(x) if isinstance(x, (str, bytes)) else 8 for x in data if x is not None)
+                n += m
         else:
             n += getattr(data, "nbytes", 0)
         n += getattr(col.valid, "nbytes", 0)
