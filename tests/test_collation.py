@@ -145,3 +145,53 @@ class TestUnicodeCi:
         s.execute("INSERT INTO de VALUES ('Straße'), ('STRASSE'), ('strasse')")
         rows = both(s, "SELECT COUNT(*) FROM de GROUP BY x")
         assert [r[0] for r in rows] == ["3"]  # ß == ss at primary strength
+
+
+class TestExactUnicodeCI:
+    """utf8mb4_unicode_ci now carries the exact UCA 4.0.0 primary weight
+    table (round 5) — MySQL 8 oracle comparisons for the tricky cases."""
+
+    @pytest.fixture()
+    def s(self):
+        sess = Session()
+        sess.execute(
+            "CREATE TABLE uci (a VARCHAR(32) COLLATE utf8mb4_unicode_ci)"
+        )
+        return sess
+
+    def q(self, s, sql):
+        return s.must_query(sql)
+
+    def test_expansions(self, s):
+        # MySQL/UCA 4.0.0: 'ß'='ss'; 'Æ' is its OWN letter (primary
+        # 0xE38) equal to 'æ' but NOT 'AE', sorting between a and b
+        s.execute("INSERT INTO uci VALUES ('ss'), ('æ'), ('AE')")
+        assert self.q(s, "SELECT COUNT(*) FROM uci WHERE a = 'ß'") == [("1",)]
+        assert self.q(s, "SELECT COUNT(*) FROM uci WHERE a = 'Æ'") == [("1",)]
+        s.execute("INSERT INTO uci VALUES ('a'), ('b')")
+        rows = [r[0] for r in self.q(s, "SELECT a FROM uci WHERE a IN ('a','b','æ') ORDER BY a")]
+        assert rows == ["a", "æ", "b"]
+
+    def test_case_accent_insensitive(self, s):
+        s.execute("INSERT INTO uci VALUES ('resume')")
+        assert self.q(s, "SELECT COUNT(*) FROM uci WHERE a = 'RÉSUMÉ'") == [("1",)]
+
+    def test_hangul_order(self, s):
+        # MySQL: '가' < '나' < '다' (and all sort after Latin)
+        s.execute("INSERT INTO uci VALUES ('다'), ('가'), ('나'), ('z')")
+        rows = [r[0] for r in self.q(s, "SELECT a FROM uci ORDER BY a")]
+        assert rows == ["z", "가", "나", "다"]
+
+    def test_supplementary_planes_tie(self, s):
+        # MySQL: every supplementary-plane char weighs 0xFFFD → all equal
+        s.execute("INSERT INTO uci VALUES ('😀')")
+        assert self.q(s, "SELECT COUNT(*) FROM uci WHERE a = '𝄞'") == [("1",)]
+
+    def test_pad_space(self, s):
+        s.execute("INSERT INTO uci VALUES ('pad')")
+        assert self.q(s, "SELECT COUNT(*) FROM uci WHERE a = 'pad   '") == [("1",)]
+
+    def test_group_by_merges_expansions(self, s):
+        s.execute("INSERT INTO uci VALUES ('ss'), ('ß'), ('SS')")
+        rows = self.q(s, "SELECT COUNT(*) FROM uci GROUP BY a")
+        assert rows == [("3",)]

@@ -131,3 +131,42 @@ class TestBindingScopes:
         # the base name must NOT bind when the table is aliased
         txt = _plan_text(s, "SELECT /*+ IGNORE_INDEX(t, ig) */ * FROM t x WHERE g = 3")
         assert "ig" in txt  # hint didn't attach → index still chosen
+
+
+def test_inl_hash_and_merge_join_variants():
+    """INL_HASH_JOIN / INL_MERGE_JOIN pick the index-lookup probe variant
+    (ref: executor/index_lookup_hash_join.go, index_lookup_merge_join.go)."""
+    s = Session()
+    s.execute("CREATE TABLE big (id BIGINT PRIMARY KEY, k BIGINT, v BIGINT, KEY ik (k))")
+    s.execute("CREATE TABLE small (k BIGINT, tag BIGINT)")
+    s.execute("INSERT INTO big VALUES " + ",".join(f"({i}, {i % 50}, {i})" for i in range(500)))
+    s.execute("INSERT INTO small VALUES (3, 30), (7, 70), (7, 71), (99, 990)")
+    base = "SELECT small.tag, big.id FROM small JOIN big ON small.k = big.k"
+    plain = sorted(s.must_query(base))
+    hashed = sorted(s.must_query("SELECT /*+ INL_HASH_JOIN(big) */ small.tag, big.id"
+                                 " FROM small JOIN big ON small.k = big.k"))
+    merged_rows = s.must_query("SELECT /*+ INL_MERGE_JOIN(big) */ small.tag, big.id"
+                               " FROM small JOIN big ON small.k = big.k")
+    assert plain == hashed == sorted(merged_rows)
+    assert len(plain) == 30  # 3→10 rows, 7→10 rows ×2 outer, 99→0
+    # the hint must actually pick the variant class, not just run A join
+    from tidb_tpu.executor.executors import (
+        ExecContext, IndexLookupJoinExec, IndexLookupMergeJoinExec, build_executor,
+    )
+    from tidb_tpu.parser.parser import parse_one
+
+    plan = s.plan_select(parse_one(base))
+    for variant, cls in (("merge", IndexLookupMergeJoinExec), ("hash", IndexLookupJoinExec)):
+        ctx = ExecContext(
+            s.cop, s.read_ts(), engine="host",
+            vars=dict(s.vars, tidb_opt_prefer_index_join="ON",
+                      tidb_opt_index_join_variant=variant),
+            txn=None,
+        )
+        ex = build_executor(plan, ctx)
+        found = ex
+        for _ in range(6):
+            if isinstance(found, IndexLookupJoinExec):
+                break
+            found = getattr(found, "child", None)
+        assert type(found) is cls, (variant, type(found))

@@ -78,28 +78,45 @@ class PartitionDef:
     id: int
     name: str
     less_than: int | None = None  # RANGE bound; None = MAXVALUE / hash
+    in_values: tuple | None = None  # LIST membership (may contain None=NULL)
 
     def to_json(self):
-        return {"id": self.id, "name": self.name, "less_than": self.less_than}
+        return {"id": self.id, "name": self.name, "less_than": self.less_than,
+                "in_values": list(self.in_values) if self.in_values is not None else None}
 
     @staticmethod
     def from_json(d):
-        return PartitionDef(d["id"], d["name"], d.get("less_than"))
+        iv = d.get("in_values")
+        return PartitionDef(d["id"], d["name"], d.get("less_than"),
+                            tuple(iv) if iv is not None else None)
 
 
 @dataclass
 class PartitionInfo:
-    """HASH / RANGE partitioning over one integer column (ref: model
-    PartitionInfo + table/tables/partition.go locatePartition)."""
+    """HASH / RANGE / LIST partitioning over one integer column (ref:
+    model PartitionInfo + table/tables/partition.go locatePartition /
+    locateListPartition)."""
 
-    type: str  # 'hash' | 'range'
+    type: str  # 'hash' | 'range' | 'list'
     col: str  # partitioning column name
     defs: list[PartitionDef] = field(default_factory=list)
 
     def locate(self, v) -> PartitionDef:
-        """Partition for one (non-null) partition-column value. NULLs go
-        to partition 0 for hash, the first range partition for range
-        (MySQL: NULL sorts below every bound)."""
+        """Partition for one partition-column value. NULLs go to
+        partition 0 for hash, the first range partition for range
+        (MySQL: NULL sorts below every bound); LIST requires a partition
+        that lists NULL explicitly."""
+        from ..errors import TiDBError
+
+        if self.type == "list":
+            key = None if v is None else int(v)
+            for pd in self.defs:
+                if pd.in_values is not None and key in pd.in_values:
+                    return pd
+            raise TiDBError(
+                "Table has no partition for value "
+                + ("NULL" if v is None else str(int(v)))
+            )
         if v is None:
             return self.defs[0]
         v = int(v)
@@ -111,8 +128,6 @@ class PartitionInfo:
         for pd in self.defs:
             if pd.less_than is None or v < pd.less_than:
                 return pd
-        from ..errors import TiDBError
-
         raise TiDBError(f"Table has no partition for value {v}")
 
     def prune(self, eq_values=None, lo=None, hi=None) -> list[PartitionDef]:
@@ -130,6 +145,18 @@ class PartitionInfo:
                     seen.add(pd.id)
                     out.append(pd)
             return out
+        if self.type == "list" and (lo is not None or hi is not None):
+            # a LIST partition can match iff some listed value intersects
+            # the interval (rule_partition_processor.go list pruning)
+            return [
+                pd for pd in self.defs
+                if pd.in_values and any(
+                    x is not None
+                    and (lo is None or x >= lo)
+                    and (hi is None or x <= hi)
+                    for x in pd.in_values
+                )
+            ]
         if self.type == "range" and (lo is not None or hi is not None):
             out = []
             prev_bound = None

@@ -262,7 +262,9 @@ def _try_index_join(plan: Join, ctx: ExecContext, out_fts) -> "IndexLookupJoinEx
     dag = DAGRequest(scan)
     if right.pushed_conds:
         dag.selection = SelectionNode(right.pushed_conds)
-    return IndexLookupJoinExec(
+    variant = ctx.vars.get("tidb_opt_index_join_variant", "hash")
+    cls = IndexLookupMergeJoinExec if variant == "merge" else IndexLookupJoinExec
+    return cls(
         build_executor(plan.children[0], ctx), ctx, right.table, index, dag,
         plan.kind, plan.eq_conds, plan.other_conds, out_fts,
     )
@@ -790,7 +792,13 @@ class WindowExec(Executor):
                     prov = (getattr(storage, "store_uid", ""), tbl.id, ver,
                             _hl.sha256(spec.encode()).hexdigest()[:16])
         if prov is not None:
-            results = run_cached_window(prov, n)
+            try:
+                results = run_cached_window(prov, n)
+            except Exception as e:  # noqa: BLE001 — same contract as below
+                if eng == "tpu":
+                    raise
+                self.fallback_reason = f"device window failed: {type(e).__name__}: {e}"
+                return None
             if results is not None:
                 self.last_engine = "tpu"
                 cols = list(c.columns)
@@ -2666,7 +2674,31 @@ class IndexLookupJoinExec(Executor):
                 )
             )
         rchunk = Chunk.concat_all(chunks) if chunks else Chunk.empty(self.dag.output_types(), 0)
+        return self._probe(lchunk, rchunk)
+
+    def _probe(self, lchunk: Chunk, rchunk: Chunk) -> Chunk:
+        """Final join over the fetched inner rows — hash probe here (this
+        class IS the index_lookup_hash_join.go equivalent: the fetched
+        inner rows become the hash build side)."""
         inner = HashJoinExec(
+            ChunkSourceExec(lchunk, [c.ft for c in lchunk.columns]),
+            ChunkSourceExec(rchunk, self.dag.output_types()),
+            self.kind,
+            self.eq_conds,
+            self.other_conds,
+            self.out_fts,
+        )
+        return drain(inner)
+
+
+class IndexLookupMergeJoinExec(IndexLookupJoinExec):
+    """Merge variant (ref: executor/index_lookup_merge_join.go): the
+    fetched inner rows — already in index-key order — merge against the
+    outer side sorted on the join key, producing join-key-ordered output
+    without a hash table. Chosen by the INL_MERGE_JOIN hint."""
+
+    def _probe(self, lchunk: Chunk, rchunk: Chunk) -> Chunk:
+        inner = MergeJoinExec(
             ChunkSourceExec(lchunk, [c.ft for c in lchunk.columns]),
             ChunkSourceExec(rchunk, self.dag.output_types()),
             self.kind,

@@ -424,7 +424,9 @@ def _avg_dec_finish(s: np.ndarray, cnt: np.ndarray, arg_scale: int, out_scale: i
 # device-resident) keyed by (provenance, n, bucket), where provenance =
 # (store uid, table id, data version, window-spec digest) from the
 # caller. A repeated window over an unchanged table skips lane eval,
-# dict-encoding, packing AND the device-link upload. Byte-budgeted LRU.
+# dict-encoding, packing AND the device-link upload. Byte-budgeted LRU
+# (hits re-insert; eviction pops the least recently used). Entries pin
+# device (HBM) buffers — the budget bounds that too.
 _INPUT_CACHE: dict = {}
 _INPUT_CACHE_BYTES = [0]
 INPUT_CACHE_BUDGET = 2 << 30
@@ -443,9 +445,11 @@ def run_cached_window(provenance, n: int):
     """Replay a fully-prepared window (device inputs + post metadata) for
     a stable provenance, or None on miss. Lets the caller skip lane
     evaluation and dict-encoding entirely on repeat executions."""
-    cached = _INPUT_CACHE.get((provenance, n, _bucket(n)))
+    key = (provenance, n, _bucket(n))
+    cached = _INPUT_CACHE.get(key)
     if cached is None:
         return None
+    _INPUT_CACHE[key] = _INPUT_CACHE.pop(key)  # LRU: hits refresh recency
     words, fargs, pwords_n, owords_n, fspecs_meta = cached[0]
     return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n)
 
@@ -466,6 +470,7 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int, provenance=None):
     cache_key = (provenance, n, P) if provenance is not None else None
     cached = _INPUT_CACHE.get(cache_key) if cache_key is not None else None
     if cached is not None:
+        _INPUT_CACHE[cache_key] = _INPUT_CACHE.pop(cache_key)  # LRU touch
         words, fargs, pwords_n, owords_n, fspecs_meta = cached[0]
         return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n)
 

@@ -134,9 +134,8 @@ def pack_flat(outs):
             seg = o.astype(jnp.int64)
         header += [kind, int(seg.shape[0])]
         segs.append(seg)
-    import numpy as _np2
 
-    return jnp.concatenate([jnp.asarray(_np2.asarray(header, dtype=_np2.int64))] + segs)
+    return jnp.concatenate([jnp.asarray(_np.asarray(header, dtype=_np.int64))] + segs)
 
 
 def unpack_flat(flat):

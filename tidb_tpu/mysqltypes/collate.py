@@ -10,15 +10,23 @@ dict-encoder's sorted-vocab order) runs on weights when the column's
 collation is case-insensitive, and on the raw bytes for binary
 collations.
 
-Approximations vs MySQL's exact tables (documented, fixture-tested):
+Weight sources:
+ - *_unicode_ci: EXACT UCA 4.0.0 primary weights (uca400_weights.npz,
+   derived from the public allkeys-4.0.0.txt — the table MySQL's
+   utf8mb4_unicode_ci implements; ref: util/collate/unicode_ci.go
+   semantics: ignorables drop, supplementary planes weigh 0xFFFD, PAD
+   SPACE truncates trailing spaces).
  - *_general_ci: per-character NFD base letter, uppercased (accent- and
-   case-insensitive for Latin; code-point order elsewhere). ß folds to S.
- - *_unicode_ci / *_0900_ai_ci: NFKD + casefold + combining-mark strip —
-   UCA primary-strength behavior (ß = ss, ligatures expand).
+   case-insensitive for Latin; code-point order elsewhere). ß folds to S
+   (matches MySQL general_ci's ß=s single-character behavior).
+ - *_0900_ai_ci / *_unicode_520_ci: NFKD + casefold + combining-mark
+   strip — UCA primary-strength approximation (those need UCA 9.0/5.2
+   tables; documented gap).
 """
 
 from __future__ import annotations
 
+import os
 import unicodedata
 from functools import lru_cache
 
@@ -54,10 +62,36 @@ def _general_ci_char(ch: str) -> str:
     return u[0] if u else ch
 
 
+_UCA400_EXACT = {"utf8mb4_unicode_ci", "utf8_unicode_ci"}
+_uca400 = None
+
+
+def _uca400_tables():
+    global _uca400
+    if _uca400 is None:
+        path = os.path.join(os.path.dirname(__file__), "uca400_weights.npz")
+        z = np.load(path)
+        _uca400 = (z["offsets"], z["weights"])
+    return _uca400
+
+
+@lru_cache(maxsize=65536)
+def _uca400_char(ch: str) -> str:
+    cp = ord(ch)
+    if cp > 0xFFFF:
+        return "�"  # supplementary planes: single implicit weight
+    offsets, weights = _uca400_tables()
+    run = weights[offsets[cp]:offsets[cp + 1]]
+    return "".join(chr(int(w)) for w in run)
+
+
 def weight(s: str, coll: str) -> str:
     """Weight string for one value under `coll` (identity for binary)."""
     if coll in _GENERAL_CI:
         return "".join(_general_ci_char(ch) for ch in s)
+    if coll in _UCA400_EXACT:
+        # PAD SPACE: trailing spaces never distinguish values
+        return "".join(_uca400_char(ch) for ch in s.rstrip(" "))
     if coll in _UNICODE_CI:
         d = unicodedata.normalize("NFKD", s.casefold())
         return "".join(c for c in d if not unicodedata.combining(c))

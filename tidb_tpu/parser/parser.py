@@ -1218,9 +1218,24 @@ class Parser:
             node.partition = self._partition_spec()
         return node
 
+    def _list_in_values(self) -> tuple:
+        """VALUES IN (n | NULL, ...) value tuple for LIST partitions."""
+        self.expect_op("(")
+        vals = []
+        while True:
+            if self.try_kw("NULL"):
+                vals.append(None)
+            else:
+                vals.append(self._int_bound())
+            if not self.try_op(","):
+                break
+        self.expect_op(")")
+        return tuple(vals)
+
     def _partition_spec(self):
         """PARTITION BY HASH(col) PARTITIONS n
-        | PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n|MAXVALUE), ...)"""
+        | PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (n|MAXVALUE), ...)
+        | PARTITION BY LIST (col) (PARTITION p VALUES IN (n, ...), ...)"""
         self.expect_kw("PARTITION")
         self.expect_kw("BY")
         if self.try_kw("HASH"):
@@ -1230,6 +1245,22 @@ class Parser:
             self.expect_kw("PARTITIONS")
             n = int(self.next().text)
             return ast.PartitionSpec("hash", col, count=n)
+        if self.try_kw("LIST"):
+            self.expect_op("(")
+            col = self.ident()
+            self.expect_op(")")
+            self.expect_op("(")
+            defs = []
+            while True:
+                self.expect_kw("PARTITION")
+                name = self.ident()
+                self.expect_kw("VALUES")
+                self.expect_kw("IN")
+                defs.append((name, self._list_in_values()))
+                if not self.try_op(","):
+                    break
+            self.expect_op(")")
+            return ast.PartitionSpec("list", col, defs=defs)
         self.expect_kw("RANGE")
         self.expect_op("(")
         col = self.ident()
@@ -1376,14 +1407,17 @@ class Parser:
                         self.expect_kw("PARTITION")
                         pname = self.ident()
                         self.expect_kw("VALUES")
-                        self.expect_kw("LESS")
-                        self.expect_kw("THAN")
-                        if self.try_kw("MAXVALUE"):
-                            defs.append((pname, None))
+                        if self.try_kw("IN"):  # LIST partition
+                            defs.append((pname, ("in", self._list_in_values())))
                         else:
-                            self.expect_op("(")
-                            defs.append((pname, self._int_bound()))
-                            self.expect_op(")")
+                            self.expect_kw("LESS")
+                            self.expect_kw("THAN")
+                            if self.try_kw("MAXVALUE"):
+                                defs.append((pname, None))
+                            else:
+                                self.expect_op("(")
+                                defs.append((pname, self._int_bound()))
+                                self.expect_op(")")
                         if not self.try_op(","):
                             break
                     self.expect_op(")")

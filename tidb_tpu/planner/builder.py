@@ -467,6 +467,8 @@ class PlanBuilder:
             return self._resolve_name(node, scope)
         if isinstance(node, ast.Call):
             lname = node.name.lower()
+            if lname in ("charset", "collation", "coercibility") and len(node.args) == 1:
+                return self._type_meta_func(lname, self.to_expr(node.args[0], scope, agg_ctx))
             info_c = self._info_func(lname, node)
             if info_c is not None:
                 return info_c
@@ -546,6 +548,31 @@ class PlanBuilder:
             raise TiDBError(f"{lname} takes exactly one argument")
         self.used_eager_subquery = True  # stateful: keep out of the plan cache
         return _SeqExpr(op, db, name, self.seq_hook, arg)
+
+    def _type_meta_func(self, lname: str, arg: Expression) -> Constant:
+        """CHARSET()/COLLATION()/COERCIBILITY() — metadata of the argument
+        EXPRESSION, folded at plan time where the expression (not just its
+        value) is visible (ref: expression/builtin_info.go)."""
+        ft = arg.ret_type
+        is_null = isinstance(arg, Constant) and arg.value.is_null
+        is_str = ft.is_string() and not is_null
+        if lname == "charset":
+            v = (getattr(ft, "charset", None) or "utf8mb4") if is_str else "binary"
+            return Constant(Datum.s(v), ft_varchar(32))
+        if lname == "collation":
+            v = (getattr(ft, "collate", None) or "utf8mb4_bin") if is_str else "binary"
+            return Constant(Datum.s(v), ft_varchar(32))
+        # coercibility (MySQL levels: 2=IMPLICIT column, 4=COERCIBLE
+        # literal, 5=NUMERIC, 6=IGNORABLE NULL)
+        if is_null:
+            c = 6
+        elif not ft.is_string():
+            c = 5
+        elif isinstance(arg, Constant):
+            c = 4
+        else:
+            c = 2
+        return Constant(Datum.i(c), ft_longlong())
 
     def _info_func(self, lname: str, node) -> Constant | None:
         """Session/time information functions evaluated at plan time
@@ -1126,6 +1153,8 @@ class PlanBuilder:
                 return hit
         if isinstance(node, ast.Call):
             lname = node.name.lower()
+            if lname in ("charset", "collation", "coercibility") and len(node.args) == 1:
+                return self._type_meta_func(lname, self.to_expr(node.args[0], scope, agg_ctx))
             info_c = self._info_func(lname, node)
             if info_c is not None:
                 return info_c

@@ -1455,6 +1455,12 @@ class Session:
                 exec_vars = dict(exec_vars, tidb_opt_prefer_merge_join="ON")
             elif h in ("INL_JOIN", "INDEX_JOIN"):
                 exec_vars = dict(exec_vars, tidb_opt_prefer_index_join="ON")
+            elif h == "INL_HASH_JOIN":
+                exec_vars = dict(exec_vars, tidb_opt_prefer_index_join="ON",
+                                 tidb_opt_index_join_variant="hash")
+            elif h == "INL_MERGE_JOIN":
+                exec_vars = dict(exec_vars, tidb_opt_prefer_index_join="ON",
+                                 tidb_opt_index_join_variant="merge")
             elif h == "HASH_JOIN":
                 exec_vars = dict(
                     exec_vars, tidb_opt_prefer_merge_join="OFF", tidb_opt_prefer_index_join="OFF"
@@ -2784,6 +2790,25 @@ class Session:
             if spec.count < 1:
                 raise TiDBError("at least one partition required")
             defs = [PartitionDef(m.alloc_id(), f"p{i}") for i in range(spec.count)]
+        elif spec.type == "list":
+            # gated like the reference (ddl/ddl_api.go checks
+            # tidb_enable_list_partition before building the info)
+            if self.vars.get("tidb_enable_list_partition", "OFF") != "ON":
+                raise TiDBError(
+                    "LIST partitioning requires tidb_enable_list_partition = ON"
+                )
+            if not spec.defs:
+                raise TiDBError("at least one partition required")
+            seen_vals: set = set()
+            defs = []
+            for name, vals in spec.defs:
+                for v in vals:
+                    if v in seen_vals:
+                        raise TiDBError(
+                            f"Multiple definition of same constant in list partitioning: {v}"
+                        )
+                    seen_vals.add(v)
+                defs.append(PartitionDef(m.alloc_id(), name, in_values=tuple(vals)))
         else:
             if not spec.defs:
                 raise TiDBError("at least one partition required")
@@ -2936,25 +2961,53 @@ class Session:
         return ResultSet([], None)
 
     def _alter_add_partition(self, tn: ast.TableName, defs: list) -> None:
-        """ALTER TABLE ... ADD PARTITION for RANGE tables (ref:
-        ddl/partition.go onAddTablePartition): new bounds must ascend
-        strictly above the current maximum."""
+        """ALTER TABLE ... ADD PARTITION for RANGE/LIST tables (ref:
+        ddl/partition.go onAddTablePartition): range bounds must ascend
+        strictly above the current maximum; list values must be disjoint
+        from every existing partition's value set."""
         from ..catalog.schema import PartitionDef
 
         db = tn.db or self.current_db
         info = self.infoschema().table(db, tn.name)
-        if info.partition is None or info.partition.type != "range":
-            raise TiDBError("ADD PARTITION requires a RANGE-partitioned table")
+        if info.partition is None or info.partition.type not in ("range", "list"):
+            raise TiDBError("ADD PARTITION requires a RANGE or LIST partitioned table")
         txn = self._ddl_txn()
         m = Meta(txn)
         t = m.table(info.id)
         cur = t.partition.defs
+        if info.partition.type == "list":
+            names = {d.name.lower() for d in cur}
+            existing = {v for d in cur for v in (d.in_values or ())}
+            for name, payload in defs:
+                if not (isinstance(payload, tuple) and payload and payload[0] == "in"):
+                    txn.rollback()
+                    raise TiDBError("LIST partition requires VALUES IN (...)")
+                if name.lower() in names:
+                    txn.rollback()
+                    raise TiDBError(f"Duplicate partition name {name}")
+                vals = payload[1]
+                dup = existing.intersection(vals)
+                if dup:
+                    txn.rollback()
+                    raise TiDBError(
+                        f"Multiple definition of same constant in list partitioning: {next(iter(dup))}"
+                    )
+                t.partition.defs.append(PartitionDef(m.alloc_id(), name, in_values=tuple(vals)))
+                names.add(name.lower())
+                existing.update(vals)
+            m.put_table(t)
+            m.bump_schema_version()
+            txn.commit()
+            return
         if cur and cur[-1].less_than is None:
             txn.rollback()
             raise TiDBError("MAXVALUE can only be used in last partition definition")
         prev = cur[-1].less_than if cur else None
         names = {d.name.lower() for d in cur}
         for name, bound in defs:
+            if isinstance(bound, tuple):
+                txn.rollback()
+                raise TiDBError("VALUES IN is only valid for LIST partitioned tables")
             if name.lower() in names:
                 txn.rollback()
                 raise TiDBError(f"Duplicate partition name {name}")
@@ -2979,8 +3032,8 @@ class Session:
         info = self.infoschema().table(db, tn.name)
         if info.partition is None:
             raise TiDBError(f"table {tn.name!r} is not partitioned")
-        if not truncate and info.partition.type != "range":
-            raise TiDBError("DROP PARTITION can only be used on RANGE partitions")
+        if not truncate and info.partition.type not in ("range", "list"):
+            raise TiDBError("DROP PARTITION can only be used on RANGE/LIST partitions")
         txn = self._ddl_txn()
         m = Meta(txn)
         t = m.table(info.id)

@@ -99,6 +99,7 @@ _sv("auto_increment_increment", "1", kind="int", lo=1, hi=65535, consumed=True)
 _sv("auto_increment_offset", "1", kind="int", lo=1, hi=65535, consumed=True)
 _sv("timestamp", "", consumed=True)  # SET timestamp=N freezes NOW()
 _sv("tidb_enable_index_merge", "ON", kind="bool", consumed=True)
+_sv("tidb_enable_list_partition", "OFF", kind="bool", consumed=True)
 # agg-below-join pushdown rule doesn't exist here (cop partial/final split
 # is unconditional, like the reference's cop pushdown) — stays inert
 _sv("tidb_opt_agg_push_down", "OFF", kind="bool")
@@ -155,7 +156,6 @@ for _name, _d, _k in (
     ("tidb_enable_rate_limit_action", "ON", "bool"),
     ("tidb_enable_strict_double_type_check", "ON", "bool"),
     ("tidb_enable_table_partition", "ON", "bool"),
-    ("tidb_enable_list_partition", "OFF", "bool"),
     ("tidb_scatter_region", "OFF", "bool"),
     ("tidb_enable_collect_execution_info", "ON", "bool"),
     ("tidb_enable_telemetry", "ON", "bool"),
