@@ -297,3 +297,30 @@ class TestHashJoinSpill:
         assert len(rows) == 3
         after = set(glob.glob(tempfile.gettempdir() + "/tidbtpu-spill-*"))
         assert after <= before, f"leaked spill files: {after - before}"
+
+
+def test_topn_pushes_below_projection():
+    """Limit(Sort(Projection(Scan))) must still push the per-task TopN to
+    the reader with sort keys rewritten into scan space (round 5; ref:
+    rule_topn_push_down.go) — without it the device ships ALL rows back."""
+    from tidb_tpu.executor.executors import ExecContext, TableReaderExec, build_executor
+    from tidb_tpu.parser.parser import parse_one
+    from tidb_tpu.session import Session
+
+    s = Session()
+    s.execute("CREATE TABLE tp (a BIGINT, b BIGINT, c BIGINT)")
+    s.execute("INSERT INTO tp VALUES " + ",".join(f"({i},{(i*37)%100},{i%7})" for i in range(500)))
+    plan = s.plan_select(parse_one("SELECT a, b FROM tp ORDER BY b DESC, a LIMIT 5"))
+    ctx = ExecContext(s.cop, s.read_ts(), engine="host", vars=s.vars, txn=None)
+    ex = build_executor(plan, ctx)
+    r = ex
+    for _ in range(8):
+        if isinstance(r, TableReaderExec) or r is None:
+            break
+        r = getattr(r, "child", None)
+    assert isinstance(r, TableReaderExec) and r.dag.topn is not None, "TopN not pushed"
+    assert r.dag.topn.n == 5
+    # and results stay exact vs a full sort
+    got = s.must_query("SELECT a, b FROM tp ORDER BY b DESC, a LIMIT 5")
+    allrows = s.must_query("SELECT a, b FROM tp ORDER BY b DESC, a")
+    assert got == allrows[:5]

@@ -953,14 +953,14 @@ class TPUEngine:
                 # top_k takes largest → negate for asc; NULLs first asc
                 sortkey = jnp.where(m, jnp.where(v, -d, hi), lo)
             _, idx = jax.lax.top_k(sortkey, min(n, sortkey.shape[0]))
-            return idx, m
+            # ship only k validity bits, not the full row mask
+            return idx, m[idx]
 
         fn = self._program(key, kernel)
 
         def run():
-            idx, m = jax.device_get(fn(arrs, dev.row_valid))
-            m = m.reshape(-1)
-            idx = idx[m[idx]]  # drop indices pointing at masked rows
+            idx, ok = jax.device_get(fn(arrs, dev.row_valid))
+            idx = idx[ok]  # drop indices pointing at masked rows
             chunk = dev.batch.to_chunk(dag.scan.col_offsets)
             return chunk.take(idx[: dag.topn.n])
 

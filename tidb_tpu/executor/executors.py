@@ -358,8 +358,34 @@ def _build_limit(plan: Limit, ctx: ExecContext) -> Executor:
         spec = _mpp_topn_spec(child, child.children[0])
         sort_child = build_executor(child.children[0], ctx)
         reader = _pushable_reader(sort_child)
-        if reader is not None and all(e.pushable() for e, _ in child.by):
-            reader.dag.topn = TopNNode(child.by, n)  # per-task topn
+        push_by = child.by
+        if reader is None:
+            # TopN pushes below row-wise column projections once its sort
+            # keys are rewritten into scan space (ref: planner/core
+            # rule_topn_push_down.go pushing TopN through Projection)
+            node, mapped = child.children[0], child.by
+            ok = True
+            while ok and isinstance(node, Projection):
+                nb = []
+                for e, desc in mapped:
+                    if isinstance(e, ECol):
+                        nb.append((node.exprs[e.idx], desc))
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    mapped, node = nb, node.children[0]
+            if ok and isinstance(node, DataSource):
+                r = sort_child
+                for _ in range(6):
+                    if isinstance(r, TableReaderExec) or r is None:
+                        break
+                    r = getattr(r, "child", None)
+                if (isinstance(r, TableReaderExec) and r.dag.agg is None
+                        and r.dag.topn is None and r.dag.limit is None):
+                    reader, push_by = r, mapped
+        if reader is not None and all(e.pushable() for e, _ in push_by):
+            reader.dag.topn = TopNNode(push_by, n)  # per-task topn
         if spec is not None:
             gather = _find_mpp_gather(sort_child)
             if gather is not None and gather.mplan.agg is spec[2]:
@@ -2692,10 +2718,12 @@ class IndexLookupJoinExec(Executor):
 
 
 class IndexLookupMergeJoinExec(IndexLookupJoinExec):
-    """Merge variant (ref: executor/index_lookup_merge_join.go): the
-    fetched inner rows — already in index-key order — merge against the
-    outer side sorted on the join key, producing join-key-ordered output
-    without a hash table. Chosen by the INL_MERGE_JOIN hint."""
+    """Merge variant (ref: executor/index_lookup_merge_join.go): probes
+    the fetched inner rows with a sort-merge join instead of a hash
+    table, producing join-key-ordered output. MergeJoinExec re-sorts both
+    sides (it does not yet exploit that the index fetch already returns
+    key order); the variant's value here is the ordered output and the
+    hash-table-free memory profile. Chosen by the INL_MERGE_JOIN hint."""
 
     def _probe(self, lchunk: Chunk, rchunk: Chunk) -> Chunk:
         inner = MergeJoinExec(

@@ -1154,7 +1154,7 @@ class PlanBuilder:
         if isinstance(node, ast.Call):
             lname = node.name.lower()
             if lname in ("charset", "collation", "coercibility") and len(node.args) == 1:
-                return self._type_meta_func(lname, self.to_expr(node.args[0], scope, agg_ctx))
+                return self._type_meta_func(lname, self.to_expr(node.args[0], scope_w.base, agg_ctx))
             info_c = self._info_func(lname, node)
             if info_c is not None:
                 return info_c
