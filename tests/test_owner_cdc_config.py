@@ -1,0 +1,142 @@
+"""Round-5 coordination/observability verticals: DDL owner election over
+the meta keyspace (ref: owner/manager.go), the commit-time change feed
+(ref: br/pkg/cdclog + binlog hooks), the pprof-as-SQL CPU profile
+memtable (ref: util/profile), and the TOML config layer (ref:
+config/config.go)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+
+
+class TestOwnerElection:
+    def test_single_winner(self):
+        from tidb_tpu.ddl.owner import OwnerManager
+
+        s = Session()
+        a = OwnerManager(s.store, lease_s=30)
+        b = OwnerManager(s.store, lease_s=30)
+        assert a.campaign()
+        assert not b.campaign()  # live rival holds the seat
+        assert a.is_owner() and not b.is_owner()
+        assert b.get_owner_id() == a.id
+
+    def test_resign_hands_over(self):
+        from tidb_tpu.ddl.owner import OwnerManager
+
+        s = Session()
+        a = OwnerManager(s.store)
+        b = OwnerManager(s.store)
+        assert a.campaign()
+        a.resign()
+        assert b.campaign()
+        assert b.is_owner() and not a.is_owner()
+
+    def test_lease_expiry(self):
+        from tidb_tpu.ddl.owner import OwnerManager
+
+        s = Session()
+        a = OwnerManager(s.store, lease_s=0.05)
+        b = OwnerManager(s.store, lease_s=30)
+        assert a.campaign()
+        time.sleep(0.08)
+        assert a.get_owner_id() is None  # lease lapsed
+        assert b.campaign()
+        assert not a.renew()  # demoted: seat belongs to b now
+
+    def test_ddl_runs_through_owner(self):
+        s = Session()
+        s.execute("CREATE TABLE ot (a INT)")
+        s.execute("CREATE INDEX ia ON ot (a)")  # add-index runs the worker
+        assert s.store.ddl.owner.is_owner()
+
+
+class TestChangeFeed:
+    def test_events_in_commit_order(self):
+        s = Session()
+        got: list = []
+        s.store.cdc.subscribe(got.append)
+        try:
+            s.execute("CREATE TABLE cf (id BIGINT PRIMARY KEY, v BIGINT)")
+            s.execute("INSERT INTO cf VALUES (1, 10), (2, 20)")
+            s.execute("UPDATE cf SET v = 11 WHERE id = 1")
+            s.execute("DELETE FROM cf WHERE id = 2")
+        finally:
+            s.store.cdc.unsubscribe(got.append)
+        # batches arrive per txn in commit_ts order
+        ts = [b[0].commit_ts for b in got if b]
+        assert ts == sorted(ts)
+        rows = [e for b in got for e in b if e.table_id is not None]
+        ins = [e for e in rows if e.op == "put"]
+        dels = [e for e in rows if e.op == "delete"]
+        assert {e.handle for e in ins} >= {1, 2}
+        assert any(e.handle == 2 for e in dels)
+        assert all(e.value is not None for e in ins)
+        assert all(e.value is None for e in dels)
+
+    def test_file_sink(self, tmp_path):
+        from tidb_tpu.cdc import FileSink
+
+        s = Session()
+        path = str(tmp_path / "cdc.log")
+        sink = FileSink(path)
+        s.store.cdc.subscribe(sink)
+        try:
+            s.execute("CREATE TABLE cfs (id BIGINT PRIMARY KEY)")
+            s.execute("INSERT INTO cfs VALUES (7)")
+        finally:
+            s.store.cdc.unsubscribe(sink)
+        lines = [json.loads(l) for l in open(path)]
+        assert any(e["handle"] == 7 and e["op"] == "put" for e in lines)
+        assert all(e["commit_ts"] > 0 for e in lines)
+
+    def test_inert_without_sinks(self):
+        s = Session()
+        assert not s.store.cdc.active
+        s.execute("CREATE TABLE cfi (id INT)")
+        s.execute("INSERT INTO cfi VALUES (1)")  # no error, no capture
+
+
+class TestProfileMemtable:
+    def test_cpu_profile_tree(self):
+        s = Session()
+        stop = threading.Event()
+
+        def busy():
+            x = 0
+            while not stop.is_set():
+                x += sum(i * i for i in range(500))
+
+        t = threading.Thread(target=busy, daemon=True)
+        t.start()
+        try:
+            rows = s.must_query(
+                "SELECT function, percent_abs, samples, depth"
+                " FROM information_schema.tidb_profile_cpu"
+            )
+        finally:
+            stop.set()
+        assert rows[0][0] == "root"
+        assert any("busy" in r[0] for r in rows), rows[:6]
+        # depths increase along the indentation tree
+        assert max(int(r[3]) for r in rows) >= 3
+
+
+class TestTomlConfig:
+    def test_load_and_precedence(self, tmp_path):
+        from tidb_tpu.__main__ import load_config
+
+        p = tmp_path / "cfg.toml"
+        p.write_text(
+            'host = "0.0.0.0"\nport = 4444\n'
+            "[log]\nlevel = \"warn\"\n[gc]\nlife-minutes = 30\n"
+            "[unknown]\nkey = 1\n"
+        )
+        conf = load_config(str(p))
+        assert conf == {"host": "0.0.0.0", "port": 4444,
+                        "log_level": "warn", "gc_life_minutes": 30}

@@ -303,7 +303,9 @@ def test_topn_pushes_below_projection():
     """Limit(Sort(Projection(Scan))) must still push the per-task TopN to
     the reader with sort keys rewritten into scan space (round 5; ref:
     rule_topn_push_down.go) — without it the device ships ALL rows back."""
-    from tidb_tpu.executor.executors import ExecContext, TableReaderExec, build_executor
+    from tidb_tpu.executor.executors import (
+        ExecContext, TableReaderExec, _reader_under, build_executor,
+    )
     from tidb_tpu.parser.parser import parse_one
     from tidb_tpu.session import Session
 

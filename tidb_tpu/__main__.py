@@ -11,17 +11,69 @@ import sys
 import threading
 
 
+def load_config(path: str) -> dict:
+    """TOML config file (ref: config/config.go + config.toml.example —
+    the file layer below CLI flags). Recognized keys mirror the flag
+    names; [log]/[security]/[gc] tables flatten into them."""
+    import tomllib
+
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    flat: dict = {}
+    for k, v in raw.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat[f"{k}.{k2}"] = v2
+        else:
+            flat[k] = v
+    out = {}
+    # (dest, coerce, validator) — the same constraints the CLI flags carry
+    mapping = {
+        "host": ("host", str, None),
+        "port": ("port", int, None),
+        "log.level": ("log_level", str, ("debug", "info", "warn", "error")),
+        "gc.life-minutes": ("gc_life_minutes", int, None),
+        "security.enable-sem": ("enable_sem", bool, None),
+    }
+    for src, (dst, coerce, choices) in mapping.items():
+        if src not in flat:
+            continue
+        try:
+            v = coerce(flat[src])
+        except (TypeError, ValueError):
+            raise SystemExit(f"config: {src} must be {coerce.__name__}, got {flat[src]!r}")
+        if choices is not None and v not in choices:
+            raise SystemExit(f"config: {src} must be one of {choices}, got {v!r}")
+        out[dst] = v
+    unknown = sorted(set(flat) - set(mapping))
+    if unknown:
+        logging.getLogger(__name__).warning("config: ignoring unknown keys %s", unknown)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tidb-tpu-server", description="TPU-native TiDB-compatible SQL server")
-    ap.add_argument("--host", default="127.0.0.1", help="listen address")
-    ap.add_argument("-P", "--port", type=int, default=4000, help="listen port (0 = ephemeral)")
-    ap.add_argument("--log-level", default="info", choices=["debug", "info", "warn", "error"])
-    ap.add_argument("--gc-life-minutes", type=int, default=10, help="MVCC GC retention window")
+    ap.add_argument("--config", default=None, help="TOML config file (flags override it)")
+    ap.add_argument("--host", default=None, help="listen address")
+    ap.add_argument("-P", "--port", type=int, default=None, help="listen port (0 = ephemeral)")
+    ap.add_argument("--log-level", default=None, choices=["debug", "info", "warn", "error"])
+    ap.add_argument("--gc-life-minutes", type=int, default=None, help="MVCC GC retention window")
     ap.add_argument(
-        "--enable-sem", action="store_true",
+        "--enable-sem", action="store_true", default=None,
         help="security enhanced mode: hide restricted vars/tables, deny FILE (ref: util/sem)",
     )
     args = ap.parse_args(argv)
+    # precedence: defaults < config file < CLI flags (tidb-server rule)
+    defaults = {"host": "127.0.0.1", "port": 4000, "log_level": "info",
+                "gc_life_minutes": 10, "enable_sem": False}
+    conf = dict(defaults)
+    if args.config:
+        conf.update(load_config(args.config))
+    for k in defaults:
+        v = getattr(args, k)
+        if v is not None:
+            conf[k] = v
+        setattr(args, k, conf[k])
     if args.enable_sem:
         from .utils import sem
 

@@ -71,6 +71,10 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         ["SQL_DIGEST", "EXEC_COUNT", "SUM_CPU_TIME", "AVG_CPU_TIME", "SAMPLE_SQL"],
         [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_varchar(256)],
     ),
+    "tidb_profile_cpu": (
+        ["FUNCTION", "PERCENT_ABS", "PERCENT_PARENT", "SAMPLES", "DEPTH"],
+        [ft_varchar(512), ft_double(), ft_double(), ft_longlong(), ft_longlong()],
+    ),
 }
 
 
@@ -219,6 +223,8 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.f(cpu), Datum.f(avg), Datum.s(st["sample_sql"]),
             ])
         return out
+    if name == "tidb_profile_cpu":
+        return _cpu_profile_rows(session)
     if name == "inspection_result":
         return _inspection_rows(session)
     if name == "cluster_info":
@@ -282,3 +288,66 @@ def _inspection_rows(session) -> list:
     nregions = len(session.store.regions.regions)
     add("region", "count", nregions, "-", "info", "regions in the keyspace map")
     return rows
+
+
+def _cpu_profile_rows(session) -> list[list[Datum]]:
+    """pprof-as-SQL (ref: util/profile/profile.go + infoschema
+    TIDB_PROFILE_CPU): statistically sample every server thread's stack
+    for a short window, aggregate into a call TREE, and render it as
+    depth-indented rows with absolute and per-parent percentages — the
+    reference's flamegraph table, over Python frames instead of Go pprof.
+    """
+    import sys
+    import threading
+    import time as _time
+
+    me = threading.get_ident()
+    duration_s = 0.2
+    interval_s = 0.005
+    counts: dict[tuple, int] = {}
+    total = 0
+    deadline = _time.time() + duration_s
+    while _time.time() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # don't profile the profiler
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 48:
+                co = f.f_code
+                stack.append(f"{co.co_name} ({co.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+                f = f.f_back
+            stack.reverse()
+            prefix: tuple = ()
+            for fn in stack:  # one incremental tuple per depth
+                prefix = prefix + (fn,)
+                counts[prefix] = counts.get(prefix, 0) + 1
+            total += 1
+        _time.sleep(interval_s)
+
+    if total == 0:
+        return [[Datum.s("root"), Datum.f(100.0), Datum.f(100.0), Datum.i(0), Datum.i(0)]]
+    out = [[Datum.s("root"), Datum.f(100.0), Datum.f(100.0), Datum.i(total), Datum.i(0)]]
+    # depth-first over prefixes, children by sample count (profile tree)
+    tops = sorted({k for k in counts if len(k) == 1}, key=lambda k: -counts[k])
+
+    def emit(prefix, parent_samples):
+        n = counts[prefix]
+        if n * 100.0 / total < 0.5 and len(prefix) > 1:
+            return  # prune the noise floor like the reference's tree view
+        name = "  " * len(prefix) + ("├─ " if len(prefix) > 1 else "") + prefix[-1]
+        out.append([
+            Datum.s(name[:512]), Datum.f(round(n * 100.0 / total, 2)),
+            Datum.f(round(n * 100.0 / max(parent_samples, 1), 2)),
+            Datum.i(n), Datum.i(len(prefix)),
+        ])
+        kids = sorted(
+            (k for k in counts if len(k) == len(prefix) + 1 and k[:-1] == prefix),
+            key=lambda k: -counts[k],
+        )
+        for k in kids:
+            emit(k, n)
+
+    for t in tops:
+        emit(t, total)
+    return out

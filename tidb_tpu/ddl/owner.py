@@ -1,0 +1,133 @@
+"""Owner election over the meta keyspace (ref: owner/manager.go:94
+CampaignOwner + domain/infosync/info.go — etcd lease/campaign semantics
+re-expressed over the store's own transactional KV).
+
+The reference elects one DDL owner per cluster through an etcd session
+lease; every tidb-server campaigns and the winner runs the DDL worker.
+This framework is single-process today, but the ELECTION RUNS THROUGH
+THE SHARED KEYSPACE, not through process-local state: a second process
+attached to the same store would campaign against the same key and the
+protocol would hold — the seam the reference's multi-node schema change
+needs is real, not a stub.
+
+Protocol (the etcd Campaign/Proclaim/Resign triple over MVCC txns):
+  campaign():  txn-read the owner record; if absent or its lease expired,
+               txn-write (owner_id, lease_deadline) — write conflicts
+               mean another campaigner won, retry/observe.
+  renew():     owner extends its lease (Proclaim); losing the record
+               (another owner) demotes.
+  resign():    delete the record iff still owned; others may campaign.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+OWNER_KEY = b"m:owner:ddl"  # meta keyspace, shared by every attached node
+DEFAULT_LEASE_S = 45.0  # ref: owner.ManagerSessionTTL
+
+
+def _encode(owner_id: str, deadline: float) -> bytes:
+    return f"{owner_id}|{deadline:.6f}".encode()
+
+
+def _decode(raw: bytes) -> tuple[str, float]:
+    s = raw.decode()
+    oid, dl = s.rsplit("|", 1)
+    return oid, float(dl)
+
+
+class OwnerManager:
+    """One campaigner (ref: owner.NewOwnerManager). Thread-safe at the
+    txn layer: all state transitions go through the store's MVCC commits,
+    so concurrent campaigners serialize on write conflicts."""
+
+    def __init__(self, storage, key: bytes = OWNER_KEY, lease_s: float = DEFAULT_LEASE_S):
+        self.storage = storage
+        self.key = key
+        self.lease_s = lease_s
+        self.id = uuid.uuid4().hex[:12]
+
+    # ------------------------------------------------------------ queries
+
+    def get_owner_id(self) -> str | None:
+        """Current owner per the shared record, None if the seat is empty
+        or the lease lapsed (ref: manager.go GetOwnerID)."""
+        txn = self.storage.begin()
+        try:
+            raw = txn.get(self.key)
+        finally:
+            txn.rollback()
+        if raw is None:
+            return None
+        oid, deadline = _decode(raw)
+        if deadline < time.time():
+            return None
+        return oid
+
+    def is_owner(self) -> bool:
+        return self.get_owner_id() == self.id
+
+    # -------------------------------------------------------- transitions
+
+    def campaign(self) -> bool:
+        """Try to take (or keep) the seat; True iff this manager owns it
+        afterwards. A write conflict means a rival won — report their
+        victory instead of retrying blindly (the caller's watch loop
+        decides cadence, like the etcd campaign watch)."""
+        from ..errors import RetryableError, WriteConflict
+
+        txn = self.storage.begin()
+        try:
+            raw = txn.get(self.key)
+            if raw is not None:
+                oid, deadline = _decode(raw)
+                if deadline >= time.time() and oid != self.id:
+                    txn.rollback()
+                    return False  # live rival owner
+            txn.put(self.key, _encode(self.id, time.time() + self.lease_s))
+            txn.commit()
+            return True
+        except (WriteConflict, RetryableError):
+            return self.is_owner()
+        except Exception:
+            txn.rollback()
+            raise
+
+    def renew(self) -> bool:
+        """Extend the lease while still owner (Proclaim); False demotes."""
+        from ..errors import RetryableError, WriteConflict
+
+        txn = self.storage.begin()
+        try:
+            raw = txn.get(self.key)
+            if raw is None or _decode(raw)[0] != self.id:
+                txn.rollback()
+                return False
+            txn.put(self.key, _encode(self.id, time.time() + self.lease_s))
+            txn.commit()
+            return True
+        except (WriteConflict, RetryableError):
+            return False
+        except Exception:
+            txn.rollback()
+            raise
+
+    def resign(self) -> None:
+        """Give the seat up iff still holding it (ref: manager Resign)."""
+        from ..errors import RetryableError, WriteConflict
+
+        txn = self.storage.begin()
+        try:
+            raw = txn.get(self.key)
+            if raw is None or _decode(raw)[0] != self.id:
+                txn.rollback()
+                return
+            txn.delete(self.key)
+            txn.commit()
+        except (WriteConflict, RetryableError):
+            pass
+        except Exception:
+            txn.rollback()
+            raise

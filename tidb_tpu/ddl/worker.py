@@ -51,8 +51,15 @@ DROP_INDEX_STATES = [ST_WRITE_ONLY, ST_DELETE_ONLY, ST_NONE]
 class DDLWorker:
     def __init__(self, storage):
         self.storage = storage
-        self._lock = RLock()  # the owner-election seam: one runner at a time
+        self._lock = RLock()  # in-process serialization of the run loop
         self.hook = None  # callable(event: str, job: DDLJob) — test seam
+        # cross-process serialization: the election over the shared meta
+        # keyspace (ref: owner/manager.go CampaignOwner — only the owner
+        # may drive the job queue; a second attached process campaigns
+        # against the same record)
+        from .owner import OwnerManager
+
+        self.owner = OwnerManager(storage)
 
     def _fire(self, event: str, job: DDLJob) -> None:
         if self.hook is not None:
@@ -72,6 +79,18 @@ class DDLWorker:
         """Drive the queue until `job_id` finishes (the doDDLJob wait loop,
         ddl.go:562). Raises the job's error if it rolled back."""
         with self._lock:
+            # block until elected (the etcd campaign WAITS for the seat;
+            # a crashed predecessor's lease parks us at most one TTL —
+            # ref: owner/manager.go campaignLoop)
+            import time as _t
+
+            deadline = _t.time() + self.owner.lease_s + 5
+            while not self.owner.campaign():
+                if _t.time() > deadline:
+                    raise TiDBError(
+                        f"not the DDL owner (current: {self.owner.get_owner_id()})"
+                    )
+                _t.sleep(0.1)
             while True:
                 txn = self.storage.begin()
                 m = Meta(txn)
@@ -88,6 +107,9 @@ class DDLWorker:
                 if job is None:
                     raise TiDBError(f"DDL job {job_id} vanished from the queue")
                 self._step(job)
+                # lease keepalive between steps (Proclaim): a reorg longer
+                # than the TTL must not silently lose the seat mid-job
+                self.owner.renew()
 
     def run_pending(self) -> None:
         """Drain the whole queue (background-owner mode)."""

@@ -277,6 +277,16 @@ def _pushable_reader(e: Executor) -> "TableReaderExec | None":
     return None
 
 
+def _reader_under(e: Executor, depth: int = 6) -> "TableReaderExec | None":
+    """Descend `.child` links to the reader (through projections etc.),
+    returning it only if its DAG can still absorb an op."""
+    for _ in range(depth):
+        if e is None or isinstance(e, TableReaderExec):
+            break
+        e = getattr(e, "child", None)
+    return _pushable_reader(e) if isinstance(e, TableReaderExec) else None
+
+
 def _build_agg(plan: Aggregation, ctx: ExecContext) -> Executor:
     from ..expr.aggregation import PUSHABLE_AGGS
 
@@ -376,13 +386,8 @@ def _build_limit(plan: Limit, ctx: ExecContext) -> Executor:
                 if ok:
                     mapped, node = nb, node.children[0]
             if ok and isinstance(node, DataSource):
-                r = sort_child
-                for _ in range(6):
-                    if isinstance(r, TableReaderExec) or r is None:
-                        break
-                    r = getattr(r, "child", None)
-                if (isinstance(r, TableReaderExec) and r.dag.agg is None
-                        and r.dag.topn is None and r.dag.limit is None):
+                r = _reader_under(sort_child)
+                if r is not None:
                     reader, push_by = r, mapped
         if reader is not None and all(e.pushable() for e, _ in push_by):
             reader.dag.topn = TopNNode(push_by, n)  # per-task topn

@@ -11,8 +11,11 @@ lock-resolution retries (ref: unistore tikv/server.go:331,353 semantics).
 
 from __future__ import annotations
 
+import logging
 import time
 from threading import Lock
+
+log = logging.getLogger(__name__)
 
 from ..errors import DeadlockError, LockedError, RetryableError, TiDBError, TxnAborted, WriteConflict
 from ..utils.failpoint import inject as _fp
@@ -251,11 +254,15 @@ class Txn:
                     time.sleep(backoff)
                     backoff = min(backoff * 2, 0.1)
             except (WriteConflict, TxnAborted):
-                # partially-prewritten locks must not linger for their TTL
+                # partially-prewritten locks must not linger for their TTL;
+                # the txn is dead — release its start_ts or it pins the GC
+                # safepoint for the whole leak horizon
                 mvcc.rollback([m.key for m in muts], self.start_ts)
+                self.store._txn_done(self.start_ts)
                 raise
         else:
             mvcc.rollback([m.key for m in muts], self.start_ts)
+            self.store._txn_done(self.start_ts)
             raise RetryableError("prewrite kept hitting live locks")
 
         # phase 2
@@ -265,6 +272,7 @@ class Txn:
             mvcc.commit([primary], self.start_ts, self.commit_ts)
         except TxnAborted:
             mvcc.rollback([m.key for m in muts], self.start_ts)
+            self.store._txn_done(self.start_ts)
             raise
         _fp("txn/commit-after-primary")
         secondaries = [m.key for m in muts if m.key != primary]
@@ -274,6 +282,15 @@ class Txn:
         self.store._txn_done(self.start_ts)
         self.store.bump_version([m.key for m in muts])
         self.store.wal_sync()  # group-commit durability point
+        # change feed: the txn is durable (primary committed + WAL synced);
+        # a post-commit hook must never turn a durable commit into an
+        # error (ref: binlog.go commit hook)
+        cdc = getattr(self.store, "cdc", None)
+        if cdc is not None and cdc.active:
+            try:
+                cdc.publish(self.start_ts, self.commit_ts, muts)
+            except Exception:  # noqa: BLE001
+                log.exception("change-feed sink failed post-commit (dropped)")
         return self.commit_ts
 
     def rollback(self) -> None:
@@ -302,6 +319,11 @@ class Storage:
         self.tso = TSO()
         # SET GLOBAL overrides: seed new sessions, serve @@global.x reads
         self.global_vars: dict[str, str] = {}
+        # commit-time change feed (ref: cdclog/binlog hooks) — inert
+        # until a sink subscribes
+        from ..cdc import ChangeFeed
+
+        self.cdc = ChangeFeed()
         # distinguishes stores in process-wide caches (table ids restart
         # per store, so (table_id, version) alone is ambiguous)
         import uuid as _uuid
