@@ -129,7 +129,9 @@ def main():
                  "SELECT SUM(l_quantity) OVER (PARTITION BY l_returnflag"
                  " ORDER BY l_orderkey, l_linenumber ROWS BETWEEN 3 PRECEDING AND CURRENT ROW),"
                  " AVG(l_quantity) OVER (PARTITION BY l_linestatus"
-                 " ORDER BY l_orderkey, l_linenumber) FROM lineitem LIMIT 100000",
+                 " ORDER BY l_orderkey, l_linenumber),"
+                 " COUNT(*) OVER (ORDER BY l_orderkey RANGE BETWEEN 100 PRECEDING AND 100 FOLLOWING)"
+                 " FROM lineitem LIMIT 100000",
                  order_insensitive=False)
         run_both("mpp_q3_topk", tpch.Q3, order_insensitive=False)
         fb = s.cop.tpu.fallbacks - fb0

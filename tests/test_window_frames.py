@@ -194,3 +194,34 @@ def test_inverted_same_kind_frames_error(s):
     ):
         with pytest.raises(TiDBError):
             s.must_query(sql)
+
+
+def test_device_kernel_runs_range_offsets(s):
+    """RANGE N PRECEDING/FOLLOWING now has a device kernel (round 5):
+    forced 'tpu' must route through run_device_window, not fall back."""
+    from tidb_tpu.executor import window_device as wd
+
+    calls = []
+    orig = wd.run_device_window
+
+    def spy(*a, **k):
+        calls.append(k.get("range_lane") is not None or any(
+            f.get("frame") is not None and len(f["frame"]) > 5 for f in a[2]))
+        return orig(*a, **k)
+
+    wd.run_device_window = spy
+    try:
+        s.execute("SET tidb_cop_engine = 'tpu'")
+        host_off = s.must_query(
+            "SELECT id, SUM(v) OVER (PARTITION BY g ORDER BY v"
+            " RANGE BETWEEN 4 PRECEDING AND 4 FOLLOWING) FROM t ORDER BY id"
+        )
+        s.execute("SET tidb_cop_engine = 'host'")
+        assert host_off == s.must_query(
+            "SELECT id, SUM(v) OVER (PARTITION BY g ORDER BY v"
+            " RANGE BETWEEN 4 PRECEDING AND 4 FOLLOWING) FROM t ORDER BY id"
+        )
+        s.execute("SET tidb_cop_engine = 'auto'")
+    finally:
+        wd.run_device_window = orig
+    assert calls and calls[0], "range-offset frame did not take the device path"

@@ -837,8 +837,15 @@ class WindowExec(Executor):
                 for i, (data, valid) in enumerate(results):
                     cols.append(Column(self.out_fts[nbase + i], data, valid))
                 return Chunk(cols)
+        range_lane, range_stats = (None, None)
+        if any(
+            f.frame is not None and f.frame.unit == "range"
+            and (f.frame.start_kind in ("pre", "fol") or f.frame.end_kind in ("pre", "fol"))
+            for f in self.funcs
+        ):
+            range_lane, range_stats = self._range_lane_stats(c, n)
         try:
-            fspecs = self._device_fspecs(c, n)
+            fspecs = self._device_fspecs(c, n, range_stats)
         except _NotOnDevice as e:
             self.fallback_reason = str(e)
             return None
@@ -854,8 +861,12 @@ class WindowExec(Executor):
 
         part = [key_lane(e) for e in self.part_by]
         order = [(key_lane(e), desc) for e, desc in self.order_by]
+        if not any(f.get("frame") is not None and len(f["frame"]) > 5 for f in fspecs):
+            range_lane = None  # computed above only when a frame uses it
+        rng_arg = (range_lane + range_stats) if range_lane is not None else None
         try:
-            results = run_device_window(part, order, fspecs, n, provenance=prov)
+            results = run_device_window(part, order, fspecs, n, provenance=prov,
+                                        range_lane=rng_arg)
         except Exception as e:  # noqa: BLE001 — device route is best-effort
             if eng == "tpu":
                 raise  # forced device: surface the real failure
@@ -868,7 +879,36 @@ class WindowExec(Executor):
             cols.append(Column(self.out_fts[nbase + i], data, valid))
         return Chunk(cols)
 
-    def _device_fspecs(self, c: Chunk, n: int):
+    def _range_offset_ok(self, fr, range_stats, n: int):
+        """Device-eligibility of a RANGE-offset frame: ONE integer-typed
+        ORDER BY key (range_stats precomputed once per chunk), int
+        offsets, and a composite band (n partitions worst case) that fits
+        int64 — everything else stays on the host twin."""
+        if range_stats is None:
+            return False
+        off_s = fr.start_off if fr.start_kind in ("pre", "fol") else 0
+        off_e = fr.end_off if fr.end_kind in ("pre", "fol") else 0
+        if not isinstance(off_s, int) or not isinstance(off_e, int):
+            return False
+        gmin, gmax = range_stats
+        S = (gmax - gmin) + 2 * max(abs(off_s), abs(off_e)) + 4
+        return n * S < 1 << 61
+
+    def _range_lane_stats(self, c: Chunk, n: int):
+        """((d, v), (gmin, gmax)) for the single ORDER BY key — computed
+        ONCE per chunk and shared by eligibility gating, the kernel's
+        runtime scalars, and the shipped search lane."""
+        if len(self.order_by) != 1:
+            return None, None
+        d, v = self._lane(self.order_by[0][0], c, n)
+        if getattr(d, "dtype", None) is None or d.dtype == object or d.dtype.kind != "i":
+            return None, None
+        pres = d[:n][v[:n]]
+        if len(pres) == 0:
+            return None, None  # all-NULL key: peer bounds; host is fine
+        return (d, v), (int(pres.min()), int(pres.max()))
+
+    def _device_fspecs(self, c: Chunk, n: int, range_stats=None):
         """Build window_device fspecs; raises _NotOnDevice when some func
         has no device form (the reason lands in EXPLAIN ANALYZE)."""
         from .window_device import SUPPORTED, encode_obj
@@ -884,11 +924,17 @@ class WindowExec(Executor):
                 "first_value", "last_value", "nth_value", "count", "sum", "avg", "min", "max",
             ):
                 fr = f.frame
+                frame = fr.key()
                 if fr.unit == "range" and (
                     fr.start_kind in ("pre", "fol") or fr.end_kind in ("pre", "fol")
                 ):
-                    raise _NotOnDevice("RANGE offset frame has no device kernel")
-                frame = fr.key()
+                    if not self._range_offset_ok(fr, range_stats, n):
+                        raise _NotOnDevice(
+                            "RANGE offset frame not device-eligible (non-int key/offset or composite overflow)"
+                        )
+                    # only `desc` is static; gmin/gmax ship as runtime
+                    # scalars so data changes never recompile the kernel
+                    frame = frame + (bool(self.order_by[0][1]),)
                 if f.name in ("min", "max") and fr.start_kind != "up" and fr.end_kind != "uf":
                     # both-bounded: device needs a static sparse table
                     if fr.unit != "rows":

@@ -158,7 +158,7 @@ def _build_kernel(spec):
     words. framespecs[i] is None (default frame) or Frame.key()."""
     npw, now, funcspecs, framespecs = spec
 
-    def kernel(words, fargs):
+    def kernel(words, fargs, range_key=None):
         P = words[0].shape[0]
         iota = jnp.arange(P, dtype=jnp.int64)
         vals = []
@@ -205,13 +205,52 @@ def _build_kernel(spec):
         def scat(x):
             return jnp.zeros(P, dtype=x.dtype).at[perm].set(x)
 
+        def range_offset_bounds(sk, so, ek, eo, meta):
+            """RANGE N PRECEDING/FOLLOWING: binary search the single
+            numeric ORDER BY key (host _range_bounds twin). Keys shift
+            into a per-partition composite band (pid*S + shifted-key with
+            NULL sentinels at the band edges), so ONE global sort-method
+            searchsorted resolves every partition at once — S carries
+            enough margin that offset targets never leave their band.
+            gmin/gmax arrive as RUNTIME scalars (range_key[2:]) so data
+            changes never recompile; only `desc` and the offsets are
+            static."""
+            desc = meta
+            kd, kv, gmin, gmax = range_key
+            S = (gmax - gmin) + 2 * max(abs(so), abs(eo), 1) + 4
+            ks, kvs = kd[perm].astype(jnp.int64), kv[perm]
+            kk = (gmax - ks) if desc else (ks - gmin)  # ascending, >= 0
+            # NULLs sort first asc / last desc (canon-word contract):
+            # sentinels keep the composite globally sorted
+            sent = (S - 1) if desc else -1
+            comp = pid * S + jnp.where(kvs, kk, sent)
+            # valid-key run edges per partition (invalid block is
+            # contiguous at the head asc / tail desc)
+            inv = (~kvs).astype(jnp.int64)
+            cinv = jnp.cumsum(inv)
+            before = jnp.where(pfirst > 0, cinv[jnp.maximum(pfirst - 1, 0)], 0)
+            ninv = cinv[plast] - before  # invalids in this partition
+            vfirst = pfirst + (ninv if not desc else 0)
+            vlast = plast - (ninv if desc else 0)
+
+            def search(off, kind, side):
+                tgt = comp + (off if kind == "fol" else -off)
+                pos_ = jnp.searchsorted(comp, tgt, side=side, method="sort")
+                return pos_.astype(jnp.int64)
+
+            fs_r = jnp.clip(search(so, sk, "left"), vfirst, vlast + 1) \
+                if sk in ("pre", "fol") else None
+            fe_r = jnp.clip(search(eo, ek, "right") - 1, vfirst - 1, vlast) \
+                if ek in ("pre", "fol") else None
+            return fs_r, fe_r, kvs
+
         def frame_of(frkey):
             """frame key → (fs, fe, nonempty) over sorted rows (the host
-            WindowExec._frame_bounds twin; RANGE offset bounds never reach
-            the device)."""
+            WindowExec._frame_bounds twin; RANGE offsets resolve through
+            range_offset_bounds when the builder shipped the key lane)."""
             if frkey is None:
                 return pfirst, fe, ones
-            unit, sk, so, ek, eo = frkey
+            unit, sk, so, ek, eo = frkey[:5]
             cur_s = iota if unit == "rows" else peer_first
             cur_e = iota if unit == "rows" else peer_last
 
@@ -222,10 +261,23 @@ def _build_kernel(spec):
                     return plast
                 if kind == "cur":
                     return cur
+                if unit == "range":
+                    # offset kinds resolve by value search below; rows
+                    # with NULL keys keep their peer block (host rule)
+                    return cur
                 return iota - off if kind == "pre" else iota + off
 
             fs_raw = pos(sk, so, cur_s)
             fe_raw = pos(ek, eo, cur_e)
+            if unit == "range" and len(frkey) > 5 and (
+                sk in ("pre", "fol") or ek in ("pre", "fol")
+            ):
+                fs_r, fe_r, kvs = range_offset_bounds(sk, so, ek, eo, frkey[5])  # frkey[5] = desc
+                # NULL-key rows keep their peer-block bounds (host rule)
+                if fs_r is not None:
+                    fs_raw = jnp.where(kvs, fs_r, fs_raw)
+                if fe_r is not None:
+                    fe_raw = jnp.where(kvs, fe_r, fe_raw)
             ne = (fs_raw <= fe_raw) & (fs_raw <= plast) & (fe_raw >= pfirst)
             return jnp.clip(fs_raw, pfirst, plast), jnp.clip(fe_raw, pfirst, plast), ne
 
@@ -450,11 +502,12 @@ def run_cached_window(provenance, n: int):
     if cached is None:
         return None
     _INPUT_CACHE[key] = _INPUT_CACHE.pop(key)  # LRU: hits refresh recency
-    words, fargs, pwords_n, owords_n, fspecs_meta = cached[0]
-    return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n)
+    words, fargs, pwords_n, owords_n, fspecs_meta, range_dev = cached[0]
+    return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n, range_dev)
 
 
-def run_device_window(part_lanes, order_lanes, fspecs, n: int, provenance=None):
+def run_device_window(part_lanes, order_lanes, fspecs, n: int, provenance=None,
+                      range_lane=None):
     """Execute a window spec on device; returns [(data, valid), ...] per func
     in input row order (numpy, length n).
 
@@ -471,8 +524,8 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int, provenance=None):
     cached = _INPUT_CACHE.get(cache_key) if cache_key is not None else None
     if cached is not None:
         _INPUT_CACHE[cache_key] = _INPUT_CACHE.pop(cache_key)  # LRU touch
-        words, fargs, pwords_n, owords_n, fspecs_meta = cached[0]
-        return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n)
+        words, fargs, pwords_n, owords_n, fspecs_meta, range_dev = cached[0]
+        return _run_prepared(words, fargs, pwords_n, owords_n, fspecs_meta, n, range_dev)
 
     def pad(d, v):
         dd = np.zeros(P, dtype=d.dtype)
@@ -493,23 +546,29 @@ def run_device_window(part_lanes, order_lanes, fspecs, n: int, provenance=None):
     owords = _pack_words(order_items, n, P)
     words = tuple(jnp.asarray(w) for w in pwords + owords)
     fargs = tuple(tuple(pad(d, v) for d, v in f["args"]) for f in fspecs)
+    if range_lane is not None:
+        d0, v0, gmin, gmax = range_lane
+        range_dev = pad(d0, v0) + (jnp.asarray(np.int64(gmin)), jnp.asarray(np.int64(gmax)))
+    else:
+        range_dev = None
     if cache_key is not None:
         nbytes = sum(w.nbytes for w in words) + sum(
             d.nbytes + v.nbytes for fa in fargs for d, v in fa
-        )
+        ) + (sum(x.nbytes for x in range_dev) if range_dev is not None else 0)
         fspecs_meta = [{k: v for k, v in f.items() if k != "args"} for f in fspecs]
         _input_cache_put(
             cache_key,
-            (words, fargs, len(pwords), len(owords), fspecs_meta), nbytes,
+            (words, fargs, len(pwords), len(owords), fspecs_meta, range_dev), nbytes,
         )
-    return _run_prepared(words, fargs, len(pwords), len(owords), fspecs, n)
+    return _run_prepared(words, fargs, len(pwords), len(owords), fspecs, n, range_dev)
 
 
-def _run_prepared(words, fargs, n_pwords: int, n_owords: int, fspecs, n: int):
+def _run_prepared(words, fargs, n_pwords: int, n_owords: int, fspecs, n: int,
+                  range_dev=None):
     funcspecs = tuple(f["static"] for f in fspecs)
     framespecs = tuple(f.get("frame") for f in fspecs)
     kernel = _build_kernel((n_pwords, n_owords, funcspecs, framespecs))
-    flat = unpack_flat(np.asarray(kernel(words, fargs)))
+    flat = unpack_flat(np.asarray(kernel(words, fargs, range_dev)))
     outs = [(flat[2 * i], flat[2 * i + 1]) for i in range(len(fspecs))]
 
     results = []
