@@ -302,22 +302,47 @@ class MPPEngine:
             # take the compact cumsum-offset path (mult=2 is a path
             # selector, not a fan-out factor — output capacity is bounded
             # by the drop-guarded join output, so no multiplicity cap).
-            boffs = tuple(scan_of_joined[bk][1] for bk in frag.build_keys)
+            def key_mult(sd, key_idxs):
+                """Max multiplicity (1 or 2) of a key tuple on scan `sd`,
+                packed with domains derived from the KEY LANES THEMSELVES
+                (never an enclosing level's tables) — cached per (table,
+                version, offsets)."""
+                offs2 = tuple(scan_of_joined[k][1] for k in key_idxs)
 
-            def build_mult():
-                bkeys = self._pack_host(frag.build_keys, scan_of_joined, los, strides)
-                if bkeys is None:
-                    return None
-                kv, km = bkeys
-                present = kv[km]
-                if len(present):
-                    _, counts = np.unique(present, return_counts=True)
-                    return 1 if int(counts.max()) <= 1 else 2
-                return 1
+                def compute():
+                    los2, sizes2 = [], []
+                    for k in key_idxs:
+                        mm = self._lane_minmax(*scan_of_joined[k])
+                        if mm == "float" or mm is None:
+                            # empty lanes have no duplicates; floats can't pack
+                            if mm is None:
+                                los2.append(0)
+                                sizes2.append(1)
+                                continue
+                            return None
+                        los2.append(mm[0])
+                        sizes2.append(mm[1] - mm[0] + 1)
+                    strides2 = [1] * len(sizes2)
+                    acc = 1
+                    for i in range(len(sizes2) - 1, -1, -1):
+                        strides2[i] = acc
+                        acc *= sizes2[i] + 1
+                        if acc > 1 << 62:
+                            return None
+                    packed = self._pack_host(key_idxs, scan_of_joined, los2, strides2)
+                    if packed is None:
+                        return None
+                    kv2, km2 = packed
+                    present = kv2[km2]
+                    if len(present):
+                        _, counts = np.unique(present, return_counts=True)
+                        return 1 if int(counts.max()) <= 1 else 2
+                    return 1
 
-            # uniqueness is a property of the build key lanes alone —
-            # cache it per (table, version, key offsets)
-            mult = self._cached_stat(bscan, ("uniq", boffs), build_mult)
+                return self._cached_stat(sd, ("uniq", offs2), compute)
+
+            # uniqueness is a property of the build key lanes alone
+            mult = key_mult(bscan, frag.build_keys)
             if mult is None:
                 self.last_fallback_reason = "unpackable build keys"
                 return False
@@ -329,19 +354,31 @@ class MPPEngine:
             # only shrink the true output, so this is a hard upper bound.
             psds = {id(scan_of_joined[pk][0]) for pk in frag.probe_keys}
 
-            def probe_chain_unique(f):
-                # jcard is measured on raw scan lanes: it stays an upper
-                # bound only while every join below the probe has UNIQUE
-                # build keys (each can only filter, never fan out)
-                while isinstance(f, JoinFrag):
-                    lv = next((x for x in levels if x.frag is f), None)
-                    if lv is None or lv.mult != 1:
+            def rows_preserved(f, sd):
+                """True iff scan `sd`'s rows appear at most once in f's
+                output — jcard measured on raw scan lanes stays a hard
+                upper bound exactly then. A row survives unmultiplied
+                through a join when (a) it rides the probe side and the
+                build keys are unique, or (b) it IS the build side and the
+                probe keys are unique (each build row matches <=1 probe
+                row), recursively."""
+                if isinstance(f, ScanFrag):
+                    return by_frag[id(f)] is sd
+                lv = next((x for x in levels if x.frag is f), None)
+                if lv is None:
+                    return False
+                if by_frag[id(f.build)] is sd:
+                    pks = {id(scan_of_joined[pk][0]) for pk in f.probe_keys}
+                    if len(pks) != 1:
                         return False
-                    f = f.probe
-                return True
+                    ps2 = scan_of_joined[f.probe_keys[0]][0]
+                    return rows_preserved(f.probe, ps2) and key_mult(ps2, f.probe_keys) == 1
+                return lv.mult == 1 and rows_preserved(f.probe, sd)
 
             expected = None
-            if len(psds) == 1 and mult > 1 and probe_chain_unique(frag.probe):
+            if len(psds) == 1 and mult > 1 and rows_preserved(
+                frag.probe, scan_of_joined[frag.probe_keys[0]][0]
+            ):
                 psd = scan_of_joined[frag.probe_keys[0]][0]
                 poffs = tuple(scan_of_joined[pk][1] for pk in frag.probe_keys)
 
@@ -357,7 +394,8 @@ class MPPEngine:
                     m = (ii < len(pu)) & (pu[iic] == bu) if len(pu) else np.zeros(len(bu), bool)
                     return int(np.sum(pc[iic[m]] * bc[m])) if len(bu) else 0
 
-                tag = ("jcard", boffs, poffs, psd.frag.ds.table.id, psd.version)
+                boffs2 = tuple(scan_of_joined[bk][1] for bk in frag.build_keys)
+                tag = ("jcard", boffs2, poffs, psd.frag.ds.table.id, psd.version)
                 expected = self._cached_stat(bscan, tag, jcard)
             lvl.expected_out = expected
             # broadcast only when the build side is small by BOTH row count
