@@ -162,10 +162,19 @@ class Chunk:
         chunks = [c for c in chunks if c is not None and c.num_rows > 0]
         if not chunks:
             return Chunk([])
-        out = chunks[0]
-        for c in chunks[1:]:
-            out = out.concat(c)
-        return out
+        if len(chunks) == 1:
+            return chunks[0]
+        # one np.concatenate per column — pairwise concat is O(k^2) copies
+        import numpy as np
+
+        cols = []
+        for i, c0 in enumerate(chunks[0].columns):
+            cols.append(Column(
+                c0.ft,
+                np.concatenate([c.columns[i].data for c in chunks]),
+                np.concatenate([c.columns[i].valid for c in chunks]),
+            ))
+        return Chunk(cols)
 
     def to_pylist(self) -> list[tuple]:
         """Render all rows as python tuples (None for NULL) — test/display helper."""

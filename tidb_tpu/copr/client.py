@@ -96,6 +96,7 @@ class CopClient:
         self._tpu = None
         self._pool = None
         self._lock = Lock()  # guards lazy singletons + stats counters
+        self._ndv_cache: dict = {}  # (dag digest, batch version) → (est,)
         self.stats = {
             "tasks": 0,
             "tpu_tasks": 0,
@@ -271,6 +272,60 @@ class CopClient:
     # --- engine dispatch over an arbitrary batch --------------------------
 
     AUTO_MIN_ROWS = 2048  # below this, device jit cost can't amortize
+    AUTO_GROUP_MAX = 1 << 16  # est. NDV beyond direct addressing → host
+
+    def _estimate_groups(self, dag, batch) -> int | None:
+        """Sampled NDV estimate for the GROUP BY key tuple; None when the
+        keys aren't plain columns. A routing-cost heuristic only (the
+        sample is pre-filter, so a selective WHERE can over-estimate —
+        worst case the query runs on the well-vectorized host path).
+        Cached per (dag digest, batch version) so repeat dispatches and
+        sibling cop tasks don't re-sample."""
+        from ..expr.expression import Column as ECol
+
+        ck = (dag.digest(), getattr(batch, "version", -1))
+        hit = self._ndv_cache.get(ck)
+        if hit is not None:
+            return hit[0]
+        cols = []
+        for g in dag.agg.group_by:
+            if not isinstance(g, ECol):
+                return None
+            pos = g.idx
+            if not (0 <= pos < len(dag.scan.col_offsets)):
+                return None
+            cols.append(dag.scan.col_offsets[pos])
+        n = batch.n_rows
+        if n == 0:
+            return 0
+        m = min(n, 8192)
+        step = max(1, n // m)
+        import numpy as np
+
+        sel = slice(None, None, step)
+        valid = np.ones(len(batch.data[cols[0]][sel][:m]), dtype=bool)
+        sample = []
+        for off in cols:
+            sample.append(np.asarray(batch.data[off][sel][:m]))
+            valid &= np.asarray(batch.valid[off][sel][: len(valid)])
+        sample = [s[valid] for s in sample]
+        k = max(len(sample[0]), 1)
+        try:
+            if len(sample) == 1:
+                d = len(np.unique(sample[0]))
+            else:
+                d = len(np.unique(np.rec.fromarrays(sample)))
+        except (TypeError, ValueError):  # mixed/object lanes
+            d = len({tuple(row) for row in zip(*sample)})
+        if d >= k * 0.95:
+            est = n  # nearly all-distinct sample: assume NDV ~ rows
+        else:
+            # birthday-style scale-up, clamped to the population
+            est = min(n, int(d * (n / k)))
+        if len(self._ndv_cache) > 512:
+            self._ndv_cache.clear()
+        self._ndv_cache[ck] = (est,)
+        return est
 
     def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str) -> Chunk:
         self._bump("tasks")
@@ -283,6 +338,15 @@ class CopClient:
             # possibly remote link) computes nothing and costs everything.
             # 'tpu' stays forced (tests/EXPLAIN rely on that contract).
             engine = "host"
+        if engine == "auto" and dag.agg is not None and dag.agg.group_by:
+            # NDV routing: beyond the direct-addressing domain the device
+            # takes the sort-based path whose XLA compile scales badly
+            # with group capacity, while the vectorized host final-merge
+            # handles high-NDV partials well — send it there (the
+            # reference's engine cost choice, tidb_isolation_read_engines)
+            est = self._estimate_groups(dag, batch)
+            if est is not None and est > self.AUTO_GROUP_MAX:
+                engine = "host"
         if engine in ("tpu", "auto"):
             try:
                 chunk = self.tpu.execute(dag, batch)

@@ -1852,6 +1852,20 @@ class FinalHashAggExec(Executor):
                     out.append((d.is_null, None if d.is_null else d.val))
             return tuple(out)
 
+        vector_ok = all(
+            a.name in ("count", "sum", "avg", "min", "max") for a in self.aggs
+        )
+        chunks = []
+        while True:
+            c = self.child.next()
+            if c is None:
+                break
+            if c.num_rows:
+                chunks.append(c)
+        all_ = Chunk.concat_all(chunks) if chunks else None
+        fast = self._merge_vectorized(all_) if (vector_ok and all_ is not None) else None
+        if fast is not None:
+            return fast
         # the group hash table is the aggregate's real working set; charge
         # it to the statement tracker unless the session opted out
         # (ref: aggregate.go memTracker + tidb_track_aggregate_memory_usage)
@@ -1865,10 +1879,7 @@ class FinalHashAggExec(Executor):
         groups: dict = {}
         firsts: dict = {}
         order: list = []
-        while True:
-            c = self.child.next()
-            if c is None:
-                break
+        for c in ([all_] if all_ is not None else []):
             for row in c.iter_rows():
                 key = gkey(row[:ngroup])
                 st = groups.get(key)
@@ -1892,6 +1903,118 @@ class FinalHashAggExec(Executor):
                 out.columns[i].set_datum(r, d)
             for i, a in enumerate(self.aggs):
                 out.columns[ngroup + i].set_datum(r, self._final_value(a, st[i], self.out_fts[ngroup + i]))
+        return out
+
+    def _merge_vectorized(self, all_: Chunk):
+        """numpy merge of partial rows for the common aggregates — the
+        reference's parallel final workers (aggregate.go:104) compressed
+        into vector ops. None → the generic per-row merge runs (object/
+        unsigned lanes, int64-overflow-risk sums, exotic aggs). This is
+        the host final-merge cliff fix: high-NDV partials no longer grind
+        a Python dict row by row."""
+        if any(
+            c.data.dtype == object or c.data.dtype.kind == "u"
+            for c in all_.columns[len(self.group_by):]
+        ):
+            # string partials need datum semantics; uint64 values >= 2^63
+            # would wrap under the int64 accumulators
+            return None
+        from ..copr.host_engine import _group_codes_masked
+        from ..expr.expression import collation_key_lane
+
+        ngroup = len(self.group_by)
+        n = all_.num_rows
+        for c in all_.columns[ngroup:]:
+            if c.data.dtype.kind == "i" and len(c.data):
+                mx = int(np.abs(np.where(c.valid, c.data, 0)).max())
+                if mx and n > (1 << 62) // mx:
+                    return None  # summing could overflow int64: Dec path
+        if ngroup:
+            keyvals = [
+                (collation_key_lane(all_.columns[i].data, g.ret_type), all_.columns[i].valid)
+                for i, g in enumerate(self.group_by)
+            ]
+            inv, first_row, G = _group_codes_masked(keyvals, np.ones(n, dtype=bool))
+        else:
+            inv = np.zeros(n, dtype=np.int64)
+            first_row = np.zeros(1, dtype=np.int64)
+            G = 1
+        tracker = _ACTIVE_TRACKER.get()
+        sess = _ACTIVE_SESSION.get()
+        if tracker is not None and (
+            sess is None or sess.vars.get("tidb_track_aggregate_memory_usage", "ON") == "ON"
+        ):
+            # same contract as the generic path: the group table is the
+            # working set (may raise MemoryQuotaExceeded)
+            tracker.consume(G * (64 + 32 * len(self.aggs)))
+        out = Chunk.empty(self.out_fts, G)
+        for i in range(ngroup):
+            src = all_.columns[i]
+            out.columns[i] = Column(self.out_fts[i], src.data[first_row], src.valid[first_row])
+        pos = ngroup
+        oi = ngroup
+        for a in self.aggs:
+            ft = self.out_fts[oi]
+            if a.name == "count":
+                cc = all_.columns[pos]
+                cnt = np.zeros(G, dtype=np.int64)
+                np.add.at(cnt, inv, np.where(cc.valid, cc.data.astype(np.int64), 0))
+                out.columns[oi] = Column(ft, cnt, np.ones(G, bool))
+                pos += 1
+                oi += 1
+                continue
+            sd, sv = all_.columns[pos].data, all_.columns[pos].valid
+            hasc = np.zeros(G, dtype=np.int64)
+            np.add.at(hasc, inv, sv.astype(np.int64))
+            has = hasc > 0
+            if a.name in ("sum", "avg"):
+                if sd.dtype.kind == "f":
+                    acc = np.zeros(G, dtype=np.float64)
+                    np.add.at(acc, inv, np.where(sv, sd, 0.0))
+                else:
+                    acc = np.zeros(G, dtype=np.int64)
+                    np.add.at(acc, inv, np.where(sv, sd.astype(np.int64), 0))
+                if a.name == "sum":
+                    out.columns[oi] = Column(ft, acc, has)
+                    oi += 1
+                    pos += 1
+                else:  # avg: (sum, count) lanes, vectorized finalize
+                    cc = all_.columns[pos + 1]
+                    cnt = np.zeros(G, dtype=np.int64)
+                    np.add.at(cnt, inv, np.where(cc.valid, cc.data.astype(np.int64), 0))
+                    ok = has & (cnt > 0)
+                    if ft.is_float():
+                        data = np.where(ok, acc / np.maximum(cnt, 1), 0.0)
+                        out.columns[oi] = Column(ft, data, ok)
+                    else:
+                        # exact decimal AVG over scaled ints (the window
+                        # kernel's _avg_dec_finish replicates Dec.div +
+                        # rescale, incl. the double rounding)
+                        from .window_device import _avg_dec_finish
+
+                        sum_scale = max(a.partial_final_types()[0][1].decimal, 0)
+                        qs, valid2 = _avg_dec_finish(
+                            np.where(ok, acc, 0), np.maximum(cnt, 1),
+                            sum_scale, max(ft.decimal, 0),
+                        )
+                        out.columns[oi] = Column(ft, qs, ok & valid2)
+                    oi += 1
+                    pos += 2
+            else:  # min / max: single value lane
+                if sd.dtype.kind == "f":
+                    neutral = np.inf if a.name == "min" else -np.inf
+                    acc = np.full(G, neutral, dtype=np.float64)
+                    vals = np.where(sv, sd, neutral)
+                else:
+                    info = np.iinfo(np.int64)
+                    neutral = info.max if a.name == "min" else info.min
+                    acc = np.full(G, neutral, dtype=np.int64)
+                    vals = np.where(sv, sd.astype(np.int64), neutral)
+                (np.minimum if a.name == "min" else np.maximum).at(acc, inv, vals)
+                data = np.where(has, acc, 0)
+                out.columns[oi] = Column(ft, data.astype(np.float64) if ft.is_float() else data, has)
+                oi += 1
+                pos += 1
         return out
 
     def _merge_row(self, st, partials):
