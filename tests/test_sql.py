@@ -421,3 +421,43 @@ class TestInsertOnDupAndAdmin:
         s.execute("UPDATE cs SET v = 9 WHERE id = 1")
         r2 = s.must_query("ADMIN CHECKSUM TABLE cs")
         assert r1[0][2] != r2[0][2]  # checksum changes with data
+
+
+def test_bulk_insert_batched_allocation_and_first_liid():
+    """Round 5: multi-row INSERT allocates ids in ONE meta txn (not one
+    per row) and LAST_INSERT_ID() reports the FIRST generated id (MySQL
+    multi-row rule)."""
+    from tidb_tpu.session import Session
+
+    s = Session()
+    s.execute("CREATE TABLE bk (a BIGINT, b BIGINT)")
+    rows = ",".join(f"({i}, {i})" for i in range(5000))
+    calls = []
+    orig = type(s).alloc_auto_id
+
+    def spy(self, info, n):
+        calls.append(n)
+        return orig(self, info, n)
+
+    type(s).alloc_auto_id = spy
+    try:
+        s.execute(f"INSERT INTO bk VALUES {rows}")
+    finally:
+        type(s).alloc_auto_id = orig
+    assert calls == [5000], f"expected ONE batched allocation, got {calls[:5]}..."
+    assert s.must_query("SELECT COUNT(*) FROM bk") == [("5000",)]
+    s.execute("CREATE TABLE li2 (id BIGINT PRIMARY KEY AUTO_INCREMENT, v INT)")
+    s.execute("INSERT INTO li2 (v) VALUES (7),(8),(9)")
+    assert s.last_insert_id == 1
+    assert s.must_query("SELECT LAST_INSERT_ID()") == [("1",)]
+    # explicit values rebase the allocator: no collision with later NULLs
+    s.execute("CREATE TABLE rb (id BIGINT PRIMARY KEY AUTO_INCREMENT)")
+    s.execute("INSERT INTO rb VALUES (NULL),(2),(NULL)")
+    ids = sorted(int(r[0]) for r in s.must_query("SELECT id FROM rb"))
+    assert len(set(ids)) == 3, ids
+    # IGNOREd rows never become LAST_INSERT_ID
+    s.execute("CREATE TABLE ig (id BIGINT PRIMARY KEY AUTO_INCREMENT, u INT UNIQUE)")
+    s.execute("INSERT INTO ig (u) VALUES (5)")
+    prev = s.last_insert_id
+    s.execute("INSERT IGNORE INTO ig (u) VALUES (5)")
+    assert s.last_insert_id == prev  # all rows ignored: unchanged
