@@ -107,6 +107,7 @@ class _Level:
         self.r_post: list[Expression] = []
         self.mult = 1  # 1 = unique build keys, 2 = compact dup path
         self.expected_out: int | None = None  # exact pre-filter join card
+        self.key_i32 = False  # packed key domain fits int32 sort lanes
 
 
 class MPPEngine:
@@ -296,6 +297,9 @@ class MPPEngine:
                     self.last_fallback_reason = "join key domain overflow"
                     return False
             lvl = _Level(frag, los, strides)
+            # packed keys < acc: int32 sort operands when they fit (TPU
+            # sorts/gathers run ~2x faster on 32-bit lanes)
+            lvl.key_i32 = acc < (1 << 31) - 2
             # build-side key multiplicity, measured on the UNFILTERED lane
             # (a safe upper bound: pushed filters only shrink groups).
             # Unique keys (FK/PK joins) probe 1:1; duplicated build keys
@@ -675,7 +679,7 @@ class MPPEngine:
                 lvl.frag.kind, lvl.frag.exchange,
                 repr(lvl.frag.probe_keys), repr(lvl.frag.build_keys),
                 repr(lvl.key_lo), repr(lvl.key_stride), repr(lvl.r_post),
-                str(lvl.mult), str(lvl.expected_out),
+                str(lvl.mult), str(lvl.expected_out), str(lvl.key_i32),
             ]
         if meta["agg"]:
             a = meta["agg"]
@@ -743,6 +747,8 @@ class MPPEngine:
                 term = (d.astype(jnp.int64) - lo) * st
                 acc = term if acc is None else acc + term
                 kv = v if kv is None else (kv & v)
+            if lvl.key_i32:
+                acc = acc.astype(jnp.int32)  # domain-checked on host
             return acc, kv
 
         drop_acc: list = []  # per-exchange local drop counts (psum'd at end)
@@ -815,8 +821,11 @@ class MPPEngine:
                 bkey, bkv = pack_keys(bmap, frag.build_keys, lvl)
             bvalid = bmask & bkv
             B = bkey.shape[0]
-            order = jnp.argsort(jnp.where(bvalid, bkey, I64_MAX))
-            sk = jnp.where(bvalid, bkey, I64_MAX)[order]
+            key_max = (
+                jnp.asarray((1 << 31) - 1, jnp.int32) if lvl.key_i32 else I64_MAX
+            )
+            order = jnp.argsort(jnp.where(bvalid, bkey, key_max))
+            sk = jnp.where(bvalid, bkey, key_max)[order]
             sv = bvalid[order]
             M = lvl.mult
             if M == 1:

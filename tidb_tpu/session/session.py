@@ -2034,23 +2034,30 @@ class Session:
         self._liid_locked = False
         for datums in all_datums:
             a, d = self._insert_row(tbl, txn, datums, stmt, on_dup_cache,
-                                    alloc=alloc, inc=inc, aoff=aoff)
+                                    alloc=alloc, inc=inc, aoff=aoff, auto_col=auto_col)
             affected += a
             delta += d
         self._invalidate_tiles(info)
         self._note_delta(info.id, affected, delta)
         return ResultSet([], None, affected=affected, last_insert_id=self.last_insert_id)
 
+    def _note_liid(self, gen_id) -> None:
+        """Record the statement's FIRST landed auto id (MySQL rule)."""
+        if gen_id is not None and not getattr(self, "_liid_locked", False):
+            self.last_insert_id = gen_id
+            self._liid_locked = True
+
     def _insert_row(self, tbl: Table, txn, datums: list[Datum], stmt, on_dup_cache: dict,
-                    alloc=None, inc: int = 1, aoff: int = 1) -> tuple[int, int]:
+                    alloc=None, inc: int = 1, aoff: int = 1, auto_col=None) -> tuple[int, int]:
         """Insert one row; returns (affected_rows, net_row_delta). `alloc`
         is a statement-level pre-allocated id iterator (one meta txn per
-        STATEMENT, not per row); inc/aoff come from the statement too."""
+        STATEMENT, not per row); inc/aoff/auto_col come from the statement."""
         info = tbl.info
         # handle: clustered int pk or auto rowid
         handle = None
         gen_id = None  # generated auto id — reported only if the row lands
-        auto_col = next((c for c in info.columns if c.auto_increment), None)
+        if auto_col is None:
+            auto_col = next((c for c in info.columns if c.auto_increment), None)
         if auto_col is not None and datums[auto_col.offset].is_null:
             if inc > 1 or aoff > 1:
                 v = self._alloc_auto_series(info, inc, aoff)
@@ -2086,6 +2093,7 @@ class Session:
                         tbl.remove_record(txn, h, old)
                         removed += 1
                 tbl.add_record(txn, datums, handle, check_dup=False)
+                self._note_liid(gen_id)  # REPLACE inserted the row
                 return 1 + len(conflicts), 1 - removed
             if getattr(stmt, "ignore", False):
                 return 0, 0
@@ -2093,9 +2101,7 @@ class Session:
         tbl.add_record(txn, datums, handle)
         # MySQL: LAST_INSERT_ID() is the FIRST id generated for a row
         # that was actually INSERTED (IGNOREd rows don't count)
-        if gen_id is not None and not getattr(self, "_liid_locked", False):
-            self.last_insert_id = gen_id
-            self._liid_locked = True
+        self._note_liid(gen_id)
         return 1, 1
 
     def _lock_insert_keys(self, tbl: Table, txn, rows: list[list[Datum]]) -> None:
