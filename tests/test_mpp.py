@@ -251,3 +251,35 @@ class TestLaneCacheSnapshot:
         sess.vars["tidb_allow_mpp"] = "ON"
         sess.vars["tidb_cop_engine"] = "auto"
         assert after == host
+
+
+class TestSortedTopKAgg:
+    def test_wide_key_sorted_agg_with_fused_topk_on_mesh(self):
+        """Round 5: wide group-key domains + ORDER BY <agg> LIMIT k take
+        the sorted device-agg mode (lexsort + segment reduce + hash
+        exchange + per-device top-k) — asserted via the finalize path,
+        with exact host parity on the 8-device mesh."""
+        from tidb_tpu.models import tpch
+        from tidb_tpu.parallel.mpp import MPPEngine
+
+        s = Session()
+        tpch.setup_tpch(s, 60_000)
+        calls = {"topk": 0}
+        orig = MPPEngine._finalize_topk
+
+        def spy(self, *a, **k):
+            calls["topk"] += 1
+            return orig(self, *a, **k)
+
+        MPPEngine._finalize_topk = spy
+        try:
+            s.vars["tidb_allow_mpp"] = "ON"
+            mpp = s.must_query(tpch.Q3)
+            assert calls["topk"] == 1, "sorted top-k mode did not run"
+            assert s.cop.mpp.fallbacks == 0, s.cop.mpp.last_fallback_reason
+            s.vars["tidb_allow_mpp"] = "OFF"
+            s.vars["tidb_cop_engine"] = "host"
+            host = s.must_query(tpch.Q3)
+        finally:
+            MPPEngine._finalize_topk = orig
+        assert mpp == host and len(mpp) == 10
