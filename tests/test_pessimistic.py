@@ -80,7 +80,10 @@ class TestPessimisticDML:
         for t in ts:
             t.start()
         for t in ts:
-            t.join(timeout=60)
+            t.join(timeout=240)
+        # join(timeout) returns silently with the thread STILL RUNNING —
+        # reading SUM mid-transfer then flakes under CPU-starved suites
+        assert not any(t.is_alive() for t in ts), "transfers did not finish"
         assert not errors, errors
         assert s.must_query("SELECT SUM(bal) FROM acct") == [("300",)]
 

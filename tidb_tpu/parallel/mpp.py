@@ -222,11 +222,99 @@ class MPPEngine:
 
     # ------------------------------------------------------------ planning
 
+    @staticmethod
+    def _restream_largest(mplan: MPPPlan, by_frag: dict) -> None:
+        """Rotate an all-inner left-deep fragment chain so the LARGEST
+        scan is the sharded probe stream (ref: TiFlash picks the fact
+        side as the MPP stream; exhaust_physical_plans.go build-side
+        choice). Dimension tables then sit on the build side where their
+        keys are usually unique — the 1:1 searchsorted probe instead of
+        the compact duplicate-key path. Pure fragment-tree rewrite: the
+        joined-schema side_offsets (lanemap keys, agg/post-cond indices)
+        are per-scan and unchanged; the host plan is untouched."""
+        levels = []
+        f = mplan.root
+        while isinstance(f, JoinFrag):
+            if f.kind != "inner":
+                return
+            levels.append(f)
+            f = f.probe
+        if not isinstance(f, ScanFrag) or len(levels) < 2:
+            return
+        chain_scans = [f] + [lv.build for lv in reversed(levels)]
+
+        def owner(j):
+            for s in chain_scans:
+                if s.side_offset <= j < s.side_offset + s.n_cols:
+                    return s
+            return None
+
+        pairs = []
+        for lv in levels:
+            for pk, bk in zip(lv.probe_keys, lv.build_keys):
+                if owner(pk) is None or owner(bk) is None:
+                    return
+                pairs.append((pk, bk))
+        all_post = [c for lv in levels for c in lv.post_conds]
+        stream = max(chain_scans, key=lambda s: by_frag[id(s)].n_rows)
+        if stream is f:
+            return  # already streaming the largest
+        remaining_pairs = list(pairs)
+        used = {id(stream)}
+        node = stream
+        remaining = [s for s in chain_scans if s is not stream]
+        pending_post = list(all_post)
+
+        def attachable(cond):
+            refs: set = set()
+            cond.collect_columns(refs)
+            return all(id(owner(j)) in used for j in refs if owner(j) is not None)
+
+        while remaining:
+            attached = None
+            for s in remaining:
+                link = []
+                for a, b in remaining_pairs:
+                    oa, ob = owner(a), owner(b)
+                    if oa is s and id(ob) in used:
+                        link.append((b, a))  # (probe side, build side)
+                    elif ob is s and id(oa) in used:
+                        link.append((a, b))
+                if link:
+                    attached = s
+                    for pkk, bkk in link:
+                        for p in list(remaining_pairs):
+                            if p in ((pkk, bkk), (bkk, pkk)):
+                                remaining_pairs.remove(p)
+                                break
+                    node = JoinFrag(
+                        node, s, "inner",
+                        [p for p, _ in link], [b for _, b in link],
+                    )
+                    used.add(id(s))
+                    remaining.remove(s)
+                    # inner-join filters commute: attach each residual
+                    # cond at the EARLIEST level with all its columns, so
+                    # selective filters still prune before later
+                    # exchanges (review: hoisting everything to the root
+                    # fed unfiltered rows through exchange buckets)
+                    here = [c for c in pending_post if attachable(c)]
+                    if here:
+                        node.post_conds = here
+                        pending_post = [c for c in pending_post if c not in here]
+                    break
+            if attached is None:
+                return  # not a connected chain under this rotation: keep
+        if remaining_pairs or pending_post:
+            return  # something didn't map onto the rotated tree: keep
+        mplan.root = node
+
     def prepare(self, mplan: MPPPlan, scans: list[ScanData], variables: dict):
         """Resolve all data-dependent static choices; None → fallback."""
         from ..copr.tpu_engine import TPUEngine
 
         by_frag = {id(s.frag): s for s in scans}
+        self._restream_largest(mplan, by_frag)
         scan_of_joined = {}  # joined idx -> (ScanData, local off)
         for s in scans:
             for off in range(len(s.frag.ds.out_cols)):
