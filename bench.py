@@ -21,8 +21,10 @@ Workloads (BASELINE.md §Baseline procedure):
   window SUM() OVER (PARTITION BY ... ORDER BY ...)    (BENCH_WIN_ROWS, 8M)
   p50    one-cop-task small scan latency, both engines (1M-row table)
 
+  sched  64-way concurrent point-agg launch batching  (tools/bench_sched.py)
+
 Env knobs: BENCH_ROWS / BENCH_Q3_ROWS / BENCH_WIN_ROWS, BENCH_REPS,
-BENCH_QUERY (all|q1|q6|topn|q3|window|p50 — default all).
+BENCH_QUERY (all|q1|q6|topn|q3|window|p50|sched — default all).
 Per-dispatch tunnel round-trip is ~100ms fixed (measured; see
 dispatch_overhead_ms), so throughput workloads run at row counts that
 amortize it.
@@ -249,6 +251,13 @@ def main():
         if which in ("all", "q1"):
             q1_line = _throughput(s, tpch.Q1, rows, reps, host_reps, "tpch_q1")
             q1_line["metric"] = "tpch_q1_rows_per_sec"
+
+    # -- cross-session launch batching (sched/batcher.py) -----------------
+    if which in ("all", "sched"):
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from bench_sched import run_sched_bench
+
+        out.append(run_sched_bench())
 
     # -- q3 through the mesh MPP path -------------------------------------
     if which in ("all", "q3"):

@@ -18,4 +18,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # only exists on newer JAX; older releases (e.g. 0.4.37) get the
+    # device count from the XLA flag set above
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass

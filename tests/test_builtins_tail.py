@@ -187,13 +187,18 @@ class TestAES:
 
     def test_spec_vector(self, s):
         """aes-128-ecb + XOR key folding + PKCS7 computed independently."""
-        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+        try:
+            from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
 
-        key = bytearray(16)
-        for i, b in enumerate(b"password"):
-            key[i % 16] ^= b
-        enc = Cipher(algorithms.AES(bytes(key)), modes.ECB()).encryptor()
-        want = (enc.update(b"text" + bytes([12]) * 12) + enc.finalize()).hex().upper()
+            key = bytearray(16)
+            for i, b in enumerate(b"password"):
+                key[i % 16] ^= b
+            enc = Cipher(algorithms.AES(bytes(key)), modes.ECB()).encryptor()
+            want = (enc.update(b"text" + bytes([12]) * 12) + enc.finalize()).hex().upper()
+        except ImportError:
+            # same vector precomputed with `openssl enc -aes-128-ecb -nopad
+            # -K 70617373776f72640000000000000000` over b"text" + b"\x0c"*12
+            want = "F6BD0FA8DCB7F8CD4A2FAABC54668044"
         got = s.execute("select hex(aes_encrypt('text', 'password'))").rows()[0][0]
         assert got == want
 

@@ -106,9 +106,9 @@ class TestParallelDispatch:
         seen = set()
         orig = s.cop._run_engines
 
-        def spy(dag, batch, engine):
+        def spy(dag, batch, engine, **kw):
             seen.add(threading.current_thread().name)
-            return orig(dag, batch, engine)
+            return orig(dag, batch, engine, **kw)
 
         s.cop._run_engines = spy
         total = s.must_query("SELECT SUM(v) FROM t")

@@ -1,0 +1,479 @@
+"""Resource control (sched/): resource-group DDL + binding, admission
+fairness/deadlines/backpressure, and cross-session launch-batcher
+correctness (ref: the reference's resource groups + unified read pool;
+arXiv:2203.01877 §4.2 for the launch-amortization move)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import (
+    QueryInterrupted,
+    ResourceGroupExists,
+    ResourceGroupNotExists,
+    ResourceGroupQueueFull,
+)
+from tidb_tpu.sched import AdmissionScheduler, SchedCtx
+from tidb_tpu.session import Session
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, v INT)")
+    sess.execute(
+        "INSERT INTO t VALUES " + ",".join(f"({i}, {i % 7}, {i * 3})" for i in range(4096))
+    )
+    return sess
+
+
+class TestResourceGroupDDL:
+    def test_create_show_alter_drop(self, s):
+        s.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 1000 PRIORITY = HIGH")
+        rows = s.must_query("SHOW RESOURCE GROUPS")
+        assert ("RG1", "1000", "HIGH", "NO") in rows
+        assert ("DEFAULT", "UNLIMITED", "MEDIUM", "YES") in rows
+        s.execute("ALTER RESOURCE GROUP rg1 RU_PER_SEC = 500, PRIORITY = LOW, BURSTABLE")
+        rows = s.must_query("SHOW RESOURCE GROUPS")
+        assert ("RG1", "500", "LOW", "YES") in rows
+        s.execute("DROP RESOURCE GROUP rg1")
+        assert ("RG1", "500", "LOW", "YES") not in s.must_query("SHOW RESOURCE GROUPS")
+
+    def test_duplicate_and_missing_errors(self, s):
+        s.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 10")
+        with pytest.raises(ResourceGroupExists):
+            s.execute("CREATE RESOURCE GROUP rg1")
+        s.execute("CREATE RESOURCE GROUP IF NOT EXISTS rg1 RU_PER_SEC = 99")
+        assert ("RG1", "10", "MEDIUM", "NO") in s.must_query("SHOW RESOURCE GROUPS")
+        with pytest.raises(ResourceGroupNotExists):
+            s.execute("ALTER RESOURCE GROUP nope RU_PER_SEC = 1")
+        with pytest.raises(ResourceGroupNotExists):
+            s.execute("DROP RESOURCE GROUP nope")
+        s.execute("DROP RESOURCE GROUP IF EXISTS nope")
+
+    def test_groups_shared_across_sessions(self, s):
+        """DDL is store-wide, like bindinfo: a second session over the
+        same store observes the group without any propagation step."""
+        s.execute("CREATE RESOURCE GROUP shared RU_PER_SEC = 42")
+        other = Session(s.store)
+        assert ("SHARED", "42", "MEDIUM", "NO") in other.must_query("SHOW RESOURCE GROUPS")
+        other.execute("SET RESOURCE GROUP shared")
+        assert other.vars["tidb_resource_group"] == "shared"
+
+    def test_bind_session_group(self, s):
+        s.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 10")
+        s.execute("SET RESOURCE GROUP rg1")
+        assert s.must_query("SELECT @@tidb_resource_group") == [("rg1",)]
+        s.execute("SET tidb_resource_group = 'default'")
+        with pytest.raises(ResourceGroupNotExists):
+            s.execute("SET RESOURCE GROUP nope")
+        with pytest.raises(ResourceGroupNotExists):
+            s.execute("SET tidb_resource_group = 'nope'")
+
+    def test_explain_analyze_shows_sched_line(self, s):
+        s.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 100000")
+        s.execute("SET RESOURCE GROUP rg1")
+        text = "\n".join(
+            r[0] for r in s.must_query("EXPLAIN ANALYZE SELECT g, SUM(v) FROM t GROUP BY g")
+        )
+        assert "sched: group:rg1" in text
+        assert "ru:" in text and "batched:" in text
+
+    def test_burstable_value_forms(self, s):
+        """MySQL-style 0/1 booleans must work; garbage must be a parse
+        error, never a silent burstable=true (which disables the limit)."""
+        s.execute("CREATE RESOURCE GROUP b0 RU_PER_SEC = 10 BURSTABLE = 0")
+        s.execute("CREATE RESOURCE GROUP b1 RU_PER_SEC = 10 BURSTABLE = TRUE")
+        rows = s.must_query("SHOW RESOURCE GROUPS")
+        assert ("B0", "10", "MEDIUM", "NO") in rows
+        assert ("B1", "10", "MEDIUM", "YES") in rows
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("CREATE RESOURCE GROUP bad RU_PER_SEC = 10 BURSTABLE = banana")
+
+    def test_alter_default_group_enforces_ru(self, s):
+        """ALTER ... default RU_PER_SEC must retune the live bucket, not
+        just the SHOW output (silent non-enforcement)."""
+        mgr = s.store.sched.groups
+        try:
+            s.execute("ALTER RESOURCE GROUP default RU_PER_SEC = 100")
+            d = mgr.default
+            assert d.bucket.rate == 100
+            d.bucket.debit(500.0)  # drive it into debt
+            assert not d.bucket.admissible()
+        finally:
+            s.execute("ALTER RESOURCE GROUP default RU_PER_SEC = 0 BURSTABLE")
+            assert mgr.default.bucket.admissible()
+
+    def test_resource_control_toggle_is_global_only(self, s):
+        """A plain session SET must not be able to opt out of admission
+        (the reference keeps this variable GLOBAL-only)."""
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("SET tidb_enable_resource_control = 'OFF'")
+        s.vars["tidb_enable_cop_result_cache"] = "OFF"  # every query must reach the engines
+        s.execute("SET GLOBAL tidb_enable_resource_control = 'OFF'")
+        try:
+            before = s.store.sched.scheduler.queue_depth()  # touch the seam
+            n0 = dict(s.cop.stats)["ru"]
+            s.must_query("SELECT SUM(v) FROM t")
+            assert dict(s.cop.stats)["ru"] == n0, "admission ran while disabled"
+            assert before == 0
+        finally:
+            s.execute("SET GLOBAL tidb_enable_resource_control = 'ON'")
+        n0 = dict(s.cop.stats)["ru"]
+        s.must_query("SELECT SUM(v) FROM t")
+        assert dict(s.cop.stats)["ru"] > n0, "admission did not resume"
+
+    def test_trace_shows_sched_span(self, s):
+        s.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 100000")
+        s.execute("SET RESOURCE GROUP rg1")
+        ops = [r[0] for r in s.must_query("TRACE SELECT g, SUM(v) FROM t GROUP BY g")]
+        span = [op for op in ops if op.startswith("cop.sched[group=rg1")]
+        assert span, f"no sched span in {ops}"
+        assert "ru=" in span[0] and "batched=" in span[0]
+
+
+class TestAdmission:
+    """Unit-level scheduler semantics over a real store-backed group table."""
+
+    def _sched(self, s, max_conc=1):
+        return AdmissionScheduler(s.store.sched.groups, max_concurrency=max_conc)
+
+    def test_high_priority_admitted_before_low(self, s):
+        s.execute("CREATE RESOURCE GROUP lo PRIORITY = LOW")
+        s.execute("CREATE RESOURCE GROUP hi PRIORITY = HIGH")
+        sched = self._sched(s)
+        blocker = sched.acquire(SchedCtx())
+        order, threads = [], []
+
+        def worker(group):
+            t = sched.acquire(SchedCtx(group=group))
+            order.append(group)
+            sched.release(t)
+
+        for _ in range(4):
+            th = threading.Thread(target=worker, args=("lo",))
+            th.start()
+            threads.append(th)
+        while sched.queue_depth() < 4:
+            time.sleep(0.005)
+        th = threading.Thread(target=worker, args=("hi",))
+        th.start()
+        threads.append(th)
+        while sched.queue_depth() < 5:
+            time.sleep(0.005)
+        sched.release(blocker)
+        for th in threads:
+            th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads)
+        # the late-arriving HIGH task overtakes every queued LOW task
+        assert order[0] == "hi"
+
+    def test_low_cannot_starve_high_under_churn(self, s):
+        """Sustained LOW arrivals must not push an already-queued HIGH
+        task back (starvation): HIGH completes while LOWs keep coming."""
+        s.execute("CREATE RESOURCE GROUP lo PRIORITY = LOW")
+        s.execute("CREATE RESOURCE GROUP hi PRIORITY = HIGH")
+        sched = self._sched(s)
+        blocker = sched.acquire(SchedCtx())
+        done = threading.Event()
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                t = sched.acquire(SchedCtx(group="lo"))
+                time.sleep(0.002)
+                sched.release(t)
+
+        def high():
+            t = sched.acquire(SchedCtx(group="hi", deadline=time.monotonic() + 20))
+            sched.release(t)
+            done.set()
+
+        churners = [threading.Thread(target=churn, daemon=True) for _ in range(3)]
+        for th in churners:
+            th.start()
+        hi_th = threading.Thread(target=high)
+        hi_th.start()
+        while sched.queue_depth() < 1:
+            time.sleep(0.005)
+        sched.release(blocker)
+        assert done.wait(10), "HIGH task starved behind LOW churn"
+        stop.set()
+        hi_th.join(timeout=10)
+
+    def test_deadline_expiry_is_mysql_timeout(self, s):
+        sched = self._sched(s)
+        blocker = sched.acquire(SchedCtx())
+        t0 = time.monotonic()
+        with pytest.raises(QueryInterrupted, match="maximum statement execution time"):
+            sched.acquire(SchedCtx(deadline=time.monotonic() + 0.15))
+        assert time.monotonic() - t0 < 5.0
+        sched.release(blocker)
+        # the slot is intact after the timeout: next acquire is immediate
+        sched.release(sched.acquire(SchedCtx()))
+
+    def test_kill_while_queued(self, s):
+        class _Sess:
+            _killed = True
+
+        sched = self._sched(s)
+        blocker = sched.acquire(SchedCtx())
+        with pytest.raises(QueryInterrupted, match="interrupted"):
+            sched.acquire(SchedCtx(session=_Sess()))
+        sched.release(blocker)
+
+    def test_queue_full_rejects_not_blocks(self, s):
+        sched = self._sched(s)
+        sched.MAX_QUEUE = 2
+        blocker = sched.acquire(SchedCtx())
+        threads = []
+
+        def waiter():
+            sched.release(sched.acquire(SchedCtx()))
+
+        for _ in range(2):
+            th = threading.Thread(target=waiter)
+            th.start()
+            threads.append(th)
+        while sched.queue_depth() < 2:
+            time.sleep(0.005)
+        with pytest.raises(ResourceGroupQueueFull):
+            sched.acquire(SchedCtx())
+        sched.release(blocker)
+        for th in threads:
+            th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads)
+
+    def test_ru_debt_throttles_group(self, s):
+        """Settling a cost far above the estimate leaves the bucket in
+        debt; the group waits for refill while other groups pass."""
+        s.execute("CREATE RESOURCE GROUP tiny RU_PER_SEC = 40")
+        sched = self._sched(s, max_conc=4)
+        t = sched.acquire(SchedCtx(group="tiny"))
+        sched.release(t, ru=60.0)  # ~ -20 tokens → ~0.5s of refill debt
+        with pytest.raises(QueryInterrupted):
+            sched.acquire(SchedCtx(group="tiny", deadline=time.monotonic() + 0.12))
+        # the default group is unaffected by tiny's debt
+        sched.release(sched.acquire(SchedCtx()))
+
+    def test_failpoint_stall_backpressure_not_deadlock(self, s):
+        """An injected engine stall holds device slots; excess arrivals
+        must hard-fail with the queue-full error (backpressure), and the
+        stalled tasks must still complete (no deadlock)."""
+        ctl = s.store.sched
+        old_conc, old_q = ctl.scheduler.max_concurrency, ctl.scheduler.MAX_QUEUE
+        ctl.scheduler.max_concurrency = 1
+        ctl.scheduler.MAX_QUEUE = 1
+        sessions = [Session(s.store) for _ in range(4)]
+        oks, rejected = [], []
+
+        def run(sess):
+            try:
+                r = sess.must_query("SELECT SUM(v) FROM t")
+                oks.append(r)
+            except ResourceGroupQueueFull:
+                rejected.append(1)
+
+        try:
+            with FP.enabled("sched/engine-stall", ("sleep", 1.5)):
+                threads = []
+                for sess in sessions:
+                    th = threading.Thread(target=run, args=(sess,))
+                    th.start()
+                    threads.append(th)
+                    time.sleep(0.05)  # deterministic arrival order
+                for th in threads:
+                    th.join(timeout=60)
+            assert not any(th.is_alive() for th in threads), "scheduler deadlocked"
+            assert rejected, "overload never hit the backpressure edge"
+            assert len(oks) >= 2  # the running + queued tasks completed
+            for r in oks:
+                assert r == oks[0]
+        finally:
+            ctl.scheduler.max_concurrency = old_conc
+            ctl.scheduler.MAX_QUEUE = old_q
+
+
+def _chunks_equal(a, b) -> bool:
+    if a.num_cols != b.num_cols or a.num_rows != b.num_rows:
+        return False
+    for ca, cb in zip(a.columns, b.columns):
+        if not (np.array_equal(ca.data, cb.data) and np.array_equal(ca.valid, cb.valid)):
+            return False
+    return True
+
+
+class TestLaunchBatcher:
+    def _pairs(self, s, queries):
+        """Capture the (dag, batch) pairs a set of queries pushes through
+        the batcher — the exact per-task device work to replay."""
+        ctl = s.store.sched
+        pairs = []
+        real = ctl.batcher.execute
+
+        def capture(engine, dag, batch, dedup_key=None, stats=None):
+            pairs.append((dag, batch))
+            return real(engine, dag, batch, dedup_key=dedup_key, stats=stats)
+
+        ctl.batcher.execute = capture
+        try:
+            for q in queries:
+                s.must_query(q)
+        finally:
+            ctl.batcher.execute = real
+        assert pairs, "queries never reached the device path"
+        return pairs
+
+    def test_coalesced_results_bit_identical_to_serial(self, s):
+        ctl = s.store.sched
+        eng = ctl.tpu_engine
+        pairs = self._pairs(s, [
+            "SELECT g, SUM(v), MIN(v), MAX(v), COUNT(*) FROM t GROUP BY g",
+            "SELECT COUNT(*) FROM t WHERE v > 600",
+        ])
+        serial = [eng.execute(dag, batch) for dag, batch in pairs]
+
+        reps = 3
+        jobs = [(i, pairs[i % len(pairs)]) for i in range(len(pairs) * reps)]
+        results: dict = {}
+        barrier = threading.Barrier(len(jobs))
+
+        def run(i, dag, batch):
+            barrier.wait()
+            results[i] = ctl.batcher.execute(eng, dag, batch)
+
+        threads = [
+            threading.Thread(target=run, args=(i, dag, batch)) for i, (dag, batch) in jobs
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not any(th.is_alive() for th in threads)
+        for i, _ in jobs:
+            assert _chunks_equal(results[i], serial[i % len(pairs)]), (
+                f"job {i}: coalesced chunk differs from serial execution"
+            )
+
+    def test_coalescing_actually_happens(self, s):
+        """Compatible concurrent launches share a group: the occupancy
+        histogram must record a multi-task launch, not just solos."""
+        from tidb_tpu.utils import metrics as M
+
+        ctl = s.store.sched
+        eng = ctl.tpu_engine
+        (dag, batch) = self._pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        for _ in range(5):  # barrier makes coalescing near-certain; retry races
+            n0, sum0 = M.SCHED_BATCH_OCCUPANCY._n, M.SCHED_BATCH_OCCUPANCY._sum
+            barrier = threading.Barrier(4)
+
+            def run():
+                barrier.wait()
+                ctl.batcher.execute(eng, dag, batch)
+
+            threads = [threading.Thread(target=run) for _ in range(4)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=60)
+            groups = M.SCHED_BATCH_OCCUPANCY._n - n0
+            occupants = M.SCHED_BATCH_OCCUPANCY._sum - sum0
+            if groups and occupants > groups:
+                return  # some launch carried >1 task
+        pytest.fail("no multi-task launch group formed in 5 attempts")
+
+    def test_failed_launch_releases_followers_with_error(self, s):
+        """A failure before the group even launches (armed failpoint) must
+        raise in EVERY member promptly — no stranded follower waiting out
+        the 120s valve, no silent None result."""
+        ctl = s.store.sched
+        eng = ctl.tpu_engine
+        (dag, batch) = self._pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        outcomes: dict = {}
+        barrier = threading.Barrier(4)
+
+        def run(i):
+            barrier.wait()
+            try:
+                outcomes[i] = ("ok", ctl.batcher.execute(eng, dag, batch))
+            except Exception as e:  # noqa: BLE001
+                outcomes[i] = ("err", e)
+
+        with FP.enabled("sched/before-launch", RuntimeError("boom")):
+            threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+            t0 = time.monotonic()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads), "follower stranded"
+        assert time.monotonic() - t0 < 30
+        for i, (kind, val) in outcomes.items():
+            if kind == "ok":
+                assert val is not None, f"member {i} got a None chunk"
+            else:
+                assert isinstance(val, RuntimeError), val
+
+    def test_snapshot_dedup_shares_one_execution(self, s):
+        """Tasks with the same dedup identity (digest, table version,
+        span) run ONCE; followers get the leader's chunk."""
+        ctl = s.store.sched
+        eng = ctl.tpu_engine
+        (dag, batch) = self._pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        stats: dict = {}
+
+        def bump(key, n=1):
+            stats[key] = stats.get(key, 0) + n
+
+        for _ in range(5):
+            stats.clear()
+            barrier = threading.Barrier(3)
+            results = []
+
+            def run():
+                barrier.wait()
+                results.append(
+                    ctl.batcher.execute(eng, dag, batch, dedup_key=("k", 1), stats=bump)
+                )
+
+            threads = [threading.Thread(target=run) for _ in range(3)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=60)
+            if stats.get("dedup_tasks"):
+                assert all(_chunks_equal(r, results[0]) for r in results)
+                return
+        pytest.fail("dedup never triggered in 5 attempts")
+
+    def test_cross_session_same_query_consistent(self, s):
+        """End-to-end: concurrent identical queries from separate sessions
+        over one store return exactly the serial answer."""
+        expect = s.must_query("SELECT g, SUM(v), COUNT(*) FROM t GROUP BY g ORDER BY g")
+        sessions = [Session(s.store) for _ in range(6)]
+        out, threads = [], []
+
+        def run(sess):
+            out.append(sess.must_query("SELECT g, SUM(v), COUNT(*) FROM t GROUP BY g ORDER BY g"))
+
+        for sess in sessions:
+            th = threading.Thread(target=run, args=(sess,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=120)
+        assert not any(th.is_alive() for th in threads)
+        assert len(out) == 6 and all(r == expect for r in out)

@@ -29,6 +29,7 @@ P_JOB = b"m:job:"  # queued/running DDL jobs (ref: meta job queues, ddl_worker.g
 P_JOB_HIST = b"m:jobh:"  # finished jobs (ADMIN SHOW DDL JOBS)
 P_SEQ = b"m:seq:"  # sequences (ref: ddl sequence objects, meta/autoid SequenceAllocator)
 P_VIEW = b"m:view:"  # view definitions (stored SELECT text)
+P_RG = b"m:rg:"  # resource groups (ref: meta.go ResourceGroup key space, DDL-managed)
 
 
 class Meta:
@@ -127,6 +128,26 @@ class Meta:
 
     def list_views(self) -> list[dict]:
         return [json.loads(v) for _, v in self.txn.scan(P_VIEW, P_VIEW + b"\xff")]
+
+    # --- resource groups (ref: meta.go CreateResourceGroup; stored as the
+    # group's keepalive-free spec dict, cached by sched.ResourceGroupManager) -
+
+    @staticmethod
+    def _rg_key(name: str) -> bytes:
+        return P_RG + name.lower().encode()
+
+    def resource_group(self, name: str) -> dict | None:
+        raw = self.txn.get(self._rg_key(name))
+        return json.loads(raw) if raw else None
+
+    def put_resource_group(self, d: dict) -> None:
+        self.txn.put(self._rg_key(d["name"]), json.dumps(d).encode())
+
+    def drop_resource_group(self, name: str) -> None:
+        self.txn.delete(self._rg_key(name))
+
+    def list_resource_groups(self) -> list[dict]:
+        return [json.loads(v) for _, v in self.txn.scan(P_RG, P_RG + b"\xff")]
 
     # --- DDL job queue (ref: ddl.go:535 doDDLJob, meta job lists) ----------
 

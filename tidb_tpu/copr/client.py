@@ -28,6 +28,7 @@ from ..catalog.schema import IndexInfo, TableInfo
 from ..codec import tablecodec
 from ..codec.key import decode_datum_key
 from ..mysqltypes.datum import Datum, K_BYTES
+from ..sched import SchedCtx, ru_cost
 from ..utils.failpoint import inject as _fp
 from .dag import DAGRequest
 from .host_engine import execute_dag_host
@@ -103,6 +104,11 @@ class CopClient:
             "host_tasks": 0,
             "region_errors": 0,
             "fallback_errors": 0,
+            # resource-control counters (EXPLAIN ANALYZE sched line)
+            "sched_wait_ms": 0,
+            "ru": 0,
+            "batched_tasks": 0,
+            "dedup_tasks": 0,
         }
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -118,14 +124,46 @@ class CopClient:
         return self._pool
 
     @property
+    def ctl(self):
+        """The store-wide resource controller (admission + batcher). None
+        only for exotic storages without the `sched` seam."""
+        return getattr(self.storage, "sched", None)
+
+    @property
     def tpu(self):
         if self._tpu is None:
             with self._lock:
                 if self._tpu is None:
-                    from .tpu_engine import TPUEngine
+                    ctl = self.ctl
+                    if ctl is not None:
+                        # ONE engine (and XLA program cache) per store:
+                        # cross-session launches can only coalesce when
+                        # they share compiled programs
+                        self._tpu = ctl.tpu_engine
+                    else:
+                        from .tpu_engine import TPUEngine
 
-                    self._tpu = TPUEngine()
+                        self._tpu = TPUEngine()
         return self._tpu
+
+    def _sched_ctx(self) -> SchedCtx:
+        """Capture admission context ON the session thread (send/send_index/
+        send_handles run there; _run_task may not — contextvars don't cross
+        the cop pool)."""
+        from ..executor.executors import _ACTIVE_SESSION
+
+        sess = _ACTIVE_SESSION.get(None)
+        if sess is None:
+            return SchedCtx()
+        # GLOBAL-only toggle: read the live store value so SET GLOBAL takes
+        # effect for every session immediately, not just newly-seeded ones
+        enabled = sess.store.global_vars.get("tidb_enable_resource_control", "ON")
+        return SchedCtx(
+            group=sess.vars.get("tidb_resource_group", "default") or "default",
+            deadline=getattr(sess, "_deadline", None),
+            session=sess,
+            enabled=enabled == "ON",
+        )
 
     @property
     def mpp(self):
@@ -181,6 +219,7 @@ class CopClient:
             prefix = tablecodec.record_prefix(table.id)
             ranges = [(prefix, prefix + b"\xff")]
         tasks = self.build_tasks(table.id, ranges)
+        sctx = self._sched_ctx()
         dirty = txn is not None and self._txn_dirty(txn, table.id)
         if dirty:
             out = []
@@ -193,17 +232,17 @@ class CopClient:
                 batch = decode_rows_to_batch(table, kvs, (-1, 0))
                 if batch.n_rows == 0:
                     continue
-                out.append(self._run_engines(dag, batch, engine))
+                out.append(self._run_engines(dag, batch, engine, sctx=sctx))
             return out
         if concurrency <= 1 or len(tasks) <= 1:
-            return self._send_serial(table, dag, tasks, read_ts, engine, result_cache)
-        return self._send_parallel(table, dag, tasks, read_ts, engine, concurrency, keep_order, result_cache)
+            return self._send_serial(table, dag, tasks, read_ts, engine, result_cache, sctx)
+        return self._send_parallel(table, dag, tasks, read_ts, engine, concurrency, keep_order, result_cache, sctx)
 
-    def _send_serial(self, table, dag, tasks, read_ts, engine, result_cache=True):
+    def _send_serial(self, table, dag, tasks, read_ts, engine, result_cache=True, sctx=None):
         for t in tasks:
-            yield from self._run_task(table, dag, t, read_ts, engine, cache=result_cache)
+            yield from self._run_task(table, dag, t, read_ts, engine, cache=result_cache, sctx=sctx)
 
-    def _send_parallel(self, table, dag, tasks, read_ts, engine, concurrency, keep_order, result_cache=True):
+    def _send_parallel(self, table, dag, tasks, read_ts, engine, concurrency, keep_order, result_cache=True, sctx=None):
         """Bounded in-flight window (the copIterator concurrency semantic):
         at most `concurrency` tasks run/buffer ahead of the consumer, new
         tasks are submitted as results drain, and abandoning the stream
@@ -215,7 +254,7 @@ class CopClient:
             t = next(it, None)
             if t is not None:
                 futs.append(
-                    self.pool.submit(self._run_task, table, dag, t, read_ts, engine, cache=result_cache)
+                    self.pool.submit(self._run_task, table, dag, t, read_ts, engine, cache=result_cache, sctx=sctx)
                 )
 
         for _ in range(min(concurrency, len(tasks))):
@@ -234,7 +273,7 @@ class CopClient:
             for f in futs:
                 f.cancel()
 
-    def _run_task(self, table, dag, t: CopTask, read_ts, engine, depth: int = 0, cache: bool = True) -> list[Chunk]:
+    def _run_task(self, table, dag, t: CopTask, read_ts, engine, depth: int = 0, cache: bool = True, sctx=None) -> list[Chunk]:
         """Execute one cop task, re-splitting on region epoch change
         (ref: handleCopResponse region-error path, coprocessor.go:1025);
         repeated identical (DAG, range) reads serve from the result cache
@@ -252,7 +291,7 @@ class CopClient:
                 raise RuntimeError(f"cop task {t} exceeded region retry budget")
             out = []
             for sub in self.build_tasks(None, [(t.start, t.end)]):
-                out.extend(self._run_task(table, dag, sub, read_ts, engine, depth + 1, cache=cache))
+                out.extend(self._run_task(table, dag, sub, read_ts, engine, depth + 1, cache=cache, sctx=sctx))
             return out
         ckey = ver = last_commit = None
         if cache:
@@ -264,7 +303,11 @@ class CopClient:
         batch = self.tiles.get_batch(table, t.start, t.end, read_ts)
         if batch.n_rows == 0:
             return []
-        chunk = self._run_engines(dag, batch, engine)
+        # cross-session dedup identity: valid only under the result-cache
+        # snapshot rule (read at/after the last commit of an unchanged
+        # version) — exactly when two tasks with this key see one content
+        dedup = (ckey, ver) if (cache and read_ts >= last_commit) else None
+        chunk = self._run_engines(dag, batch, engine, sctx=sctx, dedup=dedup)
         if cache and read_ts >= last_commit:
             self.results.put(ckey, chunk, ver, last_commit, batch.n_rows)
         return [chunk]
@@ -327,7 +370,8 @@ class CopClient:
         self._ndv_cache[ck] = (est,)
         return est
 
-    def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str) -> Chunk:
+    def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str,
+                     sctx: SchedCtx | None = None, dedup=None) -> Chunk:
         self._bump("tasks")
         if engine == "auto" and batch.n_rows < self.AUTO_MIN_ROWS:
             engine = "host"
@@ -347,21 +391,43 @@ class CopClient:
             est = self._estimate_groups(dag, batch)
             if est is not None and est > self.AUTO_GROUP_MAX:
                 engine = "host"
-        if engine in ("tpu", "auto"):
-            try:
-                chunk = self.tpu.execute(dag, batch)
-                self._bump("tpu_tasks")
-                return chunk
-            except Exception:
-                if engine == "tpu":
-                    raise
-                # a device-path failure must never be silent: it is a
-                # correctness bug masked by the host answer (VERDICT Weak#5)
-                self._bump("fallback_errors")
-                log.exception("TPU engine raised; falling back to host engine")
-        chunk = execute_dag_host(dag, batch)
-        self._bump("host_tasks")
-        return chunk
+        # resource control: every engine run passes the store-wide
+        # admission gate (the unified-read-pool seam); the ticket holds a
+        # device slot + the group's RU estimate until release settles the
+        # measured cost
+        ctl = self.ctl if (sctx is None or sctx.enabled) else None
+        ticket = None
+        if ctl is not None:
+            ticket = ctl.scheduler.acquire(sctx or SchedCtx())
+            if ticket.wait_s:
+                self._bump("sched_wait_ms", ticket.wait_s * 1000.0)
+        try:
+            _fp("sched/engine-stall")
+            if engine in ("tpu", "auto"):
+                try:
+                    if ctl is not None:
+                        chunk = ctl.batcher.execute(
+                            self.tpu, dag, batch, dedup_key=dedup, stats=self._bump
+                        )
+                    else:
+                        chunk = self.tpu.execute(dag, batch)
+                    self._bump("tpu_tasks")
+                    return chunk
+                except Exception:
+                    if engine == "tpu":
+                        raise
+                    # a device-path failure must never be silent: it is a
+                    # correctness bug masked by the host answer (VERDICT Weak#5)
+                    self._bump("fallback_errors")
+                    log.exception("TPU engine raised; falling back to host engine")
+            chunk = execute_dag_host(dag, batch)
+            self._bump("host_tasks")
+            return chunk
+        finally:
+            if ticket is not None:
+                ru = ru_cost(batch.n_rows)
+                ctl.scheduler.release(ticket, ru)
+                self._bump("ru", ru)
 
     # --- index scans (ref: executor/distsql.go IndexReader/IndexLookUp) ---
 
@@ -426,7 +492,7 @@ class CopClient:
         batch = self.index_batch(table, idx, ranges, read_ts, txn)
         if batch.n_rows == 0:
             return []
-        return [self._run_engines(dag, batch, engine)]
+        return [self._run_engines(dag, batch, engine, sctx=self._sched_ctx())]
 
     def send_handles(
         self, table: TableInfo, dag: DAGRequest, handles: list[int], read_ts: int,
@@ -445,4 +511,4 @@ class CopClient:
         batch = decode_rows_to_batch(table, kvs, (-1, 0))
         if batch.n_rows == 0:
             return []
-        return [self._run_engines(dag, batch, engine)]
+        return [self._run_engines(dag, batch, engine, sctx=self._sched_ctx())]

@@ -199,9 +199,41 @@ class DeviceBatch:
         return self._data[off], self._valid[off]
 
 
+class DevicePlan:
+    """A lowered DAG split at the device→host boundary: `launch()`
+    dispatches the compiled program and returns UN-fetched device arrays
+    (XLA dispatch is async — compute proceeds in the background);
+    `finalize(fetched)` turns the host copies into the result Chunk.
+
+    The split is what makes cross-task launch batching possible: a group
+    of plans can all launch first, then pay ONE `jax.device_get` for the
+    whole group (sched/batcher.py) instead of one blocking fetch each.
+
+    Plans that also carry (`key`, `args`) are FUSABLE: tasks sharing a
+    program key (same rewritten DAG + tile bucket ⇒ identical shapes)
+    stack their input lanes and run ONE vmapped program launch for the
+    whole group (`execute_many`), the arXiv:2203.01877 §4.2 move applied
+    across sessions. Each task's lanes stay a separate batch row of the
+    vmap, so results are bit-identical to solo `launch`+`finalize`.
+    """
+
+    __slots__ = ("launch", "finalize", "key", "args", "rows")
+
+    def __init__(self, launch, finalize, key=None, args=None, rows=0):
+        self.launch = launch
+        self.finalize = finalize
+        self.key = key  # program-cache key, shared ⇒ vmap-compatible
+        self.args = args  # (flat_lanes, row_valid) device inputs
+        self.rows = rows  # real (unpadded) row count of the batch
+
+
 class TPUEngine:
+    MAX_FUSE = 64  # largest vmapped launch group (and largest size bucket)
+
     def __init__(self):
         self._programs: dict = {}  # (digest, T, domains) -> compiled fn
+        self._raw: dict = {}  # program key -> raw traceable kernel
+        self._vprograms: dict = {}  # (key, group_cap) -> jit(vmap(raw))
         self._gcap: dict = {}  # sorted-agg digest -> last sufficient capacity
         self.gcap0 = 1 << 16  # initial sorted-agg group capacity
         self._lock = Lock()  # cop pool workers share this engine
@@ -210,18 +242,99 @@ class TPUEngine:
 
     # --- public ------------------------------------------------------------
 
-    def execute(self, dag: DAGRequest, batch: ColumnBatch) -> Chunk:
+    @staticmethod
+    def tile_count(batch: ColumnBatch) -> int:
+        """Padded tile count — the static-shape bucket compiled programs
+        are keyed on; the batcher's row-count bucket."""
+        return max((batch.n_rows + TILE_ROWS - 1) // TILE_ROWS, 1)
+
+    def _plan_for(self, dag: DAGRequest, batch: ColumnBatch):
         dev = getattr(batch, "_device", None)
         if dev is None:
             dev = DeviceBatch(batch)
             batch._device = dev
+        return self._lower(dag, dev)
 
-        plan = self._lower(dag, dev)
+    def execute(self, dag: DAGRequest, batch: ColumnBatch) -> Chunk:
+        plan = self._plan_for(dag, batch)
         if plan is None:
             with self._lock:
                 self.fallbacks += 1
             return execute_dag_host(dag, batch)
-        return plan()
+        if isinstance(plan, DevicePlan):
+            return plan.finalize(jax.device_get(plan.launch()))
+        return plan()  # sorted-agg path: owns its retry loop, stays eager
+
+    def execute_many(self, items: list[tuple[DAGRequest, ColumnBatch]]) -> list[Chunk]:
+        """Run a batch of cop tasks with launch amortization, two tiers:
+
+        1. tasks sharing a program key (identical rewritten DAG + tile
+           bucket ⇒ identical lane shapes) STACK into one vmapped device
+           program launch — per-task dispatch cost paid once per group;
+        2. everything launched (fused groups and singles) is pulled back
+           by a single `jax.device_get` — one host sync (on a tunneled
+           device one round-trip) instead of len(items).
+
+        Group programs are compiled per power-of-two size bucket (group
+        padded by repeating its last task, padding discarded), so steady
+        state pays at most log2(MAX_FUSE) extra compiles per key."""
+        plans = [self._plan_for(dag, batch) for dag, batch in items]
+        results: list = [None] * len(items)
+        fusable: dict = {}  # program key -> [task index]
+        launched = []  # (kind, payload) in launch order
+        for i, (plan, (dag, batch)) in enumerate(zip(plans, items)):
+            if plan is None:
+                with self._lock:
+                    self.fallbacks += 1
+                results[i] = execute_dag_host(dag, batch)
+            elif isinstance(plan, DevicePlan):
+                if plan.key is not None and plan.args is not None:
+                    fusable.setdefault(plan.key, []).append(i)
+                else:
+                    launched.append(("one", (i, plan.launch())))
+            else:
+                results[i] = plan()  # sorted-agg: owns its retry loop
+
+        for key, idx_list in fusable.items():
+            for lo in range(0, len(idx_list), self.MAX_FUSE):
+                grp = idx_list[lo : lo + self.MAX_FUSE]
+                if len(grp) == 1:
+                    i = grp[0]
+                    launched.append(("one", (i, plans[i].launch())))
+                    continue
+                gcap = 1 << (len(grp) - 1).bit_length()
+                # single-tile (point/small-range) tasks: run the group at
+                # the real row-count bucket instead of the full padded
+                # tile — row_valid already zeroes the tail, so this only
+                # drops rows that contribute exact zeros
+                width = None
+                rv = plans[grp[0]].args[1]
+                if rv.shape[0] == 1:
+                    need = max(plans[i].rows for i in grp)
+                    w = 1 << max(need - 1, 1).bit_length()
+                    if w < rv.shape[1]:
+                        width = w
+                vfn = self._vmapped_program(key, gcap, width)
+                if vfn is None:  # no raw kernel on record: launch solo
+                    for i in grp:
+                        launched.append(("one", (i, plans[i].launch())))
+                    continue
+                padded = grp + [grp[-1]] * (gcap - len(grp))
+                out = vfn(*[plans[i].args for i in padded])
+                launched.append(("grp", (grp, out)))
+
+        if launched:
+            fetched = jax.device_get([payload[1] for _, payload in launched])
+            for (kind, payload), host in zip(launched, fetched):
+                if kind == "one":
+                    i = payload[0]
+                    results[i] = plans[i].finalize(host)
+                else:
+                    for j, i in enumerate(payload[0]):
+                        results[i] = plans[i].finalize(
+                            jax.tree_util.tree_map(lambda a: a[j], host)
+                        )
+        return results
 
     # --- lowering ----------------------------------------------------------
 
@@ -377,12 +490,50 @@ class TPUEngine:
 
     def _program(self, key, builder):
         with self._lock:
+            self._raw.setdefault(key, builder)  # for vmapped group launches
             fn = self._programs.get(key)
             if fn is None:
                 fn = jax.jit(builder)
                 self._programs[key] = fn
                 self.compile_count += 1
         return fn
+
+    def _vmapped_program(self, key, gcap, width):
+        """One device program for a whole compatible launch group: takes
+        `gcap` tasks' (lanes, row_valid) pytrees, slices every lane to
+        `width` rows (None = keep the full padded tile), stacks them on a
+        new leading axis, and vmaps the raw per-task kernel over it — all
+        INSIDE one jit so XLA fuses slice+stack+compute into one dispatch
+        (an eager stack of TILE_ROWS-padded point tasks copies ~16x more
+        bytes than the group actually holds).
+
+        Slicing is exact, not approximate: every kernel masks with
+        row_valid before reducing, so rows beyond `width` contribute
+        literal zeros — dropping them cannot change any output bit
+        (IEEE x+0.0 == x). Compiled per (key, size bucket, width bucket);
+        None if the raw kernel for `key` isn't on record."""
+        with self._lock:
+            vfn = self._vprograms.get((key, gcap, width))
+            if vfn is None:
+                raw = self._raw.get(key)
+                if raw is None:
+                    return None
+
+                def group(*argss):
+                    if width is not None:
+                        argss = [
+                            jax.tree_util.tree_map(lambda a: a[:, :width], args)
+                            for args in argss
+                        ]
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs), *argss
+                    )
+                    return jax.vmap(raw)(*stacked)
+
+                vfn = jax.jit(group)
+                self._vprograms[(key, gcap, width)] = vfn
+                self.compile_count += 1
+        return vfn
 
     # --- filter-only --------------------------------------------------------
 
@@ -394,15 +545,18 @@ class TPUEngine:
         arrs, order = self._flatten_lanes(lanes)
         fn = self._program(key, lambda flat, rv: self._mask(r_conds, self._unflatten(flat, order), rv))
 
-        def run():
-            mask = jax.device_get(fn(arrs, dev.row_valid)).reshape(-1)[: dev.batch.n_rows]
+        def finalize(mask):
+            mask = np.asarray(mask).reshape(-1)[: dev.batch.n_rows]
             chunk = dev.batch.to_chunk(dag.scan.col_offsets)
             chunk = chunk.filter(mask)
             if dag.limit is not None:
                 chunk = chunk.slice(0, min(dag.limit.n, chunk.num_rows))
             return chunk
 
-        return run
+        return DevicePlan(
+            lambda: fn(arrs, dev.row_valid), finalize,
+            key=key, args=(arrs, dev.row_valid), rows=dev.batch.n_rows,
+        )
 
     def _flatten_lanes(self, lanes):
         order = sorted(lanes)
@@ -517,15 +671,19 @@ class TPUEngine:
 
         fn, aux = self._packed_program(key, kernel, nseg)
 
-        def run():
+        def finalize(fetched):
             # The whole partial state comes back as (at most) TWO stacked
             # arrays — each device->host fetch over the tunnel pays a full
             # round-trip, so per-array fetches dominated query time before
-            # (32 × ~15-75ms); one packed fetch is one round-trip.
-            outs = self._unpack(jax.device_get(fn(arrs, dev.row_valid)), aux)
+            # (32 × ~15-75ms); one packed fetch is one round-trip, and the
+            # batcher further shares one fetch across a whole launch group.
+            outs = self._unpack(fetched, aux)
             return self._agg_outputs_to_chunk(dag, dev, outs, domains, key_cols, vocabs, nseg)
 
-        return run
+        return DevicePlan(
+            lambda: fn(arrs, dev.row_valid), finalize,
+            key=key, args=(arrs, dev.row_valid), rows=dev.batch.n_rows,
+        )
 
     # --- sort-based aggregation (high-cardinality GROUP BY) -----------------
 
@@ -690,6 +848,7 @@ class TPUEngine:
                 f_arr = jnp.stack(flts) if flts else jnp.zeros((0, nseg), jnp.float64)
                 return (scalar, i_arr, f_arr) if has_scalar else (i_arr, f_arr)
 
+            self._raw.setdefault(key, packed)
             cached = (jax.jit(packed), aux)
             self._programs[key] = cached
             self.compile_count += 1
@@ -958,13 +1117,16 @@ class TPUEngine:
 
         fn = self._program(key, kernel)
 
-        def run():
-            idx, ok = jax.device_get(fn(arrs, dev.row_valid))
+        def finalize(fetched):
+            idx, ok = fetched
             idx = idx[ok]  # drop indices pointing at masked rows
             chunk = dev.batch.to_chunk(dag.scan.col_offsets)
             return chunk.take(idx[: dag.topn.n])
 
-        return run
+        return DevicePlan(
+            lambda: fn(arrs, dev.row_valid), finalize,
+            key=key, args=(arrs, dev.row_valid), rows=dev.batch.n_rows,
+        )
 
     def _lower_topn_multi(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
         """Multi-key TopN: one multi-operand lax.sort over (mask, per-key
@@ -1002,9 +1164,12 @@ class TPUEngine:
 
         fn = self._program(key, kernel)
 
-        def run():
-            idx, ok = jax.device_get(fn(arrs, dev.row_valid))
+        def finalize(fetched):
+            idx, ok = fetched
             chunk = dev.batch.to_chunk(dag.scan.col_offsets)
             return chunk.take(idx[ok][: dag.topn.n])
 
-        return run
+        return DevicePlan(
+            lambda: fn(arrs, dev.row_valid), finalize,
+            key=key, args=(arrs, dev.row_valid), rows=dev.batch.n_rows,
+        )

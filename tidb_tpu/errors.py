@@ -84,3 +84,22 @@ class QueryInterrupted(TiDBError):
 
 class MemoryQuotaExceeded(TiDBError):
     code = 8175
+
+
+class ResourceGroupExists(TiDBError):
+    """CREATE RESOURCE GROUP on an existing name (ref: ErrResourceGroupExists)."""
+
+    code = 8248
+
+
+class ResourceGroupNotExists(TiDBError):
+    """ALTER/DROP/SET on an unknown resource group (ref: ErrResourceGroupNotExists)."""
+
+    code = 8249
+
+
+class ResourceGroupQueueFull(TiDBError):
+    """Admission queue overflow under sustained overload — the backpressure
+    hard edge (ref: ErrResourceGroupThrottled 8252)."""
+
+    code = 8252

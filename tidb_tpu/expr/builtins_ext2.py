@@ -117,30 +117,42 @@ def _mysql_aes_key(key: bytes, bits: int = 128) -> bytes:
     return bytes(out)
 
 
-try:  # optional dependency: only AES_ENCRYPT/DECRYPT need it
+try:  # optional acceleration: only AES_ENCRYPT/DECRYPT use it
     from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-except ImportError:  # pragma: no cover — baked into this image
-    Cipher = None
+except ImportError:
+    Cipher = None  # pure-Python `_aes` fallback takes over
+
+
+def _ecb_encrypt(raw: bytes, key: bytes) -> bytes:
+    if Cipher is not None:
+        enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+        return enc.update(raw) + enc.finalize()
+    from ._aes import ecb_encrypt
+
+    return ecb_encrypt(raw, key)
+
+
+def _ecb_decrypt(raw: bytes, key: bytes) -> bytes:
+    if Cipher is not None:
+        dec = Cipher(algorithms.AES(key), modes.ECB()).decryptor()
+        return dec.update(raw) + dec.finalize()
+    from ._aes import ecb_decrypt
+
+    return ecb_decrypt(raw, key)
 
 
 def _aes_encrypt(data, key):
-    if Cipher is None:
-        raise TypeError("AES functions require the 'cryptography' package")
     raw = _as_bytes(data)
     pad = 16 - len(raw) % 16
     raw += bytes([pad]) * pad  # PKCS7, always padded (MySQL semantics)
-    enc = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key))), modes.ECB()).encryptor()
-    return enc.update(raw) + enc.finalize()
+    return _ecb_encrypt(raw, _mysql_aes_key(_as_bytes(key)))
 
 
 def _aes_decrypt(data, key):
-    if Cipher is None:
-        raise TypeError("AES functions require the 'cryptography' package")
     raw = _as_bytes(data)
     if not raw or len(raw) % 16:
         _null()
-    dec = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key))), modes.ECB()).decryptor()
-    out = dec.update(raw) + dec.finalize()
+    out = _ecb_decrypt(raw, _mysql_aes_key(_as_bytes(key)))
     pad = out[-1]
     if not 1 <= pad <= 16 or out[-pad:] != bytes([pad]) * pad:
         _null()  # wrong key → invalid padding → NULL (MySQL)

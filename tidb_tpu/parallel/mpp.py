@@ -36,7 +36,18 @@ import numpy as np
 
 from ..jaxenv import jax, jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.4.38 JAX keeps it in the experimental namespace
+    # check_rep's rep-rule table is incomplete there (a nested-pjit rule
+    # returns None and _check_rep crashes) — it is a validation pass only,
+    # so disable it rather than lose the whole mesh path
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _esm
+
+    shard_map = functools.partial(_esm, check_rep=False)
 
 from ..chunk.chunk import Chunk, Column, col_numpy_dtype, VARLEN
 from ..expr.expression import Column as ExprCol, Constant, Expression

@@ -429,6 +429,26 @@ class DropSequence:
 
 
 @dataclass
+class ResourceGroupDDL:
+    """CREATE/ALTER/DROP RESOURCE GROUP (ref: ast ResourceGroupStmt;
+    `spec` holds only the options the statement named — ALTER merges)."""
+
+    kind: str  # 'create' | 'alter' | 'drop'
+    name: str
+    spec: dict = field(default_factory=dict)  # ru_per_sec / priority / burstable
+    if_not_exists: bool = False
+    if_exists: bool = False
+
+
+@dataclass
+class SetResourceGroup:
+    """SET RESOURCE GROUP name — rebind the session mid-flight
+    (ref: ast.SetResourceGroupStmt)."""
+
+    name: str
+
+
+@dataclass
 class LoadStats:
     path: str
 

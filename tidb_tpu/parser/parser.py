@@ -1139,6 +1139,12 @@ class Parser:
                 else:
                     break
             return node
+        if self.try_kw("RESOURCE"):
+            self.expect_kw("GROUP")
+            ine = self._if_not_exists()
+            return ast.ResourceGroupDDL(
+                "create", self.ident(), self._rg_options(), if_not_exists=ine
+            )
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ine = self._if_not_exists()
@@ -1371,6 +1377,10 @@ class Parser:
             while self.try_op(","):
                 names.append(self._table_name())
             return ast.DropView(names, ie)
+        if self.try_kw("RESOURCE"):
+            self.expect_kw("GROUP")
+            ie = self._if_exists()
+            return ast.ResourceGroupDDL("drop", self.ident(), {}, if_exists=ie)
         if self.at_kw("DATABASE", "SCHEMA"):
             self.next()
             ie = self._if_exists()
@@ -1392,8 +1402,46 @@ class Parser:
             return True
         return False
 
+    def _rg_options(self) -> dict:
+        """RU_PER_SEC = n | PRIORITY = LOW/MEDIUM/HIGH | BURSTABLE [= bool]
+        (ref: parser.y ResourceGroupOptionList — the RU form only; the
+        RAW mode's per-resource knobs have no meaning on one device mesh)."""
+        spec: dict = {}
+        while self.tok.kind == "ident":
+            up = self.tok.upper
+            if up == "RU_PER_SEC":
+                self.next()
+                self.try_op("=")
+                spec["ru_per_sec"] = self._int_bound()
+            elif up == "PRIORITY":
+                self.next()
+                self.try_op("=")
+                p = self.ident().upper()
+                if p not in ("LOW", "MEDIUM", "HIGH"):
+                    self.fail(f"invalid resource group priority {p!r}")
+                spec["priority"] = p
+            elif up == "BURSTABLE":
+                self.next()
+                if self.try_op("="):
+                    b = self.next().upper
+                    if b in ("TRUE", "1", "ON"):
+                        spec["burstable"] = True
+                    elif b in ("FALSE", "0", "OFF"):
+                        spec["burstable"] = False
+                    else:
+                        self.fail(f"invalid BURSTABLE value {b!r}")
+                else:
+                    spec["burstable"] = True
+            else:
+                break
+            self.try_op(",")
+        return spec
+
     def alter_stmt(self):
         self.expect_kw("ALTER")
+        if self.try_kw("RESOURCE"):
+            self.expect_kw("GROUP")
+            return ast.ResourceGroupDDL("alter", self.ident(), self._rg_options())
         self.expect_kw("TABLE")
         tbl = self._table_name()
         actions = []
@@ -1548,6 +1596,10 @@ class Parser:
         if self.try_kw("NAMES"):
             self.next()
             return ast.SetStmt([])
+        if self.at_kw("RESOURCE") and self.peek().upper == "GROUP":
+            self.next()
+            self.next()
+            return ast.SetResourceGroup(self.ident())
         assignments = []
         while True:
             scope = "session"
@@ -1592,6 +1644,9 @@ class Parser:
             node.kind = "databases"
         elif self.try_kw("BINDINGS"):
             node.kind = "bindings"
+        elif self.try_kw("RESOURCE"):
+            self.expect_kw("GROUPS")
+            node.kind = "resource_groups"
         elif self.try_kw("GRANTS"):
             node.kind = "grants"
             if self.try_kw("FOR"):

@@ -1,0 +1,64 @@
+"""Resource control for the cop path (ref: the reference's resource
+groups + unified read pool; SURVEY §5.8 names the cop client seam).
+
+Three layers, one facade:
+
+  ResourceGroupManager — RU-style token buckets + priority, DDL-managed
+      (`CREATE/ALTER/DROP RESOURCE GROUP`), persisted in the catalog meta
+      KV and cached per store like bindinfo.
+  AdmissionScheduler — inline admission gate every cop-task execution
+      passes through: per-priority wait queues, RU debt checks, deadline/
+      KILL-aware waiting, hard backpressure beyond MAX_QUEUE.
+  LaunchBatcher — cross-session micro-batching of compatible device
+      launches (same DAG digest + tile bucket): dedup of identical
+      snapshot reads plus one-fetch grouped dispatch via
+      `TPUEngine.execute_many`.
+
+One `ResourceController` hangs off each `Storage` (`Storage.sched`), so
+every session over a store shares the same admission state, the same
+device-launch batcher AND the same TPU engine (one XLA program cache per
+store instead of one per session — compatible launches can only coalesce
+when they share compiled programs).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .batcher import LaunchBatcher
+from .resource_group import (
+    DEFAULT_GROUP,
+    PRIORITIES,
+    ResourceGroup,
+    ResourceGroupManager,
+    TokenBucket,
+)
+from .scheduler import AdmissionScheduler, SchedCtx, Ticket, ru_cost
+
+__all__ = [
+    "AdmissionScheduler", "DEFAULT_GROUP", "LaunchBatcher", "PRIORITIES",
+    "ResourceController", "ResourceGroup", "ResourceGroupManager",
+    "SchedCtx", "Ticket", "TokenBucket", "ru_cost",
+]
+
+
+class ResourceController:
+    """Per-store facade: groups + scheduler + batcher + shared TPU engine."""
+
+    def __init__(self, storage):
+        self.storage = storage
+        self.groups = ResourceGroupManager(storage)
+        self.scheduler = AdmissionScheduler(self.groups)
+        self.batcher = LaunchBatcher()
+        self._tpu = None
+        self._lock = threading.Lock()
+
+    @property
+    def tpu_engine(self):
+        if self._tpu is None:
+            with self._lock:
+                if self._tpu is None:
+                    from ..copr.tpu_engine import TPUEngine
+
+                    self._tpu = TPUEngine()
+        return self._tpu

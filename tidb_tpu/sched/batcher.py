@@ -1,0 +1,169 @@
+"""Cross-session device-launch micro-batcher.
+
+Per-task device dispatch is the cop-path bottleneck (round-5 verdict:
+p50 at 0.15x of the host engine): every task pays its own jit-call
+dispatch plus a blocking device→host fetch. Tensor-runtime query engines
+win by amortizing launch cost over bucketed batches (arXiv:2203.01877
+§4.2); this batcher applies the same move across sessions.
+
+Concurrent cop tasks that lower to the SAME compiled program — same DAG
+digest, same padded tile count (the static-shape bucket the jit cache is
+keyed on) — coalesce into one launch group. The group leader waits a
+microscopic window for followers, then
+
+  * tier 1 (dedup): tasks over the identical data snapshot (same digest,
+    table version and handle span) execute ONCE and share the chunk — the
+    same sharing rule the cop result cache already applies, without its
+    min-scan-rows admission gate;
+  * tier 2 (launch coalescing): remaining tasks dispatch back-to-back
+    through `TPUEngine.execute_many`, which defers every device→host
+    fetch to ONE `device_get` over the whole group.
+
+Every task still runs its own per-task compiled program over its own
+batch, so results are bit-identical to serial `execute` calls by
+construction (no cross-task reduction reordering).
+
+A solo task (nothing else in flight) bypasses the batcher entirely:
+zero added latency on the uncontended path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import metrics as M
+from ..utils.failpoint import inject as _fp
+
+
+class _Job:
+    __slots__ = ("dag", "batch", "dedup_key", "result", "exc", "followers", "mode")
+
+    def __init__(self, dag, batch, dedup_key):
+        self.dag = dag
+        self.batch = batch
+        self.dedup_key = dedup_key
+        self.result = None
+        self.exc = None
+        self.followers: list["_Job"] = []
+        self.mode = "leader"
+
+
+class _Group:
+    __slots__ = ("jobs", "n_dedup", "done", "closed")
+
+    def __init__(self):
+        self.jobs: list[_Job] = []
+        self.n_dedup = 0
+        self.done = threading.Event()
+        self.closed = False
+
+
+class LaunchBatcher:
+    WINDOW_S = 0.002  # follower collection window; >> jit dispatch, << a launch
+    WAIT_TIMEOUT_S = 120.0  # follower safety valve (leader crashed hard)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, _Group] = {}
+        self._inflight = 0
+
+    def execute(self, engine, dag, batch, dedup_key=None, stats=None):
+        """Run one cop DAG over one batch through the engine, coalescing
+        with concurrent compatible tasks. `stats` is an optional callable
+        `(key, n)` for the owning client's per-query counters."""
+        with self._lock:
+            self._inflight += 1
+            concurrent = self._inflight > 1
+        try:
+            if not concurrent:
+                return engine.execute(dag, batch)
+            return self._coalesced(engine, dag, batch, dedup_key, stats)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    # --- grouped path -------------------------------------------------------
+
+    def _coalesced(self, engine, dag, batch, dedup_key, stats):
+        try:
+            tiles = engine.tile_count(batch)
+        except Exception:  # noqa: BLE001 — engine without tiling: run solo
+            return engine.execute(dag, batch)
+        ckey = (id(engine), dag.digest(), tiles)
+        job = _Job(dag, batch, dedup_key)
+        with self._lock:
+            g = self._pending.get(ckey)
+            if g is not None and not g.closed:
+                if dedup_key is not None:
+                    for j in g.jobs:
+                        if j.dedup_key == dedup_key:
+                            j.followers.append(job)
+                            job.mode = "dedup"
+                            g.n_dedup += 1
+                            break
+                if job.mode != "dedup":
+                    g.jobs.append(job)
+                    job.mode = "member"
+                group = g
+            else:
+                group = _Group()
+                group.jobs.append(job)
+                self._pending[ckey] = group
+
+        if job.mode == "leader":
+            time.sleep(self.WINDOW_S)
+            with self._lock:
+                group.closed = True
+                if self._pending.get(ckey) is group:
+                    del self._pending[ckey]
+            self._launch(engine, group, stats)
+        else:
+            if not group.done.wait(self.WAIT_TIMEOUT_S):
+                # leader died without completing the group (should be
+                # impossible — _launch sets done unconditionally): fail
+                # loudly rather than return a None chunk downstream
+                raise RuntimeError(
+                    "launch batcher follower timed out waiting for its group leader"
+                )
+            if stats is not None:
+                stats("dedup_tasks" if job.mode == "dedup" else "batched_tasks", 1)
+        if job.exc is not None:
+            raise job.exc
+        return job.result
+
+    def _launch(self, engine, group: _Group, stats) -> None:
+        jobs = group.jobs
+        try:
+            # everything before the engine call sits inside the guard too:
+            # an armed failpoint (or metrics error) must still release the
+            # followers via done.set(), never strand them on the 120s valve
+            _fp("sched/before-launch")
+            occupancy = len(jobs) + group.n_dedup
+            M.SCHED_BATCH_OCCUPANCY.observe(occupancy)
+            if stats is not None and occupancy > 1:
+                stats("batched_tasks", 1)
+            try:
+                results = engine.execute_many([(j.dag, j.batch) for j in jobs])
+                for j, r in zip(jobs, results):
+                    j.result = r
+            except Exception:  # noqa: BLE001
+                # one poisoned task must not fail its co-batched neighbors:
+                # fall back to per-task serial execution with per-task errors
+                for j in jobs:
+                    try:
+                        j.result = engine.execute(j.dag, j.batch)
+                    except Exception as e:  # noqa: BLE001
+                        j.exc = e
+        except BaseException as e:  # noqa: BLE001 — e.g. an armed failpoint
+            # no job may be left with neither result nor error: a follower
+            # would otherwise surface a None chunk downstream
+            for j in jobs:
+                if j.result is None and j.exc is None:
+                    j.exc = e
+            raise
+        finally:
+            for j in jobs:
+                for f in j.followers:
+                    f.result, f.exc = j.result, j.exc
+            group.done.set()

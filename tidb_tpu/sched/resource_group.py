@@ -1,0 +1,253 @@
+"""Resource groups — RU token buckets with priority, persisted in the
+catalog (ref: the reference's resource control: ddl_api.go
+CreateResourceGroup + pkg/resourcegroup; RU model per the Request Unit
+accounting of resource_manager, radically simplified to a local bucket —
+this store has no cross-keyspace GAC to reconcile with).
+
+A group is a spec dict in the meta KV (`m:rg:<name>`, see catalog/meta.py)
+plus live runtime state (the token bucket). The manager caches specs the
+way `bindinfo.BindingCache` caches bindings: a notify version bumped on
+every DDL, re-scanned lazily on first use after the bump, so every session
+over one store observes one consistent group table. Buckets survive cache
+reloads (debt must not reset on unrelated DDL) unless the group's rate or
+burst changed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..errors import ResourceGroupExists, ResourceGroupNotExists
+
+# admission order: HIGH beats MEDIUM beats LOW whenever slots are scarce
+# (the reference's tri-level priority for resource groups)
+PRIORITIES = {"LOW": 1, "MEDIUM": 8, "HIGH": 16}
+
+DEFAULT_GROUP = "default"
+
+
+class TokenBucket:
+    """RU bucket with post-hoc debits: admission charges an estimate, the
+    task settles the true cost after running, so tokens may go negative
+    (debt). A group is admissible while it holds no debt; refill pays debt
+    down at `rate` RU/s. rate <= 0 means unlimited (the default group)."""
+
+    def __init__(self, rate: float, burst: float | None = None):
+        self.rate = float(rate)
+        self.capacity = float(burst) if burst else max(self.rate, 1.0)
+        self.tokens = self.capacity
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill_locked(self, now: float) -> None:
+        dt = now - self._t
+        self._t = now
+        if self.rate > 0 and dt > 0:
+            self.tokens = min(self.tokens + dt * self.rate, self.capacity)
+
+    def available(self, now: float | None = None) -> float:
+        with self._lock:
+            self._refill_locked(time.monotonic() if now is None else now)
+            return self.tokens
+
+    def admissible(self, now: float | None = None) -> bool:
+        if self.rate <= 0:
+            return True
+        return self.available(now) > 0.0
+
+    def debit(self, n: float) -> None:
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            self.tokens -= n
+
+    def credit(self, n: float) -> None:
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            self.tokens = min(self.tokens + n, self.capacity)
+
+
+@dataclass
+class ResourceGroup:
+    name: str
+    ru_per_sec: int = 0  # 0 = unlimited
+    priority: str = "MEDIUM"
+    burstable: bool = False
+    bucket: TokenBucket = field(default=None, repr=False)  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.bucket is None:
+            # burstable groups may borrow beyond their rate while the
+            # store has headroom — modeled as an unlimited bucket (the
+            # rate still drives RU metrics / SHOW output)
+            self.bucket = TokenBucket(0 if self.burstable else self.ru_per_sec)
+
+    @property
+    def priority_value(self) -> int:
+        return PRIORITIES.get(self.priority, PRIORITIES["MEDIUM"])
+
+    def to_spec(self) -> dict:
+        return {
+            "name": self.name,
+            "ru_per_sec": self.ru_per_sec,
+            "priority": self.priority,
+            "burstable": self.burstable,
+        }
+
+    @classmethod
+    def from_spec(cls, d: dict) -> "ResourceGroup":
+        return cls(
+            name=d["name"],
+            ru_per_sec=int(d.get("ru_per_sec", 0)),
+            priority=d.get("priority", "MEDIUM"),
+            burstable=bool(d.get("burstable", False)),
+        )
+
+
+class ResourceGroupManager:
+    """Catalog-backed group table shared by every session over one store."""
+
+    def __init__(self, storage):
+        self.storage = storage
+        self.notify_version = 0
+        self._version = -1
+        self._lock = threading.Lock()
+        self._groups: dict[str, ResourceGroup] = {}
+
+    # --- read side ---------------------------------------------------------
+
+    def _ensure(self) -> None:
+        with self._lock:
+            v = self.notify_version
+            if v == self._version:
+                return
+            from ..catalog.meta import Meta
+
+            txn = self.storage.begin()
+            try:
+                specs = Meta(txn).list_resource_groups()
+            finally:
+                txn.rollback()
+            groups: dict[str, ResourceGroup] = {}
+            for spec in specs:
+                g = ResourceGroup.from_spec(spec)
+                old = self._groups.get(g.name)
+                if old is not None and (old.ru_per_sec, old.burstable) == (
+                    g.ru_per_sec, g.burstable,
+                ):
+                    g.bucket = old.bucket  # keep accumulated debt/credit
+                groups[g.name] = g
+            self._groups = groups
+            self._version = v
+
+    def get(self, name: str) -> ResourceGroup:
+        """Admission-time lookup: unknown names fall back to the default
+        group (a group dropped mid-flight must not fail running queries —
+        the reference degrades to `default` the same way)."""
+        name = (name or DEFAULT_GROUP).lower()
+        if name == DEFAULT_GROUP:
+            return self.default
+        self._ensure()
+        return self._groups.get(name) or self.default
+
+    def exists(self, name: str) -> bool:
+        if (name or "").lower() == DEFAULT_GROUP:
+            return True
+        self._ensure()
+        return name.lower() in self._groups
+
+    def list(self) -> list[ResourceGroup]:
+        self._ensure()
+        out = [self.default]
+        out.extend(self._groups[k] for k in sorted(self._groups))
+        return out
+
+    @property
+    def default(self) -> ResourceGroup:
+        if not hasattr(self, "_default"):
+            self._default = ResourceGroup(DEFAULT_GROUP, 0, "MEDIUM", True)
+        return self._default
+
+    # --- DDL side ----------------------------------------------------------
+    # `spec` carries only the options the statement named (None = keep);
+    # ALTER merges over the stored spec, CREATE fills defaults.
+
+    def create(self, name: str, spec: dict, if_not_exists: bool = False) -> None:
+        self._mutate("create", name, spec, if_not_exists=if_not_exists)
+
+    def alter(self, name: str, spec: dict) -> None:
+        self._mutate("alter", name, spec)
+
+    def drop(self, name: str, if_exists: bool = False) -> None:
+        self._mutate("drop", name, {}, if_exists=if_exists)
+
+    def _mutate(self, kind: str, name: str, spec: dict,
+                if_not_exists: bool = False, if_exists: bool = False) -> None:
+        from ..catalog.meta import Meta
+
+        name = name.lower()
+        opts = {k: v for k, v in spec.items() if v is not None}
+        if name == DEFAULT_GROUP:
+            if kind == "alter":
+                # the default group is synthetic: retune it in memory.
+                # Naming RU_PER_SEC without BURSTABLE turns bursting off —
+                # otherwise the burstable=unlimited modeling would leave
+                # the new limit silently unenforced
+                d = self.default
+                d.ru_per_sec = int(opts.get("ru_per_sec", d.ru_per_sec))
+                d.priority = opts.get("priority", d.priority)
+                if "burstable" in opts:
+                    d.burstable = bool(opts["burstable"])
+                elif "ru_per_sec" in opts:
+                    d.burstable = False
+                d.bucket = TokenBucket(0 if d.burstable else d.ru_per_sec)
+                self.bump()
+                return
+            if kind == "create":
+                if if_not_exists:
+                    return
+                raise ResourceGroupExists(f"resource group '{name}' already exists")
+            raise ResourceGroupNotExists(f"resource group '{name}' is reserved")
+        txn = self.storage.begin()
+        try:
+            m = Meta(txn)
+            cur = m.resource_group(name)
+            if kind == "create":
+                if cur is not None:
+                    if if_not_exists:
+                        txn.rollback()
+                        return
+                    raise ResourceGroupExists(f"resource group '{name}' already exists")
+                full = ResourceGroup(name).to_spec()
+                full.update(opts)
+                m.put_resource_group(full)
+            elif kind == "alter":
+                if cur is None:
+                    raise ResourceGroupNotExists(f"resource group '{name}' does not exist")
+                merged = dict(cur)
+                merged.update(opts)
+                m.put_resource_group(merged)
+            else:  # drop
+                if cur is None:
+                    if if_exists:
+                        txn.rollback()
+                        return
+                    raise ResourceGroupNotExists(f"resource group '{name}' does not exist")
+                m.drop_resource_group(name)
+            txn.commit()
+        except Exception:
+            try:
+                txn.rollback()
+            except Exception:  # noqa: BLE001 — already committed/rolled back
+                pass
+            raise
+        self.bump()
+
+    def bump(self) -> None:
+        with self._lock:
+            self.notify_version += 1

@@ -23,6 +23,7 @@ from ..codec import tablecodec
 from ..copr.client import CopClient
 from ..errors import (
     DuplicateEntry,
+    ResourceGroupNotExists,
     RetryableError,
     TableExists,
     TiDBError,
@@ -816,6 +817,12 @@ class Session:
                         )
                     except ValueError as e:
                         raise TiDBError(str(e))
+                    if name == "tidb_resource_group" and not self._in_bootstrap:
+                        out = out.lower()
+                        if not self.store.sched.groups.exists(out):
+                            raise ResourceGroupNotExists(
+                                f"resource group '{out}' does not exist"
+                            )
                     if scope == "global":
                         # SET GLOBAL: store-wide value, visible to NEW
                         # sessions and @@global reads; the current
@@ -848,6 +855,10 @@ class Session:
             return self._ddl_create_sequence(stmt)
         if isinstance(stmt, ast.DropSequence):
             return self._ddl_drop_sequence(stmt)
+        if isinstance(stmt, ast.ResourceGroupDDL):
+            return self._run_resource_group_ddl(stmt)
+        if isinstance(stmt, ast.SetResourceGroup):
+            return self._run_set_resource_group(stmt)
         if isinstance(stmt, ast.TraceStmt):
             return self._run_trace(stmt)
         if isinstance(stmt, ast.CreateView):
@@ -1615,6 +1626,30 @@ class Session:
     # ------------------------------------------------------------------- DML
 
     # ------------------------------------------------------------ sequences
+
+    # ------------------------------------------------- resource control
+
+    def _run_resource_group_ddl(self, stmt: ast.ResourceGroupDDL) -> ResultSet:
+        """CREATE/ALTER/DROP RESOURCE GROUP → the store-wide group table
+        (ref: ddl_api.go CreateResourceGroup; persisted like bindinfo,
+        effective for every session over the store on next admission)."""
+        mgr = self.store.sched.groups
+        if stmt.kind == "create":
+            mgr.create(stmt.name, stmt.spec, if_not_exists=stmt.if_not_exists)
+        elif stmt.kind == "alter":
+            mgr.alter(stmt.name, stmt.spec)
+        else:
+            # sessions still bound to the dropped name degrade to the
+            # default group at their next admission (manager.get fallback)
+            mgr.drop(stmt.name, if_exists=stmt.if_exists)
+        return ResultSet([], None)
+
+    def _run_set_resource_group(self, stmt: ast.SetResourceGroup) -> ResultSet:
+        name = stmt.name.lower()
+        if not self.store.sched.groups.exists(name):
+            raise ResourceGroupNotExists(f"resource group '{name}' does not exist")
+        self.vars["tidb_resource_group"] = name
+        return ResultSet([], None)
 
     def _ddl_create_sequence(self, stmt: ast.CreateSequence) -> ResultSet:
         """CREATE SEQUENCE (ref: docs/design/2020-04-17-sql-sequence.md;
@@ -3225,6 +3260,18 @@ class Session:
                 [ft_varchar(), ft_varchar(), ft_longlong(), ft_varchar(), ft_varchar()], rows
             )
             return ResultSet(["Name", "Engine", "Rows", "Row_format", "Comment"], chk)
+        if stmt.kind == "resource_groups":
+            rows = [
+                [
+                    Datum.s(g.name.upper()),
+                    Datum.s("UNLIMITED" if g.ru_per_sec <= 0 else str(g.ru_per_sec)),
+                    Datum.s(g.priority),
+                    Datum.s("YES" if g.burstable else "NO"),
+                ]
+                for g in self.store.sched.groups.list()
+            ]
+            chk = Chunk.from_datum_rows([ft_varchar()] * 4, rows)
+            return ResultSet(["Name", "RU_PER_SEC", "Priority", "Burstable"], chk)
         if stmt.kind == "bindings":
             rows = self._sql_internal(
                 "SELECT original_sql, bind_sql, status FROM mysql.bind_info"
@@ -3438,6 +3485,7 @@ class Session:
 
         inner = stmt.stmt
         spans: list[tuple[str, float, float]] = []  # (op, start_ms, dur_ms)
+        cop_before = dict(self.cop.stats)
         t_base = time.perf_counter_ns()
         # the inner statement runs through _execute_stmt so EVERY gate
         # (privileges, table locks, hints, outfile, ...) applies exactly
@@ -3450,6 +3498,16 @@ class Session:
             self._trace_collect = False
         t_done = time.perf_counter_ns()
         spans.append(("session.execute", 0.0, (t_done - t_base) / 1e6))
+        d = {k: self.cop.stats[k] - cop_before.get(k, 0) for k in self.cop.stats}
+        if d["tasks"]:
+            # admission layer as a span: wait is the measured queue time,
+            # the RU/batch counters ride in the operation label (the
+            # resource_control span of the reference's trace output)
+            spans.append((
+                f"cop.sched[group={self.vars.get('tidb_resource_group', 'default') or 'default'}"
+                f" ru={d['ru']:.2f} batched={d['batched_tasks']} dedup={d['dedup_tasks']}]",
+                0.0, d["sched_wait_ms"],
+            ))
         if self._trace_result is not None:
             ex, stats = self._trace_result
             self._trace_result = None
@@ -3496,6 +3554,12 @@ class Session:
             f"cop: tasks:{d['tasks']} tpu:{d['tpu_tasks']} host:{d['host_tasks']} "
             f"region_errors:{d['region_errors']} fallback_errors:{d['fallback_errors']}"
         )
+        if d["tasks"]:
+            lines.append(
+                f"sched: group:{self.vars.get('tidb_resource_group', 'default') or 'default'} "
+                f"wait:{d['sched_wait_ms']:.3f}ms ru:{d['ru']:.2f} "
+                f"batched:{d['batched_tasks']} dedup:{d['dedup_tasks']}"
+            )
         if self.cop._tpu:
             lines.append(
                 f"tpu: compiles:{self.cop.tpu.compile_count - tpu0[0]} "

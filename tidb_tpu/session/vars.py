@@ -124,6 +124,12 @@ _sv("tidb_mem_quota_sort", str(32 << 30), scope="session", kind="int", lo=-1, co
 _sv("tidb_mem_quota_topn", str(32 << 30), scope="session", kind="int", lo=-1, consumed=True)
 _sv("tidb_mem_quota_hashjoin", str(32 << 30), scope="session", kind="int", lo=-1, consumed=True)
 
+# --- resource control (sched/: admission + RU groups + launch batcher) ------
+_sv("tidb_resource_group", "default", consumed=True)
+# GLOBAL-only (as in the reference): a plain-SET session toggle would let
+# any unprivileged session opt itself out of admission control
+_sv("tidb_enable_resource_control", "ON", scope="global", kind="bool", consumed=True)
+
 # --- read-only session state surfaced via SELECT @@x (SET is rejected;
 # values are computed live by Session._sysvar_read) ------------------------
 for _name in (

@@ -384,6 +384,20 @@ class Storage:
             self._stats = StatsHandle(self)
         return self._stats
 
+    @property
+    def sched(self):
+        """Shared resource controller (ref: resource control's store-scoped
+        resource manager): admission, resource groups and the cross-session
+        device-launch batcher — one per store so every session's cop tasks
+        meet in the same queues."""
+        if getattr(self, "_sched", None) is None:
+            with self._proc_lock:
+                if getattr(self, "_sched", None) is None:
+                    from ..sched import ResourceController
+
+                    self._sched = ResourceController(self)
+        return self._sched
+
     def begin(self, pessimistic: bool = False) -> Txn:
         return Txn(self, self.tso.next(), pessimistic=pessimistic)
 

@@ -33,12 +33,41 @@ class Counter:
         return out
 
 
-class Histogram:
+class Gauge:
+    """Settable point-in-time value (queue depths, in-flight counts)."""
+
     def __init__(self, name: str, help_: str):
         self.name = name
         self.help = help_
+        self._v = defaultdict(float)  # label tuple → value
         self._lock = threading.Lock()
-        self._counts = [0] * (len(_BUCKETS) + 1)
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._v[tuple(sorted(labels.items()))] = v
+
+    def add(self, n: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._v[tuple(sorted(labels.items()))] += n
+
+    def value(self, **labels) -> float:
+        return self._v[tuple(sorted(labels.items()))]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._v.items()):
+            lbl = ",".join(f'{k}="{val}"' for k, val in key)
+            out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: tuple = _BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(buckets) + 1)
         self._sum = 0.0
         self._n = 0
 
@@ -46,7 +75,7 @@ class Histogram:
         with self._lock:
             self._sum += v
             self._n += 1
-            for i, b in enumerate(_BUCKETS):
+            for i, b in enumerate(self.buckets):
                 if v <= b:
                     self._counts[i] += 1
                     return
@@ -55,7 +84,7 @@ class Histogram:
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         cum = 0
-        for i, b in enumerate(_BUCKETS):
+        for i, b in enumerate(self.buckets):
             cum += self._counts[i]
             out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
         out.append(f'{self.name}_bucket{{le="+Inf"}} {self._n}')
@@ -77,11 +106,19 @@ class Registry:
                 self._metrics[name] = m
             return m
 
-    def histogram(self, name: str, help_: str = "") -> Histogram:
+    def gauge(self, name: str, help_: str = "") -> Gauge:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = Histogram(name, help_)
+                m = Gauge(name, help_)
+                self._metrics[name] = m
+            return m
+
+    def histogram(self, name: str, help_: str = "", buckets: tuple = _BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets)
                 self._metrics[name] = m
             return m
 
@@ -96,7 +133,7 @@ class Registry:
         out = []
         for name in sorted(self._metrics):
             m = self._metrics[name]
-            if isinstance(m, Counter):
+            if isinstance(m, (Counter, Gauge)):
                 for key, v in sorted(m._v.items()):
                     out.append((name, ",".join(f"{k}={val}" for k, val in key), v))
             else:
@@ -190,3 +227,22 @@ QUERY_DURATION = REGISTRY.histogram("tidb_query_duration_seconds", "statement wa
 COP_TASKS = REGISTRY.counter("tidb_cop_tasks_total", "coprocessor tasks by engine")
 TXN_TOTAL = REGISTRY.counter("tidb_txn_total", "transaction outcomes")
 DDL_JOBS = REGISTRY.counter("tidb_ddl_jobs_total", "DDL jobs by type and state")
+
+# resource-control series (ref: metrics/resourcemanager.go + the
+# resource-group RU counters of the reference's resource_control)
+SCHED_TASKS = REGISTRY.counter(
+    "tidb_sched_tasks_total", "cop tasks through the admission scheduler by outcome"
+)
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "tidb_sched_queue_depth", "cop tasks currently waiting for admission"
+)
+SCHED_WAIT = REGISTRY.histogram(
+    "tidb_sched_wait_seconds", "admission wait time per cop task"
+)
+SCHED_BATCH_OCCUPANCY = REGISTRY.histogram(
+    "tidb_sched_batch_occupancy", "cop tasks coalesced per device launch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+RU_CONSUMED = REGISTRY.counter(
+    "tidb_resource_group_ru_total", "request units consumed per resource group"
+)

@@ -1,0 +1,171 @@
+"""Cross-session launch-batcher microbench (ISSUE 1 acceptance gate).
+
+64 concurrent single-region point-agg cop tasks — the interactive-query
+shape the round-5 verdict flags (per-task device dispatch leaves cop p50
+at 0.15x of the host engine) — submitted two ways over identical
+(DAG, batch) work:
+
+  unbatched  each task thread calls `TPUEngine.execute` directly: one
+             jit dispatch + one blocking device→host fetch per task
+             (the pre-sched submit path of copr/client.py)
+  batched    each task thread goes through the store's LaunchBatcher:
+             compatible tasks coalesce into launch groups, the group
+             pays ONE `jax.device_get` (sched/batcher.py)
+
+Reports per-task p50 latency for both paths and verifies the batched
+chunks are bit-identical to serial execution (same data/valid lanes,
+byte for byte). Standalone: `python tools/bench_sched.py`; also runs as
+the `sched` workload of bench.py.
+"""
+
+import json
+import statistics
+import sys
+import threading
+import time
+
+N_TASKS = 64
+ROWS_PER_TASK = 4096  # same padded tile bucket for every task
+REPS = 7
+
+
+def _capture_pairs(s, n_tasks, rows_per_task):
+    """Harvest the exact per-task (DAG, batch) device work a run of
+    point-agg statements pushes through the cop client."""
+    ctl = s.store.sched
+    pairs = []
+    real = ctl.batcher.execute
+
+    def capture(engine, dag, batch, dedup_key=None, stats=None):
+        pairs.append((dag, batch))
+        return real(engine, dag, batch, dedup_key=dedup_key, stats=stats)
+
+    ctl.batcher.execute = capture
+    try:
+        for i in range(n_tasks):
+            lo = i * rows_per_task
+            s.must_query(
+                "SELECT COUNT(*), SUM(v), MIN(v), MAX(w) FROM pt"
+                f" WHERE id >= {lo} AND id < {lo + rows_per_task}"
+            )
+    finally:
+        ctl.batcher.execute = real
+    assert len(pairs) == n_tasks, f"expected {n_tasks} cop tasks, saw {len(pairs)}"
+    return pairs
+
+
+def _concurrent(fn, pairs):
+    """Run fn(i, dag, batch) from one thread per task, released together;
+    returns (results, per-task latencies in seconds)."""
+    lat = [0.0] * len(pairs)
+    results = [None] * len(pairs)
+    barrier = threading.Barrier(len(pairs))
+
+    def worker(i, dag, batch):
+        barrier.wait()
+        t0 = time.perf_counter()
+        results[i] = fn(dag, batch)
+        lat[i] = time.perf_counter() - t0
+
+    threads = [
+        threading.Thread(target=worker, args=(i, dag, batch))
+        for i, (dag, batch) in enumerate(pairs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, lat
+
+
+def _bit_identical(a, b) -> bool:
+    import numpy as np
+
+    if a.num_cols != b.num_cols or a.num_rows != b.num_rows:
+        return False
+    return all(
+        np.array_equal(ca.data, cb.data) and np.array_equal(ca.valid, cb.valid)
+        for ca, cb in zip(a.columns, b.columns)
+    )
+
+
+def run_sched_bench(n_tasks: int = N_TASKS, rows_per_task: int = ROWS_PER_TASK,
+                    reps: int = REPS) -> dict:
+    from tidb_tpu.session import Session
+    from tidb_tpu.utils import metrics as M
+
+    s = Session()
+    s.execute("CREATE TABLE pt (id INT PRIMARY KEY, v INT, w INT)")
+    total = n_tasks * rows_per_task
+    for lo in range(0, total, 8192):
+        s.execute(
+            "INSERT INTO pt VALUES "
+            + ",".join(f"({i}, {i % 997}, {(i * 7) % 131})" for i in range(lo, lo + 8192))
+        )
+    s.vars["tidb_enable_cop_result_cache"] = "OFF"
+    s.vars["tidb_cop_engine"] = "tpu"  # point tasks sit below AUTO_MIN_ROWS
+
+    ctl = s.store.sched
+    engine = ctl.tpu_engine
+    pairs = _capture_pairs(s, n_tasks, rows_per_task)
+    digests = len({dag.digest() for dag, _ in pairs})
+
+    # serial reference (also warms the one compiled program)
+    serial = [engine.execute(dag, batch) for dag, batch in pairs]
+
+    # pre-warm every group-size bucket the batcher can form (jit compiles
+    # once per power-of-two bucket; steady-state serving never re-pays)
+    g = 2
+    while g <= min(n_tasks, engine.MAX_FUSE):
+        engine.execute_many(pairs[:g])
+        g *= 2
+
+    unbatched, batched = [], []
+    identical = True
+    occ0_n, occ0_sum = M.SCHED_BATCH_OCCUPANCY._n, M.SCHED_BATCH_OCCUPANCY._sum
+    for rep in range(reps):
+        _, lat = _concurrent(engine.execute, pairs)
+        if rep:  # rep 0 is warmup for both paths
+            unbatched.extend(lat)
+        res, lat = _concurrent(
+            lambda dag, batch: ctl.batcher.execute(engine, dag, batch), pairs
+        )
+        if rep:
+            batched.extend(lat)
+        identical = identical and all(
+            _bit_identical(r, ref) for r, ref in zip(res, serial)
+        )
+    occ_n = M.SCHED_BATCH_OCCUPANCY._n - occ0_n
+    occ_mean = (M.SCHED_BATCH_OCCUPANCY._sum - occ0_sum) / occ_n if occ_n else 0.0
+
+    p50_un = statistics.median(unbatched)
+    p50_b = statistics.median(batched)
+    speedup = p50_un / p50_b if p50_b else 0.0
+    print(json.dumps({
+        "workload": "sched_microbatch_point_agg",
+        "tasks": n_tasks, "rows_per_task": rows_per_task, "digests": digests,
+        "p50_unbatched_ms": round(p50_un * 1e3, 3),
+        "p50_batched_ms": round(p50_b * 1e3, 3),
+        "p99_unbatched_ms": round(sorted(unbatched)[int(len(unbatched) * 0.99)] * 1e3, 3),
+        "p99_batched_ms": round(sorted(batched)[int(len(batched) * 0.99)] * 1e3, 3),
+        "mean_batch_occupancy": round(occ_mean, 1),
+        "bit_identical": identical,
+    }), file=sys.stderr)
+    assert identical, "batched results diverge from serial execution"
+    return {
+        "metric": "sched_batch_p50_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
+    }
+
+
+if __name__ == "__main__":
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_sched_bench()))
