@@ -27,11 +27,19 @@ from ..chunk.chunk import Chunk
 from ..catalog.schema import IndexInfo, TableInfo
 from ..codec import tablecodec
 from ..codec.key import decode_datum_key
+from ..errors import (
+    BackoffExhausted,
+    DeviceTransientError,
+    EpochNotMatch,
+    NotLeader,
+    QueryInterrupted,
+)
 from ..mysqltypes.datum import Datum, K_BYTES
 from ..sched import SchedCtx, ru_cost
 from ..utils.failpoint import inject as _fp
 from .dag import DAGRequest
 from .host_engine import execute_dag_host
+from .retry import BO_DEVICE, BO_REGION_MISS, BO_UPDATE_LEADER, Backoffer, classify_device_error
 from .tilecache import ColumnBatch, TileCache, decode_rows_to_batch
 
 
@@ -41,9 +49,7 @@ class CopTask:
     start: bytes
     end: bytes
     epoch: int = 1
-
-
-MAX_REGION_RETRY = 4
+    leader: int = 1  # leader store the task was built against
 
 
 class CopResultCache:
@@ -109,6 +115,12 @@ class CopClient:
             "ru": 0,
             "batched_tasks": 0,
             "dedup_tasks": 0,
+            # fault-tolerance counters (EXPLAIN ANALYZE retry line)
+            "retries": 0,
+            "backoff_ms": 0,
+            "breaker_skips": 0,
+            "cancelled_tasks": 0,
+            "drained_tasks": 0,
         }
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -183,13 +195,19 @@ class CopClient:
         prefix = tablecodec.index_prefix(table_id, index_id)
         return any(k.startswith(prefix) for k in txn.membuf)
 
-    def build_tasks(self, table_id: int, ranges: list[tuple[bytes, bytes]]) -> list[CopTask]:
-        """Region-align ranges (ref: buildCopTasks)."""
+    def build_ranged_tasks(self, ranges: list[tuple[bytes, bytes]]) -> list[CopTask]:
+        """Region-align raw key ranges (ref: buildCopTasksFromRemain) —
+        the re-split path's helper: the ranges are already absolute keys,
+        no table identity involved."""
         tasks = []
         for start, end in ranges:
             for region, s, e in self.storage.regions.split_ranges(start, end):
-                tasks.append(CopTask(region.id, s, e, region.epoch))
+                tasks.append(CopTask(region.id, s, e, region.epoch, region.leader_store))
         return tasks
+
+    def build_tasks(self, table_id: int, ranges: list[tuple[bytes, bytes]]) -> list[CopTask]:
+        """Region-align a table's ranges (ref: buildCopTasks)."""
+        return self.build_ranged_tasks(ranges)
 
     def send(
         self,
@@ -247,14 +265,20 @@ class CopClient:
         at most `concurrency` tasks run/buffer ahead of the consumer, new
         tasks are submitted as results drain, and abandoning the stream
         cancels everything not yet started."""
+        from threading import Event
+
         it = iter(tasks)
         futs: deque = deque()
+        abandon = Event()  # set at stream close: in-flight tasks bail at
+        # their next retry-loop/backoff checkpoint instead of riding out
+        # full backoff budgets while the drain below waits on them
 
         def submit_next():
             t = next(it, None)
             if t is not None:
                 futs.append(
-                    self.pool.submit(self._run_task, table, dag, t, read_ts, engine, cache=result_cache, sctx=sctx)
+                    self.pool.submit(self._run_task, table, dag, t, read_ts, engine,
+                                     cache=result_cache, sctx=sctx, abort=abandon)
                 )
 
         for _ in range(min(concurrency, len(tasks))):
@@ -270,29 +294,77 @@ class CopClient:
                 submit_next()
                 yield from f.result()
         finally:
+            # a failing or abandoned stream must not poison its siblings:
+            # cancel what hasn't started, then DRAIN what has — f.cancel()
+            # is a no-op on a running future, and a worker left running
+            # would outlive the stream. The abandon flag makes the drain
+            # short: a task sleeping in backoff or about to re-acquire a
+            # ticket bails at its next checkpoint (≤ one poll tick), so
+            # the wait below is bounded by one engine run, not by backoff
+            # budgets. Outcomes (results and errors alike) die with the
+            # stream.
+            abandon.set()
+            cancelled = drained = 0
             for f in futs:
-                f.cancel()
+                if f.cancel():
+                    cancelled += 1
+            for f in futs:
+                if not f.cancelled():
+                    drained += 1
+                    try:
+                        f.result()
+                    except BaseException:  # noqa: BLE001 — stream already failing
+                        pass
+            if cancelled:
+                self._bump("cancelled_tasks", cancelled)
+            if drained:
+                self._bump("drained_tasks", drained)
 
-    def _run_task(self, table, dag, t: CopTask, read_ts, engine, depth: int = 0, cache: bool = True, sctx=None) -> list[Chunk]:
-        """Execute one cop task, re-splitting on region epoch change
-        (ref: handleCopResponse region-error path, coprocessor.go:1025);
-        repeated identical (DAG, range) reads serve from the result cache
-        while the table version holds (ref: coprocessor_cache.go)."""
+    def _run_task(self, table, dag, t: CopTask, read_ts, engine, bo: Backoffer | None = None,
+                  cache: bool = True, sctx=None, abort=None) -> list[Chunk]:
+        """Execute one cop task, chasing region errors through the typed
+        backoff machinery (ref: handleCopResponse region-error path,
+        coprocessor.go:1025): EpochNotMatch re-splits the remaining range,
+        NotLeader retries the SAME task against the new leader, every
+        retry drawing from ONE per-task Backoffer budget (sub-tasks of a
+        re-split share their parent's). Repeated identical (DAG, range)
+        reads serve from the result cache while the table version holds
+        (ref: coprocessor_cache.go)."""
         _fp("cop/before-task")
-        region = self.storage.regions.locate(t.start)
-        stale = (
-            region.id != t.region_id
-            or region.epoch != t.epoch
-            or (region.end != b"" and (t.end == b"" or t.end > region.end))
-        )
-        if stale:
-            self._bump("region_errors")
-            if depth >= MAX_REGION_RETRY:
-                raise RuntimeError(f"cop task {t} exceeded region retry budget")
-            out = []
-            for sub in self.build_tasks(None, [(t.start, t.end)]):
-                out.extend(self._run_task(table, dag, sub, read_ts, engine, depth + 1, cache=cache, sctx=sctx))
-            return out
+        if bo is None:
+            bo = Backoffer.for_ctx(sctx, stats=self._bump)
+            bo.abort = abort
+        while True:
+            if bo.abort is not None and bo.abort.is_set():
+                return []  # stream abandoned: result would be discarded
+            region = self.storage.regions.locate(t.start)
+            if region.id == t.region_id and region.epoch == t.epoch and region.leader_store != t.leader:
+                # NotLeader: same region and epoch, leadership moved —
+                # no re-split, just chase the new leader after a short wait
+                self._bump("region_errors")
+                bo.backoff(BO_UPDATE_LEADER, NotLeader(
+                    f"region {region.id} leader moved store {t.leader} -> {region.leader_store}",
+                    region_id=region.id,
+                ))
+                t.leader = region.leader_store
+                continue
+            stale = (
+                region.id != t.region_id
+                or region.epoch != t.epoch
+                or (region.end != b"" and (t.end == b"" or t.end > region.end))
+            )
+            if stale:
+                self._bump("region_errors")
+                bo.backoff(BO_REGION_MISS, EpochNotMatch(
+                    f"region {t.region_id}@{t.epoch} is stale for "
+                    f"[{t.start!r}, {t.end!r}) (now {region.id}@{region.epoch})",
+                    region_id=t.region_id,
+                ))
+                out = []
+                for sub in self.build_ranged_tasks([(t.start, t.end)]):
+                    out.extend(self._run_task(table, dag, sub, read_ts, engine, bo=bo, cache=cache, sctx=sctx))
+                return out
+            break
         ckey = ver = last_commit = None
         if cache:
             ver, last_commit = self.storage.data_version(tablecodec.table_prefix(table.id))
@@ -307,7 +379,7 @@ class CopClient:
         # snapshot rule (read at/after the last commit of an unchanged
         # version) — exactly when two tasks with this key see one content
         dedup = (ckey, ver) if (cache and read_ts >= last_commit) else None
-        chunk = self._run_engines(dag, batch, engine, sctx=sctx, dedup=dedup)
+        chunk = self._run_engines(dag, batch, engine, sctx=sctx, dedup=dedup, bo=bo)
         if cache and read_ts >= last_commit:
             self.results.put(ckey, chunk, ver, last_commit, batch.n_rows)
         return [chunk]
@@ -371,7 +443,8 @@ class CopClient:
         return est
 
     def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str,
-                     sctx: SchedCtx | None = None, dedup=None) -> Chunk:
+                     sctx: SchedCtx | None = None, dedup=None,
+                     bo: Backoffer | None = None) -> Chunk:
         self._bump("tasks")
         if engine == "auto" and batch.n_rows < self.AUTO_MIN_ROWS:
             engine = "host"
@@ -396,38 +469,86 @@ class CopClient:
         # device slot + the group's RU estimate until release settles the
         # measured cost
         ctl = self.ctl if (sctx is None or sctx.enabled) else None
-        ticket = None
-        if ctl is not None:
-            ticket = ctl.scheduler.acquire(sctx or SchedCtx())
-            if ticket.wait_s:
-                self._bump("sched_wait_ms", ticket.wait_s * 1000.0)
-        try:
-            _fp("sched/engine-stall")
-            if engine in ("tpu", "auto"):
-                try:
-                    if ctl is not None:
-                        chunk = ctl.batcher.execute(
-                            self.tpu, dag, batch, dedup_key=dedup, stats=self._bump
-                        )
+        if bo is None:
+            bo = Backoffer.for_ctx(sctx, stats=self._bump)
+        while True:
+            if bo.abort is not None and bo.abort.is_set():
+                raise QueryInterrupted("cop stream abandoned")
+            ticket = None
+            if ctl is not None:
+                ticket = ctl.scheduler.acquire(
+                    sctx or SchedCtx(),
+                    stop=bo.abort.is_set if bo.abort is not None else None,
+                )
+                if ticket.wait_s:
+                    self._bump("sched_wait_ms", ticket.wait_s * 1000.0)
+            try:
+                _fp("sched/engine-stall")
+                if engine in ("tpu", "auto"):
+                    breaker = self.tpu.breaker
+                    if not breaker.allow():
+                        # open breaker: 'auto' routes host at zero exception
+                        # cost; forced 'tpu' fails fast with the state
+                        if engine == "tpu":
+                            breaker.raise_open()
+                        self._bump("breaker_skips")
                     else:
-                        chunk = self.tpu.execute(dag, batch)
-                    self._bump("tpu_tasks")
-                    return chunk
-                except Exception:
-                    if engine == "tpu":
-                        raise
-                    # a device-path failure must never be silent: it is a
-                    # correctness bug masked by the host answer (VERDICT Weak#5)
-                    self._bump("fallback_errors")
-                    log.exception("TPU engine raised; falling back to host engine")
-            chunk = execute_dag_host(dag, batch)
-            self._bump("host_tasks")
-            return chunk
-        finally:
-            if ticket is not None:
-                ru = ru_cost(batch.n_rows)
-                ctl.scheduler.release(ticket, ru)
-                self._bump("ru", ru)
+                        try:
+                            _fp("cop/device-error")
+                            if ctl is not None:
+                                chunk = ctl.batcher.execute(
+                                    self.tpu, dag, batch, dedup_key=dedup, stats=self._bump
+                                )
+                            else:
+                                chunk = self.tpu.execute(dag, batch)
+                        except Exception as exc:
+                            err = classify_device_error(exc)
+                            if err is None:
+                                # not a device fault (kill/quota/SQL error):
+                                # propagate untouched, no fault counted —
+                                # but release a held half-open probe slot
+                                breaker.record_aborted()
+                                raise
+                            tripped = breaker.record_failure(exc)
+                            if isinstance(err, DeviceTransientError) and not tripped:
+                                # release the device slot while sleeping so
+                                # backoff never holds admission capacity,
+                                # then retry the device path
+                                if ticket is not None:
+                                    ctl.scheduler.release(ticket)
+                                    ticket = None
+                                try:
+                                    bo.backoff(BO_DEVICE, err)
+                                except BackoffExhausted as bex:
+                                    if engine == "tpu":
+                                        raise
+                                    err = bex
+                                else:
+                                    continue
+                            if engine == "tpu":
+                                raise err from exc
+                            # a device-path failure must never be silent: it
+                            # is a correctness bug masked by the host answer
+                            # (VERDICT Weak#5)
+                            self._bump("fallback_errors")
+                            # keep the stack: a fatal classification may be
+                            # a masked lowering bug (VERDICT Weak#5)
+                            log.warning(
+                                "TPU engine fault (%s); falling back to host engine",
+                                err, exc_info=exc,
+                            )
+                        else:
+                            breaker.record_success()
+                            self._bump("tpu_tasks")
+                            return chunk
+                chunk = execute_dag_host(dag, batch)
+                self._bump("host_tasks")
+                return chunk
+            finally:
+                if ticket is not None:
+                    ru = ru_cost(batch.n_rows)
+                    ctl.scheduler.release(ticket, ru)
+                    self._bump("ru", ru)
 
     # --- index scans (ref: executor/distsql.go IndexReader/IndexLookUp) ---
 

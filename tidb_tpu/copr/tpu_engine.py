@@ -231,6 +231,8 @@ class TPUEngine:
     MAX_FUSE = 64  # largest vmapped launch group (and largest size bucket)
 
     def __init__(self):
+        from .retry import CircuitBreaker
+
         self._programs: dict = {}  # (digest, T, domains) -> compiled fn
         self._raw: dict = {}  # program key -> raw traceable kernel
         self._vprograms: dict = {}  # (key, group_cap) -> jit(vmap(raw))
@@ -239,6 +241,10 @@ class TPUEngine:
         self._lock = Lock()  # cop pool workers share this engine
         self.compile_count = 0
         self.fallbacks = 0
+        # device-fault circuit breaker (copr/retry.py): the cop client
+        # records successes/faults at the engine boundary; a store's whole
+        # auto traffic routes host while open
+        self.breaker = CircuitBreaker()
 
     # --- public ------------------------------------------------------------
 

@@ -33,12 +33,20 @@ from .resource_group import (
     ResourceGroupManager,
     TokenBucket,
 )
-from .scheduler import AdmissionScheduler, SchedCtx, Ticket, ru_cost
+from .scheduler import (
+    AdmissionScheduler,
+    SchedCtx,
+    Ticket,
+    raise_if_interrupted,
+    ru_cost,
+    sleep_interruptible,
+)
 
 __all__ = [
     "AdmissionScheduler", "DEFAULT_GROUP", "LaunchBatcher", "PRIORITIES",
     "ResourceController", "ResourceGroup", "ResourceGroupManager",
-    "SchedCtx", "Ticket", "TokenBucket", "ru_cost",
+    "SchedCtx", "Ticket", "TokenBucket", "raise_if_interrupted", "ru_cost",
+    "sleep_interruptible",
 ]
 
 

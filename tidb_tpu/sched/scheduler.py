@@ -64,6 +64,50 @@ def ru_cost(rows: int) -> float:
     return 1.0 + rows / 1024.0
 
 
+def raise_if_interrupted(session=None, deadline=None) -> None:
+    """The deadline/KILL gate, shared by admission waits AND cop-path
+    backoff sleeps (copr/retry.py): one definition of "stop now" so a
+    KILLed or timed-out statement escapes every wait the same way. The
+    raised error carries `.reason` ("killed" | "timeout") for metric
+    labeling."""
+    if session is not None and getattr(session, "_killed", False):
+        session._killed = False
+        e = QueryInterrupted("Query execution was interrupted")
+        e.reason = "killed"
+        raise e
+    if deadline is not None and time.monotonic() >= deadline:
+        e = QueryInterrupted(
+            "Query execution was interrupted, maximum statement execution time exceeded"
+        )
+        e.reason = "timeout"
+        raise e
+
+
+def sleep_interruptible(seconds: float, deadline=None, session=None, stop=None) -> None:
+    """Deadline/KILL-aware sleep: naps in scheduler-tick slices so a task
+    backing off between retries observes KILL / max_execution_time within
+    one poll interval instead of finishing its full backoff first. `stop`
+    (optional () -> bool) aborts the wait the same way when its stream was
+    abandoned — the drain path must not ride out full backoff budgets."""
+    end = time.monotonic() + seconds
+    while True:
+        # abandon check FIRST: raise_if_interrupted consumes the one-shot
+        # _killed flag, and an abandoned task's interrupt is swallowed by
+        # the stream drain — it must not eat a KILL meant for live work
+        if stop is not None and stop():
+            e = QueryInterrupted("cop stream abandoned")
+            e.reason = "abandoned"
+            raise e
+        raise_if_interrupted(session, deadline)
+        now = time.monotonic()
+        if now >= end:
+            return
+        nap = min(AdmissionScheduler._TICK_S, end - now)
+        if deadline is not None:
+            nap = min(nap, max(deadline - now, 0.001))
+        time.sleep(nap)
+
+
 class AdmissionScheduler:
     MAX_QUEUE = 256  # waiters beyond this hard-fail (backpressure edge)
     EST_RU = 1.0  # debited at admission, settled at release
@@ -89,7 +133,10 @@ class AdmissionScheduler:
 
     # --- admission ----------------------------------------------------------
 
-    def acquire(self, ctx: SchedCtx) -> Ticket:
+    def acquire(self, ctx: SchedCtx, stop=None) -> Ticket:
+        """`stop` (optional () -> bool): abort the wait when the owning
+        cop stream was abandoned — a drained task must not sit out the
+        admission queue to run work whose result is already discarded."""
         _fp("sched/before-admit")
         g = self.groups.get(ctx.group)
         t0 = time.monotonic()
@@ -114,17 +161,19 @@ class AdmissionScheduler:
                     self._grant_locked()
                     if w.granted:
                         break
-                    sess = ctx.session
-                    if sess is not None and getattr(sess, "_killed", False):
-                        sess._killed = False
-                        M.SCHED_TASKS.inc(group=g.name, outcome="killed")
-                        raise QueryInterrupted("Query execution was interrupted")
-                    now = time.monotonic()
-                    if ctx.deadline is not None and now >= ctx.deadline:
-                        M.SCHED_TASKS.inc(group=g.name, outcome="timeout")
-                        raise QueryInterrupted(
-                            "Query execution was interrupted, maximum statement execution time exceeded"
+                    if stop is not None and stop():
+                        M.SCHED_TASKS.inc(group=g.name, outcome="abandoned")
+                        e = QueryInterrupted("cop stream abandoned")
+                        e.reason = "abandoned"
+                        raise e
+                    try:
+                        raise_if_interrupted(ctx.session, ctx.deadline)
+                    except QueryInterrupted as e:
+                        M.SCHED_TASKS.inc(
+                            group=g.name, outcome=getattr(e, "reason", "killed")
                         )
+                        raise
+                    now = time.monotonic()
                     timeout = self._TICK_S
                     if ctx.deadline is not None:
                         timeout = min(timeout, max(ctx.deadline - now, 0.001))

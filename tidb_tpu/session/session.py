@@ -3560,10 +3560,19 @@ class Session:
                 f"wait:{d['sched_wait_ms']:.3f}ms ru:{d['ru']:.2f} "
                 f"batched:{d['batched_tasks']} dedup:{d['dedup_tasks']}"
             )
+        if d["retries"] or d["breaker_skips"]:
+            # fault-tolerance line: typed backoff retries this statement
+            # paid, and device launches skipped by an open breaker
+            lines.append(
+                f"retry: backoffs:{d['retries']} backoff_ms:{d['backoff_ms']:.3f} "
+                f"breaker_skips:{d['breaker_skips']}"
+            )
         if self.cop._tpu:
+            br = self.cop.tpu.breaker
             lines.append(
                 f"tpu: compiles:{self.cop.tpu.compile_count - tpu0[0]} "
-                f"fallbacks:{self.cop.tpu.fallbacks - tpu0[1]}"
+                f"fallbacks:{self.cop.tpu.fallbacks - tpu0[1]} "
+                f"breaker:{br.state} trips:{br.trips}"
             )
         lines.append(f"total: {wall_ms:.3f}ms")
         chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(l)] for l in lines])

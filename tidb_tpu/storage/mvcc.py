@@ -177,6 +177,14 @@ class MVCCStore:
             if not k.startswith(b"w") or (end is not None and k[1:-8] >= end):
                 break
             ukey = k[1:-8]
+            if ukey < start:
+                # iter_from(b"w"+start) can land mid-version-space of the
+                # PRECEDING user key when `start` falls strictly inside a
+                # stored key's (ukey || rev_ts) span — e.g. a region split
+                # at a non-record-key boundary (chaos found this): the
+                # rev_ts bytes of ukey's versions sort above start's
+                # suffix. Half-open [start, end) means ukey >= start.
+                continue
             if ukey == last_key:
                 continue  # older version of an already-decided key
             ts = unrev_ts(k[-8:])

@@ -1,10 +1,23 @@
 """Failpoint framework — conditional fault-injection sites
 (ref: pingcap/failpoint; the reference compiles `failpoint.Inject` sites
 into 94 files and enables them per test via Makefile failpoint-enable.
-Here sites are always present and zero-cost when disarmed)."""
+Here sites are always present and zero-cost when disarmed).
+
+Actions an armed site can carry:
+  * an Exception instance or class — raised at the site
+  * a callable — invoked at the site
+  * ("sleep", seconds) — blocks the site
+  * ("prob", p, action) — fires `action` with probability p per hit
+    (the chaos-harness marker: 30%-probability device faults, random
+    region churn)
+  * ("nth", n, action) — fires `action` on every n-th hit (hit counts
+    reset when the site is re-armed), for "fail exactly between step A
+    and step B" regression tests
+"""
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -15,10 +28,10 @@ class Failpoints:
         self._active: dict[str, object] = {}
         self._hits: dict[str, int] = {}
         self._lock = threading.Lock()
+        self._rng = random.Random()
 
     def enable(self, name: str, action) -> None:
-        """action: an Exception instance (raised at the site), a callable
-        (invoked at the site), or ("sleep", seconds)."""
+        """action: see the module docstring for the accepted shapes."""
         with self._lock:
             self._active[name] = action
             self._hits[name] = 0  # fresh count per arm cycle
@@ -32,16 +45,40 @@ class Failpoints:
             self._active.clear()
             self._hits.clear()
 
+    def seed(self, n: int) -> None:
+        """Deterministic ("prob", ...) firing for reproducible chaos runs."""
+        with self._lock:
+            self._rng.seed(n)
+
     def hits(self, name: str) -> int:
-        return self._hits.get(name, 0)
+        with self._lock:
+            return self._hits.get(name, 0)
 
     def inject(self, name: str) -> None:
-        """The site call: no-op unless armed."""
-        action = self._active.get(name)
-        if action is None:
-            return
+        """The site call: no-op unless armed. The action lookup, hit-count
+        bump and conditional-firing decision happen under ONE lock hold —
+        a concurrent disable_all between the read and the count can no
+        longer resurrect the hit entry, and the nth counter can't race."""
         with self._lock:
-            self._hits[name] = self._hits.get(name, 0) + 1
+            action = self._active.get(name)
+            if action is None:
+                return
+            hits = self._hits.get(name, 0) + 1
+            self._hits[name] = hits
+            if isinstance(action, tuple) and action:
+                if action[0] == "prob":
+                    if self._rng.random() >= action[1]:
+                        return
+                    action = action[2]
+                elif action[0] == "nth":
+                    if hits % action[1] != 0:
+                        return
+                    action = action[2]
+        # fire OUTSIDE the lock: sleeps and callables may block or re-enter
+        self._fire(action)
+
+    @staticmethod
+    def _fire(action) -> None:
         if isinstance(action, BaseException):
             raise action
         if isinstance(action, type) and issubclass(action, BaseException):

@@ -246,3 +246,21 @@ SCHED_BATCH_OCCUPANCY = REGISTRY.histogram(
 RU_CONSUMED = REGISTRY.counter(
     "tidb_resource_group_ru_total", "request units consumed per resource group"
 )
+
+# fault-tolerance series (ref: metrics/tikvclient.go backoff counters; the
+# breaker is this reproduction's addition for the accelerator path)
+COP_RETRIES = REGISTRY.counter(
+    "tidb_cop_retries_total", "cop-task backoff retries by error class"
+)
+COP_BACKOFF = REGISTRY.histogram(
+    "tidb_cop_backoff_seconds", "per-retry backoff sleep on the cop path"
+)
+BREAKER_STATE = REGISTRY.gauge(
+    "tidb_tpu_breaker_state", "TPU engine circuit breaker state (0 closed, 1 half-open, 2 open)"
+)
+BREAKER_TRIPS = REGISTRY.counter(
+    "tidb_tpu_breaker_trips_total", "TPU engine circuit breaker trips to open"
+)
+# both breaker series carry an engine="e<n>" label (one per breaker
+# instance); a breaker publishes only on its first state transition, so
+# idle breakers never add series
