@@ -1,0 +1,118 @@
+"""Prometheus text-format conformance for Registry.render() and the
+/metrics HTTP endpoint (ref: the exposition format spec §text format:
+HELP/TYPE ordering, label-value escaping, cumulative histogram buckets)."""
+
+import urllib.request
+
+import pytest
+
+from tidb_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+
+
+class TestTextFormat:
+    def test_help_and_type_precede_samples(self):
+        reg = Registry()
+        reg.counter("a_total", "first").inc()
+        reg.histogram("b_seconds", "second").observe(0.01)
+        reg.gauge("c_depth", "third").set(2)
+        lines = reg.render().splitlines()
+        for name, typ in (("a_total", "counter"), ("b_seconds", "histogram"), ("c_depth", "gauge")):
+            idx_help = lines.index(f"# HELP {name} " + {"a_total": "first", "b_seconds": "second", "c_depth": "third"}[name])
+            assert lines[idx_help + 1] == f"# TYPE {name} {typ}"
+            # every sample line for this metric comes after its TYPE line
+            for i, ln in enumerate(lines):
+                if ln.startswith(name) and not ln.startswith("#"):
+                    assert i > idx_help + 1
+        assert reg.render().endswith("\n")
+
+    def test_label_value_escaping(self):
+        c = Counter("esc_total", "escaping")
+        c.inc(sql='say "hi"\nback\\slash')
+        line = [l for l in c.render() if not l.startswith("#")][0]
+        assert line == 'esc_total{sql="say \\"hi\\"\\nback\\\\slash"} 1.0'
+        # no raw newline/quote survives into the exposition line
+        assert "\n" not in line
+
+    def test_gauge_label_escaping_and_sorting(self):
+        g = Gauge("g_val", "gauge")
+        g.set(1.0, b="x", a='q"q')
+        line = [l for l in g.render() if not l.startswith("#")][0]
+        # labels render sorted by key, values escaped
+        assert line == 'g_val{a="q\\"q",b="x"} 1.0'
+
+    def test_histogram_buckets_cumulative_with_inf(self):
+        h = Histogram("h_seconds", "hist", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        lines = h.render()
+        buckets = [l for l in lines if "_bucket" in l]
+        assert buckets == [
+            'h_seconds_bucket{le="0.1"} 2',
+            'h_seconds_bucket{le="1.0"} 3',
+            'h_seconds_bucket{le="10.0"} 4',
+            'h_seconds_bucket{le="+Inf"} 5',
+        ]
+        assert f"h_seconds_sum {0.05 + 0.05 + 0.5 + 5.0 + 50.0}" in lines
+        assert "h_seconds_count 5" in lines
+        # cumulative counts are monotonically non-decreasing
+        counts = [float(l.rsplit(" ", 1)[1]) for l in buckets]
+        assert counts == sorted(counts)
+        # +Inf bucket equals the observation count (spec requirement)
+        assert counts[-1] == 5
+
+    def test_registry_renders_metrics_sorted_by_name(self):
+        reg = Registry()
+        reg.counter("z_total", "z").inc()
+        reg.counter("a_total", "a").inc()
+        lines = reg.render().splitlines()
+        assert lines.index("# HELP a_total a") < lines.index("# HELP z_total z")
+
+
+class TestMetricsEndpoint:
+    @pytest.fixture()
+    def srv(self):
+        from tidb_tpu.server import Server
+        from tidb_tpu.session import Session
+
+        sess = Session()
+        sess.execute("CREATE TABLE m (id INT PRIMARY KEY, v INT)")
+        sess.execute("INSERT INTO m VALUES (1, 10), (2, 20)")
+        sess.must_query("SELECT SUM(v) FROM m")
+        server = Server(storage=sess.store, port=0, status_port=0)
+        server.start()
+        yield server
+        server.close()
+
+    def test_endpoint_content_type_and_parseable(self, srv):
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.status_port}/metrics", timeout=10
+        )
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode()
+        seen_type: dict[str, str] = {}
+        for ln in body.splitlines():
+            if not ln:
+                continue
+            if ln.startswith("# TYPE "):
+                _, _, name, typ = ln.split(" ", 3)
+                seen_type[name] = typ
+                continue
+            if ln.startswith("#"):
+                continue
+            # every sample parses as "name{labels} value" with a float value
+            head, _, val = ln.rpartition(" ")
+            float(val)
+            base = head.split("{", 1)[0]
+            root = base
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix) and base[: -len(suffix)] in seen_type:
+                    root = base[: -len(suffix)]
+            assert root in seen_type, f"sample {ln!r} precedes its TYPE line"
+        # the device-path series registered by PR 3 are exposed
+        for series in (
+            "tidb_tpu_compile_seconds",
+            "tidb_tpu_compile_cache_total",
+            "tidb_tpu_transfer_bytes_total",
+            "tidb_tpu_device_execute_seconds",
+        ):
+            assert f"# TYPE {series} " in body, f"missing {series}"

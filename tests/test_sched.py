@@ -271,20 +271,28 @@ class TestAdmission:
 
     def test_failpoint_stall_backpressure_not_deadlock(self, s):
         """An injected engine stall holds device slots; excess arrivals
-        must hard-fail with the queue-full error (backpressure), and the
-        stalled tasks must still complete (no deadlock)."""
+        hit the queue-full backpressure edge — now typed ServerBusy, so
+        the cop client retries it through the Backoffer until the
+        statement's backoff budget runs out (set to ~0 here so overload
+        still surfaces promptly) — and the stalled tasks must still
+        complete (no deadlock)."""
+        from tidb_tpu.errors import BackoffExhausted
+
         ctl = s.store.sched
         old_conc, old_q = ctl.scheduler.max_concurrency, ctl.scheduler.MAX_QUEUE
         ctl.scheduler.max_concurrency = 1
         ctl.scheduler.MAX_QUEUE = 1
         sessions = [Session(s.store) for _ in range(4)]
+        for sess in sessions:
+            sess.vars["tidb_backoff_budget_ms"] = "0"
         oks, rejected = [], []
 
         def run(sess):
             try:
                 r = sess.must_query("SELECT SUM(v) FROM t")
                 oks.append(r)
-            except ResourceGroupQueueFull:
+            except BackoffExhausted as e:
+                assert "serverBusy" in str(e)
                 rejected.append(1)
 
         try:

@@ -1,0 +1,407 @@
+"""End-to-end statement tracing (PR 3): span trees over the full cop
+path — admission waits, batched-launch fan-out attribution, backoff
+sleeps by error class, device compile/transfer/execute phases — plus the
+TIDB_TRACE ring memtable, /debug/trace, the new slow-log /
+STATEMENTS_SUMMARY exec-detail columns, the tidb_backoff_budget_ms
+sysvar, and the ServerBusy admission backpressure retry path."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tidb_tpu.errors import BackoffExhausted, DeviceTransientError
+from tidb_tpu.sched import SchedCtx
+from tidb_tpu.session import Session
+from tidb_tpu.utils import tracing
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, v INT)")
+    sess.execute(
+        "INSERT INTO t VALUES " + ",".join(f"({i}, {i % 7}, {i * 3})" for i in range(4096))
+    )
+    sess.vars["tidb_cop_engine"] = "tpu"
+    sess.vars["tidb_enable_cop_result_cache"] = "OFF"
+    return sess
+
+
+def _ops(rows):
+    return [r[0] for r in rows]
+
+
+class TestTraceTree:
+    def test_trace_shows_full_cop_path(self, s):
+        rows = s.must_query("TRACE SELECT g, SUM(v) FROM t GROUP BY g")
+        ops = _ops(rows)
+        assert ops[0] == "session.execute"
+        assert any("cop.task" in o for o in ops)
+        assert any("sched.admission" in o for o in ops), ops
+        assert any("device.execute" in o for o in ops), ops
+        # fresh store → at least one program compiled under this statement
+        assert any("device.compile" in o for o in ops), ops
+        assert any("executor." in o for o in ops)
+        assert all(r[1].endswith("ms") and r[2].endswith("ms") for r in rows)
+        # spans nest: device phases render BELOW the task level (dotted)
+        dev = next(o for o in ops if "device.execute" in o)
+        assert dev.startswith(".")
+
+    def test_chaos_retry_appears_as_extra_spans_not_corruption(self, s):
+        """An injected transient device fault adds backoff spans labeled
+        by error class; the tree stays a tree (every parent resolvable,
+        exactly one root)."""
+        s.vars["tidb_enable_trace"] = "ON"
+        calls = {"n": 0}
+
+        def fail_once():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise DeviceTransientError("unavailable: injected fault")
+
+        with FP.enabled("cop/device-error", fail_once):
+            res = s.must_query("SELECT SUM(v) FROM t")
+        assert res == [(str(sum(i * 3 for i in range(4096))),)]
+        tr = s.store.trace_ring.snapshot()[-1]
+        names = [sp["operation"] for sp in tr["spans"]]
+        assert any(n == "backoff.deviceTransient" for n in names), names
+        assert tr["counters"].get("retries", 0) >= 1
+        assert tr["counters"].get("backoff_ms", 0) > 0
+        ids = {sp["span_id"] for sp in tr["spans"]}
+        roots = [sp for sp in tr["spans"] if sp["parent_id"] == 0]
+        assert len(roots) == 1 and roots[0]["operation"] == "session.execute"
+        for sp in tr["spans"]:
+            if sp["parent_id"] != 0:
+                assert sp["parent_id"] in ids, f"dangling parent in {sp}"
+
+    def test_trace_statement_still_gated_and_legacy_spans(self, s):
+        """TRACE keeps its contract: sched summary span format and the
+        executor spans EXPLAIN ANALYZE uses."""
+        ops = _ops(s.must_query("TRACE SELECT COUNT(*) FROM t"))
+        sched = [o for o in ops if o.startswith("cop.sched[group=default")]
+        assert sched and "ru=" in sched[0] and "batched=" in sched[0]
+
+
+class TestFanoutAttribution:
+    def _pairs(self, s, queries):
+        ctl = s.store.sched
+        pairs = []
+        real = ctl.batcher.execute
+
+        def capture(engine, dag, batch, dedup_key=None, stats=None):
+            pairs.append((dag, batch))
+            return real(engine, dag, batch, dedup_key=dedup_key, stats=stats)
+
+        ctl.batcher.execute = capture
+        try:
+            for q in queries:
+                s.must_query(q)
+        finally:
+            ctl.batcher.execute = real
+        assert pairs
+        return pairs
+
+    def test_shared_launch_span_fans_out_with_identical_ids(self, s):
+        """Co-batched waiters each see THE shared launch span in their own
+        trace: same span/launch id, occupancy covering every waiter,
+        parented under each waiter's own task span."""
+        ctl = s.store.sched
+        eng = ctl.tpu_engine
+        (dag, batch) = self._pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        n = 3
+        for _ in range(5):  # barrier makes coalescing near-certain; retry races
+            traces = [
+                tracing.StatementTrace(sql=f"q{i}", session_id=i + 1, recording=True)
+                for i in range(n)
+            ]
+            task_ids = [None] * n
+            barrier = threading.Barrier(n)
+
+            def run(i):
+                with tracing.activate(traces[i]):
+                    with traces[i].span("cop.task") as sp:
+                        task_ids[i] = sp.span.span_id
+                        barrier.wait()
+                        ctl.batcher.execute(eng, dag, batch)
+
+            threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=60)
+            assert not any(th.is_alive() for th in threads)
+            launches = [
+                [sp for sp in tr.spans if sp.name == "cop.launch"] for tr in traces
+            ]
+            shared = {}
+            for i, ls in enumerate(launches):
+                for sp in ls:
+                    shared.setdefault(sp.span_id, []).append((i, sp))
+            multi = [v for v in shared.values() if len(v) >= 2]
+            if not multi:
+                continue  # solo-raced this round; retry
+            group = max(multi, key=len)
+            occ = group[0][1].tags["occupancy"]
+            assert occ == len(group), (occ, len(group))
+            for i, sp in group:
+                assert sp.tags["launch_id"] == sp.span_id
+                assert sp.parent_id == task_ids[i], "launch not under the waiter's own task span"
+                assert traces[i].counters.get("batch_occupancy") == occ
+            # the runner tag names ONE trace — the statement that ran it
+            runners = {sp.tags["runner"] for _, sp in group}
+            assert len(runners) == 1
+            assert runners.pop() in {tr.trace_id for tr in traces}
+            return
+        pytest.fail("no co-batched launch formed in 5 attempts")
+
+
+class TestFanoutSameTrace:
+    def test_sibling_tasks_of_one_statement_adopt_launch_once(self):
+        """Two cop tasks of the SAME statement co-batched into one launch
+        adopt the shared span (and its phase children) once, not once per
+        task — tree() must not render a children cross-product."""
+        import time as _time
+        from types import SimpleNamespace
+
+        from tidb_tpu.sched.batcher import LaunchBatcher, _Job
+
+        tr = tracing.StatementTrace(sql="q", recording=True)
+        with tracing.activate(tr):
+            jobs = [_Job(None, None, None), _Job(None, None, None)]
+        b = LaunchBatcher()
+        b._attribute(jobs, SimpleNamespace(n_dedup=0), _time.perf_counter_ns(),
+                     {"execute_ms": 1.0, "d2h_bytes": 8})
+        names = [sp.name for sp in tr.spans]
+        assert names.count("cop.launch") == 1, names
+        assert names.count("device.execute") == 1, names
+        rendered = [sp.name for _, sp in tr.tree()]
+        assert rendered.count("device.execute") == 1, rendered
+        assert tr.counters.get("batch_occupancy") == 2
+
+
+class TestFanoutTwoSessions:
+    def test_two_sessions_share_launch_span_end_to_end(self, s):
+        """The acceptance shape: two concurrent SESSIONS co-batched into
+        one device launch each carry the shared launch span — identical
+        launch ids, occupancy covering both — in their own ring trace."""
+        ctl = s.store.sched
+        old_window = ctl.batcher.WINDOW_S
+        ctl.batcher.WINDOW_S = 0.05  # widen the follower window: determinism
+        sessions = [Session(s.store) for _ in range(4)]
+        for sess in sessions:
+            sess.vars["tidb_cop_engine"] = "tpu"
+            sess.vars["tidb_enable_cop_result_cache"] = "OFF"
+            sess.vars["tidb_enable_trace"] = "ON"
+        q = "SELECT g, SUM(v) FROM t GROUP BY g"
+        s.must_query(q)  # warm the compiled program
+        try:
+            for _ in range(5):
+                barrier = threading.Barrier(len(sessions))
+
+                def run(sess):
+                    barrier.wait()
+                    sess.must_query(q)
+
+                threads = [threading.Thread(target=run, args=(x,)) for x in sessions]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join(timeout=60)
+                assert not any(th.is_alive() for th in threads)
+                # latest trace per session, from the shared store ring
+                traces = {}
+                for tr in s.store.trace_ring.snapshot():
+                    if tr["sql"] == q:
+                        traces[tr["session_id"]] = tr
+                shared: dict = {}
+                for sid, tr in traces.items():
+                    for sp in tr["spans"]:
+                        if sp["operation"] == "cop.launch":
+                            shared.setdefault(sp["tags"]["launch_id"], []).append(
+                                (sid, sp)
+                            )
+                multi = [v for v in shared.values() if len({sid for sid, _ in v}) >= 2]
+                if not multi:
+                    continue
+                group = max(multi, key=len)
+                occ = group[0][1]["tags"]["occupancy"]
+                assert occ >= 2
+                ids = {sp["span_id"] for _, sp in group}
+                assert len(ids) == 1, "launch ids differ across sessions"
+                for _, sp in group:
+                    assert sp["tags"]["occupancy"] == occ
+                return
+            pytest.fail("no cross-session co-batched launch in 5 attempts")
+        finally:
+            ctl.batcher.WINDOW_S = old_window
+
+
+class TestBackoffBudgetSysvar:
+    def test_for_ctx_reads_ctx_budget(self):
+        from tidb_tpu.copr.retry import COP_BACKOFF_BUDGET_MS, Backoffer
+
+        assert Backoffer.for_ctx(None).budget_ms == COP_BACKOFF_BUDGET_MS
+        assert Backoffer.for_ctx(SchedCtx(backoff_budget_ms=123.0)).budget_ms == 123.0
+
+    def test_session_scope_budget_exhausts_fast(self, s):
+        s.execute("SET tidb_backoff_budget_ms = 0")
+        with FP.enabled("cop/device-error", DeviceTransientError("unavailable: chronic")):
+            with pytest.raises(BackoffExhausted) as ei:
+                s.must_query("SELECT SUM(v) FROM t")
+        assert "0ms" in str(ei.value)
+
+    def test_statement_scope_via_set_var_hint(self, s):
+        """SET_VAR pins the budget for ONE statement; the session value
+        is untouched and the next statement retries normally again."""
+        assert s.vars["tidb_backoff_budget_ms"] == "2000"
+        with FP.enabled("cop/device-error", DeviceTransientError("unavailable: chronic")):
+            with pytest.raises(BackoffExhausted):
+                s.must_query(
+                    "SELECT /*+ SET_VAR(tidb_backoff_budget_ms=0) */ SUM(v) FROM t"
+                )
+        assert s.vars["tidb_backoff_budget_ms"] == "2000"
+        calls = {"n": 0}
+
+        def fail_once():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise DeviceTransientError("unavailable: once")
+
+        with FP.enabled("cop/device-error", fail_once):
+            assert s.must_query("SELECT COUNT(*) FROM t") == [("4096",)]
+
+    def test_sysvar_validation(self, s):
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("SET tidb_backoff_budget_ms = 'banana'")
+
+
+class TestServerBusyBackpressure:
+    def test_queue_full_retried_as_server_busy(self, s):
+        """The admission queue-full edge is typed ServerBusy: the cop
+        client retries it through the Backoffer's serverBusy class and
+        surfaces BackoffExhausted naming it once the budget is gone."""
+        from tidb_tpu.utils import metrics as M
+
+        ctl = s.store.sched
+        sched = ctl.scheduler
+        old_q = sched.MAX_QUEUE
+        blockers = [sched.acquire(SchedCtx()) for _ in range(sched.max_concurrency)]
+        sched.MAX_QUEUE = 0
+        s.vars["tidb_backoff_budget_ms"] = "0"
+        before = M.COP_RETRIES.value(reason="serverBusy")
+        try:
+            with pytest.raises(BackoffExhausted) as ei:
+                s.must_query("SELECT SUM(v) FROM t")
+            assert "serverBusy" in str(ei.value)
+            assert M.COP_RETRIES.value(reason="serverBusy") > before
+        finally:
+            sched.MAX_QUEUE = old_q
+            for b in blockers:
+                sched.release(b)
+        # capacity restored: the same statement succeeds with budget left
+        s.vars["tidb_backoff_budget_ms"] = "2000"
+        assert s.must_query("SELECT COUNT(*) FROM t") == [("4096",)]
+
+
+class TestTraceSurfaces:
+    def test_ring_memtable_and_debug_endpoint(self, s):
+        from tidb_tpu.server import Server
+
+        s.execute("SET tidb_enable_trace = 'ON'")
+        s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        s.execute("SET tidb_enable_trace = 'OFF'")
+        rows = s.must_query(
+            "SELECT trace_id, operation FROM information_schema.tidb_trace"
+        )
+        assert any(op == "session.execute" for _, op in rows)
+        assert any("cop.task" in op for _, op in rows), rows
+        trace_ids = {tid for tid, _ in rows}
+        assert trace_ids
+        srv = Server(storage=s.store, port=0, status_port=0)
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/debug/trace", timeout=10
+            ).read().decode()
+        finally:
+            srv.close()
+        traces = json.loads(body)
+        assert {t["trace_id"] for t in traces} & trace_ids
+        t0 = traces[-1]
+        assert t0["spans"][0]["operation"] == "session.execute"
+        assert t0["duration_ms"] > 0
+
+    def test_slow_log_and_summary_exec_detail_columns(self, s):
+        s.vars["tidb_slow_log_threshold"] = "0"
+        s.must_query("SELECT g, SUM(v), MIN(v) FROM t GROUP BY g")
+        s.vars["tidb_slow_log_threshold"] = "300"
+        rows = s.must_query(
+            "SELECT query, sched_wait, batch_occupancy, retries, backoff_ms,"
+            " compile_ms, transfer_bytes FROM information_schema.slow_query"
+        )
+        mine = [r for r in rows if "MIN(v)" in r[0]]
+        assert mine, rows
+        q, wait, occ, retries, backoff, compile_ms, tbytes = mine[-1]
+        # fresh program key → this statement paid a compile and transfers
+        assert float(compile_ms) > 0
+        assert int(tbytes) > 0
+        assert int(retries) == 0 and float(backoff) == 0.0
+        srows = s.must_query(
+            "SELECT exec_count, sum_compile_ms, sum_transfer_bytes, max_batch_occupancy"
+            " FROM information_schema.statements_summary"
+            " WHERE digest_text LIKE '%MIN(v)%'"
+        )
+        assert len(srows) == 1
+        assert float(srows[0][1]) > 0 and int(srows[0][2]) > 0
+
+    def test_device_metrics_series(self, s):
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        body = REGISTRY.render()
+        for series in (
+            "tidb_tpu_compile_seconds_count",
+            'tidb_tpu_compile_cache_total{result="miss"}',
+            'tidb_tpu_transfer_bytes_total{dir="h2d"}',
+            'tidb_tpu_transfer_bytes_total{dir="d2h"}',
+            "tidb_tpu_device_execute_seconds_count",
+        ):
+            assert series in body, f"missing {series}"
+        # steady state: re-running the same statement is a cache hit
+        hit0 = '{result="hit"}'
+        s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        assert f"tidb_tpu_compile_cache_total{hit0}" in REGISTRY.render()
+
+    def test_disabled_tracing_records_no_spans(self, s):
+        n0 = len(s.store.trace_ring.snapshot())
+        s.must_query("SELECT COUNT(*) FROM t")
+        assert len(s.store.trace_ring.snapshot()) == n0
+
+
+class TestMetricsHistoryTick:
+    def test_statement_completion_fills_summary_window(self, s):
+        """METRICS_SUMMARY windows fill under a pure-SQL workload — no
+        metrics reader ever polls; statement completion drives tick()."""
+        from tidb_tpu.utils.metrics import HISTORY
+
+        with HISTORY._lock:
+            HISTORY._ring.clear()
+        s.must_query("SELECT COUNT(*) FROM t")
+        with HISTORY._lock:
+            n = len(HISTORY._ring)
+        assert n == 1, "statement completion did not record a metrics sample"
+        # min-interval guard: an immediate second statement adds no sample
+        s.must_query("SELECT COUNT(*) FROM t")
+        with HISTORY._lock:
+            assert len(HISTORY._ring) == 1

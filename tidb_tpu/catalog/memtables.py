@@ -21,12 +21,30 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         [ft_varchar(64), ft_varchar(64), ft_varchar(64), ft_longlong(), ft_varchar(32)],
     ),
     "slow_query": (
-        ["TIME", "USER", "DB", "QUERY_TIME", "DIGEST", "SUCC", "QUERY"],
-        [ft_varchar(32), ft_varchar(32), ft_varchar(64), ft_double(), ft_varchar(32), ft_longlong(), ft_varchar(512)],
+        ["TIME", "USER", "DB", "QUERY_TIME", "DIGEST", "SUCC", "QUERY",
+         # cop-path exec details (PR 3): admission wait, launch batching,
+         # retries/backoff, device compile + host<->device transfer
+         "SCHED_WAIT", "BATCH_OCCUPANCY", "RETRIES", "BACKOFF_MS",
+         "COMPILE_MS", "TRANSFER_BYTES"],
+        [ft_varchar(32), ft_varchar(32), ft_varchar(64), ft_double(), ft_varchar(32), ft_longlong(), ft_varchar(512),
+         ft_double(), ft_longlong(), ft_longlong(), ft_double(),
+         ft_double(), ft_longlong()],
     ),
     "statements_summary": (
-        ["DIGEST", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY", "AVG_LATENCY", "ERRORS", "DIGEST_TEXT"],
-        [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_longlong(), ft_varchar(256)],
+        ["DIGEST", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY", "AVG_LATENCY", "ERRORS", "DIGEST_TEXT",
+         "SUM_SCHED_WAIT", "MAX_BATCH_OCCUPANCY", "SUM_RETRIES",
+         "SUM_BACKOFF_MS", "SUM_COMPILE_MS", "SUM_TRANSFER_BYTES"],
+        [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_longlong(), ft_varchar(256),
+         ft_double(), ft_longlong(), ft_longlong(),
+         ft_double(), ft_double(), ft_longlong()],
+    ),
+    "tidb_trace": (
+        # flattened span rows of the last-N statement traces
+        # (utils/tracing.TraceRing; one row per span, root included)
+        ["TRACE_ID", "SESSION_ID", "SPAN_ID", "PARENT_SPAN_ID", "OPERATION",
+         "START_MS", "DURATION_MS", "TAGS", "SQL"],
+        [ft_varchar(32), ft_longlong(), ft_longlong(), ft_longlong(), ft_varchar(128),
+         ft_double(), ft_double(), ft_varchar(256), ft_varchar(512)],
     ),
     "metrics": (
         ["NAME", "LABELS", "VALUE"],
@@ -119,6 +137,12 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.s(ts), Datum.s(e["user"]), Datum.s(e["db"]),
                 Datum.f(e["query_time_s"]), Datum.s(e["digest"]),
                 Datum.i(1 if e["succ"] else 0), Datum.s(e["query"]),
+                Datum.f(e.get("sched_wait_ms", 0.0) / 1000.0),
+                Datum.i(int(e.get("batch_occupancy", 0))),
+                Datum.i(int(e.get("retries", 0))),
+                Datum.f(e.get("backoff_ms", 0.0)),
+                Datum.f(e.get("compile_ms", 0.0)),
+                Datum.i(int(e.get("transfer_bytes", 0))),
             ])
         return out
     if name == "statements_summary":
@@ -132,7 +156,26 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.s(st["digest"]), Datum.i(st["exec_count"]),
                 Datum.f(st["sum_latency_s"]), Datum.f(st["max_latency_s"]),
                 Datum.f(avg), Datum.i(st["errors"]), Datum.s(st["sample_sql"]),
+                Datum.f(st.get("sum_sched_wait_ms", 0.0) / 1000.0),
+                Datum.i(int(st.get("max_batch_occupancy", 0))),
+                Datum.i(int(st.get("sum_retries", 0))),
+                Datum.f(st.get("sum_backoff_ms", 0.0)),
+                Datum.f(st.get("sum_compile_ms", 0.0)),
+                Datum.i(int(st.get("sum_transfer_bytes", 0))),
             ])
+        return out
+    if name == "tidb_trace":
+        out = []
+        for tr in session.store.trace_ring.snapshot():
+            for sp in tr["spans"]:
+                tags = " ".join(f"{k}={v}" for k, v in sp["tags"].items())
+                out.append([
+                    Datum.s(tr["trace_id"]), Datum.i(tr["session_id"]),
+                    Datum.i(sp["span_id"]), Datum.i(sp["parent_id"]),
+                    Datum.s(sp["operation"]),
+                    Datum.f(sp["start_ms"]), Datum.f(sp["duration_ms"]),
+                    Datum.s(tags[:256]), Datum.s(tr["sql"][:512]),
+                ])
         return out
     if name == "metrics":
         from ..utils.metrics import REGISTRY

@@ -14,6 +14,7 @@ per-session (`tidb_cop_engine` sysvar: 'tpu' | 'host' | 'auto').
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass
@@ -33,13 +34,22 @@ from ..errors import (
     EpochNotMatch,
     NotLeader,
     QueryInterrupted,
+    ServerBusy,
 )
 from ..mysqltypes.datum import Datum, K_BYTES
 from ..sched import SchedCtx, ru_cost
+from ..utils import tracing
 from ..utils.failpoint import inject as _fp
 from .dag import DAGRequest
 from .host_engine import execute_dag_host
-from .retry import BO_DEVICE, BO_REGION_MISS, BO_UPDATE_LEADER, Backoffer, classify_device_error
+from .retry import (
+    BO_DEVICE,
+    BO_REGION_MISS,
+    BO_SERVER_BUSY,
+    BO_UPDATE_LEADER,
+    Backoffer,
+    classify_device_error,
+)
 from .tilecache import ColumnBatch, TileCache, decode_rows_to_batch
 
 
@@ -121,11 +131,31 @@ class CopClient:
             "breaker_skips": 0,
             "cancelled_tasks": 0,
             "drained_tasks": 0,
+            # device-path counters (EXPLAIN ANALYZE device line / tracing)
+            "compile_ms": 0,
+            "transfer_bytes": 0,
+            "device_ms": 0,
+            "host_ms": 0,
         }
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._lock:
             self.stats[key] += n
+
+    def _stats_fn(self, sctx):
+        """The per-call stats sink: the store-wide counters, mirrored into
+        the statement's trace when one is attached (per-statement exec
+        details for the slow log / STATEMENTS_SUMMARY / TRACE)."""
+        trace = getattr(sctx, "trace", None) if sctx is not None else None
+        if trace is None:
+            return self._bump
+        bump = self._bump
+
+        def both(key: str, n: float = 1) -> None:
+            bump(key, n)
+            trace.add(key, n)
+
+        return both
 
     @property
     def pool(self) -> ThreadPoolExecutor:
@@ -170,11 +200,22 @@ class CopClient:
         # GLOBAL-only toggle: read the live store value so SET GLOBAL takes
         # effect for every session immediately, not just newly-seeded ones
         enabled = sess.store.global_vars.get("tidb_enable_resource_control", "ON")
+        # backoff budget: statement scope (SET_VAR hint) wins over session
+        budget = None
+        raw = (getattr(sess, "_stmt_vars", None) or {}).get("tidb_backoff_budget_ms") \
+            or sess.vars.get("tidb_backoff_budget_ms")
+        if raw:
+            try:
+                budget = float(raw)
+            except ValueError:
+                budget = None
         return SchedCtx(
             group=sess.vars.get("tidb_resource_group", "default") or "default",
             deadline=getattr(sess, "_deadline", None),
             session=sess,
             enabled=enabled == "ON",
+            trace=getattr(sess, "_tracer", None),
+            backoff_budget_ms=budget,
         )
 
     @property
@@ -331,9 +372,18 @@ class CopClient:
         reads serve from the result cache while the table version holds
         (ref: coprocessor_cache.go)."""
         _fp("cop/before-task")
+        st = self._stats_fn(sctx)
         if bo is None:
-            bo = Backoffer.for_ctx(sctx, stats=self._bump)
+            bo = Backoffer.for_ctx(sctx, stats=st)
             bo.abort = abort
+        trace = getattr(sctx, "trace", None) if sctx is not None else None
+        with tracing.activate(trace), (
+            trace.span("cop.task", region=t.region_id) if trace is not None else tracing._NOOP
+        ):
+            return self._run_task_traced(table, dag, t, read_ts, engine, bo, cache, sctx, st)
+
+    def _run_task_traced(self, table, dag, t: CopTask, read_ts, engine,
+                         bo: Backoffer, cache: bool, sctx, st) -> list[Chunk]:
         while True:
             if bo.abort is not None and bo.abort.is_set():
                 return []  # stream abandoned: result would be discarded
@@ -341,7 +391,7 @@ class CopClient:
             if region.id == t.region_id and region.epoch == t.epoch and region.leader_store != t.leader:
                 # NotLeader: same region and epoch, leadership moved —
                 # no re-split, just chase the new leader after a short wait
-                self._bump("region_errors")
+                st("region_errors")
                 bo.backoff(BO_UPDATE_LEADER, NotLeader(
                     f"region {region.id} leader moved store {t.leader} -> {region.leader_store}",
                     region_id=region.id,
@@ -354,7 +404,7 @@ class CopClient:
                 or (region.end != b"" and (t.end == b"" or t.end > region.end))
             )
             if stale:
-                self._bump("region_errors")
+                st("region_errors")
                 bo.backoff(BO_REGION_MISS, EpochNotMatch(
                     f"region {t.region_id}@{t.epoch} is stale for "
                     f"[{t.start!r}, {t.end!r}) (now {region.id}@{region.epoch})",
@@ -445,7 +495,9 @@ class CopClient:
     def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str,
                      sctx: SchedCtx | None = None, dedup=None,
                      bo: Backoffer | None = None) -> Chunk:
-        self._bump("tasks")
+        st = self._stats_fn(sctx)
+        trace = getattr(sctx, "trace", None) if sctx is not None else None
+        st("tasks")
         if engine == "auto" and batch.n_rows < self.AUTO_MIN_ROWS:
             engine = "host"
         if (engine == "auto" and dag.agg is None and dag.topn is None
@@ -470,85 +522,119 @@ class CopClient:
         # measured cost
         ctl = self.ctl if (sctx is None or sctx.enabled) else None
         if bo is None:
-            bo = Backoffer.for_ctx(sctx, stats=self._bump)
-        while True:
-            if bo.abort is not None and bo.abort.is_set():
-                raise QueryInterrupted("cop stream abandoned")
-            ticket = None
-            if ctl is not None:
-                ticket = ctl.scheduler.acquire(
-                    sctx or SchedCtx(),
-                    stop=bo.abort.is_set if bo.abort is not None else None,
-                )
-                if ticket.wait_s:
-                    self._bump("sched_wait_ms", ticket.wait_s * 1000.0)
-            try:
-                _fp("sched/engine-stall")
-                if engine in ("tpu", "auto"):
-                    breaker = self.tpu.breaker
-                    if not breaker.allow():
-                        # open breaker: 'auto' routes host at zero exception
-                        # cost; forced 'tpu' fails fast with the state
-                        if engine == "tpu":
-                            breaker.raise_open()
-                        self._bump("breaker_skips")
-                    else:
-                        try:
-                            _fp("cop/device-error")
-                            if ctl is not None:
-                                chunk = ctl.batcher.execute(
-                                    self.tpu, dag, batch, dedup_key=dedup, stats=self._bump
+            bo = Backoffer.for_ctx(sctx, stats=st)
+        with tracing.activate(trace):
+            while True:
+                if bo.abort is not None and bo.abort.is_set():
+                    raise QueryInterrupted("cop stream abandoned")
+                ticket = None
+                if ctl is not None:
+                    try:
+                        ticket = ctl.scheduler.acquire(
+                            sctx or SchedCtx(),
+                            stop=bo.abort.is_set if bo.abort is not None else None,
+                        )
+                    except ServerBusy as sb:
+                        # queue-full backpressure is the in-process
+                        # ServerBusy: retry through its own backoff class
+                        # (holding no slot) until the budget runs out
+                        bo.backoff(BO_SERVER_BUSY, sb)
+                        continue
+                    if ticket.wait_s:
+                        st("sched_wait_ms", ticket.wait_s * 1000.0)
+                try:
+                    _fp("sched/engine-stall")
+                    if engine in ("tpu", "auto"):
+                        breaker = self.tpu.breaker
+                        if not breaker.allow():
+                            # open breaker: 'auto' routes host at zero exception
+                            # cost; forced 'tpu' fails fast with the state
+                            if engine == "tpu":
+                                breaker.raise_open()
+                            st("breaker_skips")
+                            if trace is not None and trace.recording:
+                                trace.closed_span("breaker.skip", 0.0, state=breaker.state)
+                        else:
+                            try:
+                                _fp("cop/device-error")
+                                with tracing.collect_phases() as ph:
+                                    if ctl is not None:
+                                        chunk = ctl.batcher.execute(
+                                            self.tpu, dag, batch, dedup_key=dedup, stats=st
+                                        )
+                                    else:
+                                        chunk = self.tpu.execute(dag, batch)
+                            except Exception as exc:
+                                err = classify_device_error(exc)
+                                if err is None:
+                                    # not a device fault (kill/quota/SQL error):
+                                    # propagate untouched, no fault counted —
+                                    # but release a held half-open probe slot
+                                    breaker.record_aborted()
+                                    raise
+                                tripped = breaker.record_failure(exc)
+                                if isinstance(err, DeviceTransientError) and not tripped:
+                                    # release the device slot while sleeping so
+                                    # backoff never holds admission capacity,
+                                    # then retry the device path
+                                    if ticket is not None:
+                                        ctl.scheduler.release(ticket)
+                                        ticket = None
+                                    try:
+                                        bo.backoff(BO_DEVICE, err)
+                                    except BackoffExhausted as bex:
+                                        if engine == "tpu":
+                                            raise
+                                        err = bex
+                                    else:
+                                        continue
+                                if engine == "tpu":
+                                    raise err from exc
+                                # a device-path failure must never be silent: it
+                                # is a correctness bug masked by the host answer
+                                # (VERDICT Weak#5)
+                                st("fallback_errors")
+                                # keep the stack: a fatal classification may be
+                                # a masked lowering bug (VERDICT Weak#5)
+                                log.warning(
+                                    "TPU engine fault (%s); falling back to host engine",
+                                    err, exc_info=exc,
                                 )
                             else:
-                                chunk = self.tpu.execute(dag, batch)
-                        except Exception as exc:
-                            err = classify_device_error(exc)
-                            if err is None:
-                                # not a device fault (kill/quota/SQL error):
-                                # propagate untouched, no fault counted —
-                                # but release a held half-open probe slot
-                                breaker.record_aborted()
-                                raise
-                            tripped = breaker.record_failure(exc)
-                            if isinstance(err, DeviceTransientError) and not tripped:
-                                # release the device slot while sleeping so
-                                # backoff never holds admission capacity,
-                                # then retry the device path
-                                if ticket is not None:
-                                    ctl.scheduler.release(ticket)
-                                    ticket = None
-                                try:
-                                    bo.backoff(BO_DEVICE, err)
-                                except BackoffExhausted as bex:
-                                    if engine == "tpu":
-                                        raise
-                                    err = bex
-                                else:
-                                    continue
-                            if engine == "tpu":
-                                raise err from exc
-                            # a device-path failure must never be silent: it
-                            # is a correctness bug masked by the host answer
-                            # (VERDICT Weak#5)
-                            self._bump("fallback_errors")
-                            # keep the stack: a fatal classification may be
-                            # a masked lowering bug (VERDICT Weak#5)
-                            log.warning(
-                                "TPU engine fault (%s); falling back to host engine",
-                                err, exc_info=exc,
-                            )
-                        else:
-                            breaker.record_success()
-                            self._bump("tpu_tasks")
-                            return chunk
-                chunk = execute_dag_host(dag, batch)
-                self._bump("host_tasks")
-                return chunk
-            finally:
-                if ticket is not None:
-                    ru = ru_cost(batch.n_rows)
-                    ctl.scheduler.release(ticket, ru)
-                    self._bump("ru", ru)
+                                breaker.record_success()
+                                st("tpu_tasks")
+                                self._note_device_phases(ph, st, trace)
+                                return chunk
+                    t0 = time.perf_counter()
+                    chunk = execute_dag_host(dag, batch)
+                    host_s = time.perf_counter() - t0
+                    st("host_tasks")
+                    st("host_ms", host_s * 1000.0)
+                    if trace is not None and trace.recording:
+                        trace.closed_span("cop.host_execute", host_s, rows=batch.n_rows)
+                    return chunk
+                finally:
+                    if ticket is not None:
+                        ru = ru_cost(batch.n_rows)
+                        ctl.scheduler.release(ticket, ru)
+                        st("ru", ru)
+
+    @staticmethod
+    def _note_device_phases(ph: dict, st, trace) -> None:
+        """Solo-launch device phases (the batcher attributes grouped
+        launches itself): exec-detail counters + trace spans."""
+        if not ph:
+            return
+        if ph.get("compile_ms"):
+            st("compile_ms", ph["compile_ms"])
+        tb = ph.get("h2d_bytes", 0.0) + ph.get("d2h_bytes", 0.0)
+        if tb:
+            st("transfer_bytes", tb)
+        dm = ph.get("execute_ms", 0.0) + ph.get("h2d_ms", 0.0)
+        if dm:
+            st("device_ms", dm)
+        if trace is not None:
+            trace.add_phase_spans(ph)
 
     # --- index scans (ref: executor/distsql.go IndexReader/IndexLookUp) ---
 

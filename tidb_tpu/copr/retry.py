@@ -76,11 +76,13 @@ class Backoffer:
     their own Backoffers (per-task isolation)."""
 
     def __init__(self, budget_ms: float = COP_BACKOFF_BUDGET_MS, deadline=None,
-                 session=None, rng: random.Random | None = None, stats=None):
+                 session=None, rng: random.Random | None = None, stats=None,
+                 trace=None):
         self.budget_ms = budget_ms
         self.deadline = deadline
         self.session = session
         self.abort = None  # optional Event: owning stream was abandoned
+        self.trace = trace  # StatementTrace: backoff sleeps become spans
         self.slept_ms = 0.0
         self.attempts: dict[str, int] = {}
         self.errors: list[BaseException] = []
@@ -88,14 +90,22 @@ class Backoffer:
         self._stats = stats  # optional callable(key, n) — client counters
 
     @classmethod
-    def for_ctx(cls, sctx, budget_ms: float = COP_BACKOFF_BUDGET_MS, stats=None):
+    def for_ctx(cls, sctx, budget_ms: float | None = None, stats=None):
         """Build from a SchedCtx (or None) so backoff waits observe the
-        same deadline/KILL state admission waits do."""
+        same deadline/KILL state admission waits do. The budget comes from
+        the context's `backoff_budget_ms` (the tidb_backoff_budget_ms
+        sysvar / SET_VAR hint) unless overridden, falling back to the
+        compiled-in default."""
+        if budget_ms is None:
+            budget_ms = getattr(sctx, "backoff_budget_ms", None)
+        if budget_ms is None:
+            budget_ms = COP_BACKOFF_BUDGET_MS
         return cls(
             budget_ms,
             deadline=getattr(sctx, "deadline", None),
             session=getattr(sctx, "session", None),
             stats=stats,
+            trace=getattr(sctx, "trace", None),
         )
 
     @property
@@ -123,6 +133,13 @@ class Backoffer:
             sleep / 1000.0, self.deadline, self.session,
             stop=self.abort.is_set if self.abort is not None else None,
         )
+        if self.trace is not None and self.trace.recording:
+            # after the sleep so the span is closed (back-dated) — a
+            # KILL/deadline escape mid-sleep skips it with the exception
+            self.trace.closed_span(
+                f"backoff.{cfg.name}", sleep / 1000.0,
+                attempt=n + 1, error=type(err).__name__,
+            )
 
     def _exhausted_msg(self, last_err: BaseException) -> str:
         region = next(

@@ -28,11 +28,14 @@ The jit cache is the compile-once analog of the coprocessor cache
 from __future__ import annotations
 
 import bisect
+import time
 from threading import Lock
 
 import numpy as np
 
 from ..jaxenv import jax, jnp
+from ..utils import metrics as M
+from ..utils import tracing
 from ..chunk.chunk import Chunk, Column
 from ..expr.expression import Column as ExprCol, Constant, Expression, ScalarFunc
 from ..mysqltypes.datum import Datum, K_STR, K_BYTES
@@ -41,6 +44,59 @@ from ..mysqltypes.mydecimal import pow10
 from .dag import DAGRequest
 from .host_engine import exact_sum64, exact_sumsq64, execute_dag_host
 from .tilecache import ColumnBatch
+
+class _Timed:
+    """A jitted program with its first dispatch timed: JAX traces+compiles
+    synchronously inside the first call (later calls dispatch async in
+    sub-ms), so the first-call wall IS the compile cost — the
+    tidb_tpu_compile_seconds series and the trace's device.compile phase.
+    A benign race (two threads both timing the first call) at worst
+    records one extra sample."""
+
+    __slots__ = ("fn", "_compiled")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self._compiled = False
+
+    def __call__(self, *args):
+        if self._compiled:
+            return self.fn(*args)
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        dt = time.perf_counter() - t0
+        self._compiled = True
+        M.TPU_COMPILE_SECONDS.observe(dt)
+        tracing.add_phase("compile_ms", dt * 1e3)
+        return out
+
+
+def _to_device(a: np.ndarray):
+    """Host→device upload with transfer accounting (the h2d half of
+    tidb_tpu_transfer_bytes_total and the trace's device.transfer phase)."""
+    t0 = time.perf_counter()
+    out = jnp.asarray(a)
+    M.TPU_TRANSFER_BYTES.inc(a.nbytes, dir="h2d")
+    tracing.add_phase("h2d_bytes", a.nbytes)
+    tracing.add_phase("h2d_ms", (time.perf_counter() - t0) * 1e3)
+    return out
+
+
+def _fetch(x):
+    """Device→host fetch: `jax.device_get` blocks until the async dispatch
+    finishes computing, so its wall is the observable device execute+fetch
+    time (tidb_tpu_device_execute_seconds); result bytes are the d2h half
+    of the transfer series."""
+    t0 = time.perf_counter()
+    out = jax.device_get(x)
+    dt = time.perf_counter() - t0
+    nbytes = sum(getattr(l, "nbytes", 0) for l in jax.tree_util.tree_leaves(out))
+    M.TPU_EXECUTE_SECONDS.observe(dt)
+    M.TPU_TRANSFER_BYTES.inc(nbytes, dir="d2h")
+    tracing.add_phase("execute_ms", dt * 1e3)
+    tracing.add_phase("d2h_bytes", nbytes)
+    return out
+
 
 TILE_ROWS = 1 << 16
 DIRECT_GROUP_MAX = 1 << 16
@@ -176,7 +232,7 @@ class DeviceBatch:
         self._valid: dict[int, object] = {}
         rv = np.zeros(self.padded, dtype=bool)
         rv[:n] = True
-        self.row_valid = jnp.asarray(rv.reshape(self.t, TILE_ROWS))
+        self.row_valid = _to_device(rv.reshape(self.t, TILE_ROWS))
 
     def _pad2d(self, a: np.ndarray):
         out = np.zeros(self.padded, dtype=a.dtype)
@@ -194,8 +250,8 @@ class DeviceBatch:
                 codes, vocab = _dict_encode_lane(d, v, coll)
                 self.vocabs[off] = vocab
                 d = codes
-            self._data[off] = jnp.asarray(self._pad2d(d))
-            self._valid[off] = jnp.asarray(self._pad2d(v))
+            self._data[off] = _to_device(self._pad2d(d))
+            self._valid[off] = _to_device(self._pad2d(v))
         return self._data[off], self._valid[off]
 
 
@@ -268,7 +324,7 @@ class TPUEngine:
                 self.fallbacks += 1
             return execute_dag_host(dag, batch)
         if isinstance(plan, DevicePlan):
-            return plan.finalize(jax.device_get(plan.launch()))
+            return plan.finalize(_fetch(plan.launch()))
         return plan()  # sorted-agg path: owns its retry loop, stays eager
 
     def execute_many(self, items: list[tuple[DAGRequest, ColumnBatch]]) -> list[Chunk]:
@@ -330,7 +386,7 @@ class TPUEngine:
                 launched.append(("grp", (grp, out)))
 
         if launched:
-            fetched = jax.device_get([payload[1] for _, payload in launched])
+            fetched = _fetch([payload[1] for _, payload in launched])
             for (kind, payload), host in zip(launched, fetched):
                 if kind == "one":
                     i = payload[0]
@@ -499,9 +555,12 @@ class TPUEngine:
             self._raw.setdefault(key, builder)  # for vmapped group launches
             fn = self._programs.get(key)
             if fn is None:
-                fn = jax.jit(builder)
+                M.TPU_COMPILE_CACHE.inc(result="miss")
+                fn = _Timed(jax.jit(builder))
                 self._programs[key] = fn
                 self.compile_count += 1
+            else:
+                M.TPU_COMPILE_CACHE.inc(result="hit")
         return fn
 
     def _vmapped_program(self, key, gcap, width):
@@ -536,9 +595,12 @@ class TPUEngine:
                     )
                     return jax.vmap(raw)(*stacked)
 
-                vfn = jax.jit(group)
+                M.TPU_COMPILE_CACHE.inc(result="miss")
+                vfn = _Timed(jax.jit(group))
                 self._vprograms[(key, gcap, width)] = vfn
                 self.compile_count += 1
+            else:
+                M.TPU_COMPILE_CACHE.inc(result="hit")
         return vfn
 
     # --- filter-only --------------------------------------------------------
@@ -777,7 +839,7 @@ class TPUEngine:
             gcap = self._gcap.get(base_key, self.gcap0)
             while True:
                 fn, aux = self._packed_program(base_key + (gcap,), make_kernel(gcap), gcap, has_scalar=True)
-                ng_a, i_arr, f_arr = jax.device_get(fn(arrs, dev.row_valid))
+                ng_a, i_arr, f_arr = _fetch(fn(arrs, dev.row_valid))
                 ng = int(ng_a)
                 if ng <= gcap:
                     break
@@ -835,29 +897,33 @@ class TPUEngine:
 
     def _packed_program_locked(self, key, kernel, nseg, has_scalar):
         cached = self._programs.get(key)
-        if cached is None:
-            aux: dict = {}
+        if cached is not None:
+            M.TPU_COMPILE_CACHE.inc(result="hit")
+            return cached
 
-            def packed(flat, row_valid):
-                res = kernel(flat, row_valid)
-                scalar, outs = res if has_scalar else (None, res)
-                ints, flts, lay = [], [], []
-                for o in outs:
-                    if jnp.issubdtype(o.dtype, jnp.floating):
-                        lay.append(("f", len(flts)))
-                        flts.append(o.astype(jnp.float64))
-                    else:
-                        lay.append(("i", len(ints)))
-                        ints.append(o.astype(jnp.int64))
-                aux["layout"] = lay
-                i_arr = jnp.stack(ints) if ints else jnp.zeros((0, nseg), jnp.int64)
-                f_arr = jnp.stack(flts) if flts else jnp.zeros((0, nseg), jnp.float64)
-                return (scalar, i_arr, f_arr) if has_scalar else (i_arr, f_arr)
+        aux: dict = {}
 
-            self._raw.setdefault(key, packed)
-            cached = (jax.jit(packed), aux)
-            self._programs[key] = cached
-            self.compile_count += 1
+        def packed(flat, row_valid):
+            res = kernel(flat, row_valid)
+            scalar, outs = res if has_scalar else (None, res)
+            ints, flts, lay = [], [], []
+            for o in outs:
+                if jnp.issubdtype(o.dtype, jnp.floating):
+                    lay.append(("f", len(flts)))
+                    flts.append(o.astype(jnp.float64))
+                else:
+                    lay.append(("i", len(ints)))
+                    ints.append(o.astype(jnp.int64))
+            aux["layout"] = lay
+            i_arr = jnp.stack(ints) if ints else jnp.zeros((0, nseg), jnp.int64)
+            f_arr = jnp.stack(flts) if flts else jnp.zeros((0, nseg), jnp.float64)
+            return (scalar, i_arr, f_arr) if has_scalar else (i_arr, f_arr)
+
+        self._raw.setdefault(key, packed)
+        M.TPU_COMPILE_CACHE.inc(result="miss")
+        cached = (_Timed(jax.jit(packed)), aux)
+        self._programs[key] = cached
+        self.compile_count += 1
         return cached
 
     @staticmethod

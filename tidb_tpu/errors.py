@@ -98,13 +98,6 @@ class ResourceGroupNotExists(TiDBError):
     code = 8249
 
 
-class ResourceGroupQueueFull(TiDBError):
-    """Admission queue overflow under sustained overload — the backpressure
-    hard edge (ref: ErrResourceGroupThrottled 8252)."""
-
-    code = 8252
-
-
 # --- cop-path retriable taxonomy (ref: store/tikv/retry + kv/error.go) ----
 #
 # The Backoffer (copr/retry.py) classifies every fault on the cop path into
@@ -138,6 +131,15 @@ class ServerBusy(RegionError):
     decorrelated backoff (ref: ServerIsBusy, 9003)."""
 
     code = 9003
+
+
+class ResourceGroupQueueFull(ServerBusy):
+    """Admission queue overflow under sustained overload — the in-process
+    ServerBusy: the cop client retries it through the Backoffer's
+    serverBusy class before surfacing (ref: ErrResourceGroupThrottled
+    8252; TiKV's ServerIsBusy→BoTiKVServerBusy loop)."""
+
+    code = 8252
 
 
 class DeviceError(TiDBError):

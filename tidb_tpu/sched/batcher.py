@@ -29,15 +29,20 @@ zero added latency on the uncontended path.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
 from ..utils import metrics as M
+from ..utils import tracing
 from ..utils.failpoint import inject as _fp
+
+log = logging.getLogger("tidb_tpu.sched")
 
 
 class _Job:
-    __slots__ = ("dag", "batch", "dedup_key", "result", "exc", "followers", "mode")
+    __slots__ = ("dag", "batch", "dedup_key", "result", "exc", "followers", "mode",
+                 "trace", "parent_id")
 
     def __init__(self, dag, batch, dedup_key):
         self.dag = dag
@@ -47,6 +52,11 @@ class _Job:
         self.exc = None
         self.followers: list["_Job"] = []
         self.mode = "leader"
+        # fan-out attribution: the waiter's statement trace + the span the
+        # shared launch span should hang under in THAT trace, captured on
+        # the waiter's own thread at enqueue time
+        self.trace = tracing.current_trace()
+        self.parent_id = self.trace.current_parent() if self.trace is not None else 0
 
 
 class _Group:
@@ -134,6 +144,11 @@ class LaunchBatcher:
 
     def _launch(self, engine, group: _Group, stats) -> None:
         jobs = group.jobs
+        t0_ns = time.perf_counter_ns()
+        # the leader runs device work for OTHER statements' traces too:
+        # collect the device phases (compile/transfer/execute) for the
+        # whole launch here and fan them out with the shared launch span
+        ph_token = tracing.push_phases()
         try:
             # everything before the engine call sits inside the guard too:
             # an armed failpoint (or metrics error) must still release the
@@ -163,7 +178,79 @@ class LaunchBatcher:
                     j.exc = e
             raise
         finally:
+            phases = tracing.pop_phases(ph_token)
             for j in jobs:
                 for f in j.followers:
                     f.result, f.exc = j.result, j.exc
+            try:
+                self._attribute(jobs, group, t0_ns, phases)
+            except Exception:  # noqa: BLE001 — attribution must never strand waiters
+                log.warning("launch-span fan-out attribution failed", exc_info=True)
             group.done.set()
+
+    def _attribute(self, jobs, group: _Group, t0_ns: int, phases: dict) -> None:
+        """Fan the ONE launch out into every co-batched waiter's trace:
+        each participant (members, dedup followers, the leader itself)
+        gets the SAME launch span — identical launch/span id, occupancy,
+        which statement ran it, and the device-phase breakdown — linked
+        as a child of its own cop-task span, plus the exec-detail
+        counters the slow log / STATEMENTS_SUMMARY columns read."""
+        waiters = []
+        for j in jobs:
+            waiters.append(j)
+            waiters.extend(j.followers)
+        occupancy = len(waiters)
+        traces = []
+        seen = set()
+        for w in waiters:
+            t = w.trace
+            if t is not None and id(t) not in seen:
+                seen.add(id(t))
+                traces.append(t)
+        if not traces:
+            return
+        dur_ns = time.perf_counter_ns() - t0_ns
+        for t in traces:
+            t.set_max("batch_occupancy", occupancy)
+            for key, cnt in (("compile_ms", phases.get("compile_ms", 0.0)),
+                             ("transfer_bytes", phases.get("h2d_bytes", 0.0)
+                              + phases.get("d2h_bytes", 0.0)),
+                             ("device_ms", phases.get("execute_ms", 0.0)
+                              + phases.get("h2d_ms", 0.0))):
+                if cnt:
+                    t.add(key, cnt)
+        if not any(t.recording for t in traces):
+            return
+        leader = jobs[0].trace
+        span = tracing.Span("cop.launch", 0, dur_ns)
+        span.tags.update(
+            launch_id=span.span_id, occupancy=occupancy, n_dedup=group.n_dedup,
+            runner=leader.trace_id if leader is not None else "-",
+        )
+        failed = next((j.exc for j in jobs if j.exc is not None), None)
+        if failed is not None:
+            span.tags["error"] = type(failed).__name__
+        # device phase children, with starts relative to the launch span's
+        # own start (shifted per adopting trace below)
+        children = tracing.phase_spans(phases, span.span_id, dur_ns)
+        adopted = set()
+        for w in waiters:
+            t = w.trace
+            if t is None or not t.recording:
+                continue
+            if id(t) in adopted:
+                # one launch appears ONCE per trace: a statement whose own
+                # sibling cop tasks co-batched must not adopt the span (and
+                # its children, which key off the shared span id) twice —
+                # tree() would render the children cross-product
+                continue
+            adopted.add(id(t))
+            # start relative to THIS trace's epoch: the launch ends "now"
+            sp = span.copy_with_parent(w.parent_id or t.root_id)
+            sp.start_ns = t._now_ns() - dur_ns
+            kids = tuple(
+                tracing.Span(c.name, sp.start_ns + c.start_ns, c.dur_ns,
+                             parent_id=c.parent_id, span_id=c.span_id, tags=c.tags)
+                for c in children
+            )
+            t.adopt(sp, sp.parent_id, children=kids)

@@ -40,6 +40,8 @@ class SchedCtx:
     deadline: float | None = None  # time.monotonic() deadline, from max_execution_time
     session: object = None  # for KILL checks while queued
     enabled: bool = True
+    trace: object = None  # StatementTrace: per-statement spans + exec details
+    backoff_budget_ms: float | None = None  # tidb_backoff_budget_ms (None = default)
 
 
 @dataclass
@@ -146,8 +148,13 @@ class AdmissionScheduler:
                 g.bucket.debit(self.EST_RU)
                 M.SCHED_TASKS.inc(group=g.name, outcome="admitted")
                 M.SCHED_WAIT.observe(0.0)
+                if ctx.trace is not None and ctx.trace.recording:
+                    ctx.trace.closed_span("sched.admission", 0.0, group=g.name, queued=False)
                 return Ticket(g, self.EST_RU)
             if len(self._waiting) >= self.MAX_QUEUE:
+                # backpressure hard edge — typed as ServerBusy so the cop
+                # client retries it through the Backoffer's serverBusy
+                # class before surfacing (PR 2 taxonomy, exercised here)
                 M.SCHED_TASKS.inc(group=g.name, outcome="rejected")
                 raise ResourceGroupQueueFull(
                     f"resource group '{g.name}' admission queue is full "
@@ -185,6 +192,8 @@ class AdmissionScheduler:
         wait = time.monotonic() - t0
         M.SCHED_WAIT.observe(wait)
         M.SCHED_TASKS.inc(group=g.name, outcome="admitted")
+        if ctx.trace is not None and ctx.trace.recording:
+            ctx.trace.closed_span("sched.admission", wait, group=g.name, queued=True)
         return Ticket(g, self.EST_RU, wait)
 
     def _grant_locked(self) -> None:

@@ -335,6 +335,12 @@ class Server:
 
                     body = REGISTRY.render().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path == "/debug/trace" or self.path.startswith("/debug/trace?"):
+                    # last-N statement traces (utils/tracing TraceRing):
+                    # the span trees TRACE <sql> renders, as JSON — the
+                    # status-API half of the reference's trace viewer
+                    body = json.dumps(server.storage.trace_ring.snapshot()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/stats/dump/"):
                     # /stats/dump/{db}/{table} (ref: statistics_handler.go)
                     parts = self.path.split("/")

@@ -116,6 +116,8 @@ class Session:
         # sessions run as root, the bootstrap superuser)
         self.user = "root"
         self._session_bindings: dict[str, list] = {}  # digest → hints
+        self._tracer = None  # per-statement StatementTrace (utils/tracing)
+        self._stmt_vars: dict[str, str] = {}  # SET_VAR hint statement scope
         import itertools as _it
 
         self.conn_id = next(Session._conn_counter)
@@ -380,6 +382,21 @@ class Session:
         itok = _si.CURRENT.set(self._info)
         met = int(self.vars.get("max_execution_time", "0") or 0)
         self._deadline = (time.monotonic() + met / 1000.0) if met > 0 else None
+        # per-statement trace: counters (exec details for the slow log /
+        # STATEMENTS_SUMMARY) always; spans only under tidb_enable_trace
+        # or TRACE <sql> (near-zero cost otherwise)
+        prev_tracer = self._tracer
+        tracer = None
+        prev_stmt_vars = self._stmt_vars
+        self._stmt_vars = {}
+        if not self._in_bootstrap:
+            from ..utils.tracing import StatementTrace
+
+            tracer = StatementTrace(
+                sql=log_sql, session_id=self.conn_id,
+                recording=self.vars.get("tidb_enable_trace", "OFF") == "ON",
+            )
+            self._tracer = tracer
         if self.vars.get("tidb_general_log", "OFF") == "ON" and not self._in_bootstrap:
             gl = log_sql
             if self.vars.get("tidb_redact_log", "OFF") == "ON":
@@ -446,6 +463,10 @@ class Session:
             _si.CURRENT.reset(itok)
             dur = time.perf_counter() - t0
             cpu = time.thread_time() - c0
+            # restore, not clear: internal statements can nest (ANALYZE,
+            # bootstrap upgrades) under an outer statement's hint scope
+            self._tracer = prev_tracer
+            self._stmt_vars = prev_stmt_vars
             if not self._in_bootstrap:
                 self.store.clear_process(self.conn_id)
                 self.store.plugins.fire("on_query", self.user, self.current_db, sql, ok, dur)
@@ -456,14 +477,29 @@ class Session:
                     # never record credential-bearing literals (MySQL
                     # redacts user-admin statements from logs)
                     log_sql = f"<redacted {type(stmt).__name__}>"
+                details = None
+                if tracer is not None:
+                    tracer.finish(ok=ok)
+                    details = tracer.details()
+                    if tracer.recording:
+                        if isinstance(stmt, (ast.CreateUser, ast.Grant, ast.SetStmt)):
+                            tracer.sql = log_sql
+                        elif self.vars.get("tidb_redact_log", "OFF") == "ON":
+                            from ..utils.stmtstats import normalize_sql
+
+                            tracer.sql = normalize_sql(tracer.sql)
+                        self.store.trace_ring.push(tracer)  # rendered lazily on read
                 self.store.stmt_stats.record(
                     log_sql, dur, self.user, self.current_db, ok, threshold, cpu_s=cpu,
                     summary_on=self.vars.get("tidb_enable_stmt_summary", "ON") == "ON",
                     slow_log_on=self.vars.get("tidb_enable_slow_log", "ON") == "ON",
                     max_sql_len=int(self.vars.get("tidb_stmt_summary_max_sql_length", "4096")),
                     redact=self.vars.get("tidb_redact_log", "OFF") == "ON",
+                    details=details,
                 )
                 # AFTER the counters above so a snapshot sees this stmt
+                # (statement completion drives metrics_summary windows even
+                # under pure-SQL workloads; min-interval guard in tick())
                 M.HISTORY.tick()  # metrics_summary window sampling
 
     def must_query(self, sql: str) -> list[tuple]:
@@ -1482,6 +1518,25 @@ class Session:
                     engine = "tpu"
                 elif store_kind in ("host", "tikv"):
                     engine = "host"
+            elif h == "SET_VAR" and args:
+                # statement-scope sysvar override (ref: MySQL SET_VAR
+                # optimizer hint); consumed by the cop path via
+                # _stmt_vars (e.g. tidb_backoff_budget_ms), cleared at
+                # statement end
+                from .vars import SYSVARS
+
+                for a in args:
+                    if "=" not in a:
+                        continue
+                    k, v = (p.strip() for p in a.split("=", 1))
+                    sv = SYSVARS.get(k)
+                    if sv is None:
+                        self.warnings.append(f"Unresolved name '{k}' in SET_VAR hint")
+                        continue
+                    try:
+                        self._stmt_vars[k] = sv.normalize(v)
+                    except ValueError as e:
+                        self.warnings.append(str(e))
         ctx = ExecContext(
             self.cop,
             self.read_ts(),
@@ -3477,16 +3532,24 @@ class Session:
         return ResultSet(["plan"], chk)
 
     def _run_trace(self, stmt: ast.TraceStmt) -> ResultSet:
-        """TRACE <sql>: span rows (operation, startTS, duration) from the
-        instrumented run (ref: executor/trace.go + util/tracing; spans
-        come from the same per-operator runtime stats EXPLAIN ANALYZE
-        uses — no separate tracer needed in-process)."""
+        """TRACE <sql>: hierarchical span rows (operation, startTS,
+        duration) from the statement tracer (ref: executor/trace.go +
+        util/tracing). The tree covers the full cop path — admission
+        waits, co-batched launch spans (fan-out attributed, with
+        occupancy and launch id), backoff sleeps labeled by error class,
+        breaker events, device compile/transfer/execute phases — plus the
+        per-operator executor spans EXPLAIN ANALYZE uses, and the legacy
+        resource-control summary span."""
         from ..executor.runtime_stats import child_execs
+        from ..utils.tracing import Span
 
         inner = stmt.stmt
-        spans: list[tuple[str, float, float]] = []  # (op, start_ms, dur_ms)
-        cop_before = dict(self.cop.stats)
-        t_base = time.perf_counter_ns()
+        tracer = self._tracer
+        if tracer is not None:
+            # the statement trace already exists (created per statement in
+            # _execute_parsed); TRACE flips span recording on for the
+            # gated inner run
+            tracer.enable_recording()
         # the inner statement runs through _execute_stmt so EVERY gate
         # (privileges, table locks, hints, outfile, ...) applies exactly
         # as it would un-traced; run_select stores the instrumented tree
@@ -3496,36 +3559,44 @@ class Session:
             self._execute_stmt(inner)
         finally:
             self._trace_collect = False
-        t_done = time.perf_counter_ns()
-        spans.append(("session.execute", 0.0, (t_done - t_base) / 1e6))
-        d = {k: self.cop.stats[k] - cop_before.get(k, 0) for k in self.cop.stats}
-        if d["tasks"]:
-            # admission layer as a span: wait is the measured queue time,
-            # the RU/batch counters ride in the operation label (the
-            # resource_control span of the reference's trace output)
-            spans.append((
+        if tracer is None:  # bootstrap-internal edge: nothing to render
+            return ResultSet(
+                ["operation", "startTS", "duration"],
+                Chunk.from_datum_rows([ft_varchar()] * 3, []),
+            )
+        extra: list[Span] = []
+        c = dict(tracer.counters)
+        if c.get("tasks"):
+            # resource-control summary span: wait is the measured queue
+            # time, RU/batch counters ride in the operation label
+            extra.append(Span(
                 f"cop.sched[group={self.vars.get('tidb_resource_group', 'default') or 'default'}"
-                f" ru={d['ru']:.2f} batched={d['batched_tasks']} dedup={d['dedup_tasks']}]",
-                0.0, d["sched_wait_ms"],
+                f" ru={c.get('ru', 0.0):.2f} batched={int(c.get('batched_tasks', 0))}"
+                f" dedup={int(c.get('dedup_tasks', 0))}]",
+                0, int(c.get("sched_wait_ms", 0.0) * 1e6), parent_id=tracer.root_id,
             ))
         if self._trace_result is not None:
             ex, stats = self._trace_result
             self._trace_result = None
 
-            def rec(e, depth):
-                st = stats.get(id(e), {"time_ns": 0, "rows": 0})
-                spans.append((
-                    f"{'.' * depth}executor.{type(e).__name__}",
-                    0.0, st["time_ns"] / 1e6,
-                ))
+            def rec(e, parent_id):
+                est = stats.get(id(e), {"time_ns": 0, "rows": 0})
+                sp = Span(f"executor.{type(e).__name__}", 0, est["time_ns"],
+                          parent_id=parent_id)
+                extra.append(sp)
                 for ch in child_execs(e):
-                    rec(ch, depth + 1)
+                    rec(ch, sp.span_id)
 
-            rec(ex, 0)
-        rows = [
-            [Datum.s(op), Datum.s(f"{start:.3f}ms"), Datum.s(f"{dur:.3f}ms")]
-            for op, start, dur in spans
-        ]
+            rec(ex, tracer.root_id)
+        rows = []
+        for depth, sp in tracer.tree(extra=extra):
+            tags = " ".join(f"{k}={v}" for k, v in sp.tags.items())
+            op = ("." * max(depth - 1, 0)) + sp.name + (f"[{tags}]" if tags else "")
+            rows.append([
+                Datum.s(op),
+                Datum.s(f"{sp.start_ns / 1e6:.3f}ms"),
+                Datum.s(f"{sp.dur_ns / 1e6:.3f}ms"),
+            ])
         chk = Chunk.from_datum_rows([ft_varchar()] * 3, rows)
         return ResultSet(["operation", "startTS", "duration"], chk)
 
@@ -3566,6 +3637,14 @@ class Session:
             lines.append(
                 f"retry: backoffs:{d['retries']} backoff_ms:{d['backoff_ms']:.3f} "
                 f"breaker_skips:{d['breaker_skips']}"
+            )
+        if d["compile_ms"] or d["transfer_bytes"] or d["device_ms"]:
+            # device-path line: XLA compile wall, host<->device bytes and
+            # execute+fetch time attributed to this statement's cop tasks
+            lines.append(
+                f"device: compile_ms:{d['compile_ms']:.3f} "
+                f"transfer_bytes:{int(d['transfer_bytes'])} "
+                f"device_ms:{d['device_ms']:.3f}"
             )
         if self.cop._tpu:
             br = self.cop.tpu.breaker

@@ -124,6 +124,14 @@ _sv("tidb_mem_quota_sort", str(32 << 30), scope="session", kind="int", lo=-1, co
 _sv("tidb_mem_quota_topn", str(32 << 30), scope="session", kind="int", lo=-1, consumed=True)
 _sv("tidb_mem_quota_hashjoin", str(32 << 30), scope="session", kind="int", lo=-1, consumed=True)
 
+# --- observability (PR 3: statement tracing + cop-path exec details) -------
+# span recording for every statement (TRACE <sql> records regardless);
+# traces land in the TIDB_TRACE ring / /debug/trace
+_sv("tidb_enable_trace", "OFF", kind="bool", consumed=True)
+# per-statement cop backoff sleep budget (session scope; statement scope
+# via the SET_VAR optimizer hint) — replaces the fixed COP_BACKOFF_BUDGET_MS
+_sv("tidb_backoff_budget_ms", "2000", kind="int", lo=0, hi=600000, consumed=True)
+
 # --- resource control (sched/: admission + RU groups + launch batcher) ------
 _sv("tidb_resource_group", "default", consumed=True)
 # GLOBAL-only (as in the reference): a plain-SET session toggle would let

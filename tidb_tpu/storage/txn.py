@@ -593,6 +593,16 @@ class Storage:
             self._stmt_stats = StmtStats()
         return self._stmt_stats
 
+    @property
+    def trace_ring(self):
+        """Last-N statement traces (utils/tracing.TraceRing) — the
+        TIDB_TRACE memtable / `/debug/trace` backing store."""
+        if getattr(self, "_trace_ring", None) is None:
+            from ..utils.tracing import TraceRing
+
+            self._trace_ring = TraceRing()
+        return self._trace_ring
+
     # --- active-txn registry (GC safepoint clamp) --------------------------
 
     MAX_TXN_PIN_S = 3600.0  # leaked/abandoned txns stop blocking GC after this

@@ -10,6 +10,16 @@ from collections import defaultdict
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
 
+def _esc(v) -> str:
+    """Prometheus text-format label-value escaping (exposition format
+    §label values: backslash, double-quote and newline must be escaped)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(key) -> str:
+    return ",".join(f'{k}="{_esc(val)}"' for k, val in key)
+
+
 class Counter:
     def __init__(self, name: str, help_: str):
         self.name = name
@@ -28,7 +38,7 @@ class Counter:
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         for key, v in sorted(self._v.items()):
-            lbl = ",".join(f'{k}="{val}"' for k, val in key)
+            lbl = _fmt_labels(key)
             out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
         return out
 
@@ -56,7 +66,7 @@ class Gauge:
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         for key, v in sorted(self._v.items()):
-            lbl = ",".join(f'{k}="{val}"' for k, val in key)
+            lbl = _fmt_labels(key)
             out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
         return out
 
@@ -264,3 +274,21 @@ BREAKER_TRIPS = REGISTRY.counter(
 # both breaker series carry an engine="e<n>" label (one per breaker
 # instance); a breaker publishes only on its first state transition, so
 # idle breakers never add series
+
+# device-path series (ref: "Query Processing on Tensor Computation
+# Runtimes" names compile-cache behavior and host↔device transfer as the
+# dominant hidden costs — these make them first-class)
+TPU_COMPILE_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_compile_seconds",
+    "XLA program trace+compile wall time (first dispatch of a new program key)",
+)
+TPU_COMPILE_CACHE = REGISTRY.counter(
+    "tidb_tpu_compile_cache_total", "device program-cache lookups by result"
+)
+TPU_TRANSFER_BYTES = REGISTRY.counter(
+    "tidb_tpu_transfer_bytes_total", "host<->device transfer bytes by direction"
+)
+TPU_EXECUTE_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_device_execute_seconds",
+    "device execute+fetch wall time (dispatch to device_get completion)",
+)

@@ -60,21 +60,33 @@ class StmtStats:
         self.summary_capacity = summary_capacity
         self._lock = threading.Lock()
 
+    # cop-path exec details carried per statement (utils/tracing
+    # StatementTrace.details()); summed per digest in the summary,
+    # verbatim on each slow-log entry (ref: util/execdetails fields of
+    # LogSlowQuery / stmtsummary)
+    DETAIL_KEYS = ("sched_wait_ms", "retries", "backoff_ms", "compile_ms",
+                   "transfer_bytes")
+
     def record(
         self, sql: str, dur_s: float, user: str, db: str, ok: bool,
         slow_threshold_s: float, cpu_s: float = 0.0, *,
         summary_on: bool = True, slow_log_on: bool = True,
         max_sql_len: int = 256, redact: bool = False,
+        details: dict | None = None,
     ) -> None:
         """Record one statement. The keyword gates map the reference's
         knobs: tidb_enable_stmt_summary, tidb_enable_slow_log,
         tidb_stmt_summary_max_sql_length, tidb_redact_log (literals →
         '?' in every stored sample). summary_capacity is store-level,
-        applied by SET GLOBAL tidb_stmt_summary_max_stmt_count."""
+        applied by SET GLOBAL tidb_stmt_summary_max_stmt_count.
+        `details` carries the statement's cop-path exec details
+        (sched_wait_ms, batch_occupancy, retries, backoff_ms, compile_ms,
+        transfer_bytes)."""
         digest = sql_digest(sql)
         if redact:
             sql = normalize_sql(sql)
         now = time.time()
+        d = details or {}
         with self._lock:
             if summary_on:
                 st = self.summary.get(digest)
@@ -99,15 +111,22 @@ class StmtStats:
                 st["sum_cpu_s"] = st.get("sum_cpu_s", 0.0) + cpu_s
                 if not ok:
                     st["errors"] += 1
-            if slow_log_on and dur_s >= slow_threshold_s:
-                self.slow.append(
-                    {
-                        "time": now,
-                        "user": user,
-                        "db": db,
-                        "query_time_s": dur_s,
-                        "digest": digest,
-                        "query": sql[:512],
-                        "succ": ok,
-                    }
+                for k in self.DETAIL_KEYS:
+                    st["sum_" + k] = st.get("sum_" + k, 0.0) + d.get(k, 0.0)
+                st["max_batch_occupancy"] = max(
+                    st.get("max_batch_occupancy", 0), int(d.get("batch_occupancy", 0))
                 )
+            if slow_log_on and dur_s >= slow_threshold_s:
+                entry = {
+                    "time": now,
+                    "user": user,
+                    "db": db,
+                    "query_time_s": dur_s,
+                    "digest": digest,
+                    "query": sql[:512],
+                    "succ": ok,
+                    "batch_occupancy": int(d.get("batch_occupancy", 0)),
+                }
+                for k in self.DETAIL_KEYS:
+                    entry[k] = d.get(k, 0.0)
+                self.slow.append(entry)
