@@ -1,0 +1,426 @@
+"""MemTracker tree accounting (utils/memory): statement → session →
+server propagation, release-path unwinding (success / KILL /
+BackoffExhausted must all leave the global tracker at zero), and the
+server arbiter's top-consumer selection — the tree-accounting contracts
+ISSUE 4 gates on."""
+
+import pytest
+
+from tidb_tpu.errors import (
+    BackoffExhausted,
+    DeviceTransientError,
+    MemoryQuotaExceeded,
+    QueryInterrupted,
+    ServerMemoryExceeded,
+)
+from tidb_tpu.session import Session
+from tidb_tpu.utils.failpoint import FP
+from tidb_tpu.utils.memory import MemTracker, ServerMemTracker
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+class _FakeSession:
+    def __init__(self):
+        self._killed = False
+        self._kill_reason = None
+
+
+class TestTrackerTree:
+    def test_consume_propagates_to_every_ancestor(self):
+        root = ServerMemTracker()
+        sess = MemTracker(0, "session", parent=root)
+        stmt = MemTracker(0, "stmt", parent=sess)
+        stmt.consume(1000)
+        assert (stmt.consumed, sess.consumed, root.consumed) == (1000, 1000, 1000)
+        stmt.release(400)
+        assert (stmt.consumed, sess.consumed, root.consumed) == (600, 600, 600)
+        assert stmt.max_consumed == 1000 and root.max_consumed == 1000
+
+    def test_leaf_quota_fires_before_server_arbitration(self):
+        root = ServerMemTracker()
+        root.set_limit(10_000)
+        stmt = MemTracker(500, "stmt", parent=root)
+        root.attach_statement(stmt)
+        with pytest.raises(MemoryQuotaExceeded, match=r"\[stmt\]"):
+            stmt.consume(600)
+        stmt.detach()
+        assert root.consumed == 0
+
+    def test_detach_unwinds_outstanding_bytes(self):
+        root = ServerMemTracker()
+        sess = MemTracker(0, "session", parent=root)
+        a = MemTracker(0, "a", parent=sess)
+        b = MemTracker(0, "b", parent=sess)
+        root.attach_statement(a)
+        root.attach_statement(b)
+        a.consume(700)
+        b.consume(300)
+        a.detach()
+        assert root.consumed == 300 and sess.consumed == 300
+        b.detach()
+        assert root.consumed == 0 and sess.consumed == 0
+        assert root.statements() == []
+
+    def test_hard_limit_kills_top_consumer_not_allocator(self):
+        """The arbitration contract: a small allocation tipping the store
+        over the limit flags the TOP consumer's session through the
+        shared interrupt gate; the small allocator proceeds."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        big_sess, small_sess = _FakeSession(), _FakeSession()
+        big = MemTracker(0, "big", parent=root, session=big_sess)
+        small = MemTracker(0, "small", parent=root, session=small_sess)
+        root.attach_statement(big)
+        root.attach_statement(small)
+        big.consume(900)
+        small.consume(200)  # breaches: big is top → big dies, small lives
+        assert big_sess._killed and big_sess._kill_reason == "oom"
+        assert not small_sess._killed
+        # the gate translates the flag into the 8175 server-limit error
+        from tidb_tpu.sched.scheduler import raise_if_interrupted
+
+        with pytest.raises(ServerMemoryExceeded, match="server"):
+            raise_if_interrupted(big_sess)
+        assert big_sess._kill_reason is None
+
+    def test_allocator_that_is_top_fails_in_place(self):
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        stmt = MemTracker(0, "bomb", parent=root, session=_FakeSession())
+        root.attach_statement(stmt)
+        with pytest.raises(ServerMemoryExceeded, match="top consumer"):
+            stmt.consume(1500)
+        stmt.detach()
+        assert root.consumed == 0
+
+    def test_one_victim_at_a_time(self):
+        """While a kill is unwinding, further breaches must not massacre
+        the remaining statements — the grace ends when the victim
+        detaches."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        s1, s2, s3 = _FakeSession(), _FakeSession(), _FakeSession()
+        t1 = MemTracker(0, "t1", parent=root, session=s1)
+        t2 = MemTracker(0, "t2", parent=root, session=s2)
+        t3 = MemTracker(0, "t3", parent=root, session=s3)
+        for t in (t1, t2, t3):
+            root.attach_statement(t)
+        t1.consume(900)
+        t2.consume(200)  # kill t1
+        assert s1._killed
+        t3.consume(200)  # still over, but t1 is mid-unwind: no new kill
+        assert not s2._killed and not s3._killed
+        t1.detach()  # victim unwound; next breach may arbitrate again
+        with pytest.raises(ServerMemoryExceeded):
+            t3.consume(700)  # t2=200, t3=900: t3 is top AND allocator
+
+    def test_quota_breach_keeps_ancestors_consistent(self):
+        """A quota-raising consume must still have charged every
+        ancestor: after the breached statement detaches, the root holds
+        exactly the OTHER statements' bytes (review fix: leaf-first
+        raising desynced the tree and detach erased innocents' bytes)."""
+        root = ServerMemTracker()
+        a = MemTracker(0, "a", parent=root)
+        b = MemTracker(100, "b", parent=root)
+        root.attach_statement(a)
+        root.attach_statement(b)
+        a.consume(500)
+        with pytest.raises(MemoryQuotaExceeded):
+            b.consume(150)
+        assert b.consumed == 150 and root.consumed == 650
+        b.detach()
+        assert root.consumed == 500, "detach must not eat a's bytes"
+        a.detach()
+        assert root.consumed == 0
+
+    def test_unobserved_oom_kill_cancelled_at_victim_teardown(self):
+        """A kill flag whose target statement ends before observing it
+        must be cancelled, or it would kill the session's NEXT statement
+        (review fix)."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        victim_sess = _FakeSession()
+        big = MemTracker(0, "big", parent=root, session=victim_sess)
+        small = MemTracker(0, "small", parent=root, session=_FakeSession())
+        root.attach_statement(big)
+        root.attach_statement(small)
+        big.consume(900)
+        small.consume(200)
+        assert victim_sess._killed
+        big.detach()  # statement finished without hitting a checkpoint
+        assert not victim_sess._killed and victim_sess._kill_reason is None
+        small.detach()
+
+    def test_cobatched_fallback_isolates_quota_errors(self):
+        """Batcher review fix: when a group launch dies of ONE waiter's
+        quota, the serial fallback runs each job under its own tracker —
+        the breaching statement fails, its co-batched neighbor succeeds."""
+        from tidb_tpu.sched.batcher import LaunchBatcher, _Group, _Job
+        from tidb_tpu.utils import memory
+
+        root = ServerMemTracker()
+        poor = MemTracker(1000, "poor", parent=root)
+        rich = MemTracker(0, "rich", parent=root)
+
+        class StubEngine:
+            def execute_many(self, items):
+                raise RuntimeError("group launch poisoned")
+
+            def execute(self, dag, batch):
+                memory.consume_current(2000)  # > poor's quota
+                return "chunk"
+
+        with memory.bind(poor):
+            j1 = _Job("dag", "batch", None)
+        with memory.bind(rich):
+            j2 = _Job("dag", "batch", None)
+            follower = _Job("dag", "batch", None)
+        j1.followers.append(follower)  # dedup'd onto the poor member
+        group = _Group()
+        group.jobs = [j1, j2]
+        group.n_dedup = 1
+        LaunchBatcher()._launch(StubEngine(), group, None)
+        assert isinstance(j1.exc, MemoryQuotaExceeded)
+        assert j2.exc is None and j2.result == "chunk"
+        # the dedup follower must not inherit its member's quota verdict:
+        # it re-runs under its own tracker and succeeds
+        assert follower.exc is None and follower.result == "chunk"
+
+    def test_group_launch_not_charged_to_the_leader(self):
+        """Review fix: a grouped launch's shared uploads are unbound —
+        the leader must not fail ITS quota on neighbors' data."""
+        from tidb_tpu.sched.batcher import LaunchBatcher, _Group, _Job
+        from tidb_tpu.utils import memory
+
+        root = ServerMemTracker()
+        poor = MemTracker(1000, "leader", parent=root)
+
+        class GroupEngine:
+            def execute_many(self, items):
+                memory.consume_current(5000)  # group-shared h2d volume
+                return ["chunk"] * len(items)
+
+        with memory.bind(poor):  # the leader thread's ambient binding
+            j1 = _Job("dag", "batch", None)
+            j2 = _Job("dag", "batch", None)
+            group = _Group()
+            group.jobs = [j1, j2]
+            LaunchBatcher()._launch(GroupEngine(), group, None)
+        assert j1.exc is None and j2.exc is None
+        assert j1.result == "chunk" and j2.result == "chunk"
+        assert poor.consumed == 0, "leader charged for the shared launch"
+        # ...but the SERVER root still saw the launch volume (and it
+        # unwound when the launch finished)
+        assert root.max_consumed >= 5000
+        assert root.consumed == 0
+
+    def test_detached_tracker_drops_late_consumes(self):
+        """Review fix: a cop worker outliving its abandoned stream
+        consumes into a detached tracker — the bytes must be dropped,
+        not ratcheted into the session/server trackers forever."""
+        root = ServerMemTracker()
+        stmt = MemTracker(0, "stmt", parent=root, session=_FakeSession())
+        root.attach_statement(stmt)
+        stmt.consume(100)
+        stmt.detach()
+        assert root.consumed == 0
+        stmt.consume(7777)  # the straggler's late charge
+        stmt.release(10)
+        assert root.consumed == 0, "late consume leaked past detach"
+        assert stmt.consumed == 0
+        # the TOCTOU arm: even past the entry check, _add on a dead node
+        # absorbs nothing and tells the walk to stop
+        assert stmt._add(5) is None and stmt.consumed == 0
+
+    def test_transient_unregistered_volume_never_kills_statements(self):
+        """Review fix: when the overage lives in unregistered transient
+        volume (a grouped launch's shared uploads), the registered
+        statements collectively fit under the limit — killing one would
+        reclaim nothing, so nobody is killed; degrade still fires."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        sess = _FakeSession()
+        stmt = MemTracker(0, "stmt", parent=root, session=sess)
+        root.attach_statement(stmt)
+        stmt.consume(300)
+        transient = MemTracker(0, "cop.launch", parent=root)  # unregistered
+        transient.consume(900)  # root at 1200 > limit
+        assert not sess._killed, "innocent executed for a launch's bytes"
+        assert not [e for e in root.events if e["op"] == "kill"]
+        assert root.degraded  # the soft action still protects the store
+        transient.detach()
+        stmt.detach()
+        assert root.consumed == 0
+
+    def test_self_kill_also_holds_the_victim_grace(self):
+        """Review fix: the allocator-is-top in-place raise is a kill in
+        flight too — a concurrent small allocation during the bomb's
+        unwind must not record a second kill or flag anyone."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        bomb = MemTracker(0, "bomb", parent=root, session=_FakeSession())
+        root.attach_statement(bomb)
+        with pytest.raises(ServerMemoryExceeded):
+            bomb.consume(1500)
+        kills = [e for e in root.events if e["op"] == "kill"]
+        assert len(kills) == 1
+        inn_sess = _FakeSession()
+        innocent = MemTracker(0, "innocent", parent=root, session=inn_sess)
+        root.attach_statement(innocent)
+        innocent.consume(50)  # still over the limit, but bomb is unwinding
+        assert not inn_sess._killed
+        assert len([e for e in root.events if e["op"] == "kill"]) == 1
+        bomb.detach()
+        innocent.detach()
+        assert root.consumed == 0
+
+    def test_second_bomb_cannot_slip_through_the_grace_window(self):
+        """Review/flake fix: while victim #1 unwinds, a NEW allocator
+        whose own bytes alone breach the limit is killed in place — the
+        grace protects innocents, not fresh bombs."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        bomb1 = MemTracker(0, "bomb1", parent=root, session=_FakeSession())
+        bomb2 = MemTracker(0, "bomb2", parent=root, session=_FakeSession())
+        root.attach_statement(bomb1)
+        root.attach_statement(bomb2)
+        with pytest.raises(ServerMemoryExceeded):
+            bomb1.consume(1500)  # victim #1, grace armed
+        with pytest.raises(ServerMemoryExceeded, match="alone holds"):
+            bomb2.consume(1200)  # must NOT ride bomb1's unwind out
+        assert len([e for e in root.events if e["op"] == "kill"]) == 2
+        bomb1.detach()
+        bomb2.detach()
+        assert root.consumed == 0
+
+    def test_killed_victim_stays_dead_while_unwinding(self):
+        """Review fix: the grace must not let the victim ITSELF allocate
+        again (the batcher's serial fallback re-runs a killed leader) —
+        a recorded kill may never quietly complete."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        bomb = MemTracker(0, "bomb", parent=root, session=_FakeSession())
+        root.attach_statement(bomb)
+        with pytest.raises(ServerMemoryExceeded):
+            bomb.consume(1500)
+        with pytest.raises(ServerMemoryExceeded, match="already killed"):
+            bomb.consume(10)
+        assert len([e for e in root.events if e["op"] == "kill"]) == 1
+        bomb.detach()
+        assert root.consumed == 0
+
+    def test_kill_rechecks_consumption_under_the_lock(self):
+        """Review fix: arbitration re-reads the total under the registry
+        lock — when the real top consumer unwinds between the breach
+        snapshot and the lock, the innocent allocator must NOT be
+        executed on the stale total (it would look like the top)."""
+        root = ServerMemTracker()
+        root.set_limit(1000)
+        inn_sess = _FakeSession()
+        bomb = MemTracker(0, "bomb", parent=root, session=_FakeSession())
+        innocent = MemTracker(0, "innocent", parent=root, session=inn_sess)
+        root.attach_statement(bomb)
+        root.attach_statement(innocent)
+        bomb.consume(900)
+        real = root._reg_lock
+
+        class TrickLock:
+            """Interleaves the race deterministically: the bomb detaches
+            the instant the arbiter reaches for the registry lock."""
+
+            fired = False
+
+            def __enter__(self):
+                if not TrickLock.fired:
+                    TrickLock.fired = True
+                    root._reg_lock = real  # detach() must see the real lock
+                    bomb.detach()  # the 900 unwinds: total falls to 200
+                return real.__enter__()
+
+            def __exit__(self, *a):
+                return real.__exit__(*a)
+
+        root._reg_lock = TrickLock()
+        innocent.consume(200)  # snapshot sees 1100; truth at the lock is 200
+        assert not inn_sess._killed, "stale snapshot must not kill the innocent"
+        assert not [e for e in root.events if e["op"] == "kill"]
+        innocent.detach()
+        assert root.consumed == 0
+
+    def test_soft_limit_degrades_and_recovers_with_hysteresis(self):
+        root = ServerMemTracker()
+        root.set_limit(1000)  # soft = 800
+        stmt = MemTracker(0, "s", parent=root, session=_FakeSession())
+        root.attach_statement(stmt)
+        stmt.consume(850)
+        assert root.degraded
+        stmt.release(60)  # 790 ≥ soft*0.9=720: still degraded (hysteresis)
+        assert root.degraded
+        stmt.release(200)  # 590 < 720 → recover
+        assert not root.degraded
+        ops = [e["op"] for e in root.events]
+        assert ops == ["degrade", "recover"]
+
+
+class TestStatementUnwind:
+    """End-to-end: the three teardown paths leave the store tracker at
+    zero (tree accounting can never leak into the global tracker)."""
+
+    @pytest.fixture()
+    def s(self):
+        sess = Session()
+        sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, v INT)")
+        sess.execute(
+            "INSERT INTO t VALUES " + ",".join(f"({i}, {i % 7}, {i * 3})" for i in range(4096))
+        )
+        assert sess.store.mem.consumed == 0
+        return sess
+
+    def test_success_path_unwinds(self, s):
+        s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        s.must_query("SELECT * FROM t WHERE id < 100")
+        assert s.store.mem.consumed == 0
+        assert s.mem_tracker.consumed == 0
+        assert s.store.mem.max_consumed > 0  # something was actually tracked
+
+    def test_kill_path_unwinds(self, s):
+        calls = {"n": 0}
+
+        def kill_late():
+            # kill AFTER the first cop task so some memory is already
+            # consumed when the interrupt lands at a chunk boundary
+            calls["n"] += 1
+            s._killed = True
+
+        with FP.enabled("cop/before-task", kill_late):
+            with pytest.raises(QueryInterrupted):
+                s.must_query("SELECT * FROM t")
+        assert calls["n"] >= 1
+        assert s.store.mem.consumed == 0
+        assert s.mem_tracker.consumed == 0
+
+    def test_backoff_exhausted_path_unwinds(self, s):
+        s.vars["tidb_cop_engine"] = "tpu"
+        s.vars["tidb_backoff_budget_ms"] = "0"
+        s.vars["tidb_enable_cop_result_cache"] = "OFF"
+        with FP.enabled("cop/device-error", DeviceTransientError("preempted")):
+            with pytest.raises(BackoffExhausted):
+                s.must_query("SELECT SUM(v) FROM t")
+        assert s.store.mem.consumed == 0
+        assert s.mem_tracker.consumed == 0
+
+    def test_device_transfers_consume_into_statement(self, s):
+        """tpu_engine h2d/d2h land in the statement tracker: a device-path
+        statement's peak exceeds its host-visible chunk bytes alone, and
+        still unwinds to zero."""
+        s.vars["tidb_cop_engine"] = "tpu"
+        s.vars["tidb_enable_cop_result_cache"] = "OFF"
+        base = s.store.mem.max_consumed
+        s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        assert s.store.mem.max_consumed > base
+        assert s.store.mem.consumed == 0

@@ -40,20 +40,20 @@ class TestResourceGroupDDL:
     def test_create_show_alter_drop(self, s):
         s.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 1000 PRIORITY = HIGH")
         rows = s.must_query("SHOW RESOURCE GROUPS")
-        assert ("RG1", "1000", "HIGH", "NO") in rows
-        assert ("DEFAULT", "UNLIMITED", "MEDIUM", "YES") in rows
+        assert ("RG1", "1000", "HIGH", "NO", "NULL") in rows
+        assert ("DEFAULT", "UNLIMITED", "MEDIUM", "YES", "NULL") in rows
         s.execute("ALTER RESOURCE GROUP rg1 RU_PER_SEC = 500, PRIORITY = LOW, BURSTABLE")
         rows = s.must_query("SHOW RESOURCE GROUPS")
-        assert ("RG1", "500", "LOW", "YES") in rows
+        assert ("RG1", "500", "LOW", "YES", "NULL") in rows
         s.execute("DROP RESOURCE GROUP rg1")
-        assert ("RG1", "500", "LOW", "YES") not in s.must_query("SHOW RESOURCE GROUPS")
+        assert ("RG1", "500", "LOW", "YES", "NULL") not in s.must_query("SHOW RESOURCE GROUPS")
 
     def test_duplicate_and_missing_errors(self, s):
         s.execute("CREATE RESOURCE GROUP rg1 RU_PER_SEC = 10")
         with pytest.raises(ResourceGroupExists):
             s.execute("CREATE RESOURCE GROUP rg1")
         s.execute("CREATE RESOURCE GROUP IF NOT EXISTS rg1 RU_PER_SEC = 99")
-        assert ("RG1", "10", "MEDIUM", "NO") in s.must_query("SHOW RESOURCE GROUPS")
+        assert ("RG1", "10", "MEDIUM", "NO", "NULL") in s.must_query("SHOW RESOURCE GROUPS")
         with pytest.raises(ResourceGroupNotExists):
             s.execute("ALTER RESOURCE GROUP nope RU_PER_SEC = 1")
         with pytest.raises(ResourceGroupNotExists):
@@ -65,7 +65,7 @@ class TestResourceGroupDDL:
         same store observes the group without any propagation step."""
         s.execute("CREATE RESOURCE GROUP shared RU_PER_SEC = 42")
         other = Session(s.store)
-        assert ("SHARED", "42", "MEDIUM", "NO") in other.must_query("SHOW RESOURCE GROUPS")
+        assert ("SHARED", "42", "MEDIUM", "NO", "NULL") in other.must_query("SHOW RESOURCE GROUPS")
         other.execute("SET RESOURCE GROUP shared")
         assert other.vars["tidb_resource_group"] == "shared"
 
@@ -94,8 +94,8 @@ class TestResourceGroupDDL:
         s.execute("CREATE RESOURCE GROUP b0 RU_PER_SEC = 10 BURSTABLE = 0")
         s.execute("CREATE RESOURCE GROUP b1 RU_PER_SEC = 10 BURSTABLE = TRUE")
         rows = s.must_query("SHOW RESOURCE GROUPS")
-        assert ("B0", "10", "MEDIUM", "NO") in rows
-        assert ("B1", "10", "MEDIUM", "YES") in rows
+        assert ("B0", "10", "MEDIUM", "NO", "NULL") in rows
+        assert ("B1", "10", "MEDIUM", "YES", "NULL") in rows
         from tidb_tpu.errors import TiDBError
 
         with pytest.raises(TiDBError):
@@ -332,9 +332,9 @@ class TestLaunchBatcher:
         pairs = []
         real = ctl.batcher.execute
 
-        def capture(engine, dag, batch, dedup_key=None, stats=None):
+        def capture(engine, dag, batch, **kw):
             pairs.append((dag, batch))
-            return real(engine, dag, batch, dedup_key=dedup_key, stats=stats)
+            return real(engine, dag, batch, **kw)
 
         ctl.batcher.execute = capture
         try:

@@ -97,9 +97,9 @@ class TestFanoutAttribution:
         pairs = []
         real = ctl.batcher.execute
 
-        def capture(engine, dag, batch, dedup_key=None, stats=None):
+        def capture(engine, dag, batch, **kw):
             pairs.append((dag, batch))
-            return real(engine, dag, batch, dedup_key=dedup_key, stats=stats)
+            return real(engine, dag, batch, **kw)
 
         ctl.batcher.execute = capture
         try:

@@ -1,0 +1,454 @@
+"""Runaway-query watchdog + server memory arbitration (ISSUE 4
+acceptance): (a) a global-limit breach kills the top consumer while
+concurrent innocent statements finish bit-identical, (b) soft-limit
+degradation reroutes auto-engine tasks to host with no client-visible
+error, (c) a KILLed runaway's digest is rejected at admission for the
+watch TTL and COOLDOWN demotes without killing — all observable in the
+memtables, metrics and trace spans."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import (
+    MemoryQuotaExceeded,
+    ParseError,
+    RunawayKilled,
+    RunawayQuarantined,
+)
+from tidb_tpu.sched import AdmissionScheduler, SchedCtx, ru_cost
+from tidb_tpu.sched.runaway import RunawayChecker, parse_duration_ms
+from tidb_tpu.session import Session
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, v INT)")
+    sess.execute(
+        "INSERT INTO t VALUES " + ",".join(f"({i}, {i % 7}, {i * 3})" for i in range(4096))
+    )
+    sess.vars["tidb_enable_cop_result_cache"] = "OFF"
+    return sess
+
+
+class TestServerMemoryArbitration:
+    def test_memory_bomb_killed_innocents_bit_identical(self, s):
+        """(a) concurrent memory bombs die at the server limit; innocent
+        statements running alongside return exactly the serial answer."""
+        s.execute("CREATE TABLE big (id INT PRIMARY KEY, a INT, b INT, c INT)")
+        for lo in range(0, 40960, 8192):
+            s.execute("INSERT INTO big VALUES "
+                      + ",".join(f"({i},{i},{i},{i})" for i in range(lo, lo + 8192)))
+        innocent_sql = "SELECT g, SUM(v), COUNT(*) FROM t GROUP BY g ORDER BY g"
+        expect = s.must_query(innocent_sql)
+        kills0 = M.SERVER_MEM_ACTIONS.value(action="kill")
+        s.execute("SET GLOBAL tidb_server_memory_limit = 262144")
+        bombs = [Session(s.store) for _ in range(2)]
+        innocents = [Session(s.store) for _ in range(2)]
+        for i in innocents:
+            # pin innocents to the host path: a device route would pad
+            # their 4096 rows to full 64Ki tiles and the tracked h2d
+            # upload alone (~1.2MB) would dwarf the bomb — the soft-limit
+            # test below covers auto-engine behavior under pressure
+            i.vars["tidb_cop_engine"] = "host"
+        killed, errors, results = [], [], []
+
+        def bomb(sess):
+            for _ in range(3):
+                try:
+                    sess.must_query("SELECT * FROM big")
+                    errors.append("bomb survived the server limit")
+                except MemoryQuotaExceeded:
+                    killed.append(1)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"bomb died wrong: {type(e).__name__}: {e}")
+
+        def innocent(sess):
+            for _ in range(8):
+                try:
+                    results.append(sess.must_query(innocent_sql))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"innocent failed: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=bomb, args=(b,)) for b in bombs]
+        threads += [threading.Thread(target=innocent, args=(i,)) for i in innocents]
+        try:
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=120)
+            assert not any(th.is_alive() for th in threads)
+        finally:
+            s.execute("SET GLOBAL tidb_server_memory_limit = 0")
+        assert not errors, errors
+        assert len(killed) == 6, "every bomb attempt must hit the limit"
+        assert len(results) == 16 and all(r == expect for r in results), \
+            "innocent results must be bit-identical under memory pressure"
+        # unwound: nothing leaked into the store tracker
+        assert s.store.mem.consumed == 0
+        # observable: ops history + metrics recorded the kills
+        ops = [r[0] for r in s.must_query(
+            "SELECT OP FROM information_schema.memory_usage_ops_history")]
+        assert "kill" in ops
+        assert M.SERVER_MEM_ACTIONS.value(action="kill") >= kills0 + 6
+
+    def test_soft_limit_degrades_auto_to_host_without_error(self, s):
+        """(b) above limit×alarm_ratio, auto cop tasks reroute to host —
+        the client sees a correct answer, never an error — and the tile
+        caches (with their device mirrors) are evicted."""
+        from tidb_tpu.utils.memory import MemTracker
+
+        sql = "SELECT g, SUM(v) FROM t GROUP BY g ORDER BY g"
+        expect = s.must_query(sql)  # warms the tile cache too
+        assert len(s.cop.tiles._cache) > 0
+        s.execute("SET GLOBAL tidb_server_memory_limit = 10485760")
+        held = MemTracker(0, "held", parent=s.store.mem, session=None)
+        s.store.mem.attach_statement(held)
+        try:
+            held.consume(9_000_000)  # > 80% of 10MB: soft, not hard
+            assert s.store.mem.degraded
+            assert len(s.cop.tiles._cache) == 0, "soft action must evict tiles"
+            before = dict(s.cop.stats)
+            s.vars["tidb_enable_trace"] = "ON"
+            try:
+                got = s.must_query(sql)
+            finally:
+                s.vars["tidb_enable_trace"] = "OFF"
+            d = {k: s.cop.stats[k] - before.get(k, 0) for k in s.cop.stats}
+            assert got == expect, "degraded answer must be bit-identical"
+            assert d["mem_degraded_tasks"] >= 1
+            assert d["host_tasks"] >= 1 and d["tpu_tasks"] == 0
+            # the degradation decision is a trace span
+            spans = [r[2] for r in s.must_query(
+                "SELECT TRACE_ID, SESSION_ID, OPERATION FROM information_schema.tidb_trace")]
+            assert "mem.degrade" in spans
+        finally:
+            held.detach()
+            s.execute("SET GLOBAL tidb_server_memory_limit = 0")
+        assert not s.store.mem.degraded, "release must recover the store"
+        ops = [e["op"] for e in s.store.mem.events]
+        assert "degrade" in ops and "recover" in ops
+
+
+class TestRunawayWatchdog:
+    def test_kill_then_watch_rejects_until_ttl(self, s):
+        """(c) EXEC_ELAPSED breach with ACTION=KILL interrupts the
+        statement; its digest is rejected AT ADMISSION for the WATCH TTL
+        (even after the group's limit is dropped), then readmitted."""
+        s.execute("CREATE RESOURCE GROUP rg_kill "
+                  "QUERY_LIMIT=(EXEC_ELAPSED='120ms', ACTION=KILL, WATCH='1200ms')")
+        s.execute("SET RESOURCE GROUP rg_kill")
+        hits0 = M.RUNAWAY_WATCH_HITS.value(action="KILL", group="rg_kill")
+        with FP.enabled("cop/before-task", ("sleep", 0.3)):
+            with pytest.raises(RunawayKilled, match="runaway"):
+                s.must_query("SELECT SUM(v) FROM t")
+        # only the watch list enforces from here on
+        s.execute("ALTER RESOURCE GROUP rg_kill QUERY_LIMIT=NULL")
+        with pytest.raises(RunawayQuarantined, match="watch list"):
+            s.must_query("SELECT SUM(v) FROM t")
+        assert M.RUNAWAY_WATCH_HITS.value(action="KILL", group="rg_kill") == hits0 + 1
+        rows = s.must_query(
+            "SELECT RESOURCE_GROUP, ACTION, REASON FROM information_schema.runaway_watches")
+        assert ("rg_kill", "KILL", "exec_elapsed") in rows
+        events = s.must_query(
+            "SELECT ACTION, RULE FROM information_schema.runaway_events")
+        assert ("KILL", "exec_elapsed") in events and ("KILL", "watch") in events
+        time.sleep(1.3)  # watch TTL expires
+        assert s.must_query("SELECT SUM(v) FROM t")  # readmitted
+        s.execute("SET RESOURCE GROUP default")
+
+    def test_cooldown_demotes_without_killing(self, s):
+        s.execute("CREATE RESOURCE GROUP rg_cool "
+                  "QUERY_LIMIT=(EXEC_ELAPSED='20ms', ACTION=COOLDOWN)")
+        s.execute("SET RESOURCE GROUP rg_cool")
+        expect = s.must_query("SELECT COUNT(*) FROM t")
+        with FP.enabled("cop/before-task", ("sleep", 0.08)):
+            got = s.must_query("SELECT COUNT(*) FROM t")
+        assert got == expect, "COOLDOWN must not change the answer"
+        events = s.must_query(
+            "SELECT RESOURCE_GROUP, ACTION, RULE FROM information_schema.runaway_events")
+        assert ("rg_cool", "COOLDOWN", "exec_elapsed") in events
+        assert M.RUNAWAY_ACTIONS.value(
+            group="rg_cool", action="COOLDOWN", rule="exec_elapsed") >= 1
+        s.execute("SET RESOURCE GROUP default")
+
+    def test_cooldown_shrinks_backoff_budget(self, s):
+        from tidb_tpu.copr.retry import Backoffer
+
+        ctl = s.store.sched
+        checker = RunawayChecker(ctl.runaway, None, "g", None, "d", None, "")
+        ctx = SchedCtx(backoff_budget_ms=1000.0, runaway=checker)
+        assert Backoffer.for_ctx(ctx).budget_ms == 1000.0
+        checker.demoted = True
+        assert Backoffer.for_ctx(ctx).budget_ms == 250.0
+
+    def test_oom_kill_while_queued_is_labeled_in_sched_metrics(self, s):
+        """Review fix: an oom-arbiter kill landing in the admission wait
+        loop must reach the SCHED_TASKS outcome metric (it raises
+        MemoryQuotaExceeded, not QueryInterrupted)."""
+        from tidb_tpu.errors import ServerMemoryExceeded
+
+        class _Sess:
+            _killed = True
+            _kill_reason = "oom"
+
+        sched = AdmissionScheduler(s.store.sched.groups, max_concurrency=1)
+        blocker = sched.acquire(SchedCtx())
+        n0 = M.SCHED_TASKS.value(group="default", outcome="oom")
+        with pytest.raises(ServerMemoryExceeded):
+            sched.acquire(SchedCtx(session=_Sess()))
+        assert M.SCHED_TASKS.value(group="default", outcome="oom") == n0 + 1
+        sched.release(blocker)
+
+    def test_demoted_statement_queues_at_low_priority(self, s):
+        """A COOLDOWN-demoted statement loses its group priority: a
+        MEDIUM waiter overtakes a demoted HIGH waiter in the queue."""
+        s.execute("CREATE RESOURCE GROUP hi PRIORITY = HIGH")
+        sched = AdmissionScheduler(s.store.sched.groups, max_concurrency=1)
+        blocker = sched.acquire(SchedCtx())
+        checker = RunawayChecker(s.store.sched.runaway, None, "hi", None, "d", None, "")
+        checker.demoted = True
+        order, threads = [], []
+
+        def worker(name, ctx):
+            t = sched.acquire(ctx)
+            order.append(name)
+            sched.release(t)
+
+        th = threading.Thread(target=worker, args=("demoted-hi", SchedCtx(group="hi", runaway=checker)))
+        th.start()
+        threads.append(th)
+        while sched.queue_depth() < 1:
+            time.sleep(0.005)
+        th = threading.Thread(target=worker, args=("medium", SchedCtx()))
+        th.start()
+        threads.append(th)
+        while sched.queue_depth() < 2:
+            time.sleep(0.005)
+        sched.release(blocker)
+        for th in threads:
+            th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads)
+        assert order[0] == "medium", "demotion must outrank the HIGH group"
+
+    def test_processed_rows_rule(self, s):
+        s.execute("CREATE RESOURCE GROUP rg_rows "
+                  "QUERY_LIMIT=(PROCESSED_ROWS=100, ACTION=KILL, WATCH='50ms')")
+        s.execute("SET RESOURCE GROUP rg_rows")
+        with pytest.raises(RunawayKilled, match="processed_rows"):
+            s.must_query("SELECT SUM(v) FROM t")  # scans 4096 rows
+        time.sleep(0.1)
+        s.execute("SET RESOURCE GROUP default")
+
+    def test_ru_rule(self, s):
+        s.execute("CREATE RESOURCE GROUP rg_ru "
+                  "QUERY_LIMIT=(RU=1, ACTION=KILL, WATCH='50ms')")
+        s.execute("SET RESOURCE GROUP rg_ru")
+        with pytest.raises(RunawayKilled, match="rule: ru"):
+            s.must_query("SELECT SUM(v) FROM t")  # ~5 RU of rows+bytes
+        time.sleep(0.1)
+        s.execute("SET RESOURCE GROUP default")
+
+    def test_dryrun_records_only(self, s):
+        s.execute("CREATE RESOURCE GROUP rg_dry "
+                  "QUERY_LIMIT=(EXEC_ELAPSED='20ms', ACTION=DRYRUN)")
+        s.execute("SET RESOURCE GROUP rg_dry")
+        expect = s.must_query("SELECT COUNT(*) FROM t")
+        with FP.enabled("cop/before-task", ("sleep", 0.08)):
+            assert s.must_query("SELECT COUNT(*) FROM t") == expect
+        events = s.must_query(
+            "SELECT RESOURCE_GROUP, ACTION FROM information_schema.runaway_events")
+        assert ("rg_dry", "DRYRUN") in events
+        s.execute("SET RESOURCE GROUP default")
+
+    def test_cooldown_watch_demotes_next_statement(self, s):
+        """An explicit WATCH on a COOLDOWN limit carries the demotion to
+        the digest's NEXT statements — visible as a watch hit, never a
+        kill."""
+        s.execute("CREATE RESOURCE GROUP rg_cw "
+                  "QUERY_LIMIT=(EXEC_ELAPSED='20ms', ACTION=COOLDOWN, WATCH='5s')")
+        s.execute("SET RESOURCE GROUP rg_cw")
+        with FP.enabled("cop/before-task", ("sleep", 0.08)):
+            s.must_query("SELECT MAX(v) FROM t")
+        assert s.must_query("SELECT MAX(v) FROM t")  # same digest: demoted, not killed
+        events = s.must_query(
+            "SELECT ACTION, RULE FROM information_schema.runaway_events")
+        assert ("COOLDOWN", "watch") in events
+        s.execute("SET RESOURCE GROUP default")
+
+    def test_admission_watch_hit_recorded_once_but_enforced_always(self, s):
+        """Review fix: a statement's parallel cop tasks share one
+        checker — the watch verdict records ONE hit event but rejects
+        EVERY task."""
+        from tidb_tpu.sched.runaway import RunawayManager
+
+        mgr = RunawayManager()
+        mgr.mark("d", "g", "KILL", "test", ttl_ms=60_000)
+        hits0 = M.RUNAWAY_WATCH_HITS.value(group="g", action="KILL")
+        checker = RunawayChecker(mgr, None, "g", None, "d", None, "sql")
+        for _ in range(3):
+            with pytest.raises(RunawayQuarantined):
+                checker.on_admission()
+        assert M.RUNAWAY_WATCH_HITS.value(group="g", action="KILL") == hits0 + 1
+        assert len([e for e in mgr.events if e["rule"] == "watch"]) == 1
+
+    def test_threshold_fire_once_and_kill_verdict_sticky(self, s):
+        """Review fix: _fire draws the verdict once under a lock (no
+        duplicate events from parallel tasks) and a KILL stays sticky —
+        every later tick re-raises."""
+        from tidb_tpu.sched.runaway import QueryLimit, RunawayManager
+
+        mgr = RunawayManager()
+        lim = QueryLimit(exec_elapsed_ms=0.0, action="KILL", watch_ms=60_000.0)
+        checker = RunawayChecker(mgr, None, "g", lim, "d2", None, "sql")
+        with pytest.raises(RunawayKilled):
+            checker._fire("exec_elapsed")
+        checker._fire("exec_elapsed")  # the losing sibling: silent no-op
+        assert len([e for e in mgr.events if e["rule"] == "exec_elapsed"]) == 1
+        with pytest.raises(RunawayKilled):
+            checker.tick()  # sticky: the statement dies at every checkpoint
+
+    def test_watch_is_scoped_to_its_resource_group(self):
+        """Review fix: a KILL watch armed under one group must not
+        quarantine the digest for statements bound to OTHER groups (which
+        never opted into runaway control)."""
+        from tidb_tpu.sched.runaway import RunawayManager
+
+        mgr = RunawayManager()
+        mgr.mark("d3", "rg1", "KILL", "test", ttl_ms=60_000)
+        other = RunawayChecker(mgr, None, "default", None, "d3", None, "sql")
+        other.on_admission()  # different group: admitted
+        same = RunawayChecker(mgr, None, "rg1", None, "d3", None, "sql")
+        with pytest.raises(RunawayQuarantined):
+            same.on_admission()
+        # one digest, two groups: rg2's later DRYRUN watch must not
+        # overwrite rg1's live KILL watch (keys are (digest, group))
+        mgr.mark("d3", "rg2", "DRYRUN", "test", ttl_ms=60_000)
+        assert mgr.watch_for("d3", "rg1").action == "KILL"
+        assert mgr.watch_for("d3", "rg2").action == "DRYRUN"
+
+    def test_expired_watches_restore_the_idle_fast_path(self):
+        """Review fix: once every watch TTL lapses, checker_for must
+        return None again (no per-statement digest/checker cost forever
+        after one long-forgotten KILL)."""
+        from tidb_tpu.sched import ResourceGroup
+        from tidb_tpu.sched.runaway import RunawayManager
+
+        mgr = RunawayManager()
+        plain = ResourceGroup("plain")  # no QUERY_LIMIT
+        assert mgr.checker_for(None, plain, "SELECT 1", None) is None
+        mgr.mark("digest", "g", "KILL", "test", ttl_ms=30)
+        assert mgr.checker_for(None, plain, "SELECT 1", None) is not None
+        time.sleep(0.05)
+        assert mgr.checker_for(None, plain, "SELECT 1", None) is None, \
+            "expired watches must be swept, not pinned forever"
+        assert not mgr._watches
+
+    def test_query_limit_parse_validation(self, s):
+        with pytest.raises(ParseError):
+            s.execute("CREATE RESOURCE GROUP bad QUERY_LIMIT=(ACTION=KILL)")
+        with pytest.raises(ParseError):
+            s.execute("CREATE RESOURCE GROUP bad QUERY_LIMIT=(RU=1, ACTION=EXPLODE)")
+        assert parse_duration_ms("800ms") == 800.0
+        assert parse_duration_ms("10s") == 10_000.0
+        assert parse_duration_ms("5m") == 300_000.0
+        assert parse_duration_ms("2") == 2_000.0  # bare number = seconds
+        assert parse_duration_ms("1m30s") == 90_000.0  # compound Go form
+        with pytest.raises(ValueError):
+            parse_duration_ms("banana")
+
+    def test_alarm_ratio_clamped_to_displayed_value(self, s):
+        """SET value and enforced value must agree: out-of-range ratios
+        clamp at SET time, not silently at enforcement."""
+        s.execute("SET GLOBAL tidb_memory_usage_alarm_ratio = 5")
+        try:
+            assert s.store.global_vars["tidb_memory_usage_alarm_ratio"] == "1.0"
+            assert s.store.mem.alarm_ratio == 1.0
+        finally:
+            s.execute("SET GLOBAL tidb_memory_usage_alarm_ratio = 0.8")
+
+
+class TestSatellites:
+    def test_ru_cost_has_byte_term(self):
+        assert ru_cost(0) == 1.0
+        assert ru_cost(1024) == 2.0
+        assert ru_cost(0, 65536.0) == 2.0
+        # same rows, wider data → more RU (the PR 1 debt this closes)
+        assert ru_cost(1024, 1 << 20) > ru_cost(1024, 1 << 10)
+
+    def test_trace_ring_resize_keeps_newest(self):
+        from tidb_tpu.utils.tracing import TraceRing
+
+        ring = TraceRing(capacity=8)
+        for i in range(6):
+            ring.push({"trace_id": f"tr-{i}", "spans": []})
+        ring.resize(2)
+        snap = ring.snapshot()
+        assert [t["trace_id"] for t in snap] == ["tr-4", "tr-5"]
+        ring.resize(16)
+        assert ring.capacity == 16
+        assert [t["trace_id"] for t in ring.snapshot()] == ["tr-4", "tr-5"]
+
+    def test_trace_ring_sysvar_is_global_and_live(self, s):
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("SET tidb_trace_ring_capacity = 16")
+        assert s.store.trace_ring.capacity == 64
+        s.execute("SET GLOBAL tidb_trace_ring_capacity = 16")
+        try:
+            assert s.store.trace_ring.capacity == 16
+        finally:
+            s.execute("SET GLOBAL tidb_trace_ring_capacity = 64")
+
+    def test_cobatched_launch_counters_reach_every_client(self, s):
+        """PR 3 debt: a co-batched launch's device counters must land in
+        EVERY participating client's store-level stats (EXPLAIN ANALYZE
+        `device:` line), not only the solo-launch path."""
+        other = Session(s.store)
+        ctl = s.store.sched
+        eng = ctl.tpu_engine
+        pairs = []
+        real = ctl.batcher.execute
+
+        def capture(engine, dag, batch, **kw):
+            pairs.append((dag, batch))
+            return real(engine, dag, batch, **kw)
+
+        ctl.batcher.execute = capture
+        try:
+            s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        finally:
+            ctl.batcher.execute = real
+        assert pairs, "query never reached the device path"
+        dag, batch = pairs[0]
+        # deterministic shared launch: one group, two waiters from two
+        # different clients, driven through the real _launch path
+        from tidb_tpu.sched.batcher import _Group, _Job
+
+        j1 = _Job(dag, batch, None, client=s.cop)
+        j2 = _Job(dag, batch, None, client=other.cop)
+        group = _Group()
+        group.jobs = [j1, j2]
+        before = [dict(s.cop.stats), dict(other.cop.stats)]
+        ctl.batcher._launch(eng, group, None)
+        assert group.done.is_set()
+        assert j1.exc is None and j2.exc is None
+        assert j1.result is not None and j2.result is not None
+        for c, b in zip([s.cop, other.cop], before):
+            assert c.stats["device_ms"] > b["device_ms"], \
+                "co-batched waiter's client stats missed the launch"
+        # the one launch lands identically in both clients
+        d1 = s.cop.stats["device_ms"] - before[0]["device_ms"]
+        d2 = other.cop.stats["device_ms"] - before[1]["device_ms"]
+        assert d1 == pytest.approx(d2)

@@ -23,20 +23,48 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
     "slow_query": (
         ["TIME", "USER", "DB", "QUERY_TIME", "DIGEST", "SUCC", "QUERY",
          # cop-path exec details (PR 3): admission wait, launch batching,
-         # retries/backoff, device compile + host<->device transfer
+         # retries/backoff, device compile + host<->device transfer;
+         # (PR 4): peak tracked statement memory
          "SCHED_WAIT", "BATCH_OCCUPANCY", "RETRIES", "BACKOFF_MS",
-         "COMPILE_MS", "TRANSFER_BYTES"],
+         "COMPILE_MS", "TRANSFER_BYTES", "MEM_MAX"],
         [ft_varchar(32), ft_varchar(32), ft_varchar(64), ft_double(), ft_varchar(32), ft_longlong(), ft_varchar(512),
          ft_double(), ft_longlong(), ft_longlong(), ft_double(),
-         ft_double(), ft_longlong()],
+         ft_double(), ft_longlong(), ft_longlong()],
     ),
     "statements_summary": (
         ["DIGEST", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY", "AVG_LATENCY", "ERRORS", "DIGEST_TEXT",
          "SUM_SCHED_WAIT", "MAX_BATCH_OCCUPANCY", "SUM_RETRIES",
-         "SUM_BACKOFF_MS", "SUM_COMPILE_MS", "SUM_TRANSFER_BYTES"],
+         "SUM_BACKOFF_MS", "SUM_COMPILE_MS", "SUM_TRANSFER_BYTES", "MAX_MEM"],
         [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_longlong(), ft_varchar(256),
          ft_double(), ft_longlong(), ft_longlong(),
-         ft_double(), ft_double(), ft_longlong()],
+         ft_double(), ft_double(), ft_longlong(), ft_longlong()],
+    ),
+    # --- PR 4: runaway control + server memory arbitration ----------------
+    "runaway_watches": (
+        # live TTL watch list (sched/runaway.py): digests rejected (KILL)
+        # or demoted (COOLDOWN) at admission until the watch expires
+        ["RESOURCE_GROUP", "SQL_DIGEST", "ACTION", "REASON", "START_TIME", "REMAIN_S"],
+        [ft_varchar(64), ft_varchar(32), ft_varchar(16), ft_varchar(32),
+         ft_varchar(32), ft_double()],
+    ),
+    "runaway_events": (
+        # every QUERY_LIMIT action fired (incl. watch-list hits)
+        ["TIME", "RESOURCE_GROUP", "SQL_DIGEST", "RULE", "ACTION", "SAMPLE_SQL"],
+        [ft_varchar(32), ft_varchar(64), ft_varchar(32), ft_varchar(32),
+         ft_varchar(16), ft_varchar(256)],
+    ),
+    "memory_usage": (
+        # live tracker tree (utils/memory): the server root + every
+        # attached statement tracker
+        ["SCOPE", "LABEL", "CONSUMED", "MAX_CONSUMED", "QUOTA", "SQL"],
+        [ft_varchar(16), ft_varchar(64), ft_longlong(), ft_longlong(),
+         ft_longlong(), ft_varchar(256)],
+    ),
+    "memory_usage_ops_history": (
+        # arbiter actions: degrade / recover / kill with the victim
+        ["TIME", "OP", "CONSUMED", "LIMIT", "VICTIM", "DETAILS"],
+        [ft_varchar(32), ft_varchar(16), ft_longlong(), ft_longlong(),
+         ft_varchar(64), ft_varchar(256)],
     ),
     "tidb_trace": (
         # flattened span rows of the last-N statement traces
@@ -143,6 +171,7 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.f(e.get("backoff_ms", 0.0)),
                 Datum.f(e.get("compile_ms", 0.0)),
                 Datum.i(int(e.get("transfer_bytes", 0))),
+                Datum.i(int(e.get("mem_bytes", 0))),
             ])
         return out
     if name == "statements_summary":
@@ -162,6 +191,7 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.f(st.get("sum_backoff_ms", 0.0)),
                 Datum.f(st.get("sum_compile_ms", 0.0)),
                 Datum.i(int(st.get("sum_transfer_bytes", 0))),
+                Datum.i(int(st.get("max_mem_bytes", 0))),
             ])
         return out
     if name == "tidb_trace":
@@ -181,6 +211,49 @@ def rows_for(session, name: str) -> list[list[Datum]]:
         from ..utils.metrics import REGISTRY
 
         return [[Datum.s(n), Datum.s(l), Datum.f(v)] for n, l, v in REGISTRY.rows()]
+    if name == "runaway_watches":
+        rm = session.store.sched.runaway
+        out = []
+        for digest, w, remain in sorted(rm.watches_snapshot(), key=lambda x: x[0]):
+            ts = datetime.datetime.fromtimestamp(w.start).strftime("%Y-%m-%d %H:%M:%S")
+            out.append([
+                Datum.s(w.group), Datum.s(digest), Datum.s(w.action),
+                Datum.s(w.reason), Datum.s(ts), Datum.f(round(remain, 3)),
+            ])
+        return out
+    if name == "runaway_events":
+        rm = session.store.sched.runaway
+        out = []
+        for e in list(rm.events):
+            ts = datetime.datetime.fromtimestamp(e["time"]).strftime("%Y-%m-%d %H:%M:%S")
+            out.append([
+                Datum.s(ts), Datum.s(e["group"]), Datum.s(e["digest"]),
+                Datum.s(e["rule"]), Datum.s(e["action"]), Datum.s(e["sql"]),
+            ])
+        return out
+    if name == "memory_usage":
+        mem = session.store.mem
+        out = [[
+            Datum.s("server"), Datum.s(mem.label), Datum.i(mem.consumed),
+            Datum.i(mem.max_consumed), Datum.i(mem.limit), Datum.s(""),
+        ]]
+        for t in sorted(mem.statements(), key=lambda x: -x.consumed):
+            out.append([
+                Datum.s("statement"), Datum.s(t.label), Datum.i(t.consumed),
+                Datum.i(t.max_consumed), Datum.i(t.quota), Datum.s(t.sql),
+            ])
+        return out
+    if name == "memory_usage_ops_history":
+        mem = session.store.mem
+        out = []
+        for e in list(mem.events):
+            ts = datetime.datetime.fromtimestamp(e["time"]).strftime("%Y-%m-%d %H:%M:%S")
+            out.append([
+                Datum.s(ts), Datum.s(e["op"]), Datum.i(int(e["consumed"])),
+                Datum.i(int(e["limit"])), Datum.s(str(e.get("victim", ""))),
+                Datum.s(str(e.get("victim_sql") or e.get("detail", ""))[:256]),
+            ])
+        return out
     if name == "processlist":
         import time as _time
 

@@ -38,6 +38,7 @@ from ..errors import (
 )
 from ..mysqltypes.datum import Datum, K_BYTES
 from ..sched import SchedCtx, ru_cost
+from ..utils import memory
 from ..utils import tracing
 from ..utils.failpoint import inject as _fp
 from .dag import DAGRequest
@@ -50,7 +51,7 @@ from .retry import (
     Backoffer,
     classify_device_error,
 )
-from .tilecache import ColumnBatch, TileCache, decode_rows_to_batch
+from .tilecache import ColumnBatch, TileCache, batch_nbytes, decode_rows_to_batch
 
 
 @dataclass
@@ -109,6 +110,10 @@ class CopClient:
     def __init__(self, storage):
         self.storage = storage
         self.tiles = TileCache(storage)
+        # the server memory arbiter's soft-limit action evicts this
+        # client's tile cache (and its device mirrors) with every other
+        # registered one when the store crosses the alarm ratio
+        storage.mem.register_cache(self.tiles)
         self.results = CopResultCache()
         self._tpu = None
         self._pool = None
@@ -136,6 +141,9 @@ class CopClient:
             "transfer_bytes": 0,
             "device_ms": 0,
             "host_ms": 0,
+            # memory-arbitration + runaway counters (PR 4)
+            "mem_degraded_tasks": 0,
+            "processed_rows": 0,
         }
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -192,7 +200,7 @@ class CopClient:
         """Capture admission context ON the session thread (send/send_index/
         send_handles run there; _run_task may not — contextvars don't cross
         the cop pool)."""
-        from ..executor.executors import _ACTIVE_SESSION
+        from ..executor.executors import _ACTIVE_SESSION, _ACTIVE_TRACKER
 
         sess = _ACTIVE_SESSION.get(None)
         if sess is None:
@@ -216,6 +224,8 @@ class CopClient:
             enabled=enabled == "ON",
             trace=getattr(sess, "_tracer", None),
             backoff_budget_ms=budget,
+            runaway=getattr(sess, "_runaway", None),
+            mem=_ACTIVE_TRACKER.get(None),
         )
 
     @property
@@ -377,7 +387,8 @@ class CopClient:
             bo = Backoffer.for_ctx(sctx, stats=st)
             bo.abort = abort
         trace = getattr(sctx, "trace", None) if sctx is not None else None
-        with tracing.activate(trace), (
+        mem = getattr(sctx, "mem", None) if sctx is not None else None
+        with tracing.activate(trace), memory.bind(mem), (
             trace.span("cop.task", region=t.region_id) if trace is not None else tracing._NOOP
         ):
             return self._run_task_traced(table, dag, t, read_ts, engine, bo, cache, sctx, st)
@@ -498,8 +509,20 @@ class CopClient:
         st = self._stats_fn(sctx)
         trace = getattr(sctx, "trace", None) if sctx is not None else None
         st("tasks")
+        st("processed_rows", batch.n_rows)
         if engine == "auto" and batch.n_rows < self.AUTO_MIN_ROWS:
             engine = "host"
+        if engine == "auto" and self.storage.mem.degraded:
+            # server soft memory limit crossed: auto traffic degrades to
+            # the host engine — a device round-trip means fresh h2d
+            # uploads exactly when the store is trying to shed memory.
+            # Forced 'tpu' stays forced (the explicit-engine contract)
+            engine = "host"
+            st("mem_degraded_tasks")
+            if trace is not None and trace.recording:
+                trace.closed_span("mem.degrade", 0.0,
+                                  consumed=self.storage.mem.consumed,
+                                  limit=self.storage.mem.limit)
         if (engine == "auto" and dag.agg is None and dag.topn is None
                 and dag.limit is None and dag.selection is None):
             # bare scan: the lanes already live host-side in the tile
@@ -523,7 +546,9 @@ class CopClient:
         ctl = self.ctl if (sctx is None or sctx.enabled) else None
         if bo is None:
             bo = Backoffer.for_ctx(sctx, stats=st)
-        with tracing.activate(trace):
+        with tracing.activate(trace), memory.bind(
+            getattr(sctx, "mem", None) if sctx is not None else None
+        ):
             while True:
                 if bo.abort is not None and bo.abort.is_set():
                     raise QueryInterrupted("cop stream abandoned")
@@ -560,7 +585,8 @@ class CopClient:
                                 with tracing.collect_phases() as ph:
                                     if ctl is not None:
                                         chunk = ctl.batcher.execute(
-                                            self.tpu, dag, batch, dedup_key=dedup, stats=st
+                                            self.tpu, dag, batch, dedup_key=dedup,
+                                            stats=st, client=self,
                                         )
                                     else:
                                         chunk = self.tpu.execute(dag, batch)
@@ -615,7 +641,7 @@ class CopClient:
                     return chunk
                 finally:
                     if ticket is not None:
-                        ru = ru_cost(batch.n_rows)
+                        ru = ru_cost(batch.n_rows, batch_nbytes(batch))
                         ctl.scheduler.release(ticket, ru)
                         st("ru", ru)
 
@@ -625,14 +651,8 @@ class CopClient:
         launches itself): exec-detail counters + trace spans."""
         if not ph:
             return
-        if ph.get("compile_ms"):
-            st("compile_ms", ph["compile_ms"])
-        tb = ph.get("h2d_bytes", 0.0) + ph.get("d2h_bytes", 0.0)
-        if tb:
-            st("transfer_bytes", tb)
-        dm = ph.get("execute_ms", 0.0) + ph.get("h2d_ms", 0.0)
-        if dm:
-            st("device_ms", dm)
+        for key, n in tracing.phase_counters(ph):
+            st(key, n)
         if trace is not None:
             trace.add_phase_spans(ph)
 

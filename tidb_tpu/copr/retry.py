@@ -100,6 +100,11 @@ class Backoffer:
             budget_ms = getattr(sctx, "backoff_budget_ms", None)
         if budget_ms is None:
             budget_ms = COP_BACKOFF_BUDGET_MS
+        rc = getattr(sctx, "runaway", None)
+        if rc is not None and rc.demoted:
+            # runaway COOLDOWN: a demoted statement gets a quarter of the
+            # sleep budget — less patience for a known misbehaver
+            budget_ms *= 0.25
         return cls(
             budget_ms,
             deadline=getattr(sctx, "deadline", None),

@@ -49,6 +49,23 @@ class ColumnBatch:
         return Chunk(cols)
 
 
+def batch_nbytes(batch: ColumnBatch) -> float:
+    """Approximate host bytes of a batch — the RU read-byte term and the
+    arbiter's footprint proxy. numpy lanes answer exactly; object lanes
+    count their pointer array (a cheap, stable underestimate — the RU
+    model needs monotonic, not forensic). Cached: sibling tasks and
+    retries re-ask for the same immutable batch."""
+    cached = getattr(batch, "_nbytes", None)
+    if cached is None:
+        n = float(getattr(batch.handles, "nbytes", 0))
+        for a in batch.data:
+            n += getattr(a, "nbytes", 0)
+        for v in batch.valid:
+            n += getattr(v, "nbytes", 0)
+        batch._nbytes = cached = n
+    return cached
+
+
 def _decode_handles(keybuf: np.ndarray, n: int) -> np.ndarray:
     """(n, 19) record-key byte matrix → int64 handles (vectorized BE+sign)."""
     enc = np.ascontiguousarray(keybuf[:, 11:19]).view(">u8").reshape(n)
@@ -207,3 +224,15 @@ class TileCache:
         with self._lock:
             for key in [k for k in self._cache if k[0] == table_id]:
                 del self._cache[key]
+
+    def evict_all(self) -> None:
+        """Server soft-memory-limit action (utils/memory ServerMemTracker):
+        drop every cached column batch AND its device mirror — the tile
+        cache and the DeviceBatch uploads hanging off it are the store's
+        biggest reclaimable pools. Batches still referenced by in-flight
+        tasks keep working; only the cache lets go."""
+        with self._lock:
+            for b in self._cache.values():
+                if getattr(b, "_device", None) is not None:
+                    b._device = None
+            self._cache.clear()

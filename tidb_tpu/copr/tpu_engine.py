@@ -34,6 +34,7 @@ from threading import Lock
 import numpy as np
 
 from ..jaxenv import jax, jnp
+from ..utils import memory as _mem
 from ..utils import metrics as M
 from ..utils import tracing
 from ..chunk.chunk import Chunk, Column
@@ -73,7 +74,12 @@ class _Timed:
 
 def _to_device(a: np.ndarray):
     """Host→device upload with transfer accounting (the h2d half of
-    tidb_tpu_transfer_bytes_total and the trace's device.transfer phase)."""
+    tidb_tpu_transfer_bytes_total and the trace's device.transfer phase).
+    The bytes also consume into the bound statement MemTracker — device
+    allocations were invisible to memory quotas before PR 4 — so the
+    consume can raise the quota/server-limit error right at the
+    allocation site (a real allocation failure, never a device fault)."""
+    _mem.consume_current(a.nbytes)
     t0 = time.perf_counter()
     out = jnp.asarray(a)
     M.TPU_TRANSFER_BYTES.inc(a.nbytes, dir="h2d")
@@ -95,6 +101,9 @@ def _fetch(x):
     M.TPU_TRANSFER_BYTES.inc(nbytes, dir="d2h")
     tracing.add_phase("execute_ms", dt * 1e3)
     tracing.add_phase("d2h_bytes", nbytes)
+    # NOT consumed into the memory tracker: the fetched result becomes a
+    # chunk that drain() charges at materialization — charging the d2h
+    # here too would double-count the same data on the device path only
     return out
 
 

@@ -86,6 +86,34 @@ class MemoryQuotaExceeded(TiDBError):
     code = 8175
 
 
+class ServerMemoryExceeded(MemoryQuotaExceeded):
+    """The store-wide tidb_server_memory_limit was breached and THIS
+    statement was the top consumer: the arbiter (utils/memory
+    ServerMemTracker) fails the allocator in place instead of flagging
+    its session (ref: util/servermemorylimit killSessIfNeeded)."""
+
+
+class RunawayKilled(QueryInterrupted):
+    """A statement crossed its resource group's QUERY_LIMIT with
+    ACTION=KILL (ref: ErrResourceGroupQueryRunawayInterrupted, 8253).
+    Subclasses QueryInterrupted so every interrupt-aware wait (admission,
+    backoff, chunk boundaries) treats it like the kill it is."""
+
+    code = 8253
+
+    def __init__(self, msg: str = ""):
+        super().__init__(msg)
+        self.reason = "runaway"
+
+
+class RunawayQuarantined(RunawayKilled):
+    """A statement whose digest sits in the runaway watch list was
+    rejected at admission, before consuming a ticket (ref:
+    ErrResourceGroupQueryRunawayQuarantine, 8254)."""
+
+    code = 8254
+
+
 class ResourceGroupExists(TiDBError):
     """CREATE RESOURCE GROUP on an existing name (ref: ErrResourceGroupExists)."""
 

@@ -69,25 +69,18 @@ class Executor:
 
 
 def drain(e: Executor) -> Chunk:
-    import time as _time
+    from ..sched.scheduler import raise_if_interrupted
 
     tracker = _ACTIVE_TRACKER.get()
     sess = _ACTIVE_SESSION.get()
     e.open()
     chunks = []
     while True:
-        if sess is not None and getattr(sess, "_killed", False):
-            from ..errors import QueryInterrupted
-
-            sess._killed = False
-            raise QueryInterrupted("Query execution was interrupted")
-        dl = getattr(sess, "_deadline", None) if sess is not None else None
-        if dl is not None and _time.monotonic() > dl:
-            from ..errors import QueryInterrupted
-
-            # max_execution_time exceeded (ref: expensivequery +
-            # MAX_EXECUTION_TIME kill, server.go Kill)
-            raise QueryInterrupted("Query execution was interrupted, maximum statement execution time exceeded")
+        # the scheduler's shared interrupt gate: KILL, max_execution_time,
+        # server-memory OOM kills ("oom" reason) and the runaway
+        # watchdog's QUERY_LIMIT tick all fire at this chunk boundary
+        # exactly like they do in admission waits and backoff sleeps
+        raise_if_interrupted(sess, getattr(sess, "_deadline", None) if sess is not None else None)
         c = e.next()
         if c is None:
             break
@@ -1441,13 +1434,13 @@ class SortExec(Executor):
             mem: list[Chunk] = []
             mem_bytes = 0
             self.child.open()
+            from ..sched.scheduler import raise_if_interrupted
+
             try:
                 while True:
-                    if sess is not None and getattr(sess, "_killed", False):
-                        from ..errors import QueryInterrupted
-
-                        sess._killed = False
-                        raise QueryInterrupted("Query execution was interrupted")
+                    # the shared interrupt gate: KILL, oom-arbiter kills
+                    # and the runaway tick all land mid-spill too
+                    raise_if_interrupted(sess)
                     c = self.child.next()
                     if c is None:
                         break
@@ -1535,13 +1528,11 @@ class TopNExec(SortExec):
             tq = int(sess.vars.get("tidb_mem_quota_topn", "0") or 0) if sess is not None else 0
             buf: Chunk | None = None
             self.child.open()
+            from ..sched.scheduler import raise_if_interrupted
+
             try:
                 while True:
-                    if sess is not None and getattr(sess, "_killed", False):
-                        from ..errors import QueryInterrupted
-
-                        sess._killed = False
-                        raise QueryInterrupted("Query execution was interrupted")
+                    raise_if_interrupted(sess)
                     c = self.child.next()
                     if c is None:
                         break
@@ -2243,12 +2234,9 @@ class HashJoinExec(Executor):
 
     @staticmethod
     def _check_kill():
-        sess = _ACTIVE_SESSION.get()
-        if sess is not None and getattr(sess, "_killed", False):
-            from ..errors import QueryInterrupted
+        from ..sched.scheduler import raise_if_interrupted
 
-            sess._killed = False
-            raise QueryInterrupted("Query execution was interrupted")
+        raise_if_interrupted(_ACTIVE_SESSION.get())
 
     def _spill_side(self, chunk_iter, keys, parts, salt: int = 0):
         P = len(parts)

@@ -1404,8 +1404,10 @@ class Parser:
 
     def _rg_options(self) -> dict:
         """RU_PER_SEC = n | PRIORITY = LOW/MEDIUM/HIGH | BURSTABLE [= bool]
-        (ref: parser.y ResourceGroupOptionList — the RU form only; the
-        RAW mode's per-resource knobs have no meaning on one device mesh)."""
+        | QUERY_LIMIT = (rules..., ACTION = ..., WATCH = '...') | QUERY_LIMIT = NULL
+        (ref: parser.y ResourceGroupOptionList — the RU form plus the
+        runaway QUERY_LIMIT option; the RAW mode's per-resource knobs
+        have no meaning on one device mesh)."""
         spec: dict = {}
         while self.tok.kind == "ident":
             up = self.tok.upper
@@ -1413,6 +1415,10 @@ class Parser:
                 self.next()
                 self.try_op("=")
                 spec["ru_per_sec"] = self._int_bound()
+            elif up == "QUERY_LIMIT":
+                self.next()
+                self.try_op("=")
+                spec["query_limit"] = self._rg_query_limit()
             elif up == "PRIORITY":
                 self.next()
                 self.try_op("=")
@@ -1436,6 +1442,62 @@ class Parser:
                 break
             self.try_op(",")
         return spec
+
+    def _rg_query_limit(self) -> dict:
+        """QUERY_LIMIT = ( EXEC_ELAPSED='10s', RU=n, PROCESSED_ROWS=n,
+        ACTION=DRYRUN|COOLDOWN|KILL, WATCH='60s' ) | NULL — the runaway
+        watchdog spec (ref: parser.y ResourceGroupRunawayOptionList,
+        WATCH collapsed to the EXACT-match digest form this store keys
+        its watch list on). NULL (ALTER) clears; the parsed {} sentinel
+        survives the DDL merge where None could not. Durations become
+        milliseconds at parse time."""
+        from ..sched.runaway import ACTIONS, parse_duration_ms
+
+        if self.try_kw("NULL"):
+            return {}
+        self.expect_op("(")
+        ql: dict = {}
+
+        def dur() -> float:
+            t = self.next()
+            try:
+                return parse_duration_ms(t.text)
+            except ValueError as e:
+                self.fail(str(e))
+
+        while self.tok.kind == "ident":
+            u = self.tok.upper
+            if u == "EXEC_ELAPSED":
+                self.next()
+                self.try_op("=")
+                ql["exec_elapsed_ms"] = dur()
+            elif u == "RU":
+                self.next()
+                self.try_op("=")
+                ql["ru"] = float(self._int_bound())
+            elif u == "PROCESSED_ROWS":
+                self.next()
+                self.try_op("=")
+                ql["processed_rows"] = self._int_bound()
+            elif u == "ACTION":
+                self.next()
+                self.try_op("=")
+                a = self.ident().upper()
+                if a not in ACTIONS:
+                    self.fail(f"invalid QUERY_LIMIT action {a!r}")
+                ql["action"] = a
+            elif u == "WATCH":
+                self.next()
+                self.try_op("=")
+                ql["watch_ms"] = dur()
+            else:
+                self.fail(f"unknown QUERY_LIMIT option {self.tok.text!r}")
+            self.try_op(",")
+        self.expect_op(")")
+        if not any(k in ql for k in ("exec_elapsed_ms", "ru", "processed_rows")):
+            self.fail("QUERY_LIMIT needs at least one rule "
+                      "(EXEC_ELAPSED / RU / PROCESSED_ROWS)")
+        return ql
 
     def alter_stmt(self):
         self.expect_kw("ALTER")

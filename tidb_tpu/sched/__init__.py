@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 
 from .batcher import LaunchBatcher
+from .runaway import QueryLimit, RunawayChecker, RunawayManager
 from .resource_group import (
     DEFAULT_GROUP,
     PRIORITIES,
@@ -44,20 +45,23 @@ from .scheduler import (
 
 __all__ = [
     "AdmissionScheduler", "DEFAULT_GROUP", "LaunchBatcher", "PRIORITIES",
-    "ResourceController", "ResourceGroup", "ResourceGroupManager",
-    "SchedCtx", "Ticket", "TokenBucket", "raise_if_interrupted", "ru_cost",
+    "QueryLimit", "ResourceController", "ResourceGroup",
+    "ResourceGroupManager", "RunawayChecker", "RunawayManager", "SchedCtx",
+    "Ticket", "TokenBucket", "raise_if_interrupted", "ru_cost",
     "sleep_interruptible",
 ]
 
 
 class ResourceController:
-    """Per-store facade: groups + scheduler + batcher + shared TPU engine."""
+    """Per-store facade: groups + scheduler + batcher + runaway watchdog
+    + shared TPU engine."""
 
     def __init__(self, storage):
         self.storage = storage
         self.groups = ResourceGroupManager(storage)
         self.scheduler = AdmissionScheduler(self.groups)
         self.batcher = LaunchBatcher()
+        self.runaway = RunawayManager(self)
         self._tpu = None
         self._lock = threading.Lock()
 

@@ -33,6 +33,8 @@ import logging
 import threading
 import time
 
+from ..errors import MemoryQuotaExceeded
+from ..utils import memory
 from ..utils import metrics as M
 from ..utils import tracing
 from ..utils.failpoint import inject as _fp
@@ -42,9 +44,9 @@ log = logging.getLogger("tidb_tpu.sched")
 
 class _Job:
     __slots__ = ("dag", "batch", "dedup_key", "result", "exc", "followers", "mode",
-                 "trace", "parent_id")
+                 "trace", "parent_id", "client", "mem")
 
-    def __init__(self, dag, batch, dedup_key):
+    def __init__(self, dag, batch, dedup_key, client=None):
         self.dag = dag
         self.batch = batch
         self.dedup_key = dedup_key
@@ -57,6 +59,14 @@ class _Job:
         # the waiter's own thread at enqueue time
         self.trace = tracing.current_trace()
         self.parent_id = self.trace.current_parent() if self.trace is not None else 0
+        # the waiter's CopClient: launch-wide device counters fan out
+        # into every participating client's store-level `stats` (EXPLAIN
+        # ANALYZE's `device:` line), once per client per launch
+        self.client = client
+        # the waiter's statement MemTracker, captured on its own thread:
+        # the per-job serial fallback rebinds it so one statement's
+        # quota/server-limit error can never poison co-batched neighbors
+        self.mem = memory.current_tracker()
 
 
 class _Group:
@@ -78,30 +88,33 @@ class LaunchBatcher:
         self._pending: dict[tuple, _Group] = {}
         self._inflight = 0
 
-    def execute(self, engine, dag, batch, dedup_key=None, stats=None):
+    def execute(self, engine, dag, batch, dedup_key=None, stats=None, client=None):
         """Run one cop DAG over one batch through the engine, coalescing
         with concurrent compatible tasks. `stats` is an optional callable
-        `(key, n)` for the owning client's per-query counters."""
+        `(key, n)` for the owning client's per-query counters; `client`
+        is the owning CopClient whose store-level stats receive the
+        launch's device counters (solo bypasses report through the
+        caller's phase collector instead)."""
         with self._lock:
             self._inflight += 1
             concurrent = self._inflight > 1
         try:
             if not concurrent:
                 return engine.execute(dag, batch)
-            return self._coalesced(engine, dag, batch, dedup_key, stats)
+            return self._coalesced(engine, dag, batch, dedup_key, stats, client)
         finally:
             with self._lock:
                 self._inflight -= 1
 
     # --- grouped path -------------------------------------------------------
 
-    def _coalesced(self, engine, dag, batch, dedup_key, stats):
+    def _coalesced(self, engine, dag, batch, dedup_key, stats, client=None):
         try:
             tiles = engine.tile_count(batch)
         except Exception:  # noqa: BLE001 — engine without tiling: run solo
             return engine.execute(dag, batch)
         ckey = (id(engine), dag.digest(), tiles)
-        job = _Job(dag, batch, dedup_key)
+        job = _Job(dag, batch, dedup_key, client=client)
         with self._lock:
             g = self._pending.get(ckey)
             if g is not None and not g.closed:
@@ -145,6 +158,15 @@ class LaunchBatcher:
     def _launch(self, engine, group: _Group, stats) -> None:
         jobs = group.jobs
         t0_ns = time.perf_counter_ns()
+        # the group's shared uploads belong to NO statement (a neighbor's
+        # bytes must not draw the leader's quota verdict) but the SERVER
+        # arbiter must still see the volume: a detachable, quota-less
+        # tracker hung straight off the server root carries it for the
+        # launch's duration, then unwinds
+        mem0 = next((j.mem for j in jobs if j.mem is not None), None)
+        launch_mem = None
+        if mem0 is not None and mem0.root is not mem0:
+            launch_mem = memory.MemTracker(0, "cop.launch", parent=mem0.root)
         # the leader runs device work for OTHER statements' traces too:
         # collect the device phases (compile/transfer/execute) for the
         # whole launch here and fan them out with the shared launch span
@@ -159,15 +181,21 @@ class LaunchBatcher:
             if stats is not None and occupancy > 1:
                 stats("batched_tasks", 1)
             try:
-                results = engine.execute_many([(j.dag, j.batch) for j in jobs])
+                with memory.bind(launch_mem):
+                    results = engine.execute_many([(j.dag, j.batch) for j in jobs])
                 for j, r in zip(jobs, results):
                     j.result = r
             except Exception:  # noqa: BLE001
                 # one poisoned task must not fail its co-batched neighbors:
-                # fall back to per-task serial execution with per-task errors
+                # fall back to per-task serial execution with per-task
+                # errors, each job under ITS OWN statement's memory
+                # tracker — the group ran under the leader's, and a
+                # leader-quota breach mid-upload must die with the leader
+                # only, not with every waiter
                 for j in jobs:
                     try:
-                        j.result = engine.execute(j.dag, j.batch)
+                        with memory.bind(j.mem):
+                            j.result = engine.execute(j.dag, j.batch)
                     except Exception as e:  # noqa: BLE001
                         j.exc = e
         except BaseException as e:  # noqa: BLE001 — e.g. an armed failpoint
@@ -179,9 +207,26 @@ class LaunchBatcher:
             raise
         finally:
             phases = tracing.pop_phases(ph_token)
+            if launch_mem is not None:
+                launch_mem.detach()  # launch volume unwinds with the launch
             for j in jobs:
                 for f in j.followers:
-                    f.result, f.exc = j.result, j.exc
+                    if j.exc is not None and isinstance(j.exc, MemoryQuotaExceeded):
+                        # a statement-scoped quota verdict is the
+                        # MEMBER's, not the work's: the dedup follower
+                        # re-runs the task under ITS OWN tracker instead
+                        # of dying of a neighbor's quota. The re-run runs
+                        # AFTER pop_phases restored the leader's phase
+                        # frame — collect_phases isolates its device
+                        # phases so they can't inflate the leader's
+                        # device: line / trace
+                        try:
+                            with memory.bind(f.mem), tracing.collect_phases():
+                                f.result = engine.execute(f.dag, f.batch)
+                        except Exception as e:  # noqa: BLE001
+                            f.exc = e
+                    else:
+                        f.result, f.exc = j.result, j.exc
             try:
                 self._attribute(jobs, group, t0_ns, phases)
             except Exception:  # noqa: BLE001 — attribution must never strand waiters
@@ -200,6 +245,19 @@ class LaunchBatcher:
             waiters.append(j)
             waiters.extend(j.followers)
         occupancy = len(waiters)
+        # store-level stats fan-out (PR 3 debt): a co-batched launch's
+        # compile/transfer/execute counters land in EVERY participating
+        # client's `cop.stats` — once per client per launch — so EXPLAIN
+        # ANALYZE's `device:` line covers grouped launches, not just
+        # solos (the statement-level traces get theirs below)
+        counters = tracing.phase_counters(phases)
+        clients = {}
+        for w in waiters:
+            if w.client is not None:
+                clients[id(w.client)] = w.client
+        for cl in clients.values():
+            for key, n in counters:
+                cl._bump(key, n)
         traces = []
         seen = set()
         for w in waiters:
@@ -212,13 +270,8 @@ class LaunchBatcher:
         dur_ns = time.perf_counter_ns() - t0_ns
         for t in traces:
             t.set_max("batch_occupancy", occupancy)
-            for key, cnt in (("compile_ms", phases.get("compile_ms", 0.0)),
-                             ("transfer_bytes", phases.get("h2d_bytes", 0.0)
-                              + phases.get("d2h_bytes", 0.0)),
-                             ("device_ms", phases.get("execute_ms", 0.0)
-                              + phases.get("h2d_ms", 0.0))):
-                if cnt:
-                    t.add(key, cnt)
+            for key, cnt in counters:
+                t.add(key, cnt)
         if not any(t.recording for t in traces):
             return
         leader = jobs[0].trace

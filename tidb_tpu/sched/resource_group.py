@@ -78,6 +78,9 @@ class ResourceGroup:
     ru_per_sec: int = 0  # 0 = unlimited
     priority: str = "MEDIUM"
     burstable: bool = False
+    # QUERY_LIMIT runaway spec (sched/runaway.py): exec_elapsed_ms / ru /
+    # processed_rows thresholds + action + watch_ms; None/{} = no limit
+    query_limit: dict | None = None
     bucket: TokenBucket = field(default=None, repr=False)  # type: ignore[assignment]
 
     def __post_init__(self):
@@ -86,6 +89,17 @@ class ResourceGroup:
             # store has headroom — modeled as an unlimited bucket (the
             # rate still drives RU metrics / SHOW output)
             self.bucket = TokenBucket(0 if self.burstable else self.ru_per_sec)
+        self._ql_parsed = False
+        self._ql = None
+
+    def parsed_limit(self):
+        """Parsed QueryLimit (cached — checked once per statement)."""
+        if not self._ql_parsed:
+            from .runaway import QueryLimit
+
+            self._ql = QueryLimit.from_spec(self.query_limit or {})
+            self._ql_parsed = True
+        return self._ql
 
     @property
     def priority_value(self) -> int:
@@ -97,6 +111,7 @@ class ResourceGroup:
             "ru_per_sec": self.ru_per_sec,
             "priority": self.priority,
             "burstable": self.burstable,
+            "query_limit": self.query_limit,
         }
 
     @classmethod
@@ -106,6 +121,7 @@ class ResourceGroup:
             ru_per_sec=int(d.get("ru_per_sec", 0)),
             priority=d.get("priority", "MEDIUM"),
             burstable=bool(d.get("burstable", False)),
+            query_limit=d.get("query_limit") or None,
         )
 
 
@@ -205,6 +221,10 @@ class ResourceGroupManager:
                     d.burstable = bool(opts["burstable"])
                 elif "ru_per_sec" in opts:
                     d.burstable = False
+                if "query_limit" in opts:
+                    # {} is the parsed QUERY_LIMIT=NULL (clear) sentinel
+                    d.query_limit = opts["query_limit"] or None
+                    d._ql_parsed = False
                 d.bucket = TokenBucket(0 if d.burstable else d.ru_per_sec)
                 self.bump()
                 return

@@ -25,10 +25,10 @@ import threading
 import time
 from dataclasses import dataclass
 
-from ..errors import QueryInterrupted, ResourceGroupQueueFull
+from ..errors import MemoryQuotaExceeded, QueryInterrupted, ResourceGroupQueueFull
 from ..utils import metrics as M
 from ..utils.failpoint import inject as _fp
-from .resource_group import ResourceGroupManager
+from .resource_group import PRIORITIES, ResourceGroupManager
 
 
 @dataclass
@@ -42,6 +42,8 @@ class SchedCtx:
     enabled: bool = True
     trace: object = None  # StatementTrace: per-statement spans + exec details
     backoff_budget_ms: float | None = None  # tidb_backoff_budget_ms (None = default)
+    runaway: object = None  # RunawayChecker: QUERY_LIMIT watchdog + watch list
+    mem: object = None  # statement MemTracker: device transfers consume here
 
 
 @dataclass
@@ -59,24 +61,51 @@ class _Waiter:
     granted: bool = False
 
 
-def ru_cost(rows: int) -> float:
+def ru_cost(rows: int, nbytes: float = 0.0) -> float:
     """RU model: one base unit per cop task plus one per KiRow scanned
-    (the read-request + read-byte split of the reference's RU formula,
-    collapsed to row counts — this store has no byte accounting)."""
-    return 1.0 + rows / 1024.0
+    plus one per 64KiB of batch data touched (the read-request +
+    read-byte split of the reference's RU formula — the byte term makes
+    wide-row scans cost what they move, not just what they count; 64KiB
+    per RU mirrors the reference's ReadBytesCost)."""
+    return 1.0 + rows / 1024.0 + nbytes / 65536.0
 
 
 def raise_if_interrupted(session=None, deadline=None) -> None:
-    """The deadline/KILL gate, shared by admission waits AND cop-path
-    backoff sleeps (copr/retry.py): one definition of "stop now" so a
+    """The deadline/KILL gate, shared by admission waits, cop-path
+    backoff sleeps (copr/retry.py) AND executor chunk boundaries
+    (executor/executors.py drain): one definition of "stop now" so a
     KILLed or timed-out statement escapes every wait the same way. The
-    raised error carries `.reason` ("killed" | "timeout") for metric
-    labeling."""
-    if session is not None and getattr(session, "_killed", False):
-        session._killed = False
-        e = QueryInterrupted("Query execution was interrupted")
-        e.reason = "killed"
-        raise e
+    raised error carries `.reason` ("killed" | "timeout" | "oom" |
+    "runaway") for metric labeling.
+
+    Two protection layers piggyback this poll tick: a session KILLed by
+    the server memory arbiter carries reason "oom" and raises the 8175
+    quota error instead of a generic interrupt, and the statement's
+    runaway checker (session._runaway, sched/runaway.py) ticks its
+    QUERY_LIMIT thresholds here — no watchdog thread, the gate IS the
+    watchdog's clock."""
+    if session is not None:
+        if getattr(session, "_killed", False):
+            session._killed = False
+            reason = getattr(session, "_kill_reason", None)
+            if reason is not None:
+                session._kill_reason = None
+            if reason == "oom":
+                from ..errors import ServerMemoryExceeded
+
+                e = ServerMemoryExceeded(
+                    "Out Of Memory Quota! statement killed by the server "
+                    "memory arbiter (tidb_server_memory_limit exceeded; this "
+                    "statement was the top consumer)"
+                )
+                e.reason = "oom"
+                raise e
+            e = QueryInterrupted("Query execution was interrupted")
+            e.reason = "killed"
+            raise e
+        rc = getattr(session, "_runaway", None)
+        if rc is not None:
+            rc.tick()
     if deadline is not None and time.monotonic() >= deadline:
         e = QueryInterrupted(
             "Query execution was interrupted, maximum statement execution time exceeded"
@@ -141,6 +170,12 @@ class AdmissionScheduler:
         admission queue to run work whose result is already discarded."""
         _fp("sched/before-admit")
         g = self.groups.get(ctx.group)
+        rc = getattr(ctx, "runaway", None)
+        if rc is not None:
+            # runaway control gates admission itself: a watch-listed
+            # digest is rejected (KILL) or demoted (COOLDOWN) here,
+            # before a ticket or RU estimate is consumed
+            rc.on_admission()
         t0 = time.monotonic()
         with self._cond:
             if not self._waiting and self._running < self.max_concurrency and g.bucket.admissible():
@@ -160,7 +195,10 @@ class AdmissionScheduler:
                     f"resource group '{g.name}' admission queue is full "
                     f"({self.MAX_QUEUE} waiting); retry later"
                 )
-            w = _Waiter(g.priority_value, next(self._seq), g)
+            # a COOLDOWN-demoted statement queues at LOW priority no
+            # matter what its group grants (the runaway demotion)
+            prio = PRIORITIES["LOW"] if (rc is not None and rc.demoted) else g.priority_value
+            w = _Waiter(prio, next(self._seq), g)
             self._waiting.append(w)
             M.SCHED_QUEUE_DEPTH.set(len(self._waiting))
             try:
@@ -175,7 +213,10 @@ class AdmissionScheduler:
                         raise e
                     try:
                         raise_if_interrupted(ctx.session, ctx.deadline)
-                    except QueryInterrupted as e:
+                    except (QueryInterrupted, MemoryQuotaExceeded) as e:
+                        # MemoryQuotaExceeded covers the oom-arbiter kill
+                        # (ServerMemoryExceeded, reason "oom") — it is a
+                        # quota error, not a QueryInterrupted subclass
                         M.SCHED_TASKS.inc(
                             group=g.name, outcome=getattr(e, "reason", "killed")
                         )

@@ -135,6 +135,19 @@ class Session:
 
     PLAN_CACHE_SIZE = 128
 
+    @property
+    def mem_tracker(self):
+        """Session-level memory tracker: the middle layer of the
+        statement → session → server tree (utils/memory). No quota of
+        its own — it aggregates, the server root arbitrates."""
+        if getattr(self, "_mem_sess_tracker", None) is None:
+            from ..utils.memory import MemTracker as _MT
+
+            self._mem_sess_tracker = _MT(
+                0, f"session#{self.conn_id}", parent=self.store.mem
+            )
+        return self._mem_sess_tracker
+
     # ------------------------------------------------------------- bootstrap
 
     def _bootstrap(self):
@@ -360,11 +373,19 @@ class Session:
 
         if getattr(self, "_killed", False):
             self._killed = False
+            self._kill_reason = None
             from ..errors import QueryInterrupted
 
             raise QueryInterrupted("Query execution was interrupted")
         quota = int(self.vars.get("tidb_mem_quota_query", "0") or 0)
-        token = _ACTIVE_TRACKER.set(MemTracker(quota) if quota > 0 else None)
+        # statement tracker: leaf of the statement → session → server
+        # tree (utils/memory) — always attached, even quota-less, so the
+        # server arbiter can see (and kill) the top consumer
+        tracker = MemTracker(quota, f"conn#{self.conn_id}", parent=self.mem_tracker,
+                             session=self)
+        tracker.sql = log_sql[:256]
+        self.store.mem.attach_statement(tracker)
+        token = _ACTIVE_TRACKER.set(tracker)
         stok = _ACTIVE_SESSION.set(self)
         if not self._in_bootstrap:
             import weakref
@@ -389,6 +410,8 @@ class Session:
         tracer = None
         prev_stmt_vars = self._stmt_vars
         self._stmt_vars = {}
+        prev_runaway = getattr(self, "_runaway", None)
+        self._runaway = None
         if not self._in_bootstrap:
             from ..utils.tracing import StatementTrace
 
@@ -397,6 +420,14 @@ class Session:
                 recording=self.vars.get("tidb_enable_trace", "OFF") == "ON",
             )
             self._tracer = tracer
+            # runaway watchdog: a checker exists only when the bound
+            # group carries a QUERY_LIMIT or the watch list is armed
+            # (checker_for's fast exit IS the idle-watchdog overhead)
+            ctl = self.store.sched
+            self._runaway = ctl.runaway.checker_for(
+                self, ctl.groups.get(self.vars.get("tidb_resource_group", "default")),
+                log_sql, tracer,
+            )
         if self.vars.get("tidb_general_log", "OFF") == "ON" and not self._in_bootstrap:
             gl = log_sql
             if self.vars.get("tidb_redact_log", "OFF") == "ON":
@@ -458,6 +489,10 @@ class Session:
         finally:
             if not is_diag:
                 self._prev_error = not ok
+            # unwind the tracker tree: success, KILL and BackoffExhausted
+            # all pass here — whatever the statement still holds returns
+            # to the session + server trackers (never leaks upward)
+            tracker.detach()
             _ACTIVE_TRACKER.reset(token)
             _ACTIVE_SESSION.reset(stok)
             _si.CURRENT.reset(itok)
@@ -467,6 +502,7 @@ class Session:
             # bootstrap upgrades) under an outer statement's hint scope
             self._tracer = prev_tracer
             self._stmt_vars = prev_stmt_vars
+            self._runaway = prev_runaway
             if not self._in_bootstrap:
                 self.store.clear_process(self.conn_id)
                 self.store.plugins.fire("on_query", self.user, self.current_db, sql, ok, dur)
@@ -479,6 +515,8 @@ class Session:
                     log_sql = f"<redacted {type(stmt).__name__}>"
                 details = None
                 if tracer is not None:
+                    if tracker.max_consumed:
+                        tracer.set_max("mem_bytes", float(tracker.max_consumed))
                     tracer.finish(ok=ok)
                     details = tracer.details()
                     if tracer.recording:
@@ -1363,6 +1401,13 @@ class Session:
             # store-wide telemetry capacity: global-only, applied once
             # here instead of last-writer-wins through per-record calls
             self.store.stmt_stats.summary_capacity = int(val)
+        elif name == "tidb_trace_ring_capacity":
+            # live resize, keeping the newest traces (PR 3 debt)
+            self.store.trace_ring.resize(int(val))
+        elif name == "tidb_server_memory_limit":
+            self.store.mem.set_limit(int(val))
+        elif name == "tidb_memory_usage_alarm_ratio":
+            self.store.mem.set_alarm_ratio(float(val))
 
     def _sysvar_read_global(self, name: str):
         """@@global.x: the store-wide value (SET GLOBAL overrides over
@@ -3322,11 +3367,12 @@ class Session:
                     Datum.s("UNLIMITED" if g.ru_per_sec <= 0 else str(g.ru_per_sec)),
                     Datum.s(g.priority),
                     Datum.s("YES" if g.burstable else "NO"),
+                    Datum.s(ql.render() if (ql := g.parsed_limit()) is not None else "NULL"),
                 ]
                 for g in self.store.sched.groups.list()
             ]
-            chk = Chunk.from_datum_rows([ft_varchar()] * 4, rows)
-            return ResultSet(["Name", "RU_PER_SEC", "Priority", "Burstable"], chk)
+            chk = Chunk.from_datum_rows([ft_varchar()] * 5, rows)
+            return ResultSet(["Name", "RU_PER_SEC", "Priority", "Burstable", "QUERY_LIMIT"], chk)
         if stmt.kind == "bindings":
             rows = self._sql_internal(
                 "SELECT original_sql, bind_sql, status FROM mysql.bind_info"
@@ -3638,6 +3684,10 @@ class Session:
                 f"retry: backoffs:{d['retries']} backoff_ms:{d['backoff_ms']:.3f} "
                 f"breaker_skips:{d['breaker_skips']}"
             )
+        if d.get("mem_degraded_tasks"):
+            # memory-arbitration line: auto tasks rerouted to host while
+            # the store sat over its soft memory limit
+            lines.append(f"mem: degraded_tasks:{d['mem_degraded_tasks']}")
         if d["compile_ms"] or d["transfer_bytes"] or d["device_ms"]:
             # device-path line: XLA compile wall, host<->device bytes and
             # execute+fetch time attributed to this statement's cop tasks

@@ -43,9 +43,15 @@ class SysVar:
             return str(v)
         if self.kind == "float":
             try:
-                float(s)
+                v = float(s)
             except ValueError:
                 raise ValueError(f"Incorrect argument type to variable '{self.name}'")
+            # clamp like int vars — the stored/displayed value must match
+            # what enforcement actually uses
+            if self.lo is not None and v < self.lo:
+                return str(float(self.lo))
+            if self.hi is not None and v > self.hi:
+                return str(float(self.hi))
             return s
         if self.kind == "enum":
             for e in self.enum:
@@ -131,6 +137,19 @@ _sv("tidb_enable_trace", "OFF", kind="bool", consumed=True)
 # per-statement cop backoff sleep budget (session scope; statement scope
 # via the SET_VAR optimizer hint) — replaces the fixed COP_BACKOFF_BUDGET_MS
 _sv("tidb_backoff_budget_ms", "2000", kind="int", lo=0, hi=600000, consumed=True)
+# capacity of the per-store TIDB_TRACE ring; SET GLOBAL resizes it live
+# (PR 4 — replaces the fixed 64)
+_sv("tidb_trace_ring_capacity", "64", scope="global", kind="int", lo=1, hi=4096,
+    consumed=True)
+
+# --- server memory arbitration (PR 4: utils/memory ServerMemTracker) -------
+# store-wide hard limit on tracked statement memory; 0 = unlimited.
+# GLOBAL-only like the reference: a per-session opt-out would defeat it
+_sv("tidb_server_memory_limit", "0", scope="global", kind="int", lo=0, consumed=True)
+# soft-limit ratio: above limit*ratio the store degrades (auto→host cop
+# routing + tile/device cache eviction) before anything is killed
+_sv("tidb_memory_usage_alarm_ratio", "0.8", scope="global", kind="float",
+    lo=0, hi=1, consumed=True)
 
 # --- resource control (sched/: admission + RU groups + launch batcher) ------
 _sv("tidb_resource_group", "default", consumed=True)
@@ -203,7 +222,6 @@ for _name, _d, _k in (
     ("tidb_enable_noop_variables", "ON", "bool"),
     ("tidb_low_resolution_tso", "OFF", "bool"),
     ("tidb_expensive_query_time_threshold", "60", "int"),
-    ("tidb_memory_usage_alarm_ratio", "0.8", "float"),
     ("tidb_skip_isolation_level_check", "OFF", "bool"),
     ("tidb_skip_ascii_check", "OFF", "bool"),
     ("tidb_skip_utf8_check", "OFF", "bool"),

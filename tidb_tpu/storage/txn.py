@@ -385,6 +385,20 @@ class Storage:
         return self._stats
 
     @property
+    def mem(self):
+        """Shared server memory tracker/arbiter (utils/memory
+        ServerMemTracker): the root every session's statement trackers
+        attach under — tidb_server_memory_limit enforcement, soft-limit
+        degradation and top-consumer OOM kills happen here, store-wide."""
+        if getattr(self, "_mem", None) is None:
+            with self._proc_lock:
+                if getattr(self, "_mem", None) is None:
+                    from ..utils.memory import ServerMemTracker
+
+                    self._mem = ServerMemTracker()
+        return self._mem
+
+    @property
     def sched(self):
         """Shared resource controller (ref: resource control's store-scoped
         resource manager): admission, resource groups and the cross-session

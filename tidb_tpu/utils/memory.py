@@ -1,36 +1,404 @@
-"""Memory quota tracker (ref: util/memory/tracker.go:54 tracker tree +
-action.go:29 action chain). One tracker per statement, consuming at
-chunk-materialization points; exceeding tidb_mem_quota_query fires the
-cancel action (MemoryQuotaExceeded, MySQL's OOM-kill analog)."""
+"""Memory quota tracker tree + server-level arbitration (ref:
+util/memory/tracker.go:54 tracker tree + action.go:29 action chain +
+util/servermemorylimit — the three-layer protection the reference runs:
+per-statement quota cancel, server soft-limit actions, and a server hard
+limit that kills the TOP consumer instead of whoever allocates next).
+
+Layout: one `MemTracker` per statement, attached under its session's
+tracker, attached under the store's `ServerMemTracker` (`Storage.mem`).
+`consume` at chunk-materialization points propagates up the chain; each
+layer owns its action:
+
+  * statement — exceeding tidb_mem_quota_query raises
+    MemoryQuotaExceeded (the classic OOM-kill analog, unchanged);
+  * server soft limit (tidb_server_memory_limit ×
+    tidb_memory_usage_alarm_ratio) — DEGRADE, not cancel: `engine='auto'`
+    cop tasks reroute to the host engine (device h2d would only deepen
+    the pressure) and the tile caches drop their column batches AND
+    device mirrors (the biggest reclaimable pools);
+  * server hard limit (tidb_server_memory_limit) — the arbiter kills the
+    TOP-consuming statement through the scheduler's shared interrupt
+    gate (sched.scheduler.raise_if_interrupted): the victim's session is
+    flagged with reason "oom" and escapes at its next checkpoint, while
+    innocent allocators proceed.
+
+Device transfers (tpu_engine h2d/d2h) consume into the statement tracker
+through a thread-local binding (`bind`/`consume_current`): the cop pool
+and the launch batcher run engine work on threads where contextvars are
+wrong by construction, the same reason utils/tracing carries its own TLS.
+Transfer bytes are a VOLUME proxy, not a resident-set measure — they
+unwind with the statement at `detach()`, which releases everything the
+statement still holds from every ancestor (tree accounting can never
+leak into the global tracker).
+"""
 
 from __future__ import annotations
 
 import threading
+import time
+import weakref
 
-from ..errors import MemoryQuotaExceeded
+from ..errors import MemoryQuotaExceeded, ServerMemoryExceeded
 
 
 class MemTracker:
-    def __init__(self, quota: int = 0, label: str = "query"):
-        self.quota = quota  # 0 = unlimited
+    """One node of the tracker tree. `quota` 0 = unlimited (still
+    tracked: the parent chain needs the bytes either way)."""
+
+    def __init__(self, quota: int = 0, label: str = "query", parent: "MemTracker | None" = None,
+                 session=None):
+        self.quota = quota
         self.label = label
+        self.parent = parent
+        self.session = session  # statement trackers: the owning session
+        self.sql = ""  # statement trackers: sample text for OOM events
         self.consumed = 0
         self.max_consumed = 0
+        self._dead = False  # detached: late consumes become no-ops
         self._lock = threading.Lock()
+        root = self
+        while root.parent is not None:
+            root = root.parent
+        self.root = root
 
-    def consume(self, nbytes: int) -> None:
+    def _add(self, nbytes: int) -> bool | None:
+        """Charge this node; returns True when the node is now over its
+        own quota, or None when the node is DEAD (detached concurrently
+        — the TOCTOU between consume's entry check and detach: the node
+        absorbed nothing, so the caller must stop before charging
+        ancestors bytes that can never unwind). Never raises: every
+        ancestor must receive the bytes before any quota verdict, or
+        detach() would later subtract bytes an ancestor never saw and
+        erase OTHER statements' accounting."""
         with self._lock:
+            if self._dead:
+                return None
             self.consumed += nbytes
             if self.consumed > self.max_consumed:
                 self.max_consumed = self.consumed
-            if self.quota and self.consumed > self.quota:
-                raise MemoryQuotaExceeded(
-                    f"Out Of Memory Quota! [{self.label}] consumed {self.consumed} > quota {self.quota}"
-                )
+            return bool(nbytes > 0 and self.quota and self.consumed > self.quota)
+
+    def consume(self, nbytes: int) -> None:
+        """Charge this tracker and every ancestor, THEN act: the
+        innermost breached quota fires first (statement cancel beats
+        server arbitration, like the reference's action-chain ordering);
+        otherwise the root arbitrates with the allocating leaf
+        identified, so a hard-limit breach can kill the top consumer
+        instead of this allocator.
+
+        The whole up-chain walk runs under the LEAF's lock (every walk —
+        consume/release/detach — starts by taking it, and lock order is
+        strictly child→parent), so a concurrent detach can never snapshot
+        a leaf charge that hasn't reached the ancestors yet: a straggler
+        either completes its walk before detach unwinds it, or sees
+        `_dead` and drops its bytes entirely — the 'tree accounting never
+        leaks into the global tracker' invariant."""
+        exceeded = None
+        with self._lock:
+            if self._dead:
+                # a cop-pool worker outliving its abandoned stream: the
+                # statement already detached — charging now would inflate
+                # the session/server trackers forever (nothing unwinds
+                # after detach)
+                return
+            self.consumed += nbytes
+            if self.consumed > self.max_consumed:
+                self.max_consumed = self.consumed
+            if nbytes > 0 and self.quota and self.consumed > self.quota:
+                exceeded = self
+            t = self.parent
+            while t is not None:
+                if t._add(nbytes) and exceeded is None:
+                    exceeded = t
+                t = t.parent
+        if exceeded is not None:
+            raise MemoryQuotaExceeded(
+                f"Out Of Memory Quota! [{exceeded.label}] consumed "
+                f"{exceeded.consumed} > quota {exceeded.quota}"
+            )
+        root = self.root
+        if root is not self and isinstance(root, ServerMemTracker):
+            root.arbitrate(self)
 
     def release(self, nbytes: int) -> None:
         with self._lock:
+            if self._dead:
+                return
             self.consumed = max(0, self.consumed - nbytes)
+            t = self.parent
+            while t is not None:
+                with t._lock:
+                    t.consumed = max(0, t.consumed - nbytes)
+                t = t.parent
+        root = self.root
+        if root is not self and isinstance(root, ServerMemTracker):
+            root.settle()
+
+    def detach(self) -> None:
+        """Statement teardown: return everything still held to every
+        ancestor and drop out of the arbiter's registry. After this the
+        statement's footprint is zero at every layer — success, KILL and
+        BackoffExhausted unwind identically through the one finally.
+        Runs under the leaf lock like every walk (see consume): in-flight
+        stragglers have either fully propagated (we unwind their bytes
+        here) or will see `_dead` and drop."""
+        with self._lock:
+            self._dead = True
+            left = self.consumed
+            self.consumed = 0
+            t = self.parent
+            while t is not None:
+                with t._lock:
+                    t.consumed = max(0, t.consumed - left)
+                t = t.parent
+        root = self.root
+        if root is not self and isinstance(root, ServerMemTracker):
+            root.forget(self)
+
+
+class ServerMemTracker(MemTracker):
+    """The per-store root: `Storage.mem`. Holds the server limit, the
+    alarm ratio, the registry of LIVE statement trackers (the kill
+    candidates), the degradation flag the cop client routes on, and the
+    ops history the MEMORY_USAGE_OPS_HISTORY memtable reads."""
+
+    EVENTS_CAP = 256
+
+    def __init__(self):
+        super().__init__(0, "server")
+        self.limit = 0  # tidb_server_memory_limit; 0 = unlimited
+        self.alarm_ratio = 0.8  # tidb_memory_usage_alarm_ratio
+        self.degraded = False
+        self._stmts: list = []  # weakrefs to live statement trackers
+        self._caches: list = []  # weakrefs to evictable tile caches
+        self._killing = None  # weakref to the victim currently unwinding
+        self._reg_lock = threading.Lock()
+        from collections import deque
+
+        self.events: "deque" = deque(maxlen=self.EVENTS_CAP)
+
+    # --- configuration (SET GLOBAL side effects) ---------------------------
+
+    def set_limit(self, limit: int) -> None:
+        from . import metrics as M
+
+        self.limit = max(0, int(limit))
+        M.SERVER_MEM_LIMIT.set(float(self.limit))
+        self.settle()
+
+    def set_alarm_ratio(self, ratio: float) -> None:
+        self.alarm_ratio = min(max(float(ratio), 0.0), 1.0)
+        self.settle()
+
+    # --- registries --------------------------------------------------------
+
+    def attach_statement(self, t: MemTracker) -> None:
+        with self._reg_lock:
+            self._stmts.append(weakref.ref(t))
+
+    def forget(self, t: MemTracker) -> None:
+        with self._reg_lock:
+            self._stmts = [r for r in self._stmts if r() is not None and r() is not t]
+            k = self._killing
+            if k is not None and k() is t:
+                self._killing = None
+                # the victim statement ended before observing its kill:
+                # cancel the flag, or it would spuriously kill the
+                # session's NEXT statement. Flagging happens under this
+                # same lock (arbitrate), so there is no window where an
+                # unobserved oom flag survives its target. A user KILL
+                # (no "oom" reason) is left alone.
+                sess = t.session
+                if sess is not None and getattr(sess, "_kill_reason", None) == "oom":
+                    sess._killed = False
+                    sess._kill_reason = None
+        self.settle()
+
+    def statements(self) -> list[MemTracker]:
+        with self._reg_lock:
+            return [t for t in (r() for r in self._stmts) if t is not None]
+
+    def register_cache(self, cache) -> None:
+        """Register an evictable cache (needs an `evict_all()`); held by
+        weakref so short-lived embedded clients don't accumulate."""
+        with self._reg_lock:
+            self._caches = [r for r in self._caches if r() is not None]
+            self._caches.append(weakref.ref(cache))
+
+    # --- arbitration -------------------------------------------------------
+
+    def _event(self, op: str, **kv) -> None:
+        self.events.append({"time": time.time(), "op": op,
+                            "consumed": self.consumed, "limit": self.limit, **kv})
+
+    def arbitrate(self, origin: MemTracker) -> None:
+        """Called after `origin`'s consume reached this root. Soft limit:
+        flip degraded + evict caches once per excursion. Hard limit: kill
+        the top consumer — at most one victim in flight (its unwind must
+        land before a second kill, or pressure spikes would massacre the
+        whole process), and when the top consumer IS the allocator it
+        fails right here instead of waiting for its own checkpoint."""
+        from . import metrics as M
+
+        L = self.limit
+        c = self.consumed
+        if not L:
+            return  # feature off: not even a gauge touch on the hot path
+        M.SERVER_MEM_CONSUMED.set(float(c))
+        soft = L * self.alarm_ratio
+        if c > soft:
+            # transition under the lock: concurrent allocators crossing
+            # the ratio together must produce ONE degrade action, not a
+            # double event/metric and two eviction sweeps
+            fire = False
+            with self._reg_lock:
+                if not self.degraded:
+                    self.degraded = True
+                    fire = True
+                caches = [r() for r in self._caches] if fire else []
+            if fire:
+                self._event("degrade", detail=f"soft limit {int(soft)} exceeded")
+                M.SERVER_MEM_ACTIONS.inc(action="degrade")
+                for cache in caches:
+                    if cache is not None:
+                        cache.evict_all()
+        if c <= L:
+            return
+        with self._reg_lock:
+            k = self._killing
+            kt = k() if k is not None else None
+            if kt is not None:
+                if kt is origin:
+                    # the victim itself is allocating AGAIN mid-unwind
+                    # (e.g. the batcher's serial fallback re-running the
+                    # killed leader): it must stay dead, or a recorded
+                    # kill quietly completes — same verdict, no second
+                    # event
+                    raise ServerMemoryExceeded(
+                        f"Out Of Memory Quota! server memory limit {L} "
+                        f"exceeded; statement [{origin.label}] was already "
+                        f"killed and may not allocate further "
+                        f"(tidb_server_memory_limit)"
+                    )
+                if origin.consumed > L:
+                    # the allocator ALONE breaches the limit: it needs no
+                    # arbitration (failing it reclaims its own bytes, no
+                    # innocent involved) — a second memory bomb must not
+                    # slip through another victim's unwind window
+                    self._event("kill", victim=origin.label,
+                                victim_sql=origin.sql,
+                                victim_bytes=origin.consumed)
+                    M.SERVER_MEM_ACTIONS.inc(action="kill")
+                    raise ServerMemoryExceeded(
+                        f"Out Of Memory Quota! server memory limit {L} "
+                        f"exceeded; statement [{origin.label}] alone holds "
+                        f"{origin.consumed} bytes and was killed "
+                        f"(tidb_server_memory_limit)"
+                    )
+                return  # another victim is unwinding; ride it out
+            # re-read under the lock: a victim may have unwound between
+            # the breach snapshot and here — killing on the stale total
+            # would execute an innocent while real consumption is fine
+            c = self.consumed
+            if c <= L:
+                return
+            victims = [t for t in (r() for r in self._stmts) if t is not None]
+            if not victims:
+                return
+            if sum(t.consumed for t in victims) <= L:
+                # the overage lives in UNREGISTERED transient volume (a
+                # grouped launch's shared uploads): the statements
+                # collectively fit under the limit, so executing one
+                # reclaims nothing — ride the transient out (degrade
+                # already fired above)
+                return
+            top = max(victims, key=lambda t: t.consumed)
+            # one victim at a time on BOTH paths: the in-place raise
+            # below is also a kill in flight, and without the marker a
+            # concurrent small allocator would re-kill the dying
+            # statement (duplicate events + a re-flagged session)
+            self._killing = weakref.ref(top)
+            if top is not origin:
+                # flag the victim UNDER the registry lock: forget() (the
+                # victim's teardown) takes the same lock, so a kill can
+                # never land after its target statement already ended
+                sess = top.session
+                if sess is not None:
+                    sess._kill_reason = "oom"
+                    sess._killed = True
+        if top is origin:
+            self._event("kill", victim=origin.label, victim_sql=origin.sql,
+                        victim_bytes=origin.consumed)
+            M.SERVER_MEM_ACTIONS.inc(action="kill")
+            raise ServerMemoryExceeded(
+                f"Out Of Memory Quota! server memory limit {L} exceeded "
+                f"(consumed {c}); statement [{origin.label}] is the top consumer "
+                f"({origin.consumed} bytes) and was killed (tidb_server_memory_limit)"
+            )
+        # the victim escapes at its next shared-interrupt-gate checkpoint
+        # (chunk boundary, admission wait, backoff sleep) with the oom
+        # reason, not a generic KILL; event/metric recorded off-lock
+        self._event("kill", victim=top.label, victim_sql=top.sql,
+                    victim_bytes=top.consumed)
+        M.SERVER_MEM_ACTIONS.inc(action="kill")
+
+    def settle(self) -> None:
+        """Release-side check: leave degraded mode once consumption falls
+        back under the soft limit (with a small hysteresis so one chunk
+        released at the boundary doesn't flap the flag)."""
+        from . import metrics as M
+
+        if not self.limit and not self.degraded:
+            return  # feature off: keep release() gauge-free too
+        M.SERVER_MEM_CONSUMED.set(float(self.consumed))
+        if not self.degraded:
+            return
+        soft = self.limit * self.alarm_ratio
+        if self.limit == 0 or self.consumed < soft * 0.9:
+            with self._reg_lock:
+                if not self.degraded:
+                    return  # a releasing sibling already recovered
+                self.degraded = False
+            self._event("recover", detail="consumption back under the soft limit")
+            M.SERVER_MEM_ACTIONS.inc(action="recover")
+
+
+# --- per-thread statement-tracker binding (the cop/engine seam) -------------
+
+_TLS = threading.local()
+
+
+class bind:
+    """Bind `tracker` (may be None) to this thread for a task's duration;
+    the TPU engine's transfer accounting consumes through it."""
+
+    __slots__ = ("tracker", "prev")
+
+    def __init__(self, tracker: MemTracker | None):
+        self.tracker = tracker
+
+    def __enter__(self):
+        self.prev = getattr(_TLS, "tracker", None)
+        _TLS.tracker = self.tracker
+        return self.tracker
+
+    def __exit__(self, *exc):
+        _TLS.tracker = self.prev
+        return False
+
+
+def current_tracker() -> MemTracker | None:
+    return getattr(_TLS, "tracker", None)
+
+
+def consume_current(nbytes: int) -> None:
+    """Charge the thread's bound statement tracker (no-op unbound). May
+    raise: a quota/server-limit breach at a device transfer is a real
+    allocation failure, not a device fault — classify_device_error passes
+    TiDBError through untouched."""
+    t = getattr(_TLS, "tracker", None)
+    if t is not None and nbytes:
+        t.consume(int(nbytes))
 
 
 def chunk_bytes(chunk) -> int:

@@ -275,6 +275,28 @@ BREAKER_TRIPS = REGISTRY.counter(
 # instance); a breaker publishes only on its first state transition, so
 # idle breakers never add series
 
+# runaway-control series (ref: the reference's runaway metrics; PR 4)
+RUNAWAY_ACTIONS = REGISTRY.counter(
+    "tidb_runaway_actions_total",
+    "runaway QUERY_LIMIT actions fired, by group, action and breached rule",
+)
+RUNAWAY_WATCH_HITS = REGISTRY.counter(
+    "tidb_runaway_watch_hits_total",
+    "statements matched against the runaway watch list at admission",
+)
+
+# server memory arbitration series (utils/memory ServerMemTracker; PR 4)
+SERVER_MEM_CONSUMED = REGISTRY.gauge(
+    "tidb_server_mem_consumed_bytes", "tracked statement memory across the store"
+)
+SERVER_MEM_LIMIT = REGISTRY.gauge(
+    "tidb_server_mem_limit_bytes", "tidb_server_memory_limit (0 = unlimited)"
+)
+SERVER_MEM_ACTIONS = REGISTRY.counter(
+    "tidb_server_mem_actions_total",
+    "server memory arbiter actions (degrade / recover / kill)",
+)
+
 # device-path series (ref: "Query Processing on Tensor Computation
 # Runtimes" names compile-cache behavior and host↔device transfer as the
 # dominant hidden costs — these make them first-class)

@@ -65,7 +65,7 @@ class StmtStats:
     # verbatim on each slow-log entry (ref: util/execdetails fields of
     # LogSlowQuery / stmtsummary)
     DETAIL_KEYS = ("sched_wait_ms", "retries", "backoff_ms", "compile_ms",
-                   "transfer_bytes")
+                   "transfer_bytes", "mem_degraded_tasks")
 
     def record(
         self, sql: str, dur_s: float, user: str, db: str, ok: bool,
@@ -116,6 +116,10 @@ class StmtStats:
                 st["max_batch_occupancy"] = max(
                     st.get("max_batch_occupancy", 0), int(d.get("batch_occupancy", 0))
                 )
+                # peak tracked memory is a high-water mark, not a sum
+                st["max_mem_bytes"] = max(
+                    st.get("max_mem_bytes", 0), int(d.get("mem_bytes", 0))
+                )
             if slow_log_on and dur_s >= slow_threshold_s:
                 entry = {
                     "time": now,
@@ -126,6 +130,7 @@ class StmtStats:
                     "query": sql[:512],
                     "succ": ok,
                     "batch_occupancy": int(d.get("batch_occupancy", 0)),
+                    "mem_bytes": int(d.get("mem_bytes", 0)),
                 }
                 for k in self.DETAIL_KEYS:
                     entry[k] = d.get(k, 0.0)

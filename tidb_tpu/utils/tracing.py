@@ -160,6 +160,8 @@ class StatementTrace:
             "backoff_ms": c.get("backoff_ms", 0.0),
             "compile_ms": c.get("compile_ms", 0.0),
             "transfer_bytes": int(c.get("transfer_bytes", 0)),
+            "mem_bytes": int(c.get("mem_bytes", 0)),
+            "mem_degraded_tasks": int(c.get("mem_degraded_tasks", 0)),
         }
 
     # --- spans (recording only) --------------------------------------------
@@ -351,6 +353,24 @@ def add_phase(key: str, n: float) -> None:
         d[key] = d.get(key, 0.0) + n
 
 
+def phase_counters(phases: dict) -> list[tuple[str, float]]:
+    """(exec-detail key, value) pairs for a launch's device phases — the
+    ONE phase→counter mapping, shared by solo attribution
+    (copr/client._note_device_phases) and grouped fan-out
+    (sched/batcher._attribute) so both EXPLAIN ANALYZE `device:` paths
+    can never drift apart."""
+    out = []
+    if phases.get("compile_ms"):
+        out.append(("compile_ms", phases["compile_ms"]))
+    tb = phases.get("h2d_bytes", 0.0) + phases.get("d2h_bytes", 0.0)
+    if tb:
+        out.append(("transfer_bytes", tb))
+    dm = phases.get("execute_ms", 0.0) + phases.get("h2d_ms", 0.0)
+    if dm:
+        out.append(("device_ms", dm))
+    return out
+
+
 def phase_spans(phases: dict, parent_id: int, end_ns: int) -> list[Span]:
     """Synthesize the device-phase child spans (compile → h2d transfer →
     execute+d2h) under `parent_id`, laid out back-to-back ending at
@@ -390,6 +410,22 @@ class TraceRing:
     def push(self, trace) -> None:
         with self._lock:
             self._ring.append(trace)
+
+    def resize(self, capacity: int) -> None:
+        """Live resize (SET GLOBAL tidb_trace_ring_capacity): keeps the
+        newest traces that fit — a shrink drops from the old end, like
+        the ring itself would have."""
+        from collections import deque
+
+        capacity = max(1, int(capacity))
+        with self._lock:
+            if self._ring.maxlen == capacity:
+                return
+            self._ring = deque(self._ring, maxlen=capacity)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
 
     def snapshot(self) -> list[dict]:
         with self._lock:

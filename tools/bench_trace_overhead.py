@@ -1,119 +1,43 @@
-"""Tracing-overhead gate (ISSUE 3 acceptance): rerun the bench_sched
-point-agg workload through full statements with span recording OFF
+"""Tracing-overhead gate (ISSUE 3 acceptance): the paired off/on
+statement bench (tools/paired_bench.py) with span recording OFF
 (tidb_enable_trace=OFF — the always-on counters path every statement
-pays) and ON, compare per-statement p50, and FAIL LOUDLY (non-zero
-exit) if enabled-tracing p50 regresses more than GATE_PCT over the
-disabled path. Writes BENCH_trace_pr3.json at the repo root so future
-PRs can gate on it.
-
-Modes interleave per STATEMENT (off/on measured back-to-back, order
-alternating) so machine drift — which on a shared box dwarfs the
-instrumentation cost — cancels instead of biasing one mode. Standalone:
+pays) vs ON. FAILS LOUDLY (non-zero exit) past GATE_PCT p50 and writes
+BENCH_trace_pr3.json at the repo root. Standalone:
 `python tools/bench_trace_overhead.py`.
 """
 
-import json
 import os
-import statistics
 import sys
-import time
 
-N_TASKS = 32
-ROWS_PER_TASK = 4096
-REPS = 14  # per mode, first rep of each mode is warmup; ~420 pairs keeps
-# the median's standard error ~1% against this box's noise
-GATE_PCT = 5.0
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.paired_bench import (  # noqa: E402
+    N_TASKS,
+    REPS,
+    ROWS_PER_TASK,
+    bench_main,
+    make_pt_session,
+    run_paired_bench,
+)
 
 
-def _queries(n_tasks: int, rows_per_task: int) -> list[str]:
-    return [
-        "SELECT COUNT(*), SUM(v), MIN(v), MAX(w) FROM pt"
-        f" WHERE id >= {i * rows_per_task} AND id < {(i + 1) * rows_per_task}"
-        for i in range(n_tasks)
-    ]
+def _set_mode(s, mode: str) -> None:
+    s.vars["tidb_enable_trace"] = "ON" if mode == "on" else "OFF"
 
 
 def run_trace_overhead_bench(n_tasks: int = N_TASKS, rows_per_task: int = ROWS_PER_TASK,
                              reps: int = REPS) -> dict:
-    from tidb_tpu.session import Session
-
-    s = Session()
-    s.execute("CREATE TABLE pt (id INT PRIMARY KEY, v INT, w INT)")
-    total = n_tasks * rows_per_task
-    for lo in range(0, total, 8192):
-        s.execute(
-            "INSERT INTO pt VALUES "
-            + ",".join(f"({i}, {i % 997}, {(i * 7) % 131})" for i in range(lo, lo + 8192))
-        )
-    s.vars["tidb_enable_cop_result_cache"] = "OFF"
-    s.vars["tidb_cop_engine"] = "tpu"  # point tasks sit below AUTO_MIN_ROWS
-    queries = _queries(n_tasks, rows_per_task)
-
-    # warm every compiled program (and the tile cache) before timing
-    for q in queries:
-        s.must_query(q)
-
-    lat: dict[str, list[float]] = {"off": [], "on": []}
-    deltas: list[float] = []  # paired (on - off), drift-immune
-
-    def timed(mode: str, q: str) -> float:
-        s.vars["tidb_enable_trace"] = "ON" if mode == "on" else "OFF"
-        t0 = time.perf_counter()
-        s.must_query(q)
-        return time.perf_counter() - t0
-
-    for rep in range(reps):
-        for qi, q in enumerate(queries):
-            order = ("off", "on") if (rep + qi) % 2 == 0 else ("on", "off")
-            pair = {mode: timed(mode, q) for mode in order}
-            if rep:  # rep 0 warms both paths
-                lat["off"].append(pair["off"])
-                lat["on"].append(pair["on"])
-                deltas.append(pair["on"] - pair["off"])
-    s.vars["tidb_enable_trace"] = "OFF"
-
-    p50_off = statistics.median(lat["off"])
-    p50_on = statistics.median(lat["on"])
-    # gate on the median PAIRED delta: each pair runs back-to-back, so
-    # machine drift over the run cancels per-pair instead of biasing
-    # whichever mode ran during the slow stretch
-    overhead_pct = (statistics.median(deltas) / p50_off) * 100.0 if p50_off else 0.0
-    out = {
-        "workload": "bench_sched point-agg statements, tracing off vs on",
-        "tasks": n_tasks,
-        "rows_per_task": rows_per_task,
-        "samples_per_mode": len(lat["off"]),
-        "p50_off_ms": round(p50_off * 1e3, 3),
-        "p50_on_ms": round(p50_on * 1e3, 3),
-        "p99_off_ms": round(sorted(lat["off"])[int(len(lat["off"]) * 0.99)] * 1e3, 3),
-        "p99_on_ms": round(sorted(lat["on"])[int(len(lat["on"]) * 0.99)] * 1e3, 3),
-        "overhead_pct": round(overhead_pct, 2),
-        "gate_pct": GATE_PCT,
-        "pass": overhead_pct <= GATE_PCT,
-    }
-    return out
+    s = make_pt_session(n_tasks, rows_per_task)
+    return run_paired_bench(
+        s, _set_mode,
+        "bench_sched point-agg statements, tracing off vs on",
+        n_tasks=n_tasks, rows_per_task=rows_per_task, reps=reps,
+    )
 
 
 def main() -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, root)
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    out = run_trace_overhead_bench()
-    print(json.dumps(out, indent=2))
-    with open(os.path.join(root, "BENCH_trace_pr3.json"), "w", encoding="utf8") as f:
-        json.dump(out, f, indent=2)
-        f.write("\n")
-    if not out["pass"]:
-        print(
-            f"FAIL: enabled-tracing p50 regressed {out['overhead_pct']}% "
-            f"(> {GATE_PCT}% gate)",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
+    return bench_main(run_trace_overhead_bench, "BENCH_trace_pr3.json",
+                      "enabled-tracing")
 
 
 if __name__ == "__main__":
