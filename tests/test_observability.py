@@ -43,10 +43,16 @@ class TestInfoSchema:
 
     def test_metrics_memtable(self, s):
         s.must_query("SELECT COUNT(*) FROM t")
+        # statement latency shards per resource_group (PR 5); the label
+        # sets PARTITION the observations (no double-counting base row),
+        # so summing across instances stays the true total
         rows = s.must_query(
-            "SELECT name, value FROM information_schema.metrics WHERE name = 'tidb_query_duration_seconds_count'"
+            "SELECT labels, value FROM information_schema.metrics"
+            " WHERE name = 'tidb_query_duration_seconds_count'"
         )
-        assert len(rows) == 1 and float(rows[0][1]) > 0
+        assert rows and all(float(v) > 0 for _, v in rows)
+        assert any("resource_group=default" in l for l, _ in rows), rows
+        assert not any(l == "" for l, _ in rows), "base row would double-count"
 
 
 class TestSlowLogAndSummary:

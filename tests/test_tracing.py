@@ -389,6 +389,105 @@ class TestTraceSurfaces:
         assert len(s.store.trace_ring.snapshot()) == n0
 
 
+class TestTxnTraceLinking:
+    def test_two_statement_txn_shares_one_txn_trace_id(self, s):
+        """The acceptance shape: BEGIN; <2 stmts>; COMMIT — every
+        statement of the txn (control statements included) carries ONE
+        txn_trace_id end-to-end into TIDB_TRACE; statements outside stay
+        unlinked."""
+        s.must_query("SELECT COUNT(*) FROM t")  # outside: no linkage
+        s.execute("SET tidb_enable_trace = 'ON'")
+        s.execute("BEGIN")
+        s.must_query("SELECT COUNT(*) FROM t")
+        s.must_query("SELECT SUM(v) FROM t")
+        s.execute("COMMIT")
+        s.must_query("SELECT MIN(v) FROM t")  # after: fresh statement unlinked
+        s.execute("SET tidb_enable_trace = 'OFF'")
+        by_sql = {}
+        for tr in s.store.trace_ring.snapshot():
+            by_sql[tr["sql"]] = tr
+        txn_ids = {
+            by_sql[q]["txn_trace_id"]
+            for q in ("BEGIN", "SELECT COUNT(*) FROM t", "SELECT SUM(v) FROM t", "COMMIT")
+        }
+        assert len(txn_ids) == 1 and txn_ids.pop().startswith("txn-")
+        assert by_sql["SELECT MIN(v) FROM t"]["txn_trace_id"] is None
+        # the linkage column reads straight out of the memtable
+        rows = s.must_query(
+            "SELECT DISTINCT txn_trace_id FROM information_schema.tidb_trace"
+            " WHERE sql = 'SELECT SUM(v) FROM t' AND txn_trace_id != ''"
+        )
+        assert len(rows) == 1 and rows[0][0].startswith("txn-")
+        # the root span is stamped too
+        tr = by_sql["SELECT SUM(v) FROM t"]
+        root = next(sp for sp in tr["spans"] if sp["parent_id"] == 0)
+        assert root["tags"]["txn_trace_id"] == tr["txn_trace_id"]
+
+    def test_second_txn_gets_fresh_id(self, s):
+        s.execute("SET tidb_enable_trace = 'ON'")
+        ids = []
+        for _ in range(2):
+            s.execute("BEGIN")
+            s.must_query("SELECT COUNT(*) FROM t")
+            s.execute("COMMIT")
+            ids.append(s.store.trace_ring.snapshot()[-1]["txn_trace_id"])
+        s.execute("SET tidb_enable_trace = 'OFF'")
+        assert ids[0] != ids[1] and all(i.startswith("txn-") for i in ids)
+
+    def test_rollback_clears_linkage(self, s):
+        s.execute("SET tidb_enable_trace = 'ON'")
+        s.execute("BEGIN")
+        s.must_query("SELECT COUNT(*) FROM t")
+        s.execute("ROLLBACK")
+        s.must_query("SELECT COUNT(*) FROM t")
+        s.execute("SET tidb_enable_trace = 'OFF'")
+        assert s.store.trace_ring.snapshot()[-1]["txn_trace_id"] is None
+
+    def test_trace_renders_txn_tree(self, s):
+        """TRACE inside an explicit txn renders the multi-statement tree:
+        a txn root row, the already-finished statements of the txn, then
+        the traced statement."""
+        s.execute("SET tidb_enable_trace = 'ON'")
+        s.execute("BEGIN")
+        s.must_query("SELECT COUNT(*) FROM t")
+        rows = s.must_query("TRACE SELECT SUM(v) FROM t")
+        s.execute("COMMIT")
+        s.execute("SET tidb_enable_trace = 'OFF'")
+        ops = _ops(rows)
+        assert ops[0].startswith("txn[txn_trace_id=txn-"), ops[0]
+        assert "statements=3" in ops[0]  # BEGIN + SELECT + the traced one
+        assert sum(1 for o in ops if o.startswith("session.execute")) == 3
+        # TRACE outside a txn keeps the single-statement contract
+        assert _ops(s.must_query("TRACE SELECT COUNT(*) FROM t"))[0] == "session.execute"
+
+
+class TestRealTimestampPhaseSpans:
+    def test_device_phases_carry_captured_timestamps(self, s):
+        """PR 3 synthesized ONE device.transfer span laid back-to-back
+        before device.execute; real capture keeps one span per upload
+        with its own clock readings — uploads are distinguishable and
+        execute starts at/after the last upload ends (gaps survive)."""
+        s.execute("CREATE TABLE fresh (id INT PRIMARY KEY, a INT, b INT, c INT)")
+        s.execute(
+            "INSERT INTO fresh VALUES "
+            + ",".join(f"({i}, {i % 5}, {i % 11}, {i % 3})" for i in range(4096))
+        )
+        s.vars["tidb_enable_trace"] = "ON"
+        s.must_query("SELECT a, SUM(b), MIN(c) FROM fresh GROUP BY a")
+        s.vars["tidb_enable_trace"] = "OFF"
+        tr = s.store.trace_ring.snapshot()[-1]
+        transfers = [sp for sp in tr["spans"] if sp["operation"] == "device.transfer"]
+        executes = [sp for sp in tr["spans"] if sp["operation"] == "device.execute"]
+        assert len(transfers) > 1, "per-upload spans expected, got one synthesized wall"
+        assert executes
+        ends = [sp["start_ms"] + sp["duration_ms"] for sp in transfers]
+        # chronology is real: the fetch follows every upload on the clock
+        assert min(sp["start_ms"] for sp in executes) >= max(ends) - 0.5
+        # per-upload byte tags survive
+        assert all(sp["tags"]["bytes"] > 0 and sp["tags"]["dir"] == "h2d"
+                   for sp in transfers)
+
+
 class TestMetricsHistoryTick:
     def test_statement_completion_fills_summary_window(self, s):
         """METRICS_SUMMARY windows fill under a pure-SQL workload — no

@@ -68,11 +68,21 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
     ),
     "tidb_trace": (
         # flattened span rows of the last-N statement traces
-        # (utils/tracing.TraceRing; one row per span, root included)
+        # (utils/tracing.TraceRing; one row per span, root included);
+        # TXN_TRACE_ID links statements of one BEGIN…COMMIT (PR 5)
         ["TRACE_ID", "SESSION_ID", "SPAN_ID", "PARENT_SPAN_ID", "OPERATION",
-         "START_MS", "DURATION_MS", "TAGS", "SQL"],
+         "START_MS", "DURATION_MS", "TAGS", "SQL", "TXN_TRACE_ID"],
         [ft_varchar(32), ft_longlong(), ft_longlong(), ft_longlong(), ft_varchar(128),
-         ft_double(), ft_double(), ft_varchar(256), ft_varchar(512)],
+         ft_double(), ft_double(), ft_varchar(256), ft_varchar(512), ft_varchar(32)],
+    ),
+    "tidb_timeline": (
+        # flattened device-timeline events (utils/timeline.TimelineRing):
+        # real-timestamped engine-boundary + launch-lifecycle events,
+        # TS_US/DUR_US in µs relative to the ring epoch (the same numbers
+        # /debug/timeline exports for Perfetto)
+        ["LANE", "TRACK", "NAME", "CATEGORY", "TS_US", "DUR_US", "ARGS"],
+        [ft_varchar(16), ft_varchar(64), ft_varchar(64), ft_varchar(32),
+         ft_double(), ft_double(), ft_varchar(512)],
     ),
     "metrics": (
         ["NAME", "LABELS", "VALUE"],
@@ -205,7 +215,23 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                     Datum.s(sp["operation"]),
                     Datum.f(sp["start_ms"]), Datum.f(sp["duration_ms"]),
                     Datum.s(tags[:256]), Datum.s(tr["sql"][:512]),
+                    Datum.s(tr.get("txn_trace_id") or ""),
                 ])
+        return out
+    if name == "tidb_timeline":
+        from ..utils.timeline import _PID_NAMES
+
+        tl = session.store.timeline
+        out = []
+        for ev in tl.snapshot():
+            args = " ".join(f"{k}={v}" for k, v in ev.args.items())
+            out.append([
+                Datum.s(_PID_NAMES.get(ev.pid, str(ev.pid))), Datum.s(ev.lane),
+                Datum.s(ev.name), Datum.s(ev.cat),
+                Datum.f(round((ev.t_start_ns - tl.epoch_ns) / 1e3, 3)),
+                Datum.f(round(max(ev.t_end_ns - ev.t_start_ns, 0) / 1e3, 3)),
+                Datum.s(args[:512]),
+            ])
         return out
     if name == "metrics":
         from ..utils.metrics import REGISTRY

@@ -39,6 +39,7 @@ from ..errors import (
 from ..mysqltypes.datum import Datum, K_BYTES
 from ..sched import SchedCtx, ru_cost
 from ..utils import memory
+from ..utils import timeline as TL
 from ..utils import tracing
 from ..utils.failpoint import inject as _fp
 from .dag import DAGRequest
@@ -141,6 +142,11 @@ class CopClient:
             "transfer_bytes": 0,
             "device_ms": 0,
             "host_ms": 0,
+            # upload-attribution counters (PR 5): bytes served from a
+            # prior launch's cached device lanes, and grouped-launch
+            # shared uploads performed on behalf of the whole group
+            "cache_ref_bytes": 0,
+            "shared_h2d_bytes": 0,
             # memory-arbitration + runaway counters (PR 4)
             "mem_degraded_tasks": 0,
             "processed_rows": 0,
@@ -546,8 +552,14 @@ class CopClient:
         ctl = self.ctl if (sctx is None or sctx.enabled) else None
         if bo is None:
             bo = Backoffer.for_ctx(sctx, stats=st)
+        # device timeline: bind the store ring + this statement's resource
+        # group to the engine-call thread — the engine boundary hooks and
+        # the launch batcher's lifecycle events read it from TLS
         with tracing.activate(trace), memory.bind(
             getattr(sctx, "mem", None) if sctx is not None else None
+        ), TL.bind(
+            getattr(self.storage, "timeline", None),
+            getattr(sctx, "group", "default") if sctx is not None else "default",
         ):
             while True:
                 if bo.abort is not None and bo.abort.is_set():

@@ -36,6 +36,7 @@ import numpy as np
 from ..jaxenv import jax, jnp
 from ..utils import memory as _mem
 from ..utils import metrics as M
+from ..utils import timeline as TL
 from ..utils import tracing
 from ..chunk.chunk import Chunk, Column
 from ..expr.expression import Column as ExprCol, Constant, Expression, ScalarFunc
@@ -62,13 +63,26 @@ class _Timed:
 
     def __call__(self, *args):
         if self._compiled:
-            return self.fn(*args)
-        t0 = time.perf_counter()
+            tl = TL.active()
+            if tl is None:
+                return self.fn(*args)
+            # warmed path: the jit call IS the async dispatch — its wall
+            # is queueing cost, not compute (device_get observes that)
+            t0 = time.perf_counter_ns()
+            out = self.fn(*args)
+            tl.device_event("device.dispatch", "dispatch", t0, time.perf_counter_ns())
+            return out
+        t0 = time.perf_counter_ns()
         out = self.fn(*args)
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter_ns()
+        dt = (t1 - t0) / 1e9
         self._compiled = True
         M.TPU_COMPILE_SECONDS.observe(dt)
         tracing.add_phase("compile_ms", dt * 1e3)
+        tracing.add_phase_event("device.compile", t0, t1)
+        tl = TL.active()
+        if tl is not None:
+            tl.device_event("device.compile", "compile", t0, t1)
         return out
 
 
@@ -80,11 +94,16 @@ def _to_device(a: np.ndarray):
     consume can raise the quota/server-limit error right at the
     allocation site (a real allocation failure, never a device fault)."""
     _mem.consume_current(a.nbytes)
-    t0 = time.perf_counter()
+    t0 = time.perf_counter_ns()
     out = jnp.asarray(a)
+    t1 = time.perf_counter_ns()
     M.TPU_TRANSFER_BYTES.inc(a.nbytes, dir="h2d")
     tracing.add_phase("h2d_bytes", a.nbytes)
-    tracing.add_phase("h2d_ms", (time.perf_counter() - t0) * 1e3)
+    tracing.add_phase("h2d_ms", (t1 - t0) / 1e6)
+    tracing.add_phase_event("device.transfer", t0, t1, dir="h2d", bytes=int(a.nbytes))
+    tl = TL.active()
+    if tl is not None:
+        tl.device_event("device.h2d", "transfer", t0, t1, bytes=int(a.nbytes))
     return out
 
 
@@ -93,14 +112,19 @@ def _fetch(x):
     finishes computing, so its wall is the observable device execute+fetch
     time (tidb_tpu_device_execute_seconds); result bytes are the d2h half
     of the transfer series."""
-    t0 = time.perf_counter()
+    t0 = time.perf_counter_ns()
     out = jax.device_get(x)
-    dt = time.perf_counter() - t0
+    t1 = time.perf_counter_ns()
+    dt = (t1 - t0) / 1e9
     nbytes = sum(getattr(l, "nbytes", 0) for l in jax.tree_util.tree_leaves(out))
-    M.TPU_EXECUTE_SECONDS.observe(dt)
+    M.TPU_EXECUTE_SECONDS.observe(dt, resource_group=TL.current_group())
     M.TPU_TRANSFER_BYTES.inc(nbytes, dir="d2h")
     tracing.add_phase("execute_ms", dt * 1e3)
     tracing.add_phase("d2h_bytes", nbytes)
+    tracing.add_phase_event("device.execute", t0, t1, d2h_bytes=int(nbytes))
+    tl = TL.active()
+    if tl is not None:
+        tl.device_event("device.execute", "execute", t0, t1, d2h_bytes=int(nbytes))
     # NOT consumed into the memory tracker: the fetched result becomes a
     # chunk that drain() charges at materialization — charging the d2h
     # here too would double-count the same data on the device path only
@@ -239,6 +263,10 @@ class DeviceBatch:
         self.vocabs: dict[int, list] = {}
         self._data: dict[int, object] = {}
         self._valid: dict[int, object] = {}
+        # per-lane upload identity: (upload_id, bytes) recorded by the
+        # launch that actually paid the h2d — later statements hitting
+        # the cached lane reference it instead of inheriting the cost
+        self.upload_ids: dict[int, tuple[int, int]] = {}
         rv = np.zeros(self.padded, dtype=bool)
         rv[:n] = True
         self.row_valid = _to_device(rv.reshape(self.t, TILE_ROWS))
@@ -250,7 +278,10 @@ class DeviceBatch:
 
     def lanes(self, off: int):
         """(data [T,R] jnp, valid [T,R] jnp) for a table column offset,
-        dict-encoding object lanes on first use."""
+        dict-encoding object lanes on first use. The h2d upload span and
+        bytes belong to the launch that performs it; a cache hit records
+        a zero-duration `cache_ref` annotation carrying the original
+        upload id — attribution follows the work, not first-touch."""
         if off not in self._data:
             d = self.batch.data[off]
             v = self.batch.valid[off]
@@ -261,6 +292,21 @@ class DeviceBatch:
                 d = codes
             self._data[off] = _to_device(self._pad2d(d))
             self._valid[off] = _to_device(self._pad2d(v))
+            self.upload_ids[off] = (
+                tracing._next_id(),
+                int(self._data[off].nbytes) + int(self._valid[off].nbytes),
+            )
+        else:
+            rec = self.upload_ids.get(off)
+            if rec is not None:
+                now = time.perf_counter_ns()
+                tracing.add_phase("cache_ref_bytes", rec[1])
+                tracing.add_phase_event("device.cache_ref", now, now,
+                                        upload_id=rec[0], bytes=rec[1])
+                tl = TL.active()
+                if tl is not None:
+                    tl.device_event("device.cache_ref", "transfer", now, now,
+                                    upload_id=rec[0], bytes=rec[1])
         return self._data[off], self._valid[off]
 
 

@@ -36,6 +36,7 @@ import time
 from ..errors import MemoryQuotaExceeded
 from ..utils import memory
 from ..utils import metrics as M
+from ..utils import timeline as TL
 from ..utils import tracing
 from ..utils.failpoint import inject as _fp
 
@@ -115,6 +116,7 @@ class LaunchBatcher:
             return engine.execute(dag, batch)
         ckey = (id(engine), dag.digest(), tiles)
         job = _Job(dag, batch, dedup_key, client=client)
+        t_enq = time.perf_counter_ns()
         with self._lock:
             g = self._pending.get(ckey)
             if g is not None and not g.closed:
@@ -134,12 +136,17 @@ class LaunchBatcher:
                 group.jobs.append(job)
                 self._pending[ckey] = group
 
+        TL.group_event("launch.enqueue", "launch", t_enq, t_enq, mode=job.mode,
+                       trace=job.trace.trace_id if job.trace is not None else None)
         if job.mode == "leader":
             time.sleep(self.WINDOW_S)
             with self._lock:
                 group.closed = True
                 if self._pending.get(ckey) is group:
                     del self._pending[ckey]
+            TL.group_event("launch.leader_elected", "launch", t_enq,
+                           time.perf_counter_ns(),
+                           jobs=len(group.jobs), n_dedup=group.n_dedup)
             self._launch(engine, group, stats)
         else:
             if not group.done.wait(self.WAIT_TIMEOUT_S):
@@ -158,6 +165,9 @@ class LaunchBatcher:
     def _launch(self, engine, group: _Group, stats) -> None:
         jobs = group.jobs
         t0_ns = time.perf_counter_ns()
+        # one launch identity shared by the timeline event and the trace
+        # span fanned into every waiter (same id space as span ids)
+        launch_id = tracing._next_id()
         # the group's shared uploads belong to NO statement (a neighbor's
         # bytes must not draw the leader's quota verdict) but the SERVER
         # arbiter must still see the volume: a detachable, quota-less
@@ -228,12 +238,16 @@ class LaunchBatcher:
                     else:
                         f.result, f.exc = j.result, j.exc
             try:
-                self._attribute(jobs, group, t0_ns, phases)
+                self._attribute(jobs, group, t0_ns, phases, launch_id=launch_id)
             except Exception:  # noqa: BLE001 — attribution must never strand waiters
                 log.warning("launch-span fan-out attribution failed", exc_info=True)
             group.done.set()
+            TL.group_event("launch.fanout", "launch",
+                           time.perf_counter_ns(), time.perf_counter_ns(),
+                           launch_id=launch_id, waiters=len(jobs) + group.n_dedup)
 
-    def _attribute(self, jobs, group: _Group, t0_ns: int, phases: dict) -> None:
+    def _attribute(self, jobs, group: _Group, t0_ns: int, phases: dict,
+                   launch_id: int | None = None) -> None:
         """Fan the ONE launch out into every co-batched waiter's trace:
         each participant (members, dedup followers, the leader itself)
         gets the SAME launch span — identical launch/span id, occupancy,
@@ -245,12 +259,33 @@ class LaunchBatcher:
             waiters.append(j)
             waiters.extend(j.followers)
         occupancy = len(waiters)
+        dur_ns = time.perf_counter_ns() - t0_ns
+        # grouped-launch shared uploads: memory tracking deliberately
+        # charges these bytes to NOBODY (a neighbor's data must not draw
+        # the leader's quota verdict) — but the volume is real device
+        # traffic, so it gets its own series and rides the shared launch
+        # span/event as `shared_h2d` instead of vanishing
+        shared_h2d = int(phases.get("h2d_bytes", 0)) if occupancy > 1 else 0
+        if shared_h2d:
+            M.TPU_SHARED_UPLOAD_BYTES.inc(shared_h2d)
+        # ONE timeline event per grouped launch on the runner's device
+        # lane, referenced by every co-batched waiter's trace id
+        tl = TL.active()
+        if tl is not None and occupancy > 1:
+            tl.device_event(
+                "cop.launch", "launch", t0_ns, t0_ns + dur_ns,
+                launch_id=launch_id, occupancy=occupancy, n_dedup=group.n_dedup,
+                shared_h2d_bytes=shared_h2d,
+                waiters=[w.trace.trace_id for w in waiters if w.trace is not None],
+            )
         # store-level stats fan-out (PR 3 debt): a co-batched launch's
         # compile/transfer/execute counters land in EVERY participating
         # client's `cop.stats` — once per client per launch — so EXPLAIN
         # ANALYZE's `device:` line covers grouped launches, not just
         # solos (the statement-level traces get theirs below)
         counters = tracing.phase_counters(phases)
+        if shared_h2d:
+            counters = counters + [("shared_h2d_bytes", shared_h2d)]
         clients = {}
         for w in waiters:
             if w.client is not None:
@@ -267,7 +302,6 @@ class LaunchBatcher:
                 traces.append(t)
         if not traces:
             return
-        dur_ns = time.perf_counter_ns() - t0_ns
         for t in traces:
             t.set_max("batch_occupancy", occupancy)
             for key, cnt in counters:
@@ -275,17 +309,29 @@ class LaunchBatcher:
         if not any(t.recording for t in traces):
             return
         leader = jobs[0].trace
-        span = tracing.Span("cop.launch", 0, dur_ns)
+        span = tracing.Span("cop.launch", 0, dur_ns, span_id=launch_id)
         span.tags.update(
             launch_id=span.span_id, occupancy=occupancy, n_dedup=group.n_dedup,
             runner=leader.trace_id if leader is not None else "-",
         )
+        if shared_h2d:
+            span.tags["shared_h2d"] = shared_h2d
         failed = next((j.exc for j in jobs if j.exc is not None), None)
         if failed is not None:
             span.tags["error"] = type(failed).__name__
-        # device phase children, with starts relative to the launch span's
-        # own start (shifted per adopting trace below)
-        children = tracing.phase_spans(phases, span.span_id, dur_ns)
+        # device phase children: real captured timestamps when the frame
+        # carries boundary events (start_ns holds the ABSOLUTE clock
+        # reading, rebased per adopting trace); plain-dict frames fall
+        # back to back-to-back synthesis relative to the launch start
+        events = getattr(phases, "events", None)
+        if events:
+            children = [
+                tracing.Span(name, c_t0, c_t1 - c_t0,
+                             parent_id=span.span_id, tags=dict(tags))
+                for name, c_t0, c_t1, tags in events
+            ]
+        else:
+            children = tracing.phase_spans(phases, span.span_id, dur_ns)
         adopted = set()
         for w in waiters:
             t = w.trace
@@ -298,12 +344,25 @@ class LaunchBatcher:
                 # tree() would render the children cross-product
                 continue
             adopted.add(id(t))
-            # start relative to THIS trace's epoch: the launch ends "now"
             sp = span.copy_with_parent(w.parent_id or t.root_id)
-            sp.start_ns = t._now_ns() - dur_ns
-            kids = tuple(
-                tracing.Span(c.name, sp.start_ns + c.start_ns, c.dur_ns,
-                             parent_id=c.parent_id, span_id=c.span_id, tags=c.tags)
-                for c in children
-            )
+            if events:
+                # real timestamps: rebase the one monotonic clock onto
+                # this trace's epoch — gaps between phases survive
+                sp.start_ns = t0_ns - t._epoch_ns
+                kids = tuple(
+                    tracing.Span(c.name, c.start_ns - t._epoch_ns, c.dur_ns,
+                                 parent_id=c.parent_id, span_id=c.span_id,
+                                 tags=c.tags)
+                    for c in children
+                )
+            else:
+                # synthesized: start relative to THIS trace's epoch, the
+                # launch ends "now"
+                sp.start_ns = t._now_ns() - dur_ns
+                kids = tuple(
+                    tracing.Span(c.name, sp.start_ns + c.start_ns, c.dur_ns,
+                                 parent_id=c.parent_id, span_id=c.span_id,
+                                 tags=c.tags)
+                    for c in children
+                )
             t.adopt(sp, sp.parent_id, children=kids)

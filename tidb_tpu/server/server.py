@@ -341,6 +341,12 @@ class Server:
                     # status-API half of the reference's trace viewer
                     body = json.dumps(server.storage.trace_ring.snapshot()).encode()
                     ctype = "application/json"
+                elif self.path == "/debug/timeline" or self.path.startswith("/debug/timeline?"):
+                    # device timeline (utils/timeline TimelineRing) in
+                    # Chrome trace-event JSON — save and open in Perfetto
+                    # (ui.perfetto.dev) or chrome://tracing
+                    body = json.dumps(server.storage.timeline.chrome_trace()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/stats/dump/"):
                     # /stats/dump/{db}/{table} (ref: statistics_handler.go)
                     parts = self.path.split("/")

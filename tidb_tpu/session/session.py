@@ -117,6 +117,9 @@ class Session:
         self.user = "root"
         self._session_bindings: dict[str, list] = {}  # digest → hints
         self._tracer = None  # per-statement StatementTrace (utils/tracing)
+        # txn-level trace linkage: minted at BEGIN, stamped on every
+        # statement trace until COMMIT/ROLLBACK (TIDB_TRACE TXN_TRACE_ID)
+        self._txn_trace_id: str | None = None
         self._stmt_vars: dict[str, str] = {}  # SET_VAR hint statement scope
         import itertools as _it
 
@@ -419,6 +422,13 @@ class Session:
                 sql=log_sql, session_id=self.conn_id,
                 recording=self.vars.get("tidb_enable_trace", "OFF") == "ON",
             )
+            # txn-level trace linking: the ast.Begin handler mints the id
+            # once the txn actually starts (a failed BEGIN must not leave
+            # a phantom id on later autocommit statements) and stamps it
+            # onto this tracer; every statement inside the explicit txn
+            # (COMMIT/ROLLBACK included — they are part of it) carries it
+            # until the txn-control handler clears
+            tracer.txn_trace_id = self._txn_trace_id
             self._tracer = tracer
             # runaway watchdog: a checker exists only when the bound
             # group carries a QUERY_LIMIT or the watch list is armed
@@ -439,6 +449,7 @@ class Session:
                 gl = gl[:maxlen]
             log.info("GENERAL_LOG conn=%s user=%s db=%s sql=%s", self.conn_id, self.user, self.current_db, gl)
         t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()  # timeline clock (one monotonic source)
         c0 = time.thread_time()  # Top-SQL CPU attribution by digest
         ok = True
         try:
@@ -506,8 +517,24 @@ class Session:
             if not self._in_bootstrap:
                 self.store.clear_process(self.conn_id)
                 self.store.plugins.fire("on_query", self.user, self.current_db, sql, ok, dur)
+                group = self.vars.get("tidb_resource_group", "default") or "default"
                 M.QUERY_TOTAL.inc(type=type(stmt).__name__, result="OK" if ok else "Error")
-                M.QUERY_DURATION.observe(dur)
+                M.QUERY_DURATION.observe(dur, resource_group=group)
+                tl = self.store.timeline
+                if tl.enabled and tracer is not None:
+                    from ..utils.timeline import PID_GROUPS, group_lane
+
+                    # statement wall on the resource-group lane (one track
+                    # per group+thread: concurrent sessions in one group
+                    # must not emit partially-overlapping complete events
+                    # on a single tid)
+                    tl.record(
+                        "statement", "statement", t0_ns, time.perf_counter_ns(),
+                        pid=PID_GROUPS, lane=group_lane(group),
+                        trace_id=tracer.trace_id,
+                        txn_trace_id=tracer.txn_trace_id,
+                        session_id=self.conn_id, ok=ok,
+                    )
                 threshold = float(self.vars.get("tidb_slow_log_threshold", "300")) / 1000.0
                 if isinstance(stmt, (ast.CreateUser, ast.Grant, ast.SetStmt)):
                     # never record credential-bearing literals (MySQL
@@ -849,6 +876,11 @@ class Session:
                 self._flush_deltas()
             self.txn = self.store.begin(pessimistic=self._txn_mode_pessimistic(stmt.mode))
             self.in_explicit_txn = True
+            from ..utils import tracing as _tracing
+
+            self._txn_trace_id = _tracing.new_txn_trace_id()
+            if self._tracer is not None:  # stamp the BEGIN itself
+                self._tracer.txn_trace_id = self._txn_trace_id
             return ResultSet([], None)
         if isinstance(stmt, ast.Commit):
             t = self.txn
@@ -856,6 +888,7 @@ class Session:
                 t.commit()
             self.txn = None
             self.in_explicit_txn = False
+            self._txn_trace_id = None  # COMMIT itself was stamped already
             self._txn_committed(t)
             return ResultSet([], None)
         if isinstance(stmt, ast.Rollback):
@@ -863,6 +896,7 @@ class Session:
                 self.txn.rollback()
             self.txn = None
             self.in_explicit_txn = False
+            self._txn_trace_id = None
             self._pending_deltas.clear()
             return ResultSet([], None)
         if isinstance(stmt, ast.SetStmt):
@@ -1023,6 +1057,7 @@ class Session:
             t.commit()
             self.txn = None
             self.in_explicit_txn = False
+            self._txn_trace_id = None
             self._txn_committed(t)
 
     def _run_create_user(self, stmt: ast.CreateUser) -> ResultSet:
@@ -1404,6 +1439,10 @@ class Session:
         elif name == "tidb_trace_ring_capacity":
             # live resize, keeping the newest traces (PR 3 debt)
             self.store.trace_ring.resize(int(val))
+        elif name == "tidb_enable_timeline":
+            # store-wide flag on the ring itself: takes effect for every
+            # session's next engine call, no per-session re-read needed
+            self.store.timeline.enabled = val == "ON"
         elif name == "tidb_server_memory_limit":
             self.store.mem.set_limit(int(val))
         elif name == "tidb_memory_usage_alarm_ratio":
@@ -3634,15 +3673,40 @@ class Session:
                     rec(ch, sp.span_id)
 
             rec(ex, tracer.root_id)
+
+        def span_rows(tree_rows, base_depth=0):
+            out = []
+            for depth, sp in tree_rows:
+                tags = " ".join(f"{k}={v}" for k, v in sp.tags.items())
+                op = ("." * max(depth + base_depth - 1, 0)) + sp.name + (
+                    f"[{tags}]" if tags else "")
+                out.append([
+                    Datum.s(op),
+                    Datum.s(f"{sp.start_ns / 1e6:.3f}ms"),
+                    Datum.s(f"{sp.dur_ns / 1e6:.3f}ms"),
+                ])
+            return out
+
         rows = []
-        for depth, sp in tracer.tree(extra=extra):
-            tags = " ".join(f"{k}={v}" for k, v in sp.tags.items())
-            op = ("." * max(depth - 1, 0)) + sp.name + (f"[{tags}]" if tags else "")
-            rows.append([
-                Datum.s(op),
-                Datum.s(f"{sp.start_ns / 1e6:.3f}ms"),
-                Datum.s(f"{sp.dur_ns / 1e6:.3f}ms"),
-            ])
+        txn_id = tracer.txn_trace_id
+        if txn_id is not None:
+            # multi-statement txn tree: every already-finished statement
+            # of this txn (from the ring) renders under one txn root,
+            # the traced statement last — `BEGIN; ...; TRACE <stmt>`
+            # shows the whole transaction so far
+            from ..utils.tracing import StatementTrace as _ST
+
+            siblings = [
+                t for t in self.store.trace_ring.items()
+                if isinstance(t, _ST) and t.txn_trace_id == txn_id and t is not tracer
+            ]
+            rows.append([Datum.s(f"txn[txn_trace_id={txn_id} statements={len(siblings) + 1}]"),
+                         Datum.s("0.000ms"), Datum.s("-")])
+            for t in siblings:
+                rows.extend(span_rows(t.tree(), base_depth=1))
+            rows.extend(span_rows(tracer.tree(extra=extra), base_depth=1))
+        else:
+            rows = span_rows(tracer.tree(extra=extra))
         chk = Chunk.from_datum_rows([ft_varchar()] * 3, rows)
         return ResultSet(["operation", "startTS", "duration"], chk)
 
@@ -3688,13 +3752,18 @@ class Session:
             # memory-arbitration line: auto tasks rerouted to host while
             # the store sat over its soft memory limit
             lines.append(f"mem: degraded_tasks:{d['mem_degraded_tasks']}")
-        if d["compile_ms"] or d["transfer_bytes"] or d["device_ms"]:
+        if (d["compile_ms"] or d["transfer_bytes"] or d["device_ms"]
+                or d.get("cache_ref_bytes") or d.get("shared_h2d_bytes")):
             # device-path line: XLA compile wall, host<->device bytes and
-            # execute+fetch time attributed to this statement's cop tasks
+            # execute+fetch time attributed to this statement's cop tasks,
+            # plus bytes served from cached device lanes (cache_ref) and
+            # grouped-launch shared uploads (shared_h2d, PR 5)
             lines.append(
                 f"device: compile_ms:{d['compile_ms']:.3f} "
                 f"transfer_bytes:{int(d['transfer_bytes'])} "
-                f"device_ms:{d['device_ms']:.3f}"
+                f"device_ms:{d['device_ms']:.3f} "
+                f"cache_ref:{int(d.get('cache_ref_bytes', 0))} "
+                f"shared_h2d:{int(d.get('shared_h2d_bytes', 0))}"
             )
         if self.cop._tpu:
             br = self.cop.tpu.breaker

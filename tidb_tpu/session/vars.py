@@ -141,6 +141,10 @@ _sv("tidb_backoff_budget_ms", "2000", kind="int", lo=0, hi=600000, consumed=True
 # (PR 4 — replaces the fixed 64)
 _sv("tidb_trace_ring_capacity", "64", scope="global", kind="int", lo=1, hi=4096,
     consumed=True)
+# device timeline profiler (PR 5): real-timestamped engine-boundary and
+# launch-lifecycle events into the per-store ring behind /debug/timeline
+# and TIDB_TIMELINE. GLOBAL-only: one ring per store, one flag on it
+_sv("tidb_enable_timeline", "ON", scope="global", kind="bool", consumed=True)
 
 # --- server memory arbitration (PR 4: utils/memory ServerMemTracker) -------
 # store-wide hard limit on tracked statement memory; 0 = unlimited.

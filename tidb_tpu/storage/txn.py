@@ -617,6 +617,24 @@ class Storage:
             self._trace_ring = TraceRing()
         return self._trace_ring
 
+    _timeline_init_lock = Lock()
+
+    @property
+    def timeline(self):
+        """Per-store device timeline ring (utils/timeline.TimelineRing) —
+        the TIDB_TIMELINE memtable / `/debug/timeline` backing store;
+        `SET GLOBAL tidb_enable_timeline` flips its recording flag.
+        Double-checked init: unlike trace_ring, first access can come
+        from PARALLEL cop worker threads (the TL.bind seam), and a racing
+        second ring would silently swallow the loser's events."""
+        if getattr(self, "_timeline", None) is None:
+            from ..utils.timeline import TimelineRing
+
+            with Storage._timeline_init_lock:
+                if getattr(self, "_timeline", None) is None:
+                    self._timeline = TimelineRing()
+        return self._timeline
+
     # --- active-txn registry (GC safepoint clamp) --------------------------
 
     MAX_TXN_PIN_S = 3600.0  # leaked/abandoned txns stop blocking GC after this

@@ -72,6 +72,14 @@ class Gauge:
 
 
 class Histogram:
+    """Histogram with optional labels: `observe(v)` feeds the base
+    (unlabeled) series; `observe(v, resource_group="g")` feeds that label
+    set's shard INSTEAD — label sets partition the observations exactly
+    like Counter labels do, so consumers that sum a metric across its
+    label instances (metrics_summary, MetricsHistory.base_rates) stay
+    correct. The base series renders only while it has samples or no
+    shards exist (a labeled histogram exposes labeled children only)."""
+
     def __init__(self, name: str, help_: str, buckets: tuple = _BUCKETS):
         self.name = name
         self.help = help_
@@ -80,26 +88,51 @@ class Histogram:
         self._counts = [0] * (len(buckets) + 1)
         self._sum = 0.0
         self._n = 0
+        # label tuple → [counts, sum, n]
+        self._shards: dict[tuple, list] = {}
 
-    def observe(self, v: float) -> None:
+    def _observe_into(self, counts: list, v: float) -> None:
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                counts[i] += 1
+                return
+        counts[-1] += 1
+
+    def observe(self, v: float, **labels) -> None:
         with self._lock:
-            self._sum += v
-            self._n += 1
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+            if labels:
+                key = tuple(sorted(labels.items()))
+                shard = self._shards.get(key)
+                if shard is None:
+                    shard = self._shards[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                shard[1] += v
+                shard[2] += 1
+                self._observe_into(shard[0], v)
+            else:
+                self._sum += v
+                self._n += 1
+                self._observe_into(self._counts, v)
+
+    def _render_series(self, out: list[str], counts: list, total_sum: float,
+                       n: int, lbl: str) -> None:
+        sep = "," if lbl else ""
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += counts[i]
+            out.append(f'{self.name}_bucket{{le="{b}"{sep}{lbl}}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"{sep}{lbl}}} {n}')
+        suffix = f"{{{lbl}}}" if lbl else ""
+        out.append(f"{self.name}_sum{suffix} {total_sum}")
+        out.append(f"{self.name}_count{suffix} {n}")
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        cum = 0
-        for i, b in enumerate(self.buckets):
-            cum += self._counts[i]
-            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self._n}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._n}")
+        with self._lock:
+            if self._n or not self._shards:
+                self._render_series(out, self._counts, self._sum, self._n, "")
+            for key in sorted(self._shards):
+                counts, s, n = self._shards[key]
+                self._render_series(out, counts, s, n, _fmt_labels(key))
         return out
 
 
@@ -147,8 +180,17 @@ class Registry:
                 for key, v in sorted(m._v.items()):
                     out.append((name, ",".join(f"{k}={val}" for k, val in key), v))
             else:
-                out.append((name + "_count", "", float(m._n)))
-                out.append((name + "_sum", "", m._sum))
+                # under the histogram's lock: observe() can insert a new
+                # label shard while a metrics reader iterates
+                with m._lock:
+                    if m._n or not m._shards:
+                        out.append((name + "_count", "", float(m._n)))
+                        out.append((name + "_sum", "", m._sum))
+                    for key in sorted(m._shards):
+                        _, s, n = m._shards[key]
+                        lbl = ",".join(f"{k}={val}" for k, val in key)
+                        out.append((name + "_count", lbl, float(n)))
+                        out.append((name + "_sum", lbl, s))
         return out
 
 
@@ -233,6 +275,7 @@ HISTORY = MetricsHistory(REGISTRY)
 
 # core series (ref: metrics/{session,executor,distsql,ddl}.go)
 QUERY_TOTAL = REGISTRY.counter("tidb_query_total", "queries by statement type and result")
+# also sharded per resource_group label (PR 5): per-group latency SLOs
 QUERY_DURATION = REGISTRY.histogram("tidb_query_duration_seconds", "statement wall time")
 COP_TASKS = REGISTRY.counter("tidb_cop_tasks_total", "coprocessor tasks by engine")
 TXN_TOTAL = REGISTRY.counter("tidb_txn_total", "transaction outcomes")
@@ -310,7 +353,15 @@ TPU_COMPILE_CACHE = REGISTRY.counter(
 TPU_TRANSFER_BYTES = REGISTRY.counter(
     "tidb_tpu_transfer_bytes_total", "host<->device transfer bytes by direction"
 )
+# also sharded per resource_group label (PR 5)
 TPU_EXECUTE_SECONDS = REGISTRY.histogram(
     "tidb_tpu_device_execute_seconds",
     "device execute+fetch wall time (dispatch to device_get completion)",
+)
+# grouped-launch h2d volume that statement memory tracking deliberately
+# charges to nobody (a neighbor's bytes must not draw the leader's quota
+# verdict) — surfaced here and as `shared_h2d` on the launch span (PR 5)
+TPU_SHARED_UPLOAD_BYTES = REGISTRY.counter(
+    "tidb_tpu_shared_upload_bytes_total",
+    "h2d bytes uploaded by grouped launches on behalf of the whole group",
 )

@@ -1,0 +1,201 @@
+"""Device timeline profiler — individually-timestamped phase events.
+
+PR 3's statement traces carried device phases as walls accumulated in a
+dict and synthesized back-to-back; tensor-runtime query engines need the
+real device timeline (arXiv:2203.01877 attributes latency to
+compile/transfer/kernel phases on it; arXiv:2604.28079 argues for
+per-launch, per-lane profiling). This module is that timeline: a bounded
+per-store ring (`Storage.timeline`, next to `trace_ring`) of events with
+`t_start_ns`/`t_end_ns` captured from ONE monotonic clock
+(`time.perf_counter_ns`) at the actual engine boundaries —
+first-dispatch compile, each h2d upload, each jitted dispatch, each d2h
+fetch (`copr/tpu_engine.py`) — and at the batcher's launch lifecycle
+(enqueue → leader-elected → flush → fan-out, `sched/batcher.py`).
+
+Lanes map to Chrome trace-event (pid, tid) pairs, loadable in Perfetto
+via `/debug/timeline` (or `chrome://tracing`):
+
+  * pid DEVICE — one tid per runner thread that touched the device.
+    Events within a runner tid are PROPERLY NESTED by construction (one
+    thread, one clock): phase events are pairwise disjoint, and a
+    grouped `cop.launch` — which occupies its runner lane exactly ONCE,
+    args carrying launch id, occupancy, shared-upload bytes and every
+    co-batched waiter's trace id — fully encloses the phase events its
+    thread recorded during the launch (rendered as a nested slice).
+    Partial overlap, which the Chrome format cannot represent on one
+    tid, never occurs.
+  * pid GROUPS — one tid per (resource group, thread): statement walls
+    and launch lifecycle events, clustered by the leading group name in
+    the UI. The thread split keeps concurrent same-group statements off
+    one tid (complete events on a tid must not partially overlap).
+
+Cross-thread plumbing mirrors `utils/tracing`: `bind()` attaches the
+store's ring (plus the statement's resource group) to the current thread
+for the duration of an engine call; the engine hooks read it from TLS,
+so the uninstrumented path costs one TLS miss. `SET GLOBAL
+tidb_enable_timeline` flips recording store-wide.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+_TLS = threading.local()
+
+# lane kinds → Chrome trace pids (process_name metadata at export)
+PID_DEVICE = 1
+PID_GROUPS = 2
+_PID_NAMES = {PID_DEVICE: "device", PID_GROUPS: "resource-groups"}
+
+
+class TimelineEvent:
+    """One timed operation on the device timeline. Timestamps are
+    absolute `time.perf_counter_ns()` readings — the ring's epoch (taken
+    from the same clock) rebases them for export."""
+
+    __slots__ = ("name", "cat", "t_start_ns", "t_end_ns", "pid", "lane", "args")
+
+    def __init__(self, name: str, cat: str, t_start_ns: int, t_end_ns: int,
+                 pid: int, lane: str, args: dict):
+        self.name = name
+        self.cat = cat
+        self.t_start_ns = t_start_ns
+        self.t_end_ns = t_end_ns
+        self.pid = pid  # PID_DEVICE | PID_GROUPS
+        self.lane = lane  # tid label: runner thread / resource group
+        self.args = args
+
+
+class TimelineRing:
+    """Bounded per-store timeline (the TIDB_TIMELINE memtable /
+    `/debug/timeline` backing store). Recording is O(1) append under one
+    lock; Chrome-trace rendering happens only when a reader asks."""
+
+    CAPACITY = 8192
+
+    def __init__(self, capacity: int | None = None):
+        self.epoch_ns = time.perf_counter_ns()  # the ONE monotonic clock
+        self.epoch_wall = time.time()
+        self.enabled = True  # SET GLOBAL tidb_enable_timeline
+        self._ring: deque[TimelineEvent] = deque(maxlen=capacity or self.CAPACITY)
+        self._lock = threading.Lock()
+
+    # --- recording ---------------------------------------------------------
+
+    def record(self, name: str, cat: str, t_start_ns: int, t_end_ns: int,
+               pid: int = PID_DEVICE, lane: str = "", **args) -> None:
+        if not self.enabled:
+            return
+        ev = TimelineEvent(name, cat, t_start_ns, t_end_ns, pid, lane, args)
+        with self._lock:
+            self._ring.append(ev)
+
+    def device_event(self, name: str, cat: str, t_start_ns: int, t_end_ns: int,
+                     **args) -> None:
+        """Record on the calling runner thread's device lane — per-runner
+        tids keep each device lane non-overlapping (one thread ⇒ events
+        close before the next opens)."""
+        self.record(name, cat, t_start_ns, t_end_ns,
+                    pid=PID_DEVICE, lane=threading.current_thread().name, **args)
+
+    # --- reading -----------------------------------------------------------
+
+    def snapshot(self) -> list[TimelineEvent]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (the Perfetto/about:tracing loadable
+        form): complete events (`ph: "X"`) with `ts`/`dur` in µs relative
+        to the ring epoch, plus process/thread name metadata so lanes
+        carry their labels in the UI."""
+        events = self.snapshot()
+        out: list[dict] = []
+        tids: dict[tuple[int, str], int] = {}
+        for pid, pname in _PID_NAMES.items():
+            out.append({"ph": "M", "pid": pid, "tid": 0,
+                        "name": "process_name", "args": {"name": pname}})
+        for ev in events:
+            key = (ev.pid, ev.lane)
+            tid = tids.get(key)
+            if tid is None:
+                tid = tids[key] = len([k for k in tids if k[0] == ev.pid]) + 1
+                out.append({"ph": "M", "pid": ev.pid, "tid": tid,
+                            "name": "thread_name", "args": {"name": ev.lane}})
+            out.append({
+                "ph": "X",
+                "pid": ev.pid,
+                "tid": tid,
+                "name": ev.name,
+                "cat": ev.cat,
+                "ts": (ev.t_start_ns - self.epoch_ns) / 1e3,
+                "dur": max(ev.t_end_ns - ev.t_start_ns, 0) / 1e3,
+                "args": dict(ev.args),
+            })
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def to_json(self) -> str:
+        return json.dumps(self.chrome_trace())
+
+
+# --- per-thread binding (set by the cop client around engine work) ---------
+
+
+class bind:
+    """Attach `ring` (may be None) and the statement's resource group to
+    the current thread for the duration of an engine call; the engine's
+    boundary hooks and the launch batcher read them from here."""
+
+    __slots__ = ("ring", "group", "prev")
+
+    def __init__(self, ring: TimelineRing | None, group: str = "default"):
+        self.ring = ring
+        self.group = group or "default"
+
+    def __enter__(self):
+        self.prev = getattr(_TLS, "tl", None)
+        _TLS.tl = (self.ring, self.group)
+        return self.ring
+
+    def __exit__(self, *exc):
+        _TLS.tl = self.prev
+        return False
+
+
+def active() -> TimelineRing | None:
+    """The bound ring, or None when unbound/disabled — the one check on
+    the uninstrumented fast path."""
+    t = getattr(_TLS, "tl", None)
+    if t is None or t[0] is None or not t[0].enabled:
+        return None
+    return t[0]
+
+
+def current_group() -> str:
+    t = getattr(_TLS, "tl", None)
+    return t[1] if t is not None else "default"
+
+
+def group_lane(group: str) -> str:
+    """Track label for resource-group events: one track per (group,
+    thread). Chrome complete events on one tid must never partially
+    overlap; one thread's events are sequential, so splitting the group's
+    lane by recording thread keeps every track well-formed while the
+    leading group name still clusters them in the Perfetto UI."""
+    return f"{group} ({threading.current_thread().name})"
+
+
+def group_event(name: str, cat: str, t_start_ns: int, t_end_ns: int, **args) -> None:
+    """Record on the bound statement's resource-group lane."""
+    t = getattr(_TLS, "tl", None)
+    if t is None or t[0] is None:
+        return
+    t[0].record(name, cat, t_start_ns, t_end_ns,
+                pid=PID_GROUPS, lane=group_lane(t[1]), **args)

@@ -43,6 +43,16 @@ def _next_id() -> int:
     return next(_IDS)
 
 
+_TXN_IDS = itertools.count(1)
+
+
+def new_txn_trace_id() -> str:
+    """Transaction-level trace id, minted at BEGIN and stamped on every
+    statement trace until COMMIT/ROLLBACK (the txn-linkage key of
+    TIDB_TRACE and the TRACE txn tree)."""
+    return f"txn-{next(_TXN_IDS):06x}"
+
+
 class Span:
     """One timed operation. `start_ns` is relative to the owning trace's
     epoch; ids are process-unique so a span fanned out into several traces
@@ -126,6 +136,9 @@ class StatementTrace:
 
     def __init__(self, sql: str = "", session_id: int = 0, recording: bool = False):
         self.trace_id = f"tr-{next(self._seq):06x}"
+        # statements inside one BEGIN…COMMIT share a txn_trace_id (the
+        # session threads it); None outside explicit transactions
+        self.txn_trace_id: str | None = None
         self.sql = sql
         self.session_id = session_id
         self.recording = recording
@@ -232,11 +245,19 @@ class StatementTrace:
 
     def add_phase_spans(self, phases: dict) -> None:
         """Record a solo launch's device phases (compile / h2d transfer /
-        execute+d2h) as spans under the calling thread's current span,
-        laid back-to-back ending now."""
-        if not self.recording or not phases:
+        execute+d2h) as spans under the calling thread's current span.
+        Frames carrying real boundary events (PhaseFrame.events) keep
+        their captured timestamps; a bare counters dict falls back to
+        back-to-back synthesis ending now."""
+        if not self.recording:
             return
-        spans = phase_spans(phases, self.current_parent(), self._now_ns())
+        events = getattr(phases, "events", None)
+        if events:
+            spans = real_phase_spans(events, self.current_parent(), self._epoch_ns)
+        elif phases:
+            spans = phase_spans(phases, self.current_parent(), self._now_ns())
+        else:
+            return
         with self._lock:
             self.spans.extend(spans)
 
@@ -259,6 +280,8 @@ class StatementTrace:
             spans = spans + list(extra)
         root = Span("session.execute", 0, self.duration_ns(),
                     parent_id=0, span_id=self.root_id)
+        if self.txn_trace_id:
+            root.tags["txn_trace_id"] = self.txn_trace_id
         by_parent: dict[int, list[Span]] = {}
         ids = {root.span_id} | {s.span_id for s in spans}
         for s in spans:
@@ -280,6 +303,7 @@ class StatementTrace:
             counters = dict(self.counters)
         return {
             "trace_id": self.trace_id,
+            "txn_trace_id": self.txn_trace_id,
             "session_id": self.session_id,
             "sql": self.sql[:512],
             "start_ts": self.start_ts,
@@ -319,14 +343,32 @@ def current_trace() -> StatementTrace | None:
 # --- device-phase collector (engine → whoever wrapped the launch) -----------
 
 
+class PhaseFrame(dict):
+    """One launch's device-phase measurements. The dict half is the PR 3
+    counters contract (compile_ms, h2d_bytes/ms, execute_ms, d2h_bytes —
+    what `phase_counters` folds into exec details); `events` carries the
+    PR 5 upgrade: individually-timestamped `(name, t_start_ns, t_end_ns,
+    tags)` boundary events from ONE monotonic clock
+    (`time.perf_counter_ns`), so trace spans show the REAL device
+    timeline instead of walls synthesized back-to-back. Code that hands
+    `_attribute`/`add_phase_spans` a plain dict (tests, external shims)
+    still works — it just falls back to synthesis."""
+
+    __slots__ = ("events",)
+
+    def __init__(self):
+        super().__init__()
+        self.events: list[tuple[str, int, int, dict]] = []
+
+
 def push_phases() -> tuple:
     prev = getattr(_TLS, "phases", None)
-    d: dict[str, float] = {}
+    d = PhaseFrame()
     _TLS.phases = d
     return prev, d
 
 
-def pop_phases(token: tuple) -> dict:
+def pop_phases(token: tuple) -> PhaseFrame:
     _TLS.phases = token[0]
     return token[1]
 
@@ -353,6 +395,30 @@ def add_phase(key: str, n: float) -> None:
         d[key] = d.get(key, 0.0) + n
 
 
+def add_phase_event(name: str, t_start_ns: int, t_end_ns: int, **tags) -> None:
+    """Record one individually-timestamped device boundary event
+    (compile / h2d upload / execute+fetch / cache ref) into the active
+    phase frame. Timestamps are absolute `time.perf_counter_ns` readings;
+    consumers rebase against their own epoch (trace or timeline ring) —
+    the clocks agree because there is only one."""
+    d = getattr(_TLS, "phases", None)
+    if d is not None:
+        ev = getattr(d, "events", None)
+        if ev is not None:
+            ev.append((name, t_start_ns, t_end_ns, tags))
+
+
+def real_phase_spans(events, parent_id: int, epoch_ns: int) -> list[Span]:
+    """Device-phase child spans from REAL captured timestamps: each
+    event's start rebases from the shared monotonic clock onto the
+    consuming trace's epoch — gaps between phases survive, nothing is
+    laid back-to-back."""
+    return [
+        Span(name, t0 - epoch_ns, t1 - t0, parent_id=parent_id, tags=dict(tags))
+        for name, t0, t1, tags in events
+    ]
+
+
 def phase_counters(phases: dict) -> list[tuple[str, float]]:
     """(exec-detail key, value) pairs for a launch's device phases — the
     ONE phase→counter mapping, shared by solo attribution
@@ -368,6 +434,10 @@ def phase_counters(phases: dict) -> list[tuple[str, float]]:
     dm = phases.get("execute_ms", 0.0) + phases.get("h2d_ms", 0.0)
     if dm:
         out.append(("device_ms", dm))
+    if phases.get("cache_ref_bytes"):
+        # device-cache hits: bytes SERVED from a prior statement's upload
+        # (zero-duration cache_ref annotation), never charged as transfer
+        out.append(("cache_ref_bytes", phases["cache_ref_bytes"]))
     return out
 
 
@@ -431,6 +501,12 @@ class TraceRing:
         with self._lock:
             traces = list(self._ring)
         return [t if isinstance(t, dict) else t.to_dict() for t in traces]
+
+    def items(self) -> list:
+        """The raw ring entries (live StatementTrace objects, unrendered)
+        — the TRACE txn-tree renderer walks these for same-txn siblings."""
+        with self._lock:
+            return list(self._ring)
 
     def clear(self) -> None:
         with self._lock:

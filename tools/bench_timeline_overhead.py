@@ -1,0 +1,46 @@
+"""Timeline-overhead gate (ISSUE 5 acceptance): the paired off/on
+statement bench (tools/paired_bench.py) with the device timeline
+profiler disabled (tidb_enable_timeline=OFF — the bare counters path)
+vs enabled (every engine-boundary and launch-lifecycle event recorded
+into the per-store ring). FAILS LOUDLY (non-zero exit) past GATE_PCT
+paired-median p50 and writes BENCH_timeline_pr5.json at the repo root.
+Standalone: `python tools/bench_timeline_overhead.py`.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.paired_bench import (  # noqa: E402
+    N_TASKS,
+    REPS,
+    ROWS_PER_TASK,
+    bench_main,
+    make_pt_session,
+    run_paired_bench,
+)
+
+
+def _set_mode(s, mode: str) -> None:
+    # the store-wide flag the sysvar handler flips (one ring per store)
+    s.store.timeline.enabled = mode == "on"
+
+
+def run_timeline_overhead_bench(n_tasks: int = N_TASKS, rows_per_task: int = ROWS_PER_TASK,
+                                reps: int = REPS) -> dict:
+    s = make_pt_session(n_tasks, rows_per_task)
+    return run_paired_bench(
+        s, _set_mode,
+        "bench_sched point-agg statements, timeline off vs on",
+        n_tasks=n_tasks, rows_per_task=rows_per_task, reps=reps,
+    )
+
+
+def main() -> int:
+    return bench_main(run_timeline_overhead_bench, "BENCH_timeline_pr5.json",
+                      "enabled-timeline")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2,5 +2,16 @@
 # Tier-1 verify — the ROADMAP.md command verbatim. Run from the repo root:
 #   bash tools/t1.sh
 # Exits non-zero on any test failure; prints DOTS_PASSED=<count> last.
+#
+#   bash tools/t1.sh --bench
+# additionally runs the overhead gates (paired off/on p50, ≤5%):
+#   tools/bench_trace_overhead.py    -> BENCH_trace_pr3.json
+#   tools/bench_watchdog_overhead.py -> BENCH_watchdog_pr4.json
+#   tools/bench_timeline_overhead.py -> BENCH_timeline_pr5.json
 cd "$(dirname "$0")/.." || exit 1
+if [ "$1" = "--bench" ]; then
+  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead; do
+    env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
+  done
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
