@@ -61,6 +61,17 @@ def _chaos(sess, rng):
     return lambda: sess.store.regions.chaos_step(rng)
 
 
+def _set_breakers(eng, threshold=None, cooldown_s=None):
+    """Breakers are per device lane since PR 6: chaos faults land on
+    whichever lane placement picked, so thresholds/cooldowns must be set
+    on every lane, not just lane 0."""
+    for lane in eng.lanes:
+        if threshold is not None:
+            lane.breaker.threshold = threshold
+        if cooldown_s is not None:
+            lane.breaker.cooldown_s = cooldown_s
+
+
 def _baseline(sess):
     base = {}
     for q in QUERIES:
@@ -112,7 +123,7 @@ class TestTransientDeviceFaults:
         retry counters in /metrics, and NO silent host fallbacks (the
         transient retry keeps the work on-device)."""
         base = _baseline(s)
-        s.cop.tpu.breaker.threshold = 1000  # isolate retries from the breaker
+        _set_breakers(s.cop.tpu, threshold=1000)  # isolate retries from the breakers
         fb0 = s.cop.stats["fallback_errors"]
         rt0 = s.cop.stats["retries"]
         FP.seed(31337)
@@ -127,7 +138,7 @@ class TestTransientDeviceFaults:
     def test_budget_exhaustion_fails_stream_with_named_error(self, s):
         """A task whose faults never stop exhausts its backoff budget and
         fails the stream with a typed error naming the attempt counts."""
-        s.cop.tpu.breaker.threshold = 10_000
+        _set_breakers(s.cop.tpu, threshold=10_000)
         s.vars["tidb_cop_engine"] = "tpu"
         FP.enable("cop/device-error", DeviceTransientError("permanently flaky"))
         with pytest.raises(BackoffExhausted) as ei:
@@ -153,7 +164,8 @@ class TestTransientDeviceFaults:
         with FP.enabled("cop/device-error", poison_first):
             with pytest.raises(DeviceFatalError):
                 s.must_query("SELECT g, COUNT(*), SUM(v) FROM t GROUP BY g ORDER BY g")
-        s.cop.tpu.breaker.record_success()  # clear the injected fault's count
+        for lane in s.cop.tpu.lanes:  # clear the injected fault's count
+            lane.breaker.record_success()
         s.vars["tidb_cop_engine"] = "auto"
         assert s.must_query("SELECT COUNT(*) FROM t") == [(str(ROWS),)]
 
@@ -196,7 +208,7 @@ class TestStreamLifecycle:
                                   [c.ft for c in visible], [c.id for c in visible]))
         prefix = tablecodec.record_prefix(info.id)
         tasks = s.cop.build_ranged_tasks([(prefix, prefix + b"\xff")])
-        s.cop.tpu.breaker.threshold = 10_000
+        _set_breakers(s.cop.tpu, threshold=10_000)
         abandon = threading.Event()
         done = {}
 
@@ -228,6 +240,10 @@ class TestBreakerProof:
         path comes back after the cooldown once the failpoint disarms."""
         base = _baseline(s)
         eng = s.cop.tpu
+        # pin the mesh to ONE lane: this test proves the single-breaker
+        # state machine economics (trip cap, freeze, probe recovery) —
+        # multi-lane isolation/reroute has its own suite below
+        eng.limit_lanes(1)
         eng.breaker.threshold = 3
         eng.breaker.cooldown_s = 0.3
         # arm the CLASS: every fault is a fresh instance (one shared
@@ -262,6 +278,7 @@ class TestBreakerProof:
 
     def test_explain_analyze_surfaces_breaker_and_retry(self, s):
         eng = s.cop.tpu
+        eng.limit_lanes(1)
         eng.breaker.threshold = 2
         eng.breaker.cooldown_s = 60.0
         with FP.enabled("cop/device-error", DeviceFatalError):
@@ -287,7 +304,7 @@ class TestCombinedChaos:
         simultaneously: the worst afternoon the substrate can legally
         have, and every answer still matches the calm run bit for bit."""
         base = _baseline(s)
-        s.cop.tpu.breaker.threshold = 1000
+        _set_breakers(s.cop.tpu, threshold=1000)
         s.vars["tidb_distsql_scan_concurrency"] = "6"
         FP.seed(424242)
         FP.enable("cop/device-error", ("prob", 0.25, DeviceTransientError("flaky tunnel")))

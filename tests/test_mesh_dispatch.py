@@ -1,0 +1,447 @@
+"""Mesh-wide cop dispatch (PR 6): per-device runner lanes, residency-aware
+placement (affinity / spill / breaker reroute), per-device circuit breaker
+isolation, the solo `cop.launch` timeline row, the timeline ring-capacity
+sysvar, Perfetto flow-event arrows, and the sorted-agg batcher fusion."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.utils import timeline as TL
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, g INT, v INT, f DOUBLE)")
+    sess.execute(
+        "INSERT INTO t VALUES "
+        + ",".join(f"({i}, {i % 7}, {i * 3 % 101}, {(i % 13) * 0.5})" for i in range(4096))
+    )
+    sess.vars["tidb_cop_engine"] = "tpu"
+    sess.vars["tidb_enable_cop_result_cache"] = "OFF"
+    return sess
+
+
+def _pairs(sess, queries):
+    ctl = sess.store.sched
+    pairs = []
+    real = ctl.batcher.execute
+
+    def capture(engine, dag, batch, **kw):
+        pairs.append((dag, batch))
+        return real(engine, dag, batch, **kw)
+
+    ctl.batcher.execute = capture
+    try:
+        for q in queries:
+            sess.must_query(q)
+    finally:
+        ctl.batcher.execute = real
+    assert pairs, "queries never reached the device path"
+    return pairs
+
+
+def _force_open(lane, cooldown_s: float = 3600.0):
+    lane.breaker.cooldown_s = cooldown_s
+    lane.breaker.state = "open"
+    lane.breaker._opened_at = time.monotonic()
+
+
+def _chunks_equal(a, b) -> bool:
+    if a.num_cols != b.num_cols or a.num_rows != b.num_rows:
+        return False
+    return all(
+        np.array_equal(ca.data, cb.data) and np.array_equal(ca.valid, cb.valid)
+        for ca, cb in zip(a.columns, b.columns)
+    )
+
+
+class TestPlacement:
+    def test_mesh_has_one_lane_per_device(self, s):
+        import jax
+
+        eng = s.store.sched.tpu_engine
+        assert len(eng.lanes) == len(jax.devices()) == 8
+        assert len({l.name for l in eng.lanes}) == 8
+        assert len({id(l.breaker) for l in eng.lanes}) == 8
+
+    def test_residency_affinity_same_batch_relands_on_its_device(self, s):
+        eng = s.store.sched.tpu_engine
+        (dag, batch) = _pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        assert batch._mirrors, "query left no device mirror"
+        first = eng.place(batch)
+        eng.release_lane(first)
+        assert first.idx in batch._mirrors
+        for _ in range(5):
+            lane = eng.place(batch)
+            eng.release_lane(lane)
+            assert lane is first, "resident batch moved off its device unloaded"
+
+    def test_spill_to_idle_lane_under_load(self, s):
+        eng = s.store.sched.tpu_engine
+        (dag, batch) = _pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        resident = eng.place(batch)  # occupancy 1 on the resident lane
+        try:
+            bumps = []
+            counted = {}
+            # affinity holds up to fair share + SPILL_SLACK (same-program
+            # tasks piling on one lane coalesce — cheap), then spills to
+            # an idle sibling (a deep queue of work beats a fresh upload)
+            for _ in range(int(eng.SPILL_SLACK) + 1):
+                extra = eng.place(batch)
+                bumps.append(extra)
+                assert extra is resident
+            spilled = eng.place(
+                batch, stats=lambda k, n=1: counted.__setitem__(k, counted.get(k, 0) + n)
+            )
+            bumps.append(spilled)
+            assert spilled is not resident, "no spill despite idle siblings"
+            assert spilled.occupancy == 1
+            assert counted.get("lane_spills") == 1
+        finally:
+            for l in bumps:
+                eng.release_lane(l)
+            eng.release_lane(resident)
+
+    def test_open_breaker_reroutes_placement_to_sibling(self, s):
+        eng = s.store.sched.tpu_engine
+        (dag, batch) = _pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        resident = eng.place(batch)
+        eng.release_lane(resident)
+        _force_open(resident)
+        counted = {}
+        lane = eng.place(
+            batch, gate_breakers=True,
+            stats=lambda k, n=1: counted.__setitem__(k, counted.get(k, 0) + n),
+        )
+        try:
+            assert lane is not None and lane is not resident
+            assert counted.get("lane_reroutes") == 1
+        finally:
+            eng.release_lane(lane)
+
+    def test_every_breaker_open_places_nothing(self, s):
+        eng = s.store.sched.tpu_engine
+        (dag, batch) = _pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+        for lane in eng.lanes:
+            _force_open(lane)
+        assert eng.place(batch, gate_breakers=True) is None
+        # ungated placement (direct engine callers) still works
+        lane = eng.place(batch)
+        assert lane is not None
+        eng.release_lane(lane)
+
+
+class TestBreakerIsolation:
+    def test_one_lane_trip_leaves_siblings_closed(self, s):
+        from tidb_tpu.errors import DeviceFatalError
+
+        eng = s.store.sched.tpu_engine
+        victim = eng.lanes[3]
+        victim.breaker.threshold = 2
+        for _ in range(2):
+            victim.breaker.record_failure(DeviceFatalError("boom"))
+        assert victim.breaker.state == "open"
+        assert all(
+            l.breaker.state == "closed" for l in eng.lanes if l is not victim
+        ), "a single lane's trip opened sibling breakers"
+
+    def test_forced_open_lane_tasks_reroute_to_siblings_not_host(self, s):
+        """Acceptance: one device's breaker forced open — its tasks land
+        on sibling DEVICES (tpu counters move, host counters do not), the
+        open lane launches nothing, and results stay bit-identical."""
+        eng = s.store.sched.tpu_engine
+        q = "SELECT g, COUNT(*), SUM(v), MIN(v), MAX(v) FROM t GROUP BY g ORDER BY g"
+        base = s.must_query(q)
+        resident = {
+            idx
+            for b in s.cop.tiles._cache.values()
+            for idx in (getattr(b, "_mirrors", None) or {})
+        }
+        assert resident, "warm query left no device residency"
+        victim = eng.lanes[next(iter(resident))]
+        _force_open(victim)
+        launches0 = victim.launches
+        t0, h0 = s.cop.stats["tpu_tasks"], s.cop.stats["host_tasks"]
+        r0 = s.cop.stats["lane_reroutes"]
+        for _ in range(3):
+            assert s.must_query(q) == base
+        assert s.cop.stats["tpu_tasks"] > t0, "rerouted tasks left the device path"
+        assert s.cop.stats["host_tasks"] == h0, "open lane drained to host, not siblings"
+        assert s.cop.stats["lane_reroutes"] > r0
+        assert victim.launches == launches0, "the open lane still launched"
+        # sibling residency was built by the reroute
+        resident_now = {
+            idx
+            for b in s.cop.tiles._cache.values()
+            for idx in (getattr(b, "_mirrors", None) or {})
+        }
+        assert resident_now - {victim.idx}, "no sibling mirror after reroute"
+
+    def test_forced_tpu_raises_only_when_every_lane_is_open(self, s):
+        from tidb_tpu.errors import CircuitBreakerOpen
+
+        eng = s.store.sched.tpu_engine
+        q = "SELECT COUNT(*) FROM t"
+        base = s.must_query(q)
+        for lane in eng.lanes[:-1]:
+            _force_open(lane)
+        assert s.must_query(q) == base  # one healthy lane is enough
+        _force_open(eng.lanes[-1])
+        with pytest.raises(CircuitBreakerOpen, match="state=open"):
+            s.must_query(q)
+        s.vars["tidb_cop_engine"] = "auto"
+        b0 = s.cop.stats["breaker_skips"]
+        assert s.must_query(q) == base  # auto: host at zero exception cost
+        assert s.cop.stats["breaker_skips"] > b0
+
+
+class TestSoloLaunchTimeline:
+    def test_solo_dispatch_emits_cop_launch_row(self, s):
+        """PR 5 leftover: a solo (non-grouped) launch gets a `cop.launch`
+        lifecycle row on its device lane, enclosing its phase events."""
+        ring = s.store.timeline
+        ring.clear()
+        s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        launches = [e for e in ring.snapshot() if e.name == "cop.launch"]
+        assert launches, "solo dispatch left no cop.launch row"
+        ev = launches[0]
+        assert ev.args["occupancy"] == 1
+        assert ev.args["device"] == ev.lane  # recorded on the REAL device lane
+        phases = [
+            e for e in ring.snapshot()
+            if e.pid == TL.PID_DEVICE and e.lane == ev.lane and e.name != "cop.launch"
+        ]
+        assert phases, "no phase events under the launch"
+        assert all(
+            ev.t_start_ns <= p.t_start_ns and p.t_end_ns <= ev.t_end_ns
+            for p in phases
+        ), "cop.launch does not enclose its phases"
+
+
+class TestTimelineRingCapacitySysvar:
+    def test_live_resize_keeps_newest(self, s):
+        ring = s.store.timeline
+        assert ring.capacity == 8192
+        ring.clear()
+        for i in range(300):
+            ring.record("ev", "t", i, i + 1, trace_seq=i)
+        s.execute("SET GLOBAL tidb_timeline_ring_capacity = 256")
+        try:
+            assert ring.capacity == 256
+            evs = ring.snapshot()
+            assert len(evs) <= 256  # the SET statement itself records too
+            seqs = [e.args["trace_seq"] for e in evs if e.name == "ev"]
+            assert seqs[-1] == 299  # newest kept
+            assert seqs[0] >= 44  # oldest dropped
+            s.must_query("SELECT COUNT(*) FROM t")
+            assert len(ring.snapshot()) <= 256
+        finally:
+            s.execute("SET GLOBAL tidb_timeline_ring_capacity = 8192")
+        assert ring.capacity == 8192
+
+    def test_session_scope_rejected(self, s):
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("SET tidb_timeline_ring_capacity = 128")
+
+
+class TestPerfettoFlowEvents:
+    def test_launch_waiter_arrows_in_chrome_trace(self, s):
+        """A grouped cop.launch's waiter references become flow-event
+        arrows: one s/f pair per (launch, waiter statement) edge, ids
+        unique per edge, finish bound inside the statement slice."""
+        ring = s.store.timeline
+        ring.clear()
+        now = time.perf_counter_ns()
+        ring.record("statement", "statement", now + 1000, now + 9000,
+                    pid=TL.PID_GROUPS, lane="default (w1)", trace_id="tr-aaa")
+        ring.record("statement", "statement", now + 1100, now + 9100,
+                    pid=TL.PID_GROUPS, lane="default (w2)", trace_id="tr-bbb")
+        ring.record("cop.launch", "launch", now + 2000, now + 5000,
+                    pid=TL.PID_DEVICE, lane="cpu:2", launch_id=77,
+                    occupancy=2, waiters=["tr-aaa", "tr-bbb", "tr-gone"])
+        doc = ring.chrome_trace()
+        starts = [e for e in doc["traceEvents"] if e["ph"] == "s"]
+        finishes = [e for e in doc["traceEvents"] if e["ph"] == "f"]
+        assert len(starts) == 2 and len(finishes) == 2  # tr-gone skipped
+        assert {e["id"] for e in starts} == {"77/tr-aaa", "77/tr-bbb"}
+        assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+        by_id = {e["id"]: e for e in finishes}
+        stmt_x = [e for e in doc["traceEvents"]
+                  if e["ph"] == "X" and e["name"] == "statement"]
+        for st in stmt_x:
+            fid = f"77/{st['args']['trace_id']}"
+            f = by_id[fid]
+            assert f["pid"] == st["pid"] and f["tid"] == st["tid"]
+            assert st["ts"] <= f["ts"] <= st["ts"] + st["dur"]
+        assert all(e["pid"] == TL.PID_DEVICE for e in starts)
+        assert all(f.get("bp") == "e" for f in finishes)
+
+    def test_end_to_end_grouped_launch_produces_arrows(self, s):
+        ctl = s.store.sched
+        ring = s.store.timeline
+        old_window = ctl.batcher.WINDOW_S
+        ctl.batcher.WINDOW_S = 0.05
+        sessions = [Session(s.store) for _ in range(3)]
+        for sess in sessions:
+            sess.vars["tidb_cop_engine"] = "tpu"
+            sess.vars["tidb_enable_cop_result_cache"] = "OFF"
+        q = "SELECT g, SUM(v) FROM t GROUP BY g"
+        s.must_query(q)  # warm
+        try:
+            for _ in range(5):
+                ring.clear()
+                barrier = threading.Barrier(len(sessions))
+
+                def run(sess):
+                    barrier.wait()
+                    sess.must_query(q)
+
+                threads = [threading.Thread(target=run, args=(x,)) for x in sessions]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join(timeout=60)
+                doc = ring.chrome_trace()
+                flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+                if flows:
+                    assert any(e["ph"] == "s" for e in flows)
+                    assert any(e["ph"] == "f" for e in flows)
+                    return
+            pytest.fail("no flow arrows from 5 grouped-launch attempts")
+        finally:
+            ctl.batcher.WINDOW_S = old_window
+
+
+class TestSortedAggFusion:
+    """The high-NDV (sorted) agg path joins the batcher: its plans carry
+    (key, args) and fuse into vmapped group launches like every other
+    cop task (standing sched/ gap from PR 1)."""
+
+    def test_sorted_agg_plan_is_fusable(self, s):
+        from tidb_tpu.copr.tpu_engine import DevicePlan
+
+        eng = s.store.sched.tpu_engine
+        # float GROUP BY key forces the sorted path regardless of NDV
+        (dag, batch) = _pairs(s, ["SELECT f, COUNT(*), SUM(v) FROM t GROUP BY f"])[0]
+        plan = eng._plan_for(dag, batch)
+        assert isinstance(plan, DevicePlan)
+        assert plan.key is not None and plan.args is not None
+
+    def test_sorted_agg_group_launch_bit_identical(self, s):
+        eng = s.store.sched.tpu_engine
+        pairs = _pairs(s, [
+            "SELECT f, COUNT(*), SUM(v) FROM t WHERE id < 2048 GROUP BY f",
+            "SELECT f, COUNT(*), SUM(v) FROM t WHERE id >= 2048 GROUP BY f",
+        ])
+        serial = [eng.execute(dag, batch) for dag, batch in pairs]
+        fused = eng.execute_many(pairs)
+        for a, b in zip(fused, serial):
+            assert _chunks_equal(a, b), "fused sorted-agg differs from serial"
+
+    def test_sorted_agg_capacity_escalation_through_finalize(self, s):
+        eng = s.store.sched.tpu_engine
+        # float key → sorted path; 13 distinct f values overflow gcap0=4,
+        # so finalize must detect ng > cap from the fetched scalar and
+        # re-run escalated
+        eng.gcap0 = 4
+        try:
+            rows = s.must_query(
+                "SELECT f, COUNT(*) FROM t GROUP BY f ORDER BY f"
+            )
+            s.vars["tidb_cop_engine"] = "host"
+            expect = s.must_query(
+                "SELECT f, COUNT(*) FROM t GROUP BY f ORDER BY f"
+            )
+            assert rows == expect and len(rows) == 13
+        finally:
+            eng.gcap0 = 1 << 16
+            s.vars["tidb_cop_engine"] = "tpu"
+
+    def test_sorted_agg_concurrent_tasks_coalesce(self, s):
+        from tidb_tpu.utils import metrics as M
+
+        ctl = s.store.sched
+        eng = ctl.tpu_engine
+        # ONE (dag, batch): residency affinity lands every submitter on
+        # the resident lane, where same-program tasks coalesce (sibling
+        # tasks over different batches spread across lanes instead — the
+        # mesh tradeoff)
+        (dag, batch) = _pairs(s, ["SELECT f, SUM(v) FROM t GROUP BY f"])[0]
+        serial = eng.execute(dag, batch)
+        n_threads = 4
+        for _ in range(5):
+            n0, sum0 = M.SCHED_BATCH_OCCUPANCY._n, M.SCHED_BATCH_OCCUPANCY._sum
+            barrier = threading.Barrier(n_threads)
+            results = [None] * n_threads
+
+            def run(i):
+                barrier.wait()
+                results[i] = ctl.batcher.execute(eng, dag, batch)
+
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(n_threads)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=60)
+            assert all(_chunks_equal(r, serial) for r in results)
+            groups = M.SCHED_BATCH_OCCUPANCY._n - n0
+            if groups and (M.SCHED_BATCH_OCCUPANCY._sum - sum0) > groups:
+                return  # a multi-task sorted-agg launch formed
+        pytest.fail("sorted-agg tasks never coalesced in 5 attempts")
+
+
+class TestDispatchWidthSysvar:
+    def test_cop_lanes_narrows_and_restores(self, s):
+        eng = s.store.sched.tpu_engine
+        assert len(eng.lanes) == 8
+        s.execute("SET GLOBAL tidb_tpu_cop_lanes = 2")
+        try:
+            assert len(eng.lanes) == 2
+            (dag, batch) = _pairs(s, ["SELECT g, SUM(v) FROM t GROUP BY g"])[0]
+            lane = eng.place(batch)
+            assert lane.idx < 2
+            eng.release_lane(lane)
+        finally:
+            s.execute("SET GLOBAL tidb_tpu_cop_lanes = 0")
+        assert len(eng.lanes) == 8
+
+    def test_session_scope_rejected(self, s):
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("SET tidb_tpu_cop_lanes = 1")
+
+
+class TestMeshExplain:
+    def test_explain_analyze_device_line_carries_lanes(self, s):
+        lines = [r[0] for r in s.must_query(
+            "EXPLAIN ANALYZE SELECT g, SUM(v) FROM t GROUP BY g"
+        )]
+        dev = next(l for l in lines if l.startswith("device:"))
+        assert "lanes:8" in dev and "reroutes:" in dev and "spills:" in dev
+        tpu = next(l for l in lines if l.startswith("tpu:"))
+        assert "breaker:closed" in tpu
+
+    def test_lane_metrics_series_render(self, s):
+        from tidb_tpu.utils.metrics import REGISTRY
+
+        s.must_query("SELECT g, SUM(v) FROM t GROUP BY g")
+        body = REGISTRY.render()
+        assert "tidb_tpu_lane_occupancy" in body
+        assert "tidb_tpu_lane_launch_total" in body

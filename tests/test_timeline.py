@@ -271,7 +271,7 @@ class TestUploadAttribution:
             ctl.batcher.execute = real
         assert pairs
         dag, batch = pairs[0]
-        batch._device = None  # fresh mirror: the GROUP pays the uploads
+        batch._mirrors = None  # fresh mirrors: the GROUP pays the uploads
         j1 = _Job(dag, batch, None, client=s.cop)
         j2 = _Job(dag, batch, None, client=s.cop)
         group = _Group()

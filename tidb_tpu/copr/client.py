@@ -147,6 +147,11 @@ class CopClient:
             # shared uploads performed on behalf of the whole group
             "cache_ref_bytes": 0,
             "shared_h2d_bytes": 0,
+            # mesh-placement counters (PR 6): tasks moved OFF their
+            # resident device lane — by an open breaker (reroute to a
+            # sibling, not host) or by load (spill to an idle lane)
+            "lane_reroutes": 0,
+            "lane_spills": 0,
             # memory-arbitration + runaway counters (PR 4)
             "mem_degraded_tasks": 0,
             "processed_rows": 0,
@@ -582,26 +587,41 @@ class CopClient:
                 try:
                     _fp("sched/engine-stall")
                     if engine in ("tpu", "auto"):
-                        breaker = self.tpu.breaker
-                        if not breaker.allow():
-                            # open breaker: 'auto' routes host at zero exception
-                            # cost; forced 'tpu' fails fast with the state
+                        # per-device placement (PR 6): pick the runner lane
+                        # by residency/occupancy, skipping lanes whose
+                        # breaker rejects — an open breaker drains only its
+                        # own lane, `auto` traffic reroutes to siblings and
+                        # only falls to host when EVERY lane refuses.
+                        # Breaker outcomes are recorded on the lane that
+                        # actually ran the task.
+                        lane = self.tpu.place(
+                            batch, sched=ctl, gate_breakers=True, stats=st
+                        )
+                        if lane is None:
+                            # every device lane's breaker is open: 'auto'
+                            # routes host at zero exception cost; forced
+                            # 'tpu' fails fast with the states
                             if engine == "tpu":
-                                breaker.raise_open()
+                                self.tpu.raise_breakers_open()
                             st("breaker_skips")
                             if trace is not None and trace.recording:
-                                trace.closed_span("breaker.skip", 0.0, state=breaker.state)
+                                trace.closed_span(
+                                    "breaker.skip", 0.0,
+                                    state=self.tpu.breakers_describe(),
+                                )
                         else:
+                            breaker = lane.breaker
                             try:
                                 _fp("cop/device-error")
+                                _fp(f"cop/lane{lane.idx}/device-error")
                                 with tracing.collect_phases() as ph:
                                     if ctl is not None:
                                         chunk = ctl.batcher.execute(
                                             self.tpu, dag, batch, dedup_key=dedup,
-                                            stats=st, client=self,
+                                            stats=st, client=self, lane=lane,
                                         )
                                     else:
-                                        chunk = self.tpu.execute(dag, batch)
+                                        chunk = self.tpu.execute(dag, batch, lane=lane)
                             except Exception as exc:
                                 err = classify_device_error(exc)
                                 if err is None:
@@ -614,10 +634,14 @@ class CopClient:
                                 if isinstance(err, DeviceTransientError) and not tripped:
                                     # release the device slot while sleeping so
                                     # backoff never holds admission capacity,
-                                    # then retry the device path
+                                    # then retry the device path (the retry
+                                    # re-places: a lane tripped meanwhile is
+                                    # skipped, its tasks land on siblings)
                                     if ticket is not None:
                                         ctl.scheduler.release(ticket)
                                         ticket = None
+                                    self.tpu.release_lane(lane)
+                                    lane = None
                                     try:
                                         bo.backoff(BO_DEVICE, err)
                                     except BackoffExhausted as bex:
@@ -643,6 +667,9 @@ class CopClient:
                                 st("tpu_tasks")
                                 self._note_device_phases(ph, st, trace)
                                 return chunk
+                            finally:
+                                if lane is not None:
+                                    self.tpu.release_lane(lane)
                     t0 = time.perf_counter()
                     chunk = execute_dag_host(dag, batch)
                     host_s = time.perf_counter() - t0

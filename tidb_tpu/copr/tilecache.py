@@ -227,12 +227,13 @@ class TileCache:
 
     def evict_all(self) -> None:
         """Server soft-memory-limit action (utils/memory ServerMemTracker):
-        drop every cached column batch AND its device mirror — the tile
-        cache and the DeviceBatch uploads hanging off it are the store's
-        biggest reclaimable pools. Batches still referenced by in-flight
-        tasks keep working; only the cache lets go."""
+        drop every cached column batch AND its device mirrors — the tile
+        cache and the per-device DeviceBatch uploads hanging off it (the
+        residency index placement routes by) are the store's biggest
+        reclaimable pools. Batches still referenced by in-flight tasks
+        keep working; only the cache lets go."""
         with self._lock:
             for b in self._cache.values():
-                if getattr(b, "_device", None) is not None:
-                    b._device = None
+                if getattr(b, "_mirrors", None) is not None:
+                    b._mirrors = None
             self._cache.clear()

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import bisect
 import time
-from threading import Lock
+from threading import Lock, RLock
 
 import numpy as np
 
@@ -86,16 +86,19 @@ class _Timed:
         return out
 
 
-def _to_device(a: np.ndarray):
+def _to_device(a: np.ndarray, device=None):
     """Host→device upload with transfer accounting (the h2d half of
     tidb_tpu_transfer_bytes_total and the trace's device.transfer phase).
+    With `device` the array is COMMITTED to that mesh device — jit
+    follows committed inputs, so pinning the uploads is what pins the
+    whole launch to its runner lane (PR 6 per-device dispatch).
     The bytes also consume into the bound statement MemTracker — device
     allocations were invisible to memory quotas before PR 4 — so the
     consume can raise the quota/server-limit error right at the
     allocation site (a real allocation failure, never a device fault)."""
     _mem.consume_current(a.nbytes)
     t0 = time.perf_counter_ns()
-    out = jnp.asarray(a)
+    out = jnp.asarray(a) if device is None else jax.device_put(a, device)
     t1 = time.perf_counter_ns()
     M.TPU_TRANSFER_BYTES.inc(a.nbytes, dir="h2d")
     tracing.add_phase("h2d_bytes", a.nbytes)
@@ -253,10 +256,14 @@ def _dict_encode_lane(d: np.ndarray, v: np.ndarray, coll: str = "utf8mb4_bin"):
 
 
 class DeviceBatch:
-    """Device-resident mirror of a ColumnBatch: [T, R] lanes per column."""
+    """Device-resident mirror of a ColumnBatch: [T, R] lanes per column,
+    committed to ONE mesh device (`device`) — the residency unit the
+    placement policy routes by (a cached upload stays hot on the device
+    that owns it; a spill builds a second mirror on a sibling)."""
 
-    def __init__(self, batch: ColumnBatch):
+    def __init__(self, batch: ColumnBatch, device=None):
         self.batch = batch
+        self.device = device
         n = batch.n_rows
         self.t = max((n + TILE_ROWS - 1) // TILE_ROWS, 1)
         self.padded = self.t * TILE_ROWS
@@ -269,7 +276,7 @@ class DeviceBatch:
         self.upload_ids: dict[int, tuple[int, int]] = {}
         rv = np.zeros(self.padded, dtype=bool)
         rv[:n] = True
-        self.row_valid = _to_device(rv.reshape(self.t, TILE_ROWS))
+        self.row_valid = _to_device(rv.reshape(self.t, TILE_ROWS), device)
 
     def _pad2d(self, a: np.ndarray):
         out = np.zeros(self.padded, dtype=a.dtype)
@@ -290,8 +297,8 @@ class DeviceBatch:
                 codes, vocab = _dict_encode_lane(d, v, coll)
                 self.vocabs[off] = vocab
                 d = codes
-            self._data[off] = _to_device(self._pad2d(d))
-            self._valid[off] = _to_device(self._pad2d(v))
+            self._data[off] = _to_device(self._pad2d(d), self.device)
+            self._valid[off] = _to_device(self._pad2d(v), self.device)
             self.upload_ids[off] = (
                 tracing._next_id(),
                 int(self._data[off].nbytes) + int(self._valid[off].nbytes),
@@ -338,8 +345,59 @@ class DevicePlan:
         self.rows = rows  # real (unpadded) row count of the batch
 
 
+class DeviceLane:
+    """One cop runner lane per mesh device: the device handle, its OWN
+    circuit breaker (an open breaker drains only this lane), a launch
+    lock serializing device work (and keeping the lane's timeline tid
+    free of partial overlap), and an in-flight occupancy counter the
+    placement policy balances on. Occupancy is guarded by the engine's
+    placement lock, not per-lane — choose-and-bump must be atomic across
+    lanes or a concurrent burst all picks the same idle lane."""
+
+    __slots__ = ("idx", "device", "name", "breaker", "lock", "occupancy",
+                 "launches")
+
+    def __init__(self, idx: int, device, breaker):
+        self.idx = idx
+        self.device = device
+        plat = getattr(device, "platform", None) or "dev"
+        self.name = f"{plat}:{getattr(device, 'id', idx)}"
+        self.breaker = breaker
+        self.lock = RLock()
+        self.occupancy = 0  # placed-but-unfinished tasks (queued + running)
+        self.launches = 0
+
+
+class _lane_guard:
+    """Exclusive use of one device lane for a launch: the lane's launch
+    lock plus the timeline device-lane binding. Re-entrant — the batcher
+    guards around `execute_many`, which guards again internally."""
+
+    __slots__ = ("lane", "_scope")
+
+    def __init__(self, lane: DeviceLane):
+        self.lane = lane
+
+    def __enter__(self):
+        self.lane.lock.acquire()
+        self._scope = TL.device_scope(self.lane.name)
+        self._scope.__enter__()
+        return self.lane
+
+    def __exit__(self, *exc):
+        self._scope.__exit__(*exc)
+        self.lane.lock.release()
+        return False
+
+
 class TPUEngine:
     MAX_FUSE = 64  # largest vmapped launch group (and largest size bucket)
+    # resident-lane queue depth beyond the fair mesh share before a task
+    # spills off its resident device: slack matters because same-program
+    # tasks piling on one lane COALESCE into one launch (free), while a
+    # spill pays a fresh h2d mirror — only a genuinely deep queue of
+    # other work justifies that
+    SPILL_SLACK = 3
 
     def __init__(self):
         from .retry import CircuitBreaker
@@ -352,10 +410,157 @@ class TPUEngine:
         self._lock = Lock()  # cop pool workers share this engine
         self.compile_count = 0
         self.fallbacks = 0
-        # device-fault circuit breaker (copr/retry.py): the cop client
-        # records successes/faults at the engine boundary; a store's whole
-        # auto traffic routes host while open
-        self.breaker = CircuitBreaker()
+        # per-DEVICE runner lanes (PR 6): every mesh device gets its own
+        # queue position, circuit breaker and timeline lane; the cop
+        # client records successes/faults on the lane that ran the task,
+        # and an open breaker drains only that lane (`auto` reroutes its
+        # tasks to sibling devices before ever falling back to host)
+        try:
+            devices = list(jax.devices())
+        except Exception:  # noqa: BLE001 — broken backend: one host-side lane
+            devices = [None]
+        # one unique prefix per engine instance: two stores in one
+        # process must not clobber each other's breaker series (the
+        # retry.py label invariant), so lane labels are engine-scoped
+        eid = f"e{next(CircuitBreaker._seq)}"
+        self._all_lanes = [
+            DeviceLane(i, d, CircuitBreaker(
+                label=f"{eid}/{getattr(d, 'platform', None) or 'dev'}"
+                      f":{getattr(d, 'id', i)}"
+            ))
+            for i, d in enumerate(devices)
+        ]
+        self.lanes = list(self._all_lanes)
+        self._place_lock = Lock()  # atomic choose-and-bump across lanes
+        # device-aware residency index, keyed by batch CONTENT (table,
+        # span, version) rather than object identity: CopClients are
+        # per-session, so the same region's batch is a different object
+        # in every session — content routing is what lands cross-session
+        # same-snapshot tasks on one lane where they can coalesce. A
+        # stale entry (mirror evicted) merely routes to a lane that
+        # re-uploads; correctness never depends on this index.
+        self._residency: dict[tuple, set] = {}
+
+    @staticmethod
+    def _residency_key(batch) -> tuple:
+        t = getattr(batch, "table", None)
+        return (
+            getattr(t, "id", None),
+            getattr(batch, "start", b""),
+            getattr(batch, "end", b""),
+            getattr(batch, "version", None),
+            batch.n_rows,
+        )
+
+    # --- per-device placement ----------------------------------------------
+
+    @property
+    def breaker(self):
+        """Lane 0's breaker — the single-device view. Chaos/bench code
+        that wants the old one-breaker-per-engine economics pins the mesh
+        first with `limit_lanes(1)`; multi-lane callers use `lanes`."""
+        return self.lanes[0].breaker
+
+    def set_active_lanes(self, n: int) -> None:
+        """Dispatch width (`SET GLOBAL tidb_tpu_cop_lanes`): route cop
+        tasks over only the first `n` mesh devices; 0 = every device.
+        The serving knob for hosts whose backend SERIALIZES executions
+        across in-process devices (the CPU test box — see the mesh
+        bench's `overlap_x` probe): there, fanning a burst out pays
+        per-launch overhead with no parallel silicon behind it, and
+        width 1 recovers full cross-session coalescing. Real multi-chip
+        meshes want the full width."""
+        n = int(n)
+        if n <= 0 or n > len(self._all_lanes):
+            n = len(self._all_lanes)
+        self.lanes = self._all_lanes[:n]
+
+    def limit_lanes(self, n: int) -> None:
+        """Test/bench hook: SHRINK the dispatch width to at most `n`
+        lanes (n=1 reproduces the pre-mesh single-lane engine exactly).
+        Unlike set_active_lanes, never widens."""
+        self.set_active_lanes(min(max(1, n), len(self.lanes)))
+
+    def place(self, batch: ColumnBatch, sched=None, gate_breakers: bool = False,
+              stats=None) -> DeviceLane | None:
+        """Choose the runner lane for one cop task and bump its occupancy
+        (caller MUST `release_lane` when the task leaves the lane).
+
+        Policy, in order:
+          * residency affinity — a batch with a DeviceBatch mirror stays
+            on the device that owns the upload (no fresh h2d);
+          * spill — when the resident lane is oversubscribed relative to
+            the admission load (`Storage.sched`'s running+queued tasks
+            spread fairly over the mesh) AND an idle sibling exists, the
+            task spills to the least-occupied lane and pays a second
+            mirror there — latency under load beats upload thrift;
+          * breaker gating (`gate_breakers`, the cop-client path) — lanes
+            whose breaker rejects are skipped, so an open breaker drains
+            only its own lane and `auto` traffic reroutes to siblings;
+            None only when EVERY lane refuses (then: host / raise).
+        """
+        lanes = self.lanes
+        mirrors = getattr(batch, "_mirrors", None) or {}
+        rkey = self._residency_key(batch)
+        with self._place_lock:
+            res_idx = set(mirrors) | (self._residency.get(rkey) or set())
+            order: list[DeviceLane] = []
+            resident = [l for l in lanes if l.idx in res_idx]
+            spilled = False
+            if resident:
+                r = min(resident, key=lambda l: l.occupancy)
+                load = 0
+                if sched is not None:
+                    sc = getattr(sched, "scheduler", None)
+                    if sc is not None:
+                        load = sc.running() + sc.queue_depth()
+                fair = max(1.0, load / len(lanes))
+                if r.occupancy > fair + self.SPILL_SLACK and any(
+                    l.occupancy == 0 for l in lanes if l is not r
+                ):
+                    spilled = True  # deeply oversubscribed + an idle sibling
+                else:
+                    order.append(r)
+            chosen_first = order[0] if order else None
+            order += sorted(
+                (l for l in lanes if l is not chosen_first),
+                key=lambda l: (l.occupancy, l.idx),
+            )
+            rerouted = False
+            for lane in order:
+                if gate_breakers and not lane.breaker.allow():
+                    rerouted = True
+                    continue
+                if resident and lane.idx not in res_idx:
+                    reason = "breaker" if rerouted else "spill"
+                    M.TPU_LANE_REROUTES.inc(device=lane.name, reason=reason)
+                    if stats is not None:
+                        stats("lane_reroutes" if rerouted else "lane_spills", 1)
+                lane.occupancy += 1
+                M.TPU_LANE_OCCUPANCY.set(lane.occupancy, device=lane.name)
+                return lane
+        return None
+
+    def release_lane(self, lane: DeviceLane) -> None:
+        with self._place_lock:
+            lane.occupancy -= 1
+            M.TPU_LANE_OCCUPANCY.set(lane.occupancy, device=lane.name)
+
+    def breakers_describe(self) -> str:
+        return ", ".join(f"{l.name}:{l.breaker.state}" for l in self.lanes)
+
+    def raise_breakers_open(self) -> None:
+        """Forced `engine='tpu'` with EVERY lane's breaker rejecting."""
+        if len(self.lanes) == 1:
+            self.lanes[0].breaker.raise_open()
+        from ..errors import CircuitBreakerOpen
+
+        raise CircuitBreakerOpen(
+            f"every device lane's circuit breaker rejected the request "
+            f"(state=open on all {len(self.lanes)} lanes: "
+            f"{self.breakers_describe()}); use engine='host'/'auto' or "
+            f"wait out the cooldown"
+        )
 
     # --- public ------------------------------------------------------------
 
@@ -365,24 +570,76 @@ class TPUEngine:
         are keyed on; the batcher's row-count bucket."""
         return max((batch.n_rows + TILE_ROWS - 1) // TILE_ROWS, 1)
 
-    def _plan_for(self, dag: DAGRequest, batch: ColumnBatch):
-        dev = getattr(batch, "_device", None)
+    def _plan_for(self, dag: DAGRequest, batch: ColumnBatch, lane: DeviceLane | None = None):
+        if lane is None:
+            lane = self.lanes[0]
+        mirrors = getattr(batch, "_mirrors", None)
+        if mirrors is None:
+            mirrors = {}
+            batch._mirrors = mirrors
+        dev = mirrors.get(lane.idx)
         if dev is None:
-            dev = DeviceBatch(batch)
-            batch._device = dev
+            dev = DeviceBatch(batch, device=lane.device)
+            mirrors[lane.idx] = dev
+            with self._place_lock:
+                if len(self._residency) > 4096:
+                    self._residency.clear()
+                self._residency.setdefault(
+                    self._residency_key(batch), set()
+                ).add(lane.idx)
         return self._lower(dag, dev)
 
-    def execute(self, dag: DAGRequest, batch: ColumnBatch) -> Chunk:
-        plan = self._plan_for(dag, batch)
-        if plan is None:
-            with self._lock:
-                self.fallbacks += 1
-            return execute_dag_host(dag, batch)
-        if isinstance(plan, DevicePlan):
-            return plan.finalize(_fetch(plan.launch()))
-        return plan()  # sorted-agg path: owns its retry loop, stays eager
+    def execute(self, dag: DAGRequest, batch: ColumnBatch,
+                lane: DeviceLane | None = None, _solo_event: bool = True) -> Chunk:
+        placed = None
+        if lane is None:
+            lane = placed = self.place(batch)
+        try:
+            with _lane_guard(lane):
+                t0 = time.perf_counter_ns()
+                plan = self._plan_for(dag, batch, lane)
+                if plan is None:
+                    with self._lock:
+                        self.fallbacks += 1
+                    return execute_dag_host(dag, batch)
+                if isinstance(plan, DevicePlan):
+                    chunk = plan.finalize(_fetch(plan.launch()))
+                else:
+                    chunk = plan()
+                if _solo_event:
+                    # every device dispatch shows on the timeline, solo
+                    # launches included (grouped ones are the batcher's)
+                    lane.launches += 1
+                    M.TPU_LANE_LAUNCHES.inc(device=lane.name, mode="solo")
+                    tl = TL.active()
+                    if tl is not None:
+                        tl.device_event(
+                            "cop.launch", "launch", t0, time.perf_counter_ns(),
+                            launch_id=tracing._next_id(), occupancy=1,
+                            device=lane.name,
+                        )
+                return chunk
+        finally:
+            if placed is not None:
+                self.release_lane(placed)
 
-    def execute_many(self, items: list[tuple[DAGRequest, ColumnBatch]]) -> list[Chunk]:
+    def execute_many(self, items: list[tuple[DAGRequest, ColumnBatch]],
+                     lane: DeviceLane | None = None) -> list[Chunk]:
+        placed = None
+        if lane is None:
+            if items:
+                lane = placed = self.place(items[0][1])
+            else:
+                lane = self.lanes[0]  # nothing to place (or release)
+        try:
+            with _lane_guard(lane):
+                return self._execute_many_on(items, lane)
+        finally:
+            if placed is not None:
+                self.release_lane(placed)
+
+    def _execute_many_on(self, items: list[tuple[DAGRequest, ColumnBatch]],
+                         lane: DeviceLane) -> list[Chunk]:
         """Run a batch of cop tasks with launch amortization, two tiers:
 
         1. tasks sharing a program key (identical rewritten DAG + tile
@@ -394,8 +651,9 @@ class TPUEngine:
 
         Group programs are compiled per power-of-two size bucket (group
         padded by repeating its last task, padding discarded), so steady
-        state pays at most log2(MAX_FUSE) extra compiles per key."""
-        plans = [self._plan_for(dag, batch) for dag, batch in items]
+        state pays at most log2(MAX_FUSE) extra compiles per key — per
+        device lane (jit caches executables per committed device)."""
+        plans = [self._plan_for(dag, batch, lane) for dag, batch in items]
         results: list = [None] * len(items)
         fusable: dict = {}  # program key -> [task index]
         launched = []  # (kind, payload) in launch order
@@ -410,7 +668,7 @@ class TPUEngine:
                 else:
                     launched.append(("one", (i, plan.launch())))
             else:
-                results[i] = plan()  # sorted-agg: owns its retry loop
+                results[i] = plan()  # exotic eager plan (none today)
 
         for key, idx_list in fusable.items():
             for lo in range(0, len(idx_list), self.MAX_FUSE):
@@ -890,21 +1148,48 @@ class TPUEngine:
 
             return kernel
 
-        def run():
-            gcap = self._gcap.get(base_key, self.gcap0)
+        # DevicePlan (not an eager loop, the standing PR 1 gap): the plan
+        # launches at the remembered group capacity and carries (key,
+        # args), so concurrent same-digest sorted-agg tasks FUSE into one
+        # vmapped launch through the batcher like every other cop task.
+        # Capacity overflow is detected in finalize from the fetched
+        # n_groups scalar and re-runs THIS task solo at an escalated
+        # capacity (exact at the higher cap, so results stay bit-identical
+        # to the old loop); the remembered capacity means steady state
+        # never overflows again.
+        gcap = self._gcap.get(base_key, self.gcap0)
+        fn, aux = self._packed_program(
+            base_key + (gcap,), make_kernel(gcap), gcap, has_scalar=True
+        )
+
+        def rerun_escalated(ng: int):
+            cap = gcap
             while True:
-                fn, aux = self._packed_program(base_key + (gcap,), make_kernel(gcap), gcap, has_scalar=True)
-                ng_a, i_arr, f_arr = _fetch(fn(arrs, dev.row_valid))
+                while cap < ng:
+                    cap <<= 2
+                self._gcap[base_key] = cap
+                fn2, aux2 = self._packed_program(
+                    base_key + (cap,), make_kernel(cap), cap, has_scalar=True
+                )
+                ng_a, i_arr, f_arr = _fetch(fn2(arrs, dev.row_valid))
                 ng = int(ng_a)
-                if ng <= gcap:
-                    break
-                while gcap < ng:
-                    gcap <<= 2
-                self._gcap[base_key] = gcap
+                if ng <= cap:
+                    outs = self._unpack((i_arr, f_arr), aux2)
+                    return self._agg_sorted_to_chunk(dag, dev, outs, key_idx, vocabs, ng)
+
+        def finalize(fetched):
+            ng_a, i_arr, f_arr = fetched
+            ng = int(ng_a)
+            if ng > gcap:
+                return rerun_escalated(ng)
             outs = self._unpack((i_arr, f_arr), aux)
             return self._agg_sorted_to_chunk(dag, dev, outs, key_idx, vocabs, ng)
 
-        return run
+        return DevicePlan(
+            lambda: fn(arrs, dev.row_valid), finalize,
+            key=base_key + (gcap,), args=(arrs, dev.row_valid),
+            rows=dev.batch.n_rows,
+        )
 
     def _agg_sorted_to_chunk(self, dag, dev, outs, key_idx, vocabs, ng):
         agg = dag.agg

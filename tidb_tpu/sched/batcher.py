@@ -89,32 +89,47 @@ class LaunchBatcher:
         self._pending: dict[tuple, _Group] = {}
         self._inflight = 0
 
-    def execute(self, engine, dag, batch, dedup_key=None, stats=None, client=None):
+    def execute(self, engine, dag, batch, dedup_key=None, stats=None, client=None,
+                lane=None):
         """Run one cop DAG over one batch through the engine, coalescing
-        with concurrent compatible tasks. `stats` is an optional callable
+        with concurrent compatible tasks ON ONE DEVICE RUNNER LANE: the
+        placement policy (engine.place — residency affinity, spill to
+        idle lanes under load, breaker gating on the client path) picks
+        the lane up front, groups key on it, and sibling lanes launch in
+        parallel. `lane` is the caller's pre-placed DeviceLane (the cop
+        client places so it can record breaker outcomes on the same
+        lane); None places here. `stats` is an optional callable
         `(key, n)` for the owning client's per-query counters; `client`
         is the owning CopClient whose store-level stats receive the
         launch's device counters (solo bypasses report through the
         caller's phase collector instead)."""
+        placed = None
+        if lane is None and hasattr(engine, "place"):
+            lane = placed = engine.place(batch, stats=stats)
         with self._lock:
             self._inflight += 1
             concurrent = self._inflight > 1
         try:
-            if not concurrent:
-                return engine.execute(dag, batch)
-            return self._coalesced(engine, dag, batch, dedup_key, stats, client)
+            if not concurrent or lane is None:
+                return engine.execute(dag, batch, lane=lane) if lane is not None \
+                    else engine.execute(dag, batch)
+            return self._coalesced(engine, dag, batch, lane, dedup_key, stats, client)
         finally:
             with self._lock:
                 self._inflight -= 1
+            if placed is not None:
+                engine.release_lane(placed)
 
     # --- grouped path -------------------------------------------------------
 
-    def _coalesced(self, engine, dag, batch, dedup_key, stats, client=None):
+    def _coalesced(self, engine, dag, batch, lane, dedup_key, stats, client=None):
         try:
             tiles = engine.tile_count(batch)
         except Exception:  # noqa: BLE001 — engine without tiling: run solo
-            return engine.execute(dag, batch)
-        ckey = (id(engine), dag.digest(), tiles)
+            return engine.execute(dag, batch, lane=lane)
+        # groups are PER LANE: a group's tasks all run one vmapped launch
+        # on one device, so only same-device (and same-program) tasks fuse
+        ckey = (id(engine), lane.idx, dag.digest(), tiles)
         job = _Job(dag, batch, dedup_key, client=client)
         t_enq = time.perf_counter_ns()
         with self._lock:
@@ -146,8 +161,9 @@ class LaunchBatcher:
                     del self._pending[ckey]
             TL.group_event("launch.leader_elected", "launch", t_enq,
                            time.perf_counter_ns(),
-                           jobs=len(group.jobs), n_dedup=group.n_dedup)
-            self._launch(engine, group, stats)
+                           jobs=len(group.jobs), n_dedup=group.n_dedup,
+                           device=lane.name)
+            self._launch(engine, group, stats, lane)
         else:
             if not group.done.wait(self.WAIT_TIMEOUT_S):
                 # leader died without completing the group (should be
@@ -162,7 +178,26 @@ class LaunchBatcher:
             raise job.exc
         return job.result
 
-    def _launch(self, engine, group: _Group, stats) -> None:
+    def _launch(self, engine, group: _Group, stats, lane=None) -> None:
+        placed = None
+        if lane is None and hasattr(engine, "place"):
+            # direct callers (tests) without a pre-placed lane
+            lane = placed = engine.place(group.jobs[0].batch)
+        try:
+            if lane is not None:
+                # the lane's launch lock serializes device work per device
+                # and keeps its timeline tid free of partial overlap; the
+                # device_scope binding lands every engine-boundary event
+                # recorded below on the REAL device lane
+                with lane.lock, TL.device_scope(lane.name):
+                    self._launch_on(engine, group, stats, lane)
+            else:
+                self._launch_on(engine, group, stats, lane)
+        finally:
+            if placed is not None:
+                engine.release_lane(placed)
+
+    def _launch_on(self, engine, group: _Group, stats, lane) -> None:
         jobs = group.jobs
         t0_ns = time.perf_counter_ns()
         # one launch identity shared by the timeline event and the trace
@@ -192,7 +227,11 @@ class LaunchBatcher:
                 stats("batched_tasks", 1)
             try:
                 with memory.bind(launch_mem):
-                    results = engine.execute_many([(j.dag, j.batch) for j in jobs])
+                    results = engine.execute_many(
+                        [(j.dag, j.batch) for j in jobs], lane=lane
+                    ) if lane is not None else engine.execute_many(
+                        [(j.dag, j.batch) for j in jobs]
+                    )
                 for j, r in zip(jobs, results):
                     j.result = r
             except Exception:  # noqa: BLE001
@@ -205,7 +244,7 @@ class LaunchBatcher:
                 for j in jobs:
                     try:
                         with memory.bind(j.mem):
-                            j.result = engine.execute(j.dag, j.batch)
+                            j.result = self._solo(engine, j.dag, j.batch, lane)
                     except Exception as e:  # noqa: BLE001
                         j.exc = e
         except BaseException as e:  # noqa: BLE001 — e.g. an armed failpoint
@@ -232,13 +271,14 @@ class LaunchBatcher:
                         # device: line / trace
                         try:
                             with memory.bind(f.mem), tracing.collect_phases():
-                                f.result = engine.execute(f.dag, f.batch)
+                                f.result = self._solo(engine, f.dag, f.batch, lane)
                         except Exception as e:  # noqa: BLE001
                             f.exc = e
                     else:
                         f.result, f.exc = j.result, j.exc
             try:
-                self._attribute(jobs, group, t0_ns, phases, launch_id=launch_id)
+                self._attribute(jobs, group, t0_ns, phases, launch_id=launch_id,
+                                lane=lane)
             except Exception:  # noqa: BLE001 — attribution must never strand waiters
                 log.warning("launch-span fan-out attribution failed", exc_info=True)
             group.done.set()
@@ -246,8 +286,17 @@ class LaunchBatcher:
                            time.perf_counter_ns(), time.perf_counter_ns(),
                            launch_id=launch_id, waiters=len(jobs) + group.n_dedup)
 
+    @staticmethod
+    def _solo(engine, dag, batch, lane):
+        """Per-job serial fallback / dedup re-run on the group's OWN lane
+        — already inside the lane guard, so no solo launch event (the
+        enclosing grouped `cop.launch` slice covers it)."""
+        if lane is not None:
+            return engine.execute(dag, batch, lane=lane, _solo_event=False)
+        return engine.execute(dag, batch)
+
     def _attribute(self, jobs, group: _Group, t0_ns: int, phases: dict,
-                   launch_id: int | None = None) -> None:
+                   launch_id: int | None = None, lane=None) -> None:
         """Fan the ONE launch out into every co-batched waiter's trace:
         each participant (members, dedup followers, the leader itself)
         gets the SAME launch span — identical launch/span id, occupancy,
@@ -268,14 +317,22 @@ class LaunchBatcher:
         shared_h2d = int(phases.get("h2d_bytes", 0)) if occupancy > 1 else 0
         if shared_h2d:
             M.TPU_SHARED_UPLOAD_BYTES.inc(shared_h2d)
-        # ONE timeline event per grouped launch on the runner's device
-        # lane, referenced by every co-batched waiter's trace id
+        # ONE timeline event per launch on the runner's DEVICE lane —
+        # every dispatch shows, 1-job groups included (PR 5 leftover) —
+        # referenced by every co-batched waiter's trace id (the chrome
+        # export turns the references into flow-event arrows)
+        if lane is not None:
+            lane.launches += 1
+            M.TPU_LANE_LAUNCHES.inc(
+                device=lane.name, mode="grouped" if occupancy > 1 else "solo"
+            )
         tl = TL.active()
-        if tl is not None and occupancy > 1:
+        if tl is not None:
             tl.device_event(
                 "cop.launch", "launch", t0_ns, t0_ns + dur_ns,
                 launch_id=launch_id, occupancy=occupancy, n_dedup=group.n_dedup,
                 shared_h2d_bytes=shared_h2d,
+                device=lane.name if lane is not None else "",
                 waiters=[w.trace.trace_id for w in waiters if w.trace is not None],
             )
         # store-level stats fan-out (PR 3 debt): a co-batched launch's

@@ -1439,6 +1439,13 @@ class Session:
         elif name == "tidb_trace_ring_capacity":
             # live resize, keeping the newest traces (PR 3 debt)
             self.store.trace_ring.resize(int(val))
+        elif name == "tidb_timeline_ring_capacity":
+            # live resize of the device timeline ring, keeping the newest
+            # events (PR 5 debt: capacity was hard-coded at 8192)
+            self.store.timeline.resize(int(val))
+        elif name == "tidb_tpu_cop_lanes":
+            # mesh dispatch width: takes effect for the next placement
+            self.store.sched.tpu_engine.set_active_lanes(int(val))
         elif name == "tidb_enable_timeline":
             # store-wide flag on the ring itself: takes effect for every
             # session's next engine call, no per-session re-read needed
@@ -3763,14 +3770,30 @@ class Session:
                 f"transfer_bytes:{int(d['transfer_bytes'])} "
                 f"device_ms:{d['device_ms']:.3f} "
                 f"cache_ref:{int(d.get('cache_ref_bytes', 0))} "
-                f"shared_h2d:{int(d.get('shared_h2d_bytes', 0))}"
+                f"shared_h2d:{int(d.get('shared_h2d_bytes', 0))} "
+                f"lanes:{len(self.cop.tpu.lanes) if self.cop._tpu else 1} "
+                f"reroutes:{int(d.get('lane_reroutes', 0))} "
+                f"spills:{int(d.get('lane_spills', 0))}"
             )
         if self.cop._tpu:
-            br = self.cop.tpu.breaker
+            # per-device breakers (PR 6): one state per runner lane; the
+            # aggregate reads `open` when every lane is open (= cop path
+            # fully drained to host), `open(k/n)` for a partial outage
+            lanes = self.cop.tpu.lanes
+            n_open = sum(1 for l in lanes if l.breaker.state == "open")
+            n_half = sum(1 for l in lanes if l.breaker.state == "half-open")
+            if n_open == len(lanes):
+                agg = "open"
+            elif n_open:
+                agg = f"open({n_open}/{len(lanes)})"
+            elif n_half:
+                agg = f"half-open({n_half}/{len(lanes)})"
+            else:
+                agg = "closed"
             lines.append(
                 f"tpu: compiles:{self.cop.tpu.compile_count - tpu0[0]} "
                 f"fallbacks:{self.cop.tpu.fallbacks - tpu0[1]} "
-                f"breaker:{br.state} trips:{br.trips}"
+                f"breaker:{agg} trips:{sum(l.breaker.trips for l in lanes)}"
             )
         lines.append(f"total: {wall_ms:.3f}ms")
         chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(l)] for l in lines])

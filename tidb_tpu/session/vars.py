@@ -145,6 +145,19 @@ _sv("tidb_trace_ring_capacity", "64", scope="global", kind="int", lo=1, hi=4096,
 # launch-lifecycle events into the per-store ring behind /debug/timeline
 # and TIDB_TIMELINE. GLOBAL-only: one ring per store, one flag on it
 _sv("tidb_enable_timeline", "ON", scope="global", kind="bool", consumed=True)
+# capacity of the per-store device timeline ring; SET GLOBAL resizes it
+# live keeping the newest events (PR 6 — replaces the fixed 8192, the
+# tidb_trace_ring_capacity pattern one ring over)
+_sv("tidb_timeline_ring_capacity", "8192", scope="global", kind="int", lo=64,
+    hi=1 << 20, consumed=True)
+
+# --- mesh-wide cop dispatch (PR 6) -----------------------------------------
+# dispatch width over the device mesh: cop tasks place onto the first N
+# runner lanes (0 = every device). Serving knob for hosts whose backend
+# serializes executions across in-process devices (see BENCH_mesh_pr6's
+# overlap_x): width 1 there recovers full cross-session coalescing
+_sv("tidb_tpu_cop_lanes", "0", scope="global", kind="int", lo=0, hi=256,
+    consumed=True)
 
 # --- server memory arbitration (PR 4: utils/memory ServerMemTracker) -------
 # store-wide hard limit on tracked statement memory; 0 = unlimited.

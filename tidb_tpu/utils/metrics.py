@@ -365,3 +365,19 @@ TPU_SHARED_UPLOAD_BYTES = REGISTRY.counter(
     "tidb_tpu_shared_upload_bytes_total",
     "h2d bytes uploaded by grouped launches on behalf of the whole group",
 )
+
+# --- per-device runner lanes (PR 6: mesh-wide cop dispatch) ----------------
+# every mesh device is a cop runner lane with its own queue position,
+# breaker and timeline lane; `device` labels carry the lane name (cpu:3)
+TPU_LANE_OCCUPANCY = REGISTRY.gauge(
+    "tidb_tpu_lane_occupancy",
+    "in-flight cop tasks placed on each device runner lane",
+)
+TPU_LANE_LAUNCHES = REGISTRY.counter(
+    "tidb_tpu_lane_launch_total",
+    "device launches per runner lane, solo vs grouped",
+)
+TPU_LANE_REROUTES = REGISTRY.counter(
+    "tidb_tpu_lane_reroutes_total",
+    "placements diverted off the resident lane (reason: breaker | spill)",
+)

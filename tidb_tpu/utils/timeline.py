@@ -15,13 +15,16 @@ fetch (`copr/tpu_engine.py`) — and at the batcher's launch lifecycle
 Lanes map to Chrome trace-event (pid, tid) pairs, loadable in Perfetto
 via `/debug/timeline` (or `chrome://tracing`):
 
-  * pid DEVICE — one tid per runner thread that touched the device.
-    Events within a runner tid are PROPERLY NESTED by construction (one
-    thread, one clock): phase events are pairwise disjoint, and a
-    grouped `cop.launch` — which occupies its runner lane exactly ONCE,
-    args carrying launch id, occupancy, shared-upload bytes and every
-    co-batched waiter's trace id — fully encloses the phase events its
-    thread recorded during the launch (rendered as a nested slice).
+  * pid DEVICE — one tid per REAL device lane (`cpu:3`, `tpu:0`) when
+    the per-device dispatch path bound one via `device_scope` (PR 6:
+    runner lanes are the mesh devices, serialized by each lane's launch
+    lock), falling back to the runner thread's name for unpinned
+    engine work. Events within a lane are PROPERLY NESTED by
+    construction (one lock / one thread, one clock): phase events are
+    pairwise disjoint, and a `cop.launch` — one per launch, solo or
+    grouped, args carrying launch id, occupancy, shared-upload bytes
+    and every co-batched waiter's trace id — fully encloses the phase
+    events recorded during the launch (rendered as a nested slice).
     Partial overlap, which the Chrome format cannot represent on one
     tid, never occurs.
   * pid GROUPS — one tid per (resource group, thread): statement walls
@@ -83,6 +86,16 @@ class TimelineRing:
         self._ring: deque[TimelineEvent] = deque(maxlen=capacity or self.CAPACITY)
         self._lock = threading.Lock()
 
+    def resize(self, capacity: int) -> None:
+        """Live resize (SET GLOBAL tidb_timeline_ring_capacity): keeps
+        the newest events — deque(iterable, maxlen) retains the tail."""
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(1, int(capacity)))
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
     # --- recording ---------------------------------------------------------
 
     def record(self, name: str, cat: str, t_start_ns: int, t_end_ns: int,
@@ -95,11 +108,12 @@ class TimelineRing:
 
     def device_event(self, name: str, cat: str, t_start_ns: int, t_end_ns: int,
                      **args) -> None:
-        """Record on the calling runner thread's device lane — per-runner
-        tids keep each device lane non-overlapping (one thread ⇒ events
-        close before the next opens)."""
+        """Record on the bound REAL device lane (`device_scope`, held with
+        that lane's launch lock ⇒ events on one device tid never partially
+        overlap), falling back to the calling thread's name for unpinned
+        engine work (one thread ⇒ events close before the next opens)."""
         self.record(name, cat, t_start_ns, t_end_ns,
-                    pid=PID_DEVICE, lane=threading.current_thread().name, **args)
+                    pid=PID_DEVICE, lane=current_device_lane(), **args)
 
     # --- reading -----------------------------------------------------------
 
@@ -139,6 +153,44 @@ class TimelineRing:
                 "dur": max(ev.t_end_ns - ev.t_start_ns, 0) / 1e3,
                 "args": dict(ev.args),
             })
+        # flow-event arrows: each `cop.launch` slice points at the
+        # statement slice of every co-batched waiter (waiter linkage was
+        # args-only before PR 6). Second pass: every lane has its tid by
+        # now. One s/f pair per (launch, waiter) edge — Chrome flow ids
+        # chain events sharing an id, so per-edge ids keep N waiters from
+        # rendering as one zig-zag chain.
+        stmts = {}
+        for ev in events:
+            t = ev.args.get("trace_id")
+            if ev.name == "statement" and t is not None:
+                stmts[t] = ev
+        for ev in events:
+            waiters = ev.args.get("waiters") if ev.name == "cop.launch" else None
+            if not waiters:
+                continue
+            l_tid = tids[(ev.pid, ev.lane)]
+            l_end = (max(ev.t_end_ns, ev.t_start_ns) - self.epoch_ns) / 1e3
+            for w in waiters:
+                st = stmts.get(w)
+                if st is None:
+                    continue  # waiter's statement fell off the ring
+                fid = f"{ev.args.get('launch_id', 0)}/{w}"
+                out.append({
+                    "ph": "s", "id": fid, "pid": ev.pid, "tid": l_tid,
+                    "name": "cop.launch→stmt", "cat": "launch",
+                    "ts": (ev.t_start_ns - self.epoch_ns) / 1e3,
+                })
+                # bind inside the statement slice: clamp the arrow head
+                # to the waiter's own wall (a waiter may adopt a launch
+                # that started before its statement did)
+                s0 = (st.t_start_ns - self.epoch_ns) / 1e3
+                s1 = (max(st.t_end_ns, st.t_start_ns) - self.epoch_ns) / 1e3
+                out.append({
+                    "ph": "f", "bp": "e", "id": fid,
+                    "pid": st.pid, "tid": tids[(st.pid, st.lane)],
+                    "name": "cop.launch→stmt", "cat": "launch",
+                    "ts": min(max(l_end, s0), s1),
+                })
         return {"traceEvents": out, "displayTimeUnit": "ms"}
 
     def to_json(self) -> str:
@@ -181,6 +233,36 @@ def active() -> TimelineRing | None:
 def current_group() -> str:
     t = getattr(_TLS, "tl", None)
     return t[1] if t is not None else "default"
+
+
+class device_scope:
+    """Bind a REAL device lane label (`cpu:3`) to the current thread for
+    the duration of a launch: engine-boundary events recorded inside land
+    on that device's timeline lane instead of the thread's. The caller
+    must hold the lane's launch lock — exclusivity is what keeps one
+    device tid free of partial overlap. Re-entrant (nested launches on
+    one lane re-bind the same label harmlessly)."""
+
+    __slots__ = ("name", "prev")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.prev = getattr(_TLS, "device_lane", None)
+        _TLS.device_lane = self.name
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.device_lane = self.prev
+        return False
+
+
+def current_device_lane() -> str:
+    """The bound device-lane label, or the calling thread's name for
+    engine work outside any lane guard."""
+    name = getattr(_TLS, "device_lane", None)
+    return name if name is not None else threading.current_thread().name
 
 
 def group_lane(group: str) -> str:
