@@ -56,10 +56,11 @@ class TestServerMemoryArbitration:
         bombs = [Session(s.store) for _ in range(2)]
         innocents = [Session(s.store) for _ in range(2)]
         for i in innocents:
-            # pin innocents to the host path: a device route would pad
-            # their 4096 rows to full 64Ki tiles and the tracked h2d
-            # upload alone (~1.2MB) would dwarf the bomb — the soft-limit
-            # test below covers auto-engine behavior under pressure
+            # pin innocents to the host path: a device route would add
+            # tracked h2d volume (a few KB since the bucketed/compressed
+            # tiles of PR 7, ~1.2MB of padding before) that this test's
+            # byte arithmetic doesn't model — the soft-limit test below
+            # covers auto-engine behavior under pressure
             i.vars["tidb_cop_engine"] = "host"
         killed, errors, results = [], [], []
 

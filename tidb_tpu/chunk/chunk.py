@@ -105,9 +105,15 @@ class Column:
 
 
 class Chunk:
-    """A batch of rows in columnar form."""
+    """A batch of rows in columnar form.
 
-    __slots__ = ("columns",)
+    `_device` is set (True) by the TPU engine on chunks a device program
+    produced — the cop client charges such tasks' RU read-byte term at
+    the compressed mirror's wire bytes, while host-produced chunks (incl.
+    the engine's internal lowering fallback) charge the host lanes they
+    actually scanned. Absent on every other construction path."""
+
+    __slots__ = ("columns", "_device")
 
     def __init__(self, columns: list[Column]):
         self.columns = columns

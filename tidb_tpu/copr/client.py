@@ -52,7 +52,13 @@ from .retry import (
     Backoffer,
     classify_device_error,
 )
-from .tilecache import ColumnBatch, TileCache, batch_nbytes, decode_rows_to_batch
+from .tilecache import (
+    ColumnBatch,
+    TileCache,
+    batch_nbytes,
+    decode_rows_to_batch,
+    device_nbytes,
+)
 
 
 @dataclass
@@ -147,6 +153,12 @@ class CopClient:
             # shared uploads performed on behalf of the whole group
             "cache_ref_bytes": 0,
             "shared_h2d_bytes": 0,
+            # tile-codec counters (PR 7): the dense uncompressed bytes a
+            # statement's uploads REPRESENT vs the narrowed/compressed
+            # bytes that actually crossed the wire (EXPLAIN ANALYZE
+            # device: line `logical_bytes`/`wire_bytes`)
+            "logical_bytes": 0,
+            "wire_bytes": 0,
             # mesh-placement counters (PR 6): tasks moved OFF their
             # resident device lane — by an open breaker (reroute to a
             # sibling, not host) or by load (spill to an idle lane)
@@ -570,6 +582,7 @@ class CopClient:
                 if bo.abort is not None and bo.abort.is_set():
                     raise QueryInterrupted("cop stream abandoned")
                 ticket = None
+                wire = None  # set on device success: mirror's REAL bytes
                 if ctl is not None:
                     try:
                         ticket = ctl.scheduler.acquire(
@@ -666,6 +679,15 @@ class CopClient:
                                 breaker.record_success()
                                 st("tpu_tasks")
                                 self._note_device_phases(ph, st, trace)
+                                # only chunks a device program PRODUCED
+                                # charge the compressed mirror; the
+                                # engine's internal lowering fallback
+                                # scanned host lanes and pays host bytes
+                                if getattr(chunk, "_device", False):
+                                    wire = device_nbytes(
+                                        batch,
+                                        lane.idx if lane is not None else None,
+                                    )
                                 return chunk
                             finally:
                                 if lane is not None:
@@ -680,7 +702,13 @@ class CopClient:
                     return chunk
                 finally:
                     if ticket is not None:
-                        ru = ru_cost(batch.n_rows, batch_nbytes(batch))
+                        # RU read-byte term: a device-path task charges the
+                        # bytes its narrowed/compressed mirror actually
+                        # holds (and moved), not the 64Ki-padded or host
+                        # lane fiction; host-path tasks keep charging the
+                        # host lanes they scanned
+                        nb = wire if wire is not None else batch_nbytes(batch)
+                        ru = ru_cost(batch.n_rows, nb)
                         ctl.scheduler.release(ticket, ru)
                         st("ru", ru)
 

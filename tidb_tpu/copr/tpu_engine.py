@@ -45,7 +45,13 @@ from ..mysqltypes.field_type import ft_longlong
 from ..mysqltypes.mydecimal import pow10
 from .dag import DAGRequest
 from .host_engine import exact_sum64, exact_sumsq64, execute_dag_host
-from .tilecache import ColumnBatch
+from .tilecache import (
+    MIN_TILE_ROWS,
+    ColumnBatch,
+    encode_data_lane,
+    encode_valid_lane,
+    pow2_rows,
+)
 
 class _Timed:
     """A jitted program with its first dispatch timed: JAX traces+compiles
@@ -132,6 +138,25 @@ def _fetch(x):
     # chunk that drain() charges at materialization — charging the d2h
     # here too would double-count the same data on the device path only
     return out
+
+
+def _tree_to_device(tree, device=None):
+    """Upload every leaf of a codec payload pytree (dict of numpy arrays)
+    through `_to_device`, so transfer accounting/quota charges cover the
+    compressed form — the only form that crosses the wire."""
+    return jax.tree_util.tree_map(lambda a: _to_device(a, device), tree)
+
+
+def _mark_device(chunk):
+    """Stamp a chunk as device-produced (Chunk._device): the cop client
+    charges its RU read-byte term at the mirror's compressed wire bytes.
+    Chunks from the engine's internal host fallback stay unstamped and
+    charge the host lanes the fallback actually scanned."""
+    try:
+        chunk._device = True
+    except AttributeError:  # exotic chunk-like result without the slot
+        pass
+    return chunk
 
 
 TILE_ROWS = 1 << 16
@@ -259,50 +284,126 @@ class DeviceBatch:
     """Device-resident mirror of a ColumnBatch: [T, R] lanes per column,
     committed to ONE mesh device (`device`) — the residency unit the
     placement policy routes by (a cached upload stays hot on the device
-    that owns it; a spill builds a second mirror on a sibling)."""
+    that owns it; a spill builds a second mirror on a sibling).
 
-    def __init__(self, batch: ColumnBatch, device=None):
+    With `compress` (the `tidb_tpu_tile_compression` default) the layout
+    is bucketed and codec-encoded: batches up to TILE_ROWS pad to a
+    power-of-two row bucket (min MIN_TILE_ROWS) instead of a full 64Ki
+    tile, larger batches keep TILE_ROWS tiles, and every lane ships in the
+    cheapest of dense/pack/dict/rle form with decode fused into the
+    jitted program (tilecache codec half). `compress=False` reproduces
+    the legacy layout exactly: 64Ki tiles, dense lanes."""
+
+    def __init__(self, batch: ColumnBatch, device=None, compress: bool = True):
         self.batch = batch
         self.device = device
+        self.compress = compress
         n = batch.n_rows
-        self.t = max((n + TILE_ROWS - 1) // TILE_ROWS, 1)
-        self.padded = self.t * TILE_ROWS
+        if compress and n <= TILE_ROWS:
+            self.t, self.r = 1, pow2_rows(n)
+        else:
+            self.t, self.r = max((n + TILE_ROWS - 1) // TILE_ROWS, 1), TILE_ROWS
+        self.padded = self.t * self.r
+        M.TPU_TILE_ROWS_PADDED.inc(self.padded - n)
         self.vocabs: dict[int, list] = {}
         self._data: dict[int, object] = {}
         self._valid: dict[int, object] = {}
+        # static per-lane codec descriptors — they join the compile-cache
+        # key (programs trace the decode) and the launch-group fuse key
+        self.lane_sigs: dict[int, tuple] = {}
         # per-lane upload identity: (upload_id, bytes) recorded by the
         # launch that actually paid the h2d — later statements hitting
         # the cached lane reference it instead of inheriting the cost
         self.upload_ids: dict[int, tuple[int, int]] = {}
+        # actual transferred (= device-resident) bytes vs the dense
+        # uncompressed equivalent — what MemTracker/RU/EXPLAIN now read
+        self.wire_nbytes = 0
+        self.logical_nbytes = 0
         rv = np.zeros(self.padded, dtype=bool)
         rv[:n] = True
-        self.row_valid = _to_device(rv.reshape(self.t, TILE_ROWS), device)
+        self.row_valid = _to_device(rv.reshape(self.t, self.r), device)
+        self.wire_nbytes += self.padded
+        self.logical_nbytes += self.padded
 
     def _pad2d(self, a: np.ndarray):
-        out = np.zeros(self.padded, dtype=a.dtype)
-        out[: len(a)] = a
-        return out.reshape(self.t, TILE_ROWS)
+        from .tilecache import _pad2d
+
+        return _pad2d(a, (self.t, self.r))
+
+    @staticmethod
+    def _wire(x) -> int:
+        return sum(int(l.nbytes) for l in jax.tree_util.tree_leaves(x))
 
     def lanes(self, off: int):
-        """(data [T,R] jnp, valid [T,R] jnp) for a table column offset,
-        dict-encoding object lanes on first use. The h2d upload span and
-        bytes belong to the launch that performs it; a cache hit records
-        a zero-duration `cache_ref` annotation carrying the original
-        upload id — attribution follows the work, not first-touch."""
+        """(data, valid) device lanes for a table column offset — each a
+        plain [T,R] array or a codec payload pytree the program decodes
+        in-kernel (engine._decode_lane). Object lanes dict-encode to
+        sorted-vocab int32 codes first (the codes lane then compresses
+        like any int lane). The h2d upload span and bytes belong to the
+        launch that performs it; a cache hit records a zero-duration
+        `cache_ref` annotation carrying the original upload id —
+        attribution follows the work, not first-touch."""
         if off not in self._data:
-            d = self.batch.data[off]
-            v = self.batch.valid[off]
-            if d.dtype == object:
-                coll = getattr(self.batch.table.columns[off].ft, "collate", "utf8mb4_bin")
-                codes, vocab = _dict_encode_lane(d, v, coll)
-                self.vocabs[off] = vocab
-                d = codes
-            self._data[off] = _to_device(self._pad2d(d), self.device)
-            self._valid[off] = _to_device(self._pad2d(v), self.device)
-            self.upload_ids[off] = (
-                tracing._next_id(),
-                int(self._data[off].nbytes) + int(self._valid[off].nbytes),
+            # encode-once: the codec pass (NDV probe, np.unique, run
+            # detection) is cached ON the ColumnBatch keyed by lane +
+            # shape, so a second mirror (spill to a sibling lane, rebuild
+            # after eviction) pays only the h2d, never a re-encode — the
+            # compressed payload is small enough to keep, which the dense
+            # padded form never was. Writes race benignly: the encode is
+            # deterministic and dict assignment is atomic.
+            ecache = getattr(self.batch, "_enc_cache", None)
+            if ecache is None:
+                ecache = self.batch._enc_cache = {}
+            ekey = (off, self.t, self.r)
+            hit = ecache.get(ekey) if self.compress else None
+            if hit is not None:
+                d, vocab, pay_d, sig_d, pay_v, sig_v = hit
+                if vocab is not None:
+                    self.vocabs[off] = vocab
+                v = self.batch.valid[off]
+            else:
+                d = self.batch.data[off]
+                v = self.batch.valid[off]
+                vocab = None
+                if d.dtype == object:
+                    coll = getattr(self.batch.table.columns[off].ft, "collate", "utf8mb4_bin")
+                    codes, vocab = _dict_encode_lane(d, v, coll)
+                    self.vocabs[off] = vocab
+                    d = codes
+                if self.compress:
+                    pay_d, sig_d = encode_data_lane(d, v, (self.t, self.r))
+                    pay_v, sig_v = encode_valid_lane(v, (self.t, self.r))
+                    # cache the verdict even when both sides stayed dense:
+                    # the entry is a tuple of references (d IS the batch's
+                    # own lane) and skipping it would re-pay the O(n)
+                    # codec probes on every mirror rebuild — which cluster
+                    # exactly on the memory-pressure evict/spill paths
+                    ecache[ekey] = (d, vocab, pay_d, sig_d, pay_v, sig_v)
+                else:
+                    pay_d = pay_v = None
+                    sig_d, sig_v = ("dense",), ("dense",)
+            logical = self.padded * (d.dtype.itemsize + 1)  # dense data+valid
+            self._data[off] = (
+                _to_device(self._pad2d(d), self.device) if pay_d is None
+                else _tree_to_device(pay_d, self.device)
             )
+            self._valid[off] = (
+                _to_device(self._pad2d(v), self.device) if pay_v is None
+                else _tree_to_device(pay_v, self.device)
+            )
+            self.lane_sigs[off] = (sig_d, sig_v)
+            wire = self._wire(self._data[off]) + self._wire(self._valid[off])
+            self.wire_nbytes += wire
+            self.logical_nbytes += logical
+            M.TPU_TILE_COMPRESSED_BYTES.inc(
+                self._wire(self._data[off]), codec=sig_d[0]
+            )
+            M.TPU_TILE_COMPRESSED_BYTES.inc(
+                self._wire(self._valid[off]), codec=sig_v[0]
+            )
+            tracing.add_phase("wire_bytes", wire)
+            tracing.add_phase("logical_bytes", logical)
+            self.upload_ids[off] = (tracing._next_id(), wire)
         else:
             rec = self.upload_ids.get(off)
             if rec is not None:
@@ -410,6 +511,11 @@ class TPUEngine:
         self._lock = Lock()  # cop pool workers share this engine
         self.compile_count = 0
         self.fallbacks = 0
+        # bucketed/compressed device tiles (SET GLOBAL
+        # tidb_tpu_tile_compression, default ON): power-of-two row buckets
+        # + per-column codecs with in-program decode. OFF forces the
+        # legacy dense 64Ki-tile layout — the A/B + incident-fallback path
+        self.tile_compression = True
         # per-DEVICE runner lanes (PR 6): every mesh device gets its own
         # queue position, circuit breaker and timeline lane; the cop
         # client records successes/faults on the lane that ran the task,
@@ -566,9 +672,19 @@ class TPUEngine:
 
     @staticmethod
     def tile_count(batch: ColumnBatch) -> int:
-        """Padded tile count — the static-shape bucket compiled programs
-        are keyed on; the batcher's row-count bucket."""
+        """Padded tile count at the legacy full-tile width (kept for
+        callers that only need a coarse size class; the batcher groups on
+        `tile_bucket`, which sees the narrowed row bucket)."""
         return max((batch.n_rows + TILE_ROWS - 1) // TILE_ROWS, 1)
+
+    def tile_bucket(self, batch: ColumnBatch) -> tuple[int, int]:
+        """(tile count, row bucket) a batch pads to under the current
+        layout — the static-shape class the batcher's launch groups key
+        on: only same-bucket tasks can stack into one vmapped program."""
+        n = batch.n_rows
+        if self.tile_compression and n <= TILE_ROWS:
+            return (1, pow2_rows(n))
+        return (max((n + TILE_ROWS - 1) // TILE_ROWS, 1), TILE_ROWS)
 
     def _plan_for(self, dag: DAGRequest, batch: ColumnBatch, lane: DeviceLane | None = None):
         if lane is None:
@@ -578,8 +694,11 @@ class TPUEngine:
             mirrors = {}
             batch._mirrors = mirrors
         dev = mirrors.get(lane.idx)
+        if dev is not None and dev.compress != self.tile_compression:
+            dev = None  # layout flag flipped: rebuild under the new layout
         if dev is None:
-            dev = DeviceBatch(batch, device=lane.device)
+            dev = DeviceBatch(batch, device=lane.device,
+                              compress=self.tile_compression)
             mirrors[lane.idx] = dev
             with self._place_lock:
                 if len(self._residency) > 4096:
@@ -603,9 +722,9 @@ class TPUEngine:
                         self.fallbacks += 1
                     return execute_dag_host(dag, batch)
                 if isinstance(plan, DevicePlan):
-                    chunk = plan.finalize(_fetch(plan.launch()))
+                    chunk = _mark_device(plan.finalize(_fetch(plan.launch())))
                 else:
-                    chunk = plan()
+                    chunk = _mark_device(plan())
                 if _solo_event:
                     # every device dispatch shows on the timeline, solo
                     # launches included (grouped ones are the batcher's)
@@ -668,7 +787,7 @@ class TPUEngine:
                 else:
                     launched.append(("one", (i, plan.launch())))
             else:
-                results[i] = plan()  # exotic eager plan (none today)
+                results[i] = _mark_device(plan())  # exotic eager plan (none today)
 
         for key, idx_list in fusable.items():
             for lo in range(0, len(idx_list), self.MAX_FUSE):
@@ -678,17 +797,31 @@ class TPUEngine:
                     launched.append(("one", (i, plans[i].launch())))
                     continue
                 gcap = 1 << (len(grp) - 1).bit_length()
-                # single-tile (point/small-range) tasks: run the group at
-                # the real row-count bucket instead of the full padded
-                # tile — row_valid already zeroes the tail, so this only
-                # drops rows that contribute exact zeros
+                # run the group at the real row-count bucket instead of
+                # the full padded shape — multi-tile groups included (the
+                # old single-tile-only gate was the standing sched/ gap):
+                # a single-tile group narrows to the power-of-two bucket
+                # of its largest task, a multi-tile group narrows its
+                # LAST tile's padding to a power-of-two remainder bucket
+                # (full tiles hold real rows; pure pow2 of the total would
+                # never undercut tile-multiple padding). `width` counts
+                # FLATTENED rows, always a multiple of MIN_TILE_ROWS, and
+                # the slice happens inside the jitted group program
+                # (codec-aware, see _narrow_args). row_valid already
+                # zeroes the tail, so narrowing only drops rows that
+                # contribute exact zeros — at most log2 width buckets per
+                # (key, size bucket) keep recompiles bounded
                 width = None
                 rv = plans[grp[0]].args[1]
-                if rv.shape[0] == 1:
-                    need = max(plans[i].rows for i in grp)
-                    w = 1 << max(need - 1, 1).bit_length()
-                    if w < rv.shape[1]:
-                        width = w
+                t_, r_ = rv.shape
+                padded = t_ * r_
+                need = max(plans[i].rows for i in grp)
+                if t_ == 1:
+                    w = pow2_rows(need)
+                else:
+                    w = (t_ - 1) * r_ + pow2_rows(need - (t_ - 1) * r_)
+                if w < padded:
+                    width = w
                 vfn = self._vmapped_program(key, gcap, width)
                 if vfn is None:  # no raw kernel on record: launch solo
                     for i in grp:
@@ -703,12 +836,12 @@ class TPUEngine:
             for (kind, payload), host in zip(launched, fetched):
                 if kind == "one":
                     i = payload[0]
-                    results[i] = plans[i].finalize(host)
+                    results[i] = _mark_device(plans[i].finalize(host))
                 else:
                     for j, i in enumerate(payload[0]):
-                        results[i] = plans[i].finalize(
+                        results[i] = _mark_device(plans[i].finalize(
                             jax.tree_util.tree_map(lambda a: a[j], host)
-                        )
+                        ))
         return results
 
     # --- lowering ----------------------------------------------------------
@@ -750,11 +883,22 @@ class TPUEngine:
         if any(c is None for c in r_conds):
             return None
 
+        # the static shape half of every program key: (tile count, row
+        # bucket) plus each used lane's codec signature — the decode is
+        # traced INTO the program, so two batches whose lanes encoded
+        # differently must never share a compiled fn, and launch groups
+        # (which stack these args) must agree on every aux shape. Codec
+        # choices are content-stable, so steady state still compiles once
+        # per (digest, size bucket, width bucket, codec shape).
+        sig = (dev.t, dev.r) + tuple(
+            (i, dev.lane_sigs.get(scan_offs[i], ((), ()))) for i in sorted(used)
+        )
+
         if dag.agg is not None:
-            return self._lower_agg(dag, dev, lanes, vocabs, r_conds)
+            return self._lower_agg(dag, dev, lanes, vocabs, r_conds, sig)
         if dag.topn is not None:
-            return self._lower_topn(dag, dev, lanes, vocabs, r_conds)
-        return self._lower_filter(dag, dev, lanes, r_conds)
+            return self._lower_topn(dag, dev, lanes, vocabs, r_conds, sig)
+        return self._lower_filter(dag, dev, lanes, r_conds, sig)
 
     # --- string/dict rewriting --------------------------------------------
 
@@ -876,20 +1020,55 @@ class TPUEngine:
                 M.TPU_COMPILE_CACHE.inc(result="hit")
         return fn
 
+    @staticmethod
+    def _narrow_args(args, width):
+        """Codec-aware in-program slice of one task's (lanes, row_valid)
+        to `width` FLATTENED rows: positional lanes (dense data/valid,
+        pack sub-words, dict codes, row_valid) slice row-major — real rows
+        are a prefix of the flattened order, so only padding drops — while
+        rle payloads pass through untouched (their decode reads the
+        narrowed row_valid shape and truncates to it). Aux leaves (pack
+        base, dict vocab) are positionless and keep their shape."""
+        flat, rv = args
+
+        def cut2d(a):
+            t, r = a.shape
+            if t * r <= width:
+                return a
+            # [1, width] when the cut fits one tile row; otherwise re-tile
+            # at MIN_TILE_ROWS so the multi-tile last-tile cut stays
+            # rectangular (width is always a multiple of MIN_TILE_ROWS)
+            r2 = r if width % r == 0 else (width if width < r else MIN_TILE_ROWS)
+            return a.reshape(-1)[:width].reshape(width // r2, r2)
+
+        def cut(enc):
+            if not isinstance(enc, dict):
+                return cut2d(enc)
+            if "p" in enc:
+                return {**enc, "p": cut2d(enc["p"])}
+            if "c" in enc:
+                return {**enc, "c": cut2d(enc["c"])}
+            return enc  # rle
+
+        return ([cut(e) for e in flat], cut2d(rv))
+
     def _vmapped_program(self, key, gcap, width):
         """One device program for a whole compatible launch group: takes
-        `gcap` tasks' (lanes, row_valid) pytrees, slices every lane to
-        `width` rows (None = keep the full padded tile), stacks them on a
-        new leading axis, and vmaps the raw per-task kernel over it — all
-        INSIDE one jit so XLA fuses slice+stack+compute into one dispatch
-        (an eager stack of TILE_ROWS-padded point tasks copies ~16x more
-        bytes than the group actually holds).
+        `gcap` tasks' (lanes, row_valid) pytrees, narrows every task to
+        `width` flattened rows (None = keep the full padded shape —
+        multi-tile groups reshape to a narrower [T', R'] the same way),
+        stacks them on a new leading axis, and vmaps the raw per-task
+        kernel over it — all INSIDE one jit so XLA fuses
+        slice+stack+decode+compute into one dispatch (an eager stack of
+        TILE_ROWS-padded point tasks copies ~16x more bytes than the
+        group actually holds).
 
-        Slicing is exact, not approximate: every kernel masks with
+        Narrowing is exact, not approximate: every kernel masks with
         row_valid before reducing, so rows beyond `width` contribute
         literal zeros — dropping them cannot change any output bit
-        (IEEE x+0.0 == x). Compiled per (key, size bucket, width bucket);
-        None if the raw kernel for `key` isn't on record."""
+        (IEEE x+0.0 == x). Compiled per (key, size bucket, width bucket)
+        — `key` already carries the codec signature; None if the raw
+        kernel for `key` isn't on record."""
         with self._lock:
             vfn = self._vprograms.get((key, gcap, width))
             if vfn is None:
@@ -899,10 +1078,7 @@ class TPUEngine:
 
                 def group(*argss):
                     if width is not None:
-                        argss = [
-                            jax.tree_util.tree_map(lambda a: a[:, :width], args)
-                            for args in argss
-                        ]
+                        argss = [self._narrow_args(args, width) for args in argss]
                     stacked = jax.tree_util.tree_map(
                         lambda *xs: jnp.stack(xs), *argss
                     )
@@ -918,13 +1094,14 @@ class TPUEngine:
 
     # --- filter-only --------------------------------------------------------
 
-    def _lower_filter(self, dag: DAGRequest, dev: DeviceBatch, lanes, r_conds):
+    def _lower_filter(self, dag: DAGRequest, dev: DeviceBatch, lanes, r_conds, sig):
         # cache key includes the REWRITTEN conds: dict-code constants are
         # vocab-specific, so the same SQL against a different region/batch
         # may compile to a different program
-        key = ("filter", repr(r_conds), dev.t)
+        key = ("filter", repr(r_conds), sig)
         arrs, order = self._flatten_lanes(lanes)
-        fn = self._program(key, lambda flat, rv: self._mask(r_conds, self._unflatten(flat, order), rv))
+        fn = self._program(key, lambda flat, rv: self._mask(
+            r_conds, self._unflatten(flat, order, rv), rv))
 
         def finalize(mask):
             mask = np.asarray(mask).reshape(-1)[: dev.batch.n_rows]
@@ -948,12 +1125,47 @@ class TPUEngine:
         return flat, order
 
     @staticmethod
-    def _unflatten(flat, order):
-        return {i: (flat[2 * k], flat[2 * k + 1]) for k, i in enumerate(order)}
+    def _decode_lane(enc, row_valid):
+        """Fused in-program decode of one uploaded lane: a plain array
+        passes through; a codec payload (tilecache encode half) expands to
+        the dense [T, R] lane INSIDE the jitted program, so XLA fuses
+        decode+compute and the wire/h2d form stays the compressed form
+        (arXiv:2506.10092's decompress-in-kernel). `row_valid` supplies
+        the target static shape — the (possibly group-narrowed) one — and
+        doubles as the value of zero-byte all-valid aliases."""
+        if not isinstance(enc, dict):
+            return enc
+        if not enc:  # all-valid alias: the mask IS row_valid, for free
+            return row_valid
+        if "p" in enc:  # pack: frame-of-reference sub-word + base scalar
+            return enc["p"].astype(enc["b"].dtype) + enc["b"]
+        if "c" in enc:  # dict: sorted vocab gather
+            return enc["v"][enc["c"]]
+        # rle: static-length expand; total_repeat_length truncates to the
+        # narrowed shape (only pad rows drop). The tail BEYOND the last
+        # run gathers from the trailing zero-value pad run the encoder
+        # always keeps (jnp.repeat clamps to the last run, not zero), so
+        # pad rows decode to 0/False — and every kernel additionally
+        # masks with row_valid before reducing
+        shape = row_valid.shape
+        flat = jnp.repeat(
+            enc["rv"], enc["rl"], total_repeat_length=shape[0] * shape[1]
+        )
+        return flat.reshape(shape)
+
+    @classmethod
+    def _unflatten(cls, flat, order, row_valid):
+        return {
+            i: (
+                cls._decode_lane(flat[2 * k], row_valid),
+                cls._decode_lane(flat[2 * k + 1], row_valid),
+            )
+            for k, i in enumerate(order)
+        }
 
     # --- aggregation --------------------------------------------------------
 
-    def _lower_agg(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
+    def _lower_agg(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds, sig):
         agg = dag.agg
         gb = agg.group_by
         # group keys must be plain columns; float/uint64 keys group by
@@ -1018,7 +1230,7 @@ class TPUEngine:
         for s in domains:
             nseg *= s + 1  # +1 lane for NULL keys
         if not direct or nseg > DIRECT_GROUP_MAX:
-            return self._lower_agg_sorted(dag, dev, lanes, vocabs, r_conds)
+            return self._lower_agg_sorted(dag, dev, lanes, vocabs, r_conds, sig)
 
         arrs, order = self._flatten_lanes(lanes)
         key = (
@@ -1027,12 +1239,12 @@ class TPUEngine:
             repr([(a.name, repr(a._device_args)) for a in agg.aggs]),
             repr(key_cols),
             repr(domains),
-            dev.t,
+            sig,
             nseg,
         )
 
         def kernel(flat, row_valid):
-            l = self._unflatten(flat, order)
+            l = self._unflatten(flat, order, row_valid)
             mask = self._mask(r_conds, l, row_valid)
             flat_mask = mask.reshape(-1)
             # combined group code, mixed radix; NULL key → extra slot
@@ -1068,7 +1280,7 @@ class TPUEngine:
 
     # --- sort-based aggregation (high-cardinality GROUP BY) -----------------
 
-    def _lower_agg_sorted(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
+    def _lower_agg_sorted(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds, sig):
         """GROUP BY with unbounded/NULLable key domains, fully on device.
 
         The reference's high-NDV path is a murmur3 hash shuffle into
@@ -1091,13 +1303,13 @@ class TPUEngine:
             repr(r_conds),
             repr([(a.name, repr(a._device_args)) for a in agg.aggs]),
             repr(key_idx),
-            dev.t,
+            sig,
         )
         I64_MIN = np.iinfo(np.int64).min
 
         def make_kernel(gcap):
             def kernel(flat, row_valid):
-                l = self._unflatten(flat, order)
+                l = self._unflatten(flat, order, row_valid)
                 mask = self._mask(r_conds, l, row_valid).reshape(-1)
                 n = mask.shape[0]
                 # lexicographic sort: masked rows last, then NULL flag +
@@ -1491,20 +1703,20 @@ class TPUEngine:
 
     # --- topn ----------------------------------------------------------------
 
-    def _lower_topn(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
+    def _lower_topn(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds, sig):
         by = dag.topn.by
         if len(by) != 1:
-            return self._lower_topn_multi(dag, dev, lanes, vocabs, r_conds)
+            return self._lower_topn_multi(dag, dev, lanes, vocabs, r_conds, sig)
         e, desc = by[0]
         r_e = self._rewrite(e, vocabs)
         if r_e is None:
             return None
         n = dag.topn.n
-        key = ("topn", repr(r_conds), repr(r_e), desc, n, dev.t)
+        key = ("topn", repr(r_conds), repr(r_e), desc, n, sig)
         arrs, order = self._flatten_lanes(lanes)
 
         def kernel(flat, row_valid):
-            l = self._unflatten(flat, order)
+            l = self._unflatten(flat, order, row_valid)
             mask = self._mask(r_conds, l, row_valid)
             d, v = self._eval_device(r_e, l)
             d = jnp.full(mask.shape, d) if d.ndim == 0 else d
@@ -1540,7 +1752,7 @@ class TPUEngine:
             key=key, args=(arrs, dev.row_valid), rows=dev.batch.n_rows,
         )
 
-    def _lower_topn_multi(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds):
+    def _lower_topn_multi(self, dag: DAGRequest, dev: DeviceBatch, lanes, vocabs, r_conds, sig):
         """Multi-key TopN: one multi-operand lax.sort over (mask, per-key
         NULL-flag + data, row-id), take the first n sorted row-ids (the
         window-kernel sort recipe; ref closure_exec.go topN heap — the TPU
@@ -1553,11 +1765,11 @@ class TPUEngine:
                 return None
             r_by.append((r_e, desc))
         n = dag.topn.n
-        key = ("topn_multi", repr(r_conds), repr(r_by), n, dev.t)
+        key = ("topn_multi", repr(r_conds), repr(r_by), n, sig)
         arrs, order = self._flatten_lanes(lanes)
 
         def kernel(flat, row_valid):
-            l = self._unflatten(flat, order)
+            l = self._unflatten(flat, order, row_valid)
             mask = self._mask(r_conds, l, row_valid).reshape(-1)
             rows = mask.shape[0]
             ops = [(~mask).astype(jnp.int32)]  # masked rows last

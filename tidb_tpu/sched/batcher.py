@@ -124,7 +124,12 @@ class LaunchBatcher:
 
     def _coalesced(self, engine, dag, batch, lane, dedup_key, stats, client=None):
         try:
-            tiles = engine.tile_count(batch)
+            # the NARROWED (tile count, row bucket) class: two tasks can
+            # only stack into one vmapped program when they pad to the
+            # same shape, which since the bucketed tile layout is the
+            # power-of-two row bucket, not the legacy 64Ki tile count
+            bucket_of = getattr(engine, "tile_bucket", engine.tile_count)
+            tiles = bucket_of(batch)
         except Exception:  # noqa: BLE001 — engine without tiling: run solo
             return engine.execute(dag, batch, lane=lane)
         # groups are PER LANE: a group's tasks all run one vmapped launch

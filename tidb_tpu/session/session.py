@@ -1446,6 +1446,12 @@ class Session:
         elif name == "tidb_tpu_cop_lanes":
             # mesh dispatch width: takes effect for the next placement
             self.store.sched.tpu_engine.set_active_lanes(int(val))
+        elif name == "tidb_tpu_tile_compression":
+            # tile layout flag on the store-wide engine: mirrors built
+            # under the other layout rebuild lazily on next touch (the
+            # compile cache keys carry the codec signature, so old and
+            # new programs coexist without collisions)
+            self.store.sched.tpu_engine.tile_compression = val == "ON"
         elif name == "tidb_enable_timeline":
             # store-wide flag on the ring itself: takes effect for every
             # session's next engine call, no per-session re-read needed
@@ -3763,12 +3769,16 @@ class Session:
                 or d.get("cache_ref_bytes") or d.get("shared_h2d_bytes")):
             # device-path line: XLA compile wall, host<->device bytes and
             # execute+fetch time attributed to this statement's cop tasks,
-            # plus bytes served from cached device lanes (cache_ref) and
-            # grouped-launch shared uploads (shared_h2d, PR 5)
+            # plus bytes served from cached device lanes (cache_ref),
+            # grouped-launch shared uploads (shared_h2d, PR 5), and the
+            # tile-codec split: dense bytes the uploads represent
+            # (logical) vs narrowed/compressed bytes that moved (wire)
             lines.append(
                 f"device: compile_ms:{d['compile_ms']:.3f} "
                 f"transfer_bytes:{int(d['transfer_bytes'])} "
                 f"device_ms:{d['device_ms']:.3f} "
+                f"logical_bytes:{int(d.get('logical_bytes', 0))} "
+                f"wire_bytes:{int(d.get('wire_bytes', 0))} "
                 f"cache_ref:{int(d.get('cache_ref_bytes', 0))} "
                 f"shared_h2d:{int(d.get('shared_h2d_bytes', 0))} "
                 f"lanes:{len(self.cop.tpu.lanes) if self.cop._tpu else 1} "

@@ -159,6 +159,14 @@ _sv("tidb_timeline_ring_capacity", "8192", scope="global", kind="int", lo=64,
 _sv("tidb_tpu_cop_lanes", "0", scope="global", kind="int", lo=0, hi=256,
     consumed=True)
 
+# --- compressed, width-narrowed device tiles (PR 7) -------------------------
+# ON (default): batches pad to power-of-two row buckets (min 256) and each
+# column ships in the cheapest of dense/pack/dict/rle form with decode
+# fused into the device program. OFF forces the legacy dense 64Ki-tile
+# layout — the A/B baseline and the incident fallback. GLOBAL-only: the
+# layout keys the store-wide compile cache and batcher groups
+_sv("tidb_tpu_tile_compression", "ON", scope="global", kind="bool", consumed=True)
+
 # --- server memory arbitration (PR 4: utils/memory ServerMemTracker) -------
 # store-wide hard limit on tracked statement memory; 0 = unlimited.
 # GLOBAL-only like the reference: a per-session opt-out would defeat it

@@ -257,11 +257,18 @@ class ServerMemTracker(MemTracker):
                     fire = True
                 caches = [r() for r in self._caches] if fire else []
             if fire:
-                self._event("degrade", detail=f"soft limit {int(soft)} exceeded")
-                M.SERVER_MEM_ACTIONS.inc(action="degrade")
+                freed = 0.0
                 for cache in caches:
                     if cache is not None:
-                        cache.evict_all()
+                        # evict_all reports real bytes RELEASED FOR
+                        # COLLECTION (host lanes + compressed mirror wire
+                        # bytes, no padded-tile estimates); batches still
+                        # pinned by in-flight tasks free when they finish
+                        freed += float(cache.evict_all() or 0)
+                self._event("degrade",
+                            detail=f"soft limit {int(soft)} exceeded",
+                            dropped=int(freed))
+                M.SERVER_MEM_ACTIONS.inc(action="degrade")
         if c <= L:
             return
         with self._reg_lock:

@@ -366,6 +366,19 @@ TPU_SHARED_UPLOAD_BYTES = REGISTRY.counter(
     "h2d bytes uploaded by grouped launches on behalf of the whole group",
 )
 
+# compressed, width-narrowed device tiles (PR 7): per-lane wire bytes by
+# the codec that produced them (dense | pack | dict | rle), and the rows
+# of padding every DeviceBatch still adds beyond its real row count —
+# together they tell how much of the h2d stream is signal
+TPU_TILE_COMPRESSED_BYTES = REGISTRY.counter(
+    "tidb_tpu_tile_compressed_bytes_total",
+    "device tile lane wire bytes after codec encode, by codec",
+)
+TPU_TILE_ROWS_PADDED = REGISTRY.counter(
+    "tidb_tpu_tile_rows_padded_total",
+    "padding rows added to device tiles beyond the real batch rows",
+)
+
 # --- per-device runner lanes (PR 6: mesh-wide cop dispatch) ----------------
 # every mesh device is a cop runner lane with its own queue position,
 # breaker and timeline lane; `device` labels carry the lane name (cpu:3)

@@ -438,6 +438,12 @@ def phase_counters(phases: dict) -> list[tuple[str, float]]:
         # device-cache hits: bytes SERVED from a prior statement's upload
         # (zero-duration cache_ref annotation), never charged as transfer
         out.append(("cache_ref_bytes", phases["cache_ref_bytes"]))
+    # tile-codec split of the h2d uploads: what the lanes represent
+    # uncompressed vs what the narrowed/compressed form actually moved
+    if phases.get("logical_bytes"):
+        out.append(("logical_bytes", phases["logical_bytes"]))
+    if phases.get("wire_bytes"):
+        out.append(("wire_bytes", phases["wire_bytes"]))
     return out
 
 

@@ -1,0 +1,235 @@
+"""Compressed-tile bench (ISSUE 7 acceptance gate) — paired dense vs
+compressed on the shared paired_bench harness.
+
+Two workloads, each sampled with the device mirrors dropped before every
+timed sample so EVERY sample pays the real h2d upload (the cost the tile
+layout exists to shrink; cached-mirror steady state is the PR 5 cache_ref
+path and is not what this bench measures):
+
+  point   32 point-agg COP TASKS over 1024-row batches, executed at the
+          engine boundary (the bench_sched capture pattern) — the shape
+          where 64Ki-row padding dominated (a ~10KB task uploading
+          ~1.2MB); task-level because statement parse/plan/admission
+          overhead is mode-independent and would bury the device delta
+  q1scan  one Q1-style GROUP BY STATEMENT over a 512K-row table (8 full
+          tiles) — the scan shape where encode/decode cost could
+          conceivably hurt
+
+Modes flip `tidb_tpu_tile_compression` per sample, interleaved and paired
+per the noisy-box rule (BASELINE.md: gate on the median PAIRED delta,
+never on means of separate runs). Gates:
+
+  point:  p50 speedup >= 1.3x AND h2d wire bytes reduced >= 8x
+  q1scan: p50 speedup >= 0.95x (compressed must not regress the scan)
+
+Writes BENCH_tiles_pr7.json; exits non-zero on gate failure. Runs under
+`tools/t1.sh --bench`.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+POINT_TASKS = 32
+POINT_ROWS = 1024
+POINT_REPS = 15
+Q1_ROWS = 512 * 1024
+REPS = 9  # per mode per workload; rep 0 warms both paths
+
+
+def _drop_mirrors(session):
+    with session.cop.tiles._lock:
+        for b in session.cop.tiles._cache.values():
+            b._mirrors = None
+
+
+def _set_mode(session, mode: str) -> None:
+    on = "ON" if mode == "on" else "OFF"
+    session.execute(f"SET GLOBAL tidb_tpu_tile_compression = {on}")
+
+
+def _paired(session, queries, reps) -> dict:
+    """Interleaved paired off/on loop; every timed statement pays a fresh
+    mirror upload. Returns per-mode p50s, the median paired speedup, and
+    per-mode h2d wire bytes per statement (cop.stats['wire_bytes'])."""
+    lat = {"off": [], "on": []}
+    wire = {"off": [], "on": []}
+    ratios = []
+
+    def timed(mode, q):
+        _set_mode(session, mode)
+        _drop_mirrors(session)
+        w0 = session.cop.stats["wire_bytes"]
+        t0 = time.perf_counter()
+        session.must_query(q)
+        dt = time.perf_counter() - t0
+        return dt, session.cop.stats["wire_bytes"] - w0
+
+    for rep in range(reps):
+        for qi, q in enumerate(queries):
+            order = ("off", "on") if (rep + qi) % 2 == 0 else ("on", "off")
+            pair = {m: timed(m, q) for m in order}
+            if rep:  # rep 0 warms every program in both modes
+                for m in ("off", "on"):
+                    lat[m].append(pair[m][0])
+                    wire[m].append(pair[m][1])
+                ratios.append(pair["off"][0] / pair["on"][0])
+    _set_mode(session, "on")
+    return {
+        "p50_off_ms": round(statistics.median(lat["off"]) * 1e3, 3),
+        "p50_on_ms": round(statistics.median(lat["on"]) * 1e3, 3),
+        "speedup_x": round(statistics.median(ratios), 3),
+        "wire_off_bytes": int(statistics.median(wire["off"])),
+        "wire_on_bytes": int(statistics.median(wire["on"])),
+        "samples_per_mode": len(lat["off"]),
+    }
+
+
+def _bit_identical(a, b) -> bool:
+    import numpy as np
+
+    return (
+        a.num_cols == b.num_cols
+        and a.num_rows == b.num_rows
+        and all(
+            np.array_equal(ca.data, cb.data) and np.array_equal(ca.valid, cb.valid)
+            for ca, cb in zip(a.columns, b.columns)
+        )
+    )
+
+
+def _point_bench(s) -> dict:
+    """Per-task engine-boundary p50 over the captured point-agg (DAG,
+    batch) pairs, paired dense/compressed with fresh mirrors per sweep;
+    h2d wire bytes from the transfer series; results cross-checked
+    bit-identical between modes."""
+    from bench_sched import _capture_pairs
+    from tidb_tpu.utils import metrics as M
+
+    eng = s.store.sched.tpu_engine
+    pairs = _capture_pairs(s, POINT_TASKS, POINT_ROWS)
+
+    lat = {"off": [], "on": []}
+    wire = {"off": [], "on": []}
+    ratios = []
+    reference = None
+
+    def sweep(mode):
+        _set_mode(s, mode)
+        _drop_mirrors(s)
+        h0 = M.TPU_TRANSFER_BYTES.value(dir="h2d")
+        walls, out = [], []
+        for dag, batch in pairs:
+            t0 = time.perf_counter()
+            out.append(eng.execute(dag, batch))
+            walls.append(time.perf_counter() - t0)
+        return walls, M.TPU_TRANSFER_BYTES.value(dir="h2d") - h0, out
+
+    for rep in range(POINT_REPS):
+        order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+        got = {m: sweep(m) for m in order}
+        if reference is None:
+            reference = got["off"][2]
+        for m, (walls, w, out) in got.items():
+            assert all(_bit_identical(a, b) for a, b in zip(out, reference)), \
+                f"{m} results diverged"
+            if rep:
+                lat[m].extend(walls)
+                wire[m].append(w / len(pairs))
+        if rep:
+            ratios.append(
+                statistics.median(got["off"][0]) / statistics.median(got["on"][0])
+            )
+    _set_mode(s, "on")
+    return {
+        "workload": "point_agg_cop_task",
+        "tasks": POINT_TASKS,
+        "rows_per_task": POINT_ROWS,
+        "p50_off_ms": round(statistics.median(lat["off"]) * 1e3, 3),
+        "p50_on_ms": round(statistics.median(lat["on"]) * 1e3, 3),
+        "speedup_x": round(statistics.median(ratios), 3),
+        "wire_off_bytes": int(statistics.median(wire["off"])),
+        "wire_on_bytes": int(statistics.median(wire["on"])),
+        "samples_per_mode": len(lat["off"]),
+    }
+
+
+def run_tiles_bench() -> dict:
+    from tidb_tpu.session import Session
+
+    s = Session()
+    s.vars["tidb_enable_cop_result_cache"] = "OFF"
+    s.vars["tidb_cop_engine"] = "tpu"
+
+    # point workload: one region-range cop task per statement
+    s.execute("CREATE TABLE pt (id INT PRIMARY KEY, v INT, w INT)")
+    total = POINT_TASKS * POINT_ROWS
+    for lo in range(0, total, 8192):
+        s.execute("INSERT INTO pt VALUES " + ",".join(
+            f"({i}, {i % 997}, {(i * 7) % 131})" for i in range(lo, lo + 8192)))
+    point = _point_bench(s)
+    point["wire_reduction_x"] = round(
+        point["wire_off_bytes"] / max(point["wire_on_bytes"], 1), 1
+    )
+
+    # Q1-scale scan: full-tile batches, direct-addressed GROUP BY
+    s.execute(
+        "CREATE TABLE q1 (id INT PRIMARY KEY, g INT, v INT, w INT, f DOUBLE)"
+    )
+    for lo in range(0, Q1_ROWS, 8192):
+        s.execute("INSERT INTO q1 VALUES " + ",".join(
+            f"({i}, {i % 4}, {i % 9973}, {(i * 13) % 257}, {i % 83}.25)"
+            for i in range(lo, lo + 8192)))
+    q1_qs = [
+        "SELECT g, COUNT(*), SUM(v), SUM(w), MIN(v), MAX(w), AVG(f)"
+        " FROM q1 GROUP BY g ORDER BY g"
+    ]
+    q1 = _paired(s, q1_qs, REPS + 4)  # single query: take more reps
+    q1["workload"] = "q1_scan"
+    q1["rows"] = Q1_ROWS
+
+    point_pass = point["speedup_x"] >= 1.3 and point["wire_reduction_x"] >= 8.0
+    q1_pass = q1["speedup_x"] >= 0.95
+    return {
+        "bench": "tiles_dense_vs_compressed",
+        "point": point,
+        "q1scan": q1,
+        "gates": {
+            "point_speedup_min_x": 1.3,
+            "point_wire_reduction_min_x": 8.0,
+            "q1_speedup_min_x": 0.95,
+        },
+        "pass": bool(point_pass and q1_pass),
+    }
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+
+    # the paired_bench bootstrap, inline: this gate reports speedups and
+    # byte ratios, not an overhead_pct, so bench_main's failure line
+    # doesn't fit
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    out = run_tiles_bench()
+    print(json.dumps(out, indent=2))
+    with open(os.path.join(root, "BENCH_tiles_pr7.json"), "w", encoding="utf8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    if not out["pass"]:
+        print(
+            f"FAIL: compressed-tiles gates not met: point "
+            f"{out['point']['speedup_x']}x / wire "
+            f"{out['point']['wire_reduction_x']}x, q1 "
+            f"{out['q1scan']['speedup_x']}x",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    sys.exit(0)

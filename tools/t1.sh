@@ -4,13 +4,15 @@
 # Exits non-zero on any test failure; prints DOTS_PASSED=<count> last.
 #
 #   bash tools/t1.sh --bench
-# additionally runs the overhead gates (paired off/on p50, ≤5%):
+# additionally runs the overhead gates (paired off/on p50, ≤5%) and the
+# compressed-tile gate (paired dense/compressed speedup + wire bytes):
 #   tools/bench_trace_overhead.py    -> BENCH_trace_pr3.json
 #   tools/bench_watchdog_overhead.py -> BENCH_watchdog_pr4.json
 #   tools/bench_timeline_overhead.py -> BENCH_timeline_pr5.json
+#   tools/bench_tiles.py             -> BENCH_tiles_pr7.json
 cd "$(dirname "$0")/.." || exit 1
 if [ "$1" = "--bench" ]; then
-  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead; do
+  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
   done
 fi
