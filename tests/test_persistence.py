@@ -138,3 +138,63 @@ class TestWalRecovery:
         s.store.wal.close()
         s2 = _restart(ddir)
         assert s2.must_query("SELECT COUNT(*) FROM lineitem") == before
+
+
+class TestRunawayWatchPersistence:
+    """PR 8 satellite: the per-store runaway watch list survives restart
+    through the catalog meta — repeat offenders stay rejected, expired
+    entries are swept on load."""
+
+    def test_kill_watch_survives_restart(self, ddir):
+        import pytest as _pt
+
+        from tidb_tpu.errors import RunawayKilled, RunawayQuarantined
+        from tidb_tpu.utils.failpoint import FP
+
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t VALUES " + ",".join(f"({i},{i})" for i in range(64)))
+        s.execute("CREATE RESOURCE GROUP rg_kill "
+                  "QUERY_LIMIT=(EXEC_ELAPSED='50ms', ACTION=KILL, WATCH='60s')")
+        s.execute("SET RESOURCE GROUP rg_kill")
+        with FP.enabled("cop/before-task", ("sleep", 0.15)):
+            with _pt.raises(RunawayKilled):
+                s.must_query("SELECT SUM(v) FROM t")
+        # drop the limit BEFORE restart: only the persisted WATCH can
+        # fire on the new store (a cold second store could otherwise
+        # breach the 50ms EXEC_ELAPSED first and mask the quarantine)
+        s.execute("ALTER RESOURCE GROUP rg_kill QUERY_LIMIT=NULL")
+        s.store.wal.close()
+
+        s2 = _restart(ddir)  # fresh store, fresh RunawayManager
+        s2.execute("SET RESOURCE GROUP rg_kill")
+        with _pt.raises(RunawayQuarantined, match="watch list"):
+            s2.must_query("SELECT SUM(v) FROM t")
+        # the restored entry shows in the memtable with its group/action
+        rows = s2.must_query(
+            "SELECT RESOURCE_GROUP, ACTION FROM information_schema.runaway_watches")
+        assert ("rg_kill", "KILL") in rows
+
+    def test_expired_watch_swept_on_load(self, ddir):
+        s = Session(Storage(data_dir=ddir))
+        mgr = s.store.sched.runaway
+        mgr.mark("digest-live", "rg1", "KILL", "exec_elapsed", 60_000)
+        mgr.mark("digest-dead", "rg1", "KILL", "exec_elapsed", 1)  # 1ms TTL
+        s.store.wal.close()
+        import time as _t
+
+        _t.sleep(0.05)
+        s2 = _restart(ddir)
+        mgr2 = s2.store.sched.runaway
+        live = mgr2.watch_for("digest-live", "rg1")
+        assert live is not None and live.action == "KILL"
+        assert mgr2.watch_for("digest-dead", "rg1") is None
+        # swept from the meta as well, not just the in-memory table
+        from tidb_tpu.catalog.meta import Meta
+
+        txn = s2.store.begin()
+        try:
+            digests = {d["digest"] for d in Meta(txn).list_runaway_watches()}
+        finally:
+            txn.rollback()
+        assert "digest-live" in digests and "digest-dead" not in digests

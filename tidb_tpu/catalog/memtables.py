@@ -405,10 +405,22 @@ def _inspection_rows(session) -> list:
             Datum.s(reference), Datum.s(severity), Datum.s(details),
         ])
 
-    fallbacks = getattr(getattr(session.cop, "tpu", None), "fallbacks", 0)
+    # every device path's declines count, not just cop lowering — read
+    # the per-reason accounting (NOT the process-global registry: two
+    # stores in one process must not see each other's fallbacks, the
+    # same scoping rule the breaker series follows). The labeled
+    # tidb_tpu_fallback_total{path,reason} series carries the
+    # process-wide per-reason split for /metrics consumers.
+    cop = session.cop
+    fallbacks = getattr(cop._tpu, "fallbacks", 0) if cop._tpu else 0
+    mpp_eng = getattr(cop, "_mpp", None)
+    if mpp_eng is not None:
+        fallbacks += mpp_eng.fallbacks
+    fallbacks += int(cop.stats.get("window_fallbacks", 0))
     if fallbacks:
         add("engine", "tpu-fallback-count", fallbacks, "0", "warning",
-            "queries fell back from the device engine to the host engine")
+            "statements fell back from a device path (cop/mpp/window) to "
+            "the host engine — reason split: tidb_tpu_fallback_total{path,reason}")
     hits = getattr(session, "plan_cache_hits", 0)
     size = len(getattr(session, "_plan_cache", ()))
     add("plan-cache", "entries", size, "-", "info", f"hits this session: {hits}")

@@ -30,6 +30,9 @@ P_JOB_HIST = b"m:jobh:"  # finished jobs (ADMIN SHOW DDL JOBS)
 P_SEQ = b"m:seq:"  # sequences (ref: ddl sequence objects, meta/autoid SequenceAllocator)
 P_VIEW = b"m:view:"  # view definitions (stored SELECT text)
 P_RG = b"m:rg:"  # resource groups (ref: meta.go ResourceGroup key space, DDL-managed)
+P_RW = b"m:rw:"  # runaway watch list (sched/runaway.py): persisted KILL/
+# COOLDOWN/DRYRUN digest watches so repeat offenders stay rejected across
+# store restart (ref: mysql.tidb_runaway_watch, swept by TTL on load)
 
 
 class Meta:
@@ -148,6 +151,22 @@ class Meta:
 
     def list_resource_groups(self) -> list[dict]:
         return [json.loads(v) for _, v in self.txn.scan(P_RG, P_RG + b"\xff")]
+
+    # --- runaway watch list (ref: mysql.tidb_runaway_watch; spec dicts
+    # carry WALL-clock expiry so a restart can rebuild monotonic TTLs) ---
+
+    @staticmethod
+    def _rw_key(group: str, digest: str) -> bytes:
+        return P_RW + f"{group}:{digest}".encode()
+
+    def put_runaway_watch(self, d: dict) -> None:
+        self.txn.put(self._rw_key(d["group"], d["digest"]), json.dumps(d).encode())
+
+    def drop_runaway_watch(self, group: str, digest: str) -> None:
+        self.txn.delete(self._rw_key(group, digest))
+
+    def list_runaway_watches(self) -> list[dict]:
+        return [json.loads(v) for _, v in self.txn.scan(P_RW, P_RW + b"\xff")]
 
     # --- DDL job queue (ref: ddl.go:535 doDDLJob, meta job lists) ----------
 

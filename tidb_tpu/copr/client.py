@@ -39,6 +39,7 @@ from ..errors import (
 from ..mysqltypes.datum import Datum, K_BYTES
 from ..sched import SchedCtx, ru_cost
 from ..utils import memory
+from ..utils import metrics as M
 from ..utils import timeline as TL
 from ..utils import tracing
 from ..utils.failpoint import inject as _fp
@@ -167,6 +168,13 @@ class CopClient:
             # memory-arbitration + runaway counters (PR 4)
             "mem_degraded_tasks": 0,
             "processed_rows": 0,
+            # unified fault domain (PR 8): MPP dispatches/declines and
+            # device-window runs/declines, per statement (EXPLAIN ANALYZE
+            # `mpp:` / `window:` lines ride the before/after delta)
+            "mpp_tasks": 0,
+            "mpp_fallbacks": 0,
+            "window_device_tasks": 0,
+            "window_fallbacks": 0,
         }
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -542,6 +550,7 @@ class CopClient:
             # Forced 'tpu' stays forced (the explicit-engine contract)
             engine = "host"
             st("mem_degraded_tasks")
+            M.TPU_FALLBACK.inc(path="cop", reason="mem_degrade")
             if trace is not None and trace.recording:
                 trace.closed_span("mem.degrade", 0.0,
                                   consumed=self.storage.mem.consumed,
@@ -617,6 +626,7 @@ class CopClient:
                             if engine == "tpu":
                                 self.tpu.raise_breakers_open()
                             st("breaker_skips")
+                            M.TPU_FALLBACK.inc(path="cop", reason="breaker_open")
                             if trace is not None and trace.recording:
                                 trace.closed_span(
                                     "breaker.skip", 0.0,
@@ -669,6 +679,7 @@ class CopClient:
                                 # is a correctness bug masked by the host answer
                                 # (VERDICT Weak#5)
                                 st("fallback_errors")
+                                M.TPU_FALLBACK.inc(path="cop", reason="device_error")
                                 # keep the stack: a fatal classification may be
                                 # a masked lowering bug (VERDICT Weak#5)
                                 log.warning(

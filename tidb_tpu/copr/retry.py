@@ -88,6 +88,8 @@ class Backoffer:
         self.errors: list[BaseException] = []
         self._rng = rng or random.Random()
         self._stats = stats  # optional callable(key, n) — client counters
+        self._runaway = None  # RunawayChecker, for in-flight COOLDOWN
+        self._demote_applied = False
 
     @classmethod
     def for_ctx(cls, sctx, budget_ms: float | None = None, stats=None):
@@ -105,13 +107,18 @@ class Backoffer:
             # runaway COOLDOWN: a demoted statement gets a quarter of the
             # sleep budget — less patience for a known misbehaver
             budget_ms *= 0.25
-        return cls(
+        bo = cls(
             budget_ms,
             deadline=getattr(sctx, "deadline", None),
             session=getattr(sctx, "session", None),
             stats=stats,
             trace=getattr(sctx, "trace", None),
         )
+        # keep the checker: a COOLDOWN verdict landing MID-statement must
+        # demote the budget still unspent, not wait for the next statement
+        bo._runaway = rc
+        bo._demote_applied = rc is not None and rc.demoted
+        return bo
 
     @property
     def total_attempts(self) -> int:
@@ -121,6 +128,13 @@ class Backoffer:
         """Record `err` under `cfg`'s class and sleep its next interval;
         raises BackoffExhausted when the budget can't cover the sleep, and
         QueryInterrupted the moment a KILL/deadline lands mid-sleep."""
+        rc = self._runaway
+        if rc is not None and rc.demoted and not self._demote_applied:
+            # the COOLDOWN verdict fired while this statement was already
+            # retrying: quarter the budget it has NOT yet slept, effective
+            # from this very backoff — not from its next statement
+            self._demote_applied = True
+            self.budget_ms = self.slept_ms + (self.budget_ms - self.slept_ms) * 0.25
         n = self.attempts.get(cfg.name, 0)
         self.attempts[cfg.name] = n + 1
         self.errors.append(err)
@@ -189,6 +203,65 @@ def classify_device_error(exc: BaseException):
     if any(m in low for m in _TRANSIENT_MARKERS):
         return DeviceTransientError(msg)
     return DeviceFatalError(msg)
+
+
+# --- the one shared device-boundary guard ----------------------------------
+
+
+def guarded_device_call(fn, bo: "Backoffer", breakers=(), forced: bool = False,
+                        failpoint: str | None = None):
+    """Run a device-path callable under the unified fault domain — the
+    MPP gather and the device window route share this with the cop
+    path's inline boundary (client._run_engines), so every device entry
+    point fails the same way:
+
+      * escaping exceptions are CLASSIFIED (classify_device_error) —
+        interrupts / quota / SQL errors propagate untouched (any claimed
+        half-open probe slot is released, no fault counted);
+      * every device fault feeds every breaker in `breakers` (one event
+        per exception instance per breaker);
+      * transients retry through `bo` (per-task budget, KILL/deadline-
+        aware sleeps) while no breaker has tripped;
+      * with `forced` (engine='tpu' / enforce), the typed error raises;
+        otherwise the terminal fault is RETURNED so the caller degrades
+        to host with a typed reason and zero further exception cost.
+
+    Returns (result, None) on success — breakers hear record_success
+    only when `result is not None`, because a None result means the
+    callable declined before touching the device (a half-open probe must
+    not close on no evidence) — or (None, err) when the device path
+    lost. tools/lint_boundaries.py pins this as the ONE sanctioned
+    blanket-except site for the MPP/window boundaries."""
+    from ..utils.failpoint import inject as _fp
+
+    while True:
+        try:
+            if failpoint is not None:
+                _fp(failpoint)
+            res = fn()
+        except Exception as exc:  # noqa: BLE001 — classified, never absorbed
+            err = classify_device_error(exc)
+            if err is None:
+                for b in breakers:
+                    b.record_aborted()
+                raise
+            tripped = False
+            for b in breakers:
+                tripped = b.record_failure(exc) or tripped
+            if isinstance(err, DeviceTransientError) and not tripped:
+                try:
+                    bo.backoff(BO_DEVICE, err)
+                except BackoffExhausted as bex:
+                    err = bex
+                else:
+                    continue
+            if forced:
+                raise err from exc
+            return None, err
+        if res is not None:
+            for b in breakers:
+                b.record_success()
+        return res, None
 
 
 # --- circuit breaker --------------------------------------------------------

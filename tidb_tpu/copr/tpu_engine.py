@@ -720,6 +720,7 @@ class TPUEngine:
                 if plan is None:
                     with self._lock:
                         self.fallbacks += 1
+                    M.TPU_FALLBACK.inc(path="cop", reason="not_lowerable")
                     return execute_dag_host(dag, batch)
                 if isinstance(plan, DevicePlan):
                     chunk = _mark_device(plan.finalize(_fetch(plan.launch())))
@@ -780,6 +781,7 @@ class TPUEngine:
             if plan is None:
                 with self._lock:
                     self.fallbacks += 1
+                M.TPU_FALLBACK.inc(path="cop", reason="not_lowerable")
                 results[i] = execute_dag_host(dag, batch)
             elif isinstance(plan, DevicePlan):
                 if plan.key is not None and plan.args is not None:

@@ -91,9 +91,13 @@ def drain(e: Executor) -> Chunk:
                 tracker.consume(chunk_bytes(c))
             chunks.append(c)
     e.close()
-    if not chunks:
-        return Chunk.empty(e.out_fts, 0)
-    return Chunk.concat_all(chunks)
+    out = Chunk.empty(e.out_fts, 0) if not chunks else Chunk.concat_all(chunks)
+    # LAST poll after materialization: a kill verdict (user KILL, memory
+    # arbiter, runaway) landing while the final concat ran must not be
+    # outrun by the statement finishing — the flag would be cancelled at
+    # teardown and the over-limit result served as if nothing happened
+    raise_if_interrupted(sess, getattr(sess, "_deadline", None) if sess is not None else None)
+    return out
 
 
 # ------------------------------------------------------------------- builder
@@ -770,10 +774,59 @@ class WindowExec(Executor):
                     qv[g] = True
         return qs[pid], qv[pid]
 
+    def _device_guard_ctx(self):
+        """(sctx, stats_fn, breaker) for the device window boundary: the
+        window kernel runs a plain jit on the DEFAULT device, which is
+        runner lane 0 — that lane's circuit breaker is the one this path
+        feeds and is gated by."""
+        if self.ctx is None or getattr(self.ctx, "cop", None) is None:
+            return None, None, None
+        client = self.ctx.cop
+        sctx = client._sched_ctx()
+        return sctx, client._stats_fn(sctx), client.tpu.breaker
+
+    def _device_window_call(self, eng, sctx, st, breaker, fn):
+        """One guarded device-window attempt under the unified fault
+        domain (copr/retry.guarded_device_call): typed classification,
+        transient retry on the statement's backoff budget, breaker feed.
+        Returns results (None = cache miss), or None after setting
+        `fallback_reason` when the device path lost and `auto` degrades;
+        forced 'tpu' raises the typed error instead."""
+        from ..copr.retry import Backoffer, guarded_device_call
+        from ..utils import metrics as M
+
+        bo = Backoffer.for_ctx(sctx, stats=st)
+        res, err = guarded_device_call(
+            fn, bo,
+            breakers=(breaker,) if breaker is not None else (),
+            forced=eng == "tpu",
+            failpoint="window/device-error",
+        )
+        if err is not None:
+            # a device-path failure must never be silent: typed reason in
+            # EXPLAIN ANALYZE + the labeled fallback series, stack kept
+            # (a fatal classification may be a masked lowering bug)
+            self.fallback_reason = f"device window failed: {type(err).__name__}: {err}"
+            M.TPU_FALLBACK.inc(path="window", reason="device_error")
+            if st is not None:
+                st("window_fallbacks")
+                st("fallback_errors")
+            trace = getattr(sctx, "trace", None) if sctx is not None else None
+            if trace is not None and trace.recording:
+                trace.closed_span("window.degrade", 0.0, reason="device_error",
+                                  error=type(err).__name__)
+            return None, err
+        return res, None
+
     def _try_device(self, c: Chunk, n: int):
         """Route the window onto the device (sort + segmented scans in one
         XLA program — window_device.py) when the engine allows and every
-        func/lane has a device form. Returns the output Chunk or None."""
+        func/lane has a device form. Returns the output Chunk or None.
+
+        Device faults here live in the SAME fault domain as the cop path
+        (PR 8): typed taxonomy, Backoffer retry for transients, lane-0
+        breaker feed/gating, `auto` degrading to the host twin with a
+        typed reason and forced 'tpu' surfacing the real state."""
         from .window_device import MIN_DEVICE_ROWS
 
         eng = getattr(self.ctx, "engine", "auto") if self.ctx is not None else "auto"
@@ -782,7 +835,42 @@ class WindowExec(Executor):
             min_rows = int(self.ctx.vars.get("tidb_window_device_min_rows", MIN_DEVICE_ROWS))
         if eng == "host" or (eng != "tpu" and n < min_rows):
             return None
+        from ..utils import metrics as M
         from .window_device import encode_obj, run_cached_window, run_device_window
+
+        sctx, st, breaker = self._device_guard_ctx()
+        if breaker is not None and not breaker.allow():
+            # upfront decline at zero exception cost: `auto` reaches the
+            # host twin exactly like a breaker-skipped cop task; forced
+            # 'tpu' fails fast with the breaker state
+            if eng == "tpu":
+                breaker.raise_open()
+            self.fallback_reason = f"device breaker open ({breaker.describe()})"
+            M.TPU_FALLBACK.inc(path="window", reason="breaker_open")
+            if st is not None:
+                st("window_fallbacks")
+                st("breaker_skips")
+            trace = getattr(sctx, "trace", None) if sctx is not None else None
+            if trace is not None and trace.recording:
+                trace.closed_span("window.degrade", 0.0, reason="breaker_open",
+                                  state=breaker.describe())
+            return None
+        try:
+            return self._try_device_admitted(
+                c, n, eng, sctx, st, breaker, encode_obj,
+                run_cached_window, run_device_window,
+            )
+        finally:
+            if breaker is not None:
+                # declines that never touched the device (unsupported
+                # func, cache miss resolved by the fresh path, small
+                # input) release a claimed half-open probe slot; after a
+                # recorded success/failure this is a no-op
+                breaker.record_aborted()
+
+    def _try_device_admitted(self, c: Chunk, n: int, eng, sctx, st, breaker,
+                             encode_obj, run_cached_window, run_device_window):
+        from ..utils import metrics as M
 
         # stable provenance for the device-input cache: a plain unfiltered
         # scan of an unchanged table yields identical lanes every run —
@@ -816,15 +904,15 @@ class WindowExec(Executor):
                     prov = (getattr(storage, "store_uid", ""), tbl.id, ver,
                             _hl.sha256(spec.encode()).hexdigest()[:16])
         if prov is not None:
-            try:
-                results = run_cached_window(prov, n)
-            except Exception as e:  # noqa: BLE001 — same contract as below
-                if eng == "tpu":
-                    raise
-                self.fallback_reason = f"device window failed: {type(e).__name__}: {e}"
+            results, err = self._device_window_call(
+                eng, sctx, st, breaker, lambda: run_cached_window(prov, n)
+            )
+            if err is not None:
                 return None
             if results is not None:
                 self.last_engine = "tpu"
+                if st is not None:
+                    st("window_device_tasks")
                 cols = list(c.columns)
                 nbase = len(cols)
                 for i, (data, valid) in enumerate(results):
@@ -841,6 +929,7 @@ class WindowExec(Executor):
             fspecs = self._device_fspecs(c, n, range_stats)
         except _NotOnDevice as e:
             self.fallback_reason = str(e)
+            M.TPU_FALLBACK.inc(path="window", reason="not_supported")
             return None
 
         def key_lane(e):
@@ -857,15 +946,16 @@ class WindowExec(Executor):
         if not any(f.get("frame") is not None and len(f["frame"]) > 5 for f in fspecs):
             range_lane = None  # computed above only when a frame uses it
         rng_arg = (range_lane + range_stats) if range_lane is not None else None
-        try:
-            results = run_device_window(part, order, fspecs, n, provenance=prov,
-                                        range_lane=rng_arg)
-        except Exception as e:  # noqa: BLE001 — device route is best-effort
-            if eng == "tpu":
-                raise  # forced device: surface the real failure
-            self.fallback_reason = f"device window failed: {type(e).__name__}: {e}"
+        results, err = self._device_window_call(
+            eng, sctx, st, breaker,
+            lambda: run_device_window(part, order, fspecs, n, provenance=prov,
+                                      range_lane=rng_arg),
+        )
+        if err is not None or results is None:
             return None
         self.last_engine = "tpu"
+        if st is not None:
+            st("window_device_tasks")
         cols = list(c.columns)
         nbase = len(cols)
         for i, (data, valid) in enumerate(results):

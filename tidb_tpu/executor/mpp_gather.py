@@ -9,13 +9,19 @@ the psum'd partials / joined rows straight off the mesh."""
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from ..chunk.chunk import Chunk
 from ..codec import tablecodec
 from ..planner.fragment import MPPPlan, slice_plan
-from ..planner.plans import Aggregation, Join, LogicalPlan
+from ..planner.plans import Join, LogicalPlan
+from ..sched.scheduler import raise_if_interrupted
+from ..utils import memory
 from .executors import ExecContext, Executor, FinalHashAggExec
+
+log = logging.getLogger("tidb_tpu.mpp")
 
 
 def _has_join(plan: LogicalPlan) -> bool:
@@ -33,8 +39,32 @@ def try_build_mpp(plan: LogicalPlan, ctx: ExecContext) -> Executor | None:
         return None
     if not _has_join(plan):
         return None
-    mplan = slice_plan(plan)
+    reason: list = []
+    mplan = slice_plan(plan, reason)
     if mplan is None:
+        # a slice-time decline (string/float join keys, plan shape) is a
+        # TYPED fallback too — counted ONCE per statement per failing
+        # join node: try_build_mpp fires again for every nested Join the
+        # host build recurses into (and an Aggregation pass precedes its
+        # Join's), so the dedup keys on (statement ctx, failing node)
+        if isinstance(plan, Join) and reason:
+            key, detail, src = reason[0]
+            seen = getattr(ctx, "_mpp_declines", None)
+            if seen is None:
+                seen = ctx._mpp_declines = set()
+            if id(src) not in seen:
+                seen.add(id(src))
+                engine = ctx.cop.mpp
+                engine._fallback(key, detail)
+                if ctx.vars.get("tidb_enforce_mpp", "OFF") == "ON":
+                    from .executors import _ACTIVE_SESSION
+
+                    sess = _ACTIVE_SESSION.get(None)
+                    if sess is not None:
+                        sess.warnings.append(
+                            f"MPP mode may be blocked because: {detail} "
+                            f"(tidb_enforce_mpp=ON)"
+                        )
         return None
     # uncommitted writes on any scanned table → membuffer must be visible;
     # tile lanes come from the committed snapshot only (UnionScan later)
@@ -115,11 +145,131 @@ class MPPGatherExec(Executor):
         return parts
 
     def _dispatch(self) -> Chunk | None:
+        """Run the fragment plan on the mesh under the UNIFIED device
+        fault domain (PR 8; arXiv:2203.01877 wants the accelerator path a
+        drop-in peer of the host path, arXiv:2604.28079 wants its
+        fallback graceful and observable):
+
+          * the shared per-lane circuit breakers gate the dispatch
+            upfront — when every lane refuses, MPP declines with typed
+            reason `breaker_open` at zero exception cost (exactly the cop
+            client's all-lanes-open → host rule), and a successful mesh
+            run doubles as the half-open probe;
+          * engine-boundary failures are classified into the typed
+            taxonomy and transients retry through a Backoffer drawing the
+            statement's per-task sleep budget, KILL/deadline-aware;
+          * the O(table-bytes) host-lane concatenation and the per-scan
+            mesh uploads poll the scheduler's shared interrupt gate and
+            charge the statement's MemTracker, so KILL, runaway verdicts
+            and memory arbitration reach MPP statements mid-flight.
+        """
+        from ..copr.retry import Backoffer, guarded_device_call
         from ..parallel.mesh import make_mesh
-        from ..parallel.mpp import ScanData
 
         client = self.ctx.cop
         engine = client.mpp
+        # reset per dispatch — the reason surface must describe THIS
+        # statement, never a stale decline from a previous one
+        engine.last_fallback_reason = ""
+        engine._decline_key = "not_supported"
+        sctx = client._sched_ctx()
+        st = client._stats_fn(sctx)
+        trace = getattr(sctx, "trace", None)
+        st("mpp_tasks")
+        rc = getattr(sctx, "runaway", None)
+        if rc is not None:
+            # the runaway watch list gates MPP like it gates cop
+            # admission: a quarantined digest is rejected (8254) before a
+            # single lane is built, a COOLDOWN watch demotes the backoff
+            # budget the retry loop below will draw from
+            rc.on_admission()
+
+        def gate():
+            raise_if_interrupted(sctx.session, sctx.deadline)
+
+        tpu = client.tpu
+        # claim the mesh: every lane whose breaker admits work (an open
+        # breaker past cooldown flips half-open here and this dispatch IS
+        # its probe). The SPMD program spans the whole mesh, so a fatal
+        # mesh fault feeds every admitted lane's breaker — and when no
+        # lane admits, MPP declines before building a single lane.
+        admitted = [l for l in tpu.lanes if l.breaker.allow()]
+        if not admitted:
+            engine._fallback(
+                "breaker_open",
+                f"device circuit breaker open ({tpu.breakers_describe()})",
+            )
+            st("mpp_fallbacks")
+            st("breaker_skips")
+            if trace is not None and trace.recording:
+                trace.closed_span("mpp.degrade", 0.0, reason="breaker_open",
+                                  state=tpu.breakers_describe())
+            return None
+        resolved = False  # admitted breakers heard success/failure/abort
+        try:
+            with memory.bind(getattr(sctx, "mem", None)):
+                scan_datas = self._build_scan_datas(client, engine, gate)
+                st("processed_rows", sum(sd.n_rows for sd in scan_datas))
+                mesh = engine._mesh if getattr(engine, "_mesh", None) is not None else make_mesh()
+                engine._mesh = mesh
+                bo = Backoffer.for_ctx(sctx, stats=st)
+                res, err = guarded_device_call(
+                    lambda: engine.execute(self.mplan, scan_datas, mesh,
+                                           self.ctx.vars, gate=gate),
+                    bo,
+                    breakers=[l.breaker for l in admitted],
+                    forced=False,  # enforce_mpp degrades with a warning,
+                    # like the reference planner — it never hard-fails
+                    failpoint="mpp/device-error",
+                )
+            # success/fault resolved every admitted breaker inside the
+            # guard; a prepare-time DECLINE touched no device, so the
+            # finally below releases any claimed probe slots instead
+            resolved = err is not None or res is not None
+            if err is not None:
+                # terminal device fault: degrade to the host join with the
+                # typed reason — never silently (a masked lowering bug
+                # would hide behind the host answer)
+                engine._fallback("device_error", f"{type(err).__name__}: {err}")
+                st("mpp_fallbacks")
+                st("fallback_errors")
+                log.warning("MPP mesh fault (%s); falling back to host join", err)
+                if trace is not None and trace.recording:
+                    trace.closed_span("mpp.degrade", 0.0, reason="device_error",
+                                      error=type(err).__name__)
+                return None
+            if res is None:
+                # prepare declined or the run drop-guarded (typed reason
+                # already counted by the engine)
+                st("mpp_fallbacks")
+                if trace is not None and trace.recording:
+                    trace.closed_span("mpp.degrade", 0.0,
+                                      reason=engine._decline_key,
+                                      detail=engine.last_fallback_reason)
+                return None
+        finally:
+            if not resolved:
+                # an interrupt/quota verdict escaped mid-build: release
+                # any claimed half-open probe slots without counting a
+                # device fault either way
+                for l in admitted:
+                    l.breaker.record_aborted()
+        chunk, agg_done = res
+        if chunk is not None and self.mplan.agg is not None and not agg_done:
+            return self._host_finish_agg(chunk)
+        return chunk
+
+    def _build_scan_datas(self, client, engine, gate) -> list:
+        """Host-side lane sets per scan fragment, through the engine's
+        (table, version)-keyed host-lane cache. The concatenation is
+        O(table bytes) per column: `gate` polls the shared interrupt gate
+        at every column so a KILL lands within one concat tick, and each
+        freshly built lane charges the statement's MemTracker through the
+        TLS seam `memory.bind` armed in _dispatch (cache hits are free —
+        the builder paid; the PR 4 volume-proxy rule)."""
+        from ..parallel.mpp import ScanData
+        from ..utils.failpoint import inject as _fp
+
         scan_datas = []
         for sf in self.mplan.scans:
             table = sf.ds.table
@@ -135,6 +285,8 @@ class MPPGatherExec(Executor):
             data, valid, orig_offs = [], [], []
             parts = None
             for pc in sf.ds.out_cols:
+                gate()  # one interrupt poll per lane-concat tick
+                _fp("mpp/lane-concat")
                 off = pc.orig_offset
                 orig_offs.append(off)
                 ck = (table.id, ver, off)
@@ -163,6 +315,10 @@ class MPPGatherExec(Executor):
                             np.empty(0, dtype=object if dt is VARLEN else dt),
                             np.zeros(0, dtype=bool),
                         )
+                    # freshly concatenated lane: the statement that built
+                    # it carries the bytes (quota breach raises 8175 here,
+                    # reaching MPP statements like any cop task)
+                    memory.consume_current(int(ent[0].nbytes) + int(ent[1].nbytes))
                     if cacheable:
                         engine._host_lane_put(ck, ent)
                 data.append(ent[0])
@@ -170,22 +326,17 @@ class MPPGatherExec(Executor):
             scan_datas.append(
                 ScanData(sf, data, valid, version=ver, shared=engine, orig_offs=orig_offs)
             )
-        mesh = engine._mesh if getattr(engine, "_mesh", None) is not None else make_mesh()
-        engine._mesh = mesh
-        res = engine.execute(self.mplan, scan_datas, mesh, self.ctx.vars)
-        if res is None:
-            return None
-        chunk, agg_done = res
-        if chunk is not None and self.mplan.agg is not None and not agg_done:
-            # the mesh joined the rows; partial aggregation finishes here
-            # (group-key domains that direct addressing can't hold)
-            from ..copr.dag import DAGRequest, ScanNode
-            from ..copr.dag import AggNode as _DagAgg
-            from ..copr.host_engine import _exec_agg
+        return scan_datas
 
-            pseudo = DAGRequest(
-                ScanNode(0, list(range(chunk.num_cols)), chunk.field_types(), [])
-            )
-            pseudo.agg = _DagAgg(self.mplan.agg.group_by, self.mplan.agg.aggs)
-            chunk = _exec_agg(pseudo, chunk, None)
-        return chunk
+    def _host_finish_agg(self, chunk: Chunk) -> Chunk:
+        """The mesh joined the rows; partial aggregation finishes here
+        (group-key domains that direct addressing can't hold)."""
+        from ..copr.dag import DAGRequest, ScanNode
+        from ..copr.dag import AggNode as _DagAgg
+        from ..copr.host_engine import _exec_agg
+
+        pseudo = DAGRequest(
+            ScanNode(0, list(range(chunk.num_cols)), chunk.field_types(), [])
+        )
+        pseudo.agg = _DagAgg(self.mplan.agg.group_by, self.mplan.agg.aggs)
+        return _exec_agg(pseudo, chunk, None)

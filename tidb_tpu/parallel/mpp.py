@@ -53,6 +53,8 @@ from ..chunk.chunk import Chunk, Column, col_numpy_dtype, VARLEN
 from ..expr.expression import Column as ExprCol, Constant, Expression
 from ..mysqltypes.datum import Datum
 from ..planner.fragment import BROADCAST, HASH, JoinFrag, MPPPlan, ScanFrag
+from ..utils import metrics as M
+from ..utils.memory import consume_current
 
 I64_MAX = np.iinfo(np.int64).max
 DIRECT_GROUP_MAX = 1 << 16
@@ -127,8 +129,13 @@ class MPPEngine:
     def __init__(self):
         self._programs: dict = {}
         self.compile_count = 0
-        self.fallbacks = 0
+        # per-reason fallback accounting (PR 8): every decline/degrade is
+        # counted under its TYPED reason key and fed to the labeled
+        # tidb_tpu_fallback_total{path="mpp"} series — the bare counter
+        # the DB inspection row used to read is now the sum (`fallbacks`)
+        self.fallback_counts: dict[str, int] = {}
         self.last_fallback_reason = ""  # EXPLAIN ANALYZE / bench surface
+        self._decline_key = "not_supported"  # typed key behind the text
         # device-resident input lanes keyed by (table_id, version, tag,
         # total, sharded): re-dispatching the same fragment plan must NOT
         # re-upload unchanged table lanes — over a remote device link the
@@ -146,6 +153,31 @@ class MPPEngine:
 
     HOST_CACHE_BYTES = 4 << 30
     STAT_CACHE_BYTES = 1 << 30
+
+    # --- typed fallback accounting ---------------------------------------
+
+    @property
+    def fallbacks(self) -> int:
+        """Total declined/failed mesh dispatches (back-compat read; the
+        per-reason split lives in `fallback_counts`)."""
+        return sum(self.fallback_counts.values())
+
+    def _decline(self, key: str, detail: str) -> None:
+        """Record WHY prepare refused the mesh: a typed reason key for the
+        labeled metric plus the human detail the enforce_mpp warning and
+        EXPLAIN ANALYZE carry. execute() turns it into ONE counted
+        fallback when prepare comes back empty."""
+        self._decline_key = key
+        self.last_fallback_reason = detail
+
+    def _fallback(self, key: str, detail: str | None = None) -> None:
+        """Count one fallback under its typed reason and feed the labeled
+        series (`tidb_tpu_fallback_total{path="mpp", reason=key}`)."""
+        self.fallback_counts[key] = self.fallback_counts.get(key, 0) + 1
+        self._decline_key = key  # the trace-span reason must match too
+        if detail is not None:
+            self.last_fallback_reason = detail
+        M.TPU_FALLBACK.inc(path="mpp", reason=key)
 
     @staticmethod
     def _entry_nbytes(ent) -> int:
@@ -214,7 +246,12 @@ class MPPEngine:
         versions of the same (table, tag) are evicted eagerly; the rest
         LRU under DEV_CACHE_BYTES."""
         if key is None:
-            return jnp.asarray(build())
+            arr = jnp.asarray(build())
+            # uncacheable mesh upload: still this statement's volume —
+            # the MPP path charges the same TLS tracker seam the cop
+            # engine's h2d does, so memory arbitration sees MPP too
+            consume_current(arr.nbytes)
+            return arr
         hit = self._dev_cache.get(key)
         if hit is not None:
             self._dev_cache[key] = self._dev_cache.pop(key)  # LRU touch
@@ -223,6 +260,7 @@ class MPPEngine:
         for k in [k for k in self._dev_cache if k[0] == tid and k[2] == tag and k[1] != ver]:
             self._dev_cache_nbytes -= self._dev_cache.pop(k).nbytes
         arr = jnp.asarray(build())
+        consume_current(arr.nbytes)  # uploader pays (volume proxy, PR 4 rule)
         self._dev_cache[key] = arr
         self._dev_cache_nbytes += arr.nbytes
         while self._dev_cache_nbytes > self.DEV_CACHE_BYTES and self._dev_cache:
@@ -320,10 +358,16 @@ class MPPEngine:
             return  # something didn't map onto the rotated tree: keep
         mplan.root = node
 
-    def prepare(self, mplan: MPPPlan, scans: list[ScanData], variables: dict):
-        """Resolve all data-dependent static choices; None → fallback."""
+    def prepare(self, mplan: MPPPlan, scans: list[ScanData], variables: dict,
+                gate=None):
+        """Resolve all data-dependent static choices; None → fallback.
+        `gate` (optional () -> None) is the scheduler's shared interrupt
+        gate: the per-scan rewrites and per-level key analyses below walk
+        O(table bytes) of host lanes, and a KILL/deadline/runaway verdict
+        must land between levels, not after the whole analysis."""
         from ..copr.tpu_engine import TPUEngine
 
+        tick = gate if gate is not None else (lambda: None)
         by_frag = {id(s.frag): s for s in scans}
         self._restream_largest(mplan, by_frag)
         scan_of_joined = {}  # joined idx -> (ScanData, local off)
@@ -335,6 +379,7 @@ class MPPEngine:
         r_pushed: dict[int, list] = {}
         eng = TPUEngine()
         for s in scans:
+            tick()
             conds = s.frag.ds.pushed_conds
             used: set[int] = set()
             for c in conds:
@@ -346,7 +391,7 @@ class MPPEngine:
                     vocabs[off] = s.vocabs[off]
             rc = [eng._rewrite(c, vocabs) for c in conds]
             if any(c is None for c in rc):
-                self.last_fallback_reason = "non-lowerable pushed condition"
+                self._decline("non_lowerable_cond", "non-lowerable pushed condition")
                 return None
             r_pushed[id(s)] = rc
 
@@ -362,6 +407,7 @@ class MPPEngine:
                 return True
             if not visit(frag.probe):
                 return False
+            tick()  # one interrupt poll per join level's key analysis
             bscan = by_frag[id(frag.build)]
             # key domains from both sides (host lanes)
             los, sizes = [], []
@@ -369,13 +415,13 @@ class MPPEngine:
                 ps, poff = scan_of_joined[pk]
                 bs, boff = scan_of_joined[bk]
                 if poff in ps.vocabs or boff in bs.vocabs:
-                    self.last_fallback_reason = "string join key"
+                    self._decline("string_join_key", "string join key")
                     return False  # dict codes differ per table
                 vals = []
                 for sd, off in ((ps, poff), (bs, boff)):
                     mm = self._lane_minmax(sd, off)
                     if mm == "float":
-                        self.last_fallback_reason = "float join key"
+                        self._decline("float_join_key", "float join key")
                         return False
                     if mm is not None:
                         vals.append(mm)
@@ -393,7 +439,7 @@ class MPPEngine:
                 strides[i] = acc
                 acc *= sizes[i]
                 if acc > 1 << 62:
-                    self.last_fallback_reason = "join key domain overflow"
+                    self._decline("domain_overflow", "join key domain overflow")
                     return False
             lvl = _Level(frag, los, strides)
             # packed keys < acc: int32 sort operands when they fit (TPU
@@ -447,7 +493,7 @@ class MPPEngine:
             # uniqueness is a property of the build key lanes alone
             mult = key_mult(bscan, frag.build_keys)
             if mult is None:
-                self.last_fallback_reason = "unpackable build keys"
+                self._decline("unpackable_build_keys", "unpackable build keys")
                 return False
             lvl.mult = mult
 
@@ -514,7 +560,8 @@ class MPPEngine:
             # the mask model below can't express yet → host fallback
             if frag.post_conds:
                 if frag.kind != "inner":
-                    self.last_fallback_reason = "outer join with residual ON conditions"
+                    self._decline("outer_join_residual",
+                                  "outer join with residual ON conditions")
                     return False
                 vocabs = {}
                 used = set()
@@ -527,7 +574,7 @@ class MPPEngine:
                         vocabs[j] = sd.vocabs[off]
                 lvl.r_post = [eng._rewrite(c, vocabs) for c in frag.post_conds]
                 if any(c is None for c in lvl.r_post):
-                    self.last_fallback_reason = "non-lowerable ON condition"
+                    self._decline("non_lowerable_cond", "non-lowerable ON condition")
                     return False
             levels.append(lvl)
             return True
@@ -664,14 +711,24 @@ class MPPEngine:
 
     # ------------------------------------------------------------- compile
 
-    def execute(self, mplan: MPPPlan, scans: list[ScanData], mesh: Mesh, variables: dict, axis: str = "dp"):
+    def execute(self, mplan: MPPPlan, scans: list[ScanData], mesh: Mesh,
+                variables: dict, axis: str = "dp", gate=None):
         """Run the fragment plan; returns a Chunk in partial-agg layout
         (agg case) or joined-schema layout (rows case), or None → caller
-        falls back to the host join path."""
-        meta = self.prepare(mplan, scans, variables)
+        falls back to the host join path. `gate` is the scheduler's
+        shared interrupt gate, polled between fragment-level analyses and
+        per-scan device uploads so KILL / deadline / runaway / OOM
+        verdicts land within one level instead of after the dispatch."""
+        # reset per dispatch: a stale reason from a PREVIOUS statement
+        # must never leak into this one's enforce_mpp warning / EXPLAIN
+        self.last_fallback_reason = ""
+        self._decline_key = "not_supported"
+        tick = gate if gate is not None else (lambda: None)
+        meta = self.prepare(mplan, scans, variables, gate=gate)
         if meta is None:
-            self.fallbacks += 1
+            self._fallback(self._decline_key)
             return None
+        tick()
         n_dev = mesh.shape[axis]
         # which scans are sharded: the stream source + hash-side builds
         sharded = {id(self._stream_source(mplan.root))}
@@ -711,6 +768,7 @@ class MPPEngine:
         args, in_specs, scan_arg_meta = [], [], []
         shapes = []
         for s in scans:
+            tick()  # each scan's lane build/upload is O(table bytes)
             offs = sorted(need[id(s)])
             is_sharded = id(s.frag) in sharded
             n = s.n_rows
@@ -739,6 +797,7 @@ class MPPEngine:
             scan_arg_meta.append((id(s.frag), offs, is_sharded))
             shapes.append((total, is_sharded, offs))
 
+        tick()
         key = self._program_key(mplan, meta, scans, shapes, n_dev)
         prog = self._programs.get(key)
         if prog is None:
@@ -748,14 +807,15 @@ class MPPEngine:
         from ..jaxenv import unpack_rows
 
         packed = np.asarray(prog(*[jnp.asarray(a) for a in args]))
+        tick()
         outs = unpack_rows(packed)
         dropped = int(outs[-1][0])
         outs = outs[:-1]
         if dropped:
             # skewed keys overflowed an exchange bucket: the run is
             # incomplete — never surface it; host path takes over
-            self.fallbacks += 1
-            self.last_fallback_reason = f"exchange bucket overflow ({dropped} rows)"
+            self._fallback("capacity_overflow",
+                           f"exchange bucket overflow ({dropped} rows)")
             return None
         if meta["agg"] is not None:
             if meta["agg"]["mode"] == "sorted":

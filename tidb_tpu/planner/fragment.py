@@ -142,14 +142,25 @@ def _peel_identity_projection(node: LogicalPlan) -> LogicalPlan:
     return node
 
 
-def _slice_join(node: Join, offset: int, scans: list[ScanFrag]):
+def _note_reason(reason, key: str, detail: str, node=None) -> None:
+    """Record the FIRST slice-decline reason (typed key + human detail +
+    the Join node whose keys failed) for the enforce_mpp warning /
+    fallback accounting — later, inner declines of the same slicing
+    attempt don't overwrite it. The failing NODE lets the caller count
+    one decline per statement even when an outer Join's slice fails on an
+    inner Join's keys and the host build then retries that inner Join."""
+    if reason is not None and not reason:
+        reason.append((key, detail, node))
+
+
+def _slice_join(node: Join, offset: int, scans: list[ScanFrag], reason=None):
     """Left-deep join tree → JoinFrag tree; None if ineligible."""
     if node.kind not in ("inner", "left"):
         return None, offset
     left, right = (_fold_selection(c) for c in node.children)
     # probe side: nested join or scan; build side: scan only (left-deep)
     if isinstance(left, Join):
-        probe, offset = _slice_join(left, offset, scans)
+        probe, offset = _slice_join(left, offset, scans, reason)
         if probe is None:
             return None, offset
     elif isinstance(left, DataSource):
@@ -171,8 +182,15 @@ def _slice_join(node: Join, offset: int, scans: list[ScanFrag]):
     pk, bk = [], []
     for le, re in node.eq_conds:
         if not (isinstance(le, ExprCol) and isinstance(re, ExprCol)):
+            _note_reason(reason, "non_column_join_key", "non-column join key", node)
             return None, offset
         if not (_int_key(le.ret_type) and _int_key(re.ret_type)):
+            if le.ret_type.is_string() or re.ret_type.is_string():
+                _note_reason(reason, "string_join_key", "string join key", node)
+            elif le.ret_type.is_float() or re.ret_type.is_float():
+                _note_reason(reason, "float_join_key", "float join key", node)
+            else:
+                _note_reason(reason, "non_int_join_key", "non-integer join key", node)
             return None, offset
         # eq_conds are over the concatenated schema; build side is the
         # right child, i.e. indices >= build.side_offset
@@ -184,13 +202,15 @@ def _slice_join(node: Join, offset: int, scans: list[ScanFrag]):
     return JoinFrag(probe, build, node.kind, pk, bk, list(node.other_conds)), offset
 
 
-def slice_plan(plan: LogicalPlan) -> MPPPlan | None:
+def slice_plan(plan: LogicalPlan, reason: list | None = None) -> MPPPlan | None:
     """Try to slice an optimized plan (sub)tree into an MPP fragment plan.
 
     Accepted roots: Aggregation(JoinTree) — fully fused partial-agg
     program; JoinTree — joined-rows program (host operators continue on
     top). Returns None when the shape/types don't qualify; caller falls
-    back to the root HashJoin path."""
+    back to the root HashJoin path. `reason` (optional list) receives one
+    `(typed_key, detail)` pair describing the FIRST decline — the
+    enforce_mpp warning / tidb_tpu_fallback_total surface."""
     agg = None
     node = _peel_identity_projection(plan)
     if isinstance(node, Aggregation) and isinstance(node.children[0], (Join, Selection)):
@@ -201,7 +221,7 @@ def slice_plan(plan: LogicalPlan) -> MPPPlan | None:
     if not isinstance(node, Join):
         return None
     scans: list[ScanFrag] = []
-    root, _ = _slice_join(node, 0, scans)
+    root, _ = _slice_join(node, 0, scans, reason)
     if root is None:
         return None
     if agg is not None:

@@ -21,6 +21,7 @@ quarter (a misbehaving statement gets less patience, not more).
 
 from __future__ import annotations
 
+import logging
 import re
 import threading
 import time
@@ -29,6 +30,8 @@ from dataclasses import dataclass
 
 from ..errors import RunawayKilled, RunawayQuarantined
 from ..utils import metrics as M
+
+log = logging.getLogger("tidb_tpu.runaway")
 
 ACTIONS = ("DRYRUN", "COOLDOWN", "KILL")
 
@@ -104,6 +107,7 @@ class Watch:
     reason: str
     start: float  # wall clock, for the memtable
     until: float  # monotonic expiry
+    until_wall: float = 0.0  # wall-clock expiry, for persistence
 
 
 class RunawayChecker:
@@ -231,6 +235,93 @@ class RunawayManager:
         # rg1's still-live KILL watch for the same digest
         self._watches: dict[tuple[str, str], Watch] = {}
         self.events: deque = deque(maxlen=self.EVENTS_CAP)
+        # lazy one-shot load of watches persisted in the catalog meta: a
+        # KILLed digest must stay rejected across store restart, not
+        # only while the process that drew the verdict lives
+        self._loaded = False
+
+    # --- persistence (catalog meta, `m:rw:` keyspace) ----------------------
+
+    @property
+    def _storage(self):
+        return getattr(self.controller, "storage", None)
+
+    def _load_locked(self) -> None:
+        """Rebuild the in-memory watch table from the catalog meta ONCE
+        per manager (first touch). Entries whose wall-clock TTL lapsed
+        while the store was down are swept from the meta here; survivors
+        get a fresh monotonic expiry covering their remaining time."""
+        if self._loaded:
+            return
+        self._loaded = True
+        storage = self._storage
+        if storage is None:
+            return  # bare manager (unit tests): nothing to restore
+        from ..catalog.meta import Meta
+
+        try:
+            txn = storage.begin()
+            try:
+                specs = Meta(txn).list_runaway_watches()
+            finally:
+                txn.rollback()
+        except Exception:  # noqa: BLE001 — a cold/closed store: stay empty
+            log.warning("runaway watch-list load failed", exc_info=True)
+            return
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        expired = []
+        for d in specs:
+            remaining = float(d.get("until_wall", 0.0)) - now_wall
+            if remaining <= 0:
+                expired.append((d.get("group", ""), d.get("digest", "")))
+                continue
+            key = (d["digest"], d["group"])
+            self._watches[key] = Watch(
+                group=d["group"], action=d.get("action", "KILL"),
+                reason=d.get("reason", ""), start=float(d.get("start", now_wall)),
+                until=now_mono + remaining, until_wall=float(d["until_wall"]),
+            )
+        for group, digest in expired:
+            self._meta_drop(group, digest)
+
+    def _meta_put(self, digest: str, w: Watch) -> None:
+        storage = self._storage
+        if storage is None:
+            return
+        from ..catalog.meta import Meta
+
+        try:
+            txn = storage.begin()
+            try:
+                Meta(txn).put_runaway_watch({
+                    "digest": digest, "group": w.group, "action": w.action,
+                    "reason": w.reason, "start": w.start,
+                    "until_wall": w.until_wall,
+                })
+                txn.commit()
+            except BaseException:
+                txn.rollback()
+                raise
+        except Exception:  # noqa: BLE001 — the verdict must still fire
+            log.warning("runaway watch persist failed", exc_info=True)
+
+    def _meta_drop(self, group: str, digest: str) -> None:
+        storage = self._storage
+        if storage is None:
+            return
+        from ..catalog.meta import Meta
+
+        try:
+            txn = storage.begin()
+            try:
+                Meta(txn).drop_runaway_watch(group, digest)
+                txn.commit()
+            except BaseException:
+                txn.rollback()
+                raise
+        except Exception:  # noqa: BLE001 — expiry sweep is best-effort
+            pass
 
     # --- per-statement entry ------------------------------------------------
 
@@ -252,6 +343,9 @@ class RunawayManager:
     def _any_watch(self) -> bool:
         """True while an UNEXPIRED watch exists; purges expired entries
         so the idle fast path comes back once every TTL has lapsed."""
+        if not self._loaded:
+            with self._lock:
+                self._load_locked()
         if not self._watches:
             return False
         now = time.monotonic()
@@ -259,7 +353,10 @@ class RunawayManager:
             expired = [k for k, w in self._watches.items() if now >= w.until]
             for k in expired:
                 del self._watches[k]
-            return bool(self._watches)
+            alive = bool(self._watches)
+        for digest, group in expired:
+            self._meta_drop(group, digest)
+        return alive
 
     # --- watch list ----------------------------------------------------------
 
@@ -272,29 +369,43 @@ class RunawayManager:
         now = time.monotonic()
         key = (digest, group)
         with self._lock:
+            self._load_locked()
             w = self._watches.get(key)
             if w is None:
                 return None
             if now >= w.until:
                 del self._watches[key]
-                return None
-            return w
+                w = None
+        if w is None:
+            self._meta_drop(group, digest)
+        return w
 
     def mark(self, digest: str, group: str, action: str, reason: str, ttl_ms: float) -> None:
+        now_wall = time.time()
+        w = Watch(
+            group=group, action=action, reason=reason,
+            start=now_wall, until=time.monotonic() + ttl_ms / 1000.0,
+            until_wall=now_wall + ttl_ms / 1000.0,
+        )
         with self._lock:
-            self._watches[(digest, group)] = Watch(
-                group=group, action=action, reason=reason,
-                start=time.time(), until=time.monotonic() + ttl_ms / 1000.0,
-            )
+            self._load_locked()
+            self._watches[(digest, group)] = w
+        # persist OUTSIDE the lock: the meta write opens its own txn and
+        # must not serialize every admission-path watch probe behind it
+        self._meta_put(digest, w)
 
     def watches_snapshot(self) -> list[tuple[str, Watch, float]]:
         """[(digest, watch, remaining_s)] of unexpired entries."""
         now = time.monotonic()
         with self._lock:
+            self._load_locked()
             expired = [k for k, w in self._watches.items() if now >= w.until]
             for k in expired:
                 del self._watches[k]
-            return [(k[0], w, w.until - now) for k, w in self._watches.items()]
+            out = [(k[0], w, w.until - now) for k, w in self._watches.items()]
+        for digest, group in expired:
+            self._meta_drop(group, digest)
+        return out
 
     # --- events --------------------------------------------------------------
 

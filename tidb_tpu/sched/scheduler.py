@@ -221,6 +221,13 @@ class AdmissionScheduler:
                             group=g.name, outcome=getattr(e, "reason", "killed")
                         )
                         raise
+                    if rc is not None and rc.demoted and w.priority != PRIORITIES["LOW"]:
+                        # the COOLDOWN verdict fired while this task was
+                        # ALREADY queued (rc.tick above): demote the live
+                        # waiter now — the next _grant_locked pass sorts
+                        # it behind every normal-priority waiter instead
+                        # of honoring the priority it enqueued with
+                        w.priority = PRIORITIES["LOW"]
                     now = time.monotonic()
                     timeout = self._TICK_S
                     if ctx.deadline is not None:

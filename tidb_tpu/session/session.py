@@ -3765,6 +3765,25 @@ class Session:
             # memory-arbitration line: auto tasks rerouted to host while
             # the store sat over its soft memory limit
             lines.append(f"mem: degraded_tasks:{d['mem_degraded_tasks']}")
+        if d.get("mpp_tasks"):
+            # unified fault domain (PR 8): mesh MPP dispatches this
+            # statement attempted, how many degraded to the host join,
+            # and the TYPED reason behind the last degrade
+            mline = (
+                f"mpp: dispatches:{d['mpp_tasks']} fallbacks:{d['mpp_fallbacks']}"
+            )
+            reason = getattr(self.cop.mpp, "last_fallback_reason", "") \
+                if getattr(self.cop, "_mpp", None) is not None else ""
+            if d.get("mpp_fallbacks") and reason:
+                mline += f" reason:[{reason}]"
+            lines.append(mline)
+        if d.get("window_device_tasks") or d.get("window_fallbacks"):
+            # device-window runs vs typed declines (the per-operator
+            # fallback:[...] tag carries the reason text)
+            lines.append(
+                f"window: device:{d['window_device_tasks']} "
+                f"fallbacks:{d['window_fallbacks']}"
+            )
         if (d["compile_ms"] or d["transfer_bytes"] or d["device_ms"]
                 or d.get("cache_ref_bytes") or d.get("shared_h2d_bytes")):
             # device-path line: XLA compile wall, host<->device bytes and

@@ -35,6 +35,12 @@ class Counter:
     def value(self, **labels) -> float:
         return self._v[tuple(sorted(labels.items()))]
 
+    def total(self) -> float:
+        """Sum over every label set — the 'how many, regardless of why'
+        read consumers like the inspection memtable want."""
+        with self._lock:
+            return sum(self._v.values())
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         for key, v in sorted(self._v.items()):
@@ -364,6 +370,17 @@ TPU_EXECUTE_SECONDS = REGISTRY.histogram(
 TPU_SHARED_UPLOAD_BYTES = REGISTRY.counter(
     "tidb_tpu_shared_upload_bytes_total",
     "h2d bytes uploaded by grouped launches on behalf of the whole group",
+)
+
+# unified fault domain (PR 8): every device path (cop | mpp | window)
+# that declines or degrades to the host engine counts here with a TYPED
+# reason — breaker_open, device_error, mem_degrade, not_lowerable,
+# string_join_key, capacity_overflow, ... — so "how often and why does
+# the accelerator path lose" is one query instead of three ad-hoc
+# attributes (the Tailwind observable-fallback policy, arXiv:2604.28079)
+TPU_FALLBACK = REGISTRY.counter(
+    "tidb_tpu_fallback_total",
+    "device-path declines/degrades to the host engine by path (cop|mpp|window) and typed reason",
 )
 
 # compressed, width-narrowed device tiles (PR 7): per-lane wire bytes by

@@ -11,6 +11,9 @@
 #   tools/bench_timeline_overhead.py -> BENCH_timeline_pr5.json
 #   tools/bench_tiles.py             -> BENCH_tiles_pr7.json
 cd "$(dirname "$0")/.." || exit 1
+# static boundary lint (PR 8): device engine boundaries may only catch
+# the typed error taxonomy — a blanket `except Exception` there fails
+python tools/lint_boundaries.py || exit 1
 if [ "$1" = "--bench" ]; then
   for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
