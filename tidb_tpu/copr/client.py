@@ -689,6 +689,7 @@ class CopClient:
                             else:
                                 breaker.record_success()
                                 st("tpu_tasks")
+                                M.COP_TASKS.inc(engine="tpu")
                                 self._note_device_phases(ph, st, trace)
                                 # only chunks a device program PRODUCED
                                 # charge the compressed mirror; the
@@ -707,6 +708,7 @@ class CopClient:
                     chunk = execute_dag_host(dag, batch)
                     host_s = time.perf_counter() - t0
                     st("host_tasks")
+                    M.COP_TASKS.inc(engine="host")
                     st("host_ms", host_s * 1000.0)
                     if trace is not None and trace.recording:
                         trace.closed_span("cop.host_execute", host_s, rows=batch.n_rows)

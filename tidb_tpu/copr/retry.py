@@ -320,7 +320,7 @@ class CircuitBreaker:
             if self.state == "open" and now - self._opened_at >= self.cooldown_s:
                 self.state = "half-open"
                 self._probing = False
-                self._publish()
+                self._publish_locked()
             if self.state == "half-open":
                 if self._probing and now - self._probe_at >= self.cooldown_s:
                     self._probing = False  # lost probe: reclaim the slot
@@ -341,7 +341,7 @@ class CircuitBreaker:
             self._probing = False
             if self.state == "half-open":
                 self.state = "closed"
-                self._publish()
+                self._publish_locked()
 
     def record_aborted(self) -> None:
         """The device attempt ended for a NON-device reason (KILL, quota,
@@ -378,7 +378,7 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self.trips += 1
                 M.BREAKER_TRIPS.inc(engine=self.label)
-                self._publish()
+                self._publish_locked()
             return self.state == "open"
 
     def is_open(self) -> bool:
@@ -398,5 +398,5 @@ class CircuitBreaker:
             f"use engine='host'/'auto' or wait out the cooldown"
         )
 
-    def _publish(self) -> None:
+    def _publish_locked(self) -> None:
         M.BREAKER_STATE.set(self._STATE_GAUGE[self.state], engine=self.label)

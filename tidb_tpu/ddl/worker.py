@@ -28,6 +28,7 @@ from threading import RLock
 from ..catalog.meta import Meta
 from ..codec import tablecodec
 from ..errors import DuplicateEntry, TiDBError
+from ..utils import metrics as M
 from ..utils.failpoint import inject as _fp
 from .jobs import (
     DDLJob,
@@ -160,6 +161,7 @@ class DDLWorker:
         m.finish_job(job)
         m.bump_schema_version()
         txn.commit()
+        M.DDL_JOBS.inc(type=job.type, state=state)
         self._fire("finish", job)
 
     # --- ADD INDEX ---------------------------------------------------------

@@ -289,15 +289,25 @@ class Session:
     def _finish_stmt(self):
         """Autocommit unless inside an explicit transaction."""
         if self.txn is not None and not self.in_explicit_txn:
+            from ..utils import metrics as M
+
             t = self.txn
             t.commit()
             self.txn = None
+            # session-level count: USER transaction outcomes only — the
+            # storage layer also opens internal meta/infoschema txns,
+            # which would swamp the series (analyzer registry pass
+            # surfaced the dead metric; review placed it here)
+            M.TXN_TOTAL.inc(result="commit")
             self._txn_committed(t)
 
     def _abort_stmt(self):
         if self.txn is not None and not self.in_explicit_txn:
+            from ..utils import metrics as M
+
             self.txn.rollback()
             self.txn = None
+            M.TXN_TOTAL.inc(result="rollback")
             self._pending_deltas.clear()
 
     def read_ts(self) -> int:
@@ -457,6 +467,26 @@ class Session:
             while True:
                 try:
                     rs = self._execute_stmt(stmt, sql=sql)
+                    if isinstance(stmt, (ast.Select, ast.SetOpSelect,
+                                         ast.Insert, ast.Update, ast.Delete)):
+                        # LAST verdict poll at the success boundary: a
+                        # kill (user KILL / OOM arbiter / runaway)
+                        # landing after drain()'s final gate — during
+                        # result assembly — must fail THIS statement,
+                        # before the autocommit below; tracker.detach()
+                        # in the finally cancels unobserved oom flags
+                        # (no next-statement spillover), so this is the
+                        # verdict's last chance to be observed. Only the
+                        # query/DML shapes poll: their work is still
+                        # abortable here (autocommit happens below, an
+                        # explicit txn restores the statement savepoint),
+                        # while txn control and DDL/admin passed their
+                        # durability point INSIDE _execute_stmt — a
+                        # post-commit error would misreport a durable
+                        # change (COMMIT, CREATE INDEX, ...) as failed.
+                        from ..sched.scheduler import raise_if_interrupted
+
+                        raise_if_interrupted(self, getattr(self, "_deadline", None))
                     start_ts = self.txn.start_ts if self.txn is not None else 0
                     self._finish_stmt()
                     break
@@ -836,6 +866,8 @@ class Session:
             self.priv.require(self, self.user, db, priv, table)
 
     def _execute_stmt(self, stmt, sql: str | None = None) -> ResultSet:
+        from ..utils import metrics as M
+
         self._check_privileges(stmt)
         if isinstance(stmt, (ast.Select, ast.SetOpSelect)):
             return self.run_select(stmt, sql=sql, top_level=True)
@@ -873,6 +905,7 @@ class Session:
         if isinstance(stmt, ast.Begin):
             if self.txn is not None:
                 self.txn.commit()
+                M.TXN_TOTAL.inc(result="commit")
                 self._flush_deltas()
             self.txn = self.store.begin(pessimistic=self._txn_mode_pessimistic(stmt.mode))
             self.in_explicit_txn = True
@@ -886,6 +919,7 @@ class Session:
             t = self.txn
             if t is not None:
                 t.commit()
+                M.TXN_TOTAL.inc(result="commit")
             self.txn = None
             self.in_explicit_txn = False
             self._txn_trace_id = None  # COMMIT itself was stamped already
@@ -894,6 +928,7 @@ class Session:
         if isinstance(stmt, ast.Rollback):
             if self.txn is not None:
                 self.txn.rollback()
+                M.TXN_TOTAL.inc(result="rollback")
             self.txn = None
             self.in_explicit_txn = False
             self._txn_trace_id = None

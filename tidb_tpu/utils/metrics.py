@@ -33,7 +33,12 @@ class Counter:
             self._v[key] += n
 
     def value(self, **labels) -> float:
-        return self._v[tuple(sorted(labels.items()))]
+        # .get, not [..]: a defaultdict read INSERTS the missing key, so
+        # an unlocked probe could grow the dict mid-render (and the
+        # registry's lock-free iteration would see a changed dict); the
+        # lock makes the read coherent with concurrent inc()
+        with self._lock:
+            return self._v.get(tuple(sorted(labels.items())), 0.0)
 
     def total(self) -> float:
         """Sum over every label set — the 'how many, regardless of why'
@@ -43,7 +48,9 @@ class Counter:
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._v.items()):
+        with self._lock:  # a concurrent inc() may insert a new label set
+            items = sorted(self._v.items())
+        for key, v in items:
             lbl = _fmt_labels(key)
             out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
         return out
@@ -67,11 +74,16 @@ class Gauge:
             self._v[tuple(sorted(labels.items()))] += n
 
     def value(self, **labels) -> float:
-        return self._v[tuple(sorted(labels.items()))]
+        # .get under the lock, like Counter.value: the defaultdict read
+        # would otherwise insert the key and race a concurrent render
+        with self._lock:
+            return self._v.get(tuple(sorted(labels.items())), 0.0)
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for key, v in sorted(self._v.items()):
+        with self._lock:
+            items = sorted(self._v.items())
+        for key, v in items:
             lbl = _fmt_labels(key)
             out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
         return out
@@ -171,19 +183,29 @@ class Registry:
                 self._metrics[name] = m
             return m
 
+    def _snapshot(self) -> list:
+        """Metrics in name order, snapshotted under the registry lock —
+        a reader must not iterate `_metrics` while a first-use
+        counter()/gauge() call inserts into it."""
+        with self._lock:
+            return sorted(self._metrics.items())
+
     def render(self) -> str:
         lines: list[str] = []
-        for name in sorted(self._metrics):
-            lines.extend(self._metrics[name].render())
+        for _name, m in self._snapshot():
+            lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
     def rows(self) -> list[tuple[str, str, float]]:
         """Flat (metric, labels, value) rows for the METRICS memtable."""
         out = []
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
+        for name, m in self._snapshot():
             if isinstance(m, (Counter, Gauge)):
-                for key, v in sorted(m._v.items()):
+                # under the metric's lock: inc() can insert a label set
+                # while this reader iterates
+                with m._lock:
+                    items = sorted(m._v.items())
+                for key, v in items:
                     out.append((name, ",".join(f"{k}={val}" for k, val in key), v))
             else:
                 # under the histogram's lock: observe() can insert a new

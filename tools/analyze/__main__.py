@@ -1,0 +1,40 @@
+"""CLI: `python -m tools.analyze [--list] [--only p1,p2] [--json PATH]`.
+
+Exit status 0 = every pass clean on the tree (allowlisted findings
+excepted — each carries a written reason); 1 = violations, printed one
+per line. `tools/t1.sh` runs this before pytest (fail = red tier-1)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import REPO, default_passes, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze")
+    ap.add_argument("--list", action="store_true", help="list passes and exit")
+    ap.add_argument("--only", default="", help="comma-separated pass names to run")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write a findings/suppressions artifact")
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)  # tests
+    args = ap.parse_args(argv)
+
+    passes = default_passes(root=args.root or REPO)
+    if args.list:
+        for p in passes:
+            print(f"{p.name:22s} {p.description}")
+        return 0
+    if args.only:
+        want = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = want - {p.name for p in passes}
+        if unknown:
+            print(f"unknown pass(es): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        passes = [p for p in passes if p.name in want]
+    return run(passes, root=args.root, json_path=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

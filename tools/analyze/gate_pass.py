@@ -1,0 +1,143 @@
+"""interrupt-gate: blocking points on the statement path must poll the
+shared stop gate.
+
+`sched.scheduler.raise_if_interrupted` / `sleep_interruptible` are THE
+one definition of "stop now" (KILL, max_execution_time, the OOM
+arbiter's verdict, the runaway watchdog's tick). A sleep or condition
+wait that bypasses them rides out its full duration deaf to all four —
+the PR 8 `drain()` race was exactly one missing poll, and the PR 4
+COOLDOWN gap was another. Rules, scoped to sched/ + copr/ + executor/ +
+parallel/:
+
+  * a direct `time.sleep(...)` call is a finding — sleep through
+    `sleep_interruptible` instead (the primitive itself is allowlisted:
+    its poll loop is the gate);
+  * a blocking `.wait(...)` (Condition/Event) must sit inside a loop
+    whose body also polls the gate (`raise_if_interrupted` /
+    `sleep_interruptible` / an abandon-`stop()` check), so every wakeup
+    re-checks before sleeping again;
+  * a function named `drain` must call `raise_if_interrupted` at least
+    twice — once per chunk AND once after the final materialization
+    (the PR 8 kill-vs-finish regression, locked in).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Finding, Module, Pass, dotted
+
+_SCOPES = ("tidb_tpu/sched/", "tidb_tpu/copr/", "tidb_tpu/executor/",
+           "tidb_tpu/parallel/")
+
+_GATE_NAMES = {"raise_if_interrupted", "sleep_interruptible"}
+
+
+def _call_name(node: ast.Call) -> str:
+    return getattr(node.func, "id", getattr(node.func, "attr", ""))
+
+
+def _polls_gate(loop: ast.AST) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in _GATE_NAMES or name == "stop":
+                return True
+    return False
+
+
+class InterruptGatePass(Pass):
+    name = "interrupt-gate"
+    description = ("sleeps/waits in sched/copr/executor/parallel must poll "
+                   "raise_if_interrupted / sleep_interruptible")
+
+    ALLOW = {
+        # sleep_interruptible IS the interruptible-sleep primitive: its
+        # loop polls raise_if_interrupted + the abandon stop() before
+        # every tick-bounded nap — this time.sleep is the one all others
+        # must route through.
+        ("tidb_tpu/sched/scheduler.py", "sleep_interruptible", "time.sleep"):
+            "the shared primitive itself: naps in _TICK_S slices after "
+            "polling the gate and the abandon stop() each iteration",
+        # the batcher leader's follower-collection window is 2ms —
+        # 25x under the scheduler's 50ms poll tick, so a KILL/deadline
+        # landing inside it is observed at the very next gate (admission,
+        # backoff or chunk boundary) with no measurable added latency;
+        # plumbing a session into the batcher for a 2ms nap is not worth
+        # the coupling.
+        ("tidb_tpu/sched/batcher.py", "LaunchBatcher._coalesced", "time.sleep"):
+            "2ms follower-collection window, far under the 50ms gate poll "
+            "tick; KILL lands at the next checkpoint",
+        # a follower's wait is bounded by its leader's launch (the leader
+        # sets done unconditionally in _launch_on's finally; the 120s
+        # timeout is the leader-crashed-hard safety valve that raises).
+        # The follower cannot poll its OWN session here — the batcher is
+        # statement-agnostic by design (jobs from many sessions) — and a
+        # KILLed follower escapes at the drain-loop gate right after the
+        # launch returns.
+        ("tidb_tpu/sched/batcher.py", "LaunchBatcher._coalesced", ".wait"):
+            "bounded by the leader's launch (done.set() in _launch_on's "
+            "finally); KILL is observed at the next drain-gate poll",
+    }
+
+    def scope(self, rel: str) -> bool:
+        return any(rel.startswith(s) for s in _SCOPES)
+
+    def check(self, mod: Module):
+        findings: list[Finding] = []
+        for qual, fn in mod.qualnames():
+            loops = [n for n in ast.walk(fn)
+                     if isinstance(n, (ast.While, ast.For))]
+
+            def enclosing_loop(node):
+                best = None
+                for lp in loops:
+                    for sub in ast.walk(lp):
+                        if sub is node:
+                            best = lp  # innermost wins with later matches
+                return best
+
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                text = dotted(node.func)
+                if text == "time.sleep":
+                    findings.append(Finding(
+                        self.name, mod.rel, node.lineno,
+                        f"`{qual}` calls time.sleep() directly — a KILL / "
+                        f"deadline / OOM verdict / runaway tick cannot land "
+                        f"during it; use sched.scheduler.sleep_interruptible",
+                        key=(mod.rel, qual, "time.sleep"),
+                    ))
+                    continue
+                if isinstance(node.func, ast.Attribute) and node.func.attr == "wait":
+                    recv = dotted(node.func.value)
+                    if recv.endswith("futs") or not recv:
+                        continue
+                    lp = enclosing_loop(node)
+                    if lp is not None and _polls_gate(lp):
+                        continue
+                    findings.append(Finding(
+                        self.name, mod.rel, node.lineno,
+                        f"`{qual}` blocks in `{recv}.wait(...)` without a "
+                        f"surrounding loop that polls raise_if_interrupted / "
+                        f"sleep_interruptible / stop() — the wait is deaf to "
+                        f"KILL, deadlines, the OOM arbiter and the runaway "
+                        f"watchdog for its full duration",
+                        key=(mod.rel, qual, ".wait"),
+                    ))
+            if qual.split(".")[-1] == "drain" and mod.rel.startswith("tidb_tpu/executor/"):
+                gates = sum(
+                    1 for n in ast.walk(fn)
+                    if isinstance(n, ast.Call)
+                    and _call_name(n) == "raise_if_interrupted"
+                )
+                if gates < 2:
+                    findings.append(Finding(
+                        self.name, mod.rel, fn.lineno,
+                        f"`{qual}` must poll raise_if_interrupted per chunk "
+                        f"AND after the final concat (found {gates} call(s)) "
+                        f"— the PR 8 kill-vs-statement-finish race",
+                        key=(mod.rel, qual, "drain-gate"),
+                    ))
+        return findings

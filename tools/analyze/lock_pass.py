@@ -1,0 +1,213 @@
+"""lock-discipline: the declared lock hierarchy, statically enforced.
+
+`lock_order.toml` declares every named lock with a RANK (acquisition
+must flow low → high: scheduler → batcher → lane → engine → memtracker →
+... → metrics) and a `guarded_by` registry of fields that may only be
+touched under their lock. This pass flags:
+
+  * `with a._lock:` nesting that acquires AGAINST the declared order —
+    syntactic nesting inside one function (the runtime detector in
+    lockwatch.py covers cross-function chains on the live suite);
+  * equal-name re-acquisition where the lock has not declared
+    `nest = "tree"` (the MemTracker child→parent walk is the one
+    sanctioned chain);
+  * reads/writes of a `guarded` field outside a `with` on its lock —
+    with the caller-must-hold convention honored: methods named
+    `*_locked` (and `__init__`) are exempt, everything else is a
+    finding or a reviewed allowlist entry.
+
+Static analysis cannot resolve aliasing, so lock identity is declared
+per (file, class, dotted-pattern) in the toml; a lock expression the
+toml does not name is simply unchecked — precision over noise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding, Module, Pass, dotted, load_toml
+
+_COMPOUND = (ast.If, ast.For, ast.While, ast.Try, ast.AsyncFor, ast.AsyncWith)
+
+
+class _LockDecl:
+    __slots__ = ("name", "rank", "file", "classes", "patterns", "wrappers", "nest")
+
+    def __init__(self, d: dict):
+        self.name = d["name"]
+        self.rank = int(d["rank"])
+        self.file = d.get("file", "*")
+        self.classes = tuple(d.get("classes", ()))
+        self.patterns = tuple(d.get("patterns", ()))
+        self.wrappers = tuple(d.get("wrappers", ()))
+        self.nest = d.get("nest", "")
+
+    def applies(self, rel: str, cls: str | None) -> bool:
+        if self.file != "*" and self.file != rel:
+            return False
+        if self.classes and (cls or "") not in self.classes:
+            return False
+        return True
+
+
+class _GuardDecl:
+    __slots__ = ("file", "classes", "fields", "lock_attr", "extern")
+
+    def __init__(self, d: dict):
+        self.file = d["file"]
+        self.classes = tuple(d.get("classes", ()))
+        self.fields = tuple(d["fields"])
+        self.lock_attr = d["lock_attr"]
+        self.extern = bool(d.get("extern", False))
+
+
+class LockDisciplinePass(Pass):
+    name = "lock-discipline"
+    description = ("declared lock hierarchy (lock_order.toml): nesting order "
+                   "+ guarded-by field registry")
+
+    ALLOW: dict = {}
+
+    def __init__(self, root: str | None = None, config: dict | None = None):
+        if config is None:
+            config = load_toml(os.path.join(os.path.dirname(__file__), "lock_order.toml"))
+        self.locks = [_LockDecl(d) for d in config.get("lock", ())]
+        self.guards = [_GuardDecl(d) for d in config.get("guarded", ())]
+
+    # --- lock resolution ----------------------------------------------------
+
+    def _resolve(self, expr: ast.AST, rel: str, cls: str | None):
+        """Which declared lock (if any) does this with-item acquire?"""
+        if isinstance(expr, ast.Call):
+            fname = getattr(expr.func, "id", getattr(expr.func, "attr", ""))
+            for l in self.locks:
+                if fname in l.wrappers:
+                    return l
+            return None
+        text = dotted(expr)
+        if not text:
+            return None
+        for l in self.locks:
+            if l.applies(rel, cls) and text in l.patterns:
+                return l
+        return None
+
+    # --- per-module check ---------------------------------------------------
+
+    def check(self, mod: Module):
+        findings: list[Finding] = []
+        self_guards = [g for g in self.guards if g.file == mod.rel]
+        extern_guards = [g for g in self.guards if g.extern]
+
+        for qual, fn in mod.qualnames():
+            cls = qual.split(".")[-2] if "." in qual else None
+            base = qual.split(".")[-1]
+            exempt = base in ("__init__", "__repr__") or base.endswith("_locked")
+            held: list[_LockDecl] = []
+            held_exprs: list[str] = []  # dotted text of every held with-item
+
+            def check_exprs(nodes):
+                if exempt:
+                    return
+                for root in nodes:
+                    if root is None:
+                        continue
+                    for node in ast.walk(root):
+                        if isinstance(node, ast.Attribute):
+                            self._check_guard(
+                                findings, mod, qual, cls, node,
+                                held_exprs, self_guards, extern_guards,
+                            )
+
+            def visit(stmts):
+                for st in stmts:
+                    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.ClassDef)):
+                        continue  # nested defs are their own qualname
+                    if isinstance(st, ast.With):
+                        n_locks = n_texts = 0
+                        for item in st.items:
+                            expr = item.context_expr
+                            decl = self._resolve(expr, mod.rel, cls)
+                            if decl is not None:
+                                self._check_order(findings, mod, qual, st,
+                                                  decl, held)
+                                held.append(decl)
+                                n_locks += 1
+                            text = dotted(expr)
+                            if text:
+                                held_exprs.append(text)
+                                n_texts += 1
+                        visit(st.body)
+                        del held[len(held) - n_locks:]
+                        del held_exprs[len(held_exprs) - n_texts:]
+                        continue
+                    if isinstance(st, _COMPOUND):
+                        # header expressions at this nesting level...
+                        check_exprs([getattr(st, "test", None),
+                                     getattr(st, "iter", None),
+                                     getattr(st, "target", None)])
+                        # ...then each sub-body at its own level
+                        for attr in ("body", "orelse", "finalbody"):
+                            body = getattr(st, attr, None)
+                            if body:
+                                visit(body)
+                        for h in getattr(st, "handlers", ()):
+                            visit(h.body)
+                        continue
+                    check_exprs([st])
+
+            visit(fn.body)
+        return findings
+
+    def _check_order(self, findings, mod, qual, st, decl, held):
+        for h in held:
+            if h.name == decl.name:
+                if decl.nest != "tree":
+                    findings.append(Finding(
+                        self.name, mod.rel, st.lineno,
+                        f"`{qual}` re-acquires lock `{decl.name}` while "
+                        f"holding it — only a declared nest=\"tree\" chain "
+                        f"(strict parent order) may do that",
+                        key=(mod.rel, qual, f"{h.name}->{decl.name}"),
+                    ))
+            elif decl.rank < h.rank:
+                findings.append(Finding(
+                    self.name, mod.rel, st.lineno,
+                    f"`{qual}` acquires `{decl.name}` (rank {decl.rank}) "
+                    f"while holding `{h.name}` (rank {h.rank}) — against "
+                    f"the declared order in lock_order.toml",
+                    key=(mod.rel, qual, f"{h.name}->{decl.name}"),
+                ))
+
+    def _check_guard(self, findings, mod, qual, cls, node, held_exprs,
+                     self_guards, extern_guards):
+        attr = node.attr
+        recv = dotted(node.value)
+        if not recv:
+            return
+        if recv == "self":
+            for g in self_guards:
+                if attr in g.fields and (not g.classes or (cls or "") in g.classes):
+                    if f"self.{g.lock_attr}" not in held_exprs:
+                        findings.append(Finding(
+                            self.name, mod.rel, node.lineno,
+                            f"`{qual}` touches guarded field `self.{attr}` "
+                            f"outside `with self.{g.lock_attr}` "
+                            f"(lock_order.toml guarded-by registry)",
+                            key=(mod.rel, qual, attr),
+                        ))
+                    return
+        else:
+            for g in extern_guards:
+                if attr in g.fields:
+                    if f"{recv}.{g.lock_attr}" not in held_exprs:
+                        findings.append(Finding(
+                            self.name, mod.rel, node.lineno,
+                            f"`{qual}` touches guarded field `{recv}.{attr}` "
+                            f"outside `with {recv}.{g.lock_attr}` "
+                            f"(extern guarded-by registry: {g.file})",
+                            key=(mod.rel, qual, attr),
+                        ))
+                    return

@@ -1,0 +1,369 @@
+"""Instrumented-lock runtime race/lock-order detector.
+
+`instrument_locks()` wraps the ~20 named locks of the concurrency core
+(scheduler condition, batcher, device lanes, engine, MemTracker tree,
+breakers, tile/result caches, metrics, tracing/timeline rings, storage
+primitives) in ordered proxies. Every acquisition records, per thread,
+which named locks were already held; each (held → acquired) pair lands
+in a process-global EDGE SET. Acquiring A while holding B after some
+thread ever acquired B while holding A is a POTENTIAL DEADLOCK even if
+the runs never interleaved fatally — the pytest-fixture-style
+"flag the reversal, not the hang" report, mirroring what
+`go test -race`'s lock-order heuristics buy the reference.
+
+Same-name edges are allowed only for locks declared `nest = "tree"` in
+`lock_order.toml` (the MemTracker child→parent walk); every other
+same-name re-entry besides genuine RLock re-entrancy (same object) is
+reported too.
+
+Enabled under the chaos suites via `ANALYZE_LOCKS=1`
+(tests/conftest.py): the 30%-fault batteries double as race hunts with
+zero overhead on the default run. Instrumentation patches the target
+classes' `__init__` (locks wrap at construction) and retro-wraps the
+process-global metrics singletons; `uninstall()` restores everything.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+
+class LockWatcher:
+    """Process-global acquisition-order graph + cycle reports."""
+
+    def __init__(self, tree_names: frozenset = frozenset()):
+        self.tree_names = tree_names
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # (held_name, acquired_name) → one witness stack (first seen)
+        self.edges: dict[tuple[str, str], str] = {}
+        self.reports: list[dict] = []
+        self._reported: set[tuple[str, str]] = set()
+
+    # --- per-thread held stack ---------------------------------------------
+
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def on_acquire(self, name: str, obj_id: int) -> None:
+        held = self._held()
+        if any(hid == obj_id for _hname, hid in held):
+            # RLock re-entry on the SAME object: not an edge — but the
+            # entry must still be PUSHED so the matching release pops
+            # this level, not the outer hold (an early return here would
+            # strip the lock from held-state while it is still held and
+            # silently lose every later edge from it)
+            held.append((name, obj_id))
+            return
+        new_edges = [
+            (hname, name) for hname, _hid in held
+            # declared strict-parent chains (MemTracker walk) may stack
+            # same-name locks; everything else held becomes an edge
+            if not (hname == name and name in self.tree_names)
+        ]
+        held.append((name, obj_id))
+        if not new_edges:
+            return
+        # fast path: every edge already recorded → no stack capture, no
+        # global lock (dict membership on a dict that only grows is safe)
+        if all(e in self.edges for e in new_edges):
+            return
+        stack = "".join(traceback.format_stack(limit=12)[:-2])
+        with self._mu:
+            for edge in new_edges:
+                if edge not in self.edges:
+                    self.edges[edge] = stack
+                    self._check_cycle(edge, stack)
+
+    def on_release(self, name: str, obj_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == (name, obj_id):
+                del held[i]
+                return
+
+    # --- cycle detection (under self._mu) ----------------------------------
+
+    def _check_cycle(self, edge: tuple[str, str], stack: str) -> None:
+        """Adding a→b: report when b can already reach a through the
+        recorded edge set (the classic lock-order-reversal condition;
+        length-2 cycles are the a→b→a case, length-1 a same-name
+        re-entry on a DIFFERENT lock object)."""
+        a, b = edge
+        if (a, b) in self._reported:
+            return
+        if a == b:
+            # two distinct lock objects sharing one declared name,
+            # nested: either a real self-deadlock class or two locks
+            # that deserve distinct names in lock_order.toml
+            self._reported.add((a, b))
+            self.reports.append({
+                "edge": edge, "cycle": [a, a],
+                "stack": stack, "reverse_stack": stack,
+            })
+            return
+        parent: dict[str, str | None] = {b: None}
+        frontier = [b]
+        while frontier:
+            cur = frontier.pop()
+            for (x, y), _s in self.edges.items():
+                if x != cur or y in parent:
+                    continue
+                parent[y] = cur
+                if y == a:
+                    nodes = [a]
+                    node: str | None = cur
+                    while node is not None:
+                        nodes.append(node)
+                        node = parent[node]
+                    # closing edge a→b plus the recorded b→…→a path
+                    cyc = [a] + list(reversed(nodes[1:])) + [a]
+                    self._reported.add((a, b))
+                    self.reports.append({
+                        "edge": edge,
+                        "cycle": cyc,
+                        "stack": stack,
+                        "reverse_stack": self.edges.get((b, a), ""),
+                    })
+                    return
+                frontier.append(y)
+
+    def render_reports(self) -> str:
+        out = []
+        for r in self.reports:
+            out.append(
+                f"potential deadlock: acquiring {r['edge'][1]!r} while "
+                f"holding {r['edge'][0]!r} closes the cycle "
+                f"{' -> '.join(r['cycle'])}\n--- this acquisition ---\n"
+                f"{r['stack']}\n--- prior reverse acquisition ---\n"
+                f"{r['reverse_stack']}"
+            )
+        return "\n\n".join(out)
+
+
+class LockProxy:
+    """Wraps a Lock/RLock, reporting acquire/release to the watcher.
+    Delegates the full lock surface (`with`, acquire/release/locked)."""
+
+    __slots__ = ("_inner", "_name", "_watcher")
+
+    def __init__(self, inner, name: str, watcher: LockWatcher):
+        self._inner = inner
+        self._name = name
+        self._watcher = watcher
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._watcher.on_acquire(self._name, id(self._inner))
+        return got
+
+    def release(self):
+        self._watcher.on_release(self._name, id(self._inner))
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class CondProxy:
+    """Wraps a threading.Condition. `wait()` releases and re-acquires
+    the underlying lock internally; from an ORDERING standpoint the
+    condition stays "held" across the wait (the waiter resumes holding
+    it), so held-state is left untouched — exactly the conservative
+    choice: a waiter cannot acquire other locks while sleeping."""
+
+    __slots__ = ("_inner", "_name", "_watcher")
+
+    def __init__(self, inner, name: str, watcher: LockWatcher):
+        self._inner = inner
+        self._name = name
+        self._watcher = watcher
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._watcher.on_acquire(self._name, id(self._inner))
+        return got
+
+    def release(self):
+        self._watcher.on_release(self._name, id(self._inner))
+        self._inner.release()
+
+    def wait(self, timeout=None):
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n=1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+# --- instrumentation targets -------------------------------------------------
+
+def _targets():
+    """(class, attr, lock name, is_condition) for every named lock —
+    imported lazily so merely importing this module costs nothing."""
+    from tidb_tpu.copr import retry as _retry
+    from tidb_tpu.copr import tilecache as _tilecache
+    from tidb_tpu.copr import tpu_engine as _engine
+    from tidb_tpu.copr.client import CopClient, CopResultCache
+    from tidb_tpu.sched import batcher as _batcher
+    from tidb_tpu.sched import resource_group as _rg
+    from tidb_tpu.sched import runaway as _runaway
+    from tidb_tpu.sched import scheduler as _sched
+    from tidb_tpu.storage import detector as _detector
+    from tidb_tpu.storage import memkv as _memkv
+    from tidb_tpu.storage import regions as _regions
+    from tidb_tpu.storage import tso as _tso
+    from tidb_tpu.utils import failpoint as _failpoint
+    from tidb_tpu.utils import memory as _memory
+    from tidb_tpu.utils import metrics as _metrics
+    from tidb_tpu.utils import stmtstats as _stmtstats
+    from tidb_tpu.utils import timeline as _timeline
+    from tidb_tpu.utils import tracing as _tracing
+
+    return [
+        (_sched.AdmissionScheduler, "_cond", "sched.cond", True),
+        (_batcher.LaunchBatcher, "_lock", "batcher", False),
+        (_engine.DeviceLane, "lock", "lane", False),
+        (_engine.TPUEngine, "_lock", "engine", False),
+        (_engine.TPUEngine, "_place_lock", "engine.place", False),
+        (_tilecache.TileCache, "_lock", "tilecache", False),
+        (CopClient, "_lock", "cop.client", False),
+        (CopResultCache, "_lock", "cop.results", False),
+        (_retry.CircuitBreaker, "_lock", "breaker", False),
+        (_memory.MemTracker, "_lock", "memtracker", False),
+        (_memory.ServerMemTracker, "_reg_lock", "mem.registry", False),
+        (_rg.TokenBucket, "_lock", "rg.bucket", False),
+        (_rg.ResourceGroupManager, "_lock", "rgmgr", False),
+        (_runaway.RunawayManager, "_lock", "runaway.mgr", False),
+        (_runaway.RunawayChecker, "_lock", "runaway", False),
+        (_tracing.StatementTrace, "_lock", "trace", False),
+        (_tracing.TraceRing, "_lock", "trace.ring", False),
+        (_timeline.TimelineRing, "_lock", "timeline", False),
+        (_metrics.Counter, "_lock", "metrics", False),
+        (_metrics.Gauge, "_lock", "metrics", False),
+        (_metrics.Histogram, "_lock", "metrics", False),
+        (_metrics.Registry, "_lock", "metrics.registry", False),
+        (_failpoint.Failpoints, "_lock", "failpoint", False),
+        (_stmtstats.StmtStats, "_lock", "stmtstats", False),
+        (_memkv.MemKV, "lock", "memkv", False),
+        (_regions.RegionMap, "_lock", "regions", False),
+        (_tso.TSO, "_lock", "tso", False),
+        (_detector.DeadlockDetector, "_lock", "detector", False),
+    ]
+
+
+def _tree_names() -> frozenset:
+    from . import load_toml
+
+    cfg = load_toml(os.path.join(os.path.dirname(__file__), "lock_order.toml"))
+    return frozenset(
+        d["name"] for d in cfg.get("lock", ()) if d.get("nest") == "tree"
+    )
+
+
+class Instrumentation:
+    """Handle over one live instrumentation: `.watcher` collects edges
+    and reports; `.uninstall()` restores every patched __init__ and
+    retro-wrapped singleton lock."""
+
+    def __init__(self, watcher: LockWatcher):
+        self.watcher = watcher
+        self._patched: list[tuple[type, object]] = []
+        self._rewrapped: list[tuple[object, str, object]] = []
+
+    def _patch_class(self, cls, attrs_names):
+        orig = cls.__init__
+        watcher = self.watcher
+
+        def __init__(obj, *a, __orig=orig, **kw):
+            __orig(obj, *a, **kw)
+            # idempotent per attr (isinstance guard below), so a patched
+            # subclass calling a patched base via super() is harmless
+            for attr, name, is_cond in attrs_names:
+                inner = getattr(obj, attr, None)  # slotted classes too
+                if inner is None or isinstance(inner, (LockProxy, CondProxy)):
+                    continue
+                proxy = (CondProxy if is_cond else LockProxy)(inner, name, watcher)
+                setattr(obj, attr, proxy)
+
+        cls.__init__ = __init__
+        self._patched.append((cls, orig))
+
+    def _rewrap(self, obj, attr, name, is_cond=False):
+        inner = getattr(obj, attr, None)
+        if inner is None or isinstance(inner, (LockProxy, CondProxy)):
+            return
+        setattr(obj, attr, (CondProxy if is_cond else LockProxy)(
+            inner, name, self.watcher))
+        self._rewrapped.append((obj, attr, inner))
+
+    def uninstall(self):
+        for cls, orig in self._patched:
+            cls.__init__ = orig
+        self._patched.clear()
+        for obj, attr, inner in self._rewrapped:
+            setattr(obj, attr, inner)
+        self._rewrapped.clear()
+
+
+def instrument_locks() -> Instrumentation:
+    """Wrap the named locks; new instances of the target classes get
+    proxied locks at construction, and the process-global metrics
+    singletons (already constructed at import) are retro-wrapped."""
+    watcher = LockWatcher(tree_names=_tree_names())
+    inst = Instrumentation(watcher)
+
+    by_class: dict[type, list] = {}
+    for cls, attr, name, is_cond in _targets():
+        by_class.setdefault(cls, []).append((attr, name, is_cond))
+    # patch SUBclasses before base classes so the subclass-guard in the
+    # wrapped __init__ sees the final layout (ServerMemTracker extends
+    # MemTracker: its __init__ chain must wrap BOTH _lock and _reg_lock)
+    for cls, attrs in sorted(by_class.items(),
+                             key=lambda kv: -len(kv[0].__mro__)):
+        inst._patch_class(cls, attrs)
+
+    # retro-wrap the import-time singletons: every registered metric's
+    # lock plus the registry's own
+    from tidb_tpu.utils import metrics as _metrics
+
+    inst._rewrap(_metrics.REGISTRY, "_lock", "metrics.registry")
+    # the history ring HOLDS its own lock while snapshotting through the
+    # registry (tick → rows), so it needs its own name a rank ABOVE
+    inst._rewrap(_metrics.HISTORY, "_lock", "metrics.history")
+    for m in list(_metrics.REGISTRY._metrics.values()):
+        inst._rewrap(m, "_lock", "metrics")
+    from tidb_tpu.utils import failpoint as _failpoint
+
+    fp = getattr(_failpoint, "FP", None)
+    if fp is not None:
+        inst._rewrap(fp, "_lock", "failpoint")
+    return inst

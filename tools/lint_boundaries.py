@@ -1,180 +1,39 @@
 #!/usr/bin/env python
 """Static check: device engine boundaries may only catch the TYPED error
-taxonomy (PR 8 acceptance; the PR 2 discipline, now enforced).
+taxonomy (PR 8 acceptance; the PR 2 discipline, enforced).
 
-A `except Exception` / bare `except:` at a device boundary silently
-swallows interrupts, quota verdicts and real lowering bugs behind the
-host fallback's correct answer. Every device entry point must instead
-route escaping exceptions through `copr/retry.classify_device_error`
-(directly, or via the shared `guarded_device_call` wrapper) so
-non-device errors propagate and device faults feed the breakers.
+PR 9 moved the implementation into the analyzer framework as the
+`boundary-taxonomy` pass (tools/analyze/boundary_pass.py — boundary
+list, allowlist and classify-first idiom all live there now); this file
+is the thin CLI shim that keeps the PR 8 contract stable for callers
+(`tools/t1.sh`, the test_fault_domain lint meta-test):
 
-Rule enforced here: inside the BOUNDARY functions below, a blanket
-handler (`except Exception` / bare / any tuple containing Exception or
-BaseException) fails the lint UNLESS either
-  * the handler's FIRST statement assigns from a call to
-    `classify_device_error(...)` (the sanctioned inline classify idiom,
-    cop client style), or
-  * the (file, function) pair sits in ALLOW with a recorded reason.
-
-Run: python tools/lint_boundaries.py   (wired into tools/t1.sh)
+Run: python tools/lint_boundaries.py
 Exit status 0 = clean, 1 = violations (printed one per line).
 """
 
 from __future__ import annotations
 
-import ast
-import os
 import sys
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-# the device engine boundaries: every function through which a statement
-# reaches (or declines) an accelerator engine
-BOUNDARIES = {
-    "tidb_tpu/executor/executors.py": {
-        "WindowExec._try_device",
-        "WindowExec._try_device_admitted",
-        "WindowExec._device_window_call",
-    },
-    "tidb_tpu/executor/mpp_gather.py": {
-        "MPPGatherExec._dispatch",
-        "MPPGatherExec._produce",
-        "MPPGatherExec._build_scan_datas",
-    },
-    "tidb_tpu/parallel/mpp.py": {
-        "MPPEngine.execute",
-        "MPPEngine.prepare",
-    },
-    "tidb_tpu/executor/window_device.py": {
-        "run_device_window",
-        "run_cached_window",
-        "_run_prepared",
-    },
-    "tidb_tpu/copr/client.py": {
-        "CopClient._run_engines",
-        "CopClient._run_task",
-    },
-    "tidb_tpu/copr/tpu_engine.py": {
-        "TPUEngine.execute",
-        "TPUEngine.execute_many",
-    },
-    "tidb_tpu/sched/batcher.py": {
-        "LaunchBatcher.execute",
-        "LaunchBatcher._launch",
-    },
-    "tidb_tpu/copr/retry.py": {
-        "guarded_device_call",
-    },
-}
-
-# surviving legitimate blanket sites, each with the reason it survives —
-# additions here are a REVIEW decision, not a convenience
-ALLOW = {
-    # the one shared guard: classifies in its handler (structurally
-    # detected too, but pinned here so a refactor can't silently drop it)
-    ("tidb_tpu/copr/retry.py", "guarded_device_call"):
-        "THE sanctioned classify site for the MPP/window boundaries",
-    # per-job isolation: one poisoned co-batched task must not strand or
-    # fail its neighbors; captured exceptions are re-raised per waiter at
-    # the cop client's classify boundary, never absorbed
-    ("tidb_tpu/sched/batcher.py", "LaunchBatcher._launch"):
-        "group->serial isolation; errors re-raised per waiter and "
-        "classified at the cop client boundary",
-    ("tidb_tpu/sched/batcher.py", "LaunchBatcher.execute"):
-        "engine-capability probe (tile_bucket) only; engine faults flow "
-        "through _launch to the classify boundary",
-}
-
-
-def _is_blanket(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:
-        return True  # bare except
-    names = []
-    if isinstance(t, ast.Tuple):
-        names = [getattr(e, "id", getattr(e, "attr", "")) for e in t.elts]
-    else:
-        names = [getattr(t, "id", getattr(t, "attr", ""))]
-    return any(n in ("Exception", "BaseException") for n in names)
-
-
-def _classifies_first(handler: ast.ExceptHandler) -> bool:
-    """First handler statement is `x = classify_device_error(...)`."""
-    if not handler.body:
-        return False
-    st = handler.body[0]
-    if not isinstance(st, ast.Assign) or not isinstance(st.value, ast.Call):
-        return False
-    fn = st.value.func
-    name = getattr(fn, "id", getattr(fn, "attr", ""))
-    return name == "classify_device_error"
-
-
-def _qualnames(tree: ast.AST):
-    """Yield (qualname, funcdef) for every function, Class.method style."""
-    def walk(node, prefix):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                yield from walk(child, child.name + ".")
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield prefix + child.name, child
-                yield from walk(child, prefix + child.name + ".")
-            else:
-                yield from walk(child, prefix)
-
-    yield from walk(tree, "")
-
-
-def check_file(rel: str, boundaries: set[str]) -> list[str]:
-    path = os.path.join(REPO, rel)
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=rel)
-    problems = []
-    found = set()
-    for qual, fn in _qualnames(tree):
-        base = qual
-        # nested defs belong to their outermost boundary function
-        for b in boundaries:
-            if qual == b or qual.startswith(b + "."):
-                base = b
-                break
-        else:
-            continue
-        found.add(base)
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.ExceptHandler) or not _is_blanket(node):
-                continue
-            if (rel, base) in ALLOW:
-                continue
-            if _classifies_first(node):
-                continue
-            problems.append(
-                f"{rel}:{node.lineno}: blanket except in device boundary "
-                f"`{base}` — catch the typed taxonomy or classify first "
-                f"(copr/retry.classify_device_error / guarded_device_call)"
-            )
-    for b in boundaries - found:
-        problems.append(
-            f"{rel}: boundary function `{b}` not found — update "
-            f"tools/lint_boundaries.py BOUNDARIES after renaming it"
-        )
-    return problems
 
 
 def main() -> int:
-    problems = []
-    for rel, bounds in sorted(BOUNDARIES.items()):
-        problems.extend(check_file(rel, bounds))
-    for p in problems:
-        print(p, file=sys.stderr)
-    if problems:
-        print(f"lint_boundaries: {len(problems)} violation(s)", file=sys.stderr)
-        return 1
-    n = sum(len(b) for b in BOUNDARIES.values())
-    print(f"lint_boundaries: OK ({n} device boundaries clean)")
-    return 0
+    from tools.analyze import run
+    from tools.analyze.boundary_pass import BOUNDARIES, BoundaryTaxonomyPass
+
+    rc = run([BoundaryTaxonomyPass()], out=sys.stderr)
+    if rc == 0:
+        n = sum(len(b) for b in BOUNDARIES.values())
+        print(f"lint_boundaries: OK ({n} device boundaries clean)")
+    else:
+        print("lint_boundaries: violations (see above)", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
+    import os
+
+    # runnable as a script from the repo root OR via -m: make the repo
+    # root importable so `tools.analyze` resolves either way
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     sys.exit(main())

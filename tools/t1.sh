@@ -3,6 +3,10 @@
 #   bash tools/t1.sh
 # Exits non-zero on any test failure; prints DOTS_PASSED=<count> last.
 #
+#   bash tools/t1.sh --analyze-json PATH
+# additionally writes the analyzer findings/suppressions artifact to PATH
+# (default when the flag is given bare: analyze_report.json).
+#
 #   bash tools/t1.sh --bench
 # additionally runs the overhead gates (paired off/on p50, ≤5%) and the
 # compressed-tile gate (paired dense/compressed speedup + wire bytes):
@@ -11,10 +15,26 @@
 #   tools/bench_timeline_overhead.py -> BENCH_timeline_pr5.json
 #   tools/bench_tiles.py             -> BENCH_tiles_pr7.json
 cd "$(dirname "$0")/.." || exit 1
-# static boundary lint (PR 8): device engine boundaries may only catch
-# the typed error taxonomy — a blanket `except Exception` there fails
-python tools/lint_boundaries.py || exit 1
-if [ "$1" = "--bench" ]; then
+# static analyzer suite (PR 9): lock-discipline, tls-bind, interrupt-gate,
+# registry-consistency, boundary-taxonomy — any finding not allowlisted
+# (with a written reason) is a red tier-1. Subsumes the PR 8 boundary
+# lint (tools/lint_boundaries.py remains as a shim over the same pass).
+ANALYZE_ARGS=""
+RUN_BENCH=0
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --analyze-json)
+      shift
+      case "$1" in
+        ""|--*) ANALYZE_ARGS="--json analyze_report.json" ;;
+        *) ANALYZE_ARGS="--json $1"; shift ;;
+      esac ;;
+    --bench) RUN_BENCH=1; shift ;;
+    *) echo "t1.sh: unknown argument: $1" >&2; exit 2 ;;
+  esac
+done
+python -m tools.analyze $ANALYZE_ARGS || exit 1
+if [ "$RUN_BENCH" = "1" ]; then
   for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
   done
