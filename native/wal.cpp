@@ -135,6 +135,17 @@ void wal_close(void* h) {
     delete w;
 }
 
+// Close WITHOUT flushing or fsyncing: a poisoned log (failed append/
+// fsync) must never be written again — buffered unacked records are
+// dropped on the floor, exactly like a crash would drop them.
+void wal_abort(void* h) {
+    Wal* w = (Wal*)h;
+    if (w == nullptr) return;
+    if (w->fd >= 0) close(w->fd);
+    free(w->buf);
+    delete w;
+}
+
 // ---------------------------------------------------------------- replay
 
 void* wal_replay_open(const char* path) {
@@ -247,6 +258,22 @@ uint8_t* snap_read(const char* path, uint64_t* out_len) {
     if (crc32(buf, len) != crc) { free(buf); return nullptr; }
     *out_len = len;
     return buf;
+}
+
+// Classify a snapshot file WITHOUT handing out its payload: -1 absent
+// (fopen failed), 0 intact (magic + length footer + CRC all check out),
+// 1 corrupt (present but short / bad magic / bad CRC). snap_read returns
+// nullptr for both absent and corrupt; recovery must tell them apart —
+// proceeding without a corrupt snapshot would replay the WRONG epoch's
+// log over an empty store (silent data loss), so the caller refuses.
+int snap_probe(const char* path) {
+    uint64_t len = 0;
+    uint8_t* buf = snap_read(path, &len);
+    if (buf != nullptr) { free(buf); return 0; }
+    FILE* f = fopen(path, "rb");
+    if (f == nullptr) return -1;
+    fclose(f);
+    return 1;
 }
 
 void snap_free(uint8_t* buf) { free(buf); }

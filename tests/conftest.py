@@ -61,3 +61,11 @@ def _analyze_locks(request):
         f"instrumented-lock detector: {len(reports)} lock-order "
         f"cycle(s) under {mod}:\n{rendered}"
     )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running batteries (crashpoint random-kill soak) — "
+        "excluded from tier-1 via -m 'not slow'",
+    )

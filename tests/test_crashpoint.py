@@ -1,0 +1,106 @@
+"""Real-process crash harness (tools/crashpoint.py): the tier-1 slice
+runs one named crashpoint end-to-end (spawn → self-crash via the
+("crash",) failpoint → reopen → invariant check) and proves the checker
+actually detects broken invariants; the full named matrix runs as a
+separate t1.sh gate and the ≥30-round random-kill soak under -m slow."""
+
+import json
+import os
+
+import pytest
+
+from tools import crashpoint as cp
+
+
+class TestHarnessUnit:
+    def test_collect_acks(self):
+        acks = cp._collect_acks([
+            "READY", "ACK dml 0", "ACK dml 7", "ACK txn 3",
+            "ACK ddl add 0", "ACK ckpt 0", "ERR dml RetryableError",
+            "garbage line",
+        ])
+        assert acks["dml"] == {0, 7}
+        assert acks["txn"] == {3}
+        assert acks["ddl"] == [("add", 0)]
+        assert acks["ckpt"] == 1
+
+    def test_checker_detects_lost_ack(self, tmp_path):
+        """A green checker must be green because the invariants HOLD, not
+        because it checks nothing: an acked-but-absent row must raise."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+
+        ddir = str(tmp_path / "data")
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        s.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t_dml VALUES (0, 0)")
+        s.store.wal.close()
+        acks = {"dml": {0, 99}, "txn": set(), "ddl": [], "ckpt": 0}
+        with pytest.raises(cp.Violation, match="acked DML row 99"):
+            cp._verify(ddir, str(tmp_path / "cdc.jsonl"), acks)
+
+    def test_checker_detects_partial_txn_group(self, tmp_path):
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+
+        ddir = str(tmp_path / "data")
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        s.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+        # 2 of 3 rows of group 5: a torn atomicity unit
+        s.execute("INSERT INTO t_txn VALUES (50, 5, 3), (51, 5, 3)")
+        s.store.wal.close()
+        acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0}
+        with pytest.raises(cp.Violation, match="PARTIAL"):
+            cp._verify(ddir, str(tmp_path / "cdc.jsonl"), acks)
+
+    def test_checker_detects_cdc_ahead_of_durable(self, tmp_path):
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+        from tidb_tpu.codec import tablecodec
+
+        ddir = str(tmp_path / "data")
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        s.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+        s.store.wal.close()
+        # fabricate a sink event for a commit that never became durable
+        key = tablecodec.record_key(999, 1)
+        cdc = tmp_path / "cdc.jsonl"
+        cdc.write_text(json.dumps({
+            "commit_ts": 123456, "start_ts": 123450, "table_id": 999,
+            "handle": 1, "op": "put", "key": key.hex(), "value": "00",
+        }) + "\n")
+        acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0}
+        with pytest.raises(cp.Violation, match="CDC sink ahead"):
+            cp._verify(ddir, str(cdc), acks)
+
+
+class TestRealProcessCrash:
+    def test_named_crashpoint_round(self):
+        """One full spawn→crash→verify cycle in tier-1: the commit-gap
+        crashpoint (locks durable, commit record not) — the cheapest site
+        that still exercises orphan-lock resolution after a REAL death."""
+        ok, detail = cp.run_round("txn/between-prewrite-and-commit", seed=20260803)
+        assert ok, detail
+
+    @pytest.mark.slow
+    def test_named_matrix(self):
+        for i, site in enumerate(sorted(cp.CRASHPOINTS)):
+            ok, detail = cp.run_round(site, seed=9000 + i)
+            assert ok, f"{site}: {detail}"
+
+    @pytest.mark.slow
+    def test_random_kill_soak_30_rounds(self):
+        seed = int(os.environ.get("CRASHPOINT_SEED", "424242"))
+        print(f"\ncrashpoint soak seed={seed} (replay: CRASHPOINT_SEED={seed})")
+        failures = []
+        for i in range(30):
+            ok, detail = cp.run_round(None, seed=seed + i)
+            if not ok:
+                failures.append(f"round {i} (seed {seed + i}): {detail}")
+        assert not failures, "\n".join(failures)

@@ -1,0 +1,499 @@
+"""Durability fault domain (PR 10): WAL corruption discipline (torn tail
+vs mid-log bit rot, tidb_wal_recovery_mode), snapshot integrity, the
+IO-failure read-only degrade (fsyncgate: one failed fsync means no commit
+may ever ack again), and apply_record fuzzing."""
+
+import os
+import random
+import struct
+import zlib
+
+import pytest
+
+from tidb_tpu.errors import StorageIOError, WalCorruptionError
+from tidb_tpu.session import Session
+from tidb_tpu.storage import wal as w
+from tidb_tpu.storage.txn import Storage
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture()
+def ddir(tmp_path):
+    return str(tmp_path / "data")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+def _seed_store(ddir, n=6):
+    st = Storage(data_dir=ddir)
+    for i in range(n):
+        t = st.begin()
+        t.put(b"k%03d" % i, b"v%03d" % i)
+        t.commit()
+    st.wal.close()
+    return os.path.join(ddir, "wal.000000.log")
+
+
+def _frames(path):
+    raw = open(path, "rb").read()
+    out, pos = [], 0
+    while pos + 8 <= len(raw):
+        ln, _crc = struct.unpack_from("<II", raw, pos)
+        out.append((pos, ln))
+        pos += 8 + ln
+    return raw, out
+
+
+def _flip_payload_byte(path, frame_idx):
+    raw, frames = _frames(path)
+    b = bytearray(raw)
+    b[frames[frame_idx][0] + 8] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(b))
+
+
+class TestCorruptionDiscipline:
+    def test_midlog_corruption_refused_by_default(self, ddir):
+        """The planted defect: a bad CRC frame with valid frames AFTER it
+        is bit rot inside committed history — silently truncating there
+        (the old replay behavior) drops committed data."""
+        wal_path = _seed_store(ddir)
+        _flip_payload_byte(wal_path, 2)
+        with pytest.raises(WalCorruptionError, match="MID-LOG"):
+            Storage(data_dir=ddir)
+
+    def test_torn_tail_still_tolerated_by_default(self, ddir):
+        wal_path = _seed_store(ddir)
+        with open(wal_path, "r+b") as f:
+            f.truncate(os.path.getsize(wal_path) - 5)
+        st = Storage(data_dir=ddir)  # no raise: crash shape, auto-recovered
+        assert st.snapshot().get(b"k000") == b"v000"
+        st.wal.close()
+
+    def test_absolute_refuses_even_torn_tail(self, ddir):
+        wal_path = _seed_store(ddir)
+        with open(wal_path, "r+b") as f:
+            f.truncate(os.path.getsize(wal_path) - 5)
+        with pytest.raises(WalCorruptionError, match="absolute"):
+            Storage(data_dir=ddir, wal_recovery_mode="absolute")
+
+    def test_drop_corrupt_salvages_suffix(self, ddir):
+        wal_path = _seed_store(ddir)
+        _flip_payload_byte(wal_path, 2)
+        before = M.WAL_RECOVERY_DROPPED.value(kind="corrupt")
+        st = Storage(data_dir=ddir, wal_recovery_mode="drop-corrupt")
+        # records after the corrupt frame were salvaged, not truncated
+        keys = [k for k, _ in st.snapshot().scan(b"k", b"l")]
+        assert b"k005" in keys and len(keys) >= 5
+        assert M.WAL_RECOVERY_DROPPED.value(kind="corrupt") > before
+        st.wal.close()
+        # the salvage compacted the log: a later DEFAULT open is clean,
+        # and the one-shot ctor arg did NOT persist drop-corrupt
+        st2 = Storage(data_dir=ddir)
+        assert st2.wal_recovery_mode == "tolerate-torn-tail"
+        assert b"k005" in (k for k, _ in st2.snapshot().scan(b"k", b"l"))
+        st2.wal.close()
+
+    def test_commits_after_salvage_survive_restart(self, ddir):
+        wal_path = _seed_store(ddir)
+        _flip_payload_byte(wal_path, 2)
+        st = Storage(data_dir=ddir, wal_recovery_mode="drop-corrupt")
+        t = st.begin()
+        t.put(b"post-salvage", b"1")
+        t.commit()
+        st.wal.close()
+        st2 = Storage(data_dir=ddir)
+        assert st2.snapshot().get(b"post-salvage") == b"1"
+        assert st2.snapshot().get(b"k005") == b"v005"
+        st2.wal.close()
+
+    def test_unknown_mode_rejected(self, ddir):
+        with pytest.raises(ValueError):
+            Storage(data_dir=ddir, wal_recovery_mode="yolo")
+
+    def test_unparseable_intact_frame_refuses_typed(self, ddir):
+        """A frame whose CRC checks out but whose payload misparses (a
+        writer bug) must refuse with the typed error, not crash the
+        constructor with a raw ValueError."""
+        wal_path = _seed_store(ddir, n=2)
+        payload = b"Zgarbage"
+        frame = struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
+        with open(wal_path, "ab") as f:
+            f.write(frame)
+        with pytest.raises(WalCorruptionError, match="does not parse"):
+            Storage(data_dir=ddir)
+
+    def test_scan_log_classification(self, ddir):
+        wal_path = _seed_store(ddir)
+        scan = w.Wal.scan_log(wal_path)
+        assert not scan.corrupt and not scan.mid_log
+        _flip_payload_byte(wal_path, 1)
+        scan = w.Wal.scan_log(wal_path)
+        assert scan.corrupt and scan.mid_log and len(scan.salvage) > 0
+        # torn tail: chop mid-frame — nothing valid can follow
+        raw, frames = _frames(wal_path)
+        with open(wal_path, "r+b") as f:
+            f.truncate(frames[0][0] + 8 + frames[0][1] + 3)
+        scan = w.Wal.scan_log(wal_path)
+        assert scan.corrupt and not scan.mid_log
+
+    def test_zero_filled_tail_reads_as_torn(self, ddir):
+        """A zero-filled torn region must NOT chain as (len=0, crc=0)
+        pseudo-frames and masquerade as salvageable mid-log corruption."""
+        wal_path = _seed_store(ddir, n=3)
+        size = os.path.getsize(wal_path)
+        with open(wal_path, "r+b") as f:
+            f.truncate(size - 6)
+            f.seek(0, os.SEEK_END)
+            f.write(b"\x00" * 256)
+        scan = w.Wal.scan_log(wal_path)
+        assert scan.corrupt and not scan.mid_log
+        st = Storage(data_dir=ddir)  # default mode tolerates the tear
+        st.wal.close()
+
+
+class TestSnapshotIntegrity:
+    def _checkpointed(self, ddir):
+        st = Storage(data_dir=ddir)
+        for i in range(4):
+            t = st.begin()
+            t.put(b"s%d" % i, b"x" * 20)
+            t.commit()
+        st.checkpoint()
+        st.wal.close()
+        return os.path.join(ddir, "snapshot.bin")
+
+    def test_snap_probe_classifies(self, ddir, tmp_path):
+        snap = self._checkpointed(ddir)
+        assert w.snap_probe(str(tmp_path / "absent.bin")) == -1
+        assert w.snap_probe(snap) == 0
+        raw = bytearray(open(snap, "rb").read())
+        raw[-1] ^= 0xFF
+        open(snap, "wb").write(bytes(raw))
+        assert w.snap_probe(snap) == 1
+
+    def test_corrupt_snapshot_refused_in_every_mode(self, ddir):
+        snap = self._checkpointed(ddir)
+        raw = bytearray(open(snap, "rb").read())
+        raw[25] ^= 0xFF  # payload byte: CRC now fails
+        open(snap, "wb").write(bytes(raw))
+        for mode in Storage.RECOVERY_MODES:
+            with pytest.raises(WalCorruptionError, match="snapshot"):
+                Storage(data_dir=ddir, wal_recovery_mode=mode)
+
+    def test_short_snapshot_refused(self, ddir):
+        """The old behavior misparsed struct offsets or silently booted an
+        empty store; a torn snapshot file must refuse instead."""
+        snap = self._checkpointed(ddir)
+        size = os.path.getsize(snap)
+        with open(snap, "r+b") as f:
+            f.truncate(size // 2)
+        with pytest.raises(WalCorruptionError):
+            Storage(data_dir=ddir)
+
+    def test_snap_write_tmp_not_mistaken_for_snapshot(self, ddir):
+        self._checkpointed(ddir)
+        # a leftover .tmp (crash before rename) must not affect recovery
+        snap = os.path.join(ddir, "snapshot.bin")
+        with open(snap + ".tmp", "wb") as f:
+            f.write(b"garbage")
+        st = Storage(data_dir=ddir)
+        assert st.snapshot().get(b"s0") == b"x" * 20
+        st.wal.close()
+
+
+class TestIOFailureDegrade:
+    def _store(self, ddir):
+        st = Storage(data_dir=ddir)
+        t = st.begin()
+        t.put(b"base", b"1")
+        t.commit()
+        return st
+
+    def test_fsync_failure_poisons_forever(self, ddir):
+        """fsyncgate: ONE failed fsync and no later commit may ever ack,
+        even after the fault 'clears' — the page cache can't be trusted."""
+        st = self._store(ddir)
+        FP.enable("wal/io-error-sync", OSError(5, "Input/output error"))
+        t = st.begin()
+        t.put(b"doomed", b"x")
+        with pytest.raises(StorageIOError):
+            t.commit()
+        FP.disable_all()  # the 'transient' fault clears — too late
+        for _ in range(3):
+            t2 = st.begin()
+            t2.put(b"after", b"y")
+            with pytest.raises(StorageIOError):
+                t2.commit()
+        assert st.io_degraded and st.wal.poisoned
+        assert M.WAL_DEGRADED.value() == 1
+
+    def test_append_failure_poisons_too(self, ddir):
+        st = self._store(ddir)
+        FP.enable("wal/io-error-append", OSError(5, "Input/output error"))
+        t = st.begin()
+        t.put(b"doomed", b"x")
+        with pytest.raises(StorageIOError):
+            t.commit()
+        FP.disable_all()
+        assert st.io_degraded
+
+    def test_reads_keep_serving_when_degraded(self, ddir):
+        st = self._store(ddir)
+        FP.enable("wal/io-error-sync", OSError(5, "EIO"))
+        t = st.begin()
+        t.put(b"doomed", b"x")
+        with pytest.raises(StorageIOError):
+            t.commit()
+        FP.disable_all()
+        assert st.snapshot().get(b"base") == b"1"
+
+    def test_checkpoint_and_pessimistic_lock_refused(self, ddir):
+        st = self._store(ddir)
+        FP.enable("wal/io-error-append", OSError(5, "EIO"))
+        t = st.begin()
+        t.put(b"doomed", b"x")
+        with pytest.raises(StorageIOError):
+            t.commit()
+        FP.disable_all()
+        with pytest.raises(StorageIOError):
+            st.checkpoint()
+        tp = st.begin(pessimistic=True)
+        with pytest.raises(StorageIOError):
+            tp.lock_keys_for_update([b"base"])
+
+    def test_reopen_recovers_durable_prefix_and_writes_again(self, ddir):
+        st = self._store(ddir)
+        FP.enable("wal/io-error-sync", OSError(5, "EIO"))
+        t = st.begin()
+        t.put(b"doomed", b"x")
+        with pytest.raises(StorageIOError):
+            t.commit()
+        FP.disable_all()
+        st.wal.close()
+        st2 = Storage(data_dir=ddir)  # fresh open on 'healthy media'
+        assert not st2.io_degraded
+        assert st2.snapshot().get(b"base") == b"1"
+        # closing a POISONED log must not flush its buffered (unacked)
+        # records past the failure — they drop, exactly like a crash
+        assert st2.snapshot().get(b"doomed") is None
+        t = st2.begin()
+        t.put(b"healthy", b"1")
+        t.commit()
+        assert st2.snapshot().get(b"healthy") == b"1"
+        st2.wal.close()
+
+    def test_session_sees_typed_error_no_false_ack(self, ddir):
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY)")
+        FP.enable("wal/io-error-sync", OSError(5, "EIO"))
+        with pytest.raises(StorageIOError):
+            s.execute("INSERT INTO t VALUES (1)")
+        FP.disable_all()
+        with pytest.raises(StorageIOError):
+            s.execute("INSERT INTO t VALUES (2)")
+        # the INTERRUPTED commit is indeterminate (error at the durability
+        # point = unknown outcome, the standard contract); the refused one
+        # (id=2) must be absent; reads keep serving either way
+        rows = [int(r[0]) for r in s.must_query("SELECT id FROM t")]
+        assert rows in ([], [1])
+
+    def test_io_error_metric_counts_once(self, ddir):
+        st = self._store(ddir)
+        before = M.WAL_IO_ERRORS.value(op="sync")
+        FP.enable("wal/io-error-sync", OSError(5, "EIO"))
+        for _ in range(3):
+            t = st.begin()
+            t.put(b"d", b"x")
+            with pytest.raises(StorageIOError):
+                t.commit()
+        FP.disable_all()
+        # the poisoning failure counts once; the rest are refusals
+        assert M.WAL_IO_ERRORS.value(op="sync") == before + 1
+
+
+class TestStartupLockResolution:
+    def test_orphan_secondary_rolls_forward_after_restart(self, ddir):
+        """Commit the primary, crash before secondaries resolve, restart:
+        the first plain read must roll the orphan forward via the
+        primary's commit record (previously only tested WITHOUT the
+        restart in between)."""
+        st = Storage(data_dir=ddir)
+        t = st.begin()
+        t.put(b"a-primary", b"pv")
+        t.put(b"b-secondary", b"sv")
+        boom = RuntimeError("crash before secondaries")
+        FP.enable("txn/commit-after-primary", boom)
+        with pytest.raises(RuntimeError):
+            t.commit()
+        FP.disable_all()
+        st.wal.close()
+
+        st2 = Storage(data_dir=ddir)
+        # plain reads resolve the lock: primary has a commit record, so the
+        # secondary rolls FORWARD (value visible), not back
+        assert st2.snapshot().get(b"b-secondary") == b"sv"
+        assert st2.snapshot().get(b"a-primary") == b"pv"
+        st2.wal.close()
+
+    def test_unprewritten_txn_rolls_back_after_restart(self, ddir):
+        """Crash between prewrite and primary commit: locks are durable but
+        no commit record exists — after restart the first read waits out
+        the TTL and rolls the orphan back (no partial state)."""
+        st = Storage(data_dir=ddir)
+        t = st.begin()
+        t.put(b"a-primary", b"pv")
+        t.put(b"b-secondary", b"sv")
+        boom = RuntimeError("crash between prewrite and commit")
+        FP.enable("txn/between-prewrite-and-commit", boom)
+        with pytest.raises(RuntimeError):
+            t.commit()
+        FP.disable_all()
+        st.wal.close()
+
+        st2 = Storage(data_dir=ddir)
+        assert st2.snapshot().get(b"a-primary") is None
+        assert st2.snapshot().get(b"b-secondary") is None
+        st2.wal.close()
+
+
+class TestRecoveryModeSysvar:
+    def test_set_global_persists_sidecar(self, ddir):
+        s = Session(Storage(data_dir=ddir))
+        s.execute("SET GLOBAL tidb_wal_recovery_mode = 'drop-corrupt'")
+        assert s.store.wal_recovery_mode == "drop-corrupt"
+        assert open(os.path.join(ddir, "RECOVERY_MODE")).read().strip() == "drop-corrupt"
+        s.store.wal.close()
+        # survives the crash it exists for: a fresh open picks it up
+        st2 = Storage(data_dir=ddir)
+        assert st2.wal_recovery_mode == "drop-corrupt"
+        st2.wal.close()
+
+    def test_sidecar_write_failure_is_typed_and_atomic(self, ddir, monkeypatch):
+        """An ENOSPC/EIO on the sidecar write (exactly the degraded-disk
+        environment this knob targets) must surface typed and leave the
+        in-memory mode at its OLD value — @@global must never report a
+        mode the next recovery won't actually run under."""
+        st = Storage(data_dir=ddir)
+
+        def boom(mode):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr(st, "_write_recovery_mode_sidecar", boom)
+        with pytest.raises(StorageIOError):
+            st.set_wal_recovery_mode("absolute")
+        assert st.wal_recovery_mode == "tolerate-torn-tail"
+        assert not os.path.exists(os.path.join(ddir, "RECOVERY_MODE"))
+        st.wal.close()
+
+    def test_plain_set_rejected_and_bad_value_rejected(self, ddir):
+        s = Session(Storage(data_dir=ddir))
+        with pytest.raises(Exception, match="GLOBAL"):
+            s.execute("SET tidb_wal_recovery_mode = 'absolute'")
+        with pytest.raises(Exception):
+            s.execute("SET GLOBAL tidb_wal_recovery_mode = 'yolo'")
+        s.store.wal.close()
+
+    def test_select_global_reads_it(self, ddir):
+        s = Session(Storage(data_dir=ddir))
+        assert s.must_query("SELECT @@global.tidb_wal_recovery_mode") == [
+            ("tolerate-torn-tail",)
+        ]
+        s.execute("SET GLOBAL tidb_wal_recovery_mode = 'absolute'")
+        assert s.must_query("SELECT @@global.tidb_wal_recovery_mode") == [("absolute",)]
+        s.store.wal.close()
+
+
+def _fresh_kv_mvcc():
+    from tidb_tpu.storage.memkv import MemKV
+    from tidb_tpu.storage.mvcc import MVCCStore
+
+    kv = MemKV()
+    return kv, MVCCStore(kv)
+
+
+class TestApplyRecordFuzz:
+    """apply_record must raise ValueError (or apply cleanly) on any
+    truncated/mutated payload — never segfault, never hand np.frombuffer
+    an out-of-range view, never half-apply. CRC framing shields normal
+    recovery; this is the defense for drop-corrupt salvage + writer bugs."""
+
+    def _valid_records(self):
+        import numpy as np
+
+        recs = {
+            "P": w.rec_put(b"key-abc", b"value-payload"),
+            "D": w.rec_delete(b"key-abc"),
+            "X": w.rec_delete_range(b"aaa", b"zzz"),
+            "K": w.rec_kill_runs(b"aaa", b"zzz"),
+        }
+        key_mat = np.arange(24, dtype=np.uint8).reshape(3, 8)
+        vbuf = b"0123456789abcdef"
+        starts = np.array([0, 4, 9], dtype=np.int64)
+        lens = np.array([4, 5, 7], dtype=np.int64)
+        recs["R"] = w.rec_run(key_mat, vbuf, starts, lens, commit_ts=7)
+        return recs
+
+    def _apply(self, payload):
+        kv, mvcc = _fresh_kv_mvcc()
+        w.apply_record(payload, kv, mvcc)
+
+    def test_valid_records_apply(self):
+        for tag, rec in self._valid_records().items():
+            self._apply(rec)
+
+    def test_every_truncation_is_safe(self):
+        for tag, rec in self._valid_records().items():
+            for cut in range(len(rec)):
+                try:
+                    self._apply(rec[:cut])
+                except ValueError:
+                    pass  # the contract: typed refusal
+                # P-value truncation is indistinguishable by design (value
+                # length is implicit); frame CRC owns that case — anything
+                # else must not raise non-ValueError or crash
+
+    def test_seeded_mutations_are_safe(self):
+        rng = random.Random(0xD15C)
+        for tag, rec in self._valid_records().items():
+            for _ in range(300):
+                b = bytearray(rec)
+                for _ in range(rng.randint(1, 3)):
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+                try:
+                    self._apply(bytes(b))
+                except ValueError:
+                    pass
+
+    def test_truncation_never_half_applies(self):
+        """A refused record must leave the store untouched (validation
+        strictly precedes mutation)."""
+        kv, mvcc = _fresh_kv_mvcc()
+        kv.put(b"pre", b"existing")
+        rec = w.rec_put(b"key-abc", b"value")
+        with pytest.raises(ValueError):
+            w.apply_record(rec[:3], kv, mvcc)
+        assert kv.get(b"key-abc") is None
+        assert kv.get(b"pre") == b"existing"
+
+    def test_r_record_slice_bounds_enforced(self):
+        import numpy as np
+
+        key_mat = np.arange(16, dtype=np.uint8).reshape(2, 8)
+        starts = np.array([0, 100], dtype=np.int64)  # out of range
+        lens = np.array([4, 4], dtype=np.int64)
+        rec = w.rec_run(key_mat, b"tiny", starts, lens, commit_ts=3)
+        kv, mvcc = _fresh_kv_mvcc()
+        with pytest.raises(ValueError, match="out of range|length mismatch"):
+            w.apply_record(rec, kv, mvcc)
+
+    def test_unknown_tag_refused(self):
+        with pytest.raises(ValueError, match="unknown WAL record tag"):
+            self._apply(b"Z" + b"\x00" * 8)
+        with pytest.raises(ValueError, match="empty"):
+            self._apply(b"")

@@ -226,6 +226,11 @@ class DDLWorker:
             # retry in backfilling.go)
             return False
         if last_handle is not None:
+            # crashpoint: a backfill batch + its done-handle checkpoint are
+            # durable, the index is still write_reorg — recovery must resume
+            # from the checkpoint and finish to public (or the index stays
+            # invisible to readers), never serve a half-built index
+            _fp("ddl/mid-reorg")
             self._fire("backfill_batch", job)
         return len(rows) < batch
 

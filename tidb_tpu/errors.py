@@ -200,3 +200,30 @@ class BackoffExhausted(TiDBError):
     the message names the region, per-class attempt counts and last error."""
 
     code = 9004
+
+
+# --- durability fault domain (storage/wal.py + storage/txn.py) --------------
+#
+# The disk joins the typed taxonomy: an IO failure on the WAL poisons the
+# log (fsyncgate discipline: after one failed fsync the page cache is in
+# an unknowable state, so NOTHING may ever ack again), and recovery
+# refuses to guess when the log is corrupt rather than merely torn.
+
+
+class StorageIOError(TiDBError):
+    """A WAL append/fsync failed: the store is read-only degraded.
+    Commits fail loud with this error (no false acks — the fsyncgate
+    failure mode), reads keep serving the recovered state."""
+
+    code = 9016
+
+
+class WalCorruptionError(TiDBError):
+    """Recovery found corruption it will not silently drop: a mid-log
+    frame with valid CRC frames after it (bit rot inside committed
+    history, NOT a torn tail), or a corrupt/short snapshot payload.
+    Governed by `tidb_wal_recovery_mode` — the default tolerates only a
+    torn tail; `drop-corrupt` is the explicit opt-in to salvage past
+    corrupt log frames (never past a corrupt snapshot)."""
+
+    code = 9017

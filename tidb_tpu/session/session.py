@@ -1491,6 +1491,10 @@ class Session:
             # store-wide flag on the ring itself: takes effect for every
             # session's next engine call, no per-session re-read needed
             self.store.timeline.enabled = val == "ON"
+        elif name == "tidb_wal_recovery_mode":
+            # applies to the NEXT recovery; persisted in the data dir's
+            # RECOVERY_MODE sidecar so it survives the crash it's for
+            self.store.set_wal_recovery_mode(val)
         elif name == "tidb_server_memory_limit":
             self.store.mem.set_limit(int(val))
         elif name == "tidb_memory_usage_alarm_ratio":

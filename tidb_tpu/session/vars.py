@@ -151,6 +151,18 @@ _sv("tidb_enable_timeline", "ON", scope="global", kind="bool", consumed=True)
 _sv("tidb_timeline_ring_capacity", "8192", scope="global", kind="int", lo=64,
     hi=1 << 20, consumed=True)
 
+# --- durability fault domain (PR 10) ---------------------------------------
+# what recovery does with a damaged WAL (storage/txn.py Storage):
+# tolerate-torn-tail (default) truncates a crash-torn tail but REFUSES
+# mid-log corruption (valid frames after a bad one = bit rot inside
+# committed history); absolute refuses any damage; drop-corrupt is the
+# explicit opt-in to skip corrupt frames and salvage the records after
+# them. GLOBAL-only and persisted in the data dir's RECOVERY_MODE sidecar
+# so the setting survives the very crash it exists for. A corrupt
+# SNAPSHOT is refused in every mode.
+_sv("tidb_wal_recovery_mode", "tolerate-torn-tail", scope="global", kind="enum",
+    enum=("tolerate-torn-tail", "absolute", "drop-corrupt"), consumed=True)
+
 # --- mesh-wide cop dispatch (PR 6) -----------------------------------------
 # dispatch width over the device mesh: cop tasks place onto the first N
 # runner lanes (0 = every device). Serving knob for hosts whose backend

@@ -29,38 +29,43 @@ class MemKV:
     def get(self, key: bytes) -> bytes | None:
         return self._map.get(key)
 
+    # Mutations journal FIRST, then touch the in-memory state: a poisoned
+    # WAL (storage/wal.py IO-failure degrade) raises out of the append, and
+    # journal-first means that raise leaves memory exactly at the state the
+    # durable log describes — reads keep serving a consistent store.
+
     def put(self, key: bytes, value: bytes) -> None:
         with self.lock:
-            if key not in self._map:
-                bisect.insort(self._keys, key)
-            self._map[key] = value
             if self.journal is not None:
                 from .wal import rec_put
 
                 self.journal.append(rec_put(key, value))
+            if key not in self._map:
+                bisect.insort(self._keys, key)
+            self._map[key] = value
 
     def delete(self, key: bytes) -> None:
         with self.lock:
             if key in self._map:
-                del self._map[key]
-                i = bisect.bisect_left(self._keys, key)
-                if i < len(self._keys) and self._keys[i] == key:
-                    self._keys.pop(i)
                 if self.journal is not None:
                     from .wal import rec_delete
 
                     self.journal.append(rec_delete(key))
+                del self._map[key]
+                i = bisect.bisect_left(self._keys, key)
+                if i < len(self._keys) and self._keys[i] == key:
+                    self._keys.pop(i)
 
     def write_batch(self, puts: list[tuple[bytes, bytes]], deletes: list[bytes] = ()) -> None:
         with self.lock:
             for k, v in puts:
-                if k not in self._map:
-                    bisect.insort(self._keys, k)
-                self._map[k] = v
                 if self.journal is not None:
                     from .wal import rec_put
 
                     self.journal.append(rec_put(k, v))
+                if k not in self._map:
+                    bisect.insort(self._keys, k)
+                self._map[k] = v
             for k in deletes:
                 self.delete(k)
 
@@ -112,11 +117,11 @@ class MemKV:
             i = bisect.bisect_left(self._keys, start)
             j = bisect.bisect_left(self._keys, end)
             doomed = self._keys[i:j]
-            for k in doomed:
-                del self._map[k]
-            del self._keys[i:j]
             if doomed and self.journal is not None:
                 from .wal import rec_delete_range
 
                 self.journal.append(rec_delete_range(start, end))
+            for k in doomed:
+                del self._map[k]
+            del self._keys[i:j]
             return len(doomed)

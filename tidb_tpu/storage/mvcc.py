@@ -474,15 +474,18 @@ class MVCCStore:
         run = Run.build(key_mat, vbuf, starts, lens, commit_ts, presorted=presorted)
         if run.n:
             # kv.lock serializes against checkpoint() snapshotting runs and
-            # rotating the journal under the same lock
+            # rotating the journal under the same lock. Journal FIRST: a
+            # poisoned WAL (IO-failure degrade) raises out of the append,
+            # and journal-first keeps the in-memory runs exactly at the
+            # state the durable log describes
             with self.kv.lock:
-                self.runs.append(run)
                 j = getattr(self, "journal", None)
                 if j is not None:
                     from .wal import rec_run
 
                     j.append(rec_run(run.key_mat, run.vbuf, run.starts, run.lens, commit_ts))
                     j.sync()  # bulk ingests are their own durability point
+                self.runs.append(run)
             hook = getattr(self, "split_hook", None)
             if hook is not None:
                 hook(run)
@@ -518,13 +521,16 @@ class MVCCStore:
         n = 0
         for cf in (b"d", b"w", b"l"):
             n += self.kv.delete_range(cf + start, cf + end)
-        killed = self.kill_runs_range(start, end)
-        n += killed
+        # journal the run-kill BEFORE mutating the runs (a K record over a
+        # range no run intersects replays as a no-op, so over-journaling
+        # when self.runs is non-empty is safe; killing first and then
+        # failing the append would leave memory ahead of the durable log)
         j = getattr(self, "journal", None)
-        if j is not None and killed:
+        if j is not None and self.runs:
             from .wal import rec_kill_runs
 
             j.append(rec_kill_runs(start, end))
+        n += self.kill_runs_range(start, end)
         return n
 
     # --- GC (ref: store/gcworker) -----------------------------------------

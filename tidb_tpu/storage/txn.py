@@ -17,7 +17,16 @@ from threading import Lock
 
 log = logging.getLogger(__name__)
 
-from ..errors import DeadlockError, LockedError, RetryableError, TiDBError, TxnAborted, WriteConflict
+from ..errors import (
+    DeadlockError,
+    LockedError,
+    RetryableError,
+    StorageIOError,
+    TiDBError,
+    TxnAborted,
+    WalCorruptionError,
+    WriteConflict,
+)
 from ..utils.failpoint import inject as _fp
 from .memkv import MemKV
 from .mvcc import MVCCStore, Mutation, OP_DEL, OP_LOCK, OP_PUT
@@ -100,6 +109,9 @@ class Txn:
         if not self.pessimistic:
             self._locked_keys.update(keys)
             return
+        # pessimistic locks are journaled writes: refuse before touching
+        # the store when a WAL IO failure has degraded it read-only
+        self.store.check_writable()
         mvcc = self.store.mvcc
         # the primary is only PINNED once an acquisition succeeds — a
         # never-locked primary would read as rolled_back to waiters, who
@@ -215,6 +227,12 @@ class Txn:
             self.committed = True
             self.store._txn_done(self.start_ts)
             return self.start_ts
+        # degrade gate (fsyncgate discipline): after ONE WAL IO failure no
+        # commit may ever ack again. Failing HERE — before prewrite touches
+        # anything — keeps the in-memory state consistent with the durable
+        # log, so reads keep serving. Empty commits above are read acks and
+        # pass through.
+        self.store.check_writable()
         muts = []
         for k, v in self.membuf.items():
             if v == TOMBSTONE:
@@ -267,6 +285,9 @@ class Txn:
 
         # phase 2
         _fp("txn/commit-after-prewrite")
+        # crashpoint: prewrite locks appended (possibly flushed), primary
+        # commit record not — recovery must leave resolvable orphan locks
+        _fp("txn/between-prewrite-and-commit")
         self.commit_ts = self.store.tso.next()
         try:
             mvcc.commit([primary], self.start_ts, self.commit_ts)
@@ -295,7 +316,14 @@ class Txn:
 
     def rollback(self) -> None:
         if self._pess_keys:
-            self.store.mvcc.pessimistic_rollback(sorted(self._pess_keys), self.start_ts)
+            try:
+                self.store.mvcc.pessimistic_rollback(sorted(self._pess_keys), self.start_ts)
+            except StorageIOError:
+                # the WAL died mid-txn: the physical lock release cannot be
+                # journaled. Leave the locks — the store is read-only
+                # degraded anyway, and a reopened store resolves them via
+                # the primary's TTL like any other orphan.
+                log.warning("pessimistic rollback skipped: WAL degraded (txn %d)", self.start_ts)
             self._pess_keys.clear()
         self.store.detector.done(self.start_ts)
         self.membuf.clear()
@@ -311,9 +339,28 @@ class Storage:
     journals every mutation, commits group-flush + fsync, a fresh Storage
     over the same dir recovers snapshot + intact log prefix, and
     checkpoint() compacts log into snapshot (the reference's storage node
-    persists the same way through badger/RocksDB WALs + SSTs)."""
+    persists the same way through badger/RocksDB WALs + SSTs).
 
-    def __init__(self, data_dir: str | None = None):
+    `wal_recovery_mode` governs what recovery does with a damaged log
+    (sysvar `tidb_wal_recovery_mode`; persisted in the RECOVERY_MODE
+    sidecar so SET GLOBAL survives a crash+restart):
+      - 'tolerate-torn-tail' (default): a torn tail (crash cut the last
+        frames, nothing valid after) is truncated; MID-LOG corruption
+        (valid CRC frames follow a bad one) refuses with
+        WalCorruptionError — truncating there drops committed data.
+      - 'absolute': any bad frame refuses.
+      - 'drop-corrupt': explicit opt-in to skip corrupt log frames and
+        salvage the intact records after them (dropped bytes counted in
+        tidb_wal_recovery_dropped_bytes_total). Never applies to a
+        corrupt snapshot — that is refused in every mode."""
+
+    RECOVERY_MODES = ("tolerate-torn-tail", "absolute", "drop-corrupt")
+
+    def __init__(self, data_dir: str | None = None, wal_recovery_mode: str | None = None):
+        if wal_recovery_mode is not None and wal_recovery_mode not in self.RECOVERY_MODES:
+            raise ValueError(f"unknown wal_recovery_mode {wal_recovery_mode!r}")
+        self.wal_recovery_mode = wal_recovery_mode
+        self._io_degraded = False
         self.kv = MemKV()
         self.mvcc = MVCCStore(self.kv)
         self.tso = TSO()
@@ -368,6 +415,76 @@ class Storage:
         # splits) against fully-initialized state
         if data_dir is not None:
             self._open_durable(data_dir)
+        elif self.wal_recovery_mode is None:
+            self.wal_recovery_mode = self.RECOVERY_MODES[0]
+
+    # --- IO-failure degrade (fsyncgate discipline) -------------------------
+
+    def _wal_io_error(self, op: str) -> None:
+        """Installed as the Wal's on_io_error hook: the first failed
+        append/fsync lands here (before the writer sees StorageIOError)
+        and flips the store read-only for the rest of its life. The
+        gauge is STICKY for the process: a degraded store never heals
+        in-place (only a fresh process/Storage over healthy media does),
+        and another store's healthy open must not mask this one's state."""
+        if self._io_degraded:
+            return
+        self._io_degraded = True
+        from ..utils import metrics as M
+
+        M.WAL_DEGRADED.set(1)
+        log.error(
+            "WAL %s failed on %s: storage degraded read-only — commits "
+            "fail loud from here on, reads keep serving; reopen the store "
+            "on healthy media to write again", op, self.data_dir,
+        )
+
+    def check_writable(self) -> None:
+        """Raise StorageIOError when a WAL IO failure degraded the store.
+        Every write entry point (commit, pessimistic locking, checkpoint)
+        gates here so nothing can ack after the log went bad."""
+        if self._io_degraded:
+            raise StorageIOError(
+                "storage is read-only: a WAL IO failure poisoned the log "
+                "(no commit can ack durably); reads keep serving — reopen "
+                "the store on healthy media to restore writes"
+            )
+
+    @property
+    def io_degraded(self) -> bool:
+        return self._io_degraded
+
+    def set_wal_recovery_mode(self, mode: str) -> None:
+        """SET GLOBAL tidb_wal_recovery_mode seam: validate, persist in
+        the RECOVERY_MODE sidecar (so the setting survives the very crash
+        it exists for) and only then apply in memory — a sidecar write
+        failure must not leave @@global reporting a mode the next
+        recovery won't actually run under."""
+        if mode not in self.RECOVERY_MODES:
+            raise ValueError(f"unknown wal_recovery_mode {mode!r}")
+        if self.data_dir is not None:
+            try:
+                self._write_recovery_mode_sidecar(mode)
+            except OSError as e:
+                raise StorageIOError(
+                    f"cannot persist tidb_wal_recovery_mode={mode!r} to the "
+                    f"RECOVERY_MODE sidecar ({e}); the setting was NOT applied"
+                ) from e
+        self.wal_recovery_mode = mode
+
+    def _write_recovery_mode_sidecar(self, mode: str) -> None:
+        import os
+
+        from . import wal as w
+
+        path = os.path.join(self.data_dir, "RECOVERY_MODE")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(mode + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        w.fsync_dir(self.data_dir)
 
     @property
     def ddl(self):
@@ -469,53 +586,155 @@ class Storage:
         import os
         import struct
 
+        from ..utils import metrics as M
         from . import wal as w
 
         os.makedirs(data_dir, exist_ok=True)
+        # 0) recovery mode: an explicit ctor arg governs THIS open only
+        # (one-shot salvage must not permanently opt the store into
+        # dropping corruption); else the RECOVERY_MODE sidecar (a prior
+        # SET GLOBAL — persisted so it survives the crash it exists for);
+        # else the default
+        mode_path = os.path.join(data_dir, "RECOVERY_MODE")
+        if self.wal_recovery_mode is None:
+            if os.path.exists(mode_path):
+                with open(mode_path) as f:
+                    saved = f.read().strip()
+                if saved in self.RECOVERY_MODES:
+                    self.wal_recovery_mode = saved
+                else:
+                    log.warning("ignoring unknown RECOVERY_MODE sidecar value %r", saved)
+            if self.wal_recovery_mode is None:
+                self.wal_recovery_mode = self.RECOVERY_MODES[0]
+        # @@global.tidb_wal_recovery_mode reflects the mode THIS open
+        # actually ran under (sidecar or one-shot ctor arg included)
+        self.global_vars.setdefault("tidb_wal_recovery_mode", self.wal_recovery_mode)
         snap_path = os.path.join(data_dir, "snapshot.bin")
-        # 1) snapshot (if any); its header names the WAL epoch it subsumes
+        # 1) snapshot (if any); its header names the WAL epoch it subsumes.
+        # snap_read returns None for absent AND corrupt; a PRESENT-but-
+        # unreadable snapshot is refused in EVERY mode — booting without it
+        # would replay the wrong epoch's (or no) log over an empty store,
+        # silently losing everything the snapshot held. (snap_probe gives
+        # the same classification for tooling; one read suffices here.)
         payload = w.snap_read(snap_path)
+        if payload is None and os.path.exists(snap_path):
+            raise WalCorruptionError(
+                f"snapshot {snap_path!r} is present but corrupt (short file, "
+                f"bad magic, or CRC mismatch); refusing to recover — restore "
+                f"the snapshot from a replica/backup (refused in every "
+                f"tidb_wal_recovery_mode, including drop-corrupt)"
+            )
         if payload:
-            pos = 0
-            (self._wal_epoch,) = struct.unpack_from("<Q", payload, pos)
-            pos += 8
-            (n_entries,) = struct.unpack_from("<Q", payload, pos)
-            pos += 8
-            pairs = []
-            for _ in range(n_entries):
-                klen, vlen = struct.unpack_from("<II", payload, pos)
+            try:
+                pos = 0
+                (self._wal_epoch,) = struct.unpack_from("<Q", payload, pos)
                 pos += 8
-                k = payload[pos : pos + klen]
-                pos += klen
-                v = payload[pos : pos + vlen]
-                pos += vlen
-                pairs.append((k, v))
-            self.kv.bulk_load(pairs)
-            (n_runs,) = struct.unpack_from("<I", payload, pos)
-            pos += 4
-            for _ in range(n_runs):
-                rec_len = struct.unpack_from("<Q", payload, pos)[0]
+                (n_entries,) = struct.unpack_from("<Q", payload, pos)
                 pos += 8
-                w.apply_record(payload[pos : pos + rec_len], self.kv, self.mvcc)
-                pos += rec_len
-        # 2) replay the intact prefix of THIS epoch's log only — a crash
-        # between snapshot rename and log rotation must not re-apply runs
-        # the snapshot already contains
+                pairs = []
+                for _ in range(n_entries):
+                    klen, vlen = struct.unpack_from("<II", payload, pos)
+                    pos += 8
+                    if pos + klen + vlen > len(payload):
+                        raise ValueError("snapshot entry overruns payload")
+                    k = payload[pos : pos + klen]
+                    pos += klen
+                    v = payload[pos : pos + vlen]
+                    pos += vlen
+                    pairs.append((k, v))
+                self.kv.bulk_load(pairs)
+                (n_runs,) = struct.unpack_from("<I", payload, pos)
+                pos += 4
+                for _ in range(n_runs):
+                    rec_len = struct.unpack_from("<Q", payload, pos)[0]
+                    pos += 8
+                    if pos + rec_len > len(payload):
+                        raise ValueError("snapshot run record overruns payload")
+                    w.apply_record(payload[pos : pos + rec_len], self.kv, self.mvcc)
+                    pos += rec_len
+            except (struct.error, ValueError) as e:
+                # CRC checked out but the payload misparses: a writer bug,
+                # not media damage — same refuse-don't-guess treatment
+                raise WalCorruptionError(
+                    f"snapshot {snap_path!r} payload does not parse ({e}); "
+                    f"refusing to recover from a half-understood snapshot"
+                ) from e
+        # 2) replay THIS epoch's log only — a crash between snapshot rename
+        # and log rotation must not re-apply runs the snapshot already
+        # contains. The scan distinguishes a torn tail (nothing valid after
+        # the first bad frame — the expected crash shape, truncated) from
+        # MID-LOG corruption (valid CRC frames follow — bit rot inside
+        # committed history), which only `drop-corrupt` may skip.
         wal_path = self._wal_path(self._wal_epoch)
+        salvage: list[bytes] = []
         if os.path.exists(wal_path):
-            recs, valid = w.Wal.replay_records(wal_path)
-            for rec in recs:
-                w.apply_record(rec, self.kv, self.mvcc)
-            if valid < os.path.getsize(wal_path):
-                os.truncate(wal_path, valid)  # drop the torn tail for append
+            scan = w.Wal.scan_log(wal_path)
+            if scan.corrupt:
+                bad = scan.file_size - scan.valid_prefix
+                if self.wal_recovery_mode == "absolute":
+                    raise WalCorruptionError(
+                        f"WAL {wal_path!r} has a bad frame at byte "
+                        f"{scan.valid_prefix} ({bad} bytes unreadable) and "
+                        f"tidb_wal_recovery_mode=absolute refuses ANY damage"
+                    )
+                if scan.mid_log and self.wal_recovery_mode != "drop-corrupt":
+                    raise WalCorruptionError(
+                        f"WAL {wal_path!r} is corrupt MID-LOG: {len(scan.salvage)} "
+                        f"intact record(s) follow the bad frame at byte "
+                        f"{scan.valid_prefix} — this is bit rot inside committed "
+                        f"history, not a torn tail, and truncating would silently "
+                        f"drop committed data. Restore from a replica, or opt in "
+                        f"with tidb_wal_recovery_mode=drop-corrupt to skip the "
+                        f"corrupt region and salvage the records after it"
+                    )
+
+            def _replay(rec: bytes, what: str) -> None:
+                # CRC passed but the payload misparses: a writer bug on the
+                # intact prefix, or a pseudo-frame chain on the salvage path
+                # — either way refuse typed, never crash untyped out of the
+                # constructor in the one mode meant to survive corruption
+                try:
+                    w.apply_record(rec, self.kv, self.mvcc)
+                except ValueError as e:
+                    raise WalCorruptionError(
+                        f"WAL {wal_path!r}: {what} record does not parse "
+                        f"({e}); refusing to recover from a half-understood "
+                        f"log — restore from a replica/backup"
+                    ) from e
+
+            for rec in scan.records:
+                _replay(rec, "intact-prefix")
+            if scan.corrupt:
+                if scan.mid_log:  # drop-corrupt: skip the bad region, keep the rest
+                    for rec in scan.salvage:
+                        _replay(rec, "salvaged")
+                    salvage = list(scan.salvage)
+                    dropped = (scan.file_size - scan.valid_prefix) - sum(
+                        8 + len(r) for r in salvage
+                    )
+                    M.WAL_RECOVERY_DROPPED.inc(dropped, kind="corrupt")
+                    log.warning(
+                        "drop-corrupt recovery on %s: skipped %d corrupt byte(s), "
+                        "salvaged %d record(s) past them", wal_path, dropped, len(salvage),
+                    )
+                else:
+                    M.WAL_RECOVERY_DROPPED.inc(scan.file_size - scan.valid_prefix, kind="torn")
+                # truncate to the intact prefix before appending (salvaged
+                # records are re-appended below, through the fresh Wal)
+                os.truncate(wal_path, scan.valid_prefix)
         # stale epochs (pre-checkpoint logs) are garbage
         for f in os.listdir(data_dir):
             if f.startswith("wal.") and f.endswith(".log") and f != os.path.basename(wal_path):
                 os.unlink(os.path.join(data_dir, f))
         # 3) attach journals (AFTER replay so replay doesn't self-append)
-        self.wal = w.Wal(wal_path)
+        self.wal = w.Wal(wal_path, on_io_error=self._wal_io_error)
         self.kv.journal = self.wal
         self.mvcc.journal = self.wal
+        if salvage:
+            # make the salvaged suffix durable again in its compacted place
+            for rec in salvage:
+                self.wal.append(rec)
+            self.wal.sync()
 
     def wal_sync(self) -> None:
         if self.wal is not None:
@@ -526,6 +745,9 @@ class Storage:
         node's flush/compaction analog)."""
         if self.wal is None:
             raise TiDBError("checkpoint requires a durable Storage (data_dir)")
+        # degraded log: the snapshot would capture in-memory state the WAL
+        # can no longer guarantee matches disk — refuse like any write
+        self.check_writable()
         import os
         import struct
 
@@ -558,9 +780,13 @@ class Storage:
             # new log exists: a crash in between recovers from the
             # snapshot alone (the old epoch's log is simply ignored)
             w.snap_write(os.path.join(self.data_dir, "snapshot.bin"), payload)
+            # crashpoint: snapshot (epoch E+1) renamed into place, the new
+            # log not yet created and the old one not yet unlinked — recovery
+            # must come up from the snapshot alone, ignoring the stale log
+            _fp("checkpoint/after-snap-rename")
             old = self.wal
             self._wal_epoch = new_epoch
-            self.wal = w.Wal(self._wal_path(new_epoch))
+            self.wal = w.Wal(self._wal_path(new_epoch), on_io_error=self._wal_io_error)
             self.kv.journal = self.wal
             self.mvcc.journal = self.wal
             old.close()
@@ -570,6 +796,9 @@ class Storage:
             w.fsync_dir(self.data_dir)
             old_path = self._wal_path(new_epoch - 1)
             if os.path.exists(old_path):
+                # crashpoint: both epochs' logs exist; recovery must pick the
+                # snapshot's epoch and discard the stale predecessor
+                _fp("checkpoint/before-old-unlink")
                 os.unlink(old_path)
                 w.fsync_dir(self.data_dir)
 

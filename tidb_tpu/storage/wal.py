@@ -10,6 +10,24 @@ Record payloads (framing/CRC live in C++; payloads are ours):
   b'D' u32 klen key                delete
   b'X' u32 slen start u32 elen end delete_range
   b'R' run: u32 w, u64 n, u64 commit_ts, key_mat, starts, lens, vbuf
+
+Failure discipline (the durability fault domain, PR 10):
+
+  * IO failure — ONE failed append or fsync poisons the `Wal` (the
+    fsyncgate rule: after a failed fsync the kernel may have dropped the
+    dirty pages, so re-trying and acking would be lying). Every later
+    write raises `StorageIOError`; the owning Storage flips read-only.
+    The commit IN FLIGHT at the failure is indeterminate — the error at
+    the durability point means UNKNOWN outcome (the standard contract for
+    an error after the commit point), never a false ack; every commit
+    AFTER it fails before touching anything.
+  * Corruption — recovery distinguishes a TORN TAIL (a crash cut the
+    last frames; nothing with a valid CRC follows) from MID-LOG
+    CORRUPTION (a bad frame with valid CRC frames after it — bit rot
+    inside committed history). The first is truncated and tolerated;
+    the second raises `WalCorruptionError` unless the operator opted
+    into `drop-corrupt` (see Storage._open_durable / the
+    `tidb_wal_recovery_mode` sysvar).
 """
 
 from __future__ import annotations
@@ -19,8 +37,13 @@ import os
 import struct
 import subprocess
 import threading
+import zlib
+from dataclasses import dataclass, field
 
 import numpy as np
+
+from ..errors import StorageIOError
+from ..utils import metrics as M
 from ..utils.failpoint import inject as _fp
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "wal.cpp")
@@ -49,6 +72,7 @@ def _load_lib() -> ctypes.CDLL:
         lib.wal_sync.restype = ctypes.c_int
         lib.wal_sync.argtypes = [ctypes.c_void_p]
         lib.wal_close.argtypes = [ctypes.c_void_p]
+        lib.wal_abort.argtypes = [ctypes.c_void_p]
         lib.wal_replay_open.restype = ctypes.c_void_p
         lib.wal_replay_open.argtypes = [ctypes.c_char_p]
         lib.wal_replay_next.restype = ctypes.c_int
@@ -64,51 +88,102 @@ def _load_lib() -> ctypes.CDLL:
         lib.snap_write.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64]
         lib.snap_read.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.snap_read.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.snap_probe.restype = ctypes.c_int
+        lib.snap_probe.argtypes = [ctypes.c_char_p]
         lib.snap_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
         _LIB = lib
         return lib
 
 
 class Wal:
-    """One open write-ahead log."""
+    """One open write-ahead log.
 
-    def __init__(self, path: str):
+    `on_io_error(op)` is the degrade hook the owning Storage installs:
+    called exactly once, on the failure that poisons the log, BEFORE the
+    `StorageIOError` is raised to the writer."""
+
+    def __init__(self, path: str, on_io_error=None):
         self.lib = _load_lib()
         self.path = path
         self._h = self.lib.wal_open(path.encode())
         if not self._h:
             raise OSError(f"cannot open WAL at {path}")
         self._lock = threading.Lock()
+        self.poisoned = False
+        self.on_io_error = on_io_error
+
+    def _io_failed(self, op: str, cause) -> None:
+        """First failure poisons the log; callers see a typed error."""
+        first = not self.poisoned
+        self.poisoned = True
+        if first:
+            M.WAL_IO_ERRORS.inc(op=op)
+            cb = self.on_io_error
+            if cb is not None:
+                cb(op)
+        err = StorageIOError(
+            f"WAL {op} failed on {self.path!r} ({cause}); the log is "
+            f"poisoned and the store is read-only — no commit will ack "
+            f"until the store is reopened on healthy media"
+        )
+        if isinstance(cause, BaseException):
+            raise err from cause
+        raise err
 
     def append(self, payload: bytes) -> None:
         with self._lock:
+            if self.poisoned:
+                self._io_failed("append", "log already poisoned")
+            if self._h is None:
+                raise StorageIOError(f"WAL {self.path!r} is closed")
+            try:
+                _fp("wal/io-error-append")
+            except OSError as e:
+                self._io_failed("append", e)
             if self.lib.wal_append(self._h, payload, len(payload)) < 0:
-                raise OSError("WAL append failed")
+                self._io_failed("append", "native append error")
+        # durability-gap crashpoint: record buffered, nothing fsynced yet
+        _fp("wal/after-append-before-sync")
 
     def sync(self) -> None:
         _fp("wal/before-sync")
         with self._lock:
+            if self.poisoned:
+                self._io_failed("sync", "log already poisoned")
+            if self._h is None:
+                return  # closed: close() already flushed + fsynced
+            try:
+                _fp("wal/io-error-sync")
+            except OSError as e:
+                self._io_failed("sync", e)
             if self.lib.wal_sync(self._h) != 0:
-                raise OSError("WAL fsync failed")
+                self._io_failed("sync", "native fsync error")
 
     def close(self) -> None:
         with self._lock:
             if self._h:
-                self.lib.wal_close(self._h)
+                if self.poisoned:
+                    # NOTHING may be written after poisoning: drop the
+                    # buffered (necessarily unacked) records like a crash
+                    # would, instead of flushing them past the failure
+                    self.lib.wal_abort(self._h)
+                else:
+                    self.lib.wal_close(self._h)
                 self._h = None
 
     @staticmethod
     def replay(path: str):
-        """Yield intact record payloads (stops at a torn tail)."""
+        """Yield intact record payloads (stops at the first bad frame)."""
         recs, _ = Wal.replay_records(path)
         yield from recs
 
     @staticmethod
     def replay_records(path: str) -> tuple[list[bytes], int]:
-        """→ (intact record payloads, intact byte prefix length). The
-        caller must truncate the file to the prefix before appending, or
-        post-recovery commits land beyond the torn bytes and are lost on
-        the next replay."""
+        """→ (intact-prefix record payloads, intact byte prefix length).
+        The caller must truncate the file to the prefix before appending,
+        or post-recovery commits land beyond the torn bytes and are lost
+        on the next replay. Corruption-agnostic: use `scan_log` to learn
+        whether valid frames FOLLOW the first bad one."""
         lib = _load_lib()
         h = lib.wal_replay_open(path.encode())
         if not h:
@@ -126,6 +201,110 @@ class Wal:
             return recs, int(lib.wal_replay_valid_bytes(h))
         finally:
             lib.wal_replay_close(h)
+
+    @staticmethod
+    def scan_log(path: str) -> "WalScan":
+        """Full recovery scan: the intact prefix PLUS a look past the
+        first bad frame, so recovery can tell a torn tail from mid-log
+        corruption (see WalScan)."""
+        recs, valid = Wal.replay_records(path)
+        size = os.path.getsize(path) if os.path.exists(path) else 0
+        salvage: list[bytes] = []
+        gap = 0
+        if valid < size:
+            with open(path, "rb") as f:
+                f.seek(valid)
+                tail = f.read()
+            salvage, gap = _scan_salvage(tail)
+        return WalScan(recs, valid, size, salvage, gap)
+
+
+@dataclass
+class WalScan:
+    """Result of Wal.scan_log.
+
+    `records` is the intact prefix. When the file has a bad frame
+    (`corrupt`), `salvage` holds the valid-CRC frames found AFTER it —
+    non-empty salvage means MID-LOG corruption (committed history exists
+    beyond the bad bytes; silently truncating would drop it), empty
+    salvage means a plain torn tail. `salvage_gap` is the byte distance
+    from the intact prefix to the first salvaged frame (the corrupt
+    region recovery would discard under drop-corrupt)."""
+
+    records: list = field(default_factory=list)
+    valid_prefix: int = 0
+    file_size: int = 0
+    salvage: list = field(default_factory=list)
+    salvage_gap: int = 0
+
+    @property
+    def corrupt(self) -> bool:
+        return self.valid_prefix < self.file_size
+
+    @property
+    def mid_log(self) -> bool:
+        return bool(self.salvage)
+
+
+# resync scan window after a corrupt frame whose length header is ALSO
+# gone: probing every byte offset is O(window * frame) worst case, so it
+# is bounded — real logs resync at the first true frame boundary anyway
+_SALVAGE_SCAN_CAP = 4 << 20
+# CRC-work budget for the offset-probing fallback: pathological tails
+# (e.g. long runs whose bytes keep decoding as in-range frame lengths)
+# would otherwise cost O(window²) in checksums
+_SALVAGE_CRC_BUDGET = 32 << 20
+
+
+def _scan_salvage(tail: bytes) -> tuple[list[bytes], int]:
+    """Hunt for a valid frame chain after the first bad frame.
+
+    A chain only qualifies when it runs to EOF or ends in ONE incomplete
+    trailing frame (bit rot leaves the rest of the file as intact frames;
+    a crash may additionally tear the very last one). A torn tail's
+    garbage bytes can contain pseudo-frames whose CRC happens to check
+    out, but such a chain ends mid-garbage and is rejected — this errs
+    toward classifying as torn (auto-recoverable) while never letting a
+    real committed suffix be silently truncated. Zero-length frames also
+    disqualify a chain: no real record is empty, but a zero-filled torn
+    region chains as (len=0, crc=0) frames forever. Known limits: TWO
+    separate corrupt regions read as a torn tail at the second one, and
+    the offset-probing fallback (length header destroyed too) stops at a
+    bounded CRC budget, classifying as torn past it."""
+    n = len(tail)
+    budget = [_SALVAGE_CRC_BUDGET]
+
+    def chain(off: int) -> tuple[list[bytes], bool]:
+        out: list[bytes] = []
+        while off + 8 <= n:
+            ln, crc = struct.unpack_from("<II", tail, off)
+            if ln == 0:
+                return out, False  # no real record is empty: garbage
+            if off + 8 + ln > n:
+                return out, True  # incomplete trailing frame: torn end
+            budget[0] -= ln
+            if zlib.crc32(tail[off + 8 : off + 8 + ln]) != crc:
+                return out, False  # mid-data garbage: chain disqualified
+            out.append(tail[off + 8 : off + 8 + ln])
+            off += 8 + ln
+        return out, True  # EOF (or < 8 trailing header bytes)
+
+    # bit rot in a payload keeps the framing intact: the bad frame's
+    # length header still points at the next frame
+    if n >= 8:
+        ln, _ = struct.unpack_from("<II", tail, 0)
+        if ln and 8 + ln < n:
+            got, clean_end = chain(8 + ln)
+            if got and clean_end:
+                return got, 8 + ln
+    # length header corrupted too: resync by probing offsets (bounded)
+    for off in range(1, max(0, min(n, _SALVAGE_SCAN_CAP) - 8)):
+        if budget[0] <= 0:
+            break
+        got, clean_end = chain(off)
+        if got and clean_end:
+            return got, off
+    return [], 0
 
 
 def fsync_dir(path: str) -> None:
@@ -152,6 +331,14 @@ def snap_read(path: str) -> bytes | None:
         return ctypes.string_at(buf, n.value)
     finally:
         lib.snap_free(buf)
+
+
+def snap_probe(path: str) -> int:
+    """Classify a snapshot file: -1 absent, 0 intact, 1 corrupt (present
+    but short / bad magic / bad CRC). `snap_read` returns None for both
+    absent and corrupt; recovery must refuse on corrupt instead of
+    booting an empty store over the wrong epoch's log."""
+    return int(_load_lib().snap_probe(path.encode()))
 
 
 # --------------------------------------------------------- record payloads
@@ -187,28 +374,50 @@ def rec_run(key_mat: np.ndarray, vbuf, starts: np.ndarray, lens: np.ndarray, com
     )
 
 
+def _need(ok: bool, what: str) -> None:
+    if not ok:
+        raise ValueError(f"malformed WAL record: {what}")
+
+
 def apply_record(payload: bytes, kv, mvcc) -> None:
-    """Replay one journal record into the in-memory store."""
+    """Replay one journal record into the in-memory store.
+
+    Every length field is validated BEFORE it is used to slice: a
+    truncated or mutated payload must raise ValueError, never half-apply
+    a short key/value (Python slices truncate silently) or hand
+    np.frombuffer an out-of-range view. CRC framing makes malformed
+    payloads unreachable in normal recovery; this is the defense for the
+    drop-corrupt salvage path and for writer bugs."""
+    _need(len(payload) >= 1, "empty payload")
     tag = payload[:1]
     if tag == b"P":
+        _need(len(payload) >= 5, "P header short")
         (klen,) = struct.unpack_from("<I", payload, 1)
+        _need(len(payload) >= 5 + klen, "P key truncated")
         key = payload[5 : 5 + klen]
         kv.put(key, payload[5 + klen :])
     elif tag == b"D":
+        _need(len(payload) >= 5, "D header short")
         (klen,) = struct.unpack_from("<I", payload, 1)
+        _need(len(payload) == 5 + klen, "D length mismatch")
         kv.delete(payload[5 : 5 + klen])
     elif tag in (b"X", b"K"):
+        _need(len(payload) >= 5, "range header short")
         (slen,) = struct.unpack_from("<I", payload, 1)
+        _need(len(payload) >= 9 + slen, "range start truncated")
         start = payload[5 : 5 + slen]
         (elen,) = struct.unpack_from("<I", payload, 5 + slen)
+        _need(len(payload) == 9 + slen + elen, "range length mismatch")
         end = payload[9 + slen : 9 + slen + elen]
         if tag == b"X":
             kv.delete_range(start, end)
         else:
             mvcc.kill_runs_range(start, end)
     elif tag == b"R":
+        _need(len(payload) >= 21, "R header short")
         w, n, commit_ts = struct.unpack_from("<IQQ", payload, 1)
-        pos = 1 + 20
+        pos = 21
+        _need(len(payload) >= pos + n * w + 16 * n + 8, "R arrays truncated")
         key_mat = np.frombuffer(payload, np.uint8, n * w, pos).reshape(int(n), w).copy()
         pos += n * w
         starts = np.frombuffer(payload, np.int64, n, pos).copy()
@@ -216,7 +425,17 @@ def apply_record(payload: bytes, kv, mvcc) -> None:
         lens = np.frombuffer(payload, np.int64, n, pos).copy()
         pos += 8 * n
         (vlen,) = struct.unpack_from("<Q", payload, pos)
+        _need(len(payload) == pos + 8 + vlen, "R value buffer length mismatch")
         vbuf = payload[pos + 8 : pos + 8 + vlen]
+        if n:
+            _need(
+                bool(
+                    (starts >= 0).all() and (lens >= 0).all()
+                    and (starts <= vlen).all() and (lens <= vlen).all()
+                    and (starts + lens <= vlen).all()
+                ),
+                "R value slices out of range",
+            )
         mvcc.ingest_run(key_mat, vbuf, starts, lens, commit_ts, presorted=True)
     else:
         raise ValueError(f"unknown WAL record tag {tag!r}")

@@ -7,6 +7,10 @@ Actions an armed site can carry:
   * an Exception instance or class — raised at the site
   * a callable — invoked at the site
   * ("sleep", seconds) — blocks the site
+  * ("crash", [exit_code]) — hard-kills the process via os._exit (no
+    atexit, no flush — the closest in-process stand-in for SIGKILL;
+    the crashpoint harness tools/crashpoint.py arms this at named
+    sites inside a CHILD process and the parent checks recovery)
   * ("prob", p, action) — fires `action` with probability p per hit
     (the chaos-harness marker: 30%-probability device faults, random
     region churn)
@@ -17,6 +21,7 @@ Actions an armed site can carry:
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -86,6 +91,8 @@ class Failpoints:
         if isinstance(action, tuple) and action and action[0] == "sleep":
             time.sleep(action[1])
             return
+        if isinstance(action, tuple) and action and action[0] == "crash":
+            os._exit(action[1] if len(action) > 1 else 137)
         if callable(action):
             action()
 

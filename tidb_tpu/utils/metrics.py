@@ -433,3 +433,23 @@ TPU_LANE_REROUTES = REGISTRY.counter(
     "tidb_tpu_lane_reroutes_total",
     "placements diverted off the resident lane (reason: breaker | spill)",
 )
+
+# --- durability fault domain (PR 10: storage/wal.py WAL IO discipline) -----
+# a failed append/fsync poisons the WAL and flips the store read-only
+# (fsyncgate: one failed fsync means the page cache can no longer be
+# trusted, so no later commit may ever ack); recovery counts the bytes it
+# deliberately gave up (torn tail truncation / drop-corrupt salvage gaps)
+WAL_IO_ERRORS = REGISTRY.counter(
+    "tidb_wal_io_errors_total",
+    "WAL IO failures by op (append | sync); any hit poisons the log",
+)
+WAL_DEGRADED = REGISTRY.gauge(
+    "tidb_wal_degraded",
+    "a store in this process hit a WAL IO failure and degraded read-only "
+    "(0 ok, 1 degraded; sticky — a degraded store never heals in-place, "
+    "recovery means reopening on healthy media in a fresh process)",
+)
+WAL_RECOVERY_DROPPED = REGISTRY.counter(
+    "tidb_wal_recovery_dropped_bytes_total",
+    "log bytes recovery discarded, by kind (torn tail | corrupt frames under drop-corrupt)",
+)

@@ -43,6 +43,7 @@ _SCOPED_SYSVAR_PREFIXES = ("tidb_tpu_",)
 _SCOPED_SYSVARS = {
     "tidb_enable_trace", "tidb_enable_timeline", "tidb_trace_ring_capacity",
     "tidb_timeline_ring_capacity", "tidb_backoff_budget_ms",
+    "tidb_wal_recovery_mode",
 }
 
 _UPDATE_METHODS = {"inc", "observe", "set", "add"}

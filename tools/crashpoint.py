@@ -1,0 +1,454 @@
+#!/usr/bin/env python
+"""Real-process crash harness for the durability fault domain (PR 10).
+
+Every prior durability test simulated crashes by chopping bytes off log
+files in-process. This harness kills a LIVE child process mid-commit
+under concurrent sessions and then proves the recovery contract on the
+survivor directory:
+
+  parent                                  child (fresh data_dir)
+  ------                                  ----------------------
+  spawn ----------------------------->    setup schema, print READY
+  read acks   <--- "ACK dml 17" ------    4 workload threads: autocommit
+                                          DML, explicit multi-row txns,
+                                          ADD/DROP INDEX reorg, periodic
+                                          checkpoint(); each ack printed
+                                          (flushed) only AFTER commit()
+                                          returned — the ack contract
+  SIGKILL (random delay), or the child
+  self-crashes via a ("crash",) failpoint
+  armed at a named crashpoint
+  reopen Storage(data_dir) and check invariants:
+    * every acked commit fully visible (atomicity: all rows or none)
+    * no partially-visible txn group (acked or not)
+    * plain reads resolve orphan prewrite locks (first-read resolution)
+    * interrupted DDL resumes to public or stays invisible; ADMIN CHECK
+    * catalog/meta consistent (schema loads, jobs drainable)
+    * CDC sink never ahead of durable state (every event's commit_ts
+      exists in MVCC)
+
+Named crashpoints (failpoint action ("crash",) → os._exit inside the
+child; the parent asserts exit code 137, proving the site actually fired):
+
+    wal/after-append-before-sync      record buffered, nothing fsynced
+    txn/between-prewrite-and-commit   locks durable, commit record not
+    checkpoint/after-snap-rename      snapshot renamed, log not rotated
+    checkpoint/before-old-unlink      both epochs' logs present
+    ddl/mid-reorg                     backfill checkpoint durable, index
+                                      still write_reorg
+
+Usage:
+    python tools/crashpoint.py --matrix [--seed S]       # each named site once
+    python tools/crashpoint.py --rounds N [--seed S]     # N random-kill rounds
+    python tools/crashpoint.py --crashpoint NAME         # one named round
+Exit 0 = zero invariant violations. The seed is always printed for replay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+CRASH_EXIT = 137  # the ("crash",) failpoint default exit code
+
+CRASHPOINTS = {
+    # site → nth-hit trigger (armed AFTER setup so the schema exists)
+    "wal/after-append-before-sync": 60,
+    "txn/between-prewrite-and-commit": 4,
+    "checkpoint/after-snap-rename": 2,
+    "checkpoint/before-old-unlink": 2,
+    "ddl/mid-reorg": 3,
+}
+
+TXN_GROUP_ROWS = 3  # rows per explicit txn (the atomicity unit)
+IDX_ROWS = 400  # t_idx population (reorg batch 32 → ~13 backfill batches)
+
+
+# ===================================================================== child
+
+def _child_main(args) -> None:
+    """Run the concurrent workload against a durable store until killed
+    (or until a named crashpoint fires). Never exits voluntarily before
+    --max-seconds; every ack line is printed only after commit returned."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from tidb_tpu.cdc import FileSink
+    from tidb_tpu.errors import TiDBError
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+    from tidb_tpu.utils.failpoint import FP
+
+    out_lock = threading.Lock()
+
+    def say(line: str) -> None:
+        with out_lock:
+            print(line, flush=True)
+
+    store = Storage(data_dir=args.data_dir)
+    store.cdc.subscribe(FileSink(args.cdc))
+
+    boot = Session(store)
+    boot.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+    boot.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+    boot.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+    for lo in range(0, IDX_ROWS, 100):
+        vals = ", ".join(f"({i}, {i % 97})" for i in range(lo, min(lo + 100, IDX_ROWS)))
+        boot.execute(f"INSERT INTO t_idx VALUES {vals}")
+    store.wal_sync()
+    say("READY")
+
+    # arm AFTER setup: the nth counters must count workload hits only
+    if args.crashpoint:
+        FP.enable(args.crashpoint, ("nth", CRASHPOINTS[args.crashpoint], ("crash",)))
+
+    stop = time.time() + args.max_seconds
+
+    def dml_loop() -> None:
+        s = Session(store)
+        i = 0
+        while time.time() < stop:
+            try:
+                s.execute(f"INSERT INTO t_dml VALUES ({i}, {i * 3})")
+                say(f"ACK dml {i}")
+                i += 1
+            except TiDBError as e:
+                say(f"ERR dml {type(e).__name__}")
+                time.sleep(0.01)
+
+    def txn_loop() -> None:
+        s = Session(store)
+        g = 0
+        while time.time() < stop:
+            try:
+                s.execute("BEGIN")
+                for j in range(TXN_GROUP_ROWS):
+                    s.execute(
+                        f"INSERT INTO t_txn VALUES ({g * 10 + j}, {g}, {TXN_GROUP_ROWS})"
+                    )
+                s.execute("COMMIT")
+                say(f"ACK txn {g}")
+                g += 1
+            except TiDBError as e:
+                say(f"ERR txn {type(e).__name__}")
+                try:
+                    s.execute("ROLLBACK")
+                except TiDBError:
+                    pass
+                g += 1  # never reuse ids of a maybe-half-prewritten group
+                time.sleep(0.01)
+
+    def ddl_loop() -> None:
+        s = Session(store)
+        s.execute("SET tidb_ddl_reorg_batch_size = 32")
+        n = 0
+        while time.time() < stop:
+            try:
+                s.execute("ALTER TABLE t_idx ADD INDEX k_v (v)")
+                say(f"ACK ddl add {n}")
+                s.execute("ALTER TABLE t_idx DROP INDEX k_v")
+                say(f"ACK ddl drop {n}")
+                n += 1
+            except TiDBError as e:
+                say(f"ERR ddl {type(e).__name__}")
+                time.sleep(0.05)
+
+    def ckpt_loop() -> None:
+        n = 0
+        while time.time() < stop:
+            time.sleep(0.1)
+            try:
+                store.checkpoint()
+                say(f"ACK ckpt {n}")
+                n += 1
+            except TiDBError as e:
+                say(f"ERR ckpt {type(e).__name__}")
+
+    threads = [
+        threading.Thread(target=f, daemon=True, name=f.__name__)
+        for f in (dml_loop, txn_loop, ddl_loop, ckpt_loop)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # survived the whole window without being killed (random-mode parent
+    # should have struck long before): report and exit clean
+    say("TIMEOUT")
+
+
+# ==================================================================== parent
+
+class Violation(Exception):
+    pass
+
+
+def _collect_acks(lines: list[str]) -> dict:
+    acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0}
+    for ln in lines:
+        parts = ln.split()
+        if not parts or parts[0] != "ACK":
+            continue
+        if parts[1] == "dml":
+            acks["dml"].add(int(parts[2]))
+        elif parts[1] == "txn":
+            acks["txn"].add(int(parts[2]))
+        elif parts[1] == "ddl":
+            acks["ddl"].append((parts[2], int(parts[3])))
+        elif parts[1] == "ckpt":
+            acks["ckpt"] += 1
+    return acks
+
+
+def _verify(data_dir: str, cdc_path: str, acks: dict) -> None:
+    """Reopen the survivor directory and prove every invariant; raises
+    Violation with the first broken one."""
+    from tidb_tpu.errors import TiDBError, WalCorruptionError
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+
+    try:
+        # default recovery mode ON PURPOSE: a crash may only ever tear the
+        # tail — if recovery classifies the damage as mid-log corruption,
+        # the WAL writer broke its append-ordering contract
+        store = Storage(data_dir=data_dir)
+    except WalCorruptionError as e:
+        raise Violation(f"crash produced non-torn-tail damage: {e}") from e
+    s = Session(store)
+
+    # --- orphan locks: these first plain reads must resolve every lock the
+    # dead process left behind (primary-committed → roll forward; primary
+    # unprewritten/expired → roll back) within the read resolve deadline
+    try:
+        dml_rows = {int(r[0]): int(r[1]) for r in s.must_query("SELECT id, v FROM t_dml")}
+        txn_rows = s.must_query("SELECT id, g, total FROM t_txn")
+    except TiDBError as e:
+        raise Violation(f"post-restart read failed (unresolved orphan locks?): {e}") from e
+
+    # --- acked DML durable + correct
+    for i in sorted(acks["dml"]):
+        if dml_rows.get(i) != i * 3:
+            raise Violation(f"acked DML row {i} lost or wrong after recovery")
+
+    # --- txn atomicity: every group fully present or fully absent
+    by_group: dict[int, int] = {}
+    for _id, g, total in txn_rows:
+        g = int(g)
+        if int(total) != TXN_GROUP_ROWS:
+            raise Violation(f"txn group {g} row carries total={total}")
+        by_group[g] = by_group.get(g, 0) + 1
+    for g, cnt in sorted(by_group.items()):
+        if cnt != TXN_GROUP_ROWS:
+            raise Violation(
+                f"txn group {g} is PARTIAL after recovery ({cnt}/{TXN_GROUP_ROWS} rows)"
+            )
+    for g in sorted(acks["txn"]):
+        if by_group.get(g) != TXN_GROUP_ROWS:
+            raise Violation(f"acked txn group {g} not fully visible after recovery")
+
+    # --- DDL: drain the interrupted job queue; the reorg must resume from
+    # its durable checkpoint to public (or roll back cleanly) — then the
+    # row↔index consistency check must pass for whatever ended up public
+    try:
+        store.ddl.run_pending()
+    except TiDBError as e:
+        raise Violation(f"DDL queue did not drain after restart: {e}") from e
+    try:
+        s.execute("ADMIN CHECK TABLE t_idx")
+        s.execute("ADMIN CHECK TABLE t_dml")
+        s.execute("ADMIN CHECK TABLE t_txn")
+    except TiDBError as e:
+        raise Violation(f"ADMIN CHECK failed after recovery: {e}") from e
+
+    # --- CDC never ahead of durable state: every complete sink event must
+    # name a commit_ts that MVCC actually holds for that key (publish
+    # happens only after wal_sync, so a crash can lose sink lines — never
+    # invent them)
+    if os.path.exists(cdc_path):
+        with open(cdc_path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    ev = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue  # torn trailing line: the sink died mid-write
+                if ev.get("table_id") is None:
+                    # index/meta keys: DROP INDEX physically destroys their
+                    # MVCC versions (unsafe_destroy_range), so only record
+                    # keys give a stable durable-state witness
+                    continue
+                key = bytes.fromhex(ev["key"])
+                cts = int(ev["commit_ts"])
+                versions = {c for _s, c, _l in store.mvcc_versions(key)}
+                if cts not in versions:
+                    raise Violation(
+                        f"CDC sink ahead of durable state: event commit_ts={cts} "
+                        f"for key={ev['key'][:24]}… has no durable MVCC version"
+                    )
+
+    # --- the recovered store must still be writable (no sticky degrade)
+    t = store.begin()
+    t.put(b"zz-harness-probe", b"1")
+    t.commit()
+
+    store.wal.close()
+
+
+def run_round(
+    crashpoint: str | None,
+    seed: int,
+    keep: bool = False,
+    max_seconds: float = 45.0,
+    kill_after: float | None = None,
+) -> tuple[bool, str]:
+    """One spawn→kill→verify cycle. → (ok, detail)."""
+    rng = random.Random(seed)
+    workdir = tempfile.mkdtemp(prefix="crashpoint-")
+    data_dir = os.path.join(workdir, "data")
+    cdc_path = os.path.join(workdir, "cdc.jsonl")
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--data-dir", data_dir, "--cdc", cdc_path,
+        "--seed", str(seed), "--max-seconds", str(max_seconds),
+    ]
+    if crashpoint:
+        cmd += ["--crashpoint", crashpoint]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=REPO, env=env,
+    )
+    lines: list[str] = []
+    ready = False
+    killed = False
+    deadline = time.time() + max_seconds + 60  # child startup allowance
+    # failsafe: a child that deadlocks without printing would park the
+    # stdout read loops forever — SIGKILL it at the deadline regardless
+    failsafe = threading.Timer(
+        max_seconds + 60, lambda: proc.poll() is None and proc.kill()
+    )
+    failsafe.start()
+    try:
+        if crashpoint is None:
+            # random-kill mode: strike a seeded delay after READY
+            delay = kill_after if kill_after is not None else rng.uniform(0.4, 2.2)
+            for ln in proc.stdout:
+                lines.append(ln.rstrip("\n"))
+                if ln.startswith("READY"):
+                    ready = True
+                    break
+                if time.time() > deadline:
+                    break
+            if not ready:
+                proc.kill()
+                return False, "child never reached READY"
+            killer = threading.Timer(delay, lambda: os.kill(proc.pid, signal.SIGKILL))
+            killer.start()
+            for ln in proc.stdout:  # drain until EOF (the kill closes it)
+                lines.append(ln.rstrip("\n"))
+            killer.cancel()
+            proc.wait(timeout=30)
+            killed = proc.returncode == -signal.SIGKILL
+            if not killed and any(l.startswith("TIMEOUT") for l in lines):
+                return False, f"random kill (delay {delay:.2f}s) never landed"
+        else:
+            # named mode: the child self-crashes at the armed site
+            for ln in proc.stdout:
+                lines.append(ln.rstrip("\n"))
+                if ln.startswith("READY"):
+                    ready = True
+                if time.time() > deadline:
+                    proc.kill()
+                    return False, f"crashpoint {crashpoint} never fired (timeout)"
+            proc.wait(timeout=30)
+            if proc.returncode != CRASH_EXIT:
+                return False, (
+                    f"crashpoint {crashpoint} did not fire "
+                    f"(exit {proc.returncode}, ready={ready})"
+                )
+    finally:
+        failsafe.cancel()
+        if proc.poll() is None:
+            proc.kill()
+
+    acks = _collect_acks(lines)
+    try:
+        _verify(data_dir, cdc_path, acks)
+    except Violation as e:
+        # keep the survivor dir: it IS the evidence
+        return False, f"INVARIANT VIOLATION: {e} [survivor dir kept: {workdir}]"
+    except Exception as e:  # noqa: BLE001 — checker crash = failed round, not a dead matrix
+        return False, f"checker error: {type(e).__name__}: {e} [survivor dir kept: {workdir}]"
+    if not keep:
+        shutil.rmtree(workdir, ignore_errors=True)
+    detail = (
+        f"acks: dml={len(acks['dml'])} txn={len(acks['txn'])} "
+        f"ddl={len(acks['ddl'])} ckpt={acks['ckpt']}"
+    )
+    return True, detail
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", action="store_true", help="(internal) workload child")
+    ap.add_argument("--data-dir")
+    ap.add_argument("--cdc")
+    ap.add_argument("--crashpoint", choices=sorted(CRASHPOINTS), default=None)
+    ap.add_argument("--matrix", action="store_true",
+                    help="run every named crashpoint once")
+    ap.add_argument("--rounds", type=int, default=0,
+                    help="seeded random-SIGKILL rounds")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--keep", action="store_true", help="keep survivor dirs")
+    ap.add_argument("--max-seconds", type=float, default=45.0)
+    args = ap.parse_args()
+
+    if args.child:
+        _child_main(args)
+        return 0
+
+    seed = args.seed if args.seed is not None else random.SystemRandom().randrange(1 << 30)
+    print(f"crashpoint harness: seed={seed} (replay with --seed {seed})", flush=True)
+
+    plan: list[tuple[str | None, int]] = []
+    if args.matrix:
+        plan += [(cp, seed + i) for i, cp in enumerate(sorted(CRASHPOINTS))]
+    if args.crashpoint:
+        plan.append((args.crashpoint, seed))
+    for i in range(args.rounds):
+        plan.append((None, seed + 1000 + i))
+    if not plan:
+        ap.error("nothing to do: pass --matrix, --crashpoint, and/or --rounds N")
+
+    failures = 0
+    t0 = time.time()
+    for i, (cp, round_seed) in enumerate(plan):
+        label = cp or f"random-kill[{round_seed}]"
+        ok, detail = run_round(cp, round_seed, keep=args.keep,
+                               max_seconds=args.max_seconds)
+        status = "ok" if ok else "FAIL"
+        print(f"  [{i + 1}/{len(plan)}] {label}: {status} — {detail}", flush=True)
+        if not ok:
+            failures += 1
+    dt = time.time() - t0
+    verdict = "green" if failures == 0 else f"{failures} FAILURE(S)"
+    print(f"crash matrix: {verdict} ({len(plan)} round(s), {dt:.0f}s, seed={seed})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,10 @@ while [ $# -gt 0 ]; do
   esac
 done
 python -m tools.analyze $ANALYZE_ARGS || exit 1
+# real-process crash matrix (PR 10): each named crashpoint once against a
+# live child process, deterministic seed — the full seeded random-kill
+# soak (≥30 rounds) lives under `pytest -m slow` / crashpoint.py --rounds
+env JAX_PLATFORMS=cpu python tools/crashpoint.py --matrix --seed 7 || exit 1
 if [ "$RUN_BENCH" = "1" ]; then
   for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
