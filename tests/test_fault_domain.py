@@ -235,9 +235,13 @@ class TestMPPChaos:
         sql = "select count(*), sum(s_v) from skew join cust on s_cust = c_id"
         host = _host(mpp, sql)
         mpp.vars["tidb_broadcast_join_threshold_count"] = "0"  # force HASH
+        # a fused LUT level never exchanges — pin the pre-fusion path so
+        # the bucket drop-guard under test actually fires
+        mpp.vars["tidb_tpu_mpp_fused"] = "OFF"
         m0 = M.TPU_FALLBACK.value(path="mpp", reason="capacity_overflow")
         assert mpp.must_query(sql) == host
         del mpp.vars["tidb_broadcast_join_threshold_count"]
+        del mpp.vars["tidb_tpu_mpp_fused"]
         assert M.TPU_FALLBACK.value(path="mpp", reason="capacity_overflow") == m0 + 1
         assert "overflow" in mpp.cop.mpp.last_fallback_reason
 
@@ -279,8 +283,10 @@ class TestEnforceMPPDegradation:
         mpp.execute("insert into skew2 values "
                     + ",".join(f"({i},1)" for i in range(4096)))
         mpp.vars["tidb_broadcast_join_threshold_count"] = "0"
+        mpp.vars["tidb_tpu_mpp_fused"] = "OFF"  # LUT levels never exchange
         w = self._warn(mpp, "select count(*) from skew2 join cust on s_cust = c_id")
         del mpp.vars["tidb_broadcast_join_threshold_count"]
+        del mpp.vars["tidb_tpu_mpp_fused"]
         assert "exchange bucket overflow" in w
 
     def test_reason_resets_per_dispatch(self, mpp):

@@ -97,6 +97,9 @@ class TestBroadcastJoin:
 class TestShuffleJoin:
     def test_hash_exchange(self, sess):
         sess.vars["tidb_broadcast_join_threshold_count"] = "0"  # force all_to_all
+        # fused LUT levels never exchange; pin OFF so this keeps
+        # exercising the in-program all_to_all path
+        sess.vars["tidb_tpu_mpp_fused"] = "OFF"
         try:
             mpp, host = _both(
                 sess,
@@ -110,6 +113,7 @@ class TestShuffleJoin:
             assert _sorted(mpp) == _sorted(host)
         finally:
             sess.vars["tidb_broadcast_join_threshold_count"] = "10240"
+            sess.vars["tidb_tpu_mpp_fused"] = "ON"
 
     def test_left_join_hash(self, sess):
         sess.vars["tidb_broadcast_join_threshold_count"] = "0"
@@ -177,6 +181,9 @@ class TestFallbacks:
         sess.execute("create table skb (b_k bigint, b_x bigint)")
         sess.execute("insert into skb values (8, 1),(16, 2)")
         sess.vars["tidb_broadcast_join_threshold_count"] = "0"  # force HASH
+        # pin the pre-fusion exchange path: a fused LUT level never
+        # exchanges, so the bucket drop-guard under test would not fire
+        sess.vars["tidb_tpu_mpp_fused"] = "OFF"
         try:
             fb0 = sess.cop.mpp.fallbacks
             mpp, host = _both(
@@ -188,6 +195,7 @@ class TestFallbacks:
             assert "overflow" in sess.cop.mpp.last_fallback_reason
         finally:
             sess.vars["tidb_broadcast_join_threshold_count"] = "10240"
+            sess.vars["tidb_tpu_mpp_fused"] = "ON"
 
     def test_txn_dirty_falls_back(self, sess):
         sess.execute("begin")
@@ -274,6 +282,10 @@ class TestSortedTopKAgg:
         MPPEngine._finalize_topk = spy
         try:
             s.vars["tidb_allow_mpp"] = "ON"
+            # pin the pre-fusion path: fused chains take the rowpos agg
+            # mode (TestFusedChains) instead of the sorted lexsort mode
+            # this test covers
+            s.vars["tidb_tpu_mpp_fused"] = "OFF"
             mpp = s.must_query(tpch.Q3)
             assert calls["topk"] == 1, "sorted top-k mode did not run"
             assert s.cop.mpp.fallbacks == 0, s.cop.mpp.last_fallback_reason

@@ -397,6 +397,121 @@ def _decode_one(table: TableInfo, cols, i: int, val: bytes, handle: int) -> None
         cols[off].set_datum(i, d)
 
 
+class BuildSideCache:
+    """Device-resident build-side join structures, shared store-wide
+    (ISSUE 11; "Fine-Tuning Data Structures for Analytical Query
+    Processing", arXiv:2112.13099 — specialize the join structure per
+    build-side shape and keep it resident).
+
+    TPC-H dimension tables rarely change between statements, so the MPP
+    engine's specialized build sides (today: the direct-address LUT
+    mapping packed join key → build row position, probed as a pure
+    device gather) stay uploaded across statements instead of being
+    re-sorted inside every fused program.
+
+    Keying: `(table_id, span, schema_version, codec_sig)` where
+    `codec_sig` carries the structure tag, the table DATA version and
+    every layout parameter (key offsets, packing lo/strides, domain,
+    lane codec form). A get() under a NEW schema/data version purges the
+    stale entries of the same (table, span, tag) — a stale build side
+    must never serve — and counts them as invalidations. Entries LRU
+    under a byte budget, and `evict_all()` joins the server memory
+    arbiter's soft-limit degrade sweep exactly like the tile cache (the
+    arbiter snapshots its cache list OUTSIDE the registry lock, so this
+    lock nests under nothing of lower rank)."""
+
+    CAP_BYTES = 1 << 30
+
+    def __init__(self):
+        from collections import OrderedDict
+
+        self._od: "OrderedDict[tuple, tuple]" = OrderedDict()  # key → (value, nbytes)
+        self._lock = RLock()
+        self.nbytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evicts = 0
+        self.invalidates = 0
+
+    @staticmethod
+    def _nbytes(value) -> int:
+        n = 0
+        for x in value if isinstance(value, (tuple, list)) else (value,):
+            n += int(getattr(x, "nbytes", 64))
+        return n
+
+    def get(self, table_id: int, span: tuple, schema_ver: int, sig: tuple, build):
+        """Cached device structure for the key, building (and uploading)
+        via `build()` on miss. `sig[0]` is the structure tag: stale
+        same-(table, span, tag) entries under any OTHER (schema_ver,
+        sig) are purged here — version bumps invalidate, they don't
+        linger until LRU pressure."""
+        from ..utils import metrics as M
+
+        key = (table_id, span, schema_ver, sig)
+        with self._lock:
+            ent = self._od.get(key)
+            if ent is not None:
+                self._od.move_to_end(key)
+                self.hits += 1
+                M.TPU_BUILD_CACHE.inc(outcome="hit")
+                return ent[0]
+            stale = [k for k in self._od
+                     if k[0] == table_id and k[1] == span and k[3][0] == sig[0]
+                     and (k[2] != schema_ver or k[3] != sig)]
+            for k in stale:
+                self.nbytes -= self._od.pop(k)[1]
+                self.invalidates += 1
+                M.TPU_BUILD_CACHE.inc(outcome="invalidate")
+            self.misses += 1
+            M.TPU_BUILD_CACHE.inc(outcome="miss")
+        # build + upload OUTSIDE the lock: a slow h2d must not stall
+        # every other statement's probe (a racing duplicate build is
+        # benign — last writer wins, same content)
+        value = build()
+        nb = self._nbytes(value)
+        with self._lock:
+            old = self._od.pop(key, None)
+            if old is not None:
+                # a concurrent statement built the same key while we
+                # were outside the lock — return the bytes its entry
+                # held, or the ledger drifts up on every such race
+                self.nbytes -= old[1]
+            self._od[key] = (value, nb)
+            self.nbytes += nb
+            while self.nbytes > self.CAP_BYTES and len(self._od) > 1:
+                _, (_, old_nb) = self._od.popitem(last=False)
+                self.nbytes -= old_nb
+                self.evicts += 1
+                M.TPU_BUILD_CACHE.inc(outcome="evict")
+        return value
+
+    def invalidate_table(self, table_id: int) -> None:
+        from ..utils import metrics as M
+
+        with self._lock:
+            for k in [k for k in self._od if k[0] == table_id]:
+                self.nbytes -= self._od.pop(k)[1]
+                self.invalidates += 1
+                M.TPU_BUILD_CACHE.inc(outcome="invalidate")
+
+    def evict_all(self) -> float:
+        """Server soft-memory-limit degrade action (utils/memory
+        ServerMemTracker sweep): drop every resident structure. Returns
+        the device bytes released for collection."""
+        from ..utils import metrics as M
+
+        with self._lock:
+            freed = float(self.nbytes)
+            n = len(self._od)
+            self._od.clear()
+            self.nbytes = 0
+            self.evicts += n
+            for _ in range(n):
+                M.TPU_BUILD_CACHE.inc(outcome="evict")
+        return freed
+
+
 class TileCache:
     def __init__(self, storage):
         self.storage = storage
@@ -437,6 +552,13 @@ class TileCache:
         with self._lock:
             for key in [k for k in self._cache if k[0] == table_id]:
                 del self._cache[key]
+        # build sides are DERIVED from these lanes: whoever invalidates
+        # the tiles (DDL, TRUNCATE, RESTORE) invalidates the resident
+        # join structures too — without instantiating the cache just to
+        # empty it
+        bc = getattr(self.storage, "_build_cache", None)
+        if bc is not None:
+            bc.invalidate_table(table_id)
 
     def evict_all(self) -> float:
         """Server soft-memory-limit action (utils/memory ServerMemTracker):

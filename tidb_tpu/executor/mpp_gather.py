@@ -213,9 +213,28 @@ class MPPGatherExec(Executor):
                 mesh = engine._mesh if getattr(engine, "_mesh", None) is not None else make_mesh()
                 engine._mesh = mesh
                 bo = Backoffer.for_ctx(sctx, stats=st)
+                # fused-chain flag: the store-wide GLOBAL overrides the
+                # session copy so `SET GLOBAL tidb_tpu_mpp_fused=OFF` is a
+                # live incident fallback for EVERY session, not just ones
+                # opened after it (the engine is per-client, so there is
+                # no store-wide engine attribute to poke à la PR 7)
+                gv = getattr(client.storage, "global_vars", None) or {}
+                fused = gv.get(
+                    "tidb_tpu_mpp_fused",
+                    self.ctx.vars.get("tidb_tpu_mpp_fused", "ON"),
+                ) == "ON"
                 res, err = guarded_device_call(
+                    # the OFF path (the live incident fallback) must not
+                    # pay the per-dispatch meta read or lazily register
+                    # the build cache with the memory arbiter — neither
+                    # is consulted without fusion
                     lambda: engine.execute(self.mplan, scan_datas, mesh,
-                                           self.ctx.vars, gate=gate),
+                                           self.ctx.vars, gate=gate,
+                                           fused=fused,
+                                           build_cache=(client.storage.build_cache
+                                                        if fused else None),
+                                           schema_ver=(self._schema_version(client)
+                                                       if fused else -1)),
                     bo,
                     breakers=[l.breaker for l in admitted],
                     forced=False,  # enforce_mpp degrades with a warning,
@@ -259,6 +278,21 @@ class MPPGatherExec(Executor):
             return self._host_finish_agg(chunk)
         return chunk
 
+    @staticmethod
+    def _schema_version(client) -> int:
+        """Current catalog schema version — the build-side cache key
+        component that invalidates resident join structures on ANY DDL
+        (ADD/DROP INDEX, ALTER TABLE bump it; a stale structure must
+        never serve). One meta read per MPP dispatch, trivial next to
+        the program itself."""
+        from ..catalog.meta import Meta
+
+        txn = client.storage.begin()
+        try:
+            return Meta(txn).schema_version()
+        finally:
+            txn.rollback()
+
     def _build_scan_datas(self, client, engine, gate) -> list:
         """Host-side lane sets per scan fragment, through the engine's
         (table, version)-keyed host-lane cache. The concatenation is
@@ -290,7 +324,9 @@ class MPPGatherExec(Executor):
                 off = pc.orig_offset
                 orig_offs.append(off)
                 ck = (table.id, ver, off)
-                ent = engine._host_lane_cache.get(ck) if cacheable else None
+                # _host_lane_get, not a raw dict read: the hit must LRU-
+                # touch or the byte-budget sweep evicts by first insertion
+                ent = engine._host_lane_get(ck) if cacheable else None
                 if ent is None:
                     # whole-table lane concatenation is O(table bytes) per
                     # column: do it once per (table, version), not per

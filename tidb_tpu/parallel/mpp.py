@@ -52,7 +52,7 @@ except ImportError:  # pre-0.4.38 JAX keeps it in the experimental namespace
 from ..chunk.chunk import Chunk, Column, col_numpy_dtype, VARLEN
 from ..expr.expression import Column as ExprCol, Constant, Expression
 from ..mysqltypes.datum import Datum
-from ..planner.fragment import BROADCAST, HASH, JoinFrag, MPPPlan, ScanFrag
+from ..planner.fragment import BROADCAST, HASH, LOCAL, JoinFrag, MPPPlan, ScanFrag
 from ..utils import metrics as M
 from ..utils.memory import consume_current
 
@@ -121,6 +121,22 @@ class _Level:
         self.mult = 1  # 1 = unique build keys, 2 = compact dup path
         self.expected_out: int | None = None  # exact pre-filter join card
         self.key_i32 = False  # packed key domain fits int32 sort lanes
+        # fused-chain join structure (PR 11, arXiv:2112.13099): when the
+        # build keys are unique and their packed domain fits LUT_DOM_MAX,
+        # the level probes a device-resident direct-address LUT (packed
+        # key → build row position) instead of sorting the build side
+        # inside every program — the probe is a pure gather, the build
+        # lanes stay replicated, and the level needs no exchange at all.
+        # The LUT packs with BUILD-side-local lo/stride (never the
+        # probe/build hull): its content then depends on the build table
+        # alone, which is what lets the BuildSideCache keep it resident
+        # across statements that stream different probe tables at it.
+        self.use_lut = False
+        self.lut_lo: list[int] = []  # per-key build-local domain lo
+        self.lut_size: list[int] = []  # per-key build-local domain size
+        self.lut_stride: list[int] = []  # packing strides over lut_size
+        self.lut_dom = 0  # packed build-key domain == LUT length
+        self.fuse_reason = ""  # typed reason when the level declined fusion
 
 
 class MPPEngine:
@@ -150,6 +166,10 @@ class MPPEngine:
         self._stat_cache_nbytes = 0
         self._host_lane_cache: dict = {}
         self._host_lane_nbytes = 0
+        # fused-chain surface (PR 11): how the LAST dispatch fused
+        # (fused | partial | unfused | off) and why levels declined
+        self.last_fuse_outcome = ""
+        self.last_fuse_reasons: dict[int, str] = {}
 
     HOST_CACHE_BYTES = 4 << 30
     STAT_CACHE_BYTES = 1 << 30
@@ -202,6 +222,19 @@ class MPPEngine:
             k = next(iter(self._host_lane_cache))
             self._host_lane_nbytes -= self._entry_nbytes(self._host_lane_cache.pop(k))
 
+    def _host_lane_get(self, key):
+        """Host-lane cache hit WITH the LRU touch. Eviction order walks
+        the dict front; a hit that does not move its entry to the back
+        turns the budget sweep into FIFO-by-first-insertion — the hot
+        table a long-lived server joins every statement would be the
+        FIRST thing evicted once a cold scan pushes the cache over
+        HOST_CACHE_BYTES (PR 11 satellite fix; eviction order pinned by
+        test_host_lane_cache_lru_order)."""
+        ent = self._host_lane_cache.get(key)
+        if ent is not None:
+            self._host_lane_cache[key] = self._host_lane_cache.pop(key)
+        return ent
+
     def _stat_key(self, sd, tag):
         """Cache key for host analyses over a scan lane set; None when the
         scan has no (table, version) identity."""
@@ -214,6 +247,12 @@ class MPPEngine:
         if key is None:
             return compute()
         ent = self._stat_cache.get(key)
+        if ent is not None:
+            # LRU touch (PR 11 satellite): eviction pops the dict front,
+            # so a hit that stays in place turns the byte-budget sweep
+            # into FIFO-by-first-insertion — the analysis a long-lived
+            # server re-reads every statement would be first out
+            self._stat_cache[key] = self._stat_cache.pop(key)
         if ent is None:  # entries are 1-tuples so a None RESULT still caches
             ent = (compute(),)
             # evict stale versions of the same (table, tag)
@@ -240,6 +279,94 @@ class MPPEngine:
             return (int(d[v].min()), int(d[v].max()))
 
         return self._cached_stat(sd, ("minmax", off), compute)
+
+    def _lane_sorted(self, sd, off):
+        """True iff the raw lane is non-decreasing — the property that
+        makes equal group keys CONTIGUOUS in the stream (TPC-H lineitem
+        is clustered by l_orderkey; any PK-ordered fact table qualifies).
+        Cached per (table, version, offset) like every host analysis.
+        Checked on the raw lane: a prefiltered selection (np.nonzero)
+        preserves order, so the compacted stream inherits it."""
+        def compute():
+            d, _ = sd.lane(off)
+            # lane() dict-encodes object lanes upstream, so the object
+            # check is belt-and-braces — the guard that actually keeps
+            # string keys off the fused path is prepare's typed
+            # string_join_key decline. Dict CODES are sorted-vocab
+            # order, not collation order, so they must never pass here.
+            if d.dtype == object or d.dtype.kind == "f":
+                return False
+            return bool(np.all(d[1:] >= d[:-1]))
+
+        return self._cached_stat(sd, ("sorted", off), compute)
+
+    def _clustered_splits(self, sd, koff, sel_tag, n_dev, sel):
+        """Run-aligned shard boundaries for the clustered agg mode: the
+        ideal n/n_dev split points move LEFT to the start of the key run
+        they land in, so no group ever straddles two devices — each
+        device's run totals are complete and the program needs no
+        cross-device reduce at all. Returns (splits, L, rawmax): n_dev+1
+        cut positions into the (possibly prefiltered) stream, the padded
+        per-shard length, and the pre-padding longest shard (the skew
+        signal the dispatch guard demotes on)."""
+        def compute():
+            k = sd.lane(koff)[0]
+            if sel is not None:
+                k = k[sel]
+            n = len(k)
+            splits = [0]
+            for i in range(1, n_dev):
+                b = round(i * n / n_dev)
+                if n:
+                    b = int(np.searchsorted(k, k[min(b, n - 1)], side="left"))
+                splits.append(max(b, splits[-1]))
+            splits.append(n)
+            rawmax = max(splits[i + 1] - splits[i] for i in range(n_dev))
+            # pow2 row bucket (the tile-cache rule): predicates of similar
+            # selectivity land on the same padded shape and share one
+            # compiled program instead of recompiling per constant
+            L = max(8, 1 << (rawmax - 1).bit_length()) if rawmax else 8
+            return (tuple(splits), L, rawmax)
+
+        return self._cached_stat(sd, ("casplit", koff, sel_tag, n_dev), compute)
+
+    @staticmethod
+    def _shard_pad(a: np.ndarray, splits, L: int, fill=0) -> np.ndarray:
+        """Lay the stream out shard-by-shard at the run-aligned splits,
+        each shard padded independently to L (pad rows are masked off by
+        the validity lane; a pad run can only extend its shard's LAST
+        run with zero contribution, never split a real one)."""
+        n_dev = len(splits) - 1
+        out = np.full((n_dev, L), fill, a.dtype)
+        for i in range(n_dev):
+            seg = a[splits[i]:splits[i + 1]]
+            out[i, : len(seg)] = seg
+        return out.reshape(-1)
+
+    def _pushed_selection(self, sd, rc):
+        """Surviving row indices for a scan's pushed conditions (PR 11
+        fused chains): the predicate resolves ONCE per (table, version,
+        condition set) — cached like every other host analysis — and the
+        fused program then streams only the compacted rows. Downstream
+        join gathers and agg scatters shrink by the selectivity, and the
+        compiled program no longer bakes the predicate constants (one
+        program per shape, not per constant). Returns int64 positions."""
+        from ..copr.tpu_engine import TPUEngine
+
+        def compute():
+            mask = None
+            for c in rc:
+                used: set[int] = set()
+                c.collect_columns(used)
+                lanes = {off: sd.lane(off) for off in used}
+                d, v = TPUEngine._eval_device(c, lanes)
+                d = np.broadcast_to(np.asarray(d), (sd.n_rows,))
+                v = np.broadcast_to(np.asarray(v), (sd.n_rows,))
+                m = v & (d != 0)
+                mask = m if mask is None else (mask & m)
+            return np.nonzero(mask)[0].astype(np.int64) if mask is not None else None
+
+        return self._cached_stat(sd, ("pushsel", repr(rc)), compute)
 
     def _dev_put(self, key, build):
         """Device array for `key`, uploading via build() on miss. Stale
@@ -358,13 +485,28 @@ class MPPEngine:
             return  # something didn't map onto the rotated tree: keep
         mplan.root = node
 
+    # fused-chain limits: a LUT is 4 bytes per packed-key slot, so the
+    # domain cap bounds a structure at 64MB; the rowpos aggregation's
+    # segment space is one slot per build row
+    LUT_DOM_MAX = 1 << 24
+    ROWPOS_MAX = 1 << 22
+    # clustered-mode dispatch guards (checked per statement because both
+    # depend on the data/predicate, not the plan): _block_topk unrolls
+    # O(k^2) traced ops, and run-aligned shard splits pad every lane to
+    # the LONGEST run's shard — a skewed stream would ship n_dev x that
+    CLUSTERED_TOPN_MAX = 64
+    CLUSTERED_SKEW_MIN = 4096
+
     def prepare(self, mplan: MPPPlan, scans: list[ScanData], variables: dict,
-                gate=None):
+                gate=None, fused: bool = False):
         """Resolve all data-dependent static choices; None → fallback.
         `gate` (optional () -> None) is the scheduler's shared interrupt
         gate: the per-scan rewrites and per-level key analyses below walk
         O(table bytes) of host lanes, and a KILL/deadline/runaway verdict
-        must land between levels, not after the whole analysis."""
+        must land between levels, not after the whole analysis. `fused`
+        (the tidb_tpu_mpp_fused path) additionally specializes each
+        eligible join level to the device-resident LUT structure and the
+        aggregation to build-row-position segments."""
         from ..copr.tpu_engine import TPUEngine
 
         tick = gate if gate is not None else (lambda: None)
@@ -496,6 +638,43 @@ class MPPEngine:
                 self._decline("unpackable_build_keys", "unpackable build keys")
                 return False
             lvl.mult = mult
+            # fused-chain structure choice (arXiv:2112.13099): unique
+            # build keys over a bounded packed domain specialize to the
+            # direct-address LUT — declines carry a typed reason for the
+            # README fusion-rule table and the `partial`/`unfused`
+            # tidb_tpu_mpp_fused_total outcomes. The LUT packs with
+            # build-local lo/stride so its content (and cache identity)
+            # never depends on the probe table.
+            if fused:
+                if frag.kind != "inner":
+                    lvl.fuse_reason = "outer_join"
+                elif mult != 1:
+                    lvl.fuse_reason = "dup_build_keys"
+                else:
+                    blos, bsizes = [], []
+                    for bk in frag.build_keys:
+                        mm = self._lane_minmax(*scan_of_joined[bk])
+                        # floats were declined above; None = empty/all-
+                        # NULL lane, which matches nothing (LUT stays -1)
+                        if mm is None or mm == "float":
+                            blos.append(0)
+                            bsizes.append(1)
+                        else:
+                            blos.append(mm[0])
+                            bsizes.append(mm[1] - mm[0] + 1)
+                    bstrides = [1] * len(bsizes)
+                    bacc = 1
+                    for i in range(len(bsizes) - 1, -1, -1):
+                        bstrides[i] = bacc
+                        bacc *= bsizes[i]
+                    if bacc > self.LUT_DOM_MAX:
+                        lvl.fuse_reason = "lut_domain_overflow"
+                    else:
+                        lvl.use_lut = True
+                        lvl.lut_lo = blos
+                        lvl.lut_size = bsizes
+                        lvl.lut_stride = bstrides
+                        lvl.lut_dom = int(bacc)
 
             # exact pre-filter join cardinality (Σ over matched keys of
             # probe-count × build-count) — sizes the compact join's output
@@ -556,6 +735,13 @@ class MPPEngine:
                 if bscan.n_rows <= threshold and build_bytes <= size_threshold
                 else HASH
             )
+            if lvl.use_lut:
+                # a LUT level never exchanges: the structure (and the
+                # build lanes behind it) is replicated to every device,
+                # the sharded stream probes in place — the cached upload
+                # amortizes across statements where an all_to_all of the
+                # stream would be paid per dispatch
+                frag.exchange = LOCAL
             # left join with extra ON conditions filters *matches*, which
             # the mask model below can't express yet → host fallback
             if frag.post_conds:
@@ -584,7 +770,9 @@ class MPPEngine:
 
         agg_meta = None
         if mplan.agg is not None:
-            agg_meta = self._prepare_agg(mplan, scans, scan_of_joined, eng)
+            agg_meta = self._prepare_agg(mplan, scans, scan_of_joined,
+                                         levels=levels, by_frag=by_frag,
+                                         fused=fused)
             if agg_meta is None:
                 # the JOIN still rides the mesh; the aggregation finishes
                 # on host over the joined rows (group-key domains too wide
@@ -611,16 +799,179 @@ class MPPEngine:
             return None
         return acc, mask
 
-    def _prepare_agg(self, mplan: MPPPlan, scans, scan_of_joined, eng):
-        """Device aggregation metadata. Two modes (mirrors TPUEngine's
-        dense-vs-segment split):
+    def _lower_agg_args(self, agg, scan_of_joined):
+        """Device-evaluable aggregate argument list, or None when an arg
+        needs a string lane the program only holds as per-table dict
+        codes (min/max excepted: code order == collation order)."""
+        r_args = []
+        for a in agg.aggs:
+            ra = []
+            for x in a.args:
+                if isinstance(x, ExprCol):
+                    sd, off = scan_of_joined[x.idx]
+                    sd.lane(off)
+                    if off in sd.vocabs:
+                        if a.name in ("min", "max"):
+                            ra.append(x)  # code order == collation order
+                            continue
+                        return None
+                    ra.append(x)
+                    continue
+                used = set()
+                x.collect_columns(used)
+                if any(scan_of_joined[j][1] in scan_of_joined[j][0].vocabs for j in used):
+                    return None
+                ra.append(x)
+            r_args.append(ra)
+        return r_args
+
+    # arithmetic that cannot manufacture NULL from non-NULL inputs
+    # (division can: x/0 → NULL)
+    _NULL_PRESERVING = frozenset({"plus", "minus", "mul", "unaryminus"})
+
+    @classmethod
+    def _never_null(cls, x) -> bool:
+        """Statically provable: this expression never evaluates NULL.
+        Lets the rowpos agg reuse an aggregate's count lane as the
+        group-presence lane (one fewer B-wide scatter)."""
+        from ..expr.expression import ScalarFunc
+
+        if isinstance(x, Constant):
+            return not x.value.is_null
+        if isinstance(x, ExprCol):
+            return x.ret_type.not_null
+        if isinstance(x, ScalarFunc) and x.sig.name in cls._NULL_PRESERVING:
+            return all(cls._never_null(a) for a in x.args)
+        return False
+
+    def _prepare_agg_rowpos(self, mplan, scan_of_joined, levels, by_frag):
+        """Build-row-position aggregation (the fused-chain agg mode, PR
+        11): when every group-by column lives on ONE unique-keyed build
+        side whose join keys are a subset of the group keys, each build
+        ROW is exactly one group — the program segment-reduces by the
+        build rowid it already gathered for output, skipping the wide-key
+        lexsort entirely. Groups then live in a dense [0, n_build) space:
+        psum_scatter splits it across devices, each device top-ks its
+        slice, and the host merges n_dev*k candidates (group key VALUES
+        decode host-side from the build scan's original lanes, so dates/
+        strings/decimals all work). Requires a fused TopN like the sorted
+        mode — without it the full segment space would ship to host."""
+        agg = mplan.agg
+        if mplan.topn is None or not levels:
+            return None
+        agg_idx, _desc, _k = mplan.topn
+        if agg.aggs[agg_idx].name not in ("sum", "count"):
+            return None
+        gsd = None
+        goffs = set()
+        for g in agg.group_by:
+            if not isinstance(g, ExprCol):
+                return None
+            sd, _off = scan_of_joined[g.idx]
+            if gsd is not None and sd is not gsd:
+                return None  # group keys span scans: not one build side
+            gsd = sd
+            goffs.add(g.idx)
+        if gsd is None:
+            return None
+        lvl = next((l for l in levels if by_frag[id(l.frag.build)] is gsd), None)
+        if lvl is None or lvl.frag.kind != "inner" or lvl.mult != 1:
+            return None
+        if not set(lvl.frag.build_keys) <= goffs:
+            # grouping is COARSER than build rows (key not grouped on):
+            # rowpos segments would split one SQL group across rows
+            return None
+        if not (4096 <= gsd.n_rows <= self.ROWPOS_MAX):
+            # tiny builds stay on the proven dense/sorted paths (the
+            # per-device block must hold a top-k wider than the output
+            # lane count); huge builds would blow the segment space
+            return None
+        r_args = self._lower_agg_args(agg, scan_of_joined)
+        if r_args is None:
+            return None
+        # group-presence dedup: the first aggregate whose count lane
+        # provably equals segment_sum(mask) — count(*) or any agg over a
+        # never-NULL argument — doubles as the presence lane, saving one
+        # B-wide scatter (the scatter IS the rowpos agg's cost)
+        presence = None
+        lp = 0
+        for a, ra in zip(agg.aggs, r_args):
+            if a.name == "count":
+                if not ra or self._never_null(ra[0]):
+                    presence = lp
+                    break
+                lp += 1
+            else:
+                if ra and self._never_null(ra[0]):
+                    presence = lp + 1  # the count lane follows the value
+                    break
+                lp += 2
+        # clustered upgrade: when the stream is already SORTED by the
+        # (single) probe key of the group level, equal keys are contiguous
+        # runs — run totals come from one cumsum + two run-boundary
+        # gathers per lane (the seg_reduce trick of the sorted mode,
+        # minus its argsort), and run-aligned shard splits
+        # (_clustered_splits) keep every group whole on one device, so
+        # the program needs NO B-wide scatter and NO cross-device reduce.
+        # TPC-H lineitem is clustered by l_orderkey, so Q3-shape plans
+        # take this path; the decline reason feeds EXPLAIN + the README
+        # fusion-rule table.
+        mode, ck_idx, creason = "rowpos", None, None
+        if not (levels and all(l.use_lut for l in levels)):
+            creason = "chain_not_fully_fused"
+        elif not all(a.name in ("sum", "count", "avg") for a in agg.aggs):
+            creason = "agg_needs_minmax"  # min/max have no run-cumsum form
+        elif len(lvl.frag.probe_keys) != 1:
+            creason = "multi_column_stream_key"
+        else:
+            pk = lvl.frag.probe_keys[0]
+            psd, poff = scan_of_joined[pk]
+            if psd.frag is not self._stream_source(mplan.root):
+                creason = "group_key_not_on_stream"
+            elif not self._lane_sorted(psd, poff):
+                creason = "stream_not_clustered"
+            else:
+                mode, ck_idx = "clustered", pk
+        return {
+            "mode": mode,
+            "r_args": r_args,
+            "topn": mplan.topn,
+            "rp_fid": id(lvl.frag.build),
+            "rp_rows": gsd.n_rows,
+            "rp_presence": presence,
+            "rp_ck": ck_idx,
+            "clustered_reason": creason,
+            "rp_scan_idx": next(
+                i for i, s in enumerate(mplan.scans) if s is lvl.frag.build
+            ),
+        }
+
+    def _prepare_agg(self, mplan: MPPPlan, scans, scan_of_joined,
+                     levels=None, by_frag=None, fused: bool = False):
+        """Device aggregation metadata. Three modes (the dense/sorted
+        pair mirrors TPUEngine's dense-vs-segment split; rowpos is the
+        PR 11 fused-chain specialization):
         - dense: direct-addressed buckets + psum when the packed key
           domain is small (ref: cophandler closure exec hash agg);
+        - rowpos: fused chains whose group keys pin one unique build
+          side — segment space = build row positions (see
+          _prepare_agg_rowpos), tried when dense can't hold the domain;
         - sorted: wide int key domains, only when a TopN over an agg
           output is fused (mplan.topn) — per-device lexsort + segment
           reduce, hash exchange by group key, final reduce, device top-k.
           The mesh then returns k groups per device instead of shipping
           the joined rows back over the (slow) host link."""
+        meta = self._prepare_agg_keyed(mplan, scan_of_joined)
+        if meta is not None and meta["mode"] == "dense":
+            return meta
+        if fused:
+            rp = self._prepare_agg_rowpos(mplan, scan_of_joined, levels, by_frag)
+            if rp is not None:
+                return rp
+        return meta
+
+    def _prepare_agg_keyed(self, mplan: MPPPlan, scan_of_joined):
+        """The dense/sorted packed-group-key modes (pre-PR 11 behavior)."""
         agg = mplan.agg
         domains, key_meta = [], []
         sorted_domains = []  # step-compressed (gcd) domains for wide mode
@@ -674,26 +1025,9 @@ class MPPEngine:
             if agg.aggs[agg_idx].name not in ("sum", "count"):
                 return None
             mode = "sorted"
-        r_args = []
-        for a in agg.aggs:
-            ra = []
-            for x in a.args:
-                if isinstance(x, ExprCol):
-                    sd, off = scan_of_joined[x.idx]
-                    sd.lane(off)
-                    if off in sd.vocabs:
-                        if a.name in ("min", "max"):
-                            ra.append(x)  # code order == collation order
-                            continue
-                        return None
-                    ra.append(x)
-                    continue
-                used = set()
-                x.collect_columns(used)
-                if any(scan_of_joined[j][1] in scan_of_joined[j][0].vocabs for j in used):
-                    return None
-                ra.append(x)
-            r_args.append(ra)
+        r_args = self._lower_agg_args(agg, scan_of_joined)
+        if r_args is None:
+            return None
         meta = {"domains": domains, "key_meta": key_meta, "nseg": nseg,
                 "r_args": r_args, "mode": mode}
         if mode == "sorted":
@@ -712,22 +1046,54 @@ class MPPEngine:
     # ------------------------------------------------------------- compile
 
     def execute(self, mplan: MPPPlan, scans: list[ScanData], mesh: Mesh,
-                variables: dict, axis: str = "dp", gate=None):
+                variables: dict, axis: str = "dp", gate=None,
+                fused: bool | None = None, build_cache=None,
+                schema_ver: int = -1):
         """Run the fragment plan; returns a Chunk in partial-agg layout
         (agg case) or joined-schema layout (rows case), or None → caller
         falls back to the host join path. `gate` is the scheduler's
         shared interrupt gate, polled between fragment-level analyses and
         per-scan device uploads so KILL / deadline / runaway / OOM
-        verdicts land within one level instead of after the dispatch."""
+        verdicts land within one level instead of after the dispatch.
+
+        `fused` (None → read `tidb_tpu_mpp_fused` from `variables`,
+        default ON) enables the PR 11 fused-chain specializations: LUT
+        join levels + rowpos aggregation. `build_cache` (the store's
+        BuildSideCache) keeps LUT structures device-resident across
+        statements under (table, span, `schema_ver`, codec-sig) keys;
+        None builds them per dispatch (direct-engine tests)."""
         # reset per dispatch: a stale reason from a PREVIOUS statement
         # must never leak into this one's enforce_mpp warning / EXPLAIN
         self.last_fallback_reason = ""
         self._decline_key = "not_supported"
         tick = gate if gate is not None else (lambda: None)
-        meta = self.prepare(mplan, scans, variables, gate=gate)
+        if fused is None:
+            fused = variables.get("tidb_tpu_mpp_fused", "ON") == "ON"
+        meta = self.prepare(mplan, scans, variables, gate=gate, fused=fused)
         if meta is None:
             self._fallback(self._decline_key)
             return None
+        # fusion outcome accounting: every level fused / some did /
+        # fusion found nothing / sysvar off — the per-level decline
+        # REASONS sit in last_fuse_reasons for EXPLAIN/tests and the
+        # README fusion-rule table. The METRIC bump waits for the
+        # success boundary at the bottom: guarded_device_call re-enters
+        # this function on every transient retry, and counting attempts
+        # would inflate the A/B rates exactly when faults are under
+        # investigation (failed dispatches land in the fallback series)
+        lvls = list(meta["levels"].values())
+        self.last_fuse_reasons = {
+            i: l.fuse_reason for i, l in enumerate(lvls) if l.fuse_reason
+        }
+        if not fused:
+            outcome = "off"
+        elif lvls and all(l.use_lut for l in lvls):
+            outcome = "fused"
+        elif any(l.use_lut for l in lvls):
+            outcome = "partial"
+        else:
+            outcome = "unfused"
+        self.last_fuse_outcome = outcome
         tick()
         n_dev = mesh.shape[axis]
         # which scans are sharded: the stream source + hash-side builds
@@ -736,14 +1102,21 @@ class MPPEngine:
             if lvl.frag.exchange == HASH:
                 sharded.add(id(lvl.frag.build))
 
-        # collect device lanes needed per scan
+        # collect device lanes needed per scan (condition-only lanes
+        # tracked apart: a prefiltered stream resolves its conditions
+        # host-side, so those lanes never upload)
         need: dict[int, set] = {id(s): set() for s in scans}
+        need_cond: dict[int, set] = {id(s): set() for s in scans}
         soj = meta["scan_of_joined"]
         def note(j):
             sd, off = soj[j]
             need[id(sd)].add(off)
         for lvl in meta["levels"].values():
-            for j in lvl.frag.probe_keys + lvl.frag.build_keys:
+            # a LUT level's build keys live in the LUT itself — the raw
+            # build key lanes never enter the program
+            keys = (lvl.frag.probe_keys if lvl.use_lut
+                    else lvl.frag.probe_keys + lvl.frag.build_keys)
+            for j in keys:
                 note(j)
             for c in lvl.r_post:
                 used = set(); c.collect_columns(used)
@@ -753,10 +1126,13 @@ class MPPEngine:
             for c in meta["r_pushed"][id(s)]:
                 used = set(); c.collect_columns(used)
                 for off in used:
-                    need[id(s)].add(off)
+                    need_cond[id(s)].add(off)
         if meta["agg"] is not None:
-            for g in mplan.agg.group_by:
-                note(g.idx)
+            if meta["agg"]["mode"] not in ("rowpos", "clustered"):
+                # rowpos/clustered group by the build rowid the join
+                # already carries; group key VALUES decode host-side
+                for g in mplan.agg.group_by:
+                    note(g.idx)
             for ra in meta["agg"]["r_args"]:
                 for x in ra:
                     used = set(); x.collect_columns(used)
@@ -764,44 +1140,177 @@ class MPPEngine:
                         note(j)
 
         # flatten args: per scan (in mplan.scans order): rowid, row_valid,
-        # then (data, valid) per needed offset (sorted)
+        # then (data, valid) per needed offset (sorted). A fused SHARDED
+        # scan with pushed conditions prefilters host-side instead
+        # (_pushed_selection): its lanes upload compacted to the
+        # survivors (cached under the predicate digest), its condition
+        # lanes never ship, and the program carries no predicate
+        # constants — downstream gathers and agg scatters shrink by the
+        # selectivity, and one program serves every constant of the same
+        # shape. LUT builds are never sharded, so their row positions
+        # (the structure-cache contract) stay untouched.
         args, in_specs, scan_arg_meta = [], [], []
         shapes = []
+        # prefilter only inside FULLY fused chains: LUT levels carry no
+        # exchange/capacity math, so a compacted stream cannot starve a
+        # skew-slack bound (the mult>1 compact join sizes its output
+        # capacity partly by the stream length)
+        all_lut = bool(lvls) and all(l.use_lut for l in lvls)
+        # clustered-mode dispatch guards — data/predicate-dependent, so
+        # they cannot live in prepare: demote to the scatter-based
+        # rowpos mode (the baseline the clustered upgrade came from)
+        # when the fused TopN is too wide for _block_topk's unrolled
+        # O(k^2) extraction, or when one dominant key run would drag
+        # every run-aligned shard (and so n_dev x the padding) toward
+        # the full stream length. The typed reason lands in
+        # clustered_reason like every prepare-time decline, and mode is
+        # part of the program key, so the demoted statement compiles
+        # its own program instead of sharing the clustered one.
+        agm = meta["agg"]
+        if agm is not None and agm["mode"] == "clustered":
+            demote = None
+            if agm["topn"][2] > self.CLUSTERED_TOPN_MAX:
+                demote = "topn_too_wide"
+            else:
+                ss = next(s for s in scans
+                          if s.frag is self._stream_source(mplan.root))
+                src = meta["r_pushed"][id(ss)]
+                ssel = None
+                if (fused and all_lut and id(ss.frag) in sharded
+                        and ss.version >= 0 and src):
+                    ssel = self._pushed_selection(ss, src)
+                sh = (hashlib.sha256(repr(src).encode()).hexdigest()[:12]
+                      if ssel is not None else "")
+                koff = soj[agm["rp_ck"]][1]
+                _, _, rawmax = self._clustered_splits(ss, koff, sh, n_dev,
+                                                      ssel)
+                sn = len(ssel) if ssel is not None else ss.n_rows
+                if rawmax > max(2 * -(-sn // n_dev),
+                                self.CLUSTERED_SKEW_MIN):
+                    demote = "stream_skewed"
+            if demote is not None:
+                agm["mode"], agm["rp_ck"] = "rowpos", None
+                agm["clustered_reason"] = demote
         for s in scans:
             tick()  # each scan's lane build/upload is O(table bytes)
-            offs = sorted(need[id(s)])
             is_sharded = id(s.frag) in sharded
-            n = s.n_rows
-            total = max(-(-n // n_dev), 1) * n_dev if is_sharded else max(n, 1)
+            rc = meta["r_pushed"][id(s)]
+            sel = None
+            if fused and all_lut and is_sharded and s.version >= 0 and rc:
+                sel = self._pushed_selection(s, rc)
+            pref = sel is not None
+            offs = sorted(need[id(s)] if pref
+                          else need[id(s)] | need_cond[id(s)])
+            n = len(sel) if pref else s.n_rows
             tid = s.frag.ds.table.id
             ver = s.version
+            h = (hashlib.sha256(repr(rc).encode()).hexdigest()[:12]
+                 if pref else "")
+            # clustered agg mode: the STREAM lays out shard-by-shard at
+            # run-aligned splits (_clustered_splits — groups never
+            # straddle devices) instead of one contiguous padded block.
+            # Distinct cache tags: the same (table, version, total) can
+            # hold a different row placement under the other layout.
+            clustered = (meta["agg"] is not None
+                         and meta["agg"]["mode"] == "clustered"
+                         and s.frag is self._stream_source(mplan.root))
+            if clustered:
+                koff = soj[meta["agg"]["rp_ck"]][1]
+                splits, L, _ = self._clustered_splits(s, koff, h, n_dev, sel)
+                total = n_dev * L
+
+                def lay(a, _sp=splits, _L=L):
+                    return self._shard_pad(a, _sp, _L)
+
+                def tg(tag):
+                    return ("c", n_dev, tag)
+
+                def _rv(_lay=lay):
+                    return _lay(np.ones(n, dtype=bool))
+            else:
+                total = max(-(-n // n_dev), 1) * n_dev if is_sharded else max(n, 1)
+
+                def lay(a, _t=total):
+                    return _pad(a, _t)
+
+                def tg(tag):
+                    return tag
+
+                def _rv():
+                    rv = np.zeros(total, dtype=bool)
+                    rv[:n] = True
+                    return rv
 
             def ck(tag, _tid=tid, _ver=ver, _tot=total, _sh=is_sharded):
                 return None if _ver < 0 else (_tid, _ver, tag, _tot, _sh)
 
             spec = P(axis) if is_sharded else P()
-            args.append(self._dev_put(ck("rowid"),
-                                      lambda: _pad(np.arange(n, dtype=np.int64), total)))
-            def _rv():
-                rv = np.zeros(total, dtype=bool)
-                rv[:n] = True
-                return rv
-            args.append(self._dev_put(ck("rv"), _rv))
+            if pref:
+                args.append(self._dev_put(
+                    ck(tg(("frowid", h))), lambda: lay(sel)))
+            else:
+                args.append(self._dev_put(
+                    ck(tg("rowid")),
+                    lambda: lay(np.arange(n, dtype=np.int64))))
+            args.append(self._dev_put(ck(tg(("frv", h) if pref else "rv")), _rv))
             in_specs += [spec, spec]
             for off in offs:
-                args.append(self._dev_put(
-                    ck(("d", off)), lambda _o=off: _pad(s.lane(_o)[0], total)))
-                args.append(self._dev_put(
-                    ck(("v", off)), lambda _o=off: _pad(s.lane(_o)[1], total)))
+                if pref:
+                    args.append(self._dev_put(
+                        ck(tg(("fd", off, h))),
+                        lambda _o=off: lay(s.lane(_o)[0][sel])))
+                    args.append(self._dev_put(
+                        ck(tg(("fv", off, h))),
+                        lambda _o=off: lay(s.lane(_o)[1][sel])))
+                else:
+                    args.append(self._dev_put(
+                        ck(tg(("d", off))), lambda _o=off: lay(s.lane(_o)[0])))
+                    args.append(self._dev_put(
+                        ck(tg(("v", off))), lambda _o=off: lay(s.lane(_o)[1])))
                 in_specs += [spec, spec]
-            scan_arg_meta.append((id(s.frag), offs, is_sharded))
-            shapes.append((total, is_sharded, offs))
+            scan_arg_meta.append((id(s.frag), offs, is_sharded, pref))
+            shapes.append((total, is_sharded, offs, pref))
+
+        # LUT levels: the device-resident build structure enters the
+        # program replicated, after every scan's lanes. Resident copies
+        # come from the store's BuildSideCache under (table, span,
+        # schema-ver, codec-sig) — the sig carries the data version and
+        # every layout parameter, so a write OR a layout change can never
+        # serve a stale structure (a schema bump purges via get(), DDL/
+        # bulk-load additionally purge through TileCache.invalidate_table)
+        by_frag = {id(s.frag): s for s in scans}
+        lut_fids = []
+        for lvl in meta["levels"].values():
+            if not lvl.use_lut:
+                continue
+            tick()  # the LUT build walks O(build rows) host lanes
+            bsd = by_frag[id(lvl.frag.build)]
+            boffs = tuple(soj[bk][1] for bk in lvl.frag.build_keys)
+            sig = ("lut", bsd.version, boffs, tuple(lvl.lut_lo),
+                   tuple(lvl.lut_stride), lvl.lut_dom)
+
+            def build(_lvl=lvl, _soj=soj):
+                arr = jnp.asarray(self._build_lut(_lvl, _soj))
+                # uploader pays (PR 4 volume-proxy rule); cache hits are
+                # free — the statement that built the structure carried it
+                consume_current(arr.nbytes)
+                return arr
+
+            if build_cache is not None and bsd.version >= 0:
+                lut = build_cache.get(bsd.frag.ds.table.id, ("full",),
+                                      schema_ver, sig, build)
+            else:
+                lut = build()
+            args.append(lut)
+            in_specs.append(P())
+            lut_fids.append(id(lvl.frag))
 
         tick()
         key = self._program_key(mplan, meta, scans, shapes, n_dev)
         prog = self._programs.get(key)
         if prog is None:
-            prog = self._build_program(mplan, meta, scan_arg_meta, mesh, axis, n_dev, tuple(in_specs))
+            prog = self._build_program(mplan, meta, scan_arg_meta, mesh, axis,
+                                       n_dev, tuple(in_specs), lut_fids)
             self._programs[key] = prog
             self.compile_count += 1
         from ..jaxenv import unpack_rows
@@ -817,11 +1326,34 @@ class MPPEngine:
             self._fallback("capacity_overflow",
                            f"exchange bucket overflow ({dropped} rows)")
             return None
+        # one bump per SUCCESSFUL mesh dispatch (see the outcome block
+        # up top): retried attempts and fallbacks never reach here
+        M.TPU_MPP_FUSED.inc(outcome=outcome)
         if meta["agg"] is not None:
             if meta["agg"]["mode"] == "sorted":
                 return self._finalize_topk(mplan, meta, outs), True
+            if meta["agg"]["mode"] in ("rowpos", "clustered"):
+                return self._finalize_rowpos(mplan, meta, scans, outs), True
             return self._finalize_agg(mplan, meta, outs), True
         return self._finalize_rows(mplan, meta, scans, outs), meta["agg"] is not None
+
+    @staticmethod
+    def _build_lut(lvl, scan_of_joined) -> np.ndarray:
+        """Direct-address join structure for a fused level: int32 array
+        of length lut_dom mapping packed build key → build row position,
+        -1 = no such key. Packs with the level's BUILD-local lo/stride
+        (content depends on the build table alone — the cache contract)
+        over the unfiltered lanes; per-statement pushed conditions apply
+        at probe time through the build mask instead."""
+        lut = np.full(max(lvl.lut_dom, 1), -1, dtype=np.int32)
+        packed = MPPEngine._pack_host(lvl.frag.build_keys, scan_of_joined,
+                                      lvl.lut_lo, lvl.lut_stride)
+        if packed is not None:
+            kv, km = packed
+            # unique build keys (mult==1, verified on these same lanes):
+            # no slot is written twice
+            lut[kv[km]] = np.nonzero(km)[0].astype(np.int32)
+        return lut
 
     @staticmethod
     def _stream_source(frag):
@@ -831,30 +1363,44 @@ class MPPEngine:
 
     def _program_key(self, mplan, meta, scans, shapes, n_dev):
         parts = [repr(shapes), str(n_dev)]
-        for s in scans:
-            parts.append(repr(meta["r_pushed"][id(s)]))
+        for s, sh in zip(scans, shapes):
+            # a prefiltered scan's predicate resolved host-side: the
+            # program is constant-free, so every same-shape predicate
+            # shares one compiled program (no recompile per constant)
+            parts.append("prefiltered" if sh[3] else repr(meta["r_pushed"][id(s)]))
         for fid, lvl in meta["levels"].items():
             parts += [
                 lvl.frag.kind, lvl.frag.exchange,
                 repr(lvl.frag.probe_keys), repr(lvl.frag.build_keys),
                 repr(lvl.key_lo), repr(lvl.key_stride), repr(lvl.r_post),
                 str(lvl.mult), str(lvl.expected_out), str(lvl.key_i32),
+                # fused-chain layout (PR 11): the LUT's packing constants
+                # and length bake into the program, so layouts never
+                # share programs (the codec-keyed compile-cache rule)
+                str(lvl.use_lut), repr(lvl.lut_lo), repr(lvl.lut_size),
+                repr(lvl.lut_stride), str(lvl.lut_dom),
             ]
         if meta["agg"]:
             a = meta["agg"]
             # int keys bake `lo` (km[1]) into the compiled kernel, so the
             # cache key must carry it; dict keys are covered by kind+domain
             # (vocab only affects host decode + already-keyed r_pushed).
-            parts += [repr(a["domains"]),
-                      repr([(m[0], m[1], m[2]) if m[0] == "int" else (m[0],) for m in a["key_meta"]]),
+            parts += [repr(a.get("domains")),
+                      repr([(m[0], m[1], m[2]) if m[0] == "int" else (m[0],)
+                            for m in a.get("key_meta", ())]),
                       repr(a["r_args"]), repr([x.name for x in mplan.agg.aggs]),
                       repr(mplan.agg.group_by),
-                      a["mode"], repr(a.get("strides")), repr(a.get("topn"))]
+                      a["mode"], repr(a.get("strides")), repr(a.get("topn")),
+                      repr(a.get("rp_scan_idx")), repr(a.get("rp_rows")),
+                      # presence-dedup layout and the clustered key lane
+                      # both bake into the kernel's lane indexing
+                      repr(a.get("rp_presence")), repr(a.get("rp_ck"))]
         return hashlib.sha256("|".join(parts).encode()).hexdigest()
 
     # ------------------------------------------------------------- kernel
 
-    def _build_program(self, mplan, meta, scan_arg_meta, mesh, axis, n_dev, in_specs):
+    def _build_program(self, mplan, meta, scan_arg_meta, mesh, axis, n_dev,
+                       in_specs, lut_fids=()):
         from ..copr.tpu_engine import TPUEngine
 
         eval_dev = TPUEngine._eval_device
@@ -870,9 +1416,11 @@ class MPPEngine:
         # arg unpacking plan: index into flat args per scan
         arg_plan = []
         pos = 0
-        for fid, offs, is_sharded in scan_arg_meta:
-            arg_plan.append((fid, pos, offs))
+        for fid, offs, is_sharded, pref in scan_arg_meta:
+            arg_plan.append((fid, pos, offs, pref))
             pos += 2 + 2 * len(offs)
+        # LUT args (replicated) follow the scan args, in level order
+        lut_arg_pos = {fid: pos + i for i, fid in enumerate(lut_fids)}
 
         # r_pushed is keyed by id(ScanData); scan_arg_meta carries frag ids.
         # Re-key via scan_of_joined (every ScanData maps to its frag).
@@ -881,7 +1429,7 @@ class MPPEngine:
             sd_by_fid[id(sd.frag)] = sd
 
         def scan_stage(frag_id, flat):
-            fid, base, offs = next(a for a in arg_plan if a[0] == frag_id)
+            fid, base, offs, pref = next(a for a in arg_plan if a[0] == frag_id)
             rowid = flat[base]
             rv = flat[base + 1]
             lanes = {}
@@ -889,7 +1437,9 @@ class MPPEngine:
                 lanes[off] = (flat[base + 2 + 2 * k], flat[base + 3 + 2 * k])
             sd = sd_by_fid[frag_id]
             mask = rv
-            for c in r_pushed[id(sd)]:
+            # a prefiltered scan's lanes hold only surviving rows — its
+            # pushed conditions already applied host-side
+            for c in () if pref else r_pushed[id(sd)]:
                 d, v = eval_dev(c, lanes)
                 d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d
                 v = jnp.broadcast_to(v, mask.shape) if getattr(v, "ndim", 0) == 0 else v
@@ -963,12 +1513,52 @@ class MPPEngine:
             mask_out = xc(mask)
             return new_map, mask_out, new_rowids
 
+        def lut_join(frag, lvl, flat, pmap_, pmask, prow, bmap, bmask, brow):
+            """Fused-level probe: pack the probe keys in the BUILD-local
+            domain and gather the device-resident LUT — no build sort, no
+            searchsorted, no exchange (the structure is replicated). Out-
+            of-domain or absent keys miss; per-statement build filters
+            apply through the gathered build mask."""
+            lut = flat[lut_arg_pos[id(frag)]]
+            B = bmask.shape[0]
+            acc = None
+            pkv = None
+            for j, lo, st, size in zip(frag.probe_keys, lvl.lut_lo,
+                                       lvl.lut_stride, lvl.lut_size):
+                d, v = pmap_[j]
+                dd = d.astype(jnp.int64)
+                # per-dimension range check BEFORE packing: values outside
+                # the build domain must miss, never wrap into a false slot
+                ok = v & (dd >= lo) & (dd < lo + size)
+                term = (dd - lo) * st
+                acc = term if acc is None else acc + term
+                pkv = ok if pkv is None else (pkv & ok)
+            pos = lut[jnp.clip(acc, 0, lvl.lut_dom - 1)]
+            bsel = jnp.clip(pos.astype(jnp.int64), 0, B - 1)
+            match = pmask & pkv & (pos >= 0) & bmask[bsel]
+            merged = dict(pmap_)
+            for j, (d, v) in bmap.items():
+                merged[j] = (d[bsel], v[bsel] & match)
+            rowids = dict(prow)
+            rowids[id(frag.build)] = jnp.where(match, brow[id(frag.build)][bsel], -1)
+            return merged, match, rowids
+
         def join_stage(frag, flat):
             if isinstance(frag, ScanFrag):
                 return scan_stage(id(frag), flat)
             pmap_, pmask, prow = join_stage(frag.probe, flat)
             bmap, bmask, brow = scan_stage(id(frag.build), flat)
             lvl = levels[id(frag)]
+            if lvl.use_lut:
+                merged, mask, rowids = lut_join(
+                    frag, lvl, flat, pmap_, pmask, prow, bmap, bmask, brow
+                )
+                for c in lvl.r_post:
+                    d, v = eval_dev(c, merged)
+                    d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d
+                    v = jnp.broadcast_to(v, mask.shape) if getattr(v, "ndim", 0) == 0 else v
+                    mask = mask & v & (d != 0)
+                return merged, mask, rowids
             pkey, pkv = pack_keys(pmap_, frag.probe_keys, lvl)
             bkey, bkv = pack_keys(bmap, frag.build_keys, lvl)
             if frag.exchange == HASH:
@@ -1162,19 +1752,9 @@ class MPPEngine:
             def finish_topk(fkey, fvals, fvalid):
                 # device top-k on the fused ORDER BY aggregate
                 agg_idx, desc, k = agg_meta["topn"]
-                lane_pos = 0
-                for i, a in enumerate(agg.aggs):
-                    if i == agg_idx:
-                        break
-                    lane_pos += 1 if a.name == "count" else 2
-                val = fvals[lane_pos]
+                lane_pos = self._topn_lane_pos(agg.aggs, agg_idx)
                 valid = fvalid
-                if val.dtype in (jnp.float64, jnp.float32):
-                    score = jnp.where(valid, val, -jnp.inf)
-                    score = score if desc else -score
-                else:
-                    score = jnp.where(valid, val, -I64_MAX)
-                    score = score if desc else jnp.where(valid, -val, -I64_MAX)
+                score = self._topk_score(fvals[lane_pos], valid, desc)
                 kk = min(k, int(score.shape[0]))
                 _, idx = jax.lax.top_k(score, kk)
                 outs = [fkey[idx], valid[idx]]
@@ -1205,6 +1785,149 @@ class MPPEngine:
             fkey, fvals, fvalid = seg_reduce(ukey2, vals2, n_dev)
             return finish_topk(fkey, fvals, fvalid)
 
+        def rowpos_agg_stage(lanemap, mask, rowids):
+            """Fused-chain aggregation by BUILD ROW POSITION (PR 11):
+            group keys pin one unique build side, so the build rowid the
+            join already gathered IS the group id — no key packing, no
+            lexsort. Partials segment-reduce into the dense [0, B) space,
+            psum_scatter hands each device one contiguous slice summed
+            across the mesh, and per-slice top-k (by the fused ORDER BY
+            aggregate) returns n_dev*k exact candidates; the host decodes
+            group key values from the build scan's original lanes."""
+            B = agg_meta["rp_rows"]
+            Bp = -(-B // n_dev) * n_dev  # psum_scatter needs equal blocks
+            rid = rowids[agg_meta["rp_fid"]]
+            seg = jnp.where(mask, jnp.clip(rid, 0, B - 1), Bp).astype(jnp.int32)
+            pres = agg_meta["rp_presence"]
+            lanes = []
+            for a, ra in zip(agg.aggs, agg_meta["r_args"]):
+                lanes.extend(self._agg_partials(a, ra, lanemap, mask, seg, Bp, eval_dev))
+            base = 0
+            if pres is None:
+                # no aggregate lane provably equals the presence count:
+                # scatter a dedicated one
+                lanes.insert(0, (jax.ops.segment_sum(
+                    mask.astype(jnp.int64), seg, num_segments=Bp + 1)[:Bp], "sum"))
+                base = 1
+            if n_dev == 1:
+                full = [arr for arr, _ in lanes]
+                didx = jnp.zeros((), jnp.int32)
+            else:
+                full = []
+                for arr, op in lanes:
+                    if op == "sum":
+                        full.append(jax.lax.psum_scatter(
+                            arr, axis, scatter_dimension=0, tiled=True))
+                    else:
+                        # min/max have no scatter collective: reduce the
+                        # whole space, then slice this device's block
+                        r = (jax.lax.pmin if op == "min" else jax.lax.pmax)(arr, axis)
+                        blk = Bp // n_dev
+                        start = jax.lax.axis_index(axis) * blk
+                        full.append(jax.lax.dynamic_slice_in_dim(r, start, blk, 0))
+                didx = jax.lax.axis_index(axis)
+            blk = full[0].shape[0]
+            agg_idx, desc, k = agg_meta["topn"]
+            # presence: the dedicated lane 0 when one was scattered, else
+            # the agg count lane _prepare_agg_rowpos proved equal to it
+            gcount = full[0] if base == 1 else full[pres]
+            valid = gcount > 0
+            score = self._topk_score(
+                full[self._topn_lane_pos(agg.aggs, agg_idx, base)], valid,
+                desc)
+            # k widened to the output lane count: pack_rows ships one
+            # (n_outs, L) matrix and needs L >= n_outs (extra candidate
+            # groups are harmless — the host TopN re-cuts exactly)
+            kk = min(max(k, len(full) + 4), blk)
+            _, idx = jax.lax.top_k(score, kk)
+            gidx = (didx.astype(jnp.int64) * blk + idx.astype(jnp.int64))
+            outs = [jnp.where(valid[idx], gidx, -1), valid[idx]]
+            # ship the agg lanes only — a dedicated presence lane (base
+            # == 1) served its purpose on device and stays there
+            outs.extend(f[idx] for f in full[base:])
+            return tuple(outs)
+
+        def clustered_agg_stage(lanemap, mask, rowids):
+            """Clustered fused-chain aggregation (PR 11): the stream
+            arrives SORTED by the group level's probe key and shard-split
+            at run boundaries (_clustered_splits), so each group is one
+            contiguous run wholly on one device. Run totals come from one
+            cumsum + two run-boundary gathers per lane (seg_reduce's
+            trick without its argsort — the data is already in key
+            order), and the program carries NO B-wide scatter, no psum,
+            no exchange anywhere: each device top-ks its own complete
+            groups and the host merges n_dev·k exact candidates through
+            the same rowpos finalize."""
+            rid = rowids[agg_meta["rp_fid"]]
+            kd, _kv = lanemap[agg_meta["rp_ck"]]
+            nloc = mask.shape[0]
+            idx = jnp.arange(nloc, dtype=jnp.int32)
+            brk = kd[1:] != kd[:-1]
+            first = jnp.concatenate([jnp.ones(1, bool), brk])
+            last = jnp.concatenate([brk, jnp.ones(1, bool)])
+            rend = -jax.lax.cummax(jnp.where(last, -idx, -(nloc - 1))[::-1])[::-1]
+
+            def run_sum(vals):
+                c = jnp.cumsum(vals)
+                prev = jnp.concatenate([jnp.zeros(1, c.dtype), c[:-1]])
+                return c[rend] - prev
+
+            pres = agg_meta["rp_presence"]
+            lanes = []
+            for a, ra in zip(agg.aggs, agg_meta["r_args"]):
+                if ra:
+                    d, v = eval_dev(ra[0], lanemap)
+                    d = jnp.broadcast_to(d, mask.shape) if getattr(d, "ndim", 0) == 0 else d
+                    v = jnp.broadcast_to(v, mask.shape) if getattr(v, "ndim", 0) == 0 else v
+                else:
+                    d = jnp.ones(mask.shape, jnp.int64)
+                    v = jnp.ones(mask.shape, bool)
+                ok = mask & v
+                if a.name == "count":
+                    lanes.append(run_sum(ok.astype(jnp.int64)))
+                else:  # sum / avg — eligibility excluded min/max
+                    if d.dtype in (jnp.float64, jnp.float32):
+                        lanes.append(run_sum(jnp.where(ok, d, 0.0)))
+                    else:  # widen BEFORE the cumsum: narrow codec lanes
+                        lanes.append(run_sum(
+                            jnp.where(ok, d.astype(jnp.int64), 0)))
+                    lanes.append(run_sum(ok.astype(jnp.int64)))
+            base = 0
+            if pres is None:
+                lanes.insert(0, run_sum(mask.astype(jnp.int64)))
+                base = 1
+            match_cnt = lanes[0] if base == 1 else lanes[pres]
+            # group id: matched rows all carry the SAME build row
+            # position (unique build keys), so run_sum(rid·match) /
+            # match-count recovers it exactly without a segmented max
+            rid_sum = run_sum(jnp.where(mask, rid, 0).astype(jnp.int64))
+            gpos = jnp.where(match_cnt > 0,
+                             rid_sum // jnp.maximum(match_cnt, 1), -1)
+            agg_idx, desc, k = agg_meta["topn"]
+            # only a run's FIRST position represents its group — interior
+            # positions carry the same totals and would duplicate it
+            valid = first & (match_cnt > 0)
+            score = self._topk_score(
+                lanes[self._topn_lane_pos(agg.aggs, agg_idx, base)], valid,
+                desc)
+            kk = min(max(k, len(lanes) - base + 6), nloc)
+            tvals, ti = self._block_topk(score, kk)
+            # a shard with fewer than kk scoreable groups exhausts
+            # _block_topk: once everything above the floor is taken it
+            # returns floor-valued picks whose INDEX can repeat an
+            # already-shipped valid position (argmax over an all-floor
+            # block is position 0), and a repeated group would be
+            # double-summed by the host partial merge — mask exhausted
+            # picks by VALUE, independent of the position they name
+            floor = (jnp.asarray(-jnp.inf, score.dtype)
+                     if score.dtype in (jnp.float64, jnp.float32)
+                     else jnp.asarray(jnp.iinfo(score.dtype).min,
+                                      score.dtype))
+            tvalid = valid[ti] & (tvals > floor)
+            outs = [jnp.where(tvalid, gpos[ti], -1), tvalid]
+            outs.extend(l[ti] for l in lanes[base:])
+            return tuple(outs)
+
         def kernel(*flat):
             drop_acc.clear()
 
@@ -1230,6 +1953,10 @@ class MPPEngine:
                 return with_drops(outs)
             if agg_meta["mode"] == "sorted":
                 return with_drops(sorted_agg_stage(lanemap, mask))
+            if agg_meta["mode"] == "rowpos":
+                return with_drops(rowpos_agg_stage(lanemap, mask, rowids))
+            if agg_meta["mode"] == "clustered":
+                return with_drops(clustered_agg_stage(lanemap, mask, rowids))
             # fused partial aggregation + psum (exact int/scaled-decimal)
             nseg = agg_meta["nseg"]
             code = jnp.zeros(mask.shape, dtype=jnp.int32)
@@ -1252,6 +1979,70 @@ class MPPEngine:
 
         sm = shard_map(kernel, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs)
         return jax.jit(sm)
+
+    @staticmethod
+    def _topk_score(val, valid, desc):
+        """Sort lane for the fused ORDER-BY-agg top-k: invalid slots
+        sink to the dtype floor. The ascending negation happens INSIDE
+        the where — negating the where'd result would send every
+        invalid slot to the TOP of the order and crowd the real groups
+        out of the k slots. All three agg modes (sorted finish, rowpos,
+        clustered) share this helper so the sentinel semantics cannot
+        diverge."""
+        if val.dtype in (jnp.float64, jnp.float32):
+            return jnp.where(valid, val if desc else -val, -jnp.inf)
+        return jnp.where(valid, val if desc else -val, -I64_MAX)
+
+    @staticmethod
+    def _topn_lane_pos(aggs, agg_idx, base=0):
+        """Flat partial-lane index of the TopN aggregate: count ships
+        one lane, every other agg ships a (value, count) pair."""
+        lane_pos = base
+        for i, a in enumerate(aggs):
+            if i == agg_idx:
+                break
+            lane_pos += 1 if a.name == "count" else 2
+        return lane_pos
+
+    @staticmethod
+    def _block_topk(v, k: int, blk: int = 1024):
+        """Exact top-k over a long score lane without lax.top_k, which
+        sorts the whole array (XLA:CPU pays ~1s at 2M rows for k=16).
+        Block maxima + k extraction rounds touch O(n + k·(n/blk + blk))
+        elements instead: each round takes the global max among
+        per-block maxima, then recomputes only the winning block's max
+        with every already-taken position masked out. Returns (values,
+        indices into v), both length k."""
+        n = v.shape[0]
+        if v.dtype in (jnp.float64, jnp.float32):
+            lo = jnp.asarray(-jnp.inf, v.dtype)
+        else:
+            lo = jnp.asarray(jnp.iinfo(v.dtype).min, v.dtype)
+        pad = (-n) % blk
+        vp = jnp.concatenate([v, jnp.full((pad,), lo, v.dtype)]) if pad else v
+        m2 = vp.reshape(-1, blk)
+        bm = jnp.max(m2, axis=1)
+        bi = jnp.argmax(m2, axis=1).astype(jnp.int32)
+        vals, idxs = [], []
+        tb = jnp.full((k,), -1, jnp.int32)  # block of the t-th winner
+        tp = jnp.full((k,), -1, jnp.int32)  # in-block position of same
+        car = jnp.arange(blk, dtype=jnp.int32)
+        for t in range(k):
+            j = jnp.argmax(bm).astype(jnp.int32)
+            vals.append(bm[j])
+            idxs.append(j * blk + bi[j])
+            tb = tb.at[t].set(j)
+            tp = tp.at[t].set(bi[j])
+            row = jax.lax.dynamic_slice(m2, (j, jnp.zeros((), j.dtype)), (1, blk))[0]
+            taken = jnp.zeros(blk, bool)
+            for u in range(t + 1):  # k is ~16: the unrolled scan is tiny
+                taken = taken | ((tb[u] == j) & (car == tp[u]))
+            row = jnp.where(taken, lo, row)
+            bm = bm.at[j].set(jnp.max(row))
+            bi = bi.at[j].set(jnp.argmax(row).astype(jnp.int32))
+        # winners drawn from the pad tail (fewer than k real candidates)
+        # clip into range; their scores stay `lo` so validity masks them
+        return jnp.stack(vals), jnp.clip(jnp.stack(idxs), 0, n - 1)
 
     @staticmethod
     def _agg_partials(a, r_args, lanemap, mask, seg, nseg, eval_dev):
@@ -1286,6 +2077,83 @@ class MPPEngine:
         raise NotImplementedError(a.name)
 
     # ------------------------------------------------------------ finalize
+
+    @staticmethod
+    def _partial_agg_cols(agg, soj, outs, pos, sel, out_fts, oi) -> list[Column]:
+        """Per-agg partial-state columns (count / sum+count / min-max+
+        count lanes) from the device output arrays — the shared tail of
+        every agg finalizer. `sel` picks and orders the group rows,
+        `pos` indexes the first value lane, `oi` the first partial
+        field type. min/max over dict-coded lanes decode through the
+        vocab (code order == collation order)."""
+        G = len(sel)
+        cols: list[Column] = []
+        for a in agg.aggs:
+            if a.name == "count":
+                cnt = np.asarray(outs[pos])[sel]
+                cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
+                pos += 1
+                oi += 1
+                continue
+            s = np.asarray(outs[pos])[sel]
+            cnt = np.asarray(outs[pos + 1])[sel]
+            has = cnt > 0
+            pos += 2
+            if a.name in ("sum", "avg"):
+                sd = s if out_fts[oi].is_float() else s.astype(np.int64)
+                cols.append(Column(out_fts[oi], sd, has))
+                oi += 1
+                if a.name == "avg":
+                    cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
+                    oi += 1
+            elif a.name in ("min", "max"):
+                ft = out_fts[oi]
+                arg = a.args[0] if a.args else None
+                vocab = None
+                if isinstance(arg, ExprCol):
+                    sd2, off = soj[arg.idx]
+                    vocab = sd2.vocabs.get(off)
+                if vocab is not None:
+                    data = np.empty(G, dtype=object)
+                    for j in range(G):
+                        data[j] = (vocab[int(s[j])]
+                                   if has[j] and 0 <= int(s[j]) < len(vocab) else None)
+                    cols.append(Column(ft, data, has))
+                else:
+                    data = s if ft.is_float() else np.where(has, s.astype(np.int64), 0)
+                    cols.append(Column(ft, data, has))
+                oi += 1
+        return cols
+
+    def _finalize_rowpos(self, mplan, meta, scans, outs) -> Chunk:
+        """Rowpos-mode device output → partial-layout chunk: each row is
+        one exact group = one build-side row; group key VALUES gather
+        host-side from the build scan's original (string/date-preserving)
+        numpy lanes by the returned row position."""
+        agg = mplan.agg
+        agg_meta = meta["agg"]
+        soj = meta["scan_of_joined"]
+        B = agg_meta["rp_rows"]
+        gidx = np.asarray(outs[0]).astype(np.int64)
+        valid = np.asarray(outs[1]).astype(bool)
+        keep = np.nonzero(valid & (gidx >= 0) & (gidx < B))[0]
+        rows = gidx[keep]
+        out_fts = [g.ret_type for g in agg.group_by]
+        for a in agg.aggs:
+            out_fts.extend(ft for _, ft in a.partial_final_types())
+        cols: list[Column] = []
+        oi = 0
+        for g in agg.group_by:
+            sd, off = soj[g.idx]
+            data = sd.data[off][rows]
+            gvalid = sd.valid[off][rows]
+            if data.dtype == object:
+                data = data.copy()
+                data[~gvalid] = None
+            cols.append(Column(out_fts[oi], data, gvalid))
+            oi += 1
+        cols.extend(self._partial_agg_cols(agg, soj, outs, 2, keep, out_fts, oi))
+        return Chunk(cols)
 
     def _finalize_agg(self, mplan, meta, outs) -> Chunk:
         """psum'd partial arrays → partial-layout chunk (group keys then
@@ -1322,45 +2190,7 @@ class MPPEngine:
                 data[~valid] = 0
             cols.append(Column(ft, data, valid))
             oi += 1
-        pos = 1
-        for a, ra in zip(agg.aggs, agg_meta["r_args"]):
-            if a.name == "count":
-                cnt = np.asarray(outs[pos])[present]
-                cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
-                pos += 1
-                oi += 1
-            elif a.name in ("sum", "avg"):
-                s = np.asarray(outs[pos])[present]
-                cnt = np.asarray(outs[pos + 1])[present]
-                has = cnt > 0
-                sd = s if out_fts[oi].is_float() else s.astype(np.int64)
-                cols.append(Column(out_fts[oi], sd, has))
-                oi += 1
-                if a.name == "avg":
-                    cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
-                    oi += 1
-                pos += 2
-            elif a.name in ("min", "max"):
-                s = np.asarray(outs[pos])[present]
-                cnt = np.asarray(outs[pos + 1])[present]
-                has = cnt > 0
-                ft = out_fts[oi]
-                arg = a.args[0] if a.args else None
-                if isinstance(arg, ExprCol):
-                    sd, off = soj[arg.idx]
-                    if off in sd.vocabs:
-                        vocab = sd.vocabs[off]
-                        data = np.empty(G, dtype=object)
-                        for j in range(G):
-                            data[j] = vocab[int(s[j])] if has[j] and 0 <= int(s[j]) < len(vocab) else None
-                        cols.append(Column(ft, data, has))
-                        pos += 2
-                        oi += 1
-                        continue
-                data = s if ft.is_float() else np.where(has, s.astype(np.int64), 0)
-                cols.append(Column(ft, data, has))
-                pos += 2
-                oi += 1
+        cols.extend(self._partial_agg_cols(agg, soj, outs, 1, present, out_fts, oi))
         return Chunk(cols)
 
     def _finalize_topk(self, mplan, meta, outs) -> Chunk:
@@ -1393,45 +2223,7 @@ class MPPEngine:
                 data = np.where(kvalid, (comp - 1) * km[2] + km[1], 0).astype(np.int64)
             cols.append(Column(ft, data, kvalid))
             oi += 1
-        pos = 2
-        for a, ra in zip(agg.aggs, agg_meta["r_args"]):
-            if a.name == "count":
-                cnt = np.asarray(outs[pos])[keep]
-                cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
-                pos += 1
-                oi += 1
-            elif a.name in ("sum", "avg"):
-                s = np.asarray(outs[pos])[keep]
-                cnt = np.asarray(outs[pos + 1])[keep]
-                has = cnt > 0
-                sd = s if out_fts[oi].is_float() else s.astype(np.int64)
-                cols.append(Column(out_fts[oi], sd, has))
-                oi += 1
-                if a.name == "avg":
-                    cols.append(Column(out_fts[oi], cnt.astype(np.int64), np.ones(G, bool)))
-                    oi += 1
-                pos += 2
-            elif a.name in ("min", "max"):
-                s = np.asarray(outs[pos])[keep]
-                cnt = np.asarray(outs[pos + 1])[keep]
-                has = cnt > 0
-                ft = out_fts[oi]
-                arg = a.args[0] if a.args else None
-                if isinstance(arg, ExprCol):
-                    sd, off = soj[arg.idx]
-                    if off in sd.vocabs:
-                        vocab = sd.vocabs[off]
-                        data = np.empty(G, dtype=object)
-                        for j in range(G):
-                            data[j] = vocab[int(s[j])] if has[j] and 0 <= int(s[j]) < len(vocab) else None
-                        cols.append(Column(ft, data, has))
-                        pos += 2
-                        oi += 1
-                        continue
-                data = s if ft.is_float() else np.where(has, s.astype(np.int64), 0)
-                cols.append(Column(ft, data, has))
-                pos += 2
-                oi += 1
+        cols.extend(self._partial_agg_cols(agg, soj, outs, 2, keep, out_fts, oi))
         return Chunk(cols)
 
     def _finalize_rows(self, mplan, meta, scans, outs) -> Chunk:

@@ -32,6 +32,12 @@ from .plans import Aggregation, DataSource, Join, LogicalPlan, Projection, Selec
 HASH = "hash"
 BROADCAST = "broadcast"
 PASSTHROUGH = "passthrough"
+# PR 11 fused chains: a LUT-specialized join level needs NO exchange at
+# all — the device-resident build structure (and the build lanes behind
+# it) is replicated to every device, the sharded stream probes in place.
+# Distinct from BROADCAST so EXPLAIN/tests can tell "replicated because
+# small" from "replicated because the resident structure lives there".
+LOCAL = "local"
 
 
 @dataclass

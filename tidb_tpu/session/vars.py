@@ -179,6 +179,17 @@ _sv("tidb_tpu_cop_lanes", "0", scope="global", kind="int", lo=0, hi=256,
 # layout keys the store-wide compile cache and batcher groups
 _sv("tidb_tpu_tile_compression", "ON", scope="global", kind="bool", consumed=True)
 
+# --- fused MPP fragment chains (PR 11) --------------------------------------
+# ON (default): all-inner fragment chains specialize eligible join levels
+# to device-resident direct-address LUT structures (no in-program build
+# sort, no exchange — the structure is cached across statements in the
+# store's BuildSideCache) and group-on-build-key aggregations to
+# build-row-position segments. OFF recovers the pre-fusion sort-join /
+# sorted-agg programs exactly — the A/B baseline and the incident
+# fallback, mirroring tidb_tpu_tile_compression. GLOBAL-only; the live
+# value overrides every session's dispatch (incident semantics).
+_sv("tidb_tpu_mpp_fused", "ON", scope="global", kind="bool", consumed=True)
+
 # --- server memory arbitration (PR 4: utils/memory ServerMemTracker) -------
 # store-wide hard limit on tracked statement memory; 0 = unlimited.
 # GLOBAL-only like the reference: a per-session opt-out would defeat it

@@ -864,6 +864,25 @@ class Storage:
                     self._timeline = TimelineRing()
         return self._timeline
 
+    @property
+    def build_cache(self):
+        """Store-wide device-resident MPP build-side cache
+        (copr/tilecache.BuildSideCache): one pool per store so every
+        session's fused dispatch reuses the same uploaded join
+        structures; registered with the memory arbiter so the soft-limit
+        degrade sweep reclaims it with the tile caches. Double-checked
+        init like the timeline ring — first touch comes from whichever
+        session dispatches MPP first."""
+        if getattr(self, "_build_cache", None) is None:
+            from ..copr.tilecache import BuildSideCache
+
+            with Storage._timeline_init_lock:
+                if getattr(self, "_build_cache", None) is None:
+                    bc = BuildSideCache()
+                    self.mem.register_cache(bc)
+                    self._build_cache = bc
+        return self._build_cache
+
     # --- active-txn registry (GC safepoint clamp) --------------------------
 
     MAX_TXN_PIN_S = 3600.0  # leaked/abandoned txns stop blocking GC after this

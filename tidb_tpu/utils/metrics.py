@@ -405,6 +405,21 @@ TPU_FALLBACK = REGISTRY.counter(
     "device-path declines/degrades to the host engine by path (cop|mpp|window) and typed reason",
 )
 
+# fused MPP fragment chains (PR 11): how each MPP dispatch ran —
+# `fused` (every join level probed a resident LUT structure, agg folded
+# to build-row positions), `partial` (some levels fused, the rest took
+# the sort-join path), `unfused` (fusion on but no level qualified) or
+# `off` (tidb_tpu_mpp_fused=OFF) — and the device-resident build-side
+# cache's lifecycle (hit | miss | evict | invalidate)
+TPU_MPP_FUSED = REGISTRY.counter(
+    "tidb_tpu_mpp_fused_total",
+    "MPP dispatches by fusion outcome (fused | partial | unfused | off)",
+)
+TPU_BUILD_CACHE = REGISTRY.counter(
+    "tidb_tpu_build_cache_total",
+    "device-resident build-side cache lifecycle (hit | miss | evict | invalidate)",
+)
+
 # compressed, width-narrowed device tiles (PR 7): per-lane wire bytes by
 # the codec that produced them (dense | pack | dict | rle), and the rows
 # of padding every DeviceBatch still adds beyond its real row count —

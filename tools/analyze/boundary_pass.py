@@ -42,6 +42,13 @@ BOUNDARIES = {
         "MPPEngine.execute",
         "MPPEngine.prepare",
     },
+    "tidb_tpu/copr/tilecache.py": {
+        # PR 11 fused dispatch: a build-cache miss runs the level's
+        # build() closure — the LUT construction AND its h2d upload —
+        # from inside the statement's guarded_device_call frame; a
+        # blanket handler here would swallow typed device faults
+        "BuildSideCache.get",
+    },
     "tidb_tpu/executor/window_device.py": {
         "run_device_window",
         "run_cached_window",

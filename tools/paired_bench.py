@@ -51,6 +51,36 @@ def make_pt_session(n_tasks: int, rows_per_task: int):
     return s
 
 
+def paired_medians(run_a, run_b, reps: int, warmup: int = 1) -> dict:
+    """Generic paired A/B sampler (the noisy-box methodology of
+    run_paired_bench, without the point-agg workload baked in): run the
+    two thunks back-to-back per rep, order alternating, and report the
+    per-mode medians plus the median PAIRED delta — machine drift hits
+    both sides of a pair equally, so the delta stays honest while the
+    raw medians wander. `run_a`/`run_b` return their own elapsed seconds
+    (callers time inside, so per-sample setup like a cache flush stays
+    off the clock)."""
+    for _ in range(warmup):
+        run_a()
+        run_b()
+    a, b, deltas = [], [], []
+    for rep in range(reps):
+        if rep % 2 == 0:
+            ta, tb = run_a(), run_b()
+        else:
+            tb, ta = run_b(), run_a()
+        a.append(ta)
+        b.append(tb)
+        deltas.append(tb - ta)
+    return {
+        "p50_a_s": statistics.median(a),
+        "p50_b_s": statistics.median(b),
+        "paired_delta_p50_s": statistics.median(deltas),
+        "paired_ratio_p50": statistics.median(y / x for x, y in zip(a, b)),
+        "samples": reps,
+    }
+
+
 def run_paired_bench(session, set_mode, workload: str,
                      n_tasks: int = N_TASKS, rows_per_task: int = ROWS_PER_TASK,
                      reps: int = REPS, gate_pct: float = GATE_PCT) -> dict:

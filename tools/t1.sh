@@ -14,6 +14,7 @@
 #   tools/bench_watchdog_overhead.py -> BENCH_watchdog_pr4.json
 #   tools/bench_timeline_overhead.py -> BENCH_timeline_pr5.json
 #   tools/bench_tiles.py             -> BENCH_tiles_pr7.json
+#   tools/bench_mpp.py               -> BENCH_mpp_pr11.json
 cd "$(dirname "$0")/.." || exit 1
 # static analyzer suite (PR 9): lock-discipline, tls-bind, interrupt-gate,
 # registry-consistency, boundary-taxonomy — any finding not allowlisted
@@ -39,7 +40,7 @@ python -m tools.analyze $ANALYZE_ARGS || exit 1
 # soak (≥30 rounds) lives under `pytest -m slow` / crashpoint.py --rounds
 env JAX_PLATFORMS=cpu python tools/crashpoint.py --matrix --seed 7 || exit 1
 if [ "$RUN_BENCH" = "1" ]; then
-  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles; do
+  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
   done
 fi
