@@ -126,6 +126,16 @@ int wal_sync(void* h) {
     return fsync(w->fd);
 }
 
+// Drain the buffer WITHOUT fsync — the group-commit split: the caller
+// flushes under its append lock, then fsyncs wal_fd() OUTSIDE it so
+// concurrent committers keep appending while the group's fsync runs.
+int wal_flush(void* h) {
+    Wal* w = (Wal*)h;
+    return flush_buf(w) ? 0 : -1;
+}
+
+int wal_fd(void* h) { return ((Wal*)h)->fd; }
+
 void wal_close(void* h) {
     Wal* w = (Wal*)h;
     if (w == nullptr) return;

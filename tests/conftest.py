@@ -39,6 +39,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # pays zero overhead.
 _LOCK_HUNT_MODULES = {
     "test_chaos", "test_fault_domain", "test_watchdog", "test_mesh_dispatch",
+    # PR 13: concurrent committers + the wal/wal.group locks
+    "test_group_commit",
 }
 
 

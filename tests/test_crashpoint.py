@@ -57,6 +57,27 @@ class TestHarnessUnit:
         with pytest.raises(cp.Violation, match="PARTIAL"):
             cp._verify(ddir, str(tmp_path / "cdc.jsonl"), acks)
 
+    def test_checker_detects_falsely_acked_follower(self, tmp_path):
+        """Group-commit negative test: an ack printed for a txn group
+        that is NOT durable (the shape a buggy group commit would
+        produce — a follower acked although the leader's fsync never
+        covered it) must be caught by the checker. This is what keeps
+        the wal/group-sync-fail crashpoint honest."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+
+        ddir = str(tmp_path / "data")
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        s.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t_txn VALUES (70, 7, 3), (71, 7, 3), (72, 7, 3)")
+        s.store.wal.close()
+        # group 7 IS durable; the false ack claims group 8 too
+        acks = {"dml": set(), "txn": {7, 8}, "ddl": [], "ckpt": 0}
+        with pytest.raises(cp.Violation, match="acked txn group 8"):
+            cp._verify(ddir, str(tmp_path / "cdc.jsonl"), acks)
+
     def test_checker_detects_cdc_ahead_of_durable(self, tmp_path):
         from tidb_tpu.session import Session
         from tidb_tpu.storage.txn import Storage

@@ -1,0 +1,277 @@
+"""Group-commit WAL semantics (PR 13, ISSUE 13 satellite): concurrent
+committers batch into one leader fsync (observable via the group-size
+metrics), a KILL or deadline releases a follower wait cleanly through
+the shared interrupt gate (ack withheld, log healthy), a failed group
+sync withholds EVERY ack in the group and poisons the log (fsyncgate
+discipline unchanged), and `tidb_wal_group_commit=OFF` restores the
+per-commit-fsync behavior exactly."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import QueryInterrupted, StorageIOError
+from tidb_tpu.session import Session
+from tidb_tpu.storage.txn import Storage
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+def _mkstore(tmp_path) -> Storage:
+    return Storage(data_dir=str(tmp_path / "data"))
+
+
+def _commit_one(store: Storage, key: bytes) -> None:
+    t = store.begin()
+    t.put(key, b"v")
+    t.commit()
+
+
+class TestBatching:
+    def test_concurrent_committers_share_one_fsync(self, tmp_path):
+        """N threads committing concurrently produce follower outcomes
+        and a leader-observed group size > 1 — the batching proof."""
+        store = _mkstore(tmp_path)
+        _commit_one(store, b"warm")  # settle the first-leader path
+        f0 = M.WAL_GROUP_COMMIT.value(outcome="follower")
+        with M.WAL_GROUP_SIZE._lock:
+            n0, sum0 = M.WAL_GROUP_SIZE._n, M.WAL_GROUP_SIZE._sum
+
+        def worker(tid: int) -> None:
+            for i in range(40):
+                _commit_one(store, b"k%d-%d" % (tid, i))
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert M.WAL_GROUP_COMMIT.value(outcome="follower") > f0, \
+            "no commit ever rode another's fsync: group commit isn't grouping"
+        with M.WAL_GROUP_SIZE._lock:
+            dn, dsum = M.WAL_GROUP_SIZE._n - n0, M.WAL_GROUP_SIZE._sum - sum0
+        assert dn > 0 and dsum / dn > 1.0, \
+            f"leader-observed mean group size {dsum}/{dn} never exceeded 1"
+        store.wal.close()
+
+    def test_acked_commits_durable_after_reopen(self, tmp_path):
+        """acked => durable under group commit: every commit() that
+        returned is visible from a fresh Storage over the same dir."""
+        store = _mkstore(tmp_path)
+
+        def worker(tid: int) -> None:
+            for i in range(25):
+                _commit_one(store, b"d%d-%d" % (tid, i))
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        store.wal.close()
+        re = Storage(data_dir=str(tmp_path / "data"))
+        ts = re.tso.next()
+        for tid in range(6):
+            for i in range(25):
+                assert re.mvcc.get(b"d%d-%d" % (tid, i), ts) == b"v"
+        re.wal.close()
+
+
+class TestInterruptRelease:
+    def test_kill_releases_follower_wait(self, tmp_path):
+        """A session KILLed while waiting as a follower escapes within
+        the gate's poll tick: statement fails interrupted, ack withheld,
+        the log stays healthy and later commits succeed."""
+        store = _mkstore(tmp_path)
+        leader = Session(store)
+        victim = Session(store)
+        leader.execute("CREATE TABLE t (id INT PRIMARY KEY)")
+        # first hit only: the leader stalls mid-group-sync (signalling
+        # that it claimed the sync); the follower that piles up behind
+        # it is then KILLed mid-wait. Event-sequenced, not sleep-raced:
+        # the box may stall any thread for seconds under load.
+        claimed = threading.Event()
+
+        def stall():
+            claimed.set()
+            time.sleep(1.2)
+
+        FP.enable("wal/group-sync-fail", ("nth", 1, stall))
+        state: dict = {}
+
+        def run_leader():
+            t0 = time.perf_counter()
+            leader.execute("INSERT INTO t VALUES (1)")
+            state["leader_s"] = time.perf_counter() - t0
+
+        def run_victim():
+            assert claimed.wait(10), "leader never claimed the group sync"
+            try:
+                victim.execute("INSERT INTO t VALUES (2)")
+                state["victim"] = "ok"
+            except QueryInterrupted:
+                state["victim"] = "interrupted"
+            except Exception as e:  # noqa: BLE001 — assert on exact type below
+                state["victim"] = f"wrong: {type(e).__name__}"
+
+        tl = threading.Thread(target=run_leader)
+        tv = threading.Thread(target=run_victim)
+        tl.start()
+        tv.start()
+        claimed.wait(10)
+        deadline = time.time() + 8
+        while time.time() < deadline:  # victim registered in the group?
+            with store.wal._gc_cond:
+                if len(store.wal._group_targets) >= 2:
+                    break
+            time.sleep(0.01)
+        victim._killed = True
+        tv.join(timeout=15)
+        tl.join(timeout=15)
+        assert not tv.is_alive() and not tl.is_alive()
+        assert state["victim"] == "interrupted", state
+        assert state["leader_s"] >= 1.0  # the leader really did stall
+        assert not store.wal.poisoned and not store.io_degraded
+        # the interrupted commit is INDETERMINATE (leader's fsync covered
+        # its appended records) — never falsely acked, and the store
+        # keeps serving commits
+        probe = Session(store)
+        probe.execute("INSERT INTO t VALUES (3)")
+        assert probe.must_query("SELECT COUNT(*) FROM t WHERE id = 3") == [("1",)]
+        store.wal.close()
+
+    def test_deadline_releases_follower_wait(self, tmp_path):
+        """Statement-deadline variant at the Wal layer: a follower whose
+        deadline passes mid-wait raises the timeout interrupt."""
+        store = _mkstore(tmp_path)
+        wal = store.wal
+        claimed = threading.Event()
+
+        def stall():
+            claimed.set()
+            time.sleep(1.2)
+
+        FP.enable("wal/group-sync-fail", ("nth", 1, stall))
+        done = {}
+
+        def run_leader():
+            wal.append(b"L")
+            wal.sync_group()
+            done["leader"] = True
+
+        tl = threading.Thread(target=run_leader)
+        tl.start()
+        assert claimed.wait(10), "leader never claimed the group sync"
+        wal.append(b"F")
+        with pytest.raises(QueryInterrupted):
+            wal.sync_group(deadline=time.monotonic() + 0.2)
+        tl.join(timeout=15)
+        assert done.get("leader") and not wal.poisoned
+        store.wal.close()
+
+
+class TestFailedGroupSync:
+    def test_failed_group_sync_withholds_every_ack(self, tmp_path):
+        """EIO mid-group-sync: every committer in the group — leader AND
+        followers — raises StorageIOError; the log poisons, the store
+        degrades read-only, later commits fail loud, reads keep serving
+        (the PR 10 fsyncgate discipline, now for the whole group)."""
+        store = _mkstore(tmp_path)
+        _commit_one(store, b"before")
+        # a slow stall THEN the EIO on the same leader pass: the stall
+        # gives followers time to pile into the doomed group
+        FP.enable("wal/group-sync-fail", ("nth", 1, ("sleep", 0.5)))
+        results: list = []
+
+        def worker(tid: int) -> None:
+            try:
+                _commit_one(store, b"doomed-%d" % tid)
+                results.append(("acked", tid))
+            except StorageIOError:
+                results.append(("io", tid))
+            except Exception as e:  # noqa: BLE001 — assert on types below
+                results.append((f"wrong:{type(e).__name__}", tid))
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+        threads[0].start()
+        time.sleep(0.15)  # leader claims the sync and stalls
+        FP.enable("wal/io-error-sync", OSError(5, "injected EIO"))
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert not any(t.is_alive() for t in threads)
+        FP.disable_all()
+        outcomes = {r[0] for r in results}
+        assert outcomes == {"io"}, f"a committer in the failed group acked: {results}"
+        assert store.wal.poisoned and store.io_degraded
+        with pytest.raises(StorageIOError):
+            _commit_one(store, b"after")
+        # reads keep serving the pre-failure state
+        assert store.mvcc.get(b"before", store.tso.next()) == b"v"
+        assert M.WAL_GROUP_COMMIT.value(outcome="error") >= 1
+        store.wal.close()
+
+    def test_no_doomed_ack_survives_restart(self, tmp_path):
+        """The withheld acks were honest: after reopening the dir, the
+        pre-failure commit is durable; whatever subset of the doomed
+        group's records persisted is unacked territory (allowed), but
+        the store must recover writable."""
+        store = _mkstore(tmp_path)
+        _commit_one(store, b"before")
+        FP.enable("wal/io-error-sync", OSError(5, "injected EIO"))
+        with pytest.raises(StorageIOError):
+            _commit_one(store, b"doomed")
+        FP.disable_all()
+        store.wal.close()
+        re = Storage(data_dir=str(tmp_path / "data"))
+        assert re.mvcc.get(b"before", re.tso.next()) == b"v"
+        _commit_one(re, b"after")  # healthy media: writes restored
+        re.wal.close()
+
+
+class TestFallbackOff:
+    def test_off_restores_per_commit_sync_exactly(self, tmp_path):
+        """tidb_wal_group_commit=OFF: every commit calls Wal.sync() once
+        (the PR 10 per-commit path, bit-identical), and no leader or
+        follower outcome is recorded."""
+        store = _mkstore(tmp_path)
+        store.global_vars["tidb_wal_group_commit"] = "OFF"
+        calls = []
+        orig = store.wal.sync
+        store.wal.sync = lambda: calls.append(1) or orig()
+        l0 = M.WAL_GROUP_COMMIT.value(outcome="leader")
+        f0 = M.WAL_GROUP_COMMIT.value(outcome="follower")
+        o0 = M.WAL_GROUP_COMMIT.value(outcome="off")
+        for i in range(5):
+            _commit_one(store, b"off-%d" % i)
+        assert len(calls) == 5, "OFF must fsync once per commit"
+        assert M.WAL_GROUP_COMMIT.value(outcome="off") == o0 + 5
+        assert M.WAL_GROUP_COMMIT.value(outcome="leader") == l0
+        assert M.WAL_GROUP_COMMIT.value(outcome="follower") == f0
+        store.wal.sync = orig
+        store.wal.close()
+
+    def test_sysvar_is_global_only_and_live(self, tmp_path):
+        store = _mkstore(tmp_path)
+        s = Session(store)
+        from tidb_tpu.errors import TiDBError
+
+        with pytest.raises(TiDBError):
+            s.execute("SET tidb_wal_group_commit = OFF")
+        s.execute("SET GLOBAL tidb_wal_group_commit = OFF")
+        assert store.global_vars["tidb_wal_group_commit"] == "OFF"
+        o0 = M.WAL_GROUP_COMMIT.value(outcome="off")
+        s.execute("CREATE TABLE g (id INT PRIMARY KEY)")
+        s.execute("INSERT INTO g VALUES (1)")
+        assert M.WAL_GROUP_COMMIT.value(outcome="off") > o0
+        s.execute("SET GLOBAL tidb_wal_group_commit = ON")
+        store.wal.close()

@@ -292,6 +292,47 @@ class TestWireProtocol:
         assert rows == [("0", "10", "135"), ("1", "10", "145"), ("2", "10", "155")]
         client.query("DROP TABLE wire_agg")
 
+    def test_disconnect_rolls_back_open_txn_and_frees_locks(self, server):
+        """A dropped connection's open pessimistic txn is rolled back at
+        teardown (MySQL implicit-rollback-on-disconnect). Load-bearing
+        since the PR 13 liveness shield: while the txn is REGISTERED its
+        locks are TTL-unresolvable by design, so a connection that dies
+        without rollback would squat on its rows until the leak horizon
+        instead of the 3s lock TTL."""
+        import time as _time
+
+        a = MiniMySQLClient("127.0.0.1", server.port)
+        b = MiniMySQLClient("127.0.0.1", server.port)
+        try:
+            a.query("CREATE TABLE wire_dc (id INT PRIMARY KEY, v INT)")
+            a.query("INSERT INTO wire_dc VALUES (1, 10)")
+            a.query("SET tidb_txn_mode = pessimistic")
+            a.query("BEGIN")
+            a.query("UPDATE wire_dc SET v = 11 WHERE id = 1")  # row lock held
+            # hard-drop a's socket: no COM_QUIT, no ROLLBACK
+            a.sock.close()
+            # b must acquire the lock promptly once teardown runs — far
+            # below the lock-wait timeout, and the update must see the
+            # ROLLED BACK value (a's uncommitted write discarded)
+            b.query("SET tidb_txn_mode = pessimistic")
+            deadline = _time.time() + 10
+            while True:
+                try:
+                    b.query("BEGIN")
+                    kind, affected = b.query("UPDATE wire_dc SET v = v + 1 WHERE id = 1")
+                    b.query("COMMIT")
+                    assert (kind, affected) == ("ok", 1)
+                    break
+                except RuntimeError:
+                    b.query("ROLLBACK")
+                    assert _time.time() < deadline, \
+                        "dead connection's lock was never released"
+                    _time.sleep(0.1)
+            assert b.query("SELECT v FROM wire_dc WHERE id = 1")[1] == [("11",)]
+            b.query("DROP TABLE wire_dc")
+        finally:
+            b.close()
+
     def test_two_connections_share_storage(self, server):
         a = MiniMySQLClient("127.0.0.1", server.port)
         b = MiniMySQLClient("127.0.0.1", server.port)

@@ -125,12 +125,67 @@ class TestTxn:
         assert [k for k, _ in s.begin().scan(b"a", b"z")] == [b"b", b"c"]
 
     def test_resolve_crashed_txn(self):
-        """A lock left by a 'crashed' txn is resolved by readers after TTL."""
+        """A lock left by a 'crashed' txn is resolved by readers after TTL.
+        The dead writer uses a raw TSO value, not store.begin(): a
+        registered live txn's locks are TTL-shielded (mvcc.txn_live),
+        so 'crashed' means exactly 'not in the active registry'."""
+        s = Storage()
+        dead_ts = s.tso.next()
+        s.mvcc.prewrite([Mutation(OP_PUT, b"k", b"v")], b"k", dead_ts, ttl_ms=0)
+        snap = s.snapshot()
+        assert snap.get(b"k") is None  # resolves (rolls back) the dead lock
+
+    def test_commit_idempotent_after_resolver_rolled_forward(self):
+        """The bank-transfer race, distilled: txn Y commits its primary;
+        a blocked waiter resolves Y's SECONDARY forward (legitimate:
+        primary is committed); a newer txn X then locks that key; Y's
+        own phase-2 commit of the secondary must be IDEMPOTENT (TiKV
+        semantics), not TxnAborted('lock owned by X, not Y')."""
+        s = Storage()
+        ty = s.begin()
+        s.mvcc.prewrite(
+            [Mutation(OP_PUT, b"p", b"vp"), Mutation(OP_PUT, b"s", b"vs")],
+            b"p", ty.start_ts,
+        )
+        cts = s.tso.next()
+        s.mvcc.commit([b"p"], ty.start_ts, cts)  # primary committed
+        # a waiter blocked on the secondary resolves it via the primary
+        from tidb_tpu.storage.mvcc import Lock
+
+        lock = Lock.decode(s.kv.get(b"l" + b"s"))
+        assert s.mvcc.resolve_lock(b"s", lock, now_ms=0)  # rolled FORWARD
+        # a newer txn grabs the now-free secondary
+        tx = s.begin()
+        s.mvcc.prewrite([Mutation(OP_PUT, b"s", b"vx")], b"s", tx.start_ts)
+        # Y's own secondary commit arrives late: must be a no-op success
+        s.mvcc.commit([b"s"], ty.start_ts, cts)
+        assert s.mvcc.get(b"s", cts) == b"vs"  # Y's value at Y's commit_ts
+        # X's lock untouched — X can still commit
+        cx = s.tso.next()
+        s.mvcc.commit([b"s"], tx.start_ts, cx)
+        assert s.mvcc.get(b"s", s.tso.next()) == b"vx"
+
+    def test_live_txn_lock_not_stolen_after_ttl(self):
+        """A registered live txn's expired-TTL lock is NOT resolved away
+        (the bank-transfer race: a >TTL scheduler stall must not let a
+        waiter roll back a live owner); the owner still commits."""
         s = Storage()
         t = s.begin()
         s.mvcc.prewrite([Mutation(OP_PUT, b"k", b"v")], b"k", t.start_ts, ttl_ms=0)
-        snap = s.snapshot()
-        assert snap.get(b"k") is None  # resolves (rolls back) the dead lock
+        import time as _time
+
+        now_ms = int(_time.time() * 1000) + 60_000  # far past the TTL
+        raw = s.kv.get(b"l" + b"k")
+        assert raw is not None
+        from tidb_tpu.storage.mvcc import Lock
+
+        lock = Lock.decode(raw)
+        assert not s.mvcc.resolve_lock(b"k", lock, now_ms)
+        assert s.kv.get(b"l" + b"k") is not None, "live owner's lock was stolen"
+        cts = s.tso.next()
+        s.mvcc.commit([b"k"], t.start_ts, cts)
+        t.rollback()  # deregister the txn handle
+        assert s.mvcc.get(b"k", s.tso.next()) == b"v"
 
     def test_gc(self):
         s = Storage()

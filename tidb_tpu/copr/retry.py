@@ -66,6 +66,10 @@ BO_DEVICE = BackoffConfig("deviceTransient", 1.0, 200.0)
 # store's in-process latencies)
 COP_BACKOFF_BUDGET_MS = 2000.0
 
+# default jitter source for every Backoffer (GIL-serialized; interleaved
+# draws are fine for jitter)
+_SHARED_RNG = random.Random()
+
 
 class Backoffer:
     """Per-cop-task retry budget: every retriable fault calls
@@ -86,7 +90,11 @@ class Backoffer:
         self.slept_ms = 0.0
         self.attempts: dict[str, int] = {}
         self.errors: list[BaseException] = []
-        self._rng = rng or random.Random()
+        # shared module RNG by default: seeding a fresh Random() per
+        # statement costs ~80µs of os.urandom — pure hot-path churn for
+        # backoff jitter nobody needs to be independent (tests that want
+        # determinism still pass their own rng)
+        self._rng = rng or _SHARED_RNG
         self._stats = stats  # optional callable(key, n) — client counters
         self._runaway = None  # RunawayChecker, for in-flight COOLDOWN
         self._demote_applied = False

@@ -501,14 +501,13 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None, variables=None) -> Non
         hc = table.handle_col()
         if hc is not None and hc.offset in vis_by_off:
             pk_vis = vis_by_off[hc.offset]
-    ha = None
-    if pk_vis is not None and conds:
-        ha = ranger.detach_handle_conditions(conds, table.id, pk_vis)
-        if ha is not None and ha.point_handles is not None:
-            ds.path = "point"
-            ds.point_handles = ha.point_handles
-            _drop_conds(ds, ha.access_conds)
-            return
+    # detection shared with the DML point path (session._scan_matching_rows)
+    ha = ranger.detach_pk_handle_access(table, conds)
+    if ha is not None and ha.point_handles is not None:
+        ds.path = "point"
+        ds.point_handles = ha.point_handles
+        _drop_conds(ds, ha.access_conds)
+        return
 
     # 2. secondary indexes — gather candidates (USE_INDEX restricts,
     # IGNORE_INDEX excludes — ref: planner/core hint handling)

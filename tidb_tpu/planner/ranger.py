@@ -305,6 +305,28 @@ class HandleAccess:
     access_conds: list[Expression]
 
 
+def detach_pk_handle_access(table, conds: list[Expression]) -> HandleAccess | None:
+    """Clustered-int-pk access detection for a table whose expressions
+    were built over its VISIBLE columns in order (the DataSource scope
+    and the DML WHERE scope are both exactly that): map the handle
+    column to its visible index and detach the handle conditions. The
+    ONE definition both the SELECT point path (optimizer
+    `_choose_for_ds`) and the DML point path (`_scan_matching_rows`)
+    use — a change to handle detection lands in both or neither."""
+    if not getattr(table, "pk_is_handle", False) or not conds:
+        return None
+    hc = table.handle_col()
+    if hc is None:
+        return None
+    pk_vis = next(
+        (i for i, c in enumerate(table.visible_columns()) if c.offset == hc.offset),
+        None,
+    )
+    if pk_vis is None:
+        return None
+    return detach_handle_conditions(conds, table.id, pk_vis)
+
+
 def detach_handle_conditions(
     conds: list[Expression], table_id: int, pk_offset: int
 ) -> HandleAccess | None:

@@ -108,12 +108,27 @@ def read_lenc_int(buf: bytes, pos: int) -> tuple[int, int]:
 
 
 class PacketIO:
-    """4-byte-header packet framing over a socket (ref: packetio.go)."""
+    """4-byte-header packet framing over a socket (ref: packetio.go).
+
+    Writes are BUFFERED: `write_packet` frames into an in-memory buffer
+    and `flush()` ships the whole response in one `sendall`. A point
+    select's response is five MySQL packets — five separate `send(2)`
+    calls used to mean five syscalls and, with Nagle + delayed ACK, tens
+    of milliseconds of tail latency per statement; one writev-sized send
+    is the classic front-door fix (the reference buffers through
+    bufio.Writer and flushes per command the same way). Flushing happens
+    per dispatched command (server.py) and at the handshake; the buffer
+    also flushes itself beyond _AUTOFLUSH bytes so huge resultsets don't
+    balloon memory."""
+
+    _AUTOFLUSH = 1 << 18  # 256KB: cap buffered resultset bytes
 
     def __init__(self, sock):
         self.sock = sock
         self.seq = 0
         self.max_allowed_packet = 64 << 20  # max_allowed_packet sysvar
+        self._wbuf: list[bytes] = []
+        self._wbuf_n = 0
 
     def read_packet(self) -> bytes:
         out = b""
@@ -141,14 +156,23 @@ class PacketIO:
         return out
 
     def write_packet(self, payload: bytes) -> None:
-        out = b""
         while True:
             chunk = payload[:0xFFFFFF]
             payload = payload[0xFFFFFF:]
-            out += struct.pack("<I", len(chunk))[:3] + bytes([self.seq]) + chunk
+            self._wbuf.append(struct.pack("<I", len(chunk))[:3] + bytes([self.seq]) + chunk)
+            self._wbuf_n += 4 + len(chunk)
             self.seq = (self.seq + 1) % 256
             if len(chunk) < 0xFFFFFF:
                 break  # a full-size chunk demands a (possibly empty) follow-up
+        if self._wbuf_n >= self._AUTOFLUSH:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._wbuf:
+            return
+        out = b"".join(self._wbuf)
+        self._wbuf.clear()
+        self._wbuf_n = 0
         self.sock.sendall(out)
 
     def reset_seq(self) -> None:

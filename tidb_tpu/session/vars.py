@@ -163,6 +163,17 @@ _sv("tidb_timeline_ring_capacity", "8192", scope="global", kind="int", lo=64,
 _sv("tidb_wal_recovery_mode", "tolerate-torn-tail", scope="global", kind="enum",
     enum=("tolerate-torn-tail", "absolute", "drop-corrupt"), consumed=True)
 
+# --- group-commit WAL (PR 13) ----------------------------------------------
+# ON (default): concurrent committers batch their WAL fsyncs into one —
+# every committer appends, one leader fsyncs for the whole group, the
+# followers wait on the flushed sequence (KILL/deadline release the wait
+# through the shared interrupt gate; a failed group sync withholds EVERY
+# ack in the group and poisons the log per the fsyncgate discipline).
+# OFF recovers the exact PR 10 per-commit-fsync behavior live — the A/B
+# baseline for tools/bench_serve.py and the incident fallback.
+# GLOBAL-only: the durability protocol is a store-wide property.
+_sv("tidb_wal_group_commit", "ON", scope="global", kind="bool", consumed=True)
+
 # --- mesh-wide cop dispatch (PR 6) -----------------------------------------
 # dispatch width over the device mesh: cop tasks place onto the first N
 # runner lanes (0 = every device). Serving knob for hosts whose backend

@@ -108,6 +108,17 @@ class MVCCStore:
         self.runs: list = []  # Run segments, ascending commit_ts
         # data-version counters per table-prefix space are maintained above
         # (storage.Storage) — the MVCC layer stays schema-agnostic.
+        # liveness hook (start_ts -> bool), installed by the owning
+        # Storage: the in-process analog of the reference's txn TTL
+        # heartbeat. check_txn_status consults it before TTL-expiring a
+        # primary lock — a CPU-starved but LIVE transaction must not have
+        # its locks stolen by an impatient waiter (the bank-transfer
+        # flake: a >TTL scheduler stall between lock acquisition and
+        # commit let a sibling roll back a live txn, which then died with
+        # TxnAborted instead of the retryable contract errors). Orphans
+        # stay resolvable: a crashed process's recovered locks, and
+        # simulated dead txns using raw TSO values, are not registered.
+        self.txn_live = None
 
     # --- reads ------------------------------------------------------------
 
@@ -387,6 +398,15 @@ class MVCCStore:
                     raise TxnAborted(f"commit of missing lock, txn {start_ts}")
                 lock = Lock.decode(raw)
                 if lock.start_ts != start_ts:
+                    # a resolver may have rolled this key FORWARD already
+                    # (our primary was committed, a blocked reader/writer
+                    # resolved the secondary via check_txn_status) and a
+                    # NEWER txn locked it since — commit is idempotent on
+                    # an already-committed key (TiKV semantics); only a
+                    # foreign lock with NO write record of ours is abort
+                    st = self._find_txn_write(key, start_ts)
+                    if st is not None and st.op != OP_ROLLBACK:
+                        continue
                     raise TxnAborted(f"lock owned by {lock.start_ts}, not {start_ts}")
                 op = OP_PUT if lock.op == OP_PUT else (OP_DEL if lock.op == OP_DEL else OP_LOCK)
                 self.kv.put(_wk(key, commit_ts), WriteRecord(op, start_ts).encode())
@@ -427,6 +447,12 @@ class MVCCStore:
                 # active txns aren't rolled back by impatient waiters
                 base = max(start_ts, lock.for_update_ts)
                 if TSO.physical_ms(base) + lock.ttl_ms < now_ms:
+                    live = self.txn_live
+                    if live is not None and live(start_ts):
+                        # owner is a LIVE registered txn: an expired TTL
+                        # means a slow owner, not an abandoned one — keep
+                        # the lock; the waiter's own deadline bounds it
+                        return "locked", lock.ttl_ms
                     self.rollback([primary], start_ts)
                     return "rolled_back", 0
                 return "locked", lock.ttl_ms

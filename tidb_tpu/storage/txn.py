@@ -363,6 +363,7 @@ class Storage:
         self._io_degraded = False
         self.kv = MemKV()
         self.mvcc = MVCCStore(self.kv)
+        self.mvcc.txn_live = self.txn_is_active
         self.tso = TSO()
         # SET GLOBAL overrides: seed new sessions, serve @@global.x reads
         self.global_vars: dict[str, str] = {}
@@ -737,8 +738,30 @@ class Storage:
             self.wal.sync()
 
     def wal_sync(self) -> None:
-        if self.wal is not None:
-            self.wal.sync()
+        """Commit durability point. Default: group commit — concurrent
+        committers batch into one leader fsync (`Wal.sync_group`), with
+        the follower wait released through the shared interrupt gate.
+        `SET GLOBAL tidb_wal_group_commit = OFF` recovers the exact
+        per-commit-fsync behavior live (incident fallback)."""
+        wal = self.wal
+        if wal is None:
+            return
+        if self.global_vars.get("tidb_wal_group_commit", "ON") != "ON":
+            from ..utils import metrics as M
+
+            wal.sync()
+            M.WAL_GROUP_COMMIT.inc(outcome="off")
+            return
+        # the committing statement's session/deadline (if any) let a KILL
+        # or max_execution_time release the follower wait; the commit is
+        # then INDETERMINATE (the leader's fsync may still land it) — the
+        # PR 10 contract for an error at the durability point, never a
+        # false ack
+        from ..executor.executors import _ACTIVE_SESSION
+
+        session = _ACTIVE_SESSION.get()
+        deadline = getattr(session, "_deadline", None) if session is not None else None
+        wal.sync_group(session=session, deadline=deadline)
 
     def checkpoint(self) -> None:
         """Compact the WAL into an atomic snapshot file (the storage
@@ -894,6 +917,18 @@ class Storage:
     def _txn_done(self, start_ts: int) -> None:
         with self._active_lock:
             self._active_starts.pop(start_ts, None)
+
+    def txn_is_active(self, start_ts: int) -> bool:
+        """Is `start_ts` a LIVE transaction of this process? The MVCC
+        layer's `txn_live` hook: lock resolution must not TTL-expire a
+        slow-but-alive owner's locks (the in-process stand-in for the
+        reference's txn heartbeat). Entries past MAX_TXN_PIN_S read as
+        dead, like the GC clamp — a leaked Txn object stops shielding
+        its locks at the same horizon it stops pinning the safepoint."""
+        horizon = time.time() - self.MAX_TXN_PIN_S
+        with self._active_lock:
+            t0 = self._active_starts.get(start_ts)
+        return t0 is not None and t0 >= horizon
 
     def min_active_start_ts(self) -> int | None:
         """Oldest live transaction start-ts, or None. Entries pinned longer

@@ -11,6 +11,15 @@ Record payloads (framing/CRC live in C++; payloads are ours):
   b'X' u32 slen start u32 elen end delete_range
   b'R' run: u32 w, u64 n, u64 commit_ts, key_mat, starts, lens, vbuf
 
+Group commit (PR 13): `sync_group` batches concurrent committers'
+fsyncs — every committer appends its records, then ONE leader runs the
+fsync for the whole group while followers wait on the flushed sequence
+number. A failed group sync withholds EVERY ack in the group (leader and
+followers all raise `StorageIOError`) and poisons the log exactly like a
+per-commit fsync failure would. `tidb_wal_group_commit=OFF` routes
+`Storage.wal_sync` back to plain `sync()` — bit-identical per-commit
+behavior — as the live incident fallback.
+
 Failure discipline (the durability fault domain, PR 10):
 
   * IO failure — ONE failed append or fsync poisons the `Wal` (the
@@ -71,6 +80,10 @@ def _load_lib() -> ctypes.CDLL:
         lib.wal_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
         lib.wal_sync.restype = ctypes.c_int
         lib.wal_sync.argtypes = [ctypes.c_void_p]
+        lib.wal_flush.restype = ctypes.c_int
+        lib.wal_flush.argtypes = [ctypes.c_void_p]
+        lib.wal_fd.restype = ctypes.c_int
+        lib.wal_fd.argtypes = [ctypes.c_void_p]
         lib.wal_close.argtypes = [ctypes.c_void_p]
         lib.wal_abort.argtypes = [ctypes.c_void_p]
         lib.wal_replay_open.restype = ctypes.c_void_p
@@ -111,6 +124,22 @@ class Wal:
         self._lock = threading.Lock()
         self.poisoned = False
         self.on_io_error = on_io_error
+        # --- group commit (PR 13) -----------------------------------------
+        # `_appended_seq` counts records accepted (guarded by `_lock`, like
+        # the append itself); `_flushed_seq` is the highest count known
+        # durably fsynced (guarded by `_gc_cond`). A committer's records
+        # are all <= the seq it reads AFTER its last append, so waiting
+        # for `_flushed_seq >= that` waits for exactly its durability.
+        self._gc_cond = threading.Condition()
+        self._appended_seq = 0
+        self._flushed_seq = 0
+        self._sync_leader = False  # a group fsync is in flight
+        # targets of committers currently waiting for durability: a
+        # fsync that covers a target satisfies that committer, and the
+        # covering leader counts exactly those for the group-size metric
+        # (entrants arriving mid-fsync with later targets stay queued
+        # for the NEXT group instead of being silently absorbed)
+        self._group_targets: list[int] = []
 
     def _io_failed(self, op: str, cause) -> None:
         """First failure poisons the log; callers see a typed error."""
@@ -142,22 +171,130 @@ class Wal:
                 self._io_failed("append", e)
             if self.lib.wal_append(self._h, payload, len(payload)) < 0:
                 self._io_failed("append", "native append error")
+            self._appended_seq += 1
         # durability-gap crashpoint: record buffered, nothing fsynced yet
         _fp("wal/after-append-before-sync")
 
-    def sync(self) -> None:
+    def sync(self) -> int:
+        """Flush + fsync everything appended so far. Returns the record
+        sequence the fsync covered (appends hold the same lock, so the
+        count read after a successful fsync IS the durable high-water).
+        Publishes the covered sequence to the group-commit state, so a
+        per-commit sync (OFF mode, checkpoint) releases any concurrent
+        group waiters it covered and the next group leader doesn't
+        re-fsync already-durable records."""
         _fp("wal/before-sync")
         with self._lock:
             if self.poisoned:
                 self._io_failed("sync", "log already poisoned")
             if self._h is None:
-                return  # closed: close() already flushed + fsynced
+                covered = self._appended_seq  # closed: close() flushed + fsynced
+            else:
+                try:
+                    _fp("wal/io-error-sync")
+                except OSError as e:
+                    self._io_failed("sync", e)
+                if self.lib.wal_sync(self._h) != 0:
+                    self._io_failed("sync", "native fsync error")
+                covered = self._appended_seq
+        with self._gc_cond:
+            if covered > self._flushed_seq:
+                self._flushed_seq = covered
+            # waiters this fsync satisfied leave the queue uncounted —
+            # the size histogram is leader-observed groups only
+            self._group_targets = [t for t in self._group_targets if t > covered]
+            self._gc_cond.notify_all()
+        return covered
+
+    def sync_group(self, session=None, deadline=None) -> None:
+        """Group-commit durability point: wait until everything this
+        committer appended is fsynced, batching concurrent committers
+        into one fsync.
+
+        One leader at a time runs the real `sync()`; everyone else waits
+        on `_flushed_seq`. The wait polls the shared interrupt gate, so a
+        KILL or statement deadline releases a follower cleanly — its ack
+        is withheld (the commit is indeterminate: the leader's fsync may
+        still land it), never falsified. A failed group sync poisons the
+        log; the leader raises from `sync()` and every follower observes
+        `poisoned` and raises too — no ack in the group survives."""
+        with self._lock:
+            target = self._appended_seq
+        with self._gc_cond:
+            if self._flushed_seq >= target:
+                M.WAL_GROUP_COMMIT.inc(outcome="follower")
+                return  # an earlier leader already covered our records
+            self._group_targets.append(target)
+            while True:
+                if self.poisoned:
+                    self._io_failed("sync", "group sync failed; ack withheld")
+                if self._flushed_seq >= target:
+                    M.WAL_GROUP_COMMIT.inc(outcome="follower")
+                    return
+                if not self._sync_leader:
+                    self._sync_leader = True
+                    break  # this committer leads; all paths below are leader-only
+                self._gc_cond.wait(0.05)
+                if session is not None or deadline is not None:
+                    from ..sched.scheduler import raise_if_interrupted
+
+                    raise_if_interrupted(session, deadline)
+        # --- leader: flush under the append lock, fsync OUTSIDE it — the
+        # whole point of the group: committers keep appending (and piling
+        # into the next group) while this group's fsync runs
+        covered = -1
+        try:
             try:
-                _fp("wal/io-error-sync")
+                # EIO/crash injection mid-group-sync: records appended
+                # (possibly flushed), fsync not yet run — no committer in
+                # the group may ack past this point on failure
+                _fp("wal/group-sync-fail")
             except OSError as e:
                 self._io_failed("sync", e)
-            if self.lib.wal_sync(self._h) != 0:
-                self._io_failed("sync", "native fsync error")
+            _fp("wal/before-sync")
+            fd = -1
+            with self._lock:
+                if self.poisoned:
+                    self._io_failed("sync", "log already poisoned")
+                if self._h is not None:
+                    try:
+                        _fp("wal/io-error-sync")
+                    except OSError as e:
+                        self._io_failed("sync", e)
+                    if self.lib.wal_flush(self._h) != 0:
+                        self._io_failed("sync", "native flush error")
+                    # dup so a concurrent close() can't invalidate the fd
+                    # between releasing the lock and the fsync below
+                    fd = os.dup(self.lib.wal_fd(self._h))
+                high = self._appended_seq
+            if fd >= 0:
+                try:
+                    os.fsync(fd)
+                except OSError as e:
+                    self._io_failed("sync", e)
+                finally:
+                    os.close(fd)
+            covered = high
+        finally:
+            with self._gc_cond:
+                self._sync_leader = False
+                if covered >= 0:
+                    self._flushed_seq = max(self._flushed_seq, covered)
+                    # the group = exactly the registered committers this
+                    # fsync covered (leader included); later targets stay
+                    # queued for the next leader
+                    n = sum(1 for t in self._group_targets if t <= covered)
+                    self._group_targets = [t for t in self._group_targets if t > covered]
+                    M.WAL_GROUP_COMMIT.inc(outcome="leader")
+                    if n:
+                        M.WAL_GROUP_SIZE.observe(n)
+                else:
+                    # failed group sync: the log is poisoned, the whole
+                    # queue will observe `poisoned` and raise — the
+                    # group's acks are withheld, its targets moot
+                    self._group_targets.clear()
+                    M.WAL_GROUP_COMMIT.inc(outcome="error")
+                self._gc_cond.notify_all()
 
     def close(self) -> None:
         with self._lock:

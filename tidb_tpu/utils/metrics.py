@@ -468,3 +468,19 @@ WAL_RECOVERY_DROPPED = REGISTRY.counter(
     "tidb_wal_recovery_dropped_bytes_total",
     "log bytes recovery discarded, by kind (torn tail | corrupt frames under drop-corrupt)",
 )
+
+# --- group-commit WAL (PR 13: Wal.sync_group serving-scale OLTP) -----------
+# each commit's durability point counts once: `leader` ran the group's
+# fsync, `follower` rode a leader's fsync (including already-covered
+# fast-path returns), `off` took the per-commit fallback
+# (tidb_wal_group_commit=OFF), `error` marks a failed group sync (the
+# whole group's acks withheld, log poisoned)
+WAL_GROUP_COMMIT = REGISTRY.counter(
+    "tidb_wal_group_commit_total",
+    "commit durability points by group-commit outcome (leader | follower | off | error)",
+)
+WAL_GROUP_SIZE = REGISTRY.histogram(
+    "tidb_wal_group_commit_size",
+    "committers covered by one group fsync (observed by the leader)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)

@@ -241,6 +241,7 @@ def _targets():
     from tidb_tpu.storage import memkv as _memkv
     from tidb_tpu.storage import regions as _regions
     from tidb_tpu.storage import tso as _tso
+    from tidb_tpu.storage import wal as _wal
     from tidb_tpu.utils import failpoint as _failpoint
     from tidb_tpu.utils import memory as _memory
     from tidb_tpu.utils import metrics as _metrics
@@ -274,6 +275,8 @@ def _targets():
         (_failpoint.Failpoints, "_lock", "failpoint", False),
         (_stmtstats.StmtStats, "_lock", "stmtstats", False),
         (_memkv.MemKV, "lock", "memkv", False),
+        (_wal.Wal, "_lock", "wal", False),
+        (_wal.Wal, "_gc_cond", "wal.group", True),
         (_regions.RegionMap, "_lock", "regions", False),
         (_tso.TSO, "_lock", "tso", False),
         (_detector.DeadlockDetector, "_lock", "detector", False),

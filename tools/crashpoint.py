@@ -31,6 +31,9 @@ Named crashpoints (failpoint action ("crash",) → os._exit inside the
 child; the parent asserts exit code 137, proving the site actually fired):
 
     wal/after-append-before-sync      record buffered, nothing fsynced
+    wal/group-sync-fail               mid-group-sync: the whole group's
+                                      records appended, leader fsync not
+                                      run — NO follower may have acked
     txn/between-prewrite-and-commit   locks durable, commit record not
     checkpoint/after-snap-rename      snapshot renamed, log not rotated
     checkpoint/before-old-unlink      both epochs' logs present
@@ -67,6 +70,7 @@ CRASH_EXIT = 137  # the ("crash",) failpoint default exit code
 CRASHPOINTS = {
     # site → nth-hit trigger (armed AFTER setup so the schema exists)
     "wal/after-append-before-sync": 60,
+    "wal/group-sync-fail": 25,
     "txn/between-prewrite-and-commit": 4,
     "checkpoint/after-snap-rename": 2,
     "checkpoint/before-old-unlink": 2,

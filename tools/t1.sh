@@ -15,6 +15,9 @@
 #   tools/bench_timeline_overhead.py -> BENCH_timeline_pr5.json
 #   tools/bench_tiles.py             -> BENCH_tiles_pr7.json
 #   tools/bench_mpp.py               -> BENCH_mpp_pr11.json
+#   tools/bench_serve.py             -> BENCH_serve_pr13.json
+# (bench_serve: 32 socket clients; gates the storage-layer group-commit
+# ratio >= 3x, the front-door paired ratio + p99, and fairness)
 cd "$(dirname "$0")/.." || exit 1
 # static analyzer suite (PR 9): lock-discipline, tls-bind, interrupt-gate,
 # registry-consistency, boundary-taxonomy — any finding not allowlisted
@@ -40,7 +43,7 @@ python -m tools.analyze $ANALYZE_ARGS || exit 1
 # soak (≥30 rounds) lives under `pytest -m slow` / crashpoint.py --rounds
 env JAX_PLATFORMS=cpu python tools/crashpoint.py --matrix --seed 7 || exit 1
 if [ "$RUN_BENCH" = "1" ]; then
-  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp; do
+  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp bench_serve; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
   done
 fi
