@@ -41,6 +41,9 @@ _LOCK_HUNT_MODULES = {
     "test_chaos", "test_fault_domain", "test_watchdog", "test_mesh_dispatch",
     # PR 13: concurrent committers + the wal/wal.group locks
     "test_group_commit",
+    # PR 14: the ship tap under the wal append lock, the standby and
+    # failover serializers, semi-sync waits
+    "test_standby", "test_wal_failover",
 }
 
 

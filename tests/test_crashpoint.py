@@ -101,6 +101,64 @@ class TestHarnessUnit:
             cp._verify(ddir, str(cdc), acks)
 
 
+class TestStandbyCheckerNegative:
+    """The standby verifier must be green because the replication
+    invariants HOLD, not because it checks nothing."""
+
+    def _primary(self, tmp_path):
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+
+        ddir = str(tmp_path / "data")
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        s.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+        return s
+
+    def test_dropped_shipped_frame_is_caught(self, tmp_path):
+        """Semi-sync negative test: an acked commit whose frames never
+        reached the standby (the shape a buggy shipper would produce)
+        must be flagged on the promoted standby."""
+        from tidb_tpu.storage.ship import WalShipper
+
+        s = self._primary(tmp_path)
+        s.execute("INSERT INTO t_dml VALUES (0, 0), (1, 3)")
+        ship = WalShipper(s.store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        # the "dropped frame": this acked row is never shipped (the tap
+        # queue is simply never drained — attach() never runs)
+        s.execute("INSERT INTO t_dml VALUES (2, 6)")
+        s.store.wal.close()
+        acks = {"dml": {0, 1, 2}, "txn": set(), "ddl": [], "ckpt": 0}
+        primary = cp._verify(str(tmp_path / "data"), str(tmp_path / "cdc.jsonl"), acks)
+        with pytest.raises(cp.Violation, match="semi-sync acked DML row 2"):
+            cp._verify_standby(str(tmp_path / "standby"), primary, acks, semi_sync=True)
+
+    def test_standby_ahead_is_caught(self, tmp_path):
+        """A standby holding a row the primary's durable state lacks is
+        AHEAD — the invariant the durable-frames-only ship discipline
+        exists for."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+
+        s = self._primary(tmp_path)
+        s.execute("INSERT INTO t_dml VALUES (0, 0)")
+        s.store.wal.close()
+        # fabricate an "ahead" standby: same schema, one extra row
+        sd = str(tmp_path / "standby")
+        s2 = Session(Storage(data_dir=sd))
+        s2.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s2.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        s2.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+        s2.execute("INSERT INTO t_dml VALUES (0, 0), (99, 297)")
+        s2.store.wal.close()
+        acks = {"dml": {0}, "txn": set(), "ddl": [], "ckpt": 0}
+        primary = cp._verify(str(tmp_path / "data"), str(tmp_path / "cdc.jsonl"), acks)
+        with pytest.raises(cp.Violation, match="AHEAD of primary durable state"):
+            cp._verify_standby(sd, primary, acks, semi_sync=False)
+
+
 class TestRealProcessCrash:
     def test_named_crashpoint_round(self):
         """One full spawn→crash→verify cycle in tier-1: the commit-gap
@@ -122,6 +180,22 @@ class TestRealProcessCrash:
         failures = []
         for i in range(30):
             ok, detail = cp.run_round(None, seed=seed + i)
+            if not ok:
+                failures.append(f"round {i} (seed {seed + i}): {detail}")
+        assert not failures, "\n".join(failures)
+
+    @pytest.mark.slow
+    def test_failover_soak_30_rounds(self):
+        """Kill-primary→promote soak (PR 14): every round runs the full
+        workload with an in-process semi-sync standby, SIGKILLs at a
+        seeded random delay, then verifies the primary invariants AND
+        the promoted standby (acked ⇒ visible there; never ahead)."""
+        seed = int(os.environ.get("CRASHPOINT_SEED", "777000"))
+        print(f"\nfailover soak seed={seed} (replay: CRASHPOINT_SEED={seed})")
+        failures = []
+        for i in range(30):
+            ok, detail = cp.run_round(None, seed=seed + i, standby=True,
+                                      semi_sync=True)
             if not ok:
                 failures.append(f"round {i} (seed {seed + i}): {detail}")
         assert not failures, "\n".join(failures)

@@ -113,3 +113,95 @@ class TestPreparedEdges:
         s.execute("PREPARE p FROM 'SELECT * FROM t WHERE id = ?'")
         with pytest.raises(Exception):
             s.execute("EXECUTE p USING 5")
+
+
+class TestStatementIdPlanCache:
+    """PR 14: prepared executions skip the optimizer on repeats — the
+    plan cache keys on the prepared statement's identity, parameter
+    slots mutate in place, and only the value-derived access info
+    (point handles / key ranges) is re-derived per execute."""
+
+    def _count_optimize(self, monkeypatch):
+        import tidb_tpu.session.session as sess_mod
+
+        calls = [0]
+        orig = sess_mod.optimize
+
+        def counting(plan, *a, **k):
+            calls[0] += 1
+            return orig(plan, *a, **k)
+
+        monkeypatch.setattr(sess_mod, "optimize", counting)
+        return calls
+
+    def test_execute_repeats_skip_optimizer(self, s, monkeypatch):
+        s.execute("SET tidb_enable_auto_analyze = OFF")
+        s.execute("PREPARE p FROM 'SELECT name FROM t WHERE id = ?'")
+        s.execute("SET @a = 1")
+        s.must_query("EXECUTE p USING @a")  # warm: plans once, caches
+        calls = self._count_optimize(monkeypatch)
+        for i in (3, 17, 42):
+            s.execute(f"SET @a = {i}")
+            assert s.must_query("EXECUTE p USING @a") == [(f"n{i}",)]
+        assert calls[0] == 0, f"repeats re-ran the optimizer {calls[0]}x"
+        assert s.plan_cache_hits >= 3
+
+    def test_wire_stmt_execute_repeats_skip_optimizer(self, s, monkeypatch):
+        from tidb_tpu.parser import parse_one
+        from tidb_tpu.server.server import _py_to_constant
+
+        s.execute("SET tidb_enable_auto_analyze = OFF")
+        parsed = parse_one("SELECT g FROM t WHERE id = ?")
+        s.execute_prepared_ast(parsed, [_py_to_constant(0)], sql="q")  # warm
+        calls = self._count_optimize(monkeypatch)
+        for i in (5, 23, 44):
+            rs = s.execute_prepared_ast(parsed, [_py_to_constant(i)], sql="q")
+            assert rs.rows() == [(str(i % 5),)]
+        assert calls[0] == 0
+
+    def test_index_range_rebind(self, s):
+        s.execute("SET tidb_enable_auto_analyze = OFF")
+        s.execute("CREATE INDEX ig ON t (g)")
+        s.execute("PREPARE p FROM 'SELECT id FROM t WHERE g = ? ORDER BY id'")
+        for k in range(5):
+            s.execute(f"SET @g = {k}")
+            got = [int(r[0]) for r in s.must_query("EXECUTE p USING @g")]
+            assert got == [i for i in range(50) if i % 5 == k]
+
+    def test_shape_change_replans_correctly(self, s):
+        """A value that stops the access conds being sargable (float on
+        an int pk) must drop the cached plan and still answer right."""
+        from tidb_tpu.parser import parse_one
+        from tidb_tpu.server.server import _py_to_constant
+
+        parsed = parse_one("SELECT name FROM t WHERE id = ?")
+        assert s.execute_prepared_ast(parsed, [_py_to_constant(3)], sql="q").rows() \
+            == [("n3",)]
+        assert s.execute_prepared_ast(parsed, [_py_to_constant(3.5)], sql="q").rows() \
+            == []
+        assert s.execute_prepared_ast(parsed, [_py_to_constant(4)], sql="q").rows() \
+            == [("n4",)]
+
+    def test_param_type_flip_gets_its_own_plan(self, s):
+        from tidb_tpu.parser import parse_one
+        from tidb_tpu.server.server import _py_to_constant
+
+        parsed = parse_one("SELECT COUNT(*) FROM t WHERE name = ?")
+        assert s.execute_prepared_ast(parsed, [_py_to_constant("n7")], sql="q").rows() \
+            == [("1",)]
+        # int param against a varchar column: different type signature,
+        # distinct plan entry, still correct (no match)
+        assert s.execute_prepared_ast(parsed, [_py_to_constant(12345)], sql="q").rows() \
+            == [("0",)]
+        assert s.execute_prepared_ast(parsed, [_py_to_constant("n9")], sql="q").rows() \
+            == [("1",)]
+
+    def test_ddl_invalidates_prepared_plans(self, s):
+        s.execute("PREPARE p FROM 'SELECT name FROM t WHERE id = ?'")
+        s.execute("SET @a = 7")
+        assert s.must_query("EXECUTE p USING @a") == [("n7",)]
+        s.execute("UPDATE t SET name = 'renamed' WHERE id = 7")
+        assert s.must_query("EXECUTE p USING @a") == [("renamed",)]
+        s.execute("CREATE INDEX iname ON t (name)")  # schema version bump
+        s.execute("SET @a = 8")
+        assert s.must_query("EXECUTE p USING @a") == [("n8",)]

@@ -1,0 +1,372 @@
+"""Warm-standby WAL shipping (PR 14, storage/ship.py): bootstrap,
+continuous replay, stale reads at the applied watermark, semi-sync
+commits, ADMIN PROMOTE and the lifecycle edges (promote mid-frame,
+double promote, subscribe-after-checkpoint, KILL through the shared
+interrupt gate), plus the socket transport's CRC discipline and
+auto-promotion when the primary degrades without spare media."""
+
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import pytest
+
+from tidb_tpu.errors import (
+    CommitIndeterminateError,
+    QueryInterrupted,
+    StandbyReadOnly,
+    StorageIOError,
+    TiDBError,
+)
+from tidb_tpu.session import Session
+from tidb_tpu.storage.ship import (
+    _ACK,
+    _FRAME_HDR,
+    _TAG_FRAME,
+    _TAG_SYNC,
+    StandbyServer,
+    WalShipper,
+    frame_commit_ts,
+    frame_table_prefix,
+)
+from tidb_tpu.storage.txn import Storage
+from tidb_tpu.storage.wal import rec_put, rec_run
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+def _mk_primary(tmp_path, name="primary"):
+    store = Storage(data_dir=str(tmp_path / name))
+    s = Session(store)
+    s.execute("SET tidb_enable_auto_analyze = OFF")
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    return store, s
+
+
+def _mk_pair(tmp_path, auto_promote=False):
+    store, s = _mk_primary(tmp_path)
+    ship = WalShipper(store, auto_promote=auto_promote)
+    ship.bootstrap(str(tmp_path / "standby"))
+    standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+    ship.attach(standby)
+    return store, s, ship, standby
+
+
+def _ids(sess):
+    return [int(r[0]) for r in sess.must_query("SELECT id FROM t ORDER BY id")]
+
+
+class TestShipping:
+    def test_bootstrap_ship_and_stale_reads(self, tmp_path):
+        store, s, ship, standby = _mk_pair(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        assert ship.wait_caught_up(10)
+        rs = Session(standby)
+        assert _ids(rs) == [1, 2]
+        # the standby serves at its applied watermark — metrics agree
+        assert standby.applied_ts > 0
+        assert M.STANDBY_APPLIED_TS.value() == float(standby.applied_ts)
+        ship.stop()
+
+    def test_bootstrap_carries_pre_subscribe_state(self, tmp_path):
+        """Rows committed BEFORE the bootstrap cut arrive via the
+        snapshot, not the stream; rows after arrive via frames."""
+        store, s = _mk_primary(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        ship.attach(standby)
+        s.execute("INSERT INTO t VALUES (2, 20)")
+        assert ship.wait_caught_up(10)
+        assert _ids(Session(standby)) == [1, 2]
+        ship.stop()
+
+    def test_subscribe_after_checkpoint_and_epoch_rotation(self, tmp_path):
+        """The primary checkpoints BEFORE the subscribe (standby boots
+        from snapshot + log tail) and AGAIN mid-ship (the tap follows
+        the rotated log; a closed epoch drains as fully durable)."""
+        store, s = _mk_primary(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        store.checkpoint()
+        s.execute("INSERT INTO t VALUES (2, 20)")
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        ship.attach(standby)
+        s.execute("INSERT INTO t VALUES (3, 30)")
+        store.checkpoint()  # epoch rotation while shipping
+        s.execute("INSERT INTO t VALUES (4, 40)")
+        assert ship.wait_caught_up(10)
+        assert _ids(Session(standby)) == [1, 2, 3, 4]
+        ship.stop()
+
+    def test_standby_rejects_writes_until_promote(self, tmp_path):
+        store, s, ship, standby = _mk_pair(tmp_path)
+        rs = Session(standby)
+        with pytest.raises(StandbyReadOnly):
+            rs.execute("INSERT INTO t VALUES (9, 9)")
+        # pessimistic locking is a journaled write: refused too
+        with pytest.raises(StandbyReadOnly):
+            standby.begin(pessimistic=True).lock_keys_for_update([b"k"])
+        ship.stop()
+
+    def test_standby_survives_sigkill_shape_and_promotes(self, tmp_path):
+        """Close nothing (the SIGKILL shape), reopen the standby DIR,
+        promote, and find every shipped row — shipped bytes went through
+        the native appender, so recovery replay-verifies their CRCs."""
+        store, s, ship, standby = _mk_pair(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        assert ship.wait_caught_up(10)
+        ship.stop()
+        standby.wal.close()  # release the fd; state is already fsynced
+        re = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        re.promote()
+        rs = Session(re)
+        assert _ids(rs) == [1, 2]
+        rs.execute("INSERT INTO t VALUES (3, 30)")  # writable now
+        assert _ids(rs) == [1, 2, 3]
+
+
+class TestPromotion:
+    def test_admin_promote_via_sql(self, tmp_path):
+        store, s, ship, standby = _mk_pair(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        rs = Session(standby)
+        rs.execute("ADMIN PROMOTE")
+        rs.execute("INSERT INTO t VALUES (2, 20)")
+        assert _ids(rs) == [1, 2]
+        ship.stop()
+
+    def test_double_promote_rejected(self, tmp_path):
+        store, s, ship, standby = _mk_pair(tmp_path)
+        standby.promote()
+        with pytest.raises(TiDBError, match="double promote rejected"):
+            standby.promote()
+        # a store that never was a standby rejects too
+        with pytest.raises(TiDBError, match="not a standby"):
+            store.promote()
+        ship.stop()
+
+    def test_promote_while_ship_mid_frame(self, tmp_path):
+        """Promote serializes on the standby lock: a promote issued
+        while a batch is mid-frame waits for the batch to land, then
+        every later batch is refused and the shipper stops."""
+        store, s, ship, standby = _mk_pair(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        # slow the receive path down so promote provably overlaps it
+        FP.enable("wal/ship-mid-frame", ("sleep", 0.15))
+        s.execute("INSERT INTO t VALUES (2, 20)")
+        time.sleep(0.05)  # the ship thread is now inside the batch
+        standby.promote()
+        FP.disable("wal/ship-mid-frame")
+        # the mid-flight batch landed before the flip (lock order) …
+        assert _ids(Session(standby)) == [1, 2]
+        # … and the next shipped batch is refused, stopping the shipper
+        s.execute("INSERT INTO t VALUES (3, 30)")
+        deadline = time.time() + 10
+        while ship.broken is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert ship.broken is not None
+        assert _ids(Session(standby)) == [1, 2]  # never applied
+
+    def test_auto_promote_on_primary_degrade(self, tmp_path):
+        """No spare media + auto_promote: a WAL IO failure fences the
+        primary permanently and promotes the standby."""
+        store, s, ship, standby = _mk_pair(tmp_path, auto_promote=True)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        FP.enable("wal/io-error-sync", ("nth", 1, OSError(5, "injected EIO")))
+        with pytest.raises(StorageIOError):
+            s.execute("INSERT INTO t VALUES (2, 20)")
+        FP.disable("wal/io-error-sync")
+        deadline = time.time() + 10
+        while standby.standby and time.time() < deadline:
+            time.sleep(0.02)
+        assert not standby.standby, "standby was not auto-promoted"
+        assert store._failover_disabled  # split-brain fence
+        rs = Session(standby)
+        rs.execute("INSERT INTO t VALUES (5, 50)")
+        assert 5 in _ids(rs)
+
+
+class TestSemiSync:
+    def test_ack_means_visible_on_standby(self, tmp_path):
+        store, s, ship, standby = _mk_pair(tmp_path)
+        store.global_vars["tidb_wal_semi_sync"] = "ON"
+        rs = Session(standby)
+        for i in range(1, 6):
+            s.execute(f"INSERT INTO t VALUES ({i}, {i})")
+            # the ack just returned ⇒ the row is on the standby NOW
+            assert i in _ids(rs), f"semi-sync acked row {i} not on standby"
+        ship.stop()
+
+    def test_semi_sync_wait_released_by_kill(self, tmp_path):
+        """A committer parked in the semi-sync wait (receiver stalled)
+        is released by KILL through the shared interrupt gate — the
+        commit is indeterminate-on-standby, never falsely acked."""
+        store, s = _mk_primary(tmp_path)
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        # no attach: nothing ever ships, the wait can only end via KILL
+        store.global_vars["tidb_wal_semi_sync"] = "ON"
+        errs: list = []
+
+        def worker():
+            try:
+                s.execute("INSERT INTO t VALUES (1, 10)")
+                errs.append(None)
+            except TiDBError as e:
+                errs.append(e)
+
+        th = threading.Thread(target=worker)
+        th.start()
+        time.sleep(0.3)
+        assert th.is_alive(), "commit should be parked in the semi-sync wait"
+        s._killed = True
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert isinstance(errs[0], QueryInterrupted)
+
+    def test_stopped_shipper_raises_indeterminate(self, tmp_path):
+        store, s, ship, standby = _mk_pair(tmp_path)
+        ship.stop()
+        store.global_vars["tidb_wal_semi_sync"] = "ON"
+        with pytest.raises(CommitIndeterminateError):
+            s.execute("INSERT INTO t VALUES (1, 10)")
+
+    def test_semi_sync_not_blocked_by_unfsynced_journal_frames(self, tmp_path):
+        """A pessimistic lock acquisition journals frames WITHOUT a
+        sync; a concurrent semi-sync commit must not wait on them (they
+        are durability nobody promised) — its own frames are fsynced
+        and shipped, so the ack returns promptly."""
+        store, s, ship, standby = _mk_pair(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        store.global_vars["tidb_wal_semi_sync"] = "ON"
+        # journal-only frames from another session: lock, never sync
+        pess = store.begin(pessimistic=True)
+        pess.lock_keys_for_update([b"zz-pess-key"])
+        t0 = time.time()
+        s.execute("INSERT INTO t VALUES (2, 20)")
+        took = time.time() - t0
+        assert took < 3.0, f"semi-sync ack blocked {took:.1f}s on foreign unfsynced frames"
+        assert 2 in _ids(Session(standby))
+        pess.rollback()
+        ship.stop()
+
+    def test_semi_sync_off_never_touches_the_wait(self, tmp_path):
+        """OFF (default): commits return without consulting the shipper
+        — wait_durable would raise here (shipper stopped), so a passing
+        commit proves the wait is never entered."""
+        store, s, ship, standby = _mk_pair(tmp_path)
+        ship.stop()
+        s.execute("INSERT INTO t VALUES (1, 10)")  # must not raise
+
+
+class TestStandbyReadConsistency:
+    def test_standby_never_resolves_locks(self, tmp_path):
+        """A shipped prewrite lock must WAIT on the standby (resolution
+        would mutate the replica): the commit frames clear it."""
+        store, s, ship, standby = _mk_pair(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        # plant a bare prewrite lock on the standby's kv (the shape a
+        # ship cut mid-txn leaves) and prove a read at a later ts waits
+        # rather than rolling it back
+        from tidb_tpu.storage.mvcc import Lock
+
+        key = b"zz-lock-probe"
+        start_ts = standby.tso.next()
+        lk = Lock(op=0, primary=key, start_ts=start_ts, ttl_ms=50)
+        with standby.kv.lock:
+            standby.kv._map[b"l" + key] = lk.encode()
+            import bisect
+
+            bisect.insort(standby.kv._keys, b"l" + key)
+        snap = standby.snapshot()
+        t0 = time.time()
+        with pytest.raises(TiDBError):
+            snap.get(key)  # deadline-bounded wait, no resolution
+        assert time.time() - t0 > 1.0  # it genuinely waited
+        assert standby.kv.get(b"l" + key) is not None  # lock untouched
+        ship.stop()
+
+
+class TestSocketTransport:
+    def test_ship_over_socket(self, tmp_path):
+        store, s = _mk_primary(tmp_path)
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        srv = StandbyServer(standby)
+        ship.attach_socket("127.0.0.1", srv.port)
+        s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        assert ship.wait_caught_up(10)
+        deadline = time.time() + 10
+        while standby._applied_frames == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert _ids(Session(standby)) == [1, 2]
+        ship.stop()
+        srv.close()
+
+    def test_socket_rejects_corrupt_frame(self, tmp_path):
+        """The wire reuses the WAL frame shape: a flipped bit fails the
+        CRC and the server drops the connection instead of applying."""
+        store, s = _mk_primary(tmp_path)
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        srv = StandbyServer(standby)
+        payload = rec_put(b"k", b"v")
+        bad = bytearray(payload)
+        bad[0] ^= 0xFF
+        conn = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        conn.settimeout(5)
+        # crc computed over the ORIGINAL bytes, payload corrupted
+        conn.sendall(_FRAME_HDR.pack(_TAG_FRAME, len(bad), zlib.crc32(payload)))
+        conn.sendall(bytes(bad))
+        conn.sendall(_FRAME_HDR.pack(_TAG_SYNC, 0, 0))
+        try:
+            got = conn.recv(_ACK.size)
+        except ConnectionError:
+            got = b""  # reset IS a refusal
+        assert got == b"", "server must close, not ack, a corrupt frame"
+        assert standby._applied_frames == 0
+        srv.close()
+        ship.stop()
+
+
+class TestFrameParsing:
+    def test_frame_commit_ts_and_prefix(self):
+        import numpy as np
+
+        # write-CF put carries its commit_ts in the key suffix
+        from tidb_tpu.storage.mvcc import rev_ts
+
+        user = b"t" + b"\x00" * 8 + b"_r" + b"\x00" * 6
+        p = rec_put(b"w" + user + rev_ts(777), b"x")
+        assert frame_commit_ts(p) == 777
+        assert frame_table_prefix(p) == user[:9]
+        # data-CF put: no commit ts, but a prefix
+        d = rec_put(b"d" + user + rev_ts(5), b"x")
+        assert frame_commit_ts(d) == 0
+        assert frame_table_prefix(d) == user[:9]
+        # ingest runs name commit_ts outright
+        km = np.frombuffer(user + user, dtype=np.uint8).reshape(2, len(user)).copy()
+        r = rec_run(km, b"ab", np.array([0, 1]), np.array([1, 1]), 4242)
+        assert frame_commit_ts(r) == 4242
+        assert frame_table_prefix(r) == user[:9]
+        assert frame_commit_ts(b"") == 0
+        assert frame_table_prefix(b"") is None

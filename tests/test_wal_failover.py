@@ -1,0 +1,248 @@
+"""Online WAL media failover (PR 14, storage/txn.py): an IO failure on
+a store with `tidb_wal_spare_dirs` rotates onto a spare (checkpoint-to-
+spare under the kv barrier, fresh log, writes resume, zero acks lost);
+without a spare the PR 10 fsyncgate degrade is bit-identical; failed
+media re-enters service only through the hysteresis re-probe. Plus the
+typed indeterminate-commit satellite and the durable FileSink."""
+
+import json
+import os
+import time
+
+import pytest
+
+from tidb_tpu.errors import CommitIndeterminateError, StorageIOError
+from tidb_tpu.session import Session
+from tidb_tpu.storage.txn import Storage
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+def _mk(tmp_path, spares=None):
+    store = Storage(data_dir=str(tmp_path / "data"),
+                    spare_dirs=[str(p) for p in (spares or [])])
+    s = Session(store)
+    s.execute("SET tidb_enable_auto_analyze = OFF")
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    return store, s
+
+
+def _eio_once(site="wal/io-error-sync"):
+    FP.enable(site, ("nth", 1, OSError(5, "injected EIO")))
+
+
+class TestRotation:
+    def test_eio_rotates_writes_resume_zero_lost_acks(self, tmp_path):
+        spare = tmp_path / "spare"
+        store, s = _mk(tmp_path, spares=[spare])
+        acked = []
+        for i in range(5):
+            s.execute(f"INSERT INTO t VALUES ({i}, {i * 3})")
+            acked.append(i)
+        _eio_once()
+        with pytest.raises(CommitIndeterminateError):
+            s.execute("INSERT INTO t VALUES (100, 1)")
+        FP.disable("wal/io-error-sync")
+        # writes RESUME (check_writable gives the rotation its chance)
+        for i in range(5, 10):
+            s.execute(f"INSERT INTO t VALUES ({i}, {i * 3})")
+            acked.append(i)
+        assert not store.io_degraded
+        assert store.data_dir == str(spare)
+        assert M.WAL_ROTATIONS.value(outcome="ok") >= 1
+        store.wal.close()
+        # reopen the SPARE dir: every ack durable there
+        re = Session(Storage(data_dir=str(spare)))
+        rows = {int(a): int(b) for a, b in
+                re.must_query("SELECT id, v FROM t WHERE id < 100")}
+        assert all(rows.get(i) == i * 3 for i in acked), rows
+        # the old dir carries the operator breadcrumb
+        with open(tmp_path / "data" / "FAILED_OVER_TO") as f:
+            assert f.read().strip() == str(spare)
+
+    def test_eio_on_append_rotates_too(self, tmp_path):
+        spare = tmp_path / "spare"
+        store, s = _mk(tmp_path, spares=[spare])
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        _eio_once("wal/io-error-append")
+        with pytest.raises(StorageIOError):
+            s.execute("INSERT INTO t VALUES (2, 6)")
+        FP.disable("wal/io-error-append")
+        s.execute("INSERT INTO t VALUES (3, 9)")
+        assert not store.io_degraded
+        assert store.data_dir == str(spare)
+
+    def test_no_spare_degrades_exactly_like_before(self, tmp_path):
+        """Without spare dirs the behavior is the PR 10 contract: the
+        in-flight commit errors (typed indeterminate now — a subclass of
+        the old StorageIOError shape), every later commit fails loud and
+        determinate, reads keep serving, the degrade is sticky."""
+        store, s = _mk(tmp_path)
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        _eio_once()
+        with pytest.raises(StorageIOError):
+            s.execute("INSERT INTO t VALUES (2, 6)")
+        FP.disable("wal/io-error-sync")
+        time.sleep(0.1)  # give the follow-up thread its chance to (not) heal
+        assert store.io_degraded
+        with pytest.raises(StorageIOError) as ei:
+            s.execute("INSERT INTO t VALUES (3, 9)")
+        # determinate shape, NOT the indeterminate subclass
+        assert not isinstance(ei.value, CommitIndeterminateError)
+        # reads keep serving: row 1 (durable) and row 2 (the indeterminate
+        # commit applied in memory, sync unconfirmed — the PR 10 contract);
+        # the determinately-refused row 3 is absent
+        assert [r[0] for r in s.must_query("SELECT id FROM t")] == ["1", "2"]
+        assert M.WAL_ROTATIONS.value(outcome="no_spare") >= 1
+
+    def test_semi_sync_shipping_survives_rotation(self, tmp_path):
+        """Rotation marks the poisoned log superseded: its queued frames
+        became durable via the spare snapshot, so shipping (and
+        semi-sync) continue seamlessly on the new epoch."""
+        from tidb_tpu.storage.ship import WalShipper
+
+        spare = tmp_path / "spare"
+        store, s = _mk(tmp_path, spares=[spare])
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        ship.attach(standby)
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        # drain before arming: the failpoint site is global, and the
+        # standby's own batch fsync must not be the one that trips it
+        assert ship.wait_caught_up(10)
+        _eio_once()
+        with pytest.raises(StorageIOError):
+            s.execute("INSERT INTO t VALUES (2, 6)")
+        FP.disable("wal/io-error-sync")
+        store.global_vars["tidb_wal_semi_sync"] = "ON"
+        s.execute("INSERT INTO t VALUES (3, 9)")  # rotated + shipped + acked
+        rs = Session(standby)
+        # row 2's commit was indeterminate — the rotation snapshot
+        # captured its in-memory effects, making it durable after all,
+        # so the superseded log's queued frames legitimately shipped:
+        # the standby matches the primary exactly, never ahead of it
+        assert [int(r[0]) for r in rs.must_query("SELECT id FROM t ORDER BY id")] == [1, 2, 3]
+        assert [int(r[0]) for r in s.must_query("SELECT id FROM t ORDER BY id")] == [1, 2, 3]
+        ship.stop()
+
+
+class TestReprobeHysteresis:
+    def test_failed_spare_heals_through_reprobe(self, tmp_path, monkeypatch):
+        """An unwritable spare fails the rotation (degrade stays);
+        once the media heals, the background re-probe needs
+        PROBE_OK_STREAK consecutive good probes before the next
+        rotation trusts it — then writes resume."""
+        monkeypatch.setattr(Storage, "PROBE_COOLDOWN_S", 0.1)
+        spare = tmp_path / "spare"
+        # a FILE at the spare path makes makedirs/snap_write fail
+        spare.write_text("not a directory")
+        store, s = _mk(tmp_path, spares=[spare])
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        _eio_once()
+        with pytest.raises(StorageIOError):
+            s.execute("INSERT INTO t VALUES (2, 6)")
+        FP.disable("wal/io-error-sync")
+        deadline = time.time() + 5
+        while M.WAL_ROTATIONS.value(outcome="failed") == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert store.io_degraded
+        st = store._media_state.get(str(spare))
+        assert st is not None and st["ok_streak"] == 0
+        # heal the media: the re-probe loop must rotate within a few
+        # cooldown periods (cooldown sit-out + OK_STREAK probes)
+        spare.unlink()
+        deadline = time.time() + 10
+        while store.io_degraded and time.time() < deadline:
+            time.sleep(0.05)
+        assert not store.io_degraded, "re-probe never healed the store"
+        assert store._media_state[str(spare)]["ok_streak"] >= store.PROBE_OK_STREAK
+        s.execute("INSERT INTO t VALUES (3, 9)")
+        assert store.data_dir == str(spare)
+
+    def test_one_good_probe_is_not_enough(self, tmp_path, monkeypatch):
+        """Hysteresis: after a failure, a single passing probe must NOT
+        re-qualify the media (ok_streak < PROBE_OK_STREAK)."""
+        monkeypatch.setattr(Storage, "PROBE_COOLDOWN_S", 3600.0)
+        store, _ = _mk(tmp_path)
+        cand = str(tmp_path / "flappy")
+        store._media_state[cand] = {
+            "last_fail": time.time() - 7200, "ok_streak": 0, "last_probe": 0.0,
+        }
+        assert store._media_eligible(cand) is False  # probe 1 passes, streak 1 < 2
+        assert store._media_state[cand]["ok_streak"] == 1
+        # within the cooldown the verdict is cached, no second probe
+        assert store._media_eligible(cand) is False
+        assert store._media_state[cand]["ok_streak"] == 1
+
+
+class TestIndeterminateError:
+    def test_code_and_subclassing(self):
+        assert CommitIndeterminateError.code == 8150
+        assert issubclass(CommitIndeterminateError, StorageIOError)
+
+    def test_wire_carries_8150(self, tmp_path):
+        """The server forwards the real error code, so clients can count
+        indeterminate vs failed (bench_serve does)."""
+        import socket as _socket
+        import struct as _struct
+
+        from tidb_tpu.server.server import Server
+
+        store = Storage(data_dir=str(tmp_path / "data"))
+        boot = Session(store)
+        boot.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        srv = Server(store, port=0)
+        port = srv.start()
+        try:
+            import sys
+
+            sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+            from bench_serve import MiniClient
+
+            cli = MiniClient("127.0.0.1", port)
+            cli.query("INSERT INTO t VALUES (1, 1)")
+            _eio_once()
+            with pytest.raises(RuntimeError, match="server error 8150"):
+                cli.query("INSERT INTO t VALUES (2, 2)")
+            cli.close()
+        finally:
+            FP.disable_all()
+            srv.close()
+
+
+class TestDurableFileSink:
+    def test_fsync_and_rotation(self, tmp_path):
+        from tidb_tpu.cdc import ChangeEvent, FileSink
+
+        path = str(tmp_path / "cdc.jsonl")
+        sink = FileSink(path, durable=True, rotate_bytes=512)
+        ev = ChangeEvent(1, 0, 7, 1, "put", b"k" * 16, b"v" * 64)
+        for _ in range(20):
+            sink([ev])
+        sink.close()
+        segs = FileSink.segments(path)
+        assert len(segs) > 1, "size-based rotation never fired"
+        total = 0
+        for seg in segs:
+            with open(seg) as f:
+                for ln in f:
+                    json.loads(ln)  # every surviving line is complete
+                    total += 1
+        assert total == 20
+
+    def test_plain_sink_unchanged(self, tmp_path):
+        from tidb_tpu.cdc import ChangeEvent, FileSink
+
+        path = str(tmp_path / "cdc.jsonl")
+        sink = FileSink(path)
+        sink([ChangeEvent(1, 0, 7, 1, "put", b"k", b"v")])
+        with open(path) as f:
+            assert len(f.readlines()) == 1
+        assert FileSink.segments(path) == [path]

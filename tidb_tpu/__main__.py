@@ -67,6 +67,13 @@ def main(argv=None) -> int:
         "--enable-sem", action="store_true", default=None,
         help="security enhanced mode: hide restricted vars/tables, deny FILE (ref: util/sem)",
     )
+    ap.add_argument("--data-dir", default=None,
+                    help="durable store directory (omit for in-memory)")
+    ap.add_argument(
+        "--wal-spare-dirs", default=None,
+        help="comma-separated spare WAL dirs for online media failover "
+             "(tidb_wal_spare_dirs; requires --data-dir)",
+    )
     args = ap.parse_args(argv)
     # precedence: defaults < config file < CLI flags (tidb-server rule)
     defaults = {"host": "127.0.0.1", "port": 4000, "log_level": "info",
@@ -90,7 +97,15 @@ def main(argv=None) -> int:
     )
     from .server import Server
 
-    srv = Server(host=args.host, port=args.port)
+    storage = None
+    if args.data_dir:
+        from .storage.txn import Storage
+
+        spares = [p.strip() for p in (args.wal_spare_dirs or "").split(",") if p.strip()]
+        storage = Storage(data_dir=args.data_dir, spare_dirs=spares or None)
+        if spares:
+            storage.global_vars["tidb_wal_spare_dirs"] = ",".join(spares)
+    srv = Server(storage=storage, host=args.host, port=args.port)
     srv.storage.gc_worker.life_ms = args.gc_life_minutes * 60 * 1000
     port = srv.start()
     print(f"tidb-tpu server listening on {args.host}:{port}", flush=True)

@@ -20,8 +20,11 @@ sorts by it, exactly like cdclog consumers resolve file interleaving.
 
 from __future__ import annotations
 
+import glob
 import json
+import os
 import threading
+import time
 from dataclasses import dataclass
 
 
@@ -87,14 +90,31 @@ class ChangeFeed:
 
 class FileSink:
     """cdclog-style JSON-lines sink (ref: br/pkg/cdclog file layout —
-    one ts-ordered log of row changes)."""
+    one ts-ordered log of row changes).
 
-    def __init__(self, path: str):
+    Durable mode (PR 14): `durable=True` fsyncs the file on a cadence
+    (`fsync_interval_s`; 0 = every batch) so the sink honestly survives
+    SIGKILL — the crashpoint CDC-not-ahead invariant is then checked
+    against bytes that were really on disk, not page cache the crash may
+    or may not have flushed. `rotate_bytes` caps segment size: a full
+    segment renames to `<path>.NNNNNN` (dir-fsynced in durable mode) and
+    a fresh live file opens; `segments(path)` lists rotated + live parts
+    in write order for consumers/checkers."""
+
+    def __init__(self, path: str, durable: bool = False,
+                 fsync_interval_s: float = 0.0, rotate_bytes: int | None = None):
         self.path = path
+        self.durable = durable
+        self.fsync_interval_s = fsync_interval_s
+        self.rotate_bytes = rotate_bytes
         self._lock = threading.Lock()
+        self._f = None
+        self._rotations = 0
+        self._last_fsync = 0.0
 
     def __call__(self, events: list[ChangeEvent]) -> None:
-        with self._lock, open(self.path, "a") as f:
+        with self._lock:
+            f = self._open_locked()
             for e in events:
                 f.write(json.dumps({
                     "commit_ts": e.commit_ts,
@@ -105,3 +125,53 @@ class FileSink:
                     "key": e.key.hex(),
                     "value": e.value.hex() if e.value is not None else None,
                 }) + "\n")
+            f.flush()
+            if self.durable:
+                now = time.time()
+                if now - self._last_fsync >= self.fsync_interval_s:
+                    os.fsync(f.fileno())
+                    self._last_fsync = now
+            if self.rotate_bytes is not None and f.tell() >= self.rotate_bytes:
+                self._rotate_locked()
+
+    def _open_locked(self):
+        if self._f is None:
+            self._f = open(self.path, "a", encoding="utf8")
+            # resuming over earlier rotations: continue the numbering
+            existing = glob.glob(self.path + ".*")
+            if existing and self._rotations == 0:
+                self._rotations = len(existing)
+        return self._f
+
+    def _rotate_locked(self) -> None:
+        f = self._f
+        if self.durable:
+            os.fsync(f.fileno())
+        f.close()
+        self._f = None
+        os.replace(self.path, f"{self.path}.{self._rotations:06d}")
+        self._rotations += 1
+        if self.durable:
+            d = os.path.dirname(os.path.abspath(self.path))
+            fd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                if self.durable:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                self._f.close()
+                self._f = None
+
+    @staticmethod
+    def segments(path: str) -> list[str]:
+        """Rotated segments (write order) + the live file, existing only."""
+        out = sorted(glob.glob(path + ".*"))
+        if os.path.exists(path):
+            out.append(path)
+        return out

@@ -227,3 +227,25 @@ class WalCorruptionError(TiDBError):
     corrupt log frames (never past a corrupt snapshot)."""
 
     code = 9017
+
+
+class CommitIndeterminateError(StorageIOError):
+    """The commit IN FLIGHT at the moment of a WAL failure: the error
+    landed AT the durability point (after phase 2, during the fsync), so
+    the outcome is UNKNOWN — the group leader's fsync may still have
+    covered it, a spare-dir rotation may have snapshotted it, or it may
+    be gone with the page cache. The ack is withheld (never falsified),
+    but unlike a plain `StorageIOError` — which means the commit
+    determinately did NOT happen — the client must treat this one as
+    undetermined (ref: ErrResultUndetermined, 8150). Subclasses
+    StorageIOError so every existing degrade handler keeps working."""
+
+    code = 8150
+
+
+class StandbyReadOnly(TiDBError):
+    """The store is a warm standby replaying a primary's shipped WAL:
+    writes are rejected until `ADMIN PROMOTE` flips it read-write
+    (MySQL --super-read-only analog, ER_OPTION_PREVENTS_STATEMENT)."""
+
+    code = 1290

@@ -1870,6 +1870,9 @@ class Parser:
             tbl = self._table_name()
             idx = self.ident()
             return ast.AdminStmt("cleanup_index", (tbl, idx))
+        if self.try_kw("PROMOTE"):
+            # ADMIN PROMOTE: flip a warm standby read-write (PR 14)
+            return ast.AdminStmt("promote")
         self.fail("unsupported ADMIN")
 
     def kill_stmt(self):

@@ -485,6 +485,12 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None, variables=None) -> Non
     ds.key_ranges = None
     ds.point_handles = None
     conds = ds.pushed_conds
+    # prepared-plan-cache rebind info (PR 14): the pre-drop conjunct list
+    # (which references the parameter-slot Constants) and the conds the
+    # chosen path consumed — rebind_cached_ranges re-derives the
+    # value-dependent access info from these after a slot rebind
+    ds._rebind_conds = list(conds)
+    ds._rebind_consumed = []
     tstats = stats.get(table.id) if stats is not None else None
 
     if table.partition is not None:
@@ -506,6 +512,7 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None, variables=None) -> Non
     if ha is not None and ha.point_handles is not None:
         ds.path = "point"
         ds.point_handles = ha.point_handles
+        ds._rebind_consumed = list(ha.access_conds)
         _drop_conds(ds, ha.access_conds)
         return
 
@@ -580,6 +587,7 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None, variables=None) -> Non
         ds.index = idx
         ds.key_ranges = ia.ranges
         ds.path = "index" if covering else "index_lookup"
+        ds._rebind_consumed = list(ia.access_conds)
         _drop_conds(ds, ia.access_conds)
         return
 
@@ -587,6 +595,7 @@ def _choose_for_ds(ds: DataSource, used: set, stats=None, variables=None) -> Non
     if ha is not None and ha.ranges is not None:
         ds.path = "table"
         ds.key_ranges = ha.ranges
+        ds._rebind_consumed = list(ha.access_conds)
         _drop_conds(ds, ha.access_conds)
         return
 
@@ -680,3 +689,117 @@ def _try_index_merge(ds, conds, table, visible, vis_by_off, pk_vis, tstats) -> N
 
 def _drop_conds(ds: DataSource, consumed: list) -> None:
     ds.pushed_conds = [c for c in ds.pushed_conds if not any(c is a for a in consumed)]
+
+
+# --------------------------- prepared-plan cache rebind (PR 14) ------------
+#
+# The statement-id plan cache (session._prepared_plan_for) reuses a built
+# physical plan across COM_STMT_EXECUTE repeats by mutating the parameter
+# slot Constants in place. Everything the executors evaluate at RUN time
+# (filters, projections, join keys) follows the new values automatically;
+# what does NOT is the access info `_choose_for_ds` derived from the OLD
+# values at optimize time — point handles, key ranges, partition pruning.
+# `rebind_cached_ranges` re-derives exactly those from the saved pre-drop
+# conjuncts (ref: planner/core/plan_cache.go RebuildPlan4CachedPlan /
+# rebuildRange). A rebind that would change the plan SHAPE — a different
+# set of conds became (or stopped being) sargable, e.g. `pk = 1.5` where
+# the first execution bound an exact int — returns False: the baked
+# access/filter split no longer matches and the caller must replan.
+
+
+def plan_rebindable(root: LogicalPlan) -> bool:
+    """Is every DataSource in this plan a shape rebind_cached_ranges can
+    re-derive? Index-merge unions (per-branch detachments) and sources
+    that never went through choose_access_paths are not."""
+    ok = True
+
+    def walk(n: LogicalPlan) -> None:
+        nonlocal ok
+        if not ok:
+            return
+        if isinstance(n, DataSource):
+            if getattr(n, "_rebind_conds", None) is None:
+                ok = False
+            elif getattr(n, "path", "table") not in (
+                    "point", "table", "index", "index_lookup"):
+                ok = False
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+    return ok
+
+
+def rebind_cached_ranges(root: LogicalPlan) -> bool:
+    """Recompute the value-derived access info of a cached prepared plan
+    after its parameter slots were rebound. True = plan is ready to
+    execute; False = the new values change the plan shape, replan."""
+    ok = True
+
+    def walk(n: LogicalPlan) -> None:
+        nonlocal ok
+        if not ok:
+            return
+        if isinstance(n, DataSource):
+            ok = _rebind_ds(n)
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+    return ok
+
+
+def _same_conds(a: list, b: list) -> bool:
+    """Identity-set equality: the rebind consumed exactly the conds the
+    original optimization consumed (so the filters left in the plan
+    still cover everything the ranges don't)."""
+    return len(a) == len(b) and all(any(x is y for y in b) for x in a)
+
+
+def _rebind_ds(ds: DataSource) -> bool:
+    from . import ranger
+
+    conds = getattr(ds, "_rebind_conds", None)
+    if conds is None:
+        return False
+    table = ds.table
+    if table.partition is not None:
+        # partitioned sources bake only the pruning verdict; conds were
+        # never dropped, so re-pruning is the whole rebind
+        visible = table.visible_columns()
+        vis_by_off = {c.offset: i for i, c in enumerate(visible)}
+        ds.pruned_parts = _prune_partitions(table, conds, vis_by_off)
+        return True
+    saved = getattr(ds, "_rebind_consumed", [])
+    path = getattr(ds, "path", "table")
+    if path == "point":
+        ha = ranger.detach_pk_handle_access(table, conds)
+        if ha is None or ha.point_handles is None or not _same_conds(ha.access_conds, saved):
+            return False
+        ds.point_handles = ha.point_handles
+        return True
+    if path in ("index", "index_lookup"):
+        visible = table.visible_columns()
+        vis_by_off = {c.offset: i for i, c in enumerate(visible)}
+        col_vis, col_fts = [], []
+        for off in ds.index.col_offsets:
+            if off not in vis_by_off:
+                return False
+            col_vis.append(vis_by_off[off])
+            col_fts.append(table.columns[off].ft)
+        ia = ranger.detach_index_conditions(conds, table.id, ds.index.id, col_vis, col_fts)
+        if ia is None or not _same_conds(ia.access_conds, saved):
+            return False
+        ds.key_ranges = ia.ranges
+        return True
+    if path == "table":
+        if ds.key_ranges is None:
+            # full scan + filters: nothing value-derived was baked, as
+            # long as the original consumed nothing either
+            return not saved
+        ha = ranger.detach_pk_handle_access(table, conds)
+        if ha is None or ha.ranges is None or not _same_conds(ha.access_conds, saved):
+            return False
+        ds.key_ranges = ha.ranges
+        return True
+    return False  # index_merge & anything new: replan

@@ -238,7 +238,10 @@ class ClientConn:
         try:
             rs = self.session.execute_prepared_ast(parsed, params, sql=src_sql)
         except TiDBError as e:
-            self.pkt.write_packet(p.err_packet(1105, str(e)))
+            # real error codes on the wire: clients (and bench_serve)
+            # must be able to tell an INDETERMINATE commit (8150 — the
+            # fsync-failure shape) from a determinate failure
+            self.pkt.write_packet(p.err_packet(getattr(e, "code", 1105) or 1105, str(e)))
             return
         except Exception as e:  # noqa: BLE001 — surface as SQL error, keep conn
             log.exception("stmt execute failed")
@@ -294,7 +297,9 @@ class ClientConn:
         try:
             rs = self.session.execute(sql)
         except TiDBError as e:
-            self.pkt.write_packet(p.err_packet(1105, str(e)))
+            # carry the statement's real error code (an indeterminate
+            # commit must reach the client as 8150, not generic 1105)
+            self.pkt.write_packet(p.err_packet(getattr(e, "code", 1105) or 1105, str(e)))
             return
         except Exception as e:  # noqa: BLE001 — surface as SQL error, keep conn
             log.exception("query failed: %s", sql)

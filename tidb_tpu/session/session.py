@@ -101,6 +101,12 @@ class Session:
         self.prepared: dict[str, tuple[str, object, int]] = {}
         self.user_vars: dict[str, Constant] = {}
         self._exec_params: list | None = None
+        # prepared-plan cache identity (PR 14): the prepared statement's
+        # stored AST object is stable across executes, so it anchors the
+        # statement-id plan-cache key; `_active_prep` marks the AST the
+        # CURRENT execute runs (nested/rewritten sub-selects never match)
+        self._active_prep = None
+        self._prep_seq = 0
         from collections import OrderedDict
 
         self._plan_cache: OrderedDict = OrderedDict()
@@ -1074,6 +1080,12 @@ class Session:
                 return self._admin_recover_cleanup_index(*stmt.target, recover=True)
             if stmt.kind == "cleanup_index":
                 return self._admin_recover_cleanup_index(*stmt.target, recover=False)
+            if stmt.kind == "promote":
+                # warm-standby failover promotion (PR 14): flips the
+                # store read-write; rejected on a store that is not (or
+                # no longer) a standby
+                self.store.promote()
+                return ResultSet([], None)
         if isinstance(stmt, ast.CreateBinding):
             return self._run_create_binding(stmt)
         if isinstance(stmt, ast.DropBinding):
@@ -1513,6 +1525,10 @@ class Session:
             # applies to the NEXT recovery; persisted in the data dir's
             # RECOVERY_MODE sidecar so it survives the crash it's for
             self.store.set_wal_recovery_mode(val)
+        elif name == "tidb_wal_spare_dirs":
+            # spare WAL media for online failover (PR 14): applies to
+            # the next IO-failure rotation attempt
+            self.store.set_wal_spare_dirs(val)
         elif name == "tidb_server_memory_limit":
             self.store.mem.set_limit(int(val))
         elif name == "tidb_memory_usage_alarm_ratio":
@@ -1600,14 +1616,10 @@ class Session:
 
         return rows_for(self, name)
 
-    def _plan_for(self, stmt, sql: str | None):
-        """Plan with an LRU plan cache for parameter-free statements
-        (ref: planner/core/cache.go:128 plan-cache key = stmt digest +
-        schema version; stats generation added so ANALYZE invalidates)."""
-        if sql is None or self._exec_params is not None or self.txn is not None:
-            return self.plan_select(stmt)
-        key = (
-            sql,
+    def _plan_env_key(self) -> tuple:
+        """The non-SQL half of every plan-cache key: everything baked
+        into a built plan that can drift between executions."""
+        return (
             self.current_db,
             self.infoschema().version,
             self._temp_epoch,  # temp tables shadow names per-session
@@ -1620,6 +1632,76 @@ class Session:
             self.vars.get("tidb_opt_join_reorder_threshold", "0"),
             repr(getattr(self, "_cur_hints", None) or []),
         )
+
+    def _prepared_plan_for(self, stmt):
+        """Statement-id prepared-plan cache (ref: planner/core
+        plan_cache.go GetPlanFromSessionPlanCache + RebuildPlan4CachedPlan):
+        repeats of COM_STMT_EXECUTE / EXECUTE skip the parser AND the
+        optimizer. The first execution's parameter Constants stay
+        embedded in the cached plan as live slots; a repeat mutates them
+        in place with the new values and re-derives only the
+        value-dependent access info (point handles / key ranges /
+        partition pruning) from the saved access conditions. A repeat
+        whose values change the plan SHAPE (a cond stopped being
+        sargable) drops the entry and replans — correctness never rides
+        on the cache."""
+        from ..planner import optimizer as _opt
+
+        params = self._exec_params
+        anchor = self._active_prep
+        if anchor is None or anchor is not stmt or self.txn is not None:
+            # a nested sub-select of a prepared DML, or inside an explicit
+            # txn (the text plan cache bypasses there too): plan fresh
+            return self.plan_select(stmt)
+        seq = getattr(anchor, "_prep_plan_seq", None)
+        if seq is None:
+            self._prep_seq += 1
+            seq = self._prep_seq
+            try:
+                anchor._prep_plan_seq = seq
+            except (AttributeError, TypeError):
+                return self.plan_select(stmt)
+        # param TYPE signature: a re-prepare-free client may flip a
+        # parameter from int to string between executes — those need
+        # (and get) distinct plans, since inference baked the old type
+        sig = tuple(
+            (p.value.kind, getattr(p.ret_type, "tp", None)) for p in params
+        )
+        key = ("~prep~", seq, sig, self._plan_env_key())
+        ent = self._plan_cache.get(key)
+        if ent is not None:
+            plan, slots = ent
+            for slot, p in zip(slots, params):
+                # slot IS p on the first (caching) execution's aliases —
+                # self-assignment is a no-op; fresh wire params mutate
+                # the embedded slots, which every expression in the
+                # cached plan references
+                slot.value = p.value
+                slot.ret_type = p.ret_type
+            if _opt.rebind_cached_ranges(plan):
+                self._plan_cache.move_to_end(key)
+                self.plan_cache_hits += 1
+                self._last_plan_from_cache = True
+                return plan
+            del self._plan_cache[key]  # shape changed under the new values
+        plan = self.plan_select(stmt)
+        if not getattr(plan, "_uncacheable", False) and _opt.plan_rebindable(plan):
+            self._plan_cache[key] = (plan, list(params))
+            while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
+                self._plan_cache.popitem(last=False)
+        return plan
+
+    def _plan_for(self, stmt, sql: str | None):
+        """Plan with an LRU plan cache for parameter-free statements
+        (ref: planner/core/cache.go:128 plan-cache key = stmt digest +
+        schema version; stats generation added so ANALYZE invalidates).
+        Parameterized executions route to the statement-id prepared-plan
+        cache instead (PR 14 — prepared repeats skip the optimizer)."""
+        if self._exec_params is not None:
+            return self._prepared_plan_for(stmt)
+        if sql is None or self.txn is not None:
+            return self.plan_select(stmt)
+        key = (sql, self._plan_env_key())
         plan = self._plan_cache.get(key)
         self._last_plan_from_cache = plan is not None
         if plan is not None:
@@ -1812,10 +1894,13 @@ class Session:
                 f"Incorrect arguments to EXECUTE: statement needs {n_params}, got {len(params)}"
             )
         self._exec_params = params
+        prev_prep = self._active_prep
+        self._active_prep = parsed
         try:
             return self._execute_stmt(parsed)
         finally:
             self._exec_params = None
+            self._active_prep = prev_prep
 
     def execute_prepared_ast(self, parsed, params: list, sql: str | None = None) -> ResultSet:
         """Wire-protocol COM_STMT_EXECUTE entry: run a pre-parsed
@@ -1829,13 +1914,16 @@ class Session:
         `_finish_stmt`, so a wire prepared INSERT left its autocommit
         txn open (unsynced — no durability point) until some later text
         statement happened to close it. `sql` is the prepare-time text,
-        used for logs/digests; the plan cache stays bypassed for
-        parameterized executions regardless."""
+        used for logs/digests; parameterized SELECTs hit the
+        statement-id prepared-plan cache (`_prepared_plan_for`)."""
         self._exec_params = params
+        prev_prep = self._active_prep
+        self._active_prep = parsed
         try:
             return self._execute_parsed(parsed, sql)
         finally:
             self._exec_params = None
+            self._active_prep = prev_prep
 
     def _run_subquery(self, select_ast):
         rs = self.run_select(select_ast)

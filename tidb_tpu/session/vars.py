@@ -174,6 +174,24 @@ _sv("tidb_wal_recovery_mode", "tolerate-torn-tail", scope="global", kind="enum",
 # GLOBAL-only: the durability protocol is a store-wide property.
 _sv("tidb_wal_group_commit", "ON", scope="global", kind="bool", consumed=True)
 
+# --- warm-standby shipping + online WAL media failover (PR 14) --------------
+# semi-sync replication (MySQL rpl_semi_sync analog over WAL shipping):
+# with a WalShipper attached, ON makes every commit ack additionally
+# mean durable-on-STANDBY — after local group-commit durability the
+# committer waits for the shipper's standby-fsync confirmation (released
+# by KILL/deadline through the shared interrupt gate; the commit is then
+# indeterminate, never falsely acked). OFF (default) ships async —
+# measured cost: nothing (the wait is never entered). GLOBAL-only like
+# tidb_wal_group_commit: the durability protocol is store-wide.
+_sv("tidb_wal_semi_sync", "OFF", scope="global", kind="bool", consumed=True)
+# comma-separated spare WAL directories: on a WAL IO failure the store
+# checkpoints onto the first healthy spare (fresh log, writes resume,
+# zero acks lost) instead of degrading read-only forever; failed media
+# joins a background re-probe with hysteresis. Empty (default) keeps the
+# exact PR 10 fsyncgate degrade. GLOBAL-only: media topology is
+# store-wide.
+_sv("tidb_wal_spare_dirs", "", scope="global", consumed=True)
+
 # --- mesh-wide cop dispatch (PR 6) -----------------------------------------
 # dispatch width over the device mesh: cop tasks place onto the first N
 # runner lanes (0 = every device). Serving knob for hosts whose backend

@@ -12,15 +12,18 @@ lock-resolution retries (ref: unistore tikv/server.go:331,353 semantics).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from threading import Lock
 
 log = logging.getLogger(__name__)
 
 from ..errors import (
+    CommitIndeterminateError,
     DeadlockError,
     LockedError,
     RetryableError,
+    StandbyReadOnly,
     StorageIOError,
     TiDBError,
     TxnAborted,
@@ -72,6 +75,14 @@ class Snapshot:
                 # locks must not spin a reader forever either
                 if time.time() > deadline:
                     raise RetryableError("could not resolve locks for read") from e
+                if self.store.standby:
+                    # a warm standby must never WRITE: resolving would
+                    # commit/rollback on the replica and diverge it from
+                    # the primary. A shipped prewrite lock clears when its
+                    # commit (or rollback) frame arrives — wait for it.
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 0.25)
+                    continue
                 now_ms = int(time.time() * 1000)
                 if not self.store.mvcc.resolve_lock(e.key, e.lock, now_ms):
                     time.sleep(backoff)
@@ -302,7 +313,21 @@ class Txn:
         self.committed = True
         self.store._txn_done(self.start_ts)
         self.store.bump_version([m.key for m in muts])
-        self.store.wal_sync()  # group-commit durability point
+        try:
+            self.store.wal_sync()  # group-commit durability point
+        except CommitIndeterminateError:
+            raise
+        except StorageIOError as e:
+            # the failure landed AT the durability point: phase 2 is done
+            # in memory, the fsync outcome is unknown — surface the typed
+            # indeterminate shape (8150), distinct from the determinate
+            # "commit refused before touching anything" StorageIOError
+            # that check_writable raises at the top of this method
+            raise CommitIndeterminateError(
+                f"commit (start_ts={self.start_ts}) was in flight at a WAL "
+                f"failure: outcome indeterminate — the ack is withheld, but "
+                f"the write may or may not be durable ({e.msg})"
+            ) from e
         # change feed: the txn is durable (primary committed + WAL synced);
         # a post-commit hook must never turn a durable commit into an
         # error (ref: binlog.go commit hook)
@@ -356,11 +381,34 @@ class Storage:
 
     RECOVERY_MODES = ("tolerate-torn-tail", "absolute", "drop-corrupt")
 
-    def __init__(self, data_dir: str | None = None, wal_recovery_mode: str | None = None):
+    def __init__(self, data_dir: str | None = None, wal_recovery_mode: str | None = None,
+                 standby: bool = False, spare_dirs: list[str] | None = None):
         if wal_recovery_mode is not None and wal_recovery_mode not in self.RECOVERY_MODES:
             raise ValueError(f"unknown wal_recovery_mode {wal_recovery_mode!r}")
+        if standby and data_dir is None:
+            raise ValueError("a standby store requires a data_dir (it journals shipped frames)")
         self.wal_recovery_mode = wal_recovery_mode
         self._io_degraded = False
+        # --- warm standby + online WAL failover (PR 14) --------------------
+        # standby: this store replays a primary's shipped WAL frames and
+        # serves stale reads at its applied watermark; every write entry
+        # point refuses until promote() flips it read-write.
+        self.standby = standby
+        self.applied_ts = 0  # newest commit_ts replayed from shipped frames
+        self._applied_frames = 0
+        from threading import RLock as _RLock
+
+        self._standby_lock = _RLock()  # serializes receive_frames vs promote
+        self._shipper = None  # WalShipper (storage/ship.py) when attached
+        # spare WAL media (tidb_wal_spare_dirs): on an IO failure the
+        # store checkpoints onto a spare and resumes writes instead of
+        # degrading read-only for the rest of its life
+        self.wal_spare_dirs: list[str] = list(spare_dirs or [])
+        self._failover_lock = Lock()
+        self._failover_disabled = False  # set once a standby took over (split-brain guard)
+        self._no_spare_counted = False
+        self._media_state: dict[str, dict] = {}  # spare path → probe bookkeeping
+        self._reprobe_thread = None
         self.kv = MemKV()
         self.mvcc = MVCCStore(self.kv)
         self.mvcc.txn_live = self.txn_is_active
@@ -418,16 +466,28 @@ class Storage:
             self._open_durable(data_dir)
         elif self.wal_recovery_mode is None:
             self.wal_recovery_mode = self.RECOVERY_MODES[0]
+        if standby:
+            # shipped frames are journaled into OUR wal explicitly by
+            # receive_frames and then replayed with the journal DETACHED
+            # — kv/mvcc must not re-journal every applied record. The
+            # journals re-attach at promote().
+            self.kv.journal = None
+            self.mvcc.journal = None
 
     # --- IO-failure degrade (fsyncgate discipline) -------------------------
 
     def _wal_io_error(self, op: str) -> None:
         """Installed as the Wal's on_io_error hook: the first failed
         append/fsync lands here (before the writer sees StorageIOError)
-        and flips the store read-only for the rest of its life. The
-        gauge is STICKY for the process: a degraded store never heals
-        in-place (only a fresh process/Storage over healthy media does),
-        and another store's healthy open must not mask this one's state."""
+        and flips the store read-only. Without spare media that is the
+        end of the story (the PR 10 fsyncgate discipline: reopen on
+        healthy media in a fresh process); with `tidb_wal_spare_dirs`
+        configured the follow-up thread attempts an online rotation onto
+        a spare (writes resume, zero acks lost — every acked commit was
+        fsynced before this failure and the rotation snapshot captures
+        the full in-memory state). The hook itself only flags and
+        spawns: it runs under the failing Wal's append lock (and often
+        the kv lock), both of which the rotation needs free."""
         if self._io_degraded:
             return
         self._io_degraded = True
@@ -436,20 +496,243 @@ class Storage:
         M.WAL_DEGRADED.set(1)
         log.error(
             "WAL %s failed on %s: storage degraded read-only — commits "
-            "fail loud from here on, reads keep serving; reopen the store "
-            "on healthy media to write again", op, self.data_dir,
+            "fail loud from here on, reads keep serving; attempting "
+            "spare-dir failover (tidb_wal_spare_dirs=%r)",
+            op, self.data_dir, self.wal_spare_dirs,
         )
+        import threading as _threading
+
+        _threading.Thread(
+            target=self._degrade_followup, name="wal-failover", daemon=True
+        ).start()
+
+    def _degrade_followup(self) -> None:
+        """Async half of the degrade hook: try the spare rotation; if the
+        store stays degraded, hand the baton to the attached shipper
+        (auto-promote standby) or the background re-probe loop."""
+        try:
+            if self._attempt_wal_failover():
+                return
+            sh = self._shipper
+            if sh is not None and getattr(sh, "auto_promote", False) \
+                    and getattr(sh, "can_promote", False):
+                # the standby takes over: this store must NEVER heal
+                # afterwards — two writable stores over one history is
+                # split brain. Decide under the failover lock: a
+                # concurrent check_writable rotation that healed us in
+                # the window wins (no promote), and once the fence is
+                # set no queued rotation can slip through (the attempt
+                # re-checks the flag under the same lock).
+                with self._failover_lock:
+                    if not self._io_degraded:
+                        return
+                    self._failover_disabled = True
+                sh.on_primary_degraded()
+                return
+            if self.wal_spare_dirs:
+                self._start_reprobe()
+        except Exception:  # noqa: BLE001 — a follow-up thread must not die loud
+            log.exception("WAL failover follow-up failed")
 
     def check_writable(self) -> None:
-        """Raise StorageIOError when a WAL IO failure degraded the store.
-        Every write entry point (commit, pessimistic locking, checkpoint)
-        gates here so nothing can ack after the log went bad."""
+        """Raise when the store must not accept writes. Every write
+        entry point (commit, pessimistic locking, checkpoint) gates here
+        so nothing can ack after the log went bad — but a degraded store
+        with spare media first gets one (serialized) chance to rotate
+        and heal, so the next write after an IO failure resumes instead
+        of failing for the rest of the process."""
+        if self.standby:
+            raise StandbyReadOnly(
+                "store is a warm standby (replaying shipped WAL): writes "
+                "are rejected until ADMIN PROMOTE"
+            )
+        if self._io_degraded:
+            self._attempt_wal_failover()
         if self._io_degraded:
             raise StorageIOError(
                 "storage is read-only: a WAL IO failure poisoned the log "
                 "(no commit can ack durably); reads keep serving — reopen "
                 "the store on healthy media to restore writes"
             )
+
+    # --- online WAL media failover (PR 14) ---------------------------------
+
+    PROBE_COOLDOWN_S = 2.0  # min spacing between probes of failed media
+    PROBE_OK_STREAK = 2  # consecutive good probes before re-eligibility
+
+    def set_wal_spare_dirs(self, csv: str) -> None:
+        """SET GLOBAL tidb_wal_spare_dirs seam: comma-separated spare
+        paths tried in order on a WAL IO failure."""
+        self.wal_spare_dirs = [p.strip() for p in (csv or "").split(",") if p.strip()]
+        self._no_spare_counted = False
+
+    def _attempt_wal_failover(self) -> bool:
+        """Try to rotate the store onto a spare dir. Returns True when
+        the store is (already or now) healthy. Serialized: concurrent
+        committers queue on the failover lock for the few ms a rotation
+        takes, then find the store healed and proceed."""
+        if not self._io_degraded:
+            return True
+        if self._failover_disabled or self.wal is None or self.standby:
+            return False
+        from ..utils import metrics as M
+
+        spares = [
+            d for d in self.wal_spare_dirs
+            if os.path.abspath(d) != os.path.abspath(self.data_dir or "")
+        ]
+        if not spares:
+            if not self._no_spare_counted:
+                self._no_spare_counted = True
+                M.WAL_ROTATIONS.inc(outcome="no_spare")
+            return False
+        with self._failover_lock:
+            if not self._io_degraded:
+                return True
+            if self._failover_disabled:
+                # re-checked under the lock: a queued rotation must not
+                # slip past the split-brain fence set while it waited
+                return False
+            for cand in spares:
+                if not self._media_eligible(cand):
+                    continue
+                try:
+                    self._rotate_onto(cand)
+                except (OSError, StorageIOError) as e:
+                    # StorageIOError too: the fresh spare log's own
+                    # first sync can fail through the fsyncgate hook —
+                    # that spare is bad media like any other, and the
+                    # next candidate deserves its try
+                    self._media_state[cand] = {"last_fail": time.time(), "ok_streak": 0}
+                    M.WAL_ROTATIONS.inc(outcome="failed")
+                    log.warning("WAL failover onto %s failed: %s", cand, e)
+                    continue
+                M.WAL_ROTATIONS.inc(outcome="ok")
+                return True
+            return False
+
+    def _media_eligible(self, cand: str) -> bool:
+        """Hysteresis gate for failed media: after a failure the path
+        must sit out PROBE_COOLDOWN_S, then pass PROBE_OK_STREAK
+        consecutive write+fsync probes (spaced by the same cooldown)
+        before a rotation trusts it again — one lucky write on a
+        flapping disk is not a heal. Never-failed paths pass through."""
+        st = self._media_state.get(cand)
+        if st is None:
+            return True
+        now = time.time()
+        if now - st["last_fail"] < self.PROBE_COOLDOWN_S:
+            return False
+        if now - st.get("last_probe", 0.0) < self.PROBE_COOLDOWN_S:
+            return st["ok_streak"] >= self.PROBE_OK_STREAK
+        st["last_probe"] = now
+        if self._probe_media(cand):
+            st["ok_streak"] += 1
+        else:
+            st["last_fail"] = now
+            st["ok_streak"] = 0
+        return st["ok_streak"] >= self.PROBE_OK_STREAK
+
+    @staticmethod
+    def _probe_media(cand: str) -> bool:
+        try:
+            os.makedirs(cand, exist_ok=True)
+            p = os.path.join(cand, ".wal-probe")
+            with open(p, "wb") as f:
+                f.write(b"probe")
+                f.flush()
+                os.fsync(f.fileno())
+            os.unlink(p)
+            return True
+        except OSError:
+            return False
+
+    def _start_reprobe(self) -> None:
+        """Background re-probe: while degraded, periodically retry the
+        failover (which probes failed media under the hysteresis gate)."""
+        with self._proc_lock:
+            if self._reprobe_thread is not None and self._reprobe_thread.is_alive():
+                return
+            import threading as _threading
+
+            t = _threading.Thread(target=self._reprobe_loop, name="wal-reprobe", daemon=True)
+            self._reprobe_thread = t
+        t.start()
+
+    def _reprobe_loop(self) -> None:
+        while self._io_degraded and not self._failover_disabled:
+            time.sleep(self.PROBE_COOLDOWN_S / 2)
+            try:
+                if self._attempt_wal_failover():
+                    return
+            except Exception:  # noqa: BLE001 — the probe loop must survive
+                log.exception("WAL re-probe attempt failed")
+
+    def _rotate_onto(self, cand: str) -> None:
+        """Checkpoint-to-spare: under the kv lock (the same barrier a
+        checkpoint takes — journal-first writers hold it across
+        append+apply, so memory is exactly the durable state plus
+        fully-appended unacked residue), snapshot the full state into
+        the spare dir, open a fresh log there, swap the store over and
+        clear the degrade. Every acked commit was fsynced BEFORE the
+        failure and memory is a superset of fsynced state, so the
+        snapshot loses zero acks; unacked in-flight residue (prewrite
+        locks) recovers like any crash leftovers."""
+        from ..utils import metrics as M
+        from . import wal as w
+
+        os.makedirs(cand, exist_ok=True)
+        old_dir = self.data_dir
+        with self.kv.lock:
+            new_epoch = self._wal_epoch + 1
+            payload = self._snapshot_payload_locked(new_epoch)
+            w.snap_write(os.path.join(cand, "snapshot.bin"), payload)
+            if self.wal_recovery_mode:
+                # the RECOVERY_MODE sidecar follows the store to its new home
+                tmp = os.path.join(cand, "RECOVERY_MODE.tmp")
+                with open(tmp, "w") as f:
+                    f.write(self.wal_recovery_mode + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, os.path.join(cand, "RECOVERY_MODE"))
+            w.fsync_dir(cand)
+            # crashpoint: snapshot durable on the spare, store not yet
+            # swapped — the OLD dir (all acked commits fsynced there
+            # before the failure) and the spare snapshot must BOTH
+            # recover every ack
+            _fp("wal/rotate-after-checkpoint")
+            old = self.wal
+            self.data_dir = cand
+            self._wal_epoch = new_epoch
+            nw = w.Wal(self._wal_path(new_epoch), on_io_error=self._wal_io_error)
+            self.wal = nw
+            self.kv.journal = nw
+            self.mvcc.journal = nw
+            # supersede+close the old log BEFORE the new log's first
+            # sync: the durability carrier is the already-fsynced spare
+            # SNAPSHOT, not the fresh log — so the shipper may treat the
+            # old log's queued frames as durable (see Wal.durable_seq)
+            # even if this spare turns out bad below, and a failed
+            # nw.sync() leaves no leaked half-open wal for the next
+            # candidate's attempt
+            old._superseded = True
+            old.close()
+            nw.sync()
+            w.fsync_dir(cand)
+            self._io_degraded = False
+            self._no_spare_counted = False
+            M.WAL_DEGRADED.set(0)
+            sh = self._shipper
+            if sh is not None:
+                sh.install(nw)
+        # best-effort breadcrumb for operators (often on dead media)
+        try:
+            with open(os.path.join(old_dir, "FAILED_OVER_TO"), "w") as f:
+                f.write(cand + "\n")
+        except OSError:
+            pass
+        log.warning("WAL failover: %s -> %s (epoch %d); writes resumed",
+                    old_dir, cand, new_epoch)
 
     @property
     def io_degraded(self) -> bool:
@@ -742,26 +1025,73 @@ class Storage:
         committers batch into one leader fsync (`Wal.sync_group`), with
         the follower wait released through the shared interrupt gate.
         `SET GLOBAL tidb_wal_group_commit = OFF` recovers the exact
-        per-commit-fsync behavior live (incident fallback)."""
+        per-commit-fsync behavior live (incident fallback).
+
+        Semi-sync (`tidb_wal_semi_sync=ON`, PR 14): with a shipper
+        attached the ack additionally means durable-on-STANDBY — after
+        local durability the committer waits (through the same interrupt
+        gate) for the shipper to confirm the standby fsynced its frames.
+        The wait piggybacks the group-commit cadence: the shipper ships
+        per flushed group, so one standby fsync covers the whole group."""
         wal = self.wal
         if wal is None:
             return
+        sh = self._shipper
+        semi = (
+            sh is not None
+            and self.global_vars.get("tidb_wal_semi_sync", "OFF") == "ON"
+        )
+        # the committing statement's session/deadline (if any) let a KILL
+        # or max_execution_time release the follower/semi-sync wait; the
+        # commit is then INDETERMINATE (the leader's fsync may still land
+        # it) — the PR 10 contract for an error at the durability point,
+        # never a false ack
+        session = deadline = None
+        if semi or self.global_vars.get("tidb_wal_group_commit", "ON") == "ON":
+            from ..executor.executors import _ACTIVE_SESSION
+
+            session = _ACTIVE_SESSION.get()
+            deadline = getattr(session, "_deadline", None) if session is not None else None
         if self.global_vars.get("tidb_wal_group_commit", "ON") != "ON":
             from ..utils import metrics as M
 
             wal.sync()
             M.WAL_GROUP_COMMIT.inc(outcome="off")
-            return
-        # the committing statement's session/deadline (if any) let a KILL
-        # or max_execution_time release the follower wait; the commit is
-        # then INDETERMINATE (the leader's fsync may still land it) — the
-        # PR 10 contract for an error at the durability point, never a
-        # false ack
-        from ..executor.executors import _ACTIVE_SESSION
+        else:
+            wal.sync_group(session=session, deadline=deadline)
+        if semi:
+            sh.wait_durable(session=session, deadline=deadline)
 
-        session = _ACTIVE_SESSION.get()
-        deadline = getattr(session, "_deadline", None) if session is not None else None
-        wal.sync_group(session=session, deadline=deadline)
+    def _snapshot_payload_locked(self, epoch: int) -> bytes:
+        """Serialize the full in-memory state as a snapshot payload that
+        names `epoch` as the WAL epoch it subsumes. Caller MUST hold the
+        kv lock (the consistency barrier). Shared by checkpoint(), the
+        spare-dir failover rotation and the standby bootstrap."""
+        import struct
+
+        from . import wal as w
+
+        parts = [struct.pack("<Q", epoch), struct.pack("<Q", len(self.kv._keys))]
+        for k in self.kv._keys:
+            v = self.kv._map[k]
+            parts.append(struct.pack("<II", len(k), len(v)))
+            parts.append(k)
+            parts.append(v)
+        runs = list(self.mvcc.runs)
+        parts.append(struct.pack("<I", len(runs)))
+        for run in runs:
+            # compact killed rows out at snapshot time
+            if run.alive is not None:
+                keep = run.alive
+                km = run.key_mat[keep]
+                st = run.starts[keep]
+                ln = run.lens[keep]
+            else:
+                km, st, ln = run.key_mat, run.starts, run.lens
+            rec = w.rec_run(km, run.vbuf, st, ln, run.commit_ts)
+            parts.append(struct.pack("<Q", len(rec)))
+            parts.append(rec)
+        return b"".join(parts)
 
     def checkpoint(self) -> None:
         """Compact the WAL into an atomic snapshot file (the storage
@@ -772,33 +1102,12 @@ class Storage:
         # can no longer guarantee matches disk — refuse like any write
         self.check_writable()
         import os
-        import struct
 
         from . import wal as w
 
         with self.kv.lock:
             new_epoch = self._wal_epoch + 1
-            parts = [struct.pack("<Q", new_epoch), struct.pack("<Q", len(self.kv._keys))]
-            for k in self.kv._keys:
-                v = self.kv._map[k]
-                parts.append(struct.pack("<II", len(k), len(v)))
-                parts.append(k)
-                parts.append(v)
-            runs = list(self.mvcc.runs)
-            parts.append(struct.pack("<I", len(runs)))
-            for run in runs:
-                # compact killed rows out at checkpoint time
-                if run.alive is not None:
-                    keep = run.alive
-                    km = run.key_mat[keep]
-                    st = run.starts[keep]
-                    ln = run.lens[keep]
-                else:
-                    km, st, ln = run.key_mat, run.starts, run.lens
-                rec = w.rec_run(km, run.vbuf, st, ln, run.commit_ts)
-                parts.append(struct.pack("<Q", len(rec)))
-                parts.append(rec)
-            payload = b"".join(parts)
+            payload = self._snapshot_payload_locked(new_epoch)
             # snapshot names epoch E+1 and atomically renames BEFORE the
             # new log exists: a crash in between recovers from the
             # snapshot alone (the old epoch's log is simply ignored)
@@ -812,6 +1121,12 @@ class Storage:
             self.wal = w.Wal(self._wal_path(new_epoch), on_io_error=self._wal_io_error)
             self.kv.journal = self.wal
             self.mvcc.journal = self.wal
+            sh = self._shipper
+            if sh is not None:
+                # the ship tap follows the log across epoch rotations;
+                # the closed predecessor is fully durable, so its queued
+                # frames drain in order before the new epoch's
+                sh.install(self.wal)
             old.close()
             # the new log must be durably present in the dir BEFORE the
             # old one disappears (power-loss ordering)
@@ -824,6 +1139,83 @@ class Storage:
                 _fp("checkpoint/before-old-unlink")
                 os.unlink(old_path)
                 w.fsync_dir(self.data_dir)
+
+    # --- warm standby: shipped-frame ingest + promotion (PR 14) ------------
+
+    def receive_frames(self, payloads: list[bytes]) -> int:
+        """Standby ingest path (called by the WalShipper / StandbyServer):
+        journal every shipped frame into OUR wal (re-framed by the native
+        appender — fresh CRC chain, so a reopened standby replay-verifies
+        the shipped bytes for free), fsync ONCE per batch (the standby's
+        group commit), then replay into memory and advance the applied
+        watermark. Returns the total frames applied so far.
+
+        Order matters for the never-ahead invariant: the shipper only
+        hands us frames DURABLE on the primary, and we only ack (return)
+        after our own fsync — so `semi-sync acked ⇒ durable on standby`
+        and `standby state ⊆ primary durable state` both hold across a
+        SIGKILL at any point in this method."""
+        from ..utils import metrics as M
+        from . import wal as w
+        from .ship import frame_commit_ts, frame_table_prefix
+
+        with self._standby_lock:
+            if not self.standby:
+                raise TiDBError(
+                    "shipped frames refused: store is not (or no longer) a standby"
+                )
+            wal = self.wal
+            for p in payloads:
+                wal.append(p)
+                # crash/EIO site: frame journaled on the standby (maybe
+                # only buffered), batch not yet fsynced or applied — a
+                # death here may tear the standby log's tail, which
+                # recovery truncates; nothing was acked to semi-sync
+                _fp("wal/ship-mid-frame")
+            wal.sync()
+            applied = self.applied_ts
+            prefixes: set[bytes] = set()
+            for p in payloads:
+                w.apply_record(p, self.kv, self.mvcc)
+                ts = frame_commit_ts(p)
+                if ts > applied:
+                    applied = ts
+                pref = frame_table_prefix(p)
+                if pref is not None:
+                    prefixes.add(pref)
+            if prefixes:
+                # replayed frames must invalidate tile/cop-result caches
+                # exactly like a local commit would
+                self.bump_version(sorted(prefixes))
+            self.applied_ts = applied
+            self._applied_frames += len(payloads)
+            M.STANDBY_APPLIED_TS.set(float(applied))
+            return self._applied_frames
+
+    def promote(self) -> None:
+        """ADMIN PROMOTE: flip a warm standby read-write. Serialized
+        against receive_frames on the standby lock, so a promote issued
+        while a ship batch is mid-frame waits for the batch to land and
+        every later batch is refused — the shipper observes the flip and
+        stops. Double promote (or promoting a store that never was a
+        standby) is rejected."""
+        with self._standby_lock:
+            if not self.standby:
+                raise TiDBError(
+                    "ADMIN PROMOTE: store is not a standby (already primary; "
+                    "double promote rejected)"
+                )
+            self.standby = False
+            # re-attach the journals: from here every mutation journals
+            # through the normal primary path
+            self.kv.journal = self.wal
+            self.mvcc.journal = self.wal
+            self.wal.sync()
+        log.warning(
+            "standby PROMOTED to primary (data_dir=%s, applied_ts=%d, "
+            "%d shipped frames applied)",
+            self.data_dir, self.applied_ts, self._applied_frames,
+        )
 
     @property
     def plugins(self):

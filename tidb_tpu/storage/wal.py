@@ -140,6 +140,14 @@ class Wal:
         # (entrants arriving mid-fsync with later targets stay queued
         # for the NEXT group instead of being silently absorbed)
         self._group_targets: list[int] = []
+        # --- log shipping (PR 14) -----------------------------------------
+        # `tap(wal, seq, payload)` observes every accepted append (called
+        # under `_lock`, in append order — it must only enqueue, never
+        # block); `on_durable(wal, covered_seq)` fires when `_flushed_seq`
+        # advances (under `_gc_cond`) so a shipper can wake without
+        # polling. Installed by storage/ship.WalShipper via Storage.
+        self.tap = None
+        self.on_durable = None
 
     def _io_failed(self, op: str, cause) -> None:
         """First failure poisons the log; callers see a typed error."""
@@ -172,6 +180,8 @@ class Wal:
             if self.lib.wal_append(self._h, payload, len(payload)) < 0:
                 self._io_failed("append", "native append error")
             self._appended_seq += 1
+            if self.tap is not None:
+                self.tap(self, self._appended_seq, payload)
         # durability-gap crashpoint: record buffered, nothing fsynced yet
         _fp("wal/after-append-before-sync")
 
@@ -203,6 +213,8 @@ class Wal:
             # waiters this fsync satisfied leave the queue uncounted —
             # the size histogram is leader-observed groups only
             self._group_targets = [t for t in self._group_targets if t > covered]
+            if self.on_durable is not None:
+                self.on_durable(self, covered)
             self._gc_cond.notify_all()
         return covered
 
@@ -280,6 +292,8 @@ class Wal:
                 self._sync_leader = False
                 if covered >= 0:
                     self._flushed_seq = max(self._flushed_seq, covered)
+                    if self.on_durable is not None:
+                        self.on_durable(self, covered)
                     # the group = exactly the registered committers this
                     # fsync covered (leader included); later targets stay
                     # queued for the next leader
@@ -295,6 +309,27 @@ class Wal:
                     self._group_targets.clear()
                     M.WAL_GROUP_COMMIT.inc(outcome="error")
                 self._gc_cond.notify_all()
+
+    def durable_seq(self) -> int:
+        """Highest record sequence KNOWN durable on this log. A cleanly
+        closed log (checkpoint rotation flushed + fsynced everything) is
+        durable through its whole append count; a poisoned log is durable
+        only through the last successful fsync — frames past that must
+        never ship to a standby (they may be gone with the page cache).
+        A superseded log (spare-dir rotation snapshotted its in-memory
+        effects) is fully durable THROUGH THE SNAPSHOT, which the
+        rotation records by setting `_superseded`."""
+        if getattr(self, "_superseded", False):
+            with self._lock:
+                return self._appended_seq
+        with self._lock:
+            closed = self._h is None
+            appended = self._appended_seq
+            poisoned = self.poisoned
+        if closed and not poisoned:
+            return appended
+        with self._gc_cond:
+            return self._flushed_seq
 
     def close(self) -> None:
         with self._lock:

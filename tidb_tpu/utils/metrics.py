@@ -461,8 +461,10 @@ WAL_IO_ERRORS = REGISTRY.counter(
 WAL_DEGRADED = REGISTRY.gauge(
     "tidb_wal_degraded",
     "a store in this process hit a WAL IO failure and degraded read-only "
-    "(0 ok, 1 degraded; sticky — a degraded store never heals in-place, "
-    "recovery means reopening on healthy media in a fresh process)",
+    "(0 ok, 1 degraded; sticky until a successful spare-dir rotation — "
+    "tidb_wal_rotations_total records the heals; without a spare the "
+    "store never heals in-place and recovery means reopening on healthy "
+    "media in a fresh process)",
 )
 WAL_RECOVERY_DROPPED = REGISTRY.counter(
     "tidb_wal_recovery_dropped_bytes_total",
@@ -483,4 +485,28 @@ WAL_GROUP_SIZE = REGISTRY.histogram(
     "tidb_wal_group_commit_size",
     "committers covered by one group fsync (observed by the leader)",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+
+# --- warm-standby WAL shipping + online media failover (PR 14) --------------
+# the shipper streams DURABLE (fsynced) WAL frames to a standby data dir
+# (storage/ship.py); the lag gauge is the age of the oldest frame still
+# waiting to ship (0 when fully caught up), the applied-ts gauge is the
+# newest commit_ts the standby has replayed into its MVCC state
+WAL_SHIP_LAG = REGISTRY.gauge(
+    "tidb_wal_ship_lag_seconds",
+    "age of the oldest primary WAL frame not yet durably shipped to the "
+    "standby (0 = caught up)",
+)
+STANDBY_APPLIED_TS = REGISTRY.gauge(
+    "tidb_standby_applied_ts",
+    "newest commit_ts the standby store has replayed from shipped frames",
+)
+# online WAL media failover: on an IO failure a store with
+# tidb_wal_spare_dirs checkpoints onto a spare and resumes writes
+# (outcome=ok); a spare that fails the attempt counts outcome=failed and
+# joins the re-probe list; outcome=no_spare marks a degrade episode that
+# found no eligible spare and stayed read-only (the pre-PR-14 behavior)
+WAL_ROTATIONS = REGISTRY.counter(
+    "tidb_wal_rotations_total",
+    "WAL media-failover rotation attempts by outcome (ok | failed | no_spare)",
 )

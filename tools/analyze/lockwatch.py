@@ -240,7 +240,9 @@ def _targets():
     from tidb_tpu.storage import detector as _detector
     from tidb_tpu.storage import memkv as _memkv
     from tidb_tpu.storage import regions as _regions
+    from tidb_tpu.storage import ship as _ship
     from tidb_tpu.storage import tso as _tso
+    from tidb_tpu.storage import txn as _txn
     from tidb_tpu.storage import wal as _wal
     from tidb_tpu.utils import failpoint as _failpoint
     from tidb_tpu.utils import memory as _memory
@@ -277,6 +279,10 @@ def _targets():
         (_memkv.MemKV, "lock", "memkv", False),
         (_wal.Wal, "_lock", "wal", False),
         (_wal.Wal, "_gc_cond", "wal.group", True),
+        # PR 14: warm-standby shipping + online WAL failover
+        (_ship.WalShipper, "_cond", "wal.ship", True),
+        (_txn.Storage, "_standby_lock", "standby", False),
+        (_txn.Storage, "_failover_lock", "storage.failover", False),
         (_regions.RegionMap, "_lock", "regions", False),
         (_tso.TSO, "_lock", "tso", False),
         (_detector.DeadlockDetector, "_lock", "detector", False),

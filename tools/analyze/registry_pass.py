@@ -43,7 +43,8 @@ _SCOPED_SYSVAR_PREFIXES = ("tidb_tpu_",)
 _SCOPED_SYSVARS = {
     "tidb_enable_trace", "tidb_enable_timeline", "tidb_trace_ring_capacity",
     "tidb_timeline_ring_capacity", "tidb_backoff_budget_ms",
-    "tidb_wal_recovery_mode",
+    "tidb_wal_recovery_mode", "tidb_wal_group_commit",
+    "tidb_wal_semi_sync", "tidb_wal_spare_dirs",
 }
 
 _UPDATE_METHODS = {"inc", "observe", "set", "add"}

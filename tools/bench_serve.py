@@ -230,20 +230,24 @@ class Stats:
         self.lat: list[float] = []
         self.errors = 0
         self.retries = 0
+        self.indeterminate = 0
         self._lock = threading.Lock()
 
-    def add(self, samples: list[float], errs: int, retries: int = 0) -> None:
+    def add(self, samples: list[float], errs: int, retries: int = 0,
+            indeterminate: int = 0) -> None:
         with self._lock:
             self.lat.extend(samples)
             self.errors += errs
             self.retries += retries
+            self.indeterminate += indeterminate
 
     def summary(self, secs: float) -> dict:
         lat = sorted(self.lat)
         n = len(lat)
         if not n:
             return {"qps": 0.0, "p50_ms": None, "p99_ms": None, "n": 0,
-                    "errors": self.errors, "retries": self.retries}
+                    "errors": self.errors, "retries": self.retries,
+                    "indeterminate": self.indeterminate}
         return {
             "qps": round(n / secs, 1),
             "p50_ms": round(lat[n // 2] * 1e3, 3),
@@ -251,6 +255,10 @@ class Stats:
             "n": n,
             "errors": self.errors,
             "retries": self.retries,
+            # commits that failed AT the durability point (typed 8150 —
+            # outcome unknown, ack withheld) vs determinate failures:
+            # an operator retries the latter blindly, never the former
+            "indeterminate": self.indeterminate,
         }
 
 
@@ -330,7 +338,7 @@ def _drive(clients: list[MiniClient], op: str, secs: float) -> Stats:
         rng = random.Random(1000 + idx)
         stmt_id = cli._ps[op]
         samples: list[float] = []
-        errs = retries = 0
+        errs = retries = indet = 0
         barrier.wait()
         end = time.perf_counter() + secs
         while time.perf_counter() < end:
@@ -343,10 +351,12 @@ def _drive(clients: list[MiniClient], op: str, secs: float) -> Stats:
                     if any(s in str(e) for s in _RETRYABLE):
                         retries += 1
                         continue
+                    if "server error 8150" in str(e):
+                        indet += 1  # indeterminate commit: never blind-retried
                     errs += 1
                     break
             samples.append(time.perf_counter() - t0)
-        stats.add(samples, errs, retries)
+        stats.add(samples, errs, retries, indet)
 
     threads = [
         threading.Thread(target=loop, args=(i, c), daemon=True) for i, c in enumerate(clients)
@@ -402,6 +412,7 @@ def run_bench(clients_n: int, secs: float, host: str, port: int) -> dict:
         "per_commit_off": {k: med(off_s, k) for k in ("qps", "p50_ms", "p99_ms")},
         "paired_qps_ratio_median": round(statistics.median(ratios), 2) if ratios else 0.0,
         "errors": sum(s["errors"] for s in on_s + off_s),
+        "indeterminate": sum(s.get("indeterminate", 0) for s in on_s + off_s),
         "conflict_retries": sum(s["retries"] for s in on_s + off_s),
         "slices": {"on": on_s, "off": off_s},
     }

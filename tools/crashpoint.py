@@ -75,7 +75,24 @@ CRASHPOINTS = {
     "checkpoint/after-snap-rename": 2,
     "checkpoint/before-old-unlink": 2,
     "ddl/mid-reorg": 3,
+    # PR 14: die mid-ship (frame journaled on the standby, batch not yet
+    # fsynced/applied) — the standby log's torn tail must truncate and
+    # the standby must never end up ahead of the primary's durable state
+    "wal/ship-mid-frame": 150,
+    # PR 14: die right after the spare-dir rotation wrote its snapshot
+    # (before the store swapped over) — BOTH the old dir and the spare
+    # snapshot must recover every ack (an EIO is injected to trigger the
+    # rotation; see _child_main)
+    "wal/rotate-after-checkpoint": 1,
 }
+
+# per-site child topology: which sites run with an in-process warm
+# standby (semi-sync ON — the acked⇒on-standby invariant is the point)
+# and which get a spare WAL dir + an injected EIO to trigger rotation
+NEEDS_STANDBY = {"wal/ship-mid-frame"}
+NEEDS_SPARE = {"wal/rotate-after-checkpoint"}
+# EIO trigger for the rotation site: fail the nth wal fsync
+ROTATE_EIO_NTH = 25
 
 TXN_GROUP_ROWS = 3  # rows per explicit txn (the atomicity unit)
 IDX_ROWS = 400  # t_idx population (reorg batch 32 → ~13 backfill batches)
@@ -101,8 +118,12 @@ def _child_main(args) -> None:
         with out_lock:
             print(line, flush=True)
 
-    store = Storage(data_dir=args.data_dir)
-    store.cdc.subscribe(FileSink(args.cdc))
+    spares = [args.spare_dir] if args.spare_dir else None
+    store = Storage(data_dir=args.data_dir, spare_dirs=spares)
+    # durable CDC sink (PR 14): fsync per batch + size rotation, so the
+    # CDC-not-ahead invariant is checked against bytes that really
+    # survived the SIGKILL, not page cache the crash may have flushed
+    store.cdc.subscribe(FileSink(args.cdc, durable=True, rotate_bytes=256 << 10))
 
     boot = Session(store)
     boot.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
@@ -112,11 +133,28 @@ def _child_main(args) -> None:
         vals = ", ".join(f"({i}, {i % 97})" for i in range(lo, min(lo + 100, IDX_ROWS)))
         boot.execute(f"INSERT INTO t_idx VALUES {vals}")
     store.wal_sync()
+
+    if args.standby_dir:
+        # warm standby (PR 14): bootstrap from a snapshot of the running
+        # primary (subscribe-after-checkpoint), attach the in-process
+        # ship loop, and — for the acked⇒on-standby invariant — flip
+        # semi-sync so every printed ack means durable on BOTH dirs
+        from tidb_tpu.storage.ship import WalShipper
+
+        ship = WalShipper(store)
+        ship.bootstrap(args.standby_dir)
+        standby = Storage(data_dir=args.standby_dir, standby=True)
+        ship.attach(standby)
+        if args.semi_sync:
+            store.global_vars["tidb_wal_semi_sync"] = "ON"
     say("READY")
 
     # arm AFTER setup: the nth counters must count workload hits only
     if args.crashpoint:
         FP.enable(args.crashpoint, ("nth", CRASHPOINTS[args.crashpoint], ("crash",)))
+        if args.crashpoint == "wal/rotate-after-checkpoint":
+            # the rotation only starts after a real WAL IO failure
+            FP.enable("wal/io-error-sync", ("nth", ROTATE_EIO_NTH, OSError(5, "injected EIO")))
 
     stop = time.time() + args.max_seconds
 
@@ -216,9 +254,11 @@ def _collect_acks(lines: list[str]) -> dict:
     return acks
 
 
-def _verify(data_dir: str, cdc_path: str, acks: dict) -> None:
+def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
     """Reopen the survivor directory and prove every invariant; raises
-    Violation with the first broken one."""
+    Violation with the first broken one. Returns the recovered primary
+    state ({"dml": {id: v}, "txn_groups": {g: row_count}}) so standby
+    verification can prove the never-ahead invariant against it."""
     from tidb_tpu.errors import TiDBError, WalCorruptionError
     from tidb_tpu.session import Session
     from tidb_tpu.storage.txn import Storage
@@ -279,9 +319,11 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> None:
     # --- CDC never ahead of durable state: every complete sink event must
     # name a commit_ts that MVCC actually holds for that key (publish
     # happens only after wal_sync, so a crash can lose sink lines — never
-    # invent them)
-    if os.path.exists(cdc_path):
-        with open(cdc_path) as f:
+    # invent them). The durable sink rotates by size: read every segment.
+    from tidb_tpu.cdc import FileSink
+
+    for seg in FileSink.segments(cdc_path):
+        with open(seg) as f:
             for raw in f:
                 raw = raw.strip()
                 if not raw:
@@ -310,6 +352,118 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> None:
     t.commit()
 
     store.wal.close()
+    return {"dml": dml_rows, "txn_groups": by_group}
+
+
+def _verify_standby(standby_dir: str, primary: dict, acks: dict,
+                    semi_sync: bool) -> None:
+    """Reopen the standby survivor dir, PROMOTE it, and prove the
+    replication invariants:
+
+      * recovery succeeds (a mid-ship SIGKILL may only tear the standby
+        log's tail — shipped bytes re-framed through the native appender
+        carry their own CRC chain);
+      * never ahead: every standby row exists identically in the
+        primary's recovered (= durable) state — the shipper only ships
+        fsynced frames, so a crashed primary can never come back BEHIND
+        its standby;
+      * txn atomicity holds after promotion (first reads roll shipped
+        but uncommitted-looking locks forward/back via the primary key);
+      * with semi-sync ON: every acked commit is fully visible on the
+        PROMOTED standby — the ack meant durable on both dirs;
+      * the promoted standby accepts writes."""
+    from tidb_tpu.errors import TiDBError, WalCorruptionError
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+
+    try:
+        store = Storage(data_dir=standby_dir, standby=True)
+    except WalCorruptionError as e:
+        raise Violation(f"standby crash produced non-torn-tail damage: {e}") from e
+    try:
+        store.promote()
+    except TiDBError as e:
+        raise Violation(f"standby promotion failed: {e}") from e
+    s = Session(store)
+    try:
+        dml = {int(r[0]): int(r[1]) for r in s.must_query("SELECT id, v FROM t_dml")}
+        txn_rows = s.must_query("SELECT id, g, total FROM t_txn")
+    except TiDBError as e:
+        raise Violation(f"post-promote read failed on the standby: {e}") from e
+
+    for i, v in sorted(dml.items()):
+        if primary["dml"].get(i) != v:
+            raise Violation(
+                f"standby AHEAD of primary durable state: t_dml row {i}={v} "
+                f"has no identical durable row on the primary"
+            )
+    by_group: dict[int, int] = {}
+    for _id, g, total in txn_rows:
+        g = int(g)
+        if int(total) != TXN_GROUP_ROWS:
+            raise Violation(f"standby txn group {g} row carries total={total}")
+        by_group[g] = by_group.get(g, 0) + 1
+    for g, cnt in sorted(by_group.items()):
+        if cnt != TXN_GROUP_ROWS:
+            raise Violation(
+                f"standby txn group {g} is PARTIAL after promote "
+                f"({cnt}/{TXN_GROUP_ROWS} rows)"
+            )
+        if primary["txn_groups"].get(g) != TXN_GROUP_ROWS:
+            raise Violation(
+                f"standby AHEAD of primary durable state: txn group {g} "
+                f"is not durable on the primary"
+            )
+    if semi_sync:
+        for i in sorted(acks["dml"]):
+            if dml.get(i) != i * 3:
+                raise Violation(
+                    f"semi-sync acked DML row {i} missing on the promoted standby"
+                )
+        for g in sorted(acks["txn"]):
+            if by_group.get(g) != TXN_GROUP_ROWS:
+                raise Violation(
+                    f"semi-sync acked txn group {g} not fully visible on the "
+                    f"promoted standby"
+                )
+
+    # --- the promoted standby must accept writes
+    t = store.begin()
+    t.put(b"zz-standby-probe", b"1")
+    t.commit()
+    store.wal.close()
+
+
+def _verify_spare_snapshot(spare_dir: str, acks: dict) -> None:
+    """The rotate-after-checkpoint crash fires with the spare's snapshot
+    durable but the store not yet swapped: recovery from the spare ALONE
+    must already hold every ack (the snapshot cut is a superset of the
+    fsynced state)."""
+    from tidb_tpu.errors import TiDBError, WalCorruptionError
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+
+    if not os.path.exists(os.path.join(spare_dir, "snapshot.bin")):
+        raise Violation(
+            "rotate-after-checkpoint crashed but the spare dir holds no "
+            "snapshot — the crash site fired before its durability point?"
+        )
+    try:
+        store = Storage(data_dir=spare_dir)
+    except (WalCorruptionError, TiDBError) as e:
+        raise Violation(f"spare snapshot does not recover: {e}") from e
+    s = Session(store)
+    dml = {int(r[0]): int(r[1]) for r in s.must_query("SELECT id, v FROM t_dml")}
+    for i in sorted(acks["dml"]):
+        if dml.get(i) != i * 3:
+            raise Violation(f"acked DML row {i} missing from the spare snapshot")
+    by_group: dict[int, int] = {}
+    for _id, g, _t in s.must_query("SELECT id, g, total FROM t_txn"):
+        by_group[int(g)] = by_group.get(int(g), 0) + 1
+    for g in sorted(acks["txn"]):
+        if by_group.get(g) != TXN_GROUP_ROWS:
+            raise Violation(f"acked txn group {g} partial in the spare snapshot")
+    store.wal.close()
 
 
 def run_round(
@@ -318,17 +472,31 @@ def run_round(
     keep: bool = False,
     max_seconds: float = 45.0,
     kill_after: float | None = None,
+    standby: bool = False,
+    semi_sync: bool = False,
 ) -> tuple[bool, str]:
-    """One spawn→kill→verify cycle. → (ok, detail)."""
+    """One spawn→kill→verify cycle. → (ok, detail). `standby=True` runs
+    the child with an in-process warm standby (kill-primary→promote
+    verification); named sites pull their topology from NEEDS_*."""
     rng = random.Random(seed)
     workdir = tempfile.mkdtemp(prefix="crashpoint-")
     data_dir = os.path.join(workdir, "data")
     cdc_path = os.path.join(workdir, "cdc.jsonl")
+    standby = standby or crashpoint in NEEDS_STANDBY
+    semi_sync = semi_sync or crashpoint in NEEDS_STANDBY
+    spare_dir = os.path.join(workdir, "spare") if crashpoint in NEEDS_SPARE else None
+    standby_dir = os.path.join(workdir, "standby") if standby else None
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
         "--data-dir", data_dir, "--cdc", cdc_path,
         "--seed", str(seed), "--max-seconds", str(max_seconds),
     ]
+    if standby_dir:
+        cmd += ["--standby-dir", standby_dir]
+        if semi_sync:
+            cmd += ["--semi-sync"]
+    if spare_dir:
+        cmd += ["--spare-dir", spare_dir]
     if crashpoint:
         cmd += ["--crashpoint", crashpoint]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -391,7 +559,11 @@ def run_round(
 
     acks = _collect_acks(lines)
     try:
-        _verify(data_dir, cdc_path, acks)
+        primary_state = _verify(data_dir, cdc_path, acks)
+        if standby_dir:
+            _verify_standby(standby_dir, primary_state, acks, semi_sync)
+        if spare_dir:
+            _verify_spare_snapshot(spare_dir, acks)
     except Violation as e:
         # keep the survivor dir: it IS the evidence
         return False, f"INVARIANT VIOLATION: {e} [survivor dir kept: {workdir}]"
@@ -402,6 +574,8 @@ def run_round(
     detail = (
         f"acks: dml={len(acks['dml'])} txn={len(acks['txn'])} "
         f"ddl={len(acks['ddl'])} ckpt={acks['ckpt']}"
+        + (" [standby promoted+verified]" if standby_dir else "")
+        + (" [spare snapshot verified]" if spare_dir else "")
     )
     return True, detail
 
@@ -411,11 +585,20 @@ def main() -> int:
     ap.add_argument("--child", action="store_true", help="(internal) workload child")
     ap.add_argument("--data-dir")
     ap.add_argument("--cdc")
+    ap.add_argument("--standby-dir", default=None,
+                    help="(child) run an in-process warm standby over this dir")
+    ap.add_argument("--semi-sync", action="store_true",
+                    help="(child) tidb_wal_semi_sync=ON: acks mean durable on both dirs")
+    ap.add_argument("--spare-dir", default=None,
+                    help="(child) tidb_wal_spare_dirs for online WAL failover")
     ap.add_argument("--crashpoint", choices=sorted(CRASHPOINTS), default=None)
     ap.add_argument("--matrix", action="store_true",
                     help="run every named crashpoint once")
     ap.add_argument("--rounds", type=int, default=0,
                     help="seeded random-SIGKILL rounds")
+    ap.add_argument("--failover-rounds", type=int, default=0,
+                    help="random kill-primary→promote→verify rounds "
+                         "(in-process standby, semi-sync ON)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--keep", action="store_true", help="keep survivor dirs")
     ap.add_argument("--max-seconds", type=float, default=45.0)
@@ -428,22 +611,27 @@ def main() -> int:
     seed = args.seed if args.seed is not None else random.SystemRandom().randrange(1 << 30)
     print(f"crashpoint harness: seed={seed} (replay with --seed {seed})", flush=True)
 
-    plan: list[tuple[str | None, int]] = []
+    plan: list[tuple[str | None, int, bool]] = []
     if args.matrix:
-        plan += [(cp, seed + i) for i, cp in enumerate(sorted(CRASHPOINTS))]
+        plan += [(cp, seed + i, False) for i, cp in enumerate(sorted(CRASHPOINTS))]
     if args.crashpoint:
-        plan.append((args.crashpoint, seed))
+        plan.append((args.crashpoint, seed, False))
     for i in range(args.rounds):
-        plan.append((None, seed + 1000 + i))
+        plan.append((None, seed + 1000 + i, False))
+    for i in range(args.failover_rounds):
+        plan.append((None, seed + 2000 + i, True))
     if not plan:
-        ap.error("nothing to do: pass --matrix, --crashpoint, and/or --rounds N")
+        ap.error("nothing to do: pass --matrix, --crashpoint, --rounds N "
+                 "and/or --failover-rounds N")
 
     failures = 0
     t0 = time.time()
-    for i, (cp, round_seed) in enumerate(plan):
-        label = cp or f"random-kill[{round_seed}]"
+    for i, (cp, round_seed, fo) in enumerate(plan):
+        label = cp or (f"kill-primary-promote[{round_seed}]" if fo
+                       else f"random-kill[{round_seed}]")
         ok, detail = run_round(cp, round_seed, keep=args.keep,
-                               max_seconds=args.max_seconds)
+                               max_seconds=args.max_seconds,
+                               standby=fo, semi_sync=fo)
         status = "ok" if ok else "FAIL"
         print(f"  [{i + 1}/{len(plan)}] {label}: {status} — {detail}", flush=True)
         if not ok:

@@ -38,10 +38,13 @@ while [ $# -gt 0 ]; do
   esac
 done
 python -m tools.analyze $ANALYZE_ARGS || exit 1
-# real-process crash matrix (PR 10): each named crashpoint once against a
-# live child process, deterministic seed — the full seeded random-kill
-# soak (≥30 rounds) lives under `pytest -m slow` / crashpoint.py --rounds
-env JAX_PLATFORMS=cpu python tools/crashpoint.py --matrix --seed 7 || exit 1
+# real-process crash matrix (PR 10, extended PR 14): each named
+# crashpoint once against a live child process (incl. the warm-standby
+# ship-mid-frame and spare-dir rotate-after-checkpoint sites) plus one
+# kill-primary→promote→verify round, deterministic seed — the full
+# seeded random-kill and ≥30-round failover soaks live under
+# `pytest -m slow` / crashpoint.py --rounds/--failover-rounds
+env JAX_PLATFORMS=cpu python tools/crashpoint.py --matrix --failover-rounds 1 --seed 7 || exit 1
 if [ "$RUN_BENCH" = "1" ]; then
   for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp bench_serve; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
