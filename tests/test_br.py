@@ -90,19 +90,76 @@ class TestLoadData:
         )
         assert s.must_query("SELECT v FROM t WHERE id = 21") == [("y",)]
 
+    @staticmethod
+    def _seed_ckpt(s, p, rows_done: int) -> str:
+        import json
+
+        import tidb_tpu.br.importer as imp
+
+        cpath = imp.ckpt_path(s.store, p, "test.t", os.stat(p).st_mtime_ns)
+        os.makedirs(os.path.dirname(cpath), exist_ok=True)
+        with open(cpath, "w") as f:
+            f.write(json.dumps({
+                "table": "test.t", "rows_done": rows_done,
+                "path": os.path.abspath(p),
+            }))
+        return cpath
+
     def test_checkpoint_resume(self, s, tmp_path, monkeypatch):
         import tidb_tpu.br.importer as imp
 
         monkeypatch.setattr(imp, "BATCH_ROWS", 10)
         lines = [f"{1000 + i},r{i},{i}.00" for i in range(35)]
         p = self._write_csv(tmp_path, lines)
-        # simulate a crash after 2 batches: pre-seed the checkpoint
-        with open(p + ".ckpt", "w") as f:
-            import json
-
-            f.write(json.dumps({"table": "test.t", "rows_done": 20}))
+        # simulate a crash after 2 batches: pre-seed the checkpoint (now
+        # in the DATA dir keyed by path+table+mtime, not next to the
+        # input file). A non-zero resume point forces the legacy txn
+        # path — the bulk route must never re-ingest committed rows.
+        cpath = self._seed_ckpt(s, p, 20)
         r = s.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t FIELDS TERMINATED BY ','")
         assert r.affected == 15  # only rows 20..34 imported on resume
-        assert not os.path.exists(p + ".ckpt")
+        assert not os.path.exists(cpath)
         assert s.must_query("SELECT COUNT(*) FROM t WHERE id >= 1020") == [("15",)]
         assert s.must_query("SELECT COUNT(*) FROM t WHERE id >= 1000 AND id < 1020") == [("0",)]
+
+    def test_ckpt_not_next_to_input_readonly_dir(self, s, tmp_path, monkeypatch):
+        """The sidecar must not be written next to the user's input file:
+        a read-only input dir has to work (legacy path included)."""
+        import tidb_tpu.br.importer as imp
+
+        monkeypatch.setattr(imp, "BATCH_ROWS", 10)
+        sub = tmp_path / "ro"
+        sub.mkdir()
+        p = str(sub / "in.csv")
+        with open(p, "w") as f:
+            f.write("\n".join(f"{2000 + i},x{i},1.00" for i in range(25)) + "\n")
+        os.chmod(sub, 0o555)
+        try:
+            r = s.execute(
+                f"LOAD DATA INFILE '{p}' INTO TABLE t FIELDS TERMINATED BY ',' "
+                f"WITH bulk_ingest=0"
+            )
+        finally:
+            os.chmod(sub, 0o755)
+        assert r.affected == 25
+        assert not os.path.exists(p + ".ckpt")
+
+    def test_reedited_file_does_not_resume(self, s, tmp_path, monkeypatch):
+        """A checkpoint keyed to an OLDER mtime must not make a re-edited
+        file silently resume mid-file."""
+        import tidb_tpu.br.importer as imp
+
+        monkeypatch.setattr(imp, "BATCH_ROWS", 10)
+        lines = [f"{3000 + i},r{i},{i}.00" for i in range(30)]
+        p = self._write_csv(tmp_path, lines)
+        cpath = self._seed_ckpt(s, p, 20)
+        # re-edit: same path, new content → new mtime → fresh ckpt key
+        os.utime(p, ns=(os.stat(p).st_atime_ns, os.stat(p).st_mtime_ns + 10_000_000))
+        r = s.execute(
+            f"LOAD DATA INFILE '{p}' INTO TABLE t FIELDS TERMINATED BY ',' "
+            f"WITH bulk_ingest=0"
+        )
+        assert r.affected == 30  # full import, no bogus resume
+        assert s.must_query("SELECT COUNT(*) FROM t WHERE id >= 3000") == [("30",)]
+        # completion sweeps stale-mtime checkpoints of the same file
+        assert not os.path.exists(cpath)

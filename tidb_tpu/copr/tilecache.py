@@ -344,10 +344,73 @@ def decode_rows_to_batch(table: TableInfo, kvs: list[tuple[bytes, bytes]], versi
     return ColumnBatch(table, handles, [c.data for c in cols], [c.valid for c in cols], version)
 
 
+def _gather_columnar(table: TableInfo, cols, run, keep: np.ndarray,
+                     rows_idx: np.ndarray) -> None:
+    """ColumnarRun fast path: copy the run's column arrays straight into
+    the chunk columns — no v2 row decode, no byte-matrix gather. Mirrors
+    decode_v2_batch's routing exactly (decimal rescale to the table's
+    scale, float/uint bit views, ascii/utf8 strings, defaults for table
+    columns the run doesn't carry)."""
+    from ..mysqltypes.datum import K_DEC, K_STR
+    from ..table.table import datum_from_default
+
+    by_id = {c.id: c for c in table.columns}
+    contiguous = len(keep) == run.n  # whole-run scans skip the gather copy
+    present: set[int] = set()
+    for spec in run.cols:
+        c = by_id.get(spec.cid)
+        if c is None:
+            continue
+        present.add(spec.cid)
+        col = cols[c.offset]
+        data = spec.data if contiguous else spec.data[keep]
+        if data.dtype.kind == "O":
+            # still-object str lane: already the chunk form — no decode
+            col.data[rows_idx] = data
+        elif data.dtype.kind == "S":
+            w = data.dtype.itemsize
+            if spec.kind != K_STR:  # K_BYTES lanes keep bytes payloads
+                strs = np.array([bytes(x) for x in data], dtype=object)
+            elif w == 0:
+                strs = np.full(len(rows_idx), "", dtype=object)
+            elif (data.view(np.uint8) >= 0x80).any():  # non-ascii → utf8 per row
+                strs = np.array([bytes(x).decode("utf8") for x in data], dtype=object)
+            else:
+                strs = data.astype("U").astype(object)
+            col.data[rows_idx] = strs
+        else:
+            vals = data
+            if spec.kind == K_DEC:
+                want = max(c.ft.decimal, 0)
+                sc = spec.scale
+                if want != sc:
+                    vals = vals * 10 ** (want - sc) if want > sc else vals // 10 ** (sc - want)
+            col.data[rows_idx] = vals.astype(col.data.dtype, copy=False)
+        if spec.valid is None:
+            col.valid[rows_idx] = True
+        else:
+            col.valid[rows_idx] = spec.valid if contiguous else spec.valid[keep]
+    for c in table.columns:
+        if c.id in present:
+            continue
+        if c.hidden and c.name == "_tidb_rowid":
+            continue  # caller fills from handles
+        d = datum_from_default(c)
+        col = cols[c.offset]
+        if d.is_null:
+            col.valid[rows_idx] = False
+        else:
+            for i in rows_idx:
+                col.set_datum(int(i), d)
+
+
 def build_batch_from_segments(table: TableInfo, segs, loose, version) -> ColumnBatch:
     """Segment scan results → columnar batch, gathering key/value bytes
     straight out of run buffers (zero per-row materialization for the
-    bulk-loaded fast path)."""
+    bulk-loaded fast path; ColumnarRun segments copy their column arrays
+    directly — no row decode at all)."""
+    from ..storage.segment import ColumnarRun
+
     keeps = [s.keep_idx() for s in segs]
     n = sum(len(k) for k in keeps) + len(loose)
     chk = Chunk.empty([c.ft for c in table.columns], n)
@@ -359,6 +422,13 @@ def build_batch_from_segments(table: TableInfo, segs, loose, version) -> ColumnB
         if m == 0:
             continue
         run = s.run
+        rows_idx = np.arange(row0, row0 + m, dtype=np.int64)
+        if isinstance(run, ColumnarRun):
+            seg_handles = run.handles_arr if m == run.n else run.handles_arr[keep]
+            handles[row0 : row0 + m] = seg_handles
+            _gather_columnar(table, cols, run, keep, rows_idx)
+            row0 += m
+            continue
         key_mat = run.key_mat[keep]
         if key_mat.shape[1] == 19:
             seg_handles = _decode_handles(key_mat, m)
@@ -368,7 +438,6 @@ def build_batch_from_segments(table: TableInfo, segs, loose, version) -> ColumnB
             )
         handles[row0 : row0 + m] = seg_handles
         big = run.value_buffer()
-        rows_idx = np.arange(row0, row0 + m, dtype=np.int64)
         _decode_values_into(table, cols, big, run.starts[keep], run.lens[keep], rows_idx, seg_handles)
         row0 += m
     for k, v in loose:

@@ -125,8 +125,29 @@ class DDLWorker:
 
     # --- job execution -----------------------------------------------------
 
+    INGEST_PARK_S = 30.0  # max wait for a bulk-ingest window before erroring
+
     def _step(self, job: DDLJob) -> None:
         """Run ONE state transition (or one backfill round) of the job."""
+        if self.storage.table_ingesting(job.table_id):
+            # bulk-ingest exclusion (PR 15): a live ingest window on the
+            # target table parks the job — no schema transition may land
+            # under rows encoded against the pre-transition schema. The
+            # wait is BOUNDED: the job queue is serial (as in the
+            # reference), so an unbounded park would head-of-line-block
+            # every other table's DDL behind one leaked window; past the
+            # deadline the step fails typed and the job stays queued.
+            import time as _t
+
+            deadline = _t.time() + self.INGEST_PARK_S
+            while self.storage.table_ingesting(job.table_id):
+                if _t.time() > deadline:
+                    raise TiDBError(
+                        f"DDL job {job.id} parked behind a bulk-ingest window "
+                        f"on table {job.table_id} for {self.INGEST_PARK_S:.0f}s "
+                        f"— retry after the ingest finishes"
+                    )
+                _t.sleep(0.02)
         if job.type == "add_index":
             self._step_add_index(job)
         elif job.type == "drop_index":

@@ -13,8 +13,17 @@ import numpy as np
 from ..codec.row import encode_row
 from ..codec import tablecodec
 from ..mysqltypes.coretime import pack_time
-from ..mysqltypes.datum import Datum, K_DEC, K_INT, K_STR, K_TIME
-from ..mysqltypes.mydecimal import Dec
+from ..mysqltypes.datum import (
+    Datum,
+    K_DEC,
+    K_DUR,
+    K_FLOAT,
+    K_INT,
+    K_STR,
+    K_TIME,
+    K_UINT,
+)
+from ..br.ingest import datum_for
 
 LINEITEM_DDL = """CREATE TABLE lineitem (
   l_orderkey BIGINT NOT NULL,
@@ -189,13 +198,17 @@ ORDER BY total_qty DESC, o.o_orderkey LIMIT 10"""
 
 
 def _kind_of(ft) -> int:
-    if ft.is_decimal():
-        return K_DEC
-    if ft.is_time():
-        return K_TIME
-    if ft.is_string():
-        return K_STR
-    return K_INT
+    # ONE definition with the bulk engine (br/ingest.kind_of) — the PR 11
+    # K_INT fallthrough that truncated DOUBLE columns to ints lived in a
+    # private copy of this mapping
+    from ..br.ingest import kind_of
+
+    return kind_of(ft)
+
+
+# kinds the columnar bulk path encodes; K_BYTES stays excluded (the
+# trailing-NUL width heuristic would clip binary values ending in 0x00)
+_BULK_KINDS = (K_INT, K_UINT, K_FLOAT, K_DEC, K_TIME, K_DUR, K_STR)
 
 
 def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: dict[str, int] | None = None, batch: int = 500_000):
@@ -203,18 +216,52 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
     the Lightning local backend analog). Rows get sequential handles.
     Column kinds derive from the table schema unless overridden.
 
-    Hot path is fully vectorized: row values batch-encode in format v2
-    (codec/rowfast.py), record keys and int-keyed index keys build as numpy
-    byte matrices (ref: Lightning's backend/kv encoder, which likewise
-    batch-encodes without per-cell interpretation).
-    """
-    from ..codec import rowfast
-
+    Default route (tidb_bulk_ingest=ON): the shared bulk engine
+    (br/ingest.BulkIngest) keeps the data COLUMNAR end to end — canonical
+    numpy lanes become a ColumnarRun + IntIndexRun artifacts published
+    atomically under one WAL ingest record; no row-major byte plane is
+    materialized at load time. OFF (or ineligible kinds) recovers the
+    legacy per-batch path: v2 row encode + per-batch segment ingest."""
     info = session.infoschema().table(session.current_db, table_name)
     names = list(columns)
     col_infos = [info.col_by_name(n) for n in names]
     if kinds is None:
         kinds = {n: _kind_of(c.ft) for n, c in zip(names, col_infos)}
+    n = len(columns[names[0]])
+    kind_list = [kinds[n_] for n_ in names]
+    if (
+        session.vars.get("tidb_bulk_ingest", "ON") == "ON"
+        and info.partition is None
+        and all(k in _BULK_KINDS for k in kind_list)
+    ):
+        from ..br.ingest import BulkIngest, IngestAborted
+
+        try:
+            job = BulkIngest(session, info)
+        except IngestAborted:
+            # DDL queued/running on the table: the legacy per-batch
+            # segment path coexists with online DDL as it always did
+            job = None
+        if job is not None:
+            try:
+                job.add_columns(names, [columns[nm] for nm in names], kind_list)
+                job.commit()
+            except IngestAborted:
+                job.abort()  # publish-time abort: recover via legacy below
+            except BaseException:
+                job.abort()
+                raise
+            else:
+                return n
+    return _bulk_load_segments(session, info, names, columns, kinds, col_infos, batch)
+
+
+def _bulk_load_segments(session, info, names, columns, kinds, col_infos, batch):
+    """Legacy bulk path (tidb_bulk_ingest=OFF): v2 row-major encode +
+    one segment ingest per batch — kept bit-compatible as the live
+    fallback and the paired-bench baseline."""
+    from ..codec import rowfast
+
     col_ids = [c.id for c in col_infos]
     n = len(columns[names[0]])
     # clustered int pk: the pk VALUE is the row handle (ref: tables.go
@@ -268,6 +315,9 @@ def bulk_load(session, table_name: str, columns: dict[str, np.ndarray], kinds: d
                     mvcc.ingest(kvs, commit_ts)
     else:
         _bulk_load_rows(session, info, col_infos, col_ids, arrays, kind_list, scale_fix, pk_handle_pos, first_handle, indexes, commit_ts, batch)
+    # semi-sync parity with the bulk engine: each ingest_run fsynced
+    # locally; one wal_sync extends the ack to durable-on-standby
+    session.store.wal_sync()
     session.store.bump_version([tablecodec.record_prefix(info.id)])
     session.cop.tiles.invalidate_table(info.id)
     return n
@@ -282,13 +332,7 @@ def _index_kvs_slow(info, ix, col_infos, arrs, kind_list, scale_fix, handles, kv
     for i in range(len(handles)):
         full = [Datum.null()] * n_tbl_cols
         for off, arr, k, sf in zip(offsets, arrs, kind_list, scale_fix):
-            v = arr[i]
-            if k == K_DEC:
-                full[off] = Datum.d(Dec(int(v), sf))
-            elif k == K_STR:
-                full[off] = Datum.s(str(v))
-            else:
-                full[off] = Datum(k, int(v))
+            full[off] = datum_for(k, arr[i], sf)
         for c in info.columns:
             if c.hidden and c.name == "_tidb_rowid":
                 full[c.offset] = Datum.i(int(handles[i]))
@@ -308,15 +352,10 @@ def _bulk_load_rows(session, info, col_infos, col_ids, arrays, kind_list, scale_
     for lo in range(0, n, batch):
         hi = min(lo + batch, n)
         for i in range(lo, hi):
-            datums = []
-            for arr, k, sf in zip(arrays, kind_list, scale_fix):
-                v = arr[i]
-                if k == K_DEC:
-                    datums.append(Datum.d(Dec(int(v), sf)))
-                elif k == K_STR:
-                    datums.append(Datum.s(v))
-                else:
-                    datums.append(Datum(k, int(v)))
+            datums = [
+                datum_for(k, arr[i], sf)
+                for arr, k, sf in zip(arrays, kind_list, scale_fix)
+            ]
             handle = datums[pk_handle_pos].to_int() if pk_handle_pos is not None else first_handle + i
             kvs.append((tablecodec.record_key(info.id, handle), encode_row(col_ids, datums)))
             if indexes:

@@ -488,6 +488,10 @@ class LoadData:
     enclosed: str = ""
     ignore_lines: int = 0
     columns: list = field(default_factory=list)
+    # WITH key=value options (TiDB LOAD DATA ... WITH syntax):
+    # bulk_ingest=0|1 overrides the tidb_bulk_ingest sysvar per
+    # statement; batch_size=N sizes the legacy path's txn batches
+    options: dict = field(default_factory=dict)
 
 
 @dataclass

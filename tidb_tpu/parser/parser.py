@@ -1922,6 +1922,14 @@ class Parser:
         if self.try_op("("):
             node.columns = self.name_list()
             self.expect_op(")")
+        if self.try_kw("WITH"):
+            # TiDB LOAD DATA options: WITH bulk_ingest=1, batch_size=4096
+            while True:
+                name = self.next().text.lower()
+                self.expect_op("=")
+                node.options[name] = self.next().text
+                if not self.try_op(","):
+                    break
         return node
 
     def split_stmt(self):

@@ -327,6 +327,33 @@ def detach_pk_handle_access(table, conds: list[Expression]) -> HandleAccess | No
     return detach_handle_conditions(conds, table.id, pk_vis)
 
 
+def _or_point_values(cond: Expression, pk_offset: int, ft) -> list[Datum] | None:
+    """Flatten `pk=c1 OR pk IN (c2, c3) OR ...` into the point list the
+    IN form would produce (ref: ranger's extractOrRanges). Every leaf of
+    the OR chain must be an eq/IN on the SAME pk column with exactly-
+    representable constants; anything else keeps the full-scan filter."""
+    if not isinstance(cond, ScalarFunc):
+        return None
+    if cond.sig.name == "or":
+        out: list[Datum] = []
+        for arg in cond.args:
+            sub = _or_point_values(arg, pk_offset, ft)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out if len(out) <= MAX_POINT_RANGES else None
+    s = _simple_cond(cond)
+    if s is None:
+        return None
+    off, op, vals = s
+    if off != pk_offset or op not in ("eq", "in"):
+        return None
+    conv = [const_to_col_datum(v, ft) for v in vals]
+    if any(v is None for v in conv):
+        return None
+    return conv
+
+
 def detach_handle_conditions(
     conds: list[Expression], table_id: int, pk_offset: int
 ) -> HandleAccess | None:
@@ -336,6 +363,14 @@ def detach_handle_conditions(
     acc = collect_col_access(conds, {pk_offset: ft_longlong()})
     a = acc.get(pk_offset)
     if a is None:
+        # `pk=a OR pk=b [OR pk IN (...)]` — the disjunctive spelling of
+        # an IN list (PR 15): one OR-chain condition over only the pk
+        # detaches to the same multi-point access
+        for c in conds:
+            pts = _or_point_values(c, pk_offset, ft_longlong())
+            if pts is not None and pts:
+                handles = sorted({d.to_int() for d in pts})
+                return HandleAccess(handles, None, [c])
         return None
     if a.eq_seen:
         handles = sorted({d.to_int() for d in a.eq})

@@ -219,6 +219,17 @@ _sv("tidb_tpu_tile_compression", "ON", scope="global", kind="bool", consumed=Tru
 # value overrides every session's dispatch (incident semantics).
 _sv("tidb_tpu_mpp_fused", "ON", scope="global", kind="bool", consumed=True)
 
+# --- Lightning-style bulk ingest (PR 15: br/ingest.BulkIngest) --------------
+# ON (default): LOAD DATA and models bulk_load build sorted columnar KV
+# artifacts and publish them atomically under ONE WAL ingest record
+# (all-visible-or-absent recovery), skipping per-row MVCC prewrite/
+# commit. OFF recovers the legacy paths exactly — 2000-row txn batches
+# for LOAD DATA, per-batch segment ingest for bulk_load — as the live
+# incident fallback. Session-scoped so one load can opt out without
+# flipping the store (a LOAD DATA ... WITH bulk_ingest=0 option
+# overrides per statement).
+_sv("tidb_bulk_ingest", "ON", kind="bool", consumed=True)
+
 # --- server memory arbitration (PR 4: utils/memory ServerMemTracker) -------
 # store-wide hard limit on tracked statement memory; 0 = unlimited.
 # GLOBAL-only like the reference: a per-session opt-out would defeat it

@@ -498,22 +498,43 @@ class MVCCStore:
         from .segment import Run
 
         run = Run.build(key_mat, vbuf, starts, lens, commit_ts, presorted=presorted)
-        if run.n:
-            # kv.lock serializes against checkpoint() snapshotting runs and
-            # rotating the journal under the same lock. Journal FIRST: a
-            # poisoned WAL (IO-failure degrade) raises out of the append,
-            # and journal-first keeps the in-memory runs exactly at the
-            # state the durable log describes
-            with self.kv.lock:
-                j = getattr(self, "journal", None)
-                if j is not None:
-                    from .wal import rec_run
+        self.ingest_runs([run])
 
-                    j.append(rec_run(run.key_mat, run.vbuf, run.starts, run.lens, commit_ts))
-                    j.sync()  # bulk ingests are their own durability point
-                self.runs.append(run)
-            hook = getattr(self, "split_hook", None)
-            if hook is not None:
+    def ingest_runs(self, runs: list, precondition=None) -> None:
+        """Atomic multi-run ingest (PR 15): EVERY run — record plane plus
+        index planes — lands under ONE journal record and one lock hold,
+        so recovery sees the whole ingest or none of it (all-visible-or-
+        absent; the crashpoint `ingest/after-artifact-before-publish`
+        invariant). Runs must already be sorted (the Run/ColumnarRun/
+        IntIndexRun builders guarantee it).
+
+        `precondition`, when given, runs UNDER the kv lock before the
+        journal append — the seam that closes the bulk route's
+        check-then-publish race (a commit landing between an advance
+        occupancy check and the publish must abort the ingest, never be
+        silently shadowed). It must raise to refuse; nothing has been
+        journaled or made visible at that point."""
+        runs = [r for r in runs if r.n]
+        if not runs:
+            return
+        # kv.lock serializes against checkpoint() snapshotting runs and
+        # rotating the journal under the same lock. Journal FIRST: a
+        # poisoned WAL (IO-failure degrade) raises out of the append,
+        # and journal-first keeps the in-memory runs exactly at the
+        # state the durable log describes
+        with self.kv.lock:
+            if precondition is not None:
+                precondition()
+            j = getattr(self, "journal", None)
+            if j is not None:
+                from .wal import rec_ingest
+
+                j.append(rec_ingest(runs))
+                j.sync()  # bulk ingests are their own durability point
+            self.runs.extend(runs)
+        hook = getattr(self, "split_hook", None)
+        if hook is not None:
+            for run in runs:
                 hook(run)
 
     def ingest(self, kvs: list[tuple[bytes, bytes]], commit_ts: int) -> None:
@@ -532,6 +553,22 @@ class MVCCStore:
             starts = np.zeros(n, dtype=np.int64)
             np.cumsum(lens[:-1], out=starts[1:])
             self.ingest_run(key_mat, vbuf, starts, lens, commit_ts)
+
+    def range_occupied(self, start: bytes, end: bytes) -> bool:
+        """Any committed version, ingest-run entry or in-flight LOCK in
+        the user-key range? The bulk route's require-empty witness —
+        locks count because a prewritten txn's commit would land AFTER
+        the ingest and be silently shadowed."""
+        for cf in (b"w", b"l"):
+            for k, _v in self.kv.iter_from(cf + start):
+                if k.startswith(cf) and k[1:] < end:
+                    return True
+                break
+        for run in self.runs:
+            i, j = run.range(start, end)
+            if i < j and (run.alive is None or run.alive[i:j].any()):
+                return True
+        return False
 
     def kill_runs_range(self, start: bytes, end: bytes) -> int:
         n = 0

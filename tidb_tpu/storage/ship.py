@@ -74,6 +74,21 @@ def frame_table_prefix(payload: bytes) -> bytes | None:
         w, n, _cts = struct.unpack_from("<IQQ", payload, 1)
         if n and w >= 9:
             return payload[21 : 21 + 9]  # first row of the key matrix
+    if tag == b"C" and len(payload) >= 29:
+        from ..codec import tablecodec
+
+        table_id = struct.unpack_from("<QQq", payload, 1)[2]
+        return tablecodec.record_prefix(table_id)[:9]
+    if tag == b"N" and len(payload) >= 35:
+        from ..codec import tablecodec
+
+        table_id = struct.unpack_from("<QQq", payload, 1)[2]
+        return tablecodec.record_prefix(table_id)[:9]
+    if tag == b"I" and len(payload) >= 13:
+        # one logical bulk ingest: every nested run targets one table —
+        # the first sub-record's prefix stands for the frame
+        (slen,) = struct.unpack_from("<Q", payload, 5)
+        return frame_table_prefix(payload[13 : 13 + slen])
     return None
 
 
@@ -87,6 +102,11 @@ def frame_commit_ts(payload: bytes) -> int:
     tag = payload[:1]
     if tag == b"R" and len(payload) >= 21:
         return struct.unpack_from("<IQQ", payload, 1)[2]
+    if tag in (b"C", b"N") and len(payload) >= 17:
+        return struct.unpack_from("<QQ", payload, 1)[1]
+    if tag == b"I" and len(payload) >= 13:
+        (slen,) = struct.unpack_from("<Q", payload, 5)
+        return frame_commit_ts(payload[13 : 13 + slen])
     if tag == b"P" and len(payload) >= 5:
         (klen,) = struct.unpack_from("<I", payload, 1)
         if len(payload) >= 5 + klen and klen >= 9:

@@ -438,6 +438,18 @@ class Storage:
         # trip, so undersized regions tax warm queries for no parallelism
         self.region_split_size = 1 << 21
         self.mvcc.split_hook = self._auto_split_run
+        # bulk-ingest windows (PR 15): table_id → active window count.
+        # The DDL worker parks job steps for tables with a live window
+        # (the ingest/DDL exclusion contract — see br/ingest.BulkIngest);
+        # the lock guards ONLY this dict and is never held across other
+        # acquisitions (rank "ingest.registry" in lock_order.toml).
+        # RLock: a GC-triggered BulkIngest.__del__ finalizer may fire
+        # while the owning thread is INSIDE the registry — a plain Lock
+        # would self-deadlock
+        from threading import RLock as _IngestRLock
+
+        self._ingest_lock = _IngestRLock()
+        self._ingesting: dict[int, int] = {}
         # pessimistic-lock wait-for graph (ref: unistore tikv/detector.go)
         from .detector import DeadlockDetector
 
@@ -770,6 +782,24 @@ class Storage:
         os.replace(tmp, path)
         w.fsync_dir(self.data_dir)
 
+    # --- bulk-ingest windows (PR 15) ----------------------------------------
+
+    def begin_table_ingest(self, table_id: int) -> None:
+        with self._ingest_lock:
+            self._ingesting[table_id] = self._ingesting.get(table_id, 0) + 1
+
+    def end_table_ingest(self, table_id: int) -> None:
+        with self._ingest_lock:
+            c = self._ingesting.get(table_id, 0) - 1
+            if c <= 0:
+                self._ingesting.pop(table_id, None)
+            else:
+                self._ingesting[table_id] = c
+
+    def table_ingesting(self, table_id: int) -> bool:
+        with self._ingest_lock:
+            return table_id in self._ingesting
+
     @property
     def ddl(self):
         """Shared online-DDL worker (the owner seam: one per store)."""
@@ -1069,8 +1099,6 @@ class Storage:
         spare-dir failover rotation and the standby bootstrap."""
         import struct
 
-        from . import wal as w
-
         parts = [struct.pack("<Q", epoch), struct.pack("<Q", len(self.kv._keys))]
         for k in self.kv._keys:
             v = self.kv._map[k]
@@ -1080,15 +1108,10 @@ class Storage:
         runs = list(self.mvcc.runs)
         parts.append(struct.pack("<I", len(runs)))
         for run in runs:
-            # compact killed rows out at snapshot time
-            if run.alive is not None:
-                keep = run.alive
-                km = run.key_mat[keep]
-                st = run.starts[keep]
-                ln = run.lens[keep]
-            else:
-                km, st, ln = run.key_mat, run.starts, run.lens
-            rec = w.rec_run(km, run.vbuf, st, ln, run.commit_ts)
+            # self-describing per-run record (columnar runs serialize
+            # their columns directly — no row-major plane materialized);
+            # killed rows compact out at snapshot time
+            rec = run.to_wal_record()
             parts.append(struct.pack("<Q", len(rec)))
             parts.append(rec)
         return b"".join(parts)
@@ -1347,5 +1370,7 @@ class Storage:
         step = self.region_split_size
         if run.n < 2 * step:
             return
-        keys = [bytes(run.key_mat[i]) for i in range(step, run.n - step // 2, step)]
+        # key_at, not key_mat[i]: columnar runs synthesize the handful of
+        # split keys without materializing the whole key matrix
+        keys = [run.key_at(i) for i in range(step, run.n - step // 2, step)]
         self.regions.split_many(keys)

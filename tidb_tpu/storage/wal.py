@@ -546,6 +546,121 @@ def rec_run(key_mat: np.ndarray, vbuf, starts: np.ndarray, lens: np.ndarray, com
     )
 
 
+def rec_crun(run) -> bytes:
+    """Columnar record-run payload (storage/segment.ColumnarRun): the
+    handles + column arrays ship as-is — no row-major value plane is ever
+    materialized for the log, so the WAL write costs what the data weighs
+    (the 'compressed tile form doubles as the ingest wire format' idea,
+    arXiv:2506.10092)."""
+    parts = [
+        b"C",
+        struct.pack("<QQq I", run.n, run.commit_ts, run.table_id, len(run.cols)),
+        np.ascontiguousarray(run.handles_arr, dtype="<i8").tobytes(),
+    ]
+    for c in run.cols:
+        data = c.data
+        if data.dtype.kind in "OU":  # still-object str lanes canonicalize here
+            from .segment import canonical_str_array
+
+            data = canonical_str_array(data)
+        data = np.ascontiguousarray(data)
+        if data.dtype.kind == "S":
+            if data.dtype.itemsize == 0:  # all-empty strings: keep width >= 1
+                data = data.astype("S1")
+            width = data.dtype.itemsize
+            payload = data.tobytes()
+        else:
+            width = 0
+            payload = data.astype(data.dtype.newbyteorder("<"), copy=False).tobytes()
+        has_valid = 0 if c.valid is None else 1
+        parts.append(struct.pack("<iBBBI", c.cid, c.kind, c.scale, has_valid, width))
+        parts.append(payload)
+        if has_valid:
+            parts.append(np.ascontiguousarray(c.valid, dtype=np.uint8).tobytes())
+    return b"".join(parts)
+
+
+def rec_irun(run) -> bytes:
+    """Int-index-run payload (storage/segment.IntIndexRun): sorted key
+    columns + handles; the key byte matrix rebuilds lazily on demand."""
+    parts = [
+        b"N",
+        struct.pack("<QQqqBB", run.n, run.commit_ts, run.table_id,
+                    run.index_id, 1 if run.unique else 0, len(run.key_cols)),
+    ]
+    for c in run.key_cols:
+        parts.append(np.ascontiguousarray(c, dtype="<i8").tobytes())
+    parts.append(np.ascontiguousarray(run.handles_arr, dtype="<i8").tobytes())
+    return b"".join(parts)
+
+
+def rec_ingest(runs) -> bytes:
+    """ONE logical bulk-ingest record (PR 15): every run of the ingest —
+    record plane plus all index planes — nested in a single WAL frame,
+    so recovery (and a shipped standby) replays the ingest atomically:
+    the frame's CRC either admits the whole ingest or none of it."""
+    subs = [r.to_wal_record() for r in runs]
+    parts = [b"I", struct.pack("<I", len(subs))]
+    for s in subs:
+        parts.append(struct.pack("<Q", len(s)))
+        parts.append(s)
+    return b"".join(parts)
+
+
+def _apply_crun(payload: bytes):
+    """Parse a 'C' payload → ColumnarRun (validating every length)."""
+    from .segment import ColSpec, ColumnarRun
+
+    _need(len(payload) >= 29, "C header short")
+    n, commit_ts, table_id, ncols = struct.unpack_from("<QQq I", payload, 1)
+    pos = 29
+    _need(len(payload) >= pos + 8 * n, "C handles truncated")
+    handles = np.frombuffer(payload, "<i8", n, pos).copy()
+    pos += 8 * n
+    cols = []
+    for _ in range(ncols):
+        _need(len(payload) >= pos + 11, "C column header short")
+        # width is u32: a single TEXT value past 64KiB must not overflow
+        # the lane-width field
+        cid, kind, scale, has_valid, width = struct.unpack_from("<iBBBI", payload, pos)
+        pos += 11
+        from ..mysqltypes.datum import K_FLOAT, K_UINT
+
+        if width:
+            nb = width * n
+            _need(len(payload) >= pos + nb, "C string column truncated")
+            data = np.frombuffer(payload, f"S{width}", n, pos).copy()
+        else:
+            nb = 8 * n
+            _need(len(payload) >= pos + nb, "C fixed column truncated")
+            dt = "<f8" if kind == K_FLOAT else ("<u8" if kind == K_UINT else "<i8")
+            data = np.frombuffer(payload, dt, n, pos).copy()
+        pos += nb
+        valid = None
+        if has_valid:
+            _need(len(payload) >= pos + n, "C valid mask truncated")
+            valid = np.frombuffer(payload, np.uint8, n, pos).astype(bool)
+            pos += n
+        cols.append(ColSpec(cid, kind, scale, data, valid))
+    _need(pos == len(payload), "C trailing bytes")
+    return ColumnarRun(table_id, handles, cols, commit_ts)
+
+
+def _apply_irun(payload: bytes):
+    from .segment import IntIndexRun
+
+    _need(len(payload) >= 35, "N header short")
+    n, commit_ts, table_id, index_id, unique, k = struct.unpack_from("<QQqqBB", payload, 1)
+    pos = 35
+    _need(len(payload) == pos + 8 * n * (k + 1), "N arrays length mismatch")
+    cols = []
+    for _ in range(k):
+        cols.append(np.frombuffer(payload, "<i8", n, pos).copy())
+        pos += 8 * n
+    handles = np.frombuffer(payload, "<i8", n, pos).copy()
+    return IntIndexRun(table_id, index_id, cols, handles, bool(unique), commit_ts)
+
+
 def _need(ok: bool, what: str) -> None:
     if not ok:
         raise ValueError(f"malformed WAL record: {what}")
@@ -585,29 +700,61 @@ def apply_record(payload: bytes, kv, mvcc) -> None:
             kv.delete_range(start, end)
         else:
             mvcc.kill_runs_range(start, end)
-    elif tag == b"R":
-        _need(len(payload) >= 21, "R header short")
-        w, n, commit_ts = struct.unpack_from("<IQQ", payload, 1)
-        pos = 21
-        _need(len(payload) >= pos + n * w + 16 * n + 8, "R arrays truncated")
-        key_mat = np.frombuffer(payload, np.uint8, n * w, pos).reshape(int(n), w).copy()
-        pos += n * w
-        starts = np.frombuffer(payload, np.int64, n, pos).copy()
-        pos += 8 * n
-        lens = np.frombuffer(payload, np.int64, n, pos).copy()
-        pos += 8 * n
-        (vlen,) = struct.unpack_from("<Q", payload, pos)
-        _need(len(payload) == pos + 8 + vlen, "R value buffer length mismatch")
-        vbuf = payload[pos + 8 : pos + 8 + vlen]
-        if n:
-            _need(
-                bool(
-                    (starts >= 0).all() and (lens >= 0).all()
-                    and (starts <= vlen).all() and (lens <= vlen).all()
-                    and (starts + lens <= vlen).all()
-                ),
-                "R value slices out of range",
-            )
-        mvcc.ingest_run(key_mat, vbuf, starts, lens, commit_ts, presorted=True)
+    elif tag in (b"R", b"C", b"N"):
+        mvcc.ingest_runs([_parse_run_record(payload)])
+    elif tag == b"I":
+        # ONE logical bulk ingest: parse EVERY nested run first (any
+        # malformed sub-record refuses the whole frame — never a
+        # half-applied ingest), then publish them as one atomic unit
+        _need(len(payload) >= 5, "I header short")
+        (nsub,) = struct.unpack_from("<I", payload, 1)
+        pos = 5
+        runs = []
+        for _ in range(nsub):
+            _need(len(payload) >= pos + 8, "I sub-record header short")
+            (slen,) = struct.unpack_from("<Q", payload, pos)
+            pos += 8
+            _need(len(payload) >= pos + slen, "I sub-record truncated")
+            runs.append(_parse_run_record(payload[pos : pos + slen]))
+            pos += slen
+        _need(pos == len(payload), "I trailing bytes")
+        mvcc.ingest_runs(runs)
     else:
         raise ValueError(f"unknown WAL record tag {tag!r}")
+
+
+def _parse_run_record(payload: bytes):
+    """One run-shaped record payload → a Run/ColumnarRun/IntIndexRun
+    (validated, NOT yet published)."""
+    from .segment import Run
+
+    _need(len(payload) >= 1, "empty run record")
+    tag = payload[:1]
+    if tag == b"C":
+        return _apply_crun(payload)
+    if tag == b"N":
+        return _apply_irun(payload)
+    _need(tag == b"R", f"unexpected run record tag {tag!r}")
+    _need(len(payload) >= 21, "R header short")
+    w, n, commit_ts = struct.unpack_from("<IQQ", payload, 1)
+    pos = 21
+    _need(len(payload) >= pos + n * w + 16 * n + 8, "R arrays truncated")
+    key_mat = np.frombuffer(payload, np.uint8, n * w, pos).reshape(int(n), w).copy()
+    pos += n * w
+    starts = np.frombuffer(payload, np.int64, n, pos).copy()
+    pos += 8 * n
+    lens = np.frombuffer(payload, np.int64, n, pos).copy()
+    pos += 8 * n
+    (vlen,) = struct.unpack_from("<Q", payload, pos)
+    _need(len(payload) == pos + 8 + vlen, "R value buffer length mismatch")
+    vbuf = payload[pos + 8 : pos + 8 + vlen]
+    if n:
+        _need(
+            bool(
+                (starts >= 0).all() and (lens >= 0).all()
+                and (starts <= vlen).all() and (lens <= vlen).all()
+                and (starts + lens <= vlen).all()
+            ),
+            "R value slices out of range",
+        )
+    return Run(key_mat, vbuf, starts, lens, commit_ts)

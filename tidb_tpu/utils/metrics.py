@@ -510,3 +510,16 @@ WAL_ROTATIONS = REGISTRY.counter(
     "tidb_wal_rotations_total",
     "WAL media-failover rotation attempts by outcome (ok | failed | no_spare)",
 )
+# bulk ingest (PR 15): rows published through the Lightning-style bulk
+# path (br/ingest.BulkIngest — LOAD DATA bulk mode + models bulk_load),
+# and the bytes each pipeline stage handled: parse (raw input bytes the
+# CSV reader consumed), encode (canonical columnar artifact bytes),
+# wal (artifact bytes journaled into the single ingest record; absent
+# for in-memory stores), publish (artifact bytes made visible)
+INGEST_ROWS = REGISTRY.counter(
+    "tidb_ingest_rows_total", "rows published by bulk-ingest commits"
+)
+INGEST_BYTES = REGISTRY.counter(
+    "tidb_ingest_bytes_total",
+    "bulk-ingest bytes by pipeline stage (parse | encode | wal | publish)",
+)

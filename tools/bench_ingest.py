@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Paired bulk-vs-legacy ingest bench (PR 15) → BENCH_ingest_pr15.json.
+
+Two legs, both on the shared paired harness (tools/paired_bench.py —
+modes interleave per rep so machine drift cancels in the paired ratio):
+
+  bulk_load   the ISSUE 15 headline: lineitem columns loaded through
+              models/tpch.bulk_load with tidb_bulk_ingest OFF (legacy
+              per-batch v2-encode segment path, the committed-21.4s-
+              baseline code) vs ON (columnar BulkIngest).
+              GATE: paired legacy/bulk wall ratio >= 5x.
+  load_data   LOAD DATA INFILE on a CSV through the legacy 2000-row txn
+              batches vs the bulk route. GATE: >= 3x.
+
+Bit-identity is asserted once per leg: the two freshly-loaded stores
+must answer Q1/Q6/TopN (bulk_load leg) or a full ORDER BY scan
+(load_data leg) identically.
+
+    python tools/bench_ingest.py                  # 2M rows, 3 paired reps
+    python tools/bench_ingest.py --rows 16000000 --reps 1   # headline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.paired_bench import paired_medians  # noqa: E402
+
+OUT_NAME = "BENCH_ingest_pr15.json"
+BULK_GATE_X = 5.0
+LOAD_GATE_X = 3.0
+LOAD_ROWS = 120_000
+
+
+def _fresh_lineitem_session(bulk: bool):
+    from tidb_tpu.models import tpch
+    from tidb_tpu.session import Session
+
+    s = Session()
+    s.vars["tidb_bulk_ingest"] = "ON" if bulk else "OFF"
+    s.execute(tpch.LINEITEM_DDL)
+    return s
+
+
+def bench_bulk_load(rows: int, reps: int, warmup: int) -> dict:
+    from tidb_tpu.models import tpch
+
+    cols = tpch.gen_lineitem(rows)
+    keep: dict[str, object] = {}
+
+    def run(bulk: bool) -> float:
+        s = _fresh_lineitem_session(bulk)
+        t0 = time.perf_counter()
+        tpch.bulk_load(s, "lineitem", cols)
+        dt = time.perf_counter() - t0
+        keep["bulk" if bulk else "legacy"] = s  # last store of each mode
+        return dt
+
+    res = paired_medians(lambda: run(False), lambda: run(True), reps, warmup=warmup)
+    # bit-identity spot checks between the two freshly-loaded stores
+    checks = {}
+    for name, q in (("q1", tpch.Q1), ("q6", tpch.Q6), ("topn", tpch.TOPN)):
+        a = keep["legacy"].must_query(q)
+        b = keep["bulk"].must_query(q)
+        checks[name] = a == b
+    legacy_s, bulk_s = res["p50_a_s"], res["p50_b_s"]
+    ratio = legacy_s / bulk_s if bulk_s else 0.0
+    return {
+        "rows": rows,
+        "legacy_p50_s": round(legacy_s, 3),
+        "bulk_p50_s": round(bulk_s, 3),
+        "paired_ratio_p50": round(1.0 / res["paired_ratio_p50"], 2),
+        "speedup_x": round(ratio, 2),
+        "legacy_rows_per_s": round(rows / legacy_s, 0) if legacy_s else 0,
+        "bulk_rows_per_s": round(rows / bulk_s, 0) if bulk_s else 0,
+        "bit_identical": checks,
+        "gate_x": BULK_GATE_X,
+        "pass": ratio >= BULK_GATE_X and all(checks.values()),
+    }
+
+
+def bench_load_data(tmp_path: str, reps: int) -> dict:
+    from tidb_tpu.session import Session
+
+    csv = os.path.join(tmp_path, "ingest_bench.csv")
+    with open(csv, "w") as f:
+        for i in range(LOAD_ROWS):
+            f.write(f"{i},{i % 997},name-{i % 51}\n")
+    keep: dict[str, object] = {}
+
+    def run(bulk: bool) -> float:
+        s = Session()
+        s.execute("CREATE TABLE ld (id BIGINT PRIMARY KEY, v BIGINT, name VARCHAR(16))")
+        mode = 1 if bulk else 0
+        t0 = time.perf_counter()
+        s.execute(
+            f"LOAD DATA INFILE '{csv}' INTO TABLE ld "
+            f"FIELDS TERMINATED BY ',' WITH bulk_ingest={mode}"
+        )
+        dt = time.perf_counter() - t0
+        keep["bulk" if bulk else "legacy"] = s
+        return dt
+
+    res = paired_medians(lambda: run(False), lambda: run(True), reps, warmup=0)
+    probe = "SELECT COUNT(*), SUM(v), MIN(name), MAX(name) FROM ld"
+    identical = (
+        keep["legacy"].must_query(probe) == keep["bulk"].must_query(probe)
+        and keep["legacy"].must_query("SELECT id, v, name FROM ld WHERE id < 50 ORDER BY id")
+        == keep["bulk"].must_query("SELECT id, v, name FROM ld WHERE id < 50 ORDER BY id")
+    )
+    legacy_s, bulk_s = res["p50_a_s"], res["p50_b_s"]
+    ratio = legacy_s / bulk_s if bulk_s else 0.0
+    os.unlink(csv)
+    return {
+        "rows": LOAD_ROWS,
+        "legacy_p50_s": round(legacy_s, 3),
+        "bulk_p50_s": round(bulk_s, 3),
+        "speedup_x": round(ratio, 2),
+        "bit_identical": identical,
+        "gate_x": LOAD_GATE_X,
+        "pass": ratio >= LOAD_GATE_X and identical,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=2_000_000)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import tempfile
+
+    warmup = 1 if args.reps > 1 else 0
+    out = {
+        "bench": "ingest_pr15",
+        "note": (
+            "paired legacy-vs-bulk ingest medians (noisy-box rule: modes "
+            "interleave per rep); bulk = columnar BulkIngest under one WAL "
+            "ingest record, legacy = the pre-PR-15 paths"
+        ),
+        "bulk_load": bench_bulk_load(args.rows, args.reps, warmup),
+        "load_data": bench_load_data(tempfile.gettempdir(), max(1, min(args.reps, 3))),
+    }
+    out["pass"] = out["bulk_load"]["pass"] and out["load_data"]["pass"]
+    print(json.dumps(out, indent=2))
+    with open(os.path.join(ROOT, OUT_NAME), "w", encoding="utf8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    if not out["pass"]:
+        print("FAIL: ingest bench gate (see JSON above)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,11 @@ child; the parent asserts exit code 137, proving the site actually fired):
     checkpoint/before-old-unlink      both epochs' logs present
     ddl/mid-reorg                     backfill checkpoint durable, index
                                       still write_reorg
+    ingest/after-artifact-before-publish
+                                      bulk-ingest artifacts built, ONE
+                                      WAL ingest record NOT yet written:
+                                      the ingest must recover fully
+                                      absent; acked ingests fully visible
 
 Usage:
     python tools/crashpoint.py --matrix [--seed S]       # each named site once
@@ -84,7 +89,14 @@ CRASHPOINTS = {
     # snapshot must recover every ack (an EIO is injected to trigger the
     # rotation; see _child_main)
     "wal/rotate-after-checkpoint": 1,
+    # PR 15: die with a bulk ingest's sorted artifacts built but NOTHING
+    # journaled/published — recovery must see that ingest fully absent,
+    # and every ACKED ingest fully visible (record AND index planes:
+    # one WAL ingest record covers both, all-visible-or-absent)
+    "ingest/after-artifact-before-publish": 5,
 }
+
+ING_GROUP_ROWS = 5  # rows per bulk-ingest group (the ingest atomicity unit)
 
 # per-site child topology: which sites run with an in-process warm
 # standby (semi-sync ON — the acked⇒on-standby invariant is the point)
@@ -129,6 +141,9 @@ def _child_main(args) -> None:
     boot.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
     boot.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
     boot.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+    # bulk-ingest target (PR 15): secondary index so every ingest
+    # publishes record AND index planes under its one WAL record
+    boot.execute("CREATE TABLE t_ing (id INT PRIMARY KEY, g INT, total INT, KEY kg (g))")
     for lo in range(0, IDX_ROWS, 100):
         vals = ", ".join(f"({i}, {i % 97})" for i in range(lo, min(lo + 100, IDX_ROWS)))
         boot.execute(f"INSERT INTO t_idx VALUES {vals}")
@@ -218,9 +233,43 @@ def _child_main(args) -> None:
             except TiDBError as e:
                 say(f"ERR ckpt {type(e).__name__}")
 
+    def ingest_loop() -> None:
+        """Bulk ingests of ING_GROUP_ROWS-row groups through the shared
+        engine: ack only after commit() returned — the group (record +
+        index rows) must then be fully visible after recovery; an
+        unacked group must be fully visible or fully absent."""
+        import numpy as np
+
+        from tidb_tpu.br.ingest import BulkIngest
+
+        s = Session(store)
+        g = 0
+        G = ING_GROUP_ROWS
+        while time.time() < stop:
+            try:
+                info = s.infoschema().table(s.current_db, "t_ing")
+                job = BulkIngest(s, info)
+                try:
+                    ids = np.arange(g * G, g * G + G, dtype=np.int64)
+                    job.add_columns(
+                        ["id", "g", "total"],
+                        [ids, np.full(G, g, np.int64), np.full(G, G, np.int64)],
+                    )
+                    job.commit()
+                except BaseException:
+                    job.abort()
+                    raise
+                say(f"ACK ing {g}")
+                g += 1
+                time.sleep(0.02)
+            except TiDBError as e:
+                say(f"ERR ing {type(e).__name__}")
+                g += 1  # never reuse ids of a maybe-published group
+                time.sleep(0.02)
+
     threads = [
         threading.Thread(target=f, daemon=True, name=f.__name__)
-        for f in (dml_loop, txn_loop, ddl_loop, ckpt_loop)
+        for f in (dml_loop, txn_loop, ddl_loop, ckpt_loop, ingest_loop)
     ]
     for t in threads:
         t.start()
@@ -238,7 +287,7 @@ class Violation(Exception):
 
 
 def _collect_acks(lines: list[str]) -> dict:
-    acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0}
+    acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0, "ing": set()}
     for ln in lines:
         parts = ln.split()
         if not parts or parts[0] != "ACK":
@@ -251,6 +300,8 @@ def _collect_acks(lines: list[str]) -> dict:
             acks["ddl"].append((parts[2], int(parts[3])))
         elif parts[1] == "ckpt":
             acks["ckpt"] += 1
+        elif parts[1] == "ing":
+            acks["ing"].add(int(parts[2]))
     return acks
 
 
@@ -302,6 +353,49 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
         if by_group.get(g) != TXN_GROUP_ROWS:
             raise Violation(f"acked txn group {g} not fully visible after recovery")
 
+    # --- bulk-ingest atomicity (PR 15): every group fully present or fully
+    # absent (ONE WAL ingest record covers record + index planes), and
+    # every acked group fully visible
+    from tidb_tpu.errors import UnknownTable
+
+    ing_rows = []
+    ing_missing = False
+    try:
+        ing_rows = s.must_query("SELECT id, g, total FROM t_ing")
+    except UnknownTable:
+        # pre-ingest fixture dirs (checker unit tests) have no t_ing;
+        # but a recovery that LOST an acked ingest's whole table must
+        # still be flagged
+        if acks.get("ing"):
+            raise Violation("acked ingests exist but t_ing is missing after recovery")
+        ing_missing = True
+    except TiDBError as e:
+        raise Violation(f"post-restart t_ing read failed: {e}") from e
+    ing_groups: dict[int, int] = {}
+    for _id, g, total in ing_rows:
+        g = int(g)
+        if int(total) != ING_GROUP_ROWS:
+            raise Violation(f"ingest group {g} row carries total={total}")
+        ing_groups[g] = ing_groups.get(g, 0) + 1
+    for g, cnt in sorted(ing_groups.items()):
+        if cnt != ING_GROUP_ROWS:
+            raise Violation(
+                f"ingest group {g} is PARTIAL after recovery "
+                f"({cnt}/{ING_GROUP_ROWS} rows) — a bulk ingest must be "
+                f"all-visible-or-absent"
+            )
+    for g in sorted(acks.get("ing", ())):
+        if ing_groups.get(g) != ING_GROUP_ROWS:
+            raise Violation(f"acked ingest group {g} not fully visible after recovery")
+    # index-plane witness: count through the kg index must agree
+    for g in sorted(ing_groups):
+        (cnt,) = s.must_query(f"SELECT COUNT(*) FROM t_ing WHERE g = {g}")[0]
+        if int(cnt) != ING_GROUP_ROWS:
+            raise Violation(
+                f"ingest group {g}: index plane disagrees with record plane "
+                f"({cnt} vs {ING_GROUP_ROWS}) — the ingest record tore"
+            )
+
     # --- DDL: drain the interrupted job queue; the reorg must resume from
     # its durable checkpoint to public (or roll back cleanly) — then the
     # row↔index consistency check must pass for whatever ended up public
@@ -313,6 +407,8 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
         s.execute("ADMIN CHECK TABLE t_idx")
         s.execute("ADMIN CHECK TABLE t_dml")
         s.execute("ADMIN CHECK TABLE t_txn")
+        if not ing_missing:
+            s.execute("ADMIN CHECK TABLE t_ing")
     except TiDBError as e:
         raise Violation(f"ADMIN CHECK failed after recovery: {e}") from e
 
@@ -352,7 +448,7 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
     t.commit()
 
     store.wal.close()
-    return {"dml": dml_rows, "txn_groups": by_group}
+    return {"dml": dml_rows, "txn_groups": by_group, "ing_groups": ing_groups}
 
 
 def _verify_standby(standby_dir: str, primary: dict, acks: dict,
@@ -414,6 +510,29 @@ def _verify_standby(standby_dir: str, primary: dict, acks: dict,
                 f"standby AHEAD of primary durable state: txn group {g} "
                 f"is not durable on the primary"
             )
+    # bulk-ingest groups on the standby: shipped ingest records replay
+    # WHOLE — groups atomic, never ahead of the primary's durable state
+    from tidb_tpu.errors import UnknownTable
+
+    ing: dict[int, int] = {}
+    try:
+        for _id, g, _t in s.must_query("SELECT id, g, total FROM t_ing"):
+            ing[int(g)] = ing.get(int(g), 0) + 1
+    except UnknownTable:
+        if acks.get("ing"):
+            raise Violation("acked ingests exist but t_ing is missing on the standby")
+    for g, cnt in sorted(ing.items()):
+        if cnt != ING_GROUP_ROWS:
+            raise Violation(
+                f"standby ingest group {g} is PARTIAL after promote "
+                f"({cnt}/{ING_GROUP_ROWS} rows) — a shipped ingest record must "
+                f"replay whole"
+            )
+        if primary.get("ing_groups", {}).get(g) != ING_GROUP_ROWS:
+            raise Violation(
+                f"standby AHEAD of primary durable state: ingest group {g} "
+                f"is not durable on the primary"
+            )
     if semi_sync:
         for i in sorted(acks["dml"]):
             if dml.get(i) != i * 3:
@@ -425,6 +544,12 @@ def _verify_standby(standby_dir: str, primary: dict, acks: dict,
                 raise Violation(
                     f"semi-sync acked txn group {g} not fully visible on the "
                     f"promoted standby"
+                )
+        for g in sorted(acks.get("ing", ())):
+            if ing.get(g) != ING_GROUP_ROWS:
+                raise Violation(
+                    f"semi-sync acked ingest group {g} not fully visible on "
+                    f"the promoted standby"
                 )
 
     # --- the promoted standby must accept writes
@@ -573,7 +698,7 @@ def run_round(
         shutil.rmtree(workdir, ignore_errors=True)
     detail = (
         f"acks: dml={len(acks['dml'])} txn={len(acks['txn'])} "
-        f"ddl={len(acks['ddl'])} ckpt={acks['ckpt']}"
+        f"ddl={len(acks['ddl'])} ckpt={acks['ckpt']} ing={len(acks['ing'])}"
         + (" [standby promoted+verified]" if standby_dir else "")
         + (" [spare snapshot verified]" if spare_dir else "")
     )

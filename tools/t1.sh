@@ -16,6 +16,9 @@
 #   tools/bench_tiles.py             -> BENCH_tiles_pr7.json
 #   tools/bench_mpp.py               -> BENCH_mpp_pr11.json
 #   tools/bench_serve.py             -> BENCH_serve_pr13.json
+#   tools/bench_ingest.py            -> BENCH_ingest_pr15.json
+# (bench_ingest: paired legacy-vs-bulk load; gates bulk_load >= 5x and
+# LOAD DATA >= 3x with bit-identical query results)
 # (bench_serve: 32 socket clients; gates the storage-layer group-commit
 # ratio >= 3x, the front-door paired ratio + p99, and fairness)
 cd "$(dirname "$0")/.." || exit 1
@@ -46,7 +49,7 @@ python -m tools.analyze $ANALYZE_ARGS || exit 1
 # `pytest -m slow` / crashpoint.py --rounds/--failover-rounds
 env JAX_PLATFORMS=cpu python tools/crashpoint.py --matrix --failover-rounds 1 --seed 7 || exit 1
 if [ "$RUN_BENCH" = "1" ]; then
-  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp bench_serve; do
+  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp bench_serve bench_ingest; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
   done
 fi
