@@ -44,6 +44,9 @@ _LOCK_HUNT_MODULES = {
     # PR 14: the ship tap under the wal append lock, the standby and
     # failover serializers, semi-sync waits
     "test_standby", "test_wal_failover",
+    # PR 16: folds racing live commits — the compactor's stats lock vs
+    # the kv/wal chain
+    "test_compact",
 }
 
 

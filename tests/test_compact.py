@@ -1,0 +1,408 @@
+"""Delta-main compaction (PR 16, storage/compact.py): folds are
+bit-identical and atomic (one Z WAL record — recovery and a shipped
+standby see the whole fold or none of it), MVCC versions at/below the
+safepoint are reclaimed IN the fold (the checkpoint shrinks), the
+leveled merge bounds the per-table run count, races against live
+commits abort with nothing journaled, and the control surface
+(sysvars, COMPACTION memtable, gcworker delegation) behaves. Plus the
+two satellite regressions this PR carries: unsigned secondary-index
+point lookups (0x03 vs 0x04 key flags) and max-handle full scans
+(prefix+0xff end bounds excluded the 0xff... encoded handle)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage.txn import Storage
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+def _mk(tmp_path, name="data"):
+    store = Storage(data_dir=str(tmp_path / name))
+    s = Session(store)
+    s.execute("SET tidb_enable_auto_analyze = OFF")
+    return store, s
+
+
+def _mk_table(s, rows=60):
+    """id pk, v indexed; updates + deletes leave real MVCC garbage."""
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, KEY kv (v))")
+    s.execute("INSERT INTO t VALUES " + ", ".join(f"({i}, {i * 3})" for i in range(rows)))
+    s.execute("UPDATE t SET v = v + 1000 WHERE id % 10 = 3")
+    s.execute("DELETE FROM t WHERE id % 10 = 7")
+    return s.infoschema().table(s.current_db, "t")
+
+
+def _snap(s):
+    return (
+        s.must_query("SELECT id, v FROM t ORDER BY id"),
+        s.must_query("SELECT id FROM t WHERE v = 9 ORDER BY id"),   # index probe
+        s.must_query("SELECT id FROM t WHERE v = 1009 ORDER BY id"),
+        s.must_query("SELECT COUNT(*), SUM(v) FROM t"),
+    )
+
+
+def _fold(store, tid):
+    """Force-fold everything committed so far (sp = fresh ts)."""
+    return store.compactor.compact_table(store, tid, store.tso.next())
+
+
+def _delta_keys(store, tid):
+    comp = store.compactor
+    return sum(n for t, _, n in comp._candidates(store) if t == tid)
+
+
+class TestFold:
+    def test_fold_is_bit_identical_and_empties_delta(self, tmp_path):
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        before = _snap(s)
+        assert _delta_keys(store, info.id) > 0
+        res = _fold(store, info.id)
+        assert res is not None and res["rows"] > 0 and res["removed"] > 0
+        # the whole mutable delta re-homed into segments
+        assert _delta_keys(store, info.id) == 0
+        assert len(store.mvcc.runs) > 0
+        assert _snap(s) == before
+        s.execute("ADMIN CHECK TABLE t")  # row↔index across rebuilt planes
+
+    def test_deleted_rows_are_not_resurrected(self, tmp_path):
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        assert _fold(store, info.id) is not None
+        got = {int(r[0]) for r in s.must_query("SELECT id FROM t")}
+        assert not any(i % 10 == 7 for i in got)
+
+    def test_versions_reclaimed_checkpoint_shrinks(self, tmp_path):
+        """The acceptance pin: below-safepoint MVCC garbage dies in the
+        fold, so the post-fold snapshot is materially smaller than one
+        carrying every intermediate version."""
+        store, s = _mk(tmp_path)
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO t VALUES " + ", ".join(f"({i}, 0)" for i in range(100)))
+        for _ in range(10):
+            s.execute("UPDATE t SET v = v + 1")
+        info = s.infoschema().table(s.current_db, "t")
+        store.checkpoint()
+        snap = os.path.join(store.data_dir, "snapshot.bin")
+        size_garbage = os.path.getsize(snap)
+        res = _fold(store, info.id)
+        assert res is not None and res["removed"] >= 100 * 10
+        store.checkpoint()
+        size_folded = os.path.getsize(snap)
+        assert size_folded < size_garbage * 0.6, (size_folded, size_garbage)
+        assert [r for r in s.must_query("SELECT DISTINCT v FROM t")] == [("10",)]
+
+    def test_gcworker_delegates_version_deletion(self, tmp_path):
+        """gcworker.tick → Compactor.gc_pass: versions below the policy
+        safepoint die by folding, and the worker's ledger sees them."""
+        store, s = _mk(tmp_path)
+        _mk_table(s)
+        gw = store.gc_worker
+        # advance "now" past gc_life so the safepoint covers the writes
+        removed = gw.tick(now_ms=int(time.time() * 1000) + gw.life_ms + 60_000)
+        assert removed > 0
+        assert gw.removed_total >= removed
+        assert len(store.mvcc.runs) > 0  # reclaim happened BY folding
+        s.execute("ADMIN CHECK TABLE t")
+
+    def test_tick_folds_past_threshold_only(self, tmp_path):
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        comp = store.compactor
+        # threshold above the delta size → no-op tick
+        s.execute("SET GLOBAL tidb_compact_delta_threshold = 100000")
+        out = comp.tick(force_sp=store.tso.next())
+        assert out.get("folded", 0) == 0 and _delta_keys(store, info.id) > 0
+        s.execute("SET GLOBAL tidb_compact_delta_threshold = 1")
+        out = comp.tick(force_sp=store.tso.next())
+        assert out["folded"] >= 1 and _delta_keys(store, info.id) == 0
+
+    def test_disabled_compactor_ticks_to_nothing(self, tmp_path):
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        s.execute("SET GLOBAL tidb_compact_enable = OFF")
+        s.execute("SET GLOBAL tidb_compact_delta_threshold = 1")
+        out = store.compactor.tick(force_sp=store.tso.next())
+        assert out.get("folded", 0) == 0
+        assert _delta_keys(store, info.id) > 0
+
+
+class TestMerge:
+    def test_run_count_bounded_under_sustained_writes(self, tmp_path):
+        """Mixed INSERT/UPDATE batches, each followed by a fold: without
+        the merge every fold adds a run per plane forever; with it the
+        count stays at/under tidb_compact_max_runs per plane."""
+        store, s = _mk(tmp_path)
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, KEY kv (v))")
+        s.execute("SET GLOBAL tidb_compact_max_runs = 2")
+        info = s.infoschema().table(s.current_db, "t")
+        comp = store.compactor
+        expect = {}
+        retired = 0
+        for batch in range(6):
+            base = batch * 20
+            s.execute("INSERT INTO t VALUES " + ", ".join(
+                f"({i}, {i})" for i in range(base, base + 20)))
+            # update only WITHIN the batch: prior runs stay partially
+            # alive, so runs accumulate and the merge must do the work
+            # (touching every old row would fully kill the old runs and
+            # let the dead-run prune bound the count for free)
+            s.execute(f"UPDATE t SET v = v + 500 WHERE id >= {base} AND id < {base + 5}")
+            for i in range(base, base + 20):
+                expect[i] = i + (500 if i < base + 5 else 0)
+            assert _fold(store, info.id) is not None
+            retired += comp.maybe_merge(store, info.id)
+        assert retired > 0, "merge never fired across 6 folds"
+        # per-plane ceiling: merge fires at count > max_runs, so the
+        # steady state oscillates at ≤ max_runs+2 per plane (record +
+        # one index plane here)
+        assert len(store.mvcc.runs) <= 2 * (2 + 2)
+        got = {int(r[0]): int(r[1]) for r in s.must_query("SELECT id, v FROM t")}
+        assert got == expect
+        s.execute("ADMIN CHECK TABLE t")
+
+    def test_merge_preserves_index_probes(self, tmp_path):
+        store, s = _mk(tmp_path)
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, KEY kv (v))")
+        s.execute("SET GLOBAL tidb_compact_max_runs = 2")
+        info = s.infoschema().table(s.current_db, "t")
+        for batch in range(3):
+            base = batch * 10
+            s.execute("INSERT INTO t VALUES " + ", ".join(
+                f"({i}, {i % 5})" for i in range(base, base + 10)))
+            assert _fold(store, info.id) is not None
+        assert store.compactor.maybe_merge(store, info.id) > 0
+        got = sorted(int(r[0]) for r in s.must_query("SELECT id FROM t WHERE v = 3"))
+        assert got == [i for i in range(30) if i % 5 == 3]
+
+
+class TestRecovery:
+    def test_fold_replays_bit_identical_after_reopen(self, tmp_path):
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        assert _fold(store, info.id) is not None
+        before = _snap(s)
+        store.wal.close()
+        s2 = Session(Storage(data_dir=store.data_dir))
+        assert _snap(s2) == before
+        assert len(s2.store.mvcc.runs) > 0  # the Z record rebuilt the runs
+        s2.execute("ADMIN CHECK TABLE t")
+        # and the fold's kills replayed too: no resurrected deletes
+        got = {int(r[0]) for r in s2.must_query("SELECT id FROM t")}
+        assert not any(i % 10 == 7 for i in got)
+
+    def test_merge_replays_after_reopen(self, tmp_path):
+        store, s = _mk(tmp_path)
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+        s.execute("SET GLOBAL tidb_compact_max_runs = 2")
+        info = s.infoschema().table(s.current_db, "t")
+        for batch in range(3):
+            s.execute("INSERT INTO t VALUES " + ", ".join(
+                f"({i}, {i})" for i in range(batch * 10, batch * 10 + 10)))
+            assert _fold(store, info.id) is not None
+        assert store.compactor.maybe_merge(store, info.id) > 0
+        nruns = len(store.mvcc.runs)
+        before = s.must_query("SELECT id, v FROM t ORDER BY id")
+        store.wal.close()
+        s2 = Session(Storage(data_dir=store.data_dir))
+        assert s2.must_query("SELECT id, v FROM t ORDER BY id") == before
+        assert len(s2.store.mvcc.runs) == nruns
+
+
+class TestStandby:
+    def test_fold_ships_to_standby(self, tmp_path):
+        from tidb_tpu.storage.ship import WalShipper
+
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        ship.attach(standby)
+        assert standby.compactor is None  # standbys never fold on their own
+        before = _snap(s)
+        assert _fold(store, info.id) is not None
+        assert ship.wait_caught_up(10)
+        rs = Session(standby)
+        assert _snap(rs) == before
+        assert len(standby.mvcc.runs) > 0  # the Z frame replayed as a fold
+        ship.stop()
+
+
+class TestRaceDiscipline:
+    def test_commit_inside_fold_window_aborts_the_round(self, tmp_path):
+        """A commit with ts at/below the fold ts landing between artifact
+        build and publish must abort the fold (CompactionRaced) with
+        nothing journaled — the retry then sees it."""
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        s2 = Session(store)
+        raced0 = M.COMPACT_ROUNDS.value(outcome="raced")
+
+        def race():
+            s2.execute("INSERT INTO t VALUES (900, 2700)")
+
+        FP.enable("compact/after-artifact-before-publish", race)
+        try:
+            # fold ts minutes in the future: the raced INSERT's commit ts
+            # lands BELOW it, so the recomputed plan must differ
+            sp = store.tso.next() + (60_000 << 18)
+            assert store.compactor.compact_table(store, info.id, sp) is None
+        finally:
+            FP.disable("compact/after-artifact-before-publish")
+        assert M.COMPACT_ROUNDS.value(outcome="raced") == raced0 + 1
+        # nothing torn: the racing row is visible, a clean retry folds all
+        assert s.must_query("SELECT v FROM t WHERE id = 900") == [("2700",)]
+        assert _fold(store, info.id) is not None
+        assert s.must_query("SELECT v FROM t WHERE id = 900") == [("2700",)]
+        s.execute("ADMIN CHECK TABLE t")
+
+    def test_concurrent_writers_vs_folds(self, tmp_path):
+        """The chaos shape the lock hunt instruments: writer threads
+        commit while the main thread folds + merges in a loop. Raced
+        rounds abort silently; the final state must be exact."""
+        store, s = _mk(tmp_path)
+        s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, KEY kv (v))")
+        s.execute("SET GLOBAL tidb_compact_max_runs = 2")
+        info = s.infoschema().table(s.current_db, "t")
+        comp = store.compactor
+        errs = []
+
+        def writer(wid):
+            try:
+                ws = Session(store)
+                for i in range(40):
+                    rid = wid * 1000 + i
+                    ws.execute(f"INSERT INTO t VALUES ({rid}, {rid})")
+                    if i % 4 == 3:
+                        ws.execute(f"UPDATE t SET v = v + 1 WHERE id = {rid}")
+            except Exception as e:  # surfaced below — thread mustn't die silent
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+        for th in threads:
+            th.start()
+        for _ in range(10):
+            comp.compact_table(store, info.id, store.tso.next())  # None on race is fine
+            comp.maybe_merge(store, info.id)
+        for th in threads:
+            th.join()
+        assert not errs, errs
+        comp.compact_table(store, info.id, store.tso.next())
+        got = {int(r[0]): int(r[1]) for r in s.must_query("SELECT id, v FROM t")}
+        expect = {}
+        for w in range(3):
+            for i in range(40):
+                rid = w * 1000 + i
+                expect[rid] = rid + (1 if i % 4 == 3 else 0)
+        assert got == expect
+        s.execute("ADMIN CHECK TABLE t")
+
+
+class TestControlSurface:
+    def test_compaction_memtable_reports_progress(self, tmp_path):
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        assert _fold(store, info.id) is not None
+        rows = {int(r[0]): r for r in s.must_query(
+            "SELECT table_id, folds, rows_folded, versions_reclaimed, runs"
+            " FROM information_schema.compaction")}
+        row = rows[info.id]
+        assert int(row[1]) >= 1 and int(row[2]) > 0 and int(row[3]) > 0
+        assert int(row[4]) == len(store.mvcc.runs)
+
+    def test_invalid_interval_rejected_at_set(self, tmp_path):
+        store, s = _mk(tmp_path)
+        with pytest.raises(TiDBError, match="invalid duration"):
+            s.execute("SET GLOBAL tidb_compact_interval = 'soon'")
+        s.execute("SET GLOBAL tidb_compact_interval = '250ms'")  # valid sticks
+        assert store.global_vars["tidb_compact_interval"] == "250ms"
+
+    def test_metrics_rounds_accounted(self, tmp_path):
+        store, s = _mk(tmp_path)
+        info = _mk_table(s)
+        f0 = M.COMPACT_ROUNDS.value(outcome="fold")
+        r0 = M.COMPACT_ROWS.value()
+        assert _fold(store, info.id) is not None
+        assert M.COMPACT_ROUNDS.value(outcome="fold") == f0 + 1
+        assert M.COMPACT_ROWS.value() > r0
+
+
+class TestUnsignedIndexPointLookup:
+    """Satellite regression: unsigned index columns encode 0x04 UINT-flag
+    keys; probe-side encoding used to emit signed 0x03 keys (and
+    prefix+0xff ranges), so values >= 2^63 never matched."""
+
+    BIG = (1 << 63) + 5
+
+    def _mk(self):
+        s = Session()
+        s.execute("CREATE TABLE tu (id INT PRIMARY KEY, u BIGINT UNSIGNED, KEY ku (u))")
+        s.execute(f"INSERT INTO tu VALUES (1, 7), (2, {self.BIG}), (3, {self.BIG})")
+        return s
+
+    def test_point_lookup_above_signed_range(self):
+        s = self._mk()
+        got = sorted(int(r[0]) for r in s.must_query(
+            f"SELECT id FROM tu WHERE u = {self.BIG}"))
+        assert got == [2, 3]
+        assert s.must_query("SELECT id FROM tu WHERE u = 7") == [("1",)]
+        s.execute("ADMIN CHECK TABLE tu")
+
+    def test_index_lookup_join_probes_unsigned_domain(self):
+        s = self._mk()
+        s.execute("CREATE TABLE probe (k BIGINT UNSIGNED)")
+        s.execute(f"INSERT INTO probe VALUES (7), ({self.BIG})")
+        got = sorted(s.must_query(
+            "SELECT /*+ INL_HASH_JOIN(tu) */ tu.id FROM probe"
+            " JOIN tu ON probe.k = tu.u"))
+        assert got == [("1",), ("2",), ("3",)]
+
+
+class TestMaxHandleFullScan:
+    """Satellite regression: full scans built their end bound as
+    prefix+0xff, which sorts BELOW the max int64 handle's encoded key
+    (prefix + 8 bytes 0xff) — the row at handle 2^63-1 vanished from
+    scans, DDL backfill and stats collection."""
+
+    MAXH = (1 << 63) - 1
+
+    def test_max_handle_visible_everywhere(self, tmp_path):
+        store, s = _mk(tmp_path)
+        s.execute("CREATE TABLE tm (id BIGINT PRIMARY KEY, v INT)")
+        s.execute(f"INSERT INTO tm VALUES (1, 10), ({self.MAXH}, 20)")
+        assert s.must_query("SELECT COUNT(*) FROM tm") == [("2",)]
+        assert s.must_query(
+            f"SELECT v FROM tm WHERE id = {self.MAXH}") == [("20",)]
+        got = s.must_query("SELECT id FROM tm ORDER BY id")
+        assert got == [("1",), (str(self.MAXH),)]
+        s.execute(f"UPDATE tm SET v = 21 WHERE id = {self.MAXH}")
+        assert s.must_query("SELECT SUM(v) FROM tm") == [("31",)]
+        # DDL backfill walks the record span: the new index must cover
+        # the max handle (the old end bound silently skipped it)
+        s.execute("CREATE INDEX iv ON tm (v)")
+        assert s.must_query("SELECT id FROM tm WHERE v = 21") == [(str(self.MAXH),)]
+        s.execute("ADMIN CHECK TABLE tm")
+        s.execute("ANALYZE TABLE tm")
+
+    def test_max_handle_survives_fold(self, tmp_path):
+        store, s = _mk(tmp_path)
+        s.execute("CREATE TABLE tm (id BIGINT PRIMARY KEY, v INT)")
+        s.execute(f"INSERT INTO tm VALUES (1, 10), ({self.MAXH}, 20)")
+        info = s.infoschema().table(s.current_db, "tm")
+        assert _fold(store, info.id) is not None
+        assert s.must_query("SELECT id FROM tm ORDER BY id") == [
+            ("1",), (str(self.MAXH),)]
+        s.execute("ADMIN CHECK TABLE tm")

@@ -78,6 +78,50 @@ class TestHarnessUnit:
         with pytest.raises(cp.Violation, match="acked txn group 8"):
             cp._verify(ddir, str(tmp_path / "cdc.jsonl"), acks)
 
+    def _cmp_tables(self, tmp_path):
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+
+        ddir = str(tmp_path / "data")
+        s = Session(Storage(data_dir=ddir))
+        s.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        s.execute("CREATE TABLE t_idx (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_cmp (id INT PRIMARY KEY, v INT, KEY kv (v))")
+        return ddir, s
+
+    def test_checker_detects_resurrected_delete_after_fold(self, tmp_path):
+        """Compaction negative test (PR 16): the shape a torn fold would
+        produce — a Z record that replayed its segments without its
+        kills, so the acked round's DELETEd row is back — must raise."""
+        ddir, s = self._cmp_tables(tmp_path)
+        base = 0
+        s.execute("INSERT INTO t_cmp VALUES " + ", ".join(
+            f"({i}, {i * 3})" for i in range(base, base + cp.CMP_GROUP)))
+        s.execute(f"UPDATE t_cmp SET v = v + 1000 WHERE id = {base + 3}")
+        # the round acked a DELETE of base+7 that this state lacks: the
+        # exact read a resurrected row would produce
+        s.store.wal.close()
+        acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0,
+                "ing": set(), "cmp": {0}}
+        with pytest.raises(cp.Violation, match="RESURRECTED"):
+            cp._verify(ddir, str(tmp_path / "cdc.jsonl"), acks)
+
+    def test_checker_detects_non_identical_compacted_span(self, tmp_path):
+        """A fold that changed an acked row's value (half-published
+        artifact, lost update) must be caught as not-bit-identical."""
+        ddir, s = self._cmp_tables(tmp_path)
+        s.execute("INSERT INTO t_cmp VALUES " + ", ".join(
+            f"({i}, {i * 3})" for i in range(cp.CMP_GROUP)))
+        s.execute("UPDATE t_cmp SET v = v + 1000 WHERE id = 3")
+        s.execute("DELETE FROM t_cmp WHERE id = 7")
+        s.execute("UPDATE t_cmp SET v = 1 WHERE id = 2")  # the torn read
+        s.store.wal.close()
+        acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0,
+                "ing": set(), "cmp": {0}}
+        with pytest.raises(cp.Violation, match="not bit-identical"):
+            cp._verify(ddir, str(tmp_path / "cdc.jsonl"), acks)
+
     def test_checker_detects_cdc_ahead_of_durable(self, tmp_path):
         from tidb_tpu.session import Session
         from tidb_tpu.storage.txn import Storage

@@ -362,6 +362,46 @@ class TestStartupLockResolution:
         st2.wal.close()
 
 
+class TestTSORestartMonotonicity:
+    def test_tso_seeds_past_recovered_commits(self, ddir):
+        """A reopened store must never allocate a timestamp at or below a
+        durable commit_ts. TSO physical time is wall-clock ms — without
+        the recovery seed, a reopen inside the SAME millisecond as the
+        predecessor's last commit handed out read timestamps below that
+        commit, making the newest committed write invisible until the
+        clock ticked over (a sub-millisecond flake in restart tests)."""
+        st = Storage(data_dir=ddir)
+        t = st.begin()
+        t.put(b"freshest", b"1")
+        t.commit()
+        high_water = st.tso.current()  # == the commit_ts just allocated
+        st.wal.close()
+
+        st2 = Storage(data_dir=ddir)
+        assert st2.tso.current() >= high_water
+        # the FIRST read already sees the freshest commit — no clock wait
+        assert st2.snapshot().get(b"freshest") == b"1"
+        assert st2.begin().start_ts > high_water
+        st2.wal.close()
+
+    def test_tso_seed_covers_staged_locks(self, ddir):
+        """Orphan locks carry start/for_update timestamps too: a restart
+        mid-commit must not re-allocate a txn id below them."""
+        st = Storage(data_dir=ddir)
+        t = st.begin()
+        t.put(b"a-primary", b"pv")
+        FP.enable("txn/between-prewrite-and-commit", RuntimeError("crash"))
+        with pytest.raises(RuntimeError):
+            t.commit()
+        FP.disable_all()
+        orphan_start = t.start_ts
+        st.wal.close()
+
+        st2 = Storage(data_dir=ddir)
+        assert st2.tso.current() >= orphan_start
+        st2.wal.close()
+
+
 class TestRecoveryModeSysvar:
     def test_set_global_persists_sidecar(self, ddir):
         s = Session(Storage(data_dir=ddir))
@@ -494,6 +534,12 @@ class TestApplyRecordFuzz:
 
     def test_unknown_tag_refused(self):
         with pytest.raises(ValueError, match="unknown WAL record tag"):
-            self._apply(b"Z" + b"\x00" * 8)
+            self._apply(b"Q" + b"\x00" * 8)
         with pytest.raises(ValueError, match="empty"):
             self._apply(b"")
+
+    def test_truncated_compaction_record_refused(self):
+        # 'Z' became a real tag (delta-main compaction): a short Z frame
+        # must refuse parse, not fall through to unknown-tag
+        with pytest.raises(ValueError, match="Z header short"):
+            self._apply(b"Z" + b"\x00" * 8)

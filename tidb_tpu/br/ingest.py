@@ -45,6 +45,28 @@ class IngestAborted(TiDBError):
     schema changed under the window). Nothing became visible."""
 
 
+def publish_barrier(store, table_id: int, tiles=None) -> None:
+    """The shared publish tail every segment producer runs AFTER its WAL
+    record is appended (bulk ingest here, the delta-main compactor in
+    storage/compact.py): the semi-sync durability wait, then ONE
+    data-version bump — which invalidates every session's version-checked
+    tile/build-side cache entries for the table. Pass the local session's
+    tile cache to ALSO drop its decoded tiles eagerly (remote sessions
+    re-validate via the version bump alone)."""
+    # full publish durability point: the record is already fsynced locally
+    # (the producer syncs under the kv lock), but a semi-sync primary must
+    # ALSO wait for the standby's ack before this publish may ack — the
+    # kill-primary→promote crashpoint round caught exactly this gap.
+    # Group-commit ON makes this a covered-seq fast path, never a second
+    # fsync.
+    store.wal_sync()
+    # ONE schema-version barrier for the whole publish: data version bump
+    # + tile/build-side invalidation, not per batch
+    store.bump_version([tablecodec.record_prefix(table_id)])
+    if tiles is not None:
+        tiles.invalidate_table(table_id)
+
+
 def kind_of(ft) -> int:
     """Column kind for the bulk codecs. The PR 11 K_INT fallthrough bug
     lived here: DOUBLE/FLOAT columns fell through to K_INT and were
@@ -326,17 +348,8 @@ class BulkIngest:
             for r in runs:
                 r.commit_ts = commit_ts
             self.store.mvcc.ingest_runs(runs, precondition=self._precondition())
-            # full commit durability point: the ingest record is already
-            # fsynced locally (ingest_runs syncs under the kv lock), but
-            # a semi-sync primary must ALSO wait for the standby's ack
-            # before this commit may ack — the kill-primary→promote
-            # crashpoint round caught exactly this gap. Group-commit ON
-            # makes this a covered-seq fast path, never a second fsync.
-            self.store.wal_sync()
-            # ONE schema-version barrier for the whole ingest: data
-            # version bump + tile/build-side invalidation, not per batch
-            self.store.bump_version([tablecodec.record_prefix(self.info.id)])
-            self.session.cop.tiles.invalidate_table(self.info.id)
+            publish_barrier(self.store, self.info.id,
+                            tiles=self.session.cop.tiles)
             M.INGEST_ROWS.inc(self._rows)
             if self.store.wal is not None:
                 M.INGEST_BYTES.inc(self._bytes, stage="wal")

@@ -127,6 +127,15 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         ["SQL_DIGEST", "EXEC_COUNT", "SUM_CPU_TIME", "AVG_CPU_TIME", "SAMPLE_SQL"],
         [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_varchar(256)],
     ),
+    "compaction": (
+        # delta-main compactor state per table (PR 16, storage/compact.py):
+        # fold/merge round counts, fold output totals, the table's live
+        # run count and current mutable-delta size (w-CF entries)
+        ["TABLE_ID", "FOLDS", "MERGES", "ROWS_FOLDED", "VERSIONS_RECLAIMED",
+         "RUNS", "DELTA_KEYS"],
+        [ft_longlong(), ft_longlong(), ft_longlong(), ft_longlong(),
+         ft_longlong(), ft_longlong(), ft_longlong()],
+    ),
     "tidb_profile_cpu": (
         ["FUNCTION", "PERCENT_ABS", "PERCENT_PARENT", "SAMPLES", "DEPTH"],
         [ft_varchar(512), ft_double(), ft_double(), ft_longlong(), ft_longlong()],
@@ -365,6 +374,10 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.f(cpu), Datum.f(avg), Datum.s(st["sample_sql"]),
             ])
         return out
+    if name == "compaction":
+        from ..storage.compact import compaction_rows
+
+        return [[Datum.i(int(v)) for v in row] for row in compaction_rows(session)]
     if name == "tidb_profile_cpu":
         return _cpu_profile_rows(session)
     if name == "inspection_result":

@@ -28,6 +28,7 @@ from ..chunk.chunk import Chunk
 from ..catalog.schema import IndexInfo, TableInfo
 from ..codec import tablecodec
 from ..codec.key import decode_datum_key
+from ..planner.ranger import prefix_next
 from ..errors import (
     BackoffExhausted,
     DeviceTransientError,
@@ -317,7 +318,7 @@ class CopClient:
         across workers)."""
         if ranges is None:
             prefix = tablecodec.record_prefix(table.id)
-            ranges = [(prefix, prefix + b"\xff")]
+            ranges = [(prefix, prefix_next(prefix))]
         tasks = self.build_tasks(table.id, ranges)
         sctx = self._sched_ctx()
         dirty = txn is not None and self._txn_dirty(txn, table.id)

@@ -27,6 +27,7 @@ from threading import RLock
 
 from ..catalog.meta import Meta
 from ..codec import tablecodec
+from ..planner.ranger import prefix_next
 from ..errors import DuplicateEntry, TiDBError
 from ..utils import metrics as M
 from ..utils.failpoint import inject as _fp
@@ -217,7 +218,7 @@ class DDLWorker:
         prefix = tablecodec.record_prefix(t.id)
         start = prefix if job.reorg_handle is None else tablecodec.record_key(t.id, job.reorg_handle + 1)
         batch = int(job.args.get("reorg_batch_size", BACKFILL_BATCH))
-        rows = txn.scan(start, prefix + b"\xff", limit=batch)
+        rows = txn.scan(start, prefix_next(prefix), limit=batch)
         last_handle = None
         for k, v in rows:
             handle = tablecodec.decode_record_handle(k)

@@ -2911,26 +2911,34 @@ class IndexLookupJoinExec(Executor):
         self._done = True
         from ..codec import tablecodec
         from ..codec.key import encode_datum_key
+        from ..planner.ranger import const_to_col_datum, prefix_next
 
         lchunk = drain(self.outer)
         lkey = self.eq_conds[0][0]
         d, v = _broadcast_lane(*lkey.eval(lchunk), lchunk.num_rows)
         # distinct non-null probe datums → index point ranges
         col = Column(lkey.ret_type, d, v)
+        inner_ft = self.table.columns[self.index.col_offsets[0]].ft
         seen = set()
         ranges = []
         for i in range(lchunk.num_rows):
             if not v[i]:
                 continue
             dat = col.get_datum(i)
+            # probe keys must be encoded in the INNER column's key domain
+            # (e.g. unsigned → 0x04 flag) or they never match stored entries
+            conv = const_to_col_datum(dat, inner_ft)
+            if conv is not None:
+                dat = conv
             key = dat.val if not isinstance(dat.val, (bytearray,)) else bytes(dat.val)
+            key = (dat.kind, key)
             if key in seen:
                 continue
             seen.add(key)
             buf = bytearray(tablecodec.index_prefix(self.table.id, self.index.id))
             encode_datum_key(buf, dat)
             enc = bytes(buf)
-            ranges.append((enc, enc + b"\xff"))
+            ranges.append((enc, prefix_next(enc)))
         # probe/fetch batching (ref: executor/index_lookup_join.go —
         # tidb_index_join_batch_size outer keys per probe round,
         # tidb_index_lookup_size handles per lookup task)

@@ -16,6 +16,7 @@ import numpy as np
 from ..chunk.chunk import Chunk
 from ..codec import tablecodec
 from ..planner.fragment import MPPPlan, slice_plan
+from ..planner.ranger import prefix_next
 from ..planner.plans import Join, LogicalPlan
 from ..sched.scheduler import raise_if_interrupted
 from ..utils import memory
@@ -332,7 +333,7 @@ class MPPGatherExec(Executor):
                     # column: do it once per (table, version), not per
                     # dispatch (the host twin of the device-lane cache)
                     if parts is None:
-                        tasks = client.build_tasks(table.id, [(prefix, prefix + b"\xff")])
+                        tasks = client.build_tasks(table.id, [(prefix, prefix_next(prefix))])
                         parts = [
                             client.tiles.get_batch(table, t.start, t.end, self.ctx.read_ts)
                             for t in tasks

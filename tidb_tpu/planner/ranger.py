@@ -76,16 +76,24 @@ def const_to_col_datum(d: Datum, ft: FieldType) -> Datum | None:
                 return Datum.t(p) if p is not None else None
             return None
         if ft.is_int():
+            # unsigned columns store 0x04 UINT-flag keys (encode_uint);
+            # emitting a signed 0x03 datum here would build a key range
+            # that can never match a stored entry
+            def _fit(v: int) -> Datum | None:
+                if ft.is_unsigned:
+                    return Datum.u(v) if 0 <= v < (1 << 64) else None
+                return Datum.i(v) if -(1 << 63) <= v < (1 << 63) else None
+
             if k in (K_INT, K_UINT):
-                return Datum.i(int(d.val))
+                return _fit(int(d.val))
             if k == K_FLOAT:
-                return Datum.i(int(d.val)) if float(d.val).is_integer() else None
+                return _fit(int(d.val)) if float(d.val).is_integer() else None
             if k == K_DEC:
                 dec = d.to_dec()
                 if dec.scale == 0:
-                    return Datum.i(dec.value)
+                    return _fit(dec.value)
                 p = 10 ** dec.scale
-                return Datum.i(dec.value // p) if dec.value % p == 0 else None
+                return _fit(dec.value // p) if dec.value % p == 0 else None
             return None
         if ft.is_decimal():
             if k in (K_INT, K_UINT, K_DEC):

@@ -39,6 +39,7 @@ from ..mysqltypes.field_type import NOT_NULL_FLAG, PRI_KEY_FLAG, AUTO_INCREMENT_
 from ..mysqltypes.coretime import parse_datetime
 from ..parser import ast, parse_one
 from ..planner.builder import NameScope, PlanBuilder, lit_to_constant
+from ..planner.ranger import prefix_next
 from ..planner.optimizer import optimize
 from ..planner.plans import DataSource, Selection
 from ..storage.txn import Storage, TOMBSTONE, Txn
@@ -1349,7 +1350,7 @@ class Session:
         prefix = tablecodec.record_prefix(pid)
         decoded = [
             (tablecodec.decode_record_handle(k), tbl.decode_record(v))
-            for k, v in snap.scan(prefix, prefix + b"\xff")
+            for k, v in snap.scan(prefix, prefix_next(prefix))
         ]
         for idx in info.indexes:
             if idx.state != "public" or (info.pk_is_handle and idx.primary):
@@ -1359,7 +1360,7 @@ class Session:
                 key, val, _ = tbl.index_value_key(idx, tbl.row_datums_with_hidden(datums, handle), handle)
                 expected[key] = val
             ipfx = tablecodec.index_prefix(pid, idx.id)
-            actual = dict(snap.scan(ipfx, ipfx + b"\xff"))
+            actual = dict(snap.scan(ipfx, prefix_next(ipfx)))
             missing = set(expected) - set(actual)
             dangling = set(actual) - set(expected)
             # values must match too: a unique entry pointing at the wrong
@@ -1389,7 +1390,7 @@ class Session:
             tbl = Table(info.partition_physical(pid)) if info.partition else Table(info)
             prefix = tablecodec.record_prefix(pid)
             expected = {}
-            for k, v in snap.scan(prefix, prefix + b"\xff"):
+            for k, v in snap.scan(prefix, prefix_next(prefix)):
                 handle = tablecodec.decode_record_handle(k)
                 datums = tbl.decode_record(v)
                 key, val, _ = tbl.index_value_key(
@@ -1398,7 +1399,7 @@ class Session:
                 expected[key] = val
                 scanned += 1
             ipfx = tablecodec.index_prefix(pid, idx.id)
-            actual = dict(snap.scan(ipfx, ipfx + b"\xff"))
+            actual = dict(snap.scan(ipfx, prefix_next(ipfx)))
             if recover:
                 for k in set(expected) - set(actual):
                     txn.put(k, expected[k])
@@ -1533,6 +1534,22 @@ class Session:
             self.store.mem.set_limit(int(val))
         elif name == "tidb_memory_usage_alarm_ratio":
             self.store.mem.set_alarm_ratio(float(val))
+        elif name == "tidb_compact_interval":
+            # the compactor re-reads global_vars each tick — validate the
+            # duration here (so a bad SET fails loudly, not silently at
+            # the next tick) and wake the worker to adopt the new cadence
+            from ..storage.gcworker import parse_go_duration_ms
+
+            if parse_go_duration_ms(val) is None:
+                raise TiDBError(f"invalid duration value for '{name}': '{val}'")
+            comp = self.store.compactor
+            if comp is not None:
+                comp.wake()
+        elif name in ("tidb_compact_enable", "tidb_compact_delta_threshold",
+                      "tidb_compact_max_runs"):
+            comp = self.store.compactor
+            if comp is not None:
+                comp.wake()  # pull-model knobs: next round sees them
 
     def _sysvar_read_global(self, name: str):
         """@@global.x: the store-wide value (SET GLOBAL overrides over
@@ -2694,9 +2711,9 @@ class Session:
                     # pessimistic DML scans with a CURRENT read (fresh
                     # for_update_ts) so rows that started matching after
                     # start_ts are found and locked, not just re-filtered
-                    part = txn.scan_current(prefix, prefix + b"\xff")
+                    part = txn.scan_current(prefix, prefix_next(prefix))
                 else:
-                    part = txn.scan(prefix, prefix + b"\xff")
+                    part = txn.scan(prefix, prefix_next(prefix))
                 kvs.extend((ptbl, k, v) for k, v in part)
             for ptbl, k, v in kvs:
                 handle = tablecodec.decode_record_handle(k)

@@ -230,6 +230,22 @@ _sv("tidb_tpu_mpp_fused", "ON", scope="global", kind="bool", consumed=True)
 # overrides per statement).
 _sv("tidb_bulk_ingest", "ON", kind="bool", consumed=True)
 
+# --- delta-main compaction (PR 16: storage/compact.py) ---------------------
+# The background worker that folds row-major txn writes + MVCC versions
+# at/below the gc safepoint into sorted columnar segments, one per
+# durable primary store. GLOBAL-only: compaction is a store property
+# (the worker reads these from store.global_vars every tick — SET GLOBAL
+# takes effect on the next round, no restart).
+_sv("tidb_compact_enable", "ON", scope="global", kind="bool", consumed=True)
+# minimum mutable w-CF entries under a table's prefix before a fold is
+# worth the decode/build cost (MemKV.count_range per tick is two bisects)
+_sv("tidb_compact_delta_threshold", "2048", scope="global", kind="int", lo=1, consumed=True)
+# per-plane run-count bound: above it the oldest contiguous commit-ts
+# prefix of structurally identical runs merges into one (size-tiered)
+_sv("tidb_compact_max_runs", "8", scope="global", kind="int", lo=2, consumed=True)
+# background tick cadence, tidb_gc_* go-duration format ('500ms', '5s')
+_sv("tidb_compact_interval", "1s", scope="global", consumed=True)
+
 # --- server memory arbitration (PR 4: utils/memory ServerMemTracker) -------
 # store-wide hard limit on tracked statement memory; 0 = unlimited.
 # GLOBAL-only like the reference: a per-session opt-out would defeat it

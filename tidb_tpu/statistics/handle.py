@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 
 from ..codec import tablecodec
+from ..planner.ranger import prefix_next
 from .tablestats import TableStats, build_table_stats
 
 AUTO_ANALYZE_RATIO = 0.5
@@ -52,7 +53,7 @@ class StatsHandle:
         for pid in info.physical_ids():
             phys = info.partition_physical(pid) if info.partition else info
             prefix = tablecodec.record_prefix(pid)
-            for region, s, e in session.store.regions.split_ranges(prefix, prefix + b"\xff"):
+            for region, s, e in session.store.regions.split_ranges(prefix, prefix_next(prefix)):
                 batches.append(cop.tiles.get_batch(phys, s, e, read_ts))
         ts = build_table_stats(info, batches, read_ts)
         self.save(ts, session)

@@ -1,0 +1,706 @@
+"""Delta-main compaction (PR 16) — the background worker that turns the
+storage engine from append-only-plus-bulk into a true delta-main system
+(ref: TiFlash delta-tree — OLTP writes land row-major in a delta layer
+and a compactor folds them into the columnar main; arXiv 2112.13099 on
+specializing resident layout to the workload).
+
+Every txn write lands row-major in MemKV (the delta). The compactor,
+one per durable primary store, periodically:
+
+  1. selects tables whose mutable delta (w-CF entries) exceeds a
+     threshold, using MemKV.count_range per table prefix (two bisects —
+     no value touching),
+  2. folds each such table's rows PLUS every MVCC version at/below the
+     gcworker safepoint into fresh sorted ColumnarRun / IntIndexRun /
+     byte-Run segments (MVCCStore.fold_plan decides; the decode reuses
+     the scan path's row→chunk machinery and br/ingest's builders), and
+  3. publishes under the SAME atomic discipline bulk ingest uses: one
+     WAL record (the 'Z' compaction frame), one data-version bump, one
+     cache invalidation barrier, a crashpoint before the journal append,
+     standby-shippable.
+
+Merges keep the per-table run count bounded: when a key-space plane
+(record plane, or the index planes jointly) accumulates more than
+max_runs runs, the OLDEST contiguous commit-ts prefix of structurally
+identical runs folds into one (size-tiered/leveled: small young runs
+repeatedly merge into a larger old one). Only a contiguous-by-ts prefix
+is safe to merge — the merged run takes the newest source's commit_ts,
+so a skipped-over middle run would suddenly lose to resurrected older
+versions.
+
+MVCC GC is wired THROUGH this subsystem: gcworker.tick delegates table
+spans to Compactor.gc_pass (delete-versions-via-compaction — versions
+die by folding, the newest visible value surviving as a segment row)
+and mvcc.gc sweeps only what the fold doesn't own (meta keys, stores
+without a compactor).
+
+Scheduling: a compaction is a low-priority internal job. It defers
+whenever the admission scheduler has foreground waiters, pauses
+entirely while the memory arbiter's OOM degrade is active, and never
+instantiates the resource controller on a store that hasn't built one.
+
+Concurrency: folds race against live commits by design — the fold plan
+is recomputed under the kv lock at publish time and compared to the
+plan the artifact was built from (MVCCStore.apply_compaction's
+expect_plans); any slip aborts the round with nothing journaled
+(CompactionRaced) and the next tick retries. The compactor's own _lock
+(rank compact.worker) guards only its stats dict and is never held
+across a kv/wal acquisition.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import weakref
+
+import numpy as np
+
+from ..codec import tablecodec
+from ..utils import metrics as M
+from ..utils.failpoint import inject as _fp
+from .mvcc import CompactionRaced, _dk
+
+
+def _prefix_next(prefix: bytes) -> bytes:
+    from ..planner.ranger import prefix_next
+
+    return prefix_next(prefix)
+
+
+def _decode_be_handles(sl: np.ndarray, n: int) -> np.ndarray:
+    """(n, 8) big-endian sign-flipped key bytes → int64 (vectorized)."""
+    enc = np.ascontiguousarray(sl).view(">u8").reshape(n)
+    return (enc.astype(np.uint64) ^ np.uint64(1 << 63)).view(np.int64)
+
+
+class Compactor:
+    """Background delta-main compactor for ONE durable primary store.
+
+    Holds only a weakref to the store: the worker thread must never pin
+    a store that tests (or a failover) have dropped — the loop exits
+    when the ref dies. Inert by default in short-lived processes: the
+    fold timestamp is the gc safepoint (now - tidb_gc_life_time, 10min
+    default), so young versions never fold until an operator shortens
+    the gc life or the gcworker delegates a pass.
+    """
+
+    DEFAULT_INTERVAL_S = 1.0
+    DEFAULT_THRESHOLD = 2048
+    DEFAULT_MAX_RUNS = 8
+    FAN_IN = 4  # size-tiered merge width: oldest <=4 ts-groups fold into one
+
+    def __init__(self, storage):
+        self._storage = weakref.ref(storage)
+        # rank "compact.worker": guards stats/last_error ONLY; never held
+        # across any kv/wal/scheduler acquisition
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats: dict[int, dict] = {}  # table_id → counters (under _lock)
+        self.rounds = 0
+        self.last_error = ""
+        # (sp, delta) memo per table: skip re-walking a span whose state
+        # can't have changed since the last no-op attempt
+        self._attempted: dict[int, tuple[int, int]] = {}
+
+    # --- config (read from store.global_vars each tick: SET GLOBAL is
+    # the control plane, no push plumbing needed) --------------------------
+
+    def enabled(self, store) -> bool:
+        return store.global_vars.get("tidb_compact_enable", "ON") == "ON"
+
+    def _threshold(self, store) -> int:
+        try:
+            return int(store.global_vars.get(
+                "tidb_compact_delta_threshold", self.DEFAULT_THRESHOLD))
+        except ValueError:
+            return self.DEFAULT_THRESHOLD
+
+    def _max_runs(self, store) -> int:
+        try:
+            return max(2, int(store.global_vars.get(
+                "tidb_compact_max_runs", self.DEFAULT_MAX_RUNS)))
+        except ValueError:
+            return self.DEFAULT_MAX_RUNS
+
+    def _interval_s(self, store) -> float:
+        from .gcworker import parse_go_duration_ms
+
+        ms = parse_go_duration_ms(
+            str(store.global_vars.get("tidb_compact_interval", "")))
+        return ms / 1000.0 if ms else self.DEFAULT_INTERVAL_S
+
+    # --- worker lifecycle --------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tidb-compactor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            store = self._storage()
+            if store is None:
+                return
+            interval = self._interval_s(store)
+            store = None  # don't pin the store across the wait
+            self._wake.wait(interval)
+            self._wake.clear()
+            if self._stopped.is_set():
+                return
+            store = self._storage()
+            if store is None:
+                return
+            try:
+                self.tick(store)
+            except Exception as e:  # the worker must never die silently
+                with self._lock:
+                    self.last_error = f"{type(e).__name__}: {e}"
+            store = None
+
+    # --- one round ---------------------------------------------------------
+
+    def tick(self, store=None, force_sp: int | None = None) -> dict:
+        """One compaction round: threshold-select tables, fold each,
+        then bound run counts via merges. Synchronous — tests and the
+        gcworker call it directly; the background thread is just a
+        clock."""
+        store = self._storage() if store is None else store
+        out = {"folded": 0, "removed": 0, "merged": 0}
+        if store is None or store.standby or not self.enabled(store):
+            return out
+        if store.mem.degraded:
+            # OOM degrade pauses internal jobs first: a compaction's
+            # decode/build allocations are exactly what the arbiter is
+            # trying to claw back
+            M.COMPACT_ROUNDS.inc(outcome="paused")
+            return out
+        rc = getattr(store, "_sched", None)
+        if rc is not None and rc.scheduler.queue_depth() > 0:
+            # strictly-background admission: foreground statements are
+            # queued — never compete with them for a slot
+            M.COMPACT_ROUNDS.inc(outcome="deferred")
+            return out
+        sp = store.gc_worker.compute_safe_point() if force_sp is None else force_sp
+        if sp <= 0:
+            return out
+        threshold = self._threshold(store)
+        for tid, _prefix, delta in self._candidates(store):
+            if delta < threshold:
+                continue
+            if self._attempted.get(tid) == (sp, delta):
+                continue  # nothing changed since the last no-op attempt
+            res = self.compact_table(store, tid, sp)
+            if res is None:
+                self._attempted[tid] = (sp, delta)
+            else:
+                self._attempted.pop(tid, None)
+                out["folded"] += res["rows"]
+                out["removed"] += res["removed"]
+        for tid in self._tables_with_runs(store):
+            out["merged"] += self.maybe_merge(store, tid)
+        with self._lock:
+            self.rounds += 1
+        return out
+
+    def gc_pass(self, store, sp: int) -> int:
+        """The gcworker's delete-versions-via-compaction path: fold EVERY
+        table span's at-or-below-safepoint versions (no delta threshold —
+        GC must reclaim), returning mutable versions removed. Tables the
+        fold skips (raced, ingest window open) are left for mvcc.gc's
+        per-key sweep right after."""
+        removed = 0
+        for tid, _prefix, _delta in self._candidates(store):
+            res = self.compact_table(store, tid, sp)
+            if res is not None:
+                removed += res["removed"]
+        return removed
+
+    # --- selection ---------------------------------------------------------
+
+    def _candidates(self, store):
+        """(table_id, 9-byte prefix, delta count) per table present in
+        the mutable write CF — leapfrogs prefix to prefix via bisect, so
+        cost is O(tables · log n), not O(versions)."""
+        kv = store.mvcc.kv
+        out = []
+        k = kv.first_key_at_or_after(b"w")
+        while k is not None and k[:1] == b"w" and len(k) >= 10:
+            prefix = k[1:10]
+            end = b"w" + _prefix_next(prefix)
+            if prefix[:1] == b"t":
+                delta = kv.count_range(b"w" + prefix, end)
+                out.append((tablecodec._dint(prefix[1:9]), prefix, delta))
+            k = kv.first_key_at_or_after(end)
+        return out
+
+    def _tables_with_runs(self, store):
+        tids = set()
+        with store.mvcc.kv.lock:
+            for r in store.mvcc.runs:
+                tid = getattr(r, "table_id", None)
+                if tid is None and r.n:
+                    k = r.key_at(0)
+                    if k[:1] == b"t" and len(k) >= 9:
+                        tid = tablecodec._dint(k[1:9])
+                if tid is not None:
+                    tids.add(tid)
+        return sorted(tids)
+
+    # --- fold --------------------------------------------------------------
+
+    def compact_table(self, store, tid: int, sp: int) -> dict | None:
+        """Fold one table's mutable delta at/below sp into segments.
+        Returns stats, or None when there was nothing to fold or the
+        round must retry (raced, ingest window open, value vanished)."""
+        if store.table_ingesting(tid):
+            return None  # the ingest window owns this table right now
+        from ..utils.tracing import StatementTrace
+
+        # folding re-stamps survivor versions at the fold ts, so ANY
+        # snapshot between the original commit_ts and the fold ts would
+        # stop seeing them — the same contract GC enforces. tick() passes
+        # the gcworker safepoint (already clamped); force-folds (tests,
+        # crashpoints, bench) get the clamp here so a live txn's current
+        # reads never lose a row to a concurrent fold.
+        ma = store.min_active_start_ts()
+        if ma is not None:
+            sp = min(sp, ma - 1)
+        if sp <= 0:
+            return None
+        mvcc = store.mvcc
+        tprefix = tablecodec.table_prefix(tid)
+        start, end = tprefix, _prefix_next(tprefix)
+        trace = StatementTrace(sql=f"COMPACT TABLE {tid}", recording=True)
+        with trace.span("compact.plan", table=tid):
+            with mvcc.kv.lock:
+                plan = mvcc.fold_plan(start, end, sp)
+                doom, _kills, puts = plan
+                if not doom:
+                    return None  # nothing at/below the safepoint
+                vals = {}
+                for uk, sts, _cts in puts:
+                    v = mvcc.kv.get(_dk(uk, sts))
+                    if v is None:  # concurrent per-key gc got there first
+                        return None
+                    vals[uk] = v
+        with trace.span("compact.build", rows=len(puts)):
+            new_runs = self._build_runs(store, tid, tprefix, puts, vals, sp)
+        # crashpoint: artifacts built and sorted, NOTHING journaled or
+        # published — recovery must see the compaction as absent (and the
+        # pre-fold row-major state still fully intact)
+        _fp("compact/after-artifact-before-publish")
+        from .wal import rec_compact
+
+        record = rec_compact(tid, sp, [(start, end)], [], new_runs)
+        # a txn that began at/below the fold ts while we built artifacts
+        # could read the span mid-snapshot — abort the round like any
+        # other race (the plan compare below only witnesses WRITES)
+        ma = store.min_active_start_ts()
+        if ma is not None and ma <= sp:
+            M.COMPACT_ROUNDS.inc(outcome="raced")
+            return None
+        with trace.span("compact.publish", runs=len(new_runs)):
+            try:
+                removed = mvcc.apply_compaction(
+                    tid, sp, [(start, end)], [], new_runs,
+                    record=record, expect_plans=[plan])
+            except CompactionRaced:
+                M.COMPACT_ROUNDS.inc(outcome="raced")
+                return None
+            publish_barrier(store, tid)
+        M.COMPACT_ROUNDS.inc(outcome="fold")
+        M.COMPACT_ROWS.inc(len(puts))
+        M.COMPACT_VERSIONS.inc(removed)
+        M.COMPACT_BYTES.inc(len(record))
+        self._bump(tid, rows_folded=len(puts), versions_reclaimed=removed,
+                   folds=1)
+        trace.finish()
+        store.trace_ring.push(trace)
+        return {"rows": len(puts), "removed": removed, "runs": len(new_runs)}
+
+    def _bump(self, tid: int, **deltas) -> None:
+        with self._lock:
+            st = self.stats.setdefault(tid, {
+                "folds": 0, "merges": 0, "rows_folded": 0,
+                "versions_reclaimed": 0,
+            })
+            for k, v in deltas.items():
+                st[k] = st.get(k, 0) + v
+
+    def _table_info(self, store, tid: int):
+        from ..catalog.meta import Meta
+
+        txn = store.begin()
+        try:
+            return Meta(txn).table(tid)
+        except Exception:
+            return None
+        finally:
+            txn.rollback()
+
+    def _build_runs(self, store, tid, tprefix, puts, vals, sp) -> list:
+        """Folded (key, value) pairs → segments: columnar record plane
+        and int-index planes where the shapes allow, byte runs for
+        everything else (string/NULL/uint index keys, schema-less
+        tables). Input arrives in ascending key order (fold_plan walks
+        the sorted CF), which every builder below relies on."""
+        from ..br.ingest import runs_from_kvs
+
+        rec_prefix = tablecodec.record_prefix(tid)
+        idx_marker = tprefix + b"_i"
+        rec_keys: list[bytes] = []
+        rec_vals: list[bytes] = []
+        by_iid: dict[int, list[tuple[bytes, bytes]]] = {}
+        other: list[tuple[bytes, bytes]] = []
+        for uk, _sts, _cts in puts:
+            v = vals[uk]
+            if len(uk) == 19 and uk.startswith(rec_prefix):
+                rec_keys.append(uk)
+                rec_vals.append(v)
+            elif len(uk) >= 19 and uk.startswith(idx_marker):
+                by_iid.setdefault(tablecodec._dint(uk[11:19]), []).append((uk, v))
+            else:
+                other.append((uk, v))
+
+        info = self._table_info(store, tid)
+        runs: list = []
+        if rec_keys:
+            crun = None
+            if info is not None:
+                try:
+                    crun = self._build_record_run(info, rec_keys, rec_vals, sp)
+                except Exception:
+                    crun = None  # odd row payloads: keep them row-encoded
+            if crun is not None:
+                runs.append(crun)
+            else:
+                other.extend(zip(rec_keys, rec_vals))
+        for iid, pairs in sorted(by_iid.items()):
+            ix = None
+            if info is not None:
+                ix = next((x for x in info.indexes if x.id == iid), None)
+            irun = self._build_int_index_run(tid, ix, pairs, sp) if ix else None
+            if irun is not None:
+                runs.append(irun)
+            else:
+                other.extend(pairs)
+        if other:
+            other.sort()
+            runs.extend(runs_from_kvs(other, sp))
+        return runs
+
+    def _build_record_run(self, info, keys, vals, sp):
+        """Row-encoded record pairs → one ColumnarRun, through the SAME
+        row→chunk decode the scan path serves from — so a fold changes
+        the resident layout, never the values a read decodes."""
+        from ..br.ingest import kind_of
+        from ..copr.tilecache import decode_rows_to_batch
+        from ..mysqltypes.datum import K_DEC, K_FLOAT, K_STR, K_UINT
+        from .segment import ColSpec, ColumnarRun, canonical_str_array
+
+        batch = decode_rows_to_batch(info, list(zip(keys, vals)), 0)
+        specs = []
+        for c, data, valid in zip(info.columns, batch.data, batch.valid):
+            if getattr(c, "hidden", False) and c.name == "_tidb_rowid":
+                continue  # the run's handle plane carries it
+            k = kind_of(c.ft)
+            if k == K_STR:
+                if data.dtype.kind != "S":
+                    data = np.array(
+                        [x if (valid[i] and x is not None) else ""
+                         for i, x in enumerate(data)], dtype=object)
+                    data = canonical_str_array(data)
+            elif k == K_FLOAT:
+                data = np.ascontiguousarray(data, dtype=np.float64)
+            elif k == K_UINT:
+                data = np.ascontiguousarray(data, dtype=np.uint64)
+            else:
+                data = np.asarray(data).astype(np.int64, copy=False)
+            v = None if bool(valid.all()) else np.ascontiguousarray(valid, dtype=bool)
+            scale = max(c.ft.decimal, 0) if k == K_DEC else 0
+            specs.append(ColSpec(c.id, k, scale, data, v))
+        # keys ascend, and sign-flipped BE preserves int64 order — presorted
+        return ColumnarRun.build(info.id, batch.handles, specs, sp, presorted=True)
+
+    def _build_int_index_run(self, tid, ix, pairs, sp):
+        """Index pairs → IntIndexRun when every key is the pure int form
+        (0x03-flagged complete groups, the txn path's value shape) —
+        anything else (NULLs, strings, unsigned 0x04 flags) returns None
+        and stays a byte run. Verification is exact: a pair the plane
+        could not reproduce bit-identically never enters it."""
+        from .segment import IntIndexRun
+
+        plen = 19  # index_prefix: t + tid + _i + iid
+        k_count = len(ix.col_offsets)
+        klen = plen + 9 * k_count + (0 if ix.unique else 8)
+        n = len(pairs)
+        if n == 0 or any(len(k) != klen for k, _ in pairs):
+            return None
+        km = np.frombuffer(b"".join(k for k, _ in pairs), np.uint8).reshape(n, klen)
+        cols = []
+        for g in range(k_count):
+            off = plen + 9 * g
+            if not bool((km[:, off] == 0x03).all()):
+                return None  # NULL / uint / non-int flag byte
+            cols.append(_decode_be_handles(km[:, off + 1 : off + 9], n))
+        if ix.unique:
+            try:
+                handles = np.fromiter((int(v) for _, v in pairs), np.int64, n)
+            except (ValueError, TypeError):
+                return None
+            for (_, v), h in zip(pairs, handles):
+                if v != str(int(h)).encode():
+                    return None  # value form the plane can't synthesize
+        else:
+            if any(v != b"" for _, v in pairs):
+                return None
+            handles = _decode_be_handles(km[:, plen + 9 * k_count :], n)
+        return IntIndexRun.build(tid, ix.id, cols, handles, bool(ix.unique), sp)
+
+    # --- merge -------------------------------------------------------------
+
+    def maybe_merge(self, store, tid: int) -> int:
+        """Bound the table's run count: while any key-space plane holds
+        more than max_runs runs, fold the oldest contiguous prefix of
+        structurally identical ts-groups into one run (size-tiered).
+        Returns runs retired."""
+        mvcc = store.mvcc
+        max_runs = self._max_runs(store)
+        tprefix = tablecodec.table_prefix(tid)
+        retired_total = 0
+        for _ in range(8):  # a few levels per tick, never unbounded
+            with mvcc.kv.lock:
+                cand = self._merge_candidate(mvcc, tid, tprefix, max_runs)
+                if cand is None:
+                    break
+                skey, take = cand
+                merged, retire = self._merge_build(tid, skey, take)
+            if merged is None:
+                break
+            _fp("compact/after-artifact-before-publish")
+            from .wal import rec_compact
+
+            record = rec_compact(tid, merged.commit_ts, [], retire, [merged])
+            try:
+                mvcc.apply_compaction(
+                    tid, merged.commit_ts, [], retire, [merged],
+                    record=record, expect_plans=None)
+            except CompactionRaced:  # pragma: no cover - no spans, no race
+                break
+            publish_barrier(store, tid)
+            n_retired = sum(len(rs) for _cts, rs in take)
+            retired_total += n_retired
+            M.COMPACT_ROUNDS.inc(outcome="merge")
+            M.COMPACT_BYTES.inc(len(record))
+            self._bump(tid, merges=1)
+        return retired_total
+
+    def _merge_candidate(self, mvcc, tid, tprefix, max_runs):
+        """Pick (structural key, [(cts, [runs])]) to merge, or None.
+        Caller holds kv.lock. Planes: the record key-space (ColumnarRuns
+        + 19-byte record-shaped byte runs) and the index key-space (all
+        IntIndexRuns + other byte runs) — runs only shadow within their
+        plane, and ONLY an oldest-first contiguous ts-prefix may collapse
+        into one commit_ts without reordering history."""
+        from .segment import ColumnarRun, IntIndexRun, Run
+
+        planes: dict[str, list] = {"rec": [], "idx": []}
+        for r in mvcc.runs:
+            if isinstance(r, ColumnarRun):
+                if r.table_id == tid:
+                    planes["rec"].append((r.commit_ts, ("C", 0), r))
+            elif isinstance(r, IntIndexRun):
+                if r.table_id == tid:
+                    planes["idx"].append((r.commit_ts, ("N", r.index_id), r))
+            elif type(r) is Run and r.n and r.key_at(0).startswith(tprefix):
+                rec_shaped = r.w == 19 and r.key_at(0)[9:11] == b"_r"
+                planes["rec" if rec_shaped else "idx"].append(
+                    (r.commit_ts, ("R", r.w), r))
+        for items in planes.values():
+            if len(items) <= max_runs:
+                continue
+            items.sort(key=lambda t: t[0])  # stable: equal ts keep list order
+            groups: list[tuple[int, set, list]] = []
+            for cts, skey, r in items:
+                if groups and groups[-1][0] == cts:
+                    groups[-1][1].add(skey)
+                    groups[-1][2].append(r)
+                else:
+                    groups.append((cts, {skey}, [r]))
+            first = groups[0][1]
+            if len(first) != 1:
+                continue  # mixed oldest group: nothing safely mergeable
+            skey = next(iter(first))
+            take = []
+            for cts, skeys, rs in groups:
+                if skeys != {skey}:
+                    break  # structural barrier: stay a contiguous prefix
+                take.append((cts, rs))
+                if len(take) >= self.FAN_IN:
+                    break
+            if len(take) >= 2:
+                return skey, take
+        return None
+
+    def _merge_build(self, tid, skey, take):
+        """Build the merged run from ts-ascending source groups. Returns
+        (run | None, retire identities). Keep-newest dedup: concatenation
+        order is history order, stable sorts preserve it, and the LAST
+        occurrence of a key wins."""
+        kind, aux = skey
+        srcs = [r for _cts, rs in take for r in rs]
+        cts_out = take[-1][0]
+        if kind == "C":
+            merged = self._merge_columnar(tid, srcs, cts_out)
+            retire = [(0, 0, cts) for cts, _rs in take]
+        elif kind == "N":
+            merged = self._merge_intindex(tid, aux, srcs, cts_out)
+            retire = [(1, aux, cts) for cts, _rs in take]
+        else:
+            merged = self._merge_byte(srcs, cts_out)
+            retire = [(2, aux, cts) for cts, _rs in take]
+        return merged, retire
+
+    def _merge_columnar(self, tid, runs, cts_out):
+        from .segment import ColSpec, ColumnarRun
+
+        sig = [(c.cid, c.kind, c.scale) for c in runs[0].cols]
+        for r in runs[1:]:
+            if [(c.cid, c.kind, c.scale) for c in r.cols] != sig:
+                return None  # schema drifted between ingests: don't merge
+        hs, datas, valids = [], [[] for _ in sig], [[] for _ in sig]
+        has_valid = [False] * len(sig)
+        for r in runs:
+            keep = np.nonzero(r.alive)[0] if r.alive is not None else None
+            h = r.handles_arr if keep is None else r.handles_arr[keep]
+            hs.append(h)
+            for ci, c in enumerate(r.cols):
+                d = c.data if keep is None else c.data[keep]
+                datas[ci].append(d)
+                if c.valid is not None:
+                    has_valid[ci] = True
+                    valids[ci].append(c.valid if keep is None else c.valid[keep])
+                else:
+                    valids[ci].append(np.ones(len(h), dtype=bool))
+        handles = np.concatenate(hs)
+        n = len(handles)
+        if n == 0:
+            return None
+        order = np.argsort(handles, kind="stable")
+        sh = handles[order]
+        last = np.ones(n, dtype=bool)
+        if n > 1:
+            last[:-1] = sh[:-1] != sh[1:]
+        sel = order[last]
+        specs = []
+        for ci, (cid, ckind, scale) in enumerate(sig):
+            data = np.concatenate(datas[ci])[sel]
+            v = None
+            if has_valid[ci]:
+                v = np.concatenate(valids[ci])[sel]
+                if bool(v.all()):
+                    v = None
+            specs.append(ColSpec(cid, ckind, scale, data, v))
+        return ColumnarRun.build(tid, sh[last], specs, cts_out, presorted=True)
+
+    def _merge_intindex(self, tid, iid, runs, cts_out):
+        from .segment import IntIndexRun
+
+        k_count = len(runs[0].key_cols)
+        unique = bool(runs[0].unique)
+        for r in runs[1:]:
+            if len(r.key_cols) != k_count or bool(r.unique) != unique:
+                return None
+        cols = [[] for _ in range(k_count)]
+        hs = []
+        for r in runs:
+            keep = np.nonzero(r.alive)[0] if r.alive is not None else None
+            hs.append(r.handles_arr if keep is None else r.handles_arr[keep])
+            for ci, c in enumerate(r.key_cols):
+                cols[ci].append(c if keep is None else c[keep])
+        handles = np.concatenate(hs)
+        n = len(handles)
+        if n == 0:
+            return None
+        ccols = [np.concatenate(c) for c in cols]
+        levels = ccols + ([] if unique else [handles])
+        order = np.lexsort(tuple(levels[::-1]))  # stable, primary first
+        same = np.zeros(n - 1, dtype=bool) if n > 1 else np.zeros(0, dtype=bool)
+        if n > 1:
+            same[:] = True
+            for lv in levels:
+                s = lv[order]
+                same &= s[1:] == s[:-1]
+        last = np.ones(n, dtype=bool)
+        last[:-1] = ~same
+        sel = order[last]
+        return IntIndexRun(tid, iid, [c[sel] for c in ccols], handles[sel],
+                           unique, cts_out)
+
+    def _merge_byte(self, runs, cts_out):
+        from ..br.ingest import runs_from_kvs
+
+        pairs: dict[bytes, bytes] = {}
+        for r in runs:  # history order: later assignment = newer wins
+            for i in range(r.n):
+                if r.alive is None or r.alive[i]:
+                    pairs[r.key_at(i)] = r.value(i)
+        if not pairs:
+            return None
+        out = runs_from_kvs(sorted(pairs.items()), cts_out)
+        return out[0] if len(out) == 1 else None
+
+
+def publish_barrier(store, table_id: int) -> None:
+    """The publish tail shared with bulk ingest (br/ingest owns it; this
+    shim only dodges the storage→br import at module load): semi-sync
+    durability wait, then ONE data-version bump — which invalidates every
+    session's version-checked tile/build-side cache entries."""
+    from ..br.ingest import publish_barrier as _pb
+
+    _pb(store, table_id)
+
+
+def compaction_rows(session) -> list:
+    """information_schema.COMPACTION memtable rows (catalog/memtables)."""
+    store = session.store
+    comp = store.compactor
+    if comp is None:
+        return []
+    with store.mvcc.kv.lock:
+        run_counts: dict[int, int] = {}
+        for r in store.mvcc.runs:
+            tid = getattr(r, "table_id", None)
+            if tid is None and r.n:
+                k = r.key_at(0)
+                if k[:1] == b"t" and len(k) >= 9:
+                    tid = tablecodec._dint(k[1:9])
+            if tid is not None:
+                run_counts[tid] = run_counts.get(tid, 0) + 1
+    deltas = {tid: delta for tid, _p, delta in comp._candidates(store)}
+    with comp._lock:
+        stats = {tid: dict(st) for tid, st in comp.stats.items()}
+    rows = []
+    for tid in sorted(set(stats) | set(run_counts) | set(deltas)):
+        st = stats.get(tid, {})
+        rows.append((tid, st.get("folds", 0), st.get("merges", 0),
+                     st.get("rows_folded", 0), st.get("versions_reclaimed", 0),
+                     run_counts.get(tid, 0), deltas.get(tid, 0)))
+    return rows

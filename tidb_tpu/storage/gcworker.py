@@ -89,6 +89,15 @@ class GCWorker:
         self.last_safe_point = sp
         self.runs += 1
         self._resolve_orphan_locks(sp, now_ms)
-        removed = self.storage.mvcc.gc(sp)
+        removed = 0
+        comp = self.storage.compactor
+        if comp is not None:
+            # delete-versions-via-compaction (PR 16): table spans reclaim
+            # by folding into columnar segments — the newest visible value
+            # survives as a segment row instead of a row-major rewrite
+            removed += comp.gc_pass(self.storage, sp)
+        # sweep what the fold doesn't own: meta keys, tables the fold
+        # skipped (raced / ingest window open), stores with no compactor
+        removed += self.storage.mvcc.gc(sp)
         self.removed_total += removed
         return removed

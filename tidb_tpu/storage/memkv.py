@@ -119,6 +119,23 @@ class MemKV:
             else:
                 self._keys = list(heapq.merge(self._keys, fresh))
 
+    def count_range(self, start: bytes, end: bytes) -> int:
+        """Number of keys in [start, end) — two bisects, no snapshot.
+        The compactor's delta estimator: cheap enough to poll per table
+        per tick without touching values."""
+        with self.lock:
+            i = bisect.bisect_left(self._keys, start)
+            j = bisect.bisect_left(self._keys, end)
+            return j - i
+
+    def first_key_at_or_after(self, start: bytes) -> bytes | None:
+        """Smallest key >= start, or None. Lets a caller enumerate the
+        distinct table prefixes in a CF by leapfrogging (bisect per
+        prefix) instead of walking every version entry."""
+        with self.lock:
+            i = bisect.bisect_left(self._keys, start)
+            return self._keys[i] if i < len(self._keys) else None
+
     def delete_range(self, start: bytes, end: bytes) -> int:
         with self.lock:
             i = bisect.bisect_left(self._keys, start)

@@ -86,6 +86,33 @@ def _wk(key: bytes, ts: int) -> bytes:
     return b"w" + key + rev_ts(ts)
 
 
+class CompactionRaced(Exception):
+    """A write slipped under the fold timestamp between artifact build
+    and publish — the compactor aborts the round (nothing journaled,
+    nothing visible) and retries on a later tick."""
+
+
+def _retire_match(run, table_id: int, tprefix: bytes,
+                  kind: int, aux: int, cts: int) -> bool:
+    """Does `run` match a Z-record retire identity? Identities are stable
+    across alive-mask compaction (checkpoint snapshots rewrite runs with
+    dead rows squeezed out, so positions/first-keys may drift between the
+    live publish and a snapshot+tail replay — commit_ts, table ids and
+    key width do not)."""
+    from .segment import ColumnarRun, IntIndexRun, Run
+
+    if run.commit_ts != cts:
+        return False
+    if kind == 0:
+        return isinstance(run, ColumnarRun) and run.table_id == table_id
+    if kind == 1:
+        return isinstance(run, IntIndexRun) and run.table_id == table_id and run.index_id == aux
+    # byte run: width + table scope via the first key's prefix (runs
+    # never span tables — every producer builds them per table)
+    return (type(run) is Run and run.w == aux and run.n > 0
+            and run.key_at(0).startswith(tprefix))
+
+
 def _dk(key: bytes, ts: int) -> bytes:
     return b"d" + key + rev_ts(ts)
 
@@ -355,6 +382,33 @@ class MVCCStore:
         if self.runs:
             newest = max(newest, self._run_newest_commit(key))
         return newest
+
+    def high_water_ts(self) -> int:
+        """Largest timestamp embedded anywhere in the store's durable
+        state: commit timestamps in the write CF and segment runs, start
+        timestamps staged in the data CF, and the timestamps carried by
+        unresolved locks. Recovery and standby promotion seed the TSO
+        with this (TSO.advance_to) so a reborn store never allocates a
+        read or start timestamp at or below an already-durable commit."""
+        hw = 0
+        with self.kv.lock:
+            for cf in (b"d", b"w"):
+                for k, _ in self.kv.iter_from(cf):
+                    if not k.startswith(cf):
+                        break
+                    if len(k) >= 9:
+                        hw = max(hw, unrev_ts(k[-8:]))
+            for k, raw in self.kv.iter_from(b"l"):
+                if not k.startswith(b"l"):
+                    break
+                try:
+                    lock = Lock.decode(raw)
+                except (struct.error, IndexError):
+                    continue
+                hw = max(hw, lock.start_ts, lock.for_update_ts, lock.min_commit_ts)
+        for r in self.runs:
+            hw = max(hw, r.commit_ts)
+        return hw
 
     def acquire_pessimistic_lock(
         self, keys: list[bytes], primary: bytes, start_ts: int, for_update_ts: int, ttl_ms: int = 3000
@@ -632,4 +686,147 @@ class MVCCStore:
             for k in doomed_w + doomed_d:
                 self.kv.delete(k)
                 removed += 1
+        return removed
+
+    # --- delta-main compaction (PR 16, storage/compact.py) ----------------
+
+    def fold_plan(self, start: bytes, end: bytes, fold_ts: int):
+        """Deterministic fold decision for the mutable span [start, end)
+        at fold_ts — a pure function of (kv state, runs state, span,
+        fold_ts), so WAL replay of a Z record (which carries NO per-key
+        deletions) recomputes exactly what the live publish decided.
+        Caller must hold kv.lock. Returns (doom, kills, puts):
+
+          doom:  w/d-CF kv keys to delete (every version <= fold_ts of a
+                 key that has a visible version there, plus stray
+                 rollback/lock markers — mvcc.gc's rules, except the
+                 newest visible PUT moves into a segment instead of
+                 staying row-major)
+          kills: user keys whose entries in runs with commit_ts <
+                 fold_ts must die — REQUIRED for deletes: dropping a
+                 newest-visible DEL without killing the older run entry
+                 would resurrect the run's value (the crashpoint
+                 checker's "no resurrected GC'd versions" invariant)
+          puts:  (ukey, start_ts, commit_ts) of newest-visible PUTs to
+                 fold; their values live at _dk(ukey, start_ts), which
+                 is immutable once the w record exists
+        """
+        doom: list[bytes] = []
+        kills: list[bytes] = []
+        puts: list[tuple[bytes, int, int]] = []
+
+        def flush(ukey, entries):
+            newest = None
+            for _wk, ts, rec in entries:
+                if rec.op in (OP_PUT, OP_DEL):
+                    newest = (ts, rec)
+                    break
+            if newest is None:
+                # only rollback/lock markers at/below fold_ts: drop them,
+                # nothing folds and no run entry is disturbed
+                doom.extend(wk for wk, _ts, _r in entries)
+                return
+            for wk, _ts, rec in entries:
+                doom.append(wk)
+                if rec.op in (OP_PUT, OP_DEL):
+                    doom.append(_dk(ukey, rec.start_ts))
+            nts, nrec = newest
+            # a run entry NEWER than the newest mutable version (a bulk
+            # ingest published over txn-written rows) stays authoritative:
+            # the mutable tail is shadowed garbage, the run survives
+            run_ts = 0
+            for r in self.runs:
+                if nts < r.commit_ts <= fold_ts and r.find(ukey) >= 0:
+                    run_ts = max(run_ts, r.commit_ts)
+            if run_ts > nts:
+                return
+            kills.append(ukey)
+            if nrec.op == OP_PUT:
+                puts.append((ukey, nrec.start_ts, nts))
+
+        cur = None
+        entries: list = []
+        for k, v in self.kv.iter_from(b"w" + start):
+            if not k.startswith(b"w") or k[1:] >= end:
+                break
+            ukey, ts = k[1:-8], unrev_ts(k[-8:])
+            if ukey != cur:
+                if entries:
+                    flush(cur, entries)
+                cur, entries = ukey, []
+            if ts <= fold_ts:  # iteration order is newest-first per key
+                entries.append((k, ts, WriteRecord.decode(v)))
+        if entries:
+            flush(cur, entries)
+        return doom, kills, puts
+
+    def apply_compaction(self, table_id: int, fold_ts: int, spans, retire,
+                         new_runs, record=None, expect_plans=None) -> int:
+        """Fold-and-swap one table's delta (PR 16): delete every mutable
+        version <= fold_ts in `spans` (recomputed via fold_plan — see
+        there for why replay converges), kill run entries the fold
+        superseded, retire merged source runs, and publish `new_runs` —
+        all under ONE kv-lock hold and ONE journal record, the same
+        atomicity discipline as ingest_runs.
+
+        `record` is the pre-built Z payload on the live path (journal
+        FIRST, then mutate); replay and standby apply pass None — their
+        journals are detached or the frame was already appended upstream.
+        `expect_plans`, when given, must equal the recomputed plans or
+        CompactionRaced raises with nothing journaled — the live
+        publisher's witness that no write slipped under fold_ts between
+        artifact build and publish. Returns mutable versions removed."""
+        from ..codec import tablecodec
+
+        tprefix = tablecodec.table_prefix(table_id)
+        removed = 0
+        with self.kv.lock:
+            plans = [self.fold_plan(s, e, fold_ts) for s, e in spans]
+            if expect_plans is not None and plans != expect_plans:
+                raise CompactionRaced(
+                    f"table {table_id}: span state changed between fold "
+                    f"and publish (will retry)"
+                )
+            if record is not None:
+                j = getattr(self, "journal", None)
+                if j is not None:
+                    j.append(record)
+                    j.sync()  # compactions are their own durability point
+            kj = self.kv.journal
+            self.kv.journal = None  # the Z record IS these deletions
+            try:
+                for doom, kills, _puts in plans:
+                    for k in doom:
+                        self.kv.delete(k)
+                    removed += len(doom)
+                    # <= fold_ts: equal-ts runs share no keys with the new
+                    # fold run ONLY because this kill covers them (scans
+                    # never dedup equal-commit_ts runs); entries genuinely
+                    # newer than the folded version never reach `kills` —
+                    # fold_plan's run-wins guard keeps them
+                    for uk in kills:
+                        ke = uk + b"\x00"
+                        for r in self.runs:
+                            if r.commit_ts <= fold_ts:
+                                r.kill_range(uk, ke)
+            finally:
+                self.kv.journal = kj
+            if retire:
+                self.runs = [
+                    r for r in self.runs
+                    if not any(_retire_match(r, table_id, tprefix, *t)
+                               for t in retire)
+                ]
+            live = [r for r in new_runs if r.n]
+            self.runs.extend(live)
+            # scan recency is POSITION in this list (ascending commit_ts
+            # invariant); folded runs carry commit_ts = fold_ts, below
+            # any later ingest — the stable re-sort keeps position order
+            # equal to timestamp order
+            self.runs.sort(key=lambda r: r.commit_ts)
+            self.runs = [r for r in self.runs if r.alive is None or r.alive.any()]
+        hook = getattr(self, "split_hook", None)
+        if hook is not None:
+            for r in live:
+                hook(r)
         return removed

@@ -89,6 +89,11 @@ def frame_table_prefix(payload: bytes) -> bytes | None:
         # the first sub-record's prefix stands for the frame
         (slen,) = struct.unpack_from("<Q", payload, 5)
         return frame_table_prefix(payload[13 : 13 + slen])
+    if tag == b"Z" and len(payload) >= 17:
+        from ..codec import tablecodec
+
+        (table_id,) = struct.unpack_from("<q", payload, 1)
+        return tablecodec.record_prefix(table_id)[:9]
     return None
 
 
@@ -107,6 +112,10 @@ def frame_commit_ts(payload: bytes) -> int:
     if tag == b"I" and len(payload) >= 13:
         (slen,) = struct.unpack_from("<Q", payload, 5)
         return frame_commit_ts(payload[13 : 13 + slen])
+    if tag == b"Z" and len(payload) >= 17:
+        # a compaction frame's fold timestamp: every version it folds is
+        # at/below it, so the applied watermark never regresses
+        return struct.unpack_from("<Q", payload, 9)[0]
     if tag == b"P" and len(payload) >= 5:
         (klen,) = struct.unpack_from("<I", payload, 1)
         if len(payload) >= 5 + klen and klen >= 9:

@@ -29,6 +29,17 @@ class TSO:
         with self._lock:
             return self._last
 
+    def advance_to(self, ts: int) -> None:
+        """Never allocate at or below `ts` again. A real PD persists its
+        high water; this stand-in re-learns it at recovery/promotion from
+        the durable state instead. Without the seed, a store reopened in
+        the SAME millisecond as its predecessor's last commit hands out
+        read timestamps below that commit_ts — the freshest committed
+        write is invisible until the wall clock ticks over."""
+        with self._lock:
+            if ts > self._last:
+                self._last = ts
+
     @staticmethod
     def physical_ms(ts: int) -> int:
         return ts >> TSO.LOGICAL_BITS

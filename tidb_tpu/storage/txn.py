@@ -455,6 +455,7 @@ class Storage:
 
         self.detector = DeadlockDetector()
         self._gc_worker = None
+        self._compactor = None  # delta-main compactor (durable primaries only)
         # active-txn registry: GC clamps its safepoint to the oldest live
         # start_ts so long transactions keep their snapshot readable
         # (ref: store/gcworker/gc_worker.go:397 min-start-ts calculation)
@@ -1049,6 +1050,12 @@ class Storage:
             for rec in salvage:
                 self.wal.append(rec)
             self.wal.sync()
+        # 4) seed the TSO past every timestamp the recovered state holds.
+        # TSO physical time is wall-clock ms: reopened in the SAME
+        # millisecond the predecessor last committed in, a fresh oracle
+        # would hand out read timestamps BELOW that commit_ts and the
+        # newest committed writes vanish until the clock ticks over.
+        self.tso.advance_to(self.mvcc.high_water_ts())
 
     def wal_sync(self) -> None:
         """Commit durability point. Default: group commit — concurrent
@@ -1234,6 +1241,10 @@ class Storage:
             self.kv.journal = self.wal
             self.mvcc.journal = self.wal
             self.wal.sync()
+            # the shipped frames carry the OLD primary's timestamps — a
+            # promoted standby must never allocate below them (same seed
+            # discipline as recovery)
+            self.tso.advance_to(max(self.applied_ts, self.mvcc.high_water_ts()))
         log.warning(
             "standby PROMOTED to primary (data_dir=%s, applied_ts=%d, "
             "%d shipped frames applied)",
@@ -1363,6 +1374,22 @@ class Storage:
 
             self._gc_worker = GCWorker(self)
         return self._gc_worker
+
+    @property
+    def compactor(self):
+        """The delta-main compactor (storage/compact.py) — durable
+        primaries only. In-memory stores have no segments worth folding
+        into and a standby must never produce WAL records, so both read
+        None here (and gcworker.tick falls back to the per-key mvcc.gc
+        sweep). A promoted standby grows one on the next access."""
+        if self.wal is None or self.standby:
+            return None
+        if self._compactor is None:
+            from .compact import Compactor
+
+            self._compactor = Compactor(self)
+            self._compactor.start()
+        return self._compactor
 
     def _auto_split_run(self, run) -> None:
         """Split regions at every region_split_size-th key of a freshly

@@ -607,6 +607,37 @@ def rec_ingest(runs) -> bytes:
     return b"".join(parts)
 
 
+def rec_compact(table_id: int, fold_ts: int, spans, retire, runs) -> bytes:
+    """ONE logical delta-main compaction (PR 16): the new segments, the
+    mutable spans whose versions <= fold_ts they replace, and the retired
+    source runs of a merge — a single WAL frame, so recovery (and a
+    shipped standby) applies the whole fold-and-swap atomically or not at
+    all. The frame does NOT carry per-key deletions: the fold decision is
+    a pure function of (store state, span, fold_ts), recomputed at apply
+    time (MVCCStore.apply_compaction) — replay walks the same state the
+    live publish saw, so it reaches the same decision.
+
+    retire entries are (kind, aux, commit_ts) identity tuples:
+    kind 0 = ColumnarRun (aux unused), 1 = IntIndexRun (aux = index_id),
+    2 = byte Run (aux = key width; scoped to table_id's key prefix)."""
+    parts = [b"Z", struct.pack("<qQ", table_id, fold_ts),
+             struct.pack("<I", len(spans))]
+    for s, e in spans:
+        parts.append(struct.pack("<I", len(s)))
+        parts.append(s)
+        parts.append(struct.pack("<I", len(e)))
+        parts.append(e)
+    parts.append(struct.pack("<I", len(retire)))
+    for kind, aux, cts in retire:
+        parts.append(struct.pack("<BqQ", kind, aux, cts))
+    subs = [r.to_wal_record() for r in runs]
+    parts.append(struct.pack("<I", len(subs)))
+    for s in subs:
+        parts.append(struct.pack("<Q", len(s)))
+        parts.append(s)
+    return b"".join(parts)
+
+
 def _apply_crun(payload: bytes):
     """Parse a 'C' payload → ColumnarRun (validating every length)."""
     from .segment import ColSpec, ColumnarRun
@@ -719,6 +750,50 @@ def apply_record(payload: bytes, kv, mvcc) -> None:
             pos += slen
         _need(pos == len(payload), "I trailing bytes")
         mvcc.ingest_runs(runs)
+    elif tag == b"Z":
+        # ONE logical compaction: parse EVERYTHING first (spans, retire
+        # identities, every nested run — any malformed piece refuses the
+        # whole frame), then fold-and-swap as one atomic unit
+        _need(len(payload) >= 21, "Z header short")
+        table_id, fold_ts = struct.unpack_from("<qQ", payload, 1)
+        pos = 17
+        (nspans,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        spans = []
+        for _ in range(nspans):
+            _need(len(payload) >= pos + 4, "Z span header short")
+            (slen,) = struct.unpack_from("<I", payload, pos)
+            pos += 4
+            _need(len(payload) >= pos + slen + 4, "Z span start truncated")
+            s = payload[pos : pos + slen]
+            pos += slen
+            (elen,) = struct.unpack_from("<I", payload, pos)
+            pos += 4
+            _need(len(payload) >= pos + elen, "Z span end truncated")
+            spans.append((s, payload[pos : pos + elen]))
+            pos += elen
+        _need(len(payload) >= pos + 4, "Z retire header short")
+        (nret,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        _need(len(payload) >= pos + 17 * nret, "Z retire truncated")
+        retire = []
+        for _ in range(nret):
+            kind, aux, cts = struct.unpack_from("<BqQ", payload, pos)
+            pos += 17
+            retire.append((kind, aux, cts))
+        _need(len(payload) >= pos + 4, "Z runs header short")
+        (nruns,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        runs = []
+        for _ in range(nruns):
+            _need(len(payload) >= pos + 8, "Z sub-record header short")
+            (slen,) = struct.unpack_from("<Q", payload, pos)
+            pos += 8
+            _need(len(payload) >= pos + slen, "Z sub-record truncated")
+            runs.append(_parse_run_record(payload[pos : pos + slen]))
+            pos += slen
+        _need(pos == len(payload), "Z trailing bytes")
+        mvcc.apply_compaction(table_id, fold_ts, spans, retire, runs)
     else:
         raise ValueError(f"unknown WAL record tag {tag!r}")
 

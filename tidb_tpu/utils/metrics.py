@@ -523,3 +523,25 @@ INGEST_BYTES = REGISTRY.counter(
     "tidb_ingest_bytes_total",
     "bulk-ingest bytes by pipeline stage (parse | encode | wal | publish)",
 )
+# delta-main compaction (PR 16): the background worker that folds txn
+# writes + MVCC versions at/below the gc safepoint into columnar
+# segments (storage/compact.py). rounds count every attempt by outcome:
+# fold (delta folded into fresh runs), merge (run count bounded by a
+# leveled merge), raced (a commit slipped under the fold ts — retried),
+# deferred (foreground statements queued at the admission scheduler),
+# paused (OOM degrade active). rows/versions/bytes count fold output.
+COMPACT_ROUNDS = REGISTRY.counter(
+    "tidb_compact_rounds_total",
+    "compaction attempts by outcome (fold | merge | raced | deferred | paused)",
+)
+COMPACT_ROWS = REGISTRY.counter(
+    "tidb_compact_rows_total", "live rows folded into columnar segments"
+)
+COMPACT_VERSIONS = REGISTRY.counter(
+    "tidb_compact_versions_total",
+    "mutable MVCC version entries reclaimed by compaction folds",
+)
+COMPACT_BYTES = REGISTRY.counter(
+    "tidb_compact_bytes_total",
+    "bytes of compaction WAL records (Z frames) published",
+)

@@ -237,6 +237,7 @@ def _targets():
     from tidb_tpu.sched import resource_group as _rg
     from tidb_tpu.sched import runaway as _runaway
     from tidb_tpu.sched import scheduler as _sched
+    from tidb_tpu.storage import compact as _compact
     from tidb_tpu.storage import detector as _detector
     from tidb_tpu.storage import memkv as _memkv
     from tidb_tpu.storage import regions as _regions
@@ -283,6 +284,8 @@ def _targets():
         (_ship.WalShipper, "_cond", "wal.ship", True),
         (_txn.Storage, "_standby_lock", "standby", False),
         (_txn.Storage, "_failover_lock", "storage.failover", False),
+        # PR 16: delta-main compactor stats lock (leaf-like, rank 29)
+        (_compact.Compactor, "_lock", "compact.worker", False),
         (_regions.RegionMap, "_lock", "regions", False),
         (_tso.TSO, "_lock", "tso", False),
         (_detector.DeadlockDetector, "_lock", "detector", False),

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Delta-main compaction bench (PR 16) → BENCH_compact_pr16.json.
+
+The acceptance story: a table built through ordinary SQL INSERTs lives
+row-major in the mutable delta, and every analytic scan pays the
+per-row decode. After the compactor folds it into columnar segments,
+cold scans must serve within COLD_GATE_X of the SAME data loaded
+through the bulk-ingest path (whose runs are columnar from birth).
+
+Harness (tools/paired_bench.py — modes interleave per rep so machine
+drift cancels in the paired ratio):
+
+  A  durable store, rows INSERTed in 2000-row statements, then folded
+     to quiescence by the compactor (fold + merge)
+  B  durable store, same rows published by models/tpch.bulk_load
+
+Each rep invalidates the decoded-tile cache first: the gate is about
+the RESIDENT LAYOUT, not about hitting a warm tile twice. Bit-identity
+is asserted three ways: Q1 on store A before vs after the fold (a fold
+must never change answers), and A vs B after it.
+
+    python tools/bench_compact.py                   # 120k rows, 5 reps
+    python tools/bench_compact.py --rows 500000 --reps 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.paired_bench import paired_medians  # noqa: E402
+
+OUT_NAME = "BENCH_compact_pr16.json"
+COLD_GATE_X = 1.5
+INSERT_BATCH = 2000
+
+
+def _date_str(packed: int) -> str:
+    d = packed // (24 * 60 * 60 * 1_000_000)
+    day = d % 32
+    month = (d // 32) % 13
+    year = d // (32 * 13)
+    return f"{year:04d}-{month:02d}-{day:02d}"
+
+
+def _insert_built_session(rows: int, data_dir: str):
+    """Store A: lineitem through the front door — batched INSERT
+    statements on a durable store, row-major delta all the way."""
+    from tidb_tpu.models import tpch
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+
+    s = Session(Storage(data_dir=data_dir))
+    s.execute(tpch.LINEITEM_DDL)
+    cols = tpch.gen_lineitem(rows)
+    names = list(cols)
+    for lo in range(0, rows, INSERT_BATCH):
+        hi = min(lo + INSERT_BATCH, rows)
+        vals = []
+        for i in range(lo, hi):
+            r = {n: cols[n][i] for n in names}
+            vals.append(
+                "({},{},{},{},{:.2f},{:.2f},{:.2f},{:.2f},'{}','{}','{}','{}','{}')".format(
+                    r["l_orderkey"], r["l_partkey"], r["l_suppkey"],
+                    r["l_linenumber"], r["l_quantity"] / 100,
+                    r["l_extendedprice"] / 100, r["l_discount"] / 100,
+                    r["l_tax"] / 100, r["l_returnflag"], r["l_linestatus"],
+                    _date_str(int(r["l_shipdate"])),
+                    _date_str(int(r["l_commitdate"])),
+                    _date_str(int(r["l_receiptdate"])),
+                )
+            )
+        s.execute(f"INSERT INTO lineitem VALUES {', '.join(vals)}")
+    return s
+
+
+def _bulk_built_session(rows: int, data_dir: str):
+    """Store B: the same columns through the bulk-ingest path."""
+    from tidb_tpu.models import tpch
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+
+    s = Session(Storage(data_dir=data_dir))
+    s.execute(tpch.LINEITEM_DDL)
+    tpch.bulk_load(s, "lineitem", tpch.gen_lineitem(rows))
+    return s
+
+
+def _settle(s) -> dict:
+    """Fold the whole mutable delta into segments and bound the run
+    count — the state a long-running store converges to."""
+    store = s.store
+    info = s.infoschema().table(s.current_db, "lineitem")
+    comp = store.compactor
+    folded = comp.compact_table(store, info.id, store.tso.next())
+    merged = comp.maybe_merge(store, info.id)
+    return {
+        "rows_folded": folded["rows"] if folded else 0,
+        "versions_reclaimed": folded["removed"] if folded else 0,
+        "runs_retired_by_merge": merged,
+        "runs_now": len(store.mvcc.runs),
+    }
+
+
+def _cold_q1(s, tid: int) -> float:
+    from tidb_tpu.models import tpch
+
+    # cold: re-decode from the store's resident layout — drop decoded
+    # tiles AND the per-task result cache (both would otherwise answer
+    # the repeated identical Q1 without touching storage)
+    s.cop.tiles.invalidate_table(tid)
+    with s.cop.results._lock:
+        s.cop.results._od.clear()
+    t0 = time.perf_counter()
+    s.must_query(tpch.Q1)
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=120_000)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from tidb_tpu.models import tpch
+
+    work = tempfile.mkdtemp(prefix="bench-compact-")
+    try:
+        t0 = time.perf_counter()
+        sa = _insert_built_session(args.rows, os.path.join(work, "a"))
+        build_insert_s = time.perf_counter() - t0
+        sb = _bulk_built_session(args.rows, os.path.join(work, "b"))
+        tid_a = sa.infoschema().table(sa.current_db, "lineitem").id
+        tid_b = sb.infoschema().table(sb.current_db, "lineitem").id
+
+        # pre-fold witnesses: the row-major cold-scan cost, and Q1's answer
+        pre_q1 = sa.must_query(tpch.Q1)
+        pre_cold = [_cold_q1(sa, tid_a) for _ in range(3)]
+        pre_cold_s = sorted(pre_cold)[1]
+
+        settle = _settle(sa)
+        identical_pre_post = sa.must_query(tpch.Q1) == pre_q1
+        identical_a_b = sa.must_query(tpch.Q1) == sb.must_query(tpch.Q1)
+
+        res = paired_medians(
+            lambda: _cold_q1(sa, tid_a),
+            lambda: _cold_q1(sb, tid_b),
+            args.reps,
+            warmup=1 if args.reps > 1 else 0,
+        )
+        folded_s, bulk_s = res["p50_a_s"], res["p50_b_s"]
+        ratio = res["paired_ratio_p50"]
+        out = {
+            "bench": "compact_pr16",
+            "note": (
+                "cold Q1 (tiles invalidated per rep) on an INSERT-built "
+                "store after compaction folds it columnar, vs the same "
+                "data bulk-loaded; gate: paired ratio <= "
+                f"{COLD_GATE_X}x"
+            ),
+            "rows": args.rows,
+            "insert_build_s": round(build_insert_s, 3),
+            "precompact_cold_q1_s": round(pre_cold_s, 4),
+            "folded_cold_q1_p50_s": round(folded_s, 4),
+            "bulk_cold_q1_p50_s": round(bulk_s, 4),
+            "paired_ratio_p50": round(ratio, 3),
+            "precompact_vs_folded_x": round(pre_cold_s / folded_s, 2) if folded_s else 0.0,
+            "settle": settle,
+            "bit_identical": {
+                "q1_pre_vs_post_fold": identical_pre_post,
+                "q1_folded_vs_bulk": identical_a_b,
+            },
+            "gate_x": COLD_GATE_X,
+            "samples": res["samples"],
+        }
+        out["pass"] = (
+            ratio <= COLD_GATE_X and identical_pre_post and identical_a_b
+        )
+        sa.store.wal.close()
+        sb.store.wal.close()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    print(json.dumps(out, indent=2))
+    with open(os.path.join(ROOT, OUT_NAME), "w", encoding="utf8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    if not out["pass"]:
+        print("FAIL: compact bench gate (see JSON above)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

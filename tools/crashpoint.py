@@ -44,6 +44,13 @@ child; the parent asserts exit code 137, proving the site actually fired):
                                       WAL ingest record NOT yet written:
                                       the ingest must recover fully
                                       absent; acked ingests fully visible
+    compact/after-artifact-before-publish
+                                      delta-main fold segments built, the
+                                      ONE compaction record NOT yet
+                                      written: the span must recover
+                                      bit-identical pre-fold — no lost
+                                      latest values, no resurrected
+                                      deletes, no half-retired runs
 
 Usage:
     python tools/crashpoint.py --matrix [--seed S]       # each named site once
@@ -94,6 +101,12 @@ CRASHPOINTS = {
     # and every ACKED ingest fully visible (record AND index planes:
     # one WAL ingest record covers both, all-visible-or-absent)
     "ingest/after-artifact-before-publish": 5,
+    # PR 16: die with a delta-main compaction's folded segments built but
+    # its ONE WAL record (Z frame) not yet journaled — recovery must read
+    # the compacted span bit-identical to the pre-fold state: every acked
+    # row present with its latest value, no deleted row resurrected, no
+    # GC'd version visible
+    "compact/after-artifact-before-publish": 3,
 }
 
 ING_GROUP_ROWS = 5  # rows per bulk-ingest group (the ingest atomicity unit)
@@ -108,6 +121,7 @@ ROTATE_EIO_NTH = 25
 
 TXN_GROUP_ROWS = 3  # rows per explicit txn (the atomicity unit)
 IDX_ROWS = 400  # t_idx population (reorg batch 32 → ~13 backfill batches)
+CMP_GROUP = 10  # ids per compaction-workload round (one insert batch)
 
 
 # ===================================================================== child
@@ -144,6 +158,9 @@ def _child_main(args) -> None:
     # bulk-ingest target (PR 15): secondary index so every ingest
     # publishes record AND index planes under its one WAL record
     boot.execute("CREATE TABLE t_ing (id INT PRIMARY KEY, g INT, total INT, KEY kg (g))")
+    # delta-main compaction target (PR 16): secondary index so every fold
+    # rebuilds record AND index planes under its one WAL record
+    boot.execute("CREATE TABLE t_cmp (id INT PRIMARY KEY, v INT, KEY kv (v))")
     for lo in range(0, IDX_ROWS, 100):
         vals = ", ".join(f"({i}, {i % 97})" for i in range(lo, min(lo + 100, IDX_ROWS)))
         boot.execute(f"INSERT INTO t_idx VALUES {vals}")
@@ -267,9 +284,42 @@ def _child_main(args) -> None:
                 g += 1  # never reuse ids of a maybe-published group
                 time.sleep(0.02)
 
+    def compact_loop() -> None:
+        """Delta-main compaction rounds (PR 16): commit a deterministic
+        batch of inserts/updates/deletes, ack, then FORCE a fold of
+        every version at/below a fresh timestamp. The fold publishes
+        under ONE WAL record (Z frame) — a crash anywhere inside it
+        (the compact/after-artifact-before-publish site, or a random
+        SIGKILL mid-apply) must leave the span reading bit-identical:
+        acked rows present with their latest values, deleted rows never
+        resurrected."""
+        s = Session(store)
+        info = s.infoschema().table(s.current_db, "t_cmp")
+        comp = store.compactor
+        k = 0
+        while time.time() < stop:
+            try:
+                base = k * CMP_GROUP
+                vals = ", ".join(
+                    f"({i}, {i * 3})" for i in range(base, base + CMP_GROUP)
+                )
+                s.execute(f"INSERT INTO t_cmp VALUES {vals}")
+                s.execute(f"UPDATE t_cmp SET v = v + 1000 WHERE id = {base + 3}")
+                s.execute(f"DELETE FROM t_cmp WHERE id = {base + 7}")
+                say(f"ACK cmp {k}")
+                k += 1
+                if comp is not None:
+                    comp.compact_table(store, info.id, store.tso.next())
+                time.sleep(0.01)
+            except TiDBError as e:
+                say(f"ERR cmp {type(e).__name__}")
+                k += 1  # never reuse ids of a maybe-half-committed round
+                time.sleep(0.02)
+
     threads = [
         threading.Thread(target=f, daemon=True, name=f.__name__)
-        for f in (dml_loop, txn_loop, ddl_loop, ckpt_loop, ingest_loop)
+        for f in (dml_loop, txn_loop, ddl_loop, ckpt_loop, ingest_loop,
+                  compact_loop)
     ]
     for t in threads:
         t.start()
@@ -287,7 +337,8 @@ class Violation(Exception):
 
 
 def _collect_acks(lines: list[str]) -> dict:
-    acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0, "ing": set()}
+    acks = {"dml": set(), "txn": set(), "ddl": [], "ckpt": 0, "ing": set(),
+            "cmp": set()}
     for ln in lines:
         parts = ln.split()
         if not parts or parts[0] != "ACK":
@@ -302,6 +353,8 @@ def _collect_acks(lines: list[str]) -> dict:
             acks["ckpt"] += 1
         elif parts[1] == "ing":
             acks["ing"].add(int(parts[2]))
+        elif parts[1] == "cmp":
+            acks["cmp"].add(int(parts[2]))
     return acks
 
 
@@ -396,6 +449,54 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
                 f"({cnt} vs {ING_GROUP_ROWS}) — the ingest record tore"
             )
 
+    # --- delta-main compaction (PR 16): the compacted span must read
+    # bit-identical to what the acked workload built, regardless of how
+    # many folds published, half-built, or died mid-apply. Strict per
+    # acked round: every surviving id carries its LATEST value (the
+    # update wins), the deleted id is ABSENT (a fold that replayed its
+    # segments without its kills would resurrect it), and no extra ids
+    # exist in the round's range.
+    cmp_missing = False
+    cmp_rows: dict[int, int] = {}
+    try:
+        cmp_rows = {int(r[0]): int(r[1]) for r in s.must_query("SELECT id, v FROM t_cmp")}
+    except UnknownTable:
+        if acks.get("cmp"):
+            raise Violation("acked compaction rounds exist but t_cmp is missing after recovery")
+        cmp_missing = True
+    except TiDBError as e:
+        raise Violation(f"post-restart t_cmp read failed: {e}") from e
+    for k in sorted(acks.get("cmp", ())):
+        base = k * CMP_GROUP
+        for i in range(base, base + CMP_GROUP):
+            if i == base + 7:
+                if i in cmp_rows:
+                    raise Violation(
+                        f"compaction round {k}: deleted row {i} RESURRECTED "
+                        f"after recovery (a fold replayed without its kills)"
+                    )
+                continue
+            want = i * 3 + (1000 if i == base + 3 else 0)
+            if cmp_rows.get(i) != want:
+                raise Violation(
+                    f"compaction round {k}: row {i} reads "
+                    f"{cmp_rows.get(i)!r}, want {want} — the compacted span "
+                    f"is not bit-identical to the acked pre-fold state"
+                )
+    max_acked_cmp = max(acks.get("cmp", ()), default=-1)
+    for i, v in sorted(cmp_rows.items()):
+        k = i // CMP_GROUP
+        if k <= max_acked_cmp:
+            continue  # covered strictly above
+        # unacked tail round: each row must still be one of the two
+        # states its own statements could have committed — anything else
+        # is a torn fold
+        if v not in (i * 3, i * 3 + 1000) or (v == i * 3 + 1000 and i % CMP_GROUP != 3):
+            raise Violation(
+                f"compaction tail round {k}: row {i}={v} matches no "
+                f"committed statement state"
+            )
+
     # --- DDL: drain the interrupted job queue; the reorg must resume from
     # its durable checkpoint to public (or roll back cleanly) — then the
     # row↔index consistency check must pass for whatever ended up public
@@ -409,6 +510,9 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
         s.execute("ADMIN CHECK TABLE t_txn")
         if not ing_missing:
             s.execute("ADMIN CHECK TABLE t_ing")
+        if not cmp_missing:
+            # row↔index consistency across fold/merge-rebuilt planes
+            s.execute("ADMIN CHECK TABLE t_cmp")
     except TiDBError as e:
         raise Violation(f"ADMIN CHECK failed after recovery: {e}") from e
 
@@ -417,6 +521,20 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
     # happens only after wal_sync, so a crash can lose sink lines — never
     # invent them). The durable sink rotates by size: read every segment.
     from tidb_tpu.cdc import FileSink
+
+    # fold-aware witness (PR 16): a delta-main compaction legally
+    # DESTROYS mutable versions at/below its fold_ts, re-homing the
+    # survivors into runs stamped with the fold_ts — so an event's exact
+    # commit_ts may no longer exist. A run covering the key's table span
+    # at commit_ts >= the event's proves the event's version was durable
+    # (folds only ever subsume versions at/below their own ts, which the
+    # WAL ordered after the event's commit record).
+    span_hi: dict[bytes, int] = {}
+    with store.mvcc.kv.lock:
+        for run in store.mvcc.runs:
+            if run.n:
+                p = run.key_at(0)[:9]
+                span_hi[p] = max(span_hi.get(p, 0), run.commit_ts)
 
     for seg in FileSink.segments(cdc_path):
         with open(seg) as f:
@@ -437,10 +555,13 @@ def _verify(data_dir: str, cdc_path: str, acks: dict) -> dict:
                 cts = int(ev["commit_ts"])
                 versions = {c for _s, c, _l in store.mvcc_versions(key)}
                 if cts not in versions:
-                    raise Violation(
-                        f"CDC sink ahead of durable state: event commit_ts={cts} "
-                        f"for key={ev['key'][:24]}… has no durable MVCC version"
-                    )
+                    hi = max(versions, default=0)
+                    if max(hi, span_hi.get(key[:9], 0)) < cts:
+                        raise Violation(
+                            f"CDC sink ahead of durable state: event commit_ts={cts} "
+                            f"for key={ev['key'][:24]}… has no durable MVCC version "
+                            f"and no covering fold"
+                        )
 
     # --- the recovered store must still be writable (no sticky degrade)
     t = store.begin()
@@ -698,7 +819,8 @@ def run_round(
         shutil.rmtree(workdir, ignore_errors=True)
     detail = (
         f"acks: dml={len(acks['dml'])} txn={len(acks['txn'])} "
-        f"ddl={len(acks['ddl'])} ckpt={acks['ckpt']} ing={len(acks['ing'])}"
+        f"ddl={len(acks['ddl'])} ckpt={acks['ckpt']} ing={len(acks['ing'])} "
+        f"cmp={len(acks['cmp'])}"
         + (" [standby promoted+verified]" if standby_dir else "")
         + (" [spare snapshot verified]" if spare_dir else "")
     )
