@@ -203,6 +203,53 @@ class TestStandbyCheckerNegative:
             cp._verify_standby(sd, primary, acks, semi_sync=False)
 
 
+class TestQuorumCheckerNegative:
+    """The quorum verifier must fail the exact shape a broken QUORUM
+    commit would produce — an ack sent while only a minority of the
+    fleet had the commit durable."""
+
+    def _mk_store(self, path, rows):
+        from tidb_tpu.session import Session
+        from tidb_tpu.storage.txn import Storage
+
+        s = Session(Storage(data_dir=str(path)))
+        s.execute("CREATE TABLE t_dml (id INT PRIMARY KEY, v INT)")
+        s.execute("CREATE TABLE t_txn (id INT PRIMARY KEY, g INT, total INT)")
+        if rows:
+            s.execute("INSERT INTO t_dml VALUES " +
+                      ", ".join(f"({i}, {i * 3})" for i in rows))
+        s.store.wal.close()
+
+    def test_minority_acked_commit_is_caught(self, tmp_path):
+        """Row 1 was ACKED under QUORUM (need=2 of 3) but is durable on
+        only ONE standby: after any majority of the fleet is lost, the
+        acked commit would be gone — the checker must flag it."""
+        for d, rows in (("s1", (0, 1)), ("s2", (0,)), ("s3", (0,))):
+            self._mk_store(tmp_path / d, rows)
+        primary = {"dml": {0: 0, 1: 3}, "txn_groups": {}, "ing_groups": {}}
+        acks = {"dml": {0, 1}, "txn": set(), "ddl": [], "ckpt": 0}
+        dirs = [str(tmp_path / d) for d in ("s1", "s2", "s3")]
+        with pytest.raises(cp.Violation, match="minority durability"):
+            cp._verify_quorum(dirs, primary, acks, need=2)
+        # ...and row 0 (durable everywhere) alone is green
+        for d in ("s1", "s2", "s3"):
+            self._mk_store(tmp_path / ("ok-" + d), (0,))
+        cp._verify_quorum(
+            [str(tmp_path / ("ok-" + d)) for d in ("s1", "s2", "s3")],
+            {"dml": {0: 0}, "txn_groups": {}, "ing_groups": {}},
+            {"dml": {0}, "txn": set(), "ddl": [], "ckpt": 0}, need=2)
+
+    def test_quorum_standby_ahead_is_caught(self, tmp_path):
+        """A fleet member holding a row the primary's durable state
+        lacks is AHEAD — same ship discipline as the single standby."""
+        self._mk_store(tmp_path / "s1", (0, 99))
+        with pytest.raises(cp.Violation, match="AHEAD of primary durable state"):
+            cp._verify_quorum(
+                [str(tmp_path / "s1")],
+                {"dml": {0: 0}, "txn_groups": {}, "ing_groups": {}},
+                {"dml": {0}, "txn": set(), "ddl": [], "ckpt": 0}, need=1)
+
+
 class TestRealProcessCrash:
     def test_named_crashpoint_round(self):
         """One full spawn→crash→verify cycle in tier-1: the commit-gap
@@ -227,6 +274,14 @@ class TestRealProcessCrash:
             if not ok:
                 failures.append(f"round {i} (seed {seed + i}): {detail}")
         assert not failures, "\n".join(failures)
+
+    @pytest.mark.slow
+    def test_rejoin_soak_30_rounds(self):
+        """ADMIN REJOIN soak (PR 17): two dirs trade the primary role
+        30 times (fence → promote → rejoin-as-standby), with semi-sync
+        acked inserts every round; no acked row may ever be lost."""
+        ok, detail = cp.run_rejoin_soak(30, seed=20260806)
+        assert ok, detail
 
     @pytest.mark.slow
     def test_failover_soak_30_rounds(self):

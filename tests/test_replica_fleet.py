@@ -1,0 +1,383 @@
+"""Replica fleet (PR 17, storage/ship.py): one tap fans WAL frames out
+to N standbys, majority-quorum commit acks (typed 8150 when the quorum
+is unreachable), lag-bounded follower reads with the staleness-bounds
+battery (AS OF never ahead, never missing an acked commit within the
+bound, over-lagged replicas skipped, replica killed mid-read), bounded
+frame groups, socket reconnect-with-resync, and ADMIN REJOIN healing a
+fenced old primary back into the fleet."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import (
+    CommitIndeterminateError,
+    StandbyReadOnly,
+    TiDBError,
+)
+from tidb_tpu.session import Session
+from tidb_tpu.storage.ship import ReplicaSet, StandbyServer, WalShipper
+from tidb_tpu.storage.txn import Storage
+from tidb_tpu.storage.wal import GroupAssembler, rec_put
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+def _mk_primary(tmp_path, name="primary"):
+    store = Storage(data_dir=str(tmp_path / name))
+    s = Session(store)
+    s.execute("SET tidb_enable_auto_analyze = OFF")
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    return store, s
+
+
+def _mk_fleet(tmp_path, n=2, auto_promote=False):
+    store, s = _mk_primary(tmp_path)
+    ship = ReplicaSet(store, auto_promote=auto_promote)
+    standbys = []
+    for i in range(n):
+        d = str(tmp_path / f"standby{i}")
+        ship.bootstrap(d)
+        sb = Storage(data_dir=d, standby=True)
+        ship.attach(sb)
+        standbys.append(sb)
+    return store, s, ship, standbys
+
+
+def _ids(sess):
+    return [int(r[0]) for r in sess.must_query("SELECT id FROM t ORDER BY id")]
+
+
+def _dt(ts: float) -> str:
+    """Wall-clock → 'YYYY-MM-DD hh:mm:ss.uuuuuu' (the AS OF literal)."""
+    lt = time.localtime(ts)
+    return time.strftime("%Y-%m-%d %H:%M:%S", lt) + ".%06d" % int((ts % 1) * 1e6)
+
+
+class TestFanOut:
+    def test_one_tap_feeds_every_standby(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=3)
+        s.execute("INSERT INTO t VALUES (1, 3), (2, 6)")
+        assert ship.wait_caught_up(10)
+        for sb in standbys:
+            assert _ids(Session(sb)) == [1, 2]
+        states = ship.link_states()
+        assert len(states) == 3
+        ship.stop()
+
+    def test_dead_standby_never_blocks_the_others(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        s.execute("SET GLOBAL tidb_wal_semi_sync = 'ON'")
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        assert ship.wait_caught_up(10)
+        ship._break_link(ship._links[0], RuntimeError("standby killed"))
+        # ON needs ONE ack: the surviving link must provide it — the
+        # dead link neither blocks the commit nor the catch-up
+        s.execute("INSERT INTO t VALUES (2, 6)")
+        assert ship.wait_caught_up(10)
+        assert _ids(Session(standbys[1])) == [1, 2]
+        assert _ids(Session(standbys[0])) == [1]
+        ship.stop()
+
+
+class TestQuorum:
+    def test_quorum_acks_on_majority_of_three(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=3)
+        s.execute("SET GLOBAL tidb_wal_semi_sync = 'QUORUM'")
+        before = M.REPLICA_QUORUM.value(outcome="acked")
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        assert M.REPLICA_QUORUM.value(outcome="acked") > before
+        assert ship.wait_caught_up(10)
+        for sb in standbys:
+            assert _ids(Session(sb)) == [1]
+        ship.stop()
+
+    def test_quorum_survives_a_minority_loss(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=3)
+        s.execute("SET GLOBAL tidb_wal_semi_sync = 'QUORUM'")
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        ship._break_link(ship._links[2], RuntimeError("standby killed"))
+        # 2 of 3 live: the majority still forms, commits keep acking
+        s.execute("INSERT INTO t VALUES (2, 6)")
+        assert ship.wait_caught_up(10)
+        assert _ids(Session(standbys[0])) == [1, 2]
+        ship.stop()
+
+    def test_quorum_unreachable_raises_typed_8150(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=3)
+        s.execute("SET GLOBAL tidb_wal_semi_sync = 'QUORUM'")
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        assert ship.wait_caught_up(10)
+        ship._break_link(ship._links[1], RuntimeError("standby killed"))
+        ship._break_link(ship._links[2], RuntimeError("standby killed"))
+        before = M.REPLICA_QUORUM.value(outcome="unreachable")
+        with pytest.raises(CommitIndeterminateError) as ei:
+            s.execute("INSERT INTO t VALUES (2, 6)")
+        assert ei.value.code == 8150
+        assert M.REPLICA_QUORUM.value(outcome="unreachable") > before
+        # the commit is indeterminate, not lost: it applied locally
+        assert _ids(s) == [1, 2]
+        ship.stop()
+
+
+class TestFrameGroups:
+    def test_assembler_joins_chunks_and_passes_singles(self, tmp_path):
+        asm = GroupAssembler()
+        whole = rec_put(b"dkey", b"value")
+        assert asm.feed(whole) == [whole]
+        assert asm.feed(b"G") == []
+        assert asm.open
+        assert asm.feed(b"g" + whole[:5]) == []
+        assert asm.feed(b"g" + whole[5:]) == []
+        assert asm.feed(b"F") == [whole]
+        assert not asm.open
+
+    def test_assembler_rejects_malformed_sequences(self, tmp_path):
+        with pytest.raises(ValueError):
+            GroupAssembler().feed(b"g" + b"chunk outside a group")
+        asm = GroupAssembler()
+        asm.feed(b"G")
+        with pytest.raises(ValueError):
+            asm.feed(rec_put(b"dk", b"v"))  # non-chunk inside an open group
+
+    def test_torn_trailing_group_truncated_on_recovery(self, tmp_path):
+        store, s = _mk_primary(tmp_path, name="data")
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        # an unterminated group at the tail (the writer died mid-stream):
+        # recovery must cut the WHOLE group at its begin frame — the
+        # chunk bytes are never parsed, so even garbage is safe
+        store.wal.append(b"G")
+        store.wal.append(b"g" + b"\x00torn-ingest-chunk")
+        store.wal.sync()
+        before = M.WAL_RECOVERY_DROPPED.value(kind="torn-group")
+        store.wal.close()
+        re = Session(Storage(data_dir=str(tmp_path / "data")))
+        assert _ids(re) == [1]
+        assert M.WAL_RECOVERY_DROPPED.value(kind="torn-group") > before
+
+    def test_shipped_group_applies_as_one_logical_record(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=1)
+        payload = rec_put(b"dzz-fleet-group-key", b"fleet-value")
+        n = store.wal.append_group([payload[:4], payload[4:]])
+        assert n == len(payload)
+        store.wal.sync()
+        deadline = time.time() + 10
+        while (standbys[0].kv.get(b"dzz-fleet-group-key") is None
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert standbys[0].kv.get(b"dzz-fleet-group-key") == b"fleet-value"
+        ship.stop()
+
+
+class TestStalenessBounds:
+    """The battery: a follower-served read must be bit-identical to the
+    primary's snapshot at the same ts — never a commit above it, never
+    missing an acked commit at or below it."""
+
+    def test_as_of_never_ahead_never_missing(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        cuts = []
+        for i in range(6):
+            s.execute(f"INSERT INTO t VALUES ({i}, {i * 3})")
+            time.sleep(0.005)  # TSO physical is wall-ms: separate the cut
+            cuts.append(_dt(time.time()))
+            time.sleep(0.005)
+        assert ship.wait_caught_up(10)
+        served = M.REPLICA_READS.value(outcome="follower")
+        for rep in range(2):  # second pass re-reads through warm caches
+            for i, cut in enumerate(cuts):
+                ids = [int(r[0]) for r in s.must_query(
+                    f"SELECT id FROM t AS OF TIMESTAMP '{cut}' ORDER BY id")]
+                assert ids == list(range(i + 1)), (rep, i, cut, ids)
+        # the battery must actually exercise followers, not fall back
+        assert M.REPLICA_READS.value(outcome="follower") > served
+        ship.stop()
+
+    def test_as_of_beyond_watermark_falls_back_to_primary(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        assert ship.wait_caught_up(10)
+        # a cut the replicas' applied watermark has NOT reached: routing
+        # them could miss acked commits <= t, so the primary serves
+        cut = _dt(time.time() + 0.05)
+        time.sleep(0.06)
+        before = M.REPLICA_READS.value(outcome="fallback_stale")
+        ids = [int(r[0]) for r in s.must_query(
+            f"SELECT id FROM t AS OF TIMESTAMP '{cut}' ORDER BY id")]
+        assert ids == [1]
+        assert M.REPLICA_READS.value(outcome="fallback_stale") > before
+        ship.stop()
+
+    def test_over_lagged_replica_skipped(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        s.execute("INSERT INTO t VALUES (1, 3)")
+        assert ship.wait_caught_up(10)
+        s.execute("SET tidb_replica_read = 'follower'")
+        s.execute("SET tidb_replica_read_max_lag_ms = 50")
+        time.sleep(0.2)  # idle: applied-ts lag grows past the bound
+        stale = M.REPLICA_READS.value(outcome="fallback_stale")
+        assert _ids(s) == [1]  # primary fallback, results exact
+        assert M.REPLICA_READS.value(outcome="fallback_stale") > stale
+        s.execute("SET tidb_replica_read_max_lag_ms = 600000")
+        served = M.REPLICA_READS.value(outcome="follower")
+        assert _ids(s) == [1]
+        assert M.REPLICA_READS.value(outcome="follower") > served
+        ship.stop()
+
+    def test_kill_replica_chaos_mid_read(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        s.execute("SET GLOBAL tidb_wal_semi_sync = 'ON'")
+        errors: list = []
+        stop = threading.Event()
+        reads = [0, 0]  # before / after the kill
+        killed = threading.Event()
+
+        def reader():
+            rs = Session(store)
+            rs.execute("SET tidb_replica_read = 'follower'")
+            while not stop.is_set():
+                try:
+                    rows = rs.must_query("SELECT id, v FROM t ORDER BY id")
+                    got = [(int(a), int(b)) for a, b in rows]
+                    # frames apply in commit order, so any snapshot —
+                    # follower or primary — is a prefix of the inserts
+                    assert got == [(i, i * 3) for i in range(len(got))], got
+                    reads[1 if killed.is_set() else 0] += 1
+                except Exception as e:  # noqa: BLE001 — collected for the main thread
+                    errors.append(e)
+                    return
+
+        th = threading.Thread(target=reader)
+        th.start()
+        try:
+            for i in range(40):
+                s.execute(f"INSERT INTO t VALUES ({i}, {i * 3})")
+                if i == 20:
+                    ship._break_link(ship._links[0], RuntimeError("replica killed"))
+                    killed.set()
+        finally:
+            stop.set()
+            th.join(10)
+        assert not errors, errors
+        assert reads[0] > 0 and reads[1] > 0, reads
+        assert ship.wait_caught_up(10)
+        assert _ids(Session(standbys[1])) == list(range(40))
+        ship.stop()
+
+
+class TestSocketResync:
+    def test_reconnect_resyncs_after_connection_drop(self, tmp_path):
+        store, s = _mk_primary(tmp_path)
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        srv = StandbyServer(standby)
+        ship.attach_socket("127.0.0.1", srv.port)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        before = (M.SHIP_RECONNECTS.value(reason="peer_closed")
+                  + M.SHIP_RECONNECTS.value(reason="io_error"))
+        # yank the live connection out from under the sender: the next
+        # batch fails, the link reconnects and resyncs from the
+        # standby's acked count instead of breaking
+        ship._links[0].sender.sock.close()
+        s.execute("INSERT INTO t VALUES (2, 20)")
+        assert ship.wait_caught_up(10)
+        deadline = time.time() + 10
+        while standby.applied_ts == 0 or len(_ids(Session(standby))) < 2:
+            assert time.time() < deadline, "standby never converged after resync"
+            time.sleep(0.02)
+        assert _ids(Session(standby)) == [1, 2]
+        assert (M.SHIP_RECONNECTS.value(reason="peer_closed")
+                + M.SHIP_RECONNECTS.value(reason="io_error")) > before
+        assert ship._links[0].error is None
+        ship.stop()
+        srv.close()
+
+    def test_reconnect_budget_exhausts_then_the_link_breaks(self, tmp_path):
+        store, s = _mk_primary(tmp_path)
+        ship = WalShipper(store)
+        ship.bootstrap(str(tmp_path / "standby"))
+        standby = Storage(data_dir=str(tmp_path / "standby"), standby=True)
+        srv = StandbyServer(standby)
+        ship.attach_socket("127.0.0.1", srv.port)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        link = ship._links[0]
+        srv.close()  # nothing to reconnect TO: the budget must bound it
+        link.sender.sock.close()
+        s.execute("INSERT INTO t VALUES (2, 20)")
+        deadline = time.time() + 15
+        while link.error is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert link.error is not None, "link must break once retries exhaust"
+        ship.stop()
+
+
+class TestRejoin:
+    def test_admin_rejoin_heals_the_fleet_via_sql(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=1)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        new_primary = standbys[0]
+        new_primary.promote()
+        # fence the old primary (the failover contract: a degraded
+        # primary must stop acking writes before a standby is promoted)
+        with store._failover_lock:
+            store._io_degraded = True
+            store._failover_disabled = True
+        before = M.REPLICA_REJOINS.value(outcome="ok")
+        Session(store).execute("ADMIN REJOIN")
+        assert M.REPLICA_REJOINS.value(outcome="ok") > before
+        assert store.standby
+        # the healed fleet ships new-primary commits to the rebuilt dir
+        ns = Session(new_primary)
+        ns.execute("INSERT INTO t VALUES (2, 20)")
+        nsh = new_primary._shipper
+        assert nsh is not None and nsh.wait_caught_up(10)
+        assert _ids(Session(store)) == [1, 2]
+        with pytest.raises(StandbyReadOnly):
+            Session(store).execute("INSERT INTO t VALUES (3, 30)")
+        nsh.stop()
+
+    def test_admin_rejoin_rejected_on_a_healthy_primary(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=1)
+        standbys[0].promote()
+        with pytest.raises(TiDBError, match="healthy primary"):
+            s.execute("ADMIN REJOIN")
+        ship.stop()
+
+
+class TestRouterSQL:
+    def test_follower_read_serves_and_leader_pins(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        s.execute("SET tidb_replica_read = 'follower'")
+        served = M.REPLICA_READS.value(outcome="follower")
+        assert _ids(s) == [1]
+        assert M.REPLICA_READS.value(outcome="follower") > served
+        s.execute("SET tidb_replica_read = 'leader'")
+        served = M.REPLICA_READS.value(outcome="follower")
+        assert _ids(s) == [1]
+        assert M.REPLICA_READS.value(outcome="follower") == served
+        ship.stop()
+
+    def test_in_txn_reads_pin_to_the_primary(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        s.execute("INSERT INTO t VALUES (1, 10)")
+        assert ship.wait_caught_up(10)
+        s.execute("SET tidb_replica_read = 'follower'")
+        s.execute("BEGIN")
+        served = M.REPLICA_READS.value(outcome="follower")
+        assert _ids(s) == [1]
+        assert M.REPLICA_READS.value(outcome="follower") == served
+        s.execute("COMMIT")
+        ship.stop()

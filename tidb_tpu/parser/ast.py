@@ -127,6 +127,7 @@ class TableName:
     name: str
     alias: str | None = None
     index_hints: list = field(default_factory=list)
+    as_of: Any = None  # AS OF TIMESTAMP expr (ref: stale read)
 
 
 @dataclass
@@ -195,6 +196,7 @@ class Select:
     into_outfile: str | None = None  # SELECT ... INTO OUTFILE
     outfile_fsep: str = "\t"
     outfile_lsep: str = "\n"
+    as_of: Any = None  # AS OF TIMESTAMP expr (stale read), hoisted from FROM
 
 
 @dataclass

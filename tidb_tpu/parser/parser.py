@@ -54,6 +54,21 @@ RESERVED_STOP = {
 }
 
 
+def _walk_tables(node):
+    """Yield every TableName under a FROM tree (Join/list), not descending
+    into derived-table subqueries — those carry their own AS OF."""
+    if node is None:
+        return
+    if isinstance(node, ast.TableName):
+        yield node
+    elif isinstance(node, ast.Join):
+        yield from _walk_tables(node.left)
+        yield from _walk_tables(node.right)
+    elif isinstance(node, list):
+        for n in node:
+            yield from _walk_tables(n)
+
+
 def parse(sql: str) -> list:
     """Parse a semicolon-separated script into a list of statements."""
     p = Parser(tokenize(sql), sql)
@@ -298,6 +313,11 @@ class Parser:
                 break
         if self.try_kw("FROM"):
             sel.from_ = self.table_refs()
+            # hoist `AS OF TIMESTAMP` to the statement: the read-ts is a
+            # per-statement property (one snapshot), not per-table here
+            for t in _walk_tables(sel.from_):
+                if getattr(t, "as_of", None) is not None:
+                    sel.as_of = t.as_of
         if self.try_kw("WHERE"):
             sel.where = self.expr()
         if self.try_kw("GROUP"):
@@ -455,12 +475,21 @@ class Parser:
         name = self.ident()
         if self.try_op("."):
             db, name = name, self.ident()
+        as_of = None
+        # `t AS OF TIMESTAMP expr` must be checked before the `AS alias`
+        # branch — a bare try_kw("AS") would eat the AS and read OF as the
+        # alias (ref: planner stale-read, executor/stale_txn_test.go)
+        if self.at_kw("AS") and self.peek().kind == "ident" and self.peek().upper == "OF":
+            self.next()  # AS
+            self.next()  # OF
+            self.expect_kw("TIMESTAMP")
+            as_of = self.expr()
         alias = None
         if self.try_kw("AS"):
             alias = self.ident()
         elif self.tok.kind in ("ident", "qident") and self.tok.upper not in RESERVED_STOP:
             alias = self.ident()
-        return ast.TableName(db, name, alias)
+        return ast.TableName(db, name, alias, as_of=as_of)
 
     def name_list(self) -> list:
         names = [self.ident()]
@@ -1873,6 +1902,10 @@ class Parser:
         if self.try_kw("PROMOTE"):
             # ADMIN PROMOTE: flip a warm standby read-write (PR 14)
             return ast.AdminStmt("promote")
+        if self.try_kw("REJOIN"):
+            # ADMIN REJOIN: rebuild a fenced old primary as a standby of the
+            # promoted new primary (PR 17)
+            return ast.AdminStmt("rejoin")
         self.fail("unsupported ADMIN")
 
     def kill_stmt(self):

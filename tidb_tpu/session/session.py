@@ -117,6 +117,10 @@ class Session:
         # sequence batch cache + LASTVAL memory (ref: meta/autoid
         # SequenceAllocator; entries [cur, end, inc, store generation])
         self._seq_cache: dict = {}
+        # follower reads (PR 17): per-replica CopClient cache keyed by
+        # id(replica store) — each replica carries its own tile/result
+        # caches, exactly like the primary's shared client
+        self._replica_cops: dict = {}
         self._seq_last: dict = {}
         # session-local temporary tables: (db, name) → TableInfo
         self._temp_tables: dict = {}
@@ -340,6 +344,34 @@ class Session:
             ms = int(time.mktime((y, mo, d, h, mi, s, 0, 0, -1)) * 1000 + us // 1000)
             return ms << 18
         return self.store.tso.next()
+
+    def _as_of_read_ts(self, node) -> int:
+        """`AS OF TIMESTAMP expr` → read-ts (ref: planner staleread
+        CalculateAsOfTsExpr): the column-free expr evaluates to a datetime
+        (literal string or NOW() arithmetic); its wall time becomes the
+        TSO physical component, same mapping as tidb_snapshot."""
+        from ..mysqltypes.coretime import parse_datetime, unpack_time
+        from ..mysqltypes.datum import K_TIME
+
+        d = self._eval_const_expr(node).value
+        if d.kind == K_TIME:
+            packed = d.val
+        else:
+            packed = parse_datetime(str(d.val)) if d.val is not None else None
+        if packed is None:
+            raise TiDBError(f"invalid AS OF TIMESTAMP value {d.val!r}")
+        y, mo, day, h, mi, s, us = unpack_time(packed)
+        ms = int(time.mktime((y, mo, day, h, mi, s, 0, 0, -1)) * 1000 + us // 1000)
+        return ms << 18
+
+    def _replica_cop(self, store):
+        """CopClient for a read replica, cached for the session (tile and
+        result caches stay warm across statements)."""
+        c = self._replica_cops.get(id(store))
+        if c is None or c.storage is not store:
+            c = CopClient(store)
+            self._replica_cops[id(store)] = c
+        return c
 
     # ---------------------------------------------------------------- execute
 
@@ -1087,6 +1119,11 @@ class Session:
                 # no longer) a standby
                 self.store.promote()
                 return ResultSet([], None)
+            if stmt.kind == "rejoin":
+                # rebuild this fenced old primary as a standby of the
+                # promoted new primary (PR 17); rejected while healthy
+                self.store.rejoin()
+                return ResultSet([], None)
         if isinstance(stmt, ast.CreateBinding):
             return self._run_create_binding(stmt)
         if isinstance(stmt, ast.DropBinding):
@@ -1790,32 +1827,69 @@ class Session:
                         self._stmt_vars[k] = sv.normalize(v)
                     except ValueError as e:
                         self.warnings.append(str(e))
-        ctx = ExecContext(
-            self.cop,
-            self.read_ts(),
-            engine=engine,
-            vars=exec_vars,
-            txn=self.txn,
-        )
-        tl = getattr(self.store, "_table_locks", None)
-        if (tl is not None and tl._locks) or getattr(self, "_locked_ids", None):
-            self._check_plan_locks(plan)
-        sel_limit = int(self.vars.get("sql_select_limit", 2**64 - 1) or 2**64 - 1)
-        if top_level and sel_limit < 2**64 - 1 and getattr(stmt, "limit", None) is None:
-            # plant a real Limit node so execution stops early instead of
-            # materializing the full result and slicing (ref: planbuilder
-            # sql_select_limit handling)
-            from ..planner.plans import Limit as _LimitPlan
+        # --- stale reads + follower routing (PR 17) ------------------------
+        # AS OF TIMESTAMP pins the statement's read-ts; `tidb_replica_read`
+        # lets top-level autocommit reads run against an attached in-process
+        # replica whose applied watermark is close enough (AS OF: watermark
+        # must have REACHED the requested ts; plain follower read: lag
+        # within tidb_replica_read_max_lag_ms, served at the watermark).
+        # Fallback is always the primary — routing never changes results
+        # beyond the documented staleness bound.
+        as_of = getattr(stmt, "as_of", None)
+        read_ts = None
+        if as_of is not None:
+            if self.txn is not None:
+                raise TiDBError("as of timestamp can't be set in transaction")
+            read_ts = self._as_of_read_ts(as_of)
+        cop = self.cop
+        route_store = None
+        router = None
+        if top_level and self.txn is None and not self.store.standby:
+            sh = getattr(self.store, "_shipper", None)
+            rr = str(exec_vars.get("tidb_replica_read", "leader")).lower()
+            if sh is not None and (
+                as_of is not None or rr in ("follower", "leader-and-follower")
+            ):
+                max_lag = int(exec_vars.get("tidb_replica_read_max_lag_ms", 5000) or 0)
+                router = sh.router
+                route_store = router.route(as_of_ts=read_ts, max_lag_ms=max_lag)
+                if route_store is not None:
+                    cop = self._replica_cop(route_store)
+                    if read_ts is None:
+                        # bounded-staleness read at the replica's applied
+                        # watermark: everything the replica has is visible,
+                        # nothing torn (frames apply in commit order)
+                        read_ts = route_store.applied_ts
+        try:
+            ctx = ExecContext(
+                cop,
+                self.read_ts() if read_ts is None else read_ts,
+                engine=engine,
+                vars=exec_vars,
+                txn=self.txn,
+            )
+            tl = getattr(self.store, "_table_locks", None)
+            if (tl is not None and tl._locks) or getattr(self, "_locked_ids", None):
+                self._check_plan_locks(plan)
+            sel_limit = int(self.vars.get("sql_select_limit", 2**64 - 1) or 2**64 - 1)
+            if top_level and sel_limit < 2**64 - 1 and getattr(stmt, "limit", None) is None:
+                # plant a real Limit node so execution stops early instead of
+                # materializing the full result and slicing (ref: planbuilder
+                # sql_select_limit handling)
+                from ..planner.plans import Limit as _LimitPlan
 
-            plan = _LimitPlan(plan, sel_limit)
-        ex = build_executor(plan, ctx)
-        if getattr(self, "_trace_collect", False):
-            # TRACE hook: instrument THIS (fully gated) execution rather
-            # than re-running the select outside the normal path
-            from ..executor.runtime_stats import attach_runtime_stats
+                plan = _LimitPlan(plan, sel_limit)
+            ex = build_executor(plan, ctx)
+            if getattr(self, "_trace_collect", False):
+                # TRACE hook: instrument THIS (fully gated) execution rather
+                # than re-running the select outside the normal path
+                from ..executor.runtime_stats import attach_runtime_stats
 
-            self._trace_result = (ex, attach_runtime_stats(ex))
-        chunk = drain(ex)
+                self._trace_result = (ex, attach_runtime_stats(ex))
+            chunk = drain(ex)
+        finally:
+            if route_store is not None:
+                router.release(route_store)
         names = [c.name for c in plan.out_cols]
         rs = ResultSet(names, chunk)
         outfile = getattr(stmt, "into_outfile", None)

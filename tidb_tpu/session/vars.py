@@ -180,10 +180,31 @@ _sv("tidb_wal_group_commit", "ON", scope="global", kind="bool", consumed=True)
 # mean durable-on-STANDBY — after local group-commit durability the
 # committer waits for the shipper's standby-fsync confirmation (released
 # by KILL/deadline through the shared interrupt gate; the commit is then
-# indeterminate, never falsely acked). OFF (default) ships async —
+# indeterminate, never falsely acked). QUORUM (PR 17) upgrades the ack
+# to majority-of-N: the commit waits until the MEDIAN per-replica
+# durable horizon covers it — ceil(N/2) of the N attached links — and
+# raises the typed indeterminate shape (8150) when too many links are
+# broken for the quorum to ever form. OFF (default) ships async —
 # measured cost: nothing (the wait is never entered). GLOBAL-only like
 # tidb_wal_group_commit: the durability protocol is store-wide.
-_sv("tidb_wal_semi_sync", "OFF", scope="global", kind="bool", consumed=True)
+_sv("tidb_wal_semi_sync", "OFF", scope="global", kind="enum",
+    enum=("OFF", "ON", "QUORUM"), consumed=True)
+# follower-read routing (PR 17; ref: client-go replica-read modes):
+# "leader" (default) pins every statement to the primary; "follower" and
+# "leader-and-follower" let top-level read-only statements route to an
+# in-process replica whose applied-ts lag is within
+# tidb_replica_read_max_lag_ms (choose-and-bump placement re-weighted by
+# lag; automatic fallback to the primary when every replica is too
+# stale). AS OF TIMESTAMP reads route to a replica only once its applied
+# watermark REACHED the requested ts — the snapshot is then exactly the
+# primary's.
+_sv("tidb_replica_read", "leader", kind="enum",
+    enum=("leader", "follower", "leader-and-follower"), consumed=True)
+# bounded staleness for follower reads: a replica lagging more than this
+# many wall-clock ms (primary now vs replica applied-ts physical time)
+# is skipped
+_sv("tidb_replica_read_max_lag_ms", "5000", kind="int", lo=0, hi=3600000,
+    consumed=True)
 # comma-separated spare WAL directories: on a WAL IO failure the store
 # checkpoints onto the first healthy spare (fresh log, writes resume,
 # zero acks lost) instead of degrading read-only forever; failed media
@@ -411,7 +432,7 @@ for _name, _d, _k in (
     ("tidb_partition_prune_mode", "static", "str"),
     ("tidb_pprof_sql_cpu", "0", "int"),
     ("tidb_record_plan_in_slow_log", "ON", "bool"),
-    ("tidb_replica_read", "leader", "str"),
+    # tidb_replica_read lives in the consumed block above (PR 17)
     ("tidb_restricted_read_only", "OFF", "bool"),
     ("tidb_shard_allocate_step", str(2**63 - 1), "int"),
     ("tidb_slow_log_masking", "OFF", "bool"),

@@ -306,9 +306,20 @@ class Compactor:
         # published — recovery must see the compaction as absent (and the
         # pre-fold row-major state still fully intact)
         _fp("compact/after-artifact-before-publish")
-        from .wal import rec_compact
+        from .wal import iter_compact_chunks
 
-        record = rec_compact(tid, sp, [(start, end)], [], new_runs)
+        # the Z record streams to the journal as one frame group — never
+        # materialized whole; the counting wrapper keeps the byte metric
+        # without a second pass (zero when the publish raced: the
+        # generator is only consumed after the race checks pass)
+        jbytes = 0
+
+        def record_chunks():
+            nonlocal jbytes
+            for c in iter_compact_chunks(tid, sp, [(start, end)], [], new_runs):
+                jbytes += len(c)
+                yield c
+
         # a txn that began at/below the fold ts while we built artifacts
         # could read the span mid-snapshot — abort the round like any
         # other race (the plan compare below only witnesses WRITES)
@@ -320,7 +331,7 @@ class Compactor:
             try:
                 removed = mvcc.apply_compaction(
                     tid, sp, [(start, end)], [], new_runs,
-                    record=record, expect_plans=[plan])
+                    record_chunks=record_chunks(), expect_plans=[plan])
             except CompactionRaced:
                 M.COMPACT_ROUNDS.inc(outcome="raced")
                 return None
@@ -328,7 +339,7 @@ class Compactor:
         M.COMPACT_ROUNDS.inc(outcome="fold")
         M.COMPACT_ROWS.inc(len(puts))
         M.COMPACT_VERSIONS.inc(removed)
-        M.COMPACT_BYTES.inc(len(record))
+        M.COMPACT_BYTES.inc(jbytes)
         self._bump(tid, rows_folded=len(puts), versions_reclaimed=removed,
                    folds=1)
         trace.finish()
@@ -495,20 +506,28 @@ class Compactor:
             if merged is None:
                 break
             _fp("compact/after-artifact-before-publish")
-            from .wal import rec_compact
+            from .wal import iter_compact_chunks
 
-            record = rec_compact(tid, merged.commit_ts, [], retire, [merged])
+            jbytes = 0
+
+            def record_chunks(merged=merged, retire=retire):
+                nonlocal jbytes
+                for c in iter_compact_chunks(
+                        tid, merged.commit_ts, [], retire, [merged]):
+                    jbytes += len(c)
+                    yield c
+
             try:
                 mvcc.apply_compaction(
                     tid, merged.commit_ts, [], retire, [merged],
-                    record=record, expect_plans=None)
+                    record_chunks=record_chunks(), expect_plans=None)
             except CompactionRaced:  # pragma: no cover - no spans, no race
                 break
             publish_barrier(store, tid)
             n_retired = sum(len(rs) for _cts, rs in take)
             retired_total += n_retired
             M.COMPACT_ROUNDS.inc(outcome="merge")
-            M.COMPACT_BYTES.inc(len(record))
+            M.COMPACT_BYTES.inc(jbytes)
             self._bump(tid, merges=1)
         return retired_total
 

@@ -581,9 +581,14 @@ class MVCCStore:
                 precondition()
             j = getattr(self, "journal", None)
             if j is not None:
-                from .wal import rec_ingest
+                from .wal import iter_ingest_chunks
 
-                j.append(rec_ingest(runs))
+                # streamed as ONE frame group: the logical record is
+                # never materialized whole, so a 16M-row ingest journals
+                # at per-run memory instead of holding its entire WAL
+                # image resident (recovery re-joins the group and
+                # replays it as atomically as the single-frame form)
+                j.append_group(iter_ingest_chunks(runs))
                 j.sync()  # bulk ingests are their own durability point
             self.runs.extend(runs)
         hook = getattr(self, "split_hook", None)
@@ -761,7 +766,8 @@ class MVCCStore:
         return doom, kills, puts
 
     def apply_compaction(self, table_id: int, fold_ts: int, spans, retire,
-                         new_runs, record=None, expect_plans=None) -> int:
+                         new_runs, record=None, expect_plans=None,
+                         record_chunks=None) -> int:
         """Fold-and-swap one table's delta (PR 16): delete every mutable
         version <= fold_ts in `spans` (recomputed via fold_plan — see
         there for why replay converges), kill run entries the fold
@@ -770,8 +776,11 @@ class MVCCStore:
         atomicity discipline as ingest_runs.
 
         `record` is the pre-built Z payload on the live path (journal
-        FIRST, then mutate); replay and standby apply pass None — their
-        journals are detached or the frame was already appended upstream.
+        FIRST, then mutate); `record_chunks` is its streamed form — an
+        iterable of chunks journaled as ONE frame group, so the Z image
+        is never materialized whole (satellite of PR 17). Replay and
+        standby apply pass neither — their journals are detached or the
+        frame was already appended upstream.
         `expect_plans`, when given, must equal the recomputed plans or
         CompactionRaced raises with nothing journaled — the live
         publisher's witness that no write slipped under fold_ts between
@@ -787,10 +796,13 @@ class MVCCStore:
                     f"table {table_id}: span state changed between fold "
                     f"and publish (will retry)"
                 )
-            if record is not None:
+            if record is not None or record_chunks is not None:
                 j = getattr(self, "journal", None)
                 if j is not None:
-                    j.append(record)
+                    if record_chunks is not None:
+                        j.append_group(record_chunks)
+                    else:
+                        j.append(record)
                     j.sync()  # compactions are their own durability point
             kj = self.kv.journal
             self.kv.journal = None  # the Z record IS these deletions

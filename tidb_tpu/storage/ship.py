@@ -1,36 +1,67 @@
-"""Warm-standby WAL shipping (PR 14) — the log IS the database, so
-durability-by-replication is just streaming it (ref: "Near Data
-Processing in Taurus Database", arXiv:2506.20010 — Log Stores replicate
-the log, Page Stores replay it; MySQL semi-sync replication is the
-commit-protocol analog).
+"""Replica-fleet WAL shipping (PR 14 single standby → PR 17 fan-out) —
+the log IS the database, so durability-by-replication is just streaming
+it (ref: "Near Data Processing in Taurus Database", arXiv:2506.20010 —
+Log Stores replicate the log, Page Stores replay it; MySQL semi-sync
+replication is the commit-protocol analog).
 
-`WalShipper` taps the primary's `Wal` (every accepted append enqueues;
-see Wal.tap) and streams frames to a standby data dir — but ONLY frames
-the primary has fsynced (`Wal.durable_seq`): the standby must never be
-ahead of the primary's durable state, or a primary crash+recovery would
-leave the standby holding history the primary lost. The standby journals
-each shipped frame into its OWN wal (fresh CRC chain — a reopened
-standby replay-verifies the shipped bytes for free), fsyncs once per
-batch, applies, and advances `tidb_standby_applied_ts`.
+`ReplicaSet` taps the primary's `Wal` ONCE (every accepted append
+enqueues; see Wal.tap) and fans the stream out to N standbys over
+per-link threads — a dead or slow standby never blocks the others. Only
+frames the primary has fsynced (`Wal.durable_seq`) ever ship: a standby
+must never be ahead of the primary's durable state, or a primary
+crash+recovery would leave it holding history the primary lost. Each
+standby journals shipped frames into its OWN wal (fresh CRC chain — a
+reopened standby replay-verifies the shipped bytes for free), fsyncs
+once per batch, applies, and advances its applied watermark.
 
-Transports: in-process (`attach` — the crashpoint harness's shape: one
-process, two data dirs, SIGKILL kills both, the standby DIR survives)
-and a socket (`StandbyServer` / `attach_socket`) whose wire format
-reuses the WAL frame shape (u32 len, u32 crc32, payload) with a sync
-marker per batch and a cumulative u64 ack back.
+Frame accounting: every tapped frame gets a global ship sequence
+(`gseq`). A standby's bootstrap snapshot is cut under the primary's kv
+lock, so the cut gseq cleanly partitions history: frames at/below the
+cut are IN the snapshot, frames above it ship. A link's durable horizon
+is then `base_gseq + frames-acked-by-the-standby` — counting, not
+content inspection, which also gives socket reconnect an exact resync
+point. The shared queue prunes at the minimum horizon over live links
+(plus not-yet-attached bootstrap cuts), so one slow replica bounds
+memory, not correctness.
 
-Semi-sync (`tidb_wal_semi_sync=ON`): Storage.wal_sync calls
-`wait_durable` after local durability — the ack then additionally means
-durable-on-standby. The wait polls the shared interrupt gate (KILL /
-max_execution_time release it; the commit is then indeterminate, never
-falsely acked), and a stopped/broken shipper raises the typed
-indeterminate shape instead of blocking forever.
+Transports: in-process (`attach`) and socket (`StandbyServer` /
+`attach_socket`) whose wire format reuses the WAL frame shape (u32 len,
+u32 crc32, payload) with a sync marker per batch and a cumulative
+(count, applied_ts) ack back. The socket link survives transient damage:
+on a dropped connection (including a standby-side CRC refusal of a
+wire-corrupted frame) it reconnects with bounded backoff, re-handshakes
+(`HELLO` → standby instance token + acked count), verifies it is talking
+to the SAME standby instance, and resyncs from the last acked frame —
+counted in `tidb_ship_reconnects_total{reason}`. A changed token means
+the far side restarted (its count restarts too): the link breaks
+permanently, re-bootstrap required.
+
+Semi-sync (`tidb_wal_semi_sync`): Storage.wal_sync calls `wait_durable`
+after local durability. `ON` keeps the PR 14 contract — the ack means
+durable on AT LEAST ONE standby. `QUORUM` waits until the MEDIAN
+per-standby durable horizon covers the commit, i.e. a majority
+ceil(N/2) of the N registered links acked it. Both waits poll the
+shared interrupt gate (KILL / max_execution_time release them; the
+commit is then indeterminate, never falsely acked), and an unreachable
+quorum (too many broken links) raises the typed indeterminate shape
+(8150) instead of blocking forever.
 
 Failover coupling: when the primary degrades and cannot rotate onto a
-spare (storage/txn.py online WAL failover), a shipper constructed with
-`auto_promote=True` drains the remaining DURABLE frames and promotes the
-standby; the degraded primary is then permanently fenced
-(`_failover_disabled`) so a later media heal cannot create split brain.
+spare (storage/txn.py online WAL failover), a ReplicaSet constructed
+with `auto_promote=True` drains the remaining DURABLE frames and
+promotes the in-process standby with the HIGHEST durable horizon (the
+N>1 tie-break: it loses the least acked history); the degraded primary
+is then permanently fenced (`_failover_disabled`) so a later media heal
+cannot create split brain. `rejoin()` heals the fleet afterwards: it
+rebuilds the fenced old primary as a standby of the new one — its
+divergent unacked tail is discarded wholesale (old logs unlinked) under
+a fresh snapshot cut from the new primary, then shipping resumes.
+
+`ReplicaRouter` is the read side: lag-bounded follower reads pick among
+in-process replicas by the PR 6 placement shape (atomic choose-and-bump
+under one lock, mirroring TPUEngine.place) re-weighted by applied-ts
+lag instead of lane occupancy, falling back to the primary when every
+replica is too stale.
 """
 
 from __future__ import annotations
@@ -57,6 +88,8 @@ def frame_table_prefix(payload: bytes) -> bytes | None:
     if not payload:
         return None
     tag = payload[:1]
+    if tag in (b"G", b"g", b"F"):
+        return None  # group framing: prefixes come from the joined record
     if tag in (b"P", b"D") and len(payload) >= 5:
         (klen,) = struct.unpack_from("<I", payload, 1)
         key = payload[5 : 5 + klen]
@@ -100,11 +133,14 @@ def frame_table_prefix(payload: bytes) -> bytes | None:
 def frame_commit_ts(payload: bytes) -> int:
     """Best-effort commit_ts carried by one WAL record: R (ingest run)
     records name it outright; P records landing in the write CF encode
-    it in the key suffix. Everything else (locks, defaults, deletes)
-    reports 0 — the applied watermark only ever advances on commits."""
+    it in the key suffix. Everything else (locks, defaults, deletes,
+    group-framing chunks) reports 0 — the applied watermark only ever
+    advances on commits."""
     if not payload:
         return 0
     tag = payload[:1]
+    if tag in (b"G", b"g", b"F"):
+        return 0
     if tag == b"R" and len(payload) >= 21:
         return struct.unpack_from("<IQQ", payload, 1)[2]
     if tag in (b"C", b"N") and len(payload) >= 17:
@@ -127,51 +163,89 @@ def frame_commit_ts(payload: bytes) -> int:
     return 0
 
 
-class WalShipper:
-    """Primary-side half of warm-standby replication: observes appends
-    via the Wal tap, ships durable frames in order, releases semi-sync
-    waiters once the standby confirms its fsync."""
+class _Link:
+    """One primary→standby replication link: transport + horizons.
+    All mutable fields are guarded by the owning ReplicaSet's `_cond`
+    except the transport objects themselves (only the link's own ship
+    thread touches those)."""
+
+    __slots__ = (
+        "name", "standby", "sender", "base_gseq", "sent_gseq",
+        "durable_gseq", "applied_ts", "error", "thread", "reconnects",
+    )
+
+    def __init__(self, name: str, base_gseq: int, standby=None, sender=None):
+        self.name = name
+        self.standby = standby  # in-process standby Storage (or None)
+        self.sender = sender  # _SocketSender (or None)
+        self.base_gseq = base_gseq  # gseq of the bootstrap snapshot cut
+        self.sent_gseq = base_gseq  # highest gseq handed to the transport
+        self.durable_gseq = base_gseq  # base + frames acked durable far-side
+        self.applied_ts = 0
+        self.error: Exception | None = None
+        self.thread: threading.Thread | None = None
+        self.reconnects = 0  # consecutive failures (resets on a good ack)
+
+
+class ReplicaSet:
+    """Primary-side half of fleet replication: observes appends via the
+    Wal tap, fans durable frames out to every attached standby in order,
+    and releases semi-sync/quorum waiters as per-link durable horizons
+    advance."""
 
     POLL_S = 0.05  # cond-wait slice (interrupt-gate cadence, like sync_group)
     DRAIN_DEADLINE_S = 5.0  # auto-promote: max wait for durable frames to drain
+    RECONNECT_MAX = 5  # consecutive socket failures before the link breaks
+    RECONNECT_BACKOFF_S = 0.05  # doubles per consecutive failure, capped
 
     def __init__(self, store, auto_promote: bool = False):
         self.store = store
         self.auto_promote = auto_promote
         self._cond = threading.Condition()
-        # FIFO of (wal, local_seq, payload, global_seq, enqueue_wall):
-        # append order IS ship order; a frame ships only once `local_seq
-        # <= wal.durable_seq()`, and FIFO means an undurable frame holds
-        # later ones back (order on the standby mirrors the primary log)
+        # FIFO of (wal, local_seq, payload, gseq, enqueue_wall): append
+        # order IS ship order; a frame ships only once `local_seq <=
+        # wal.durable_seq()`, and FIFO means an undurable frame holds
+        # later ones back (order on every standby mirrors the primary log)
         self._queue: deque = deque()
-        self._enq_seq = 0
-        self._shipped_seq = 0  # highest global seq durable on the standby
-        self._receiver = None  # callable(list[payload]) — transport seam
-        self._standby = None  # in-process standby Storage (auto-promote target)
+        self._enq_seq = 0  # gseq of the newest tapped frame
+        self._pruned_gseq = 0  # highest gseq dropped (durable fleet-wide)
+        self._links: list[_Link] = []
+        # bootstrap cuts not yet consumed by an attach: abspath(dir) →
+        # cut gseq, plus FIFO order for transports that can't name a dir
+        self._cuts: dict[str, int] = {}
+        self._pending_cuts: list[str] = []
         self._stopped = False
         self._broken: Exception | None = None
-        self._thread: threading.Thread | None = None
+        self._promoted = None  # the standby promote picked (rejoin target)
+        self.router = ReplicaRouter(self)
 
     # ------------------------------------------------------- primary wiring
 
     def bootstrap(self, standby_dir: str) -> None:
         """Seed a standby data dir with a consistent snapshot of the
         primary (subscribe-after-checkpoint: the standby boots from
-        snapshot + shipped log tail) and install the tap AT THE SAME
+        snapshot + shipped log tail) and record the ship cut AT THE SAME
         BARRIER — under the primary's kv lock no mutation is mid-flight,
-        so every frame after the cut ships and nothing before it does."""
+        so every frame after the cut ships to this standby and nothing
+        before it does. Call once per standby dir; the first call also
+        installs the tap."""
         store = self.store
         if store.wal is None:
             raise TiDBError("WAL shipping requires a durable primary (data_dir)")
         from . import wal as w
 
         os.makedirs(standby_dir, exist_ok=True)
+        key = os.path.abspath(standby_dir)
         with store.kv.lock:
             # the standby starts its own epoch numbering at 0
             payload = store._snapshot_payload_locked(0)
             w.snap_write(os.path.join(standby_dir, "snapshot.bin"), payload)
             w.fsync_dir(standby_dir)
             self.install(store.wal)
+            with self._cond:
+                self._cuts[key] = self._enq_seq
+                if key not in self._pending_cuts:
+                    self._pending_cuts.append(key)
         store._shipper = self
 
     def install(self, wal) -> None:
@@ -191,55 +265,110 @@ class WalShipper:
 
     def _on_durable(self, wal, covered: int) -> None:
         # called when the primary's fsync high-water advances: wake the
-        # ship thread (frames just became shippable)
+        # link threads (frames just became shippable)
         with self._cond:
             self._cond.notify_all()
+
+    def _take_cut(self, standby_dir: str | None) -> tuple[str, int]:
+        """Consume a bootstrap cut for a new link: by dir when known,
+        else the oldest unconsumed bootstrap (FIFO pairs bootstrap →
+        attach for transports that can't name the far dir)."""
+        with self._cond:
+            if standby_dir is not None:
+                key = os.path.abspath(standby_dir)
+                if key not in self._cuts:
+                    raise TiDBError(
+                        f"standby dir {standby_dir!r} was not bootstrap()ed "
+                        f"by this shipper"
+                    )
+            elif self._pending_cuts:
+                key = self._pending_cuts[0]
+            else:
+                raise TiDBError("bootstrap() the standby dir before attaching")
+            if key in self._pending_cuts:
+                self._pending_cuts.remove(key)
+            return key, self._cuts.pop(key)
 
     # ---------------------------------------------------------- transports
 
     def attach(self, standby) -> None:
         """In-process transport: frames land straight in the standby
-        Storage's receive path; the ship thread starts here."""
+        Storage's receive path; the link's ship thread starts here."""
         if self.store._shipper is not self:
             raise TiDBError("bootstrap() the standby dir before attaching")
-        self._standby = standby
-        self._receiver = standby.receive_frames
-        self._start()
+        key, cut = self._take_cut(getattr(standby, "data_dir", None))
+        link = _Link(os.path.basename(key) or key, cut, standby=standby)
+        self._add_link(link)
 
-    def attach_socket(self, host: str, port: int, connect_timeout: float = 5.0) -> None:
+    def attach_socket(self, host: str, port: int, connect_timeout: float = 5.0,
+                      standby_dir: str | None = None) -> None:
         """Socket transport to a StandbyServer: WAL-shaped frames out,
-        cumulative ack back after each batch fsync."""
+        cumulative (count, applied_ts) ack back after each batch fsync.
+        The HELLO handshake learns the standby's instance token and
+        already-acked frame count, which seeds the resync point."""
+        _key, cut = self._take_cut(standby_dir)
         sender = _SocketSender(host, port, connect_timeout)
-        self._receiver = sender.send_batch
-        self._start()
+        count, applied = sender.connect()
+        link = _Link(f"{host}:{port}", cut, sender=sender)
+        link.sent_gseq = link.durable_gseq = cut + count
+        link.applied_ts = applied
+        self._add_link(link)
 
-    def _start(self) -> None:
-        self._thread = threading.Thread(target=self._run, name="wal-shipper", daemon=True)
-        self._thread.start()
+    def _add_link(self, link: _Link) -> None:
+        with self._cond:
+            if self._stopped:
+                raise TiDBError("shipper is stopped")
+            self._links.append(link)
+            self._cond.notify_all()
+        link.thread = threading.Thread(
+            target=self._link_run, args=(link,),
+            name=f"wal-ship:{link.name}", daemon=True,
+        )
+        link.thread.start()
 
     def stop(self) -> None:
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
-        t = self._thread
-        if t is not None and t is not threading.current_thread():
-            t.join(timeout=5.0)
+            threads = [l.thread for l in self._links]
+        me = threading.current_thread()
+        for t in threads:
+            if t is not None and t is not me:
+                t.join(timeout=5.0)
 
     @property
     def broken(self) -> Exception | None:
+        """First link error once EVERY link is broken (the single-standby
+        shape callers test), else any shipper-level failure."""
         with self._cond:
+            errs = [l.error for l in self._links]
+            if errs and all(e is not None for e in errs):
+                return next(e for e in errs if e is not None)
             return self._broken
+
+    def link_states(self) -> list[dict]:
+        """Ops/test introspection: one dict per link."""
+        with self._cond:
+            return [
+                {
+                    "name": l.name, "base_gseq": l.base_gseq,
+                    "durable_gseq": l.durable_gseq, "applied_ts": l.applied_ts,
+                    "broken": l.error is not None, "reconnects": l.reconnects,
+                }
+                for l in self._links
+            ]
 
     # ----------------------------------------------------------- ship loop
 
-    def _run(self) -> None:
+    def _link_run(self, link: _Link) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._stopped:
+                while (not self._stopped and link.error is None
+                       and not (self._queue and self._queue[-1][3] > link.sent_gseq)):
                     self._cond.wait(self.POLL_S * 4)
-                if self._stopped:
+                if self._stopped or link.error is not None:
                     return
-                pending = list(self._queue)
+                pending = [f for f in self._queue if f[3] > link.sent_gseq]
             # durability horizon OUTSIDE our lock: durable_seq takes the
             # wal's own locks, which rank below the ship condition
             horizon: dict[int, int] = {}
@@ -259,20 +388,102 @@ class WalShipper:
                 self._update_lag()
                 continue
             try:
-                self._receiver([p for _, p in batch])
-            except Exception as e:  # noqa: BLE001 — transport/standby verdict
-                with self._cond:
-                    self._broken = e
-                    self._stopped = True
-                    self._cond.notify_all()
-                log.warning("WAL shipping stopped: %s", e)
+                count, applied = self._deliver(link, [p for _, p in batch])
+                if link.base_gseq + count < batch[-1][0]:
+                    raise ConnectionError(
+                        f"standby acked {count} frames < shipped through "
+                        f"gseq {batch[-1][0]} (base {link.base_gseq})"
+                    )
+            except (ConnectionError, OSError) as e:
+                if link.sender is not None and self._reconnect(link, e):
+                    continue  # resynced: re-walk the queue from the ack point
+                self._break_link(link, e)
                 return
+            except Exception as e:  # noqa: BLE001 — standby verdict (refusal)
+                self._break_link(link, e)
+                return
+            from ..utils import metrics as M
+
             with self._cond:
-                for _ in batch:
-                    self._queue.popleft()
-                self._shipped_seq = batch[-1][0]
+                link.reconnects = 0
+                link.sent_gseq = max(link.sent_gseq, batch[-1][0])
+                link.durable_gseq = link.base_gseq + count
+                link.applied_ts = max(link.applied_ts, applied)
+                self._prune_locked()
                 self._cond.notify_all()
+            M.REPLICA_DURABLE_FRAMES.set(float(count), replica=link.name)
+            M.REPLICA_APPLIED_TS.set(float(link.applied_ts), replica=link.name)
             self._update_lag()
+
+    def _deliver(self, link: _Link, payloads: list[bytes]) -> tuple[int, int]:
+        if link.standby is not None:
+            total = link.standby.receive_frames(payloads)
+            return total, link.standby.applied_ts
+        return link.sender.send_batch(payloads)
+
+    def _reconnect(self, link: _Link, cause: Exception) -> bool:
+        """Bounded reconnect-with-resync for a socket link: a transient
+        wire fault (bit-flip → standby CRC refusal → dropped connection,
+        or a plain broken pipe) must not silently degrade semi-sync to
+        local-only. Resync restarts from the standby's acked count — the
+        frames it never acked simply re-ship. Returns False once the
+        budget is exhausted (the link then breaks for good)."""
+        from ..utils import metrics as M
+
+        reason = "peer_closed" if isinstance(cause, ConnectionError) else "io_error"
+        while True:
+            with self._cond:
+                if self._stopped or link.error is not None:
+                    return False
+                link.reconnects += 1
+                attempt = link.reconnects
+            if attempt > self.RECONNECT_MAX:
+                return False
+            M.SHIP_RECONNECTS.inc(reason=reason)
+            time.sleep(min(1.0, self.RECONNECT_BACKOFF_S * (2 ** (attempt - 1))))
+            try:
+                link.sender.close()
+                count, applied = link.sender.connect()
+            except (ConnectionError, OSError):
+                continue  # counted; try again until the budget runs out
+            except TiDBError:
+                return False  # token mismatch: a DIFFERENT standby instance
+            with self._cond:
+                # resync point: everything past the standby's acked count
+                # re-ships (it journals/acks strictly in order, so the
+                # count IS the durable prefix length)
+                link.sent_gseq = link.durable_gseq = link.base_gseq + count
+                link.applied_ts = max(link.applied_ts, applied)
+                self._cond.notify_all()
+            log.warning(
+                "ship link %s reconnected (attempt %d, reason=%s): resyncing "
+                "from %d acked frames", link.name, attempt, reason, count,
+            )
+            return True
+
+    def _break_link(self, link: _Link, e: Exception) -> None:
+        with self._cond:
+            link.error = e
+            self._prune_locked()  # a broken link no longer pins the queue
+            self._cond.notify_all()
+            all_broken = all(l.error is not None for l in self._links)
+        log.warning("WAL shipping to %s stopped: %s", link.name, e)
+        if all_broken:
+            log.warning("ALL replica links are broken: semi-sync acks will "
+                        "fail until a standby is re-attached")
+
+    def _prune_locked(self) -> None:
+        """Drop queue frames durable on EVERY live link (broken links
+        don't pin memory; not-yet-attached bootstrap cuts do, so a
+        standby attached after a write burst still gets its tail)."""
+        floors = [self._cuts[k] for k in self._pending_cuts]
+        floors += [l.durable_gseq for l in self._links if l.error is None]
+        if not floors:
+            return
+        floor = min(floors)
+        while self._queue and self._queue[0][3] <= floor:
+            f = self._queue.popleft()
+            self._pruned_gseq = f[3]
 
     def _update_lag(self) -> None:
         from ..utils import metrics as M
@@ -281,31 +492,29 @@ class WalShipper:
             lag = (time.time() - self._queue[0][4]) if self._queue else 0.0
         M.WAL_SHIP_LAG.set(round(lag, 3))
 
-    # ----------------------------------------------------------- semi-sync
+    # --------------------------------------------------- semi-sync / quorum
 
     @property
     def can_promote(self) -> bool:
-        """Does this shipper hold a promotion target? True only for the
-        in-process transport — a socket shipper cannot promote the far
-        side, so primary-degrade handling must fall through to the
-        spare re-probe instead of fencing for a promotion that will
+        """Does this shipper hold a promotion target? True only when an
+        in-process standby is attached — a socket link cannot promote
+        the far side, so primary-degrade handling must fall through to
+        the spare re-probe instead of fencing for a promotion that will
         never happen."""
-        return self._standby is not None
+        with self._cond:
+            return any(l.standby is not None for l in self._links)
 
-    def wait_durable(self, session=None, deadline=None) -> None:
-        """Block until every frame DURABLE on the primary right now is
-        durable on the standby. The committer's own frames are covered
-        (its local fsync just returned, and they were tapped during its
-        appends) — but another session's appended-yet-unfsynced journal
-        frames (pessimistic lock acquisitions, rollbacks — neither runs
-        a sync) are deliberately NOT: waiting on those would block this
-        ack on durability nobody promised, potentially forever. KILL /
-        max_execution_time release the wait through the shared interrupt
-        gate — the commit is then indeterminate-on-standby, never
-        falsely acked."""
+    def _durable_target(self) -> int:
+        """Highest gseq durable on the PRIMARY right now: everything
+        already pruned (durable fleet-wide) plus the queue's durable
+        FIFO prefix. The committer's own frames are covered (its local
+        fsync just returned) — another session's appended-yet-unfsynced
+        journal frames (pessimistic locks, rollbacks) are deliberately
+        NOT: waiting on those would block this ack on durability nobody
+        promised, potentially forever."""
         with self._cond:
             pending = list(self._queue)
-            target = self._shipped_seq  # frames already gone are covered
+            target = self._pruned_gseq
         # durability horizon OUTSIDE the ship condition (lock order:
         # durable_seq takes the wal's own locks, ranked below ours)
         horizon: dict[int, int] = {}
@@ -316,15 +525,65 @@ class WalShipper:
             if seq > d:
                 break  # FIFO: nothing past an unfsynced frame is durable
             target = gseq
+        return target
+
+    def wait_durable(self, session=None, deadline=None, mode: str = "ON") -> None:
+        """Block until the commit's frames are durable on enough
+        standbys. `ON`: one ack suffices (the PR 14 contract). `QUORUM`:
+        the MEDIAN per-link durable horizon must cover the commit —
+        equivalently a majority ceil(N/2) of the N registered links
+        acked it, so any minority of standby losses loses no acked
+        commit. KILL / max_execution_time release the wait through the
+        shared interrupt gate — the commit is then indeterminate, never
+        falsely acked. A stopped shipper, or more broken links than the
+        quorum can spare, raises the typed indeterminate shape instead
+        of blocking forever. With NO links registered yet (mid-wiring:
+        bootstrap done, attach pending) the wait blocks until one
+        appears — exactly the single-standby behavior."""
+        from ..utils import metrics as M
+
+        target = self._durable_target()
         with self._cond:
             while True:
-                if self._shipped_seq >= target:
+                links = self._links
+                need = 1
+                if mode == "QUORUM" and links:
+                    need = (len(links) + 1) // 2
+                acked = sum(1 for l in links if l.durable_gseq >= target)
+                if mode == "QUORUM" and 0 < acked < need:
+                    # crash-harness window: a MINORITY of the fleet has
+                    # the commit durable, the client has NOT been acked —
+                    # dying here must never surface the commit as acked
+                    from ..utils.failpoint import inject as _fp
+
+                    _fp("ship/quorum-partial-ack")
+                if links and acked >= need:
+                    if mode == "QUORUM":
+                        M.REPLICA_QUORUM.inc(outcome="acked")
                     return
                 if self._stopped or self._broken is not None:
                     raise CommitIndeterminateError(
-                        "semi-sync: the standby is unavailable "
+                        "semi-sync: the replica fleet is unavailable "
                         f"({self._broken or 'shipper stopped'}); the commit "
-                        "is durable locally but UNCONFIRMED on the standby"
+                        "is durable locally but UNCONFIRMED on any standby"
+                    )
+                # a broken link can still COUNT for acks it sent before
+                # breaking (those frames ARE durable there), but it can
+                # never contribute new ones — if the remaining live links
+                # plus already-acked dead ones can't reach the quorum,
+                # no amount of waiting helps
+                potential = sum(
+                    1 for l in links
+                    if l.error is None or l.durable_gseq >= target
+                )
+                if links and potential < need:
+                    if mode == "QUORUM":
+                        M.REPLICA_QUORUM.inc(outcome="unreachable")
+                    raise CommitIndeterminateError(
+                        f"semi-sync {mode}: quorum unreachable — {need} "
+                        f"ack(s) required, only {potential} link(s) can "
+                        f"still provide one; the commit is durable locally "
+                        f"but UNCONFIRMED on the fleet"
                     )
                 self._cond.wait(self.POLL_S)
                 if session is not None or deadline is not None:
@@ -333,19 +592,26 @@ class WalShipper:
                     raise_if_interrupted(session, deadline)
 
     def wait_caught_up(self, timeout: float = 10.0) -> bool:
-        """Test/ops helper: True once every currently-durable frame has
-        shipped (the queue is empty or holds only not-yet-fsynced
-        frames)."""
+        """Test/ops helper: True once every currently-durable frame is
+        durable on every live link (no links: once the queue is empty or
+        holds only not-yet-fsynced frames)."""
         end = time.time() + timeout
         while time.time() < end:
+            target = self._durable_target()
             with self._cond:
-                head = self._queue[0] if self._queue else None
                 if self._stopped:
                     return not self._queue
-            if head is None:
-                return True
-            if head[1] > head[0].durable_seq():
-                return True
+                live = [l for l in self._links if l.error is None]
+                if live:
+                    if all(l.durable_gseq >= target for l in live):
+                        return True
+                else:
+                    head = self._queue[0] if self._queue else None
+            if not live:
+                if head is None:
+                    return True
+                if head[1] > head[0].durable_seq():
+                    return True
             time.sleep(self.POLL_S / 2)
         return False
 
@@ -353,29 +619,194 @@ class WalShipper:
 
     def on_primary_degraded(self) -> None:
         """The primary degraded and could NOT rotate onto a spare: drain
-        what is durable, then promote the standby (auto_promote only).
-        Frames past the primary's last fsync are gone with its page
-        cache — dropping them is exactly the never-ahead invariant."""
-        if not self.auto_promote or self._standby is None:
+        what is durable, then promote the in-process standby with the
+        HIGHEST durable horizon (auto_promote only) — with N>1
+        candidates that pick loses the least acked history. Frames past
+        the primary's last fsync are gone with its page cache — dropping
+        them is exactly the never-ahead invariant."""
+        with self._cond:
+            cands = [l for l in self._links if l.standby is not None and l.error is None]
+        if not self.auto_promote or not cands:
             return
         end = time.time() + self.DRAIN_DEADLINE_S
         while time.time() < end:
+            target = self._durable_target()
             with self._cond:
                 if self._stopped:
                     break
-                head = self._queue[0] if self._queue else None
-            if head is None:
-                break
-            if head[1] > head[0].durable_seq():
-                break  # the rest can never become durable
+                if any(l.durable_gseq >= target for l in cands):
+                    break  # the best candidate holds every durable frame
             time.sleep(self.POLL_S)
         self.stop()
+        with self._cond:
+            best = max(cands, key=lambda l: l.durable_gseq)
         try:
-            self._standby.promote()
+            best.standby.promote()
         except TiDBError:
             pass  # already promoted by an operator — same outcome
-        log.warning("auto-promote: standby %s is the new primary",
-                    getattr(self._standby, "data_dir", "?"))
+        self._promoted = best.standby
+        log.warning(
+            "auto-promote: standby %s is the new primary (durable horizon "
+            "%d, %d candidate(s))",
+            getattr(best.standby, "data_dir", "?"), best.durable_gseq, len(cands),
+        )
+
+    # ------------------------------------------------------ rejoin (heal)
+
+    def rejoin(self, old_store) -> None:
+        """Rebuild a fenced old primary as a standby of THIS shipper's
+        store (the new primary) — the fleet heals instead of shrinking.
+        The old store's divergent unacked tail (anything it journaled
+        past what the new primary's history contains) is discarded
+        wholesale: a fresh snapshot of the new primary is cut (under the
+        new primary's kv lock, same barrier as bootstrap), written into
+        the old dir under a BUMPED epoch, the old epoch's logs are
+        unlinked (the truncate), and the in-memory state is rebuilt from
+        the snapshot. Then the dir re-enters the fleet as a normal link
+        and shipping resumes. Safe against a crash mid-way: the new
+        snapshot names epoch old+1, so recovery from the dir ignores (and
+        deletes) the stale old-epoch logs whether or not the unlink
+        landed — the same ordering contract as checkpoint()."""
+        from ..utils import metrics as M
+        from . import wal as w
+
+        store = self.store
+        if old_store is store:
+            raise TiDBError("ADMIN REJOIN: a store cannot rejoin itself")
+        if store.wal is None:
+            raise TiDBError("rejoin requires a durable new primary (data_dir)")
+        try:
+            with old_store._standby_lock:
+                if old_store.standby:
+                    raise TiDBError(
+                        "ADMIN REJOIN: store is already a standby"
+                    )
+                if not (old_store._failover_disabled or old_store._io_degraded
+                        or old_store.wal is None):
+                    raise TiDBError(
+                        "ADMIN REJOIN: store is a healthy primary — rejoin "
+                        "is for a FENCED old primary after failover (fencing "
+                        "guards split brain; a healthy primary has nothing "
+                        "to rejoin)"
+                    )
+                data_dir = old_store.data_dir
+                new_epoch = old_store._wal_epoch + 1
+                with store.kv.lock:
+                    # the snapshot payload names the epoch whose log the
+                    # rebuilt standby will journal shipped frames into
+                    payload = store._snapshot_payload_locked(new_epoch)
+                    w.snap_write(os.path.join(data_dir, "snapshot.bin"), payload)
+                    w.fsync_dir(data_dir)
+                    with self._cond:
+                        # the cut pins the queue (like a bootstrap cut)
+                        # until the link attaches below — other links'
+                        # fast acks must not prune the rejoiner's tail
+                        key = os.path.abspath(data_dir)
+                        self._cuts[key] = self._enq_seq
+                        if key not in self._pending_cuts:
+                            self._pending_cuts.append(key)
+                    self.install(store.wal)
+                # crashpoint: new-primary snapshot durable in the old dir,
+                # the old (divergent) logs not yet unlinked, memory not yet
+                # rebuilt — recovery must boot from the NEW snapshot and
+                # discard the stale epoch's logs
+                from ..utils.failpoint import inject as _fp
+
+                _fp("standby/rejoin-mid-truncate")
+                old_wal = old_store.wal
+                if old_wal is not None:
+                    old_wal.tap = None
+                    old_wal.on_durable = None
+                    old_wal.close()
+                for f in os.listdir(data_dir):
+                    if f.startswith("wal.") and f.endswith(".log"):
+                        os.unlink(os.path.join(data_dir, f))
+                w.fsync_dir(data_dir)
+                old_store._rebuild_as_standby(payload, new_epoch)
+            key, cut = self._take_cut(data_dir)
+            link = _Link(os.path.basename(key) or key, cut, standby=old_store)
+            self._add_link(link)
+        except Exception:
+            M.REPLICA_REJOINS.inc(outcome="failed")
+            raise
+        M.REPLICA_REJOINS.inc(outcome="ok")
+        log.warning(
+            "REJOIN: fenced old primary %s rebuilt as a standby of %s "
+            "(epoch %d, cut gseq %d)", data_dir, store.data_dir, new_epoch, cut,
+        )
+
+
+# the PR 14 name: one shipper, one standby. The fleet generalization
+# keeps the class (an N=1 ReplicaSet IS the old shipper, API included).
+WalShipper = ReplicaSet
+
+
+class ReplicaRouter:
+    """Lag-bounded follower-read routing (the read half of the fleet).
+
+    Mirrors the PR 6 placement shape (TPUEngine.place): score every
+    eligible replica, choose-and-bump atomically under one lock so
+    concurrent statements spread instead of dog-piling the same replica
+    — but the weight is applied-ts LAG (staleness), blended with
+    in-flight statement count, instead of lane occupancy. `None` means
+    no replica is eligible (every one too stale / broken / promoted
+    away): the caller falls back to the primary."""
+
+    def __init__(self, replica_set: ReplicaSet):
+        self._rs = replica_set
+        self._lock = threading.Lock()
+        self._inflight: dict[int, int] = {}  # id(store) → live statements
+
+    def route(self, as_of_ts: int | None = None, max_lag_ms: int = 5000):
+        """Pick a replica for one read-only statement. For `AS OF
+        TIMESTAMP t` reads a replica is eligible iff its applied
+        watermark has REACHED t (it then serves the exact same snapshot
+        the primary would — never a commit above t, never missing one at
+        or below it). For plain follower reads eligibility is bounded
+        staleness: applied-ts lag within `max_lag_ms`. Returns the
+        chosen standby Storage (inflight-bumped: pair with `release`),
+        or None for primary fallback."""
+        from ..utils import metrics as M
+
+        with self._rs._cond:
+            links = [l for l in self._rs._links
+                     if l.standby is not None and l.error is None]
+        now_ms = int(time.time() * 1000)
+        cands = []
+        for l in links:
+            st = l.standby
+            if not st.standby:
+                continue  # promoted away: it is a primary now
+            ats = st.applied_ts
+            if as_of_ts is not None:
+                if ats < as_of_ts:
+                    continue  # hasn't caught up to t: would miss commits <= t
+                lag_ms = 0.0
+            else:
+                lag_ms = max(0.0, now_ms - (ats >> 18))
+                if lag_ms > max_lag_ms:
+                    continue
+            cands.append((st, lag_ms))
+        if not cands:
+            M.REPLICA_READS.inc(outcome="fallback_stale" if links else "fallback_none")
+            return None
+        with self._lock:
+            best = min(
+                cands,
+                key=lambda c: self._inflight.get(id(c[0]), 0)
+                + c[1] / max(1.0, float(max_lag_ms)),
+            )[0]
+            self._inflight[id(best)] = self._inflight.get(id(best), 0) + 1
+        M.REPLICA_READS.inc(outcome="follower")
+        return best
+
+    def release(self, store) -> None:
+        with self._lock:
+            n = self._inflight.get(id(store), 0)
+            if n <= 1:
+                self._inflight.pop(id(store), None)
+            else:
+                self._inflight[id(store)] = n - 1
 
 
 # ------------------------------------------------------------------ socket
@@ -383,7 +814,9 @@ class WalShipper:
 _FRAME_HDR = struct.Struct("<BII")  # tag, len, crc32
 _TAG_FRAME = 0x46  # 'F'
 _TAG_SYNC = 0x53  # 'S'
-_ACK = struct.Struct("<Q")
+_TAG_HELLO = 0x48  # 'H' — sender-initiated handshake/resync probe
+_ACK = struct.Struct("<QQ")  # cumulative durable frame count, applied_ts
+_HELLO = struct.Struct("<16sQQ")  # instance token, acked count, applied_ts
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -397,44 +830,74 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class _SocketSender:
-    """Primary-side socket transport: WAL-shaped frames + a sync marker
-    per batch, then wait for the standby's cumulative durable ack."""
+    """Primary-side socket transport: HELLO handshake on (re)connect,
+    WAL-shaped frames + a sync marker per batch, then the standby's
+    cumulative (durable count, applied_ts) ack."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
-        self.sock = socket.create_connection((host, port), timeout=connect_timeout)
-        self.sock.settimeout(30.0)
-        self._sent = 0
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self.token: bytes | None = None
+        self.sock: socket.socket | None = None
 
-    def send_batch(self, payloads: list[bytes]) -> None:
+    def connect(self) -> tuple[int, int]:
+        """(Re)establish the connection and handshake. Returns the
+        standby's (acked frame count, applied_ts) — the resync point.
+        Raises TiDBError if the far side is a DIFFERENT standby instance
+        than the one this link bootstrapped (its frame count restarted
+        with it, so count-based resync would corrupt: re-bootstrap)."""
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout
+        )
+        self.sock.settimeout(30.0)
+        self.sock.sendall(_FRAME_HDR.pack(_TAG_HELLO, 0, 0))
+        token, count, applied = _HELLO.unpack(_recv_exact(self.sock, _HELLO.size))
+        if self.token is None:
+            self.token = token
+        elif token != self.token:
+            raise TiDBError(
+                "ship resync refused: the standby instance changed (token "
+                "mismatch) — its acked-frame count restarted with it, so "
+                "resuming by count would corrupt; re-bootstrap the standby"
+            )
+        return int(count), int(applied)
+
+    def send_batch(self, payloads: list[bytes]) -> tuple[int, int]:
         out = bytearray()
         for p in payloads:
             out += _FRAME_HDR.pack(_TAG_FRAME, len(p), zlib.crc32(p))
             out += p
         out += _FRAME_HDR.pack(_TAG_SYNC, 0, 0)
         self.sock.sendall(bytes(out))
-        self._sent += len(payloads)
-        (acked,) = _ACK.unpack(_recv_exact(self.sock, _ACK.size))
-        if acked < self._sent:
-            raise ConnectionError(
-                f"standby acked {acked} < shipped {self._sent} frames"
-            )
+        count, applied = _ACK.unpack(_recv_exact(self.sock, _ACK.size))
+        return int(count), int(applied)
 
     def close(self) -> None:
+        if self.sock is None:
+            return
         try:
             self.sock.close()
         except OSError:
             pass
+        self.sock = None
 
 
 class StandbyServer:
     """Standby-side socket transport: validates each frame's CRC (the
     wire reuses the WAL frame shape, so a flipped bit on the wire is
-    caught exactly like one on disk), feeds whole batches to the
-    standby's receive path at each sync marker, and acks the cumulative
-    durable frame count."""
+    caught exactly like one on disk — the connection drops and the
+    sender resyncs from the acked count), answers HELLO with this
+    instance's token + acked count, feeds whole batches to the standby's
+    receive path at each sync marker, and acks the cumulative durable
+    frame count plus the applied watermark."""
 
     def __init__(self, standby, host: str = "127.0.0.1", port: int = 0):
         self.standby = standby
+        # identifies THIS standby instance across sender reconnects: a
+        # restarted standby re-counts applied frames from its recovered
+        # state, so a sender must not resume into it by stale count
+        self.token = os.urandom(16)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -463,21 +926,27 @@ class StandbyServer:
 
     def _serve(self, conn: socket.socket) -> None:
         batch: list[bytes] = []
-        total = 0
+        total = self.standby._applied_frames
         while not self._closing:
             tag, ln, crc = _FRAME_HDR.unpack(_recv_exact(conn, _FRAME_HDR.size))
             if tag == _TAG_FRAME:
                 payload = _recv_exact(conn, ln)
                 if zlib.crc32(payload) != crc:
                     # never apply a frame the wire damaged; dropping the
-                    # connection makes the shipper surface it loudly
+                    # connection makes the sender reconnect and resync
+                    # from the last acked count (bounded retries)
                     raise ConnectionError("shipped frame failed CRC check")
                 batch.append(payload)
             elif tag == _TAG_SYNC:
                 if batch:
                     total = self.standby.receive_frames(batch)
                     batch = []
-                conn.sendall(_ACK.pack(total))
+                conn.sendall(_ACK.pack(total, self.standby.applied_ts))
+            elif tag == _TAG_HELLO:
+                conn.sendall(_HELLO.pack(
+                    self.token, self.standby._applied_frames,
+                    self.standby.applied_ts,
+                ))
             else:
                 raise ConnectionError(f"unknown ship tag {tag:#x}")
 

@@ -399,7 +399,8 @@ class Storage:
         from threading import RLock as _RLock
 
         self._standby_lock = _RLock()  # serializes receive_frames vs promote
-        self._shipper = None  # WalShipper (storage/ship.py) when attached
+        self._shipper = None  # ReplicaSet (storage/ship.py) when attached
+        self._ship_asm = None  # GroupAssembler for shipped frame groups
         # spare WAL media (tidb_wal_spare_dirs): on an IO failure the
         # store checkpoints onto a spare and resumes writes instead of
         # degrading read-only for the rest of its life
@@ -941,32 +942,7 @@ class Storage:
             )
         if payload:
             try:
-                pos = 0
-                (self._wal_epoch,) = struct.unpack_from("<Q", payload, pos)
-                pos += 8
-                (n_entries,) = struct.unpack_from("<Q", payload, pos)
-                pos += 8
-                pairs = []
-                for _ in range(n_entries):
-                    klen, vlen = struct.unpack_from("<II", payload, pos)
-                    pos += 8
-                    if pos + klen + vlen > len(payload):
-                        raise ValueError("snapshot entry overruns payload")
-                    k = payload[pos : pos + klen]
-                    pos += klen
-                    v = payload[pos : pos + vlen]
-                    pos += vlen
-                    pairs.append((k, v))
-                self.kv.bulk_load(pairs)
-                (n_runs,) = struct.unpack_from("<I", payload, pos)
-                pos += 4
-                for _ in range(n_runs):
-                    rec_len = struct.unpack_from("<Q", payload, pos)[0]
-                    pos += 8
-                    if pos + rec_len > len(payload):
-                        raise ValueError("snapshot run record overruns payload")
-                    w.apply_record(payload[pos : pos + rec_len], self.kv, self.mvcc)
-                    pos += rec_len
+                self._wal_epoch = self._load_snapshot_payload(payload)
             except (struct.error, ValueError) as e:
                 # CRC checked out but the payload misparses: a writer bug,
                 # not media damage — same refuse-don't-guess treatment
@@ -1017,26 +993,69 @@ class Storage:
                         f"log — restore from a replica/backup"
                     ) from e
 
+            def _feed(asm, rec: bytes, what: str) -> list:
+                try:
+                    return asm.feed(rec)
+                except ValueError as e:
+                    raise WalCorruptionError(
+                        f"WAL {wal_path!r}: {what} frame-group sequence is "
+                        f"malformed ({e}); refusing to recover from a "
+                        f"half-understood log — restore from a replica/backup"
+                    ) from e
+
+            # frame groups (G/g chunk/F) join back into their logical
+            # record before applying; the group's byte offset is tracked
+            # so a torn trailing group truncates at its BEGIN frame (the
+            # whole group replays atomically or not at all)
+            asm = w.GroupAssembler()
+            group_off = off = 0
             for rec in scan.records:
-                _replay(rec, "intact-prefix")
-            if scan.corrupt:
-                if scan.mid_log:  # drop-corrupt: skip the bad region, keep the rest
-                    for rec in scan.salvage:
-                        _replay(rec, "salvaged")
-                    salvage = list(scan.salvage)
-                    dropped = (scan.file_size - scan.valid_prefix) - sum(
-                        8 + len(r) for r in salvage
-                    )
-                    M.WAL_RECOVERY_DROPPED.inc(dropped, kind="corrupt")
-                    log.warning(
-                        "drop-corrupt recovery on %s: skipped %d corrupt byte(s), "
-                        "salvaged %d record(s) past them", wal_path, dropped, len(salvage),
-                    )
-                else:
-                    M.WAL_RECOVERY_DROPPED.inc(scan.file_size - scan.valid_prefix, kind="torn")
-                # truncate to the intact prefix before appending (salvaged
-                # records are re-appended below, through the fresh Wal)
-                os.truncate(wal_path, scan.valid_prefix)
+                if rec[:1] == b"G" and not asm.open:
+                    group_off = off
+                off += 8 + len(rec)
+                for full in _feed(asm, rec, "intact-prefix"):
+                    _replay(full, "intact-prefix")
+            trunc_to = scan.valid_prefix if scan.corrupt else None
+            if asm.open:
+                # the group's closing frame never became durable: its
+                # chunks stayed buffered (nothing half-applied) and the
+                # whole group is cut like any torn tail
+                trunc_to = group_off
+                M.WAL_RECOVERY_DROPPED.inc(
+                    scan.valid_prefix - group_off, kind="torn-group"
+                )
+            if scan.corrupt and scan.mid_log:
+                # drop-corrupt: skip the bad region, keep the rest. The
+                # salvage runs through its OWN assembler (a group cannot
+                # span the corrupt gap); a trailing open group in the
+                # salvage is dropped, complete ones re-append whole.
+                salv_asm = w.GroupAssembler()
+                kept: list[bytes] = []
+                group_frames: list[bytes] = []
+                for rec in scan.salvage:
+                    in_group = salv_asm.open or rec[:1] == b"G"
+                    (group_frames if in_group else kept).append(rec)
+                    done = _feed(salv_asm, rec, "salvaged")
+                    if done:
+                        kept.extend(group_frames)
+                        group_frames = []
+                    for full in done:
+                        _replay(full, "salvaged")
+                salvage = kept
+                dropped = (scan.file_size - scan.valid_prefix) - sum(
+                    8 + len(r) for r in salvage
+                )
+                M.WAL_RECOVERY_DROPPED.inc(dropped, kind="corrupt")
+                log.warning(
+                    "drop-corrupt recovery on %s: skipped %d corrupt byte(s), "
+                    "salvaged %d record(s) past them", wal_path, dropped, len(salvage),
+                )
+            elif scan.corrupt:
+                M.WAL_RECOVERY_DROPPED.inc(scan.file_size - scan.valid_prefix, kind="torn")
+            if trunc_to is not None:
+                # truncate before appending (salvaged records are
+                # re-appended below, through the fresh Wal)
+                os.truncate(wal_path, trunc_to)
         # stale epochs (pre-checkpoint logs) are garbage
         for f in os.listdir(data_dir):
             if f.startswith("wal.") and f.endswith(".log") and f != os.path.basename(wal_path):
@@ -1064,20 +1083,21 @@ class Storage:
         `SET GLOBAL tidb_wal_group_commit = OFF` recovers the exact
         per-commit-fsync behavior live (incident fallback).
 
-        Semi-sync (`tidb_wal_semi_sync=ON`, PR 14): with a shipper
-        attached the ack additionally means durable-on-STANDBY — after
+        Semi-sync (`tidb_wal_semi_sync`, PR 14/17): with a shipper
+        attached the ack additionally means durable-on-REPLICA — after
         local durability the committer waits (through the same interrupt
-        gate) for the shipper to confirm the standby fsynced its frames.
-        The wait piggybacks the group-commit cadence: the shipper ships
-        per flushed group, so one standby fsync covers the whole group."""
+        gate) for the fleet to confirm. `ON` waits for any ONE standby's
+        fsync (the PR 14 pair contract); `QUORUM` waits until the median
+        per-standby durable horizon covers the commit — a majority
+        ceil(N/2) of the N attached links. The wait piggybacks the
+        group-commit cadence: the shipper ships per flushed group, so
+        one standby fsync covers the whole group."""
         wal = self.wal
         if wal is None:
             return
         sh = self._shipper
-        semi = (
-            sh is not None
-            and self.global_vars.get("tidb_wal_semi_sync", "OFF") == "ON"
-        )
+        semi_mode = self.global_vars.get("tidb_wal_semi_sync", "OFF")
+        semi = sh is not None and semi_mode in ("ON", "QUORUM")
         # the committing statement's session/deadline (if any) let a KILL
         # or max_execution_time release the follower/semi-sync wait; the
         # commit is then INDETERMINATE (the leader's fsync may still land
@@ -1097,7 +1117,7 @@ class Storage:
         else:
             wal.sync_group(session=session, deadline=deadline)
         if semi:
-            sh.wait_durable(session=session, deadline=deadline)
+            sh.wait_durable(session=session, deadline=deadline, mode=semi_mode)
 
     def _snapshot_payload_locked(self, epoch: int) -> bytes:
         """Serialize the full in-memory state as a snapshot payload that
@@ -1122,6 +1142,43 @@ class Storage:
             parts.append(struct.pack("<Q", len(rec)))
             parts.append(rec)
         return b"".join(parts)
+
+    def _load_snapshot_payload(self, payload: bytes) -> int:
+        """Parse a `_snapshot_payload_locked` payload into the in-memory
+        store (kv pairs + ingest runs) and return the WAL epoch it names.
+        Raises struct.error/ValueError on a malformed payload — callers
+        wrap those in the typed refusal."""
+        import struct
+
+        from . import wal as w
+
+        pos = 0
+        (epoch,) = struct.unpack_from("<Q", payload, pos)
+        pos += 8
+        (n_entries,) = struct.unpack_from("<Q", payload, pos)
+        pos += 8
+        pairs = []
+        for _ in range(n_entries):
+            klen, vlen = struct.unpack_from("<II", payload, pos)
+            pos += 8
+            if pos + klen + vlen > len(payload):
+                raise ValueError("snapshot entry overruns payload")
+            k = payload[pos : pos + klen]
+            pos += klen
+            v = payload[pos : pos + vlen]
+            pos += vlen
+            pairs.append((k, v))
+        self.kv.bulk_load(pairs)
+        (n_runs,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        for _ in range(n_runs):
+            rec_len = struct.unpack_from("<Q", payload, pos)[0]
+            pos += 8
+            if pos + rec_len > len(payload):
+                raise ValueError("snapshot run record overruns payload")
+            w.apply_record(payload[pos : pos + rec_len], self.kv, self.mvcc)
+            pos += rec_len
+        return int(epoch)
 
     def checkpoint(self) -> None:
         """Compact the WAL into an atomic snapshot file (the storage
@@ -1205,14 +1262,29 @@ class Storage:
             wal.sync()
             applied = self.applied_ts
             prefixes: set[bytes] = set()
+            # frame groups (G/g chunk/F) re-join into the logical record
+            # before applying; a group split across ship batches stays
+            # buffered in the assembler — its journaled chunks are acked
+            # (durable), its effects land when the closing frame arrives
+            asm = self._ship_asm
+            if asm is None:
+                asm = self._ship_asm = w.GroupAssembler()
             for p in payloads:
-                w.apply_record(p, self.kv, self.mvcc)
-                ts = frame_commit_ts(p)
-                if ts > applied:
-                    applied = ts
-                pref = frame_table_prefix(p)
-                if pref is not None:
-                    prefixes.add(pref)
+                for rec in asm.feed(p):
+                    w.apply_record(rec, self.kv, self.mvcc)
+                    ts = frame_commit_ts(rec)
+                    if ts > applied:
+                        applied = ts
+                    pref = frame_table_prefix(rec)
+                    if pref is not None:
+                        prefixes.add(pref)
+            # the version bump below stamps tso.current() as the table's
+            # last-commit ts, and the tile/cop-result caches key snapshot
+            # validity off that stamp — without advancing first a standby
+            # TSO still reads 0, every historic AS OF read satisfies
+            # `read_ts >= 0`, and the FIRST follower read's tile (built at
+            # its own, possibly historic, snapshot) serves every later one
+            self.tso.advance_to(applied)
             if prefixes:
                 # replayed frames must invalidate tile/cop-result caches
                 # exactly like a local commit would
@@ -1250,6 +1322,85 @@ class Storage:
             "%d shipped frames applied)",
             self.data_dir, self.applied_ts, self._applied_frames,
         )
+
+    def _rebuild_as_standby(self, payload: bytes, new_epoch: int) -> None:
+        """Rejoin's in-place rebuild (called by ReplicaSet.rejoin under
+        OUR standby lock, after it wrote the new primary's snapshot into
+        our dir and unlinked the divergent old logs): discard the whole
+        in-memory state, reload from the snapshot payload, open a fresh
+        log under the bumped epoch, and come up as a standby — journals
+        detached, writes refused until promote, applied watermark at the
+        snapshot's high water. The store_uid changes: every process-wide
+        cache entry keyed to the old (divergent) history must miss."""
+        import os
+        import uuid as _uuid
+
+        from ..utils import metrics as M
+        from . import wal as w
+
+        self.kv = MemKV()
+        self.mvcc = MVCCStore(self.kv)
+        self.mvcc.txn_live = self.txn_is_active
+        self.mvcc.split_hook = self._auto_split_run
+        self.regions = RegionMap()
+        self._versions = {}
+        self.store_uid = _uuid.uuid4().hex[:16]
+        self._ship_asm = None
+        self._load_snapshot_payload(payload)
+        self._wal_epoch = new_epoch
+        self.wal = w.Wal(self._wal_path(new_epoch), on_io_error=self._wal_io_error)
+        self.wal.sync()
+        w.fsync_dir(self.data_dir)
+        # standby discipline: shipped frames journal explicitly in
+        # receive_frames; kv/mvcc must not re-journal applied records
+        self.kv.journal = None
+        self.mvcc.journal = None
+        self.standby = True
+        self._shipper = None  # the OLD primary's shipper died with its role
+        self._applied_frames = 0
+        self.applied_ts = self.mvcc.high_water_ts()
+        self.tso.advance_to(self.applied_ts)
+        # the fence existed to keep the DIVERGENT history from serving;
+        # that history is gone — this store is a consistent follower now
+        # and may degrade/promote again like any standby
+        self._io_degraded = False
+        self._failover_disabled = False
+        self._no_spare_counted = False
+        M.WAL_DEGRADED.set(0)
+        M.STANDBY_APPLIED_TS.set(float(self.applied_ts))
+
+    def rejoin(self, new_primary: "Storage | None" = None) -> None:
+        """ADMIN REJOIN: rebuild this fenced old primary as a standby of
+        the promoted new primary, healing the fleet after a failover.
+        With no explicit target, the new primary is discovered from this
+        store's old shipper: the standby auto-promote picked, or any
+        attached in-process standby that has since been promoted."""
+        target = new_primary
+        sh = self._shipper
+        if target is None and sh is not None:
+            target = getattr(sh, "_promoted", None)
+            if target is None:
+                with sh._cond:
+                    for l in sh._links:
+                        st = l.standby
+                        if st is not None and not st.standby:
+                            target = st
+                            break
+        if target is None:
+            raise TiDBError(
+                "ADMIN REJOIN: no promoted new primary found — this store's "
+                "shipper never promoted a standby (pass the new primary "
+                "explicitly via Storage.rejoin(new_primary))"
+            )
+        if sh is not None:
+            sh.stop()
+        nsh = target._shipper
+        if nsh is None:
+            from .ship import ReplicaSet
+
+            nsh = ReplicaSet(target)
+            target._shipper = nsh
+        nsh.rejoin(self)
 
     @property
     def plugins(self):

@@ -10,6 +10,8 @@ Record payloads (framing/CRC live in C++; payloads are ours):
   b'D' u32 klen key                delete
   b'X' u32 slen start u32 elen end delete_range
   b'R' run: u32 w, u64 n, u64 commit_ts, key_mat, starts, lens, vbuf
+  b'G' / b'g' chunk / b'F'         frame group: ONE logical record
+       streamed as bounded chunks (see GroupAssembler)
 
 Group commit (PR 13): `sync_group` batches concurrent committers'
 fsyncs — every committer appends its records, then ONE leader runs the
@@ -169,21 +171,46 @@ class Wal:
 
     def append(self, payload: bytes) -> None:
         with self._lock:
-            if self.poisoned:
-                self._io_failed("append", "log already poisoned")
-            if self._h is None:
-                raise StorageIOError(f"WAL {self.path!r} is closed")
-            try:
-                _fp("wal/io-error-append")
-            except OSError as e:
-                self._io_failed("append", e)
-            if self.lib.wal_append(self._h, payload, len(payload)) < 0:
-                self._io_failed("append", "native append error")
-            self._appended_seq += 1
-            if self.tap is not None:
-                self.tap(self, self._appended_seq, payload)
+            self._append_locked(payload)
         # durability-gap crashpoint: record buffered, nothing fsynced yet
         _fp("wal/after-append-before-sync")
+
+    def _append_locked(self, payload: bytes) -> None:
+        if self.poisoned:
+            self._io_failed("append", "log already poisoned")
+        if self._h is None:
+            raise StorageIOError(f"WAL {self.path!r} is closed")
+        try:
+            _fp("wal/io-error-append")
+        except OSError as e:
+            self._io_failed("append", e)
+        if self.lib.wal_append(self._h, payload, len(payload)) < 0:
+            self._io_failed("append", "native append error")
+        self._appended_seq += 1
+        if self.tap is not None:
+            self.tap(self, self._appended_seq, payload)
+
+    def append_group(self, chunks) -> int:
+        """Append ONE logical record streamed as a bounded frame group:
+        a bare b'G' frame, one b'g'-prefixed frame per chunk, a bare
+        b'F' frame — all under the append lock, so no other committer's
+        frames interleave. The logical record is the chunk concatenation;
+        it is never materialized here, which is the point — a 16M-row
+        ingest journals at per-chunk memory instead of holding its whole
+        WAL image resident. Returns the logical record's byte length.
+        Recovery (and a shipped standby) joins the group back into the
+        monolithic record; an unterminated trailing group is truncated
+        wholesale at its b'G' frame — atomic replay, same contract as
+        the single-frame form."""
+        total = 0
+        with self._lock:
+            self._append_locked(b"G")
+            for chunk in _iter_bounded(chunks):
+                total += len(chunk)
+                self._append_locked(b"g" + chunk)
+            self._append_locked(b"F")
+        _fp("wal/after-append-before-sync")
+        return total
 
     def sync(self) -> int:
         """Flush + fsync everything appended so far. Returns the record
@@ -636,6 +663,104 @@ def rec_compact(table_id: int, fold_ts: int, spans, retire, runs) -> bytes:
         parts.append(struct.pack("<Q", len(s)))
         parts.append(s)
     return b"".join(parts)
+
+
+# ------------------------------------------------------------ frame groups
+#
+# A frame group streams ONE logical record to the log as bounded pieces:
+#   b'G'            group begin (bare)
+#   b'g' <chunk>    one chunk of the logical record
+#   b'F'            group end (bare)
+# The logical record is the concatenation of the chunks — byte-identical
+# to the monolithic form, so `apply_record` never sees group tags. The
+# writer holds the append lock across the whole group (Wal.append_group),
+# so a group is always contiguous in the log and a torn group can only be
+# the log's final frames.
+
+GROUP_CHUNK_BYTES = 1 << 20
+
+
+def _iter_bounded(chunks):
+    """Re-chunk byte pieces to <= GROUP_CHUNK_BYTES each. Oversized
+    pieces are split; small ones pass through un-coalesced (bounding
+    resident memory is the goal, minimizing frame count is not)."""
+    for piece in chunks:
+        if len(piece) <= GROUP_CHUNK_BYTES:
+            if piece:
+                yield piece
+        else:
+            for off in range(0, len(piece), GROUP_CHUNK_BYTES):
+                yield piece[off : off + GROUP_CHUNK_BYTES]
+
+
+def iter_ingest_chunks(runs):
+    """Stream the bulk-ingest record as chunks whose concatenation is
+    byte-identical to `rec_ingest(runs)` — at most one run's WAL record
+    is resident at a time instead of the whole ingest image."""
+    yield b"I" + struct.pack("<I", len(runs))
+    for r in runs:
+        s = r.to_wal_record()
+        yield struct.pack("<Q", len(s))
+        yield s
+
+
+def iter_compact_chunks(table_id: int, fold_ts: int, spans, retire, runs):
+    """Stream the delta-main compaction record as chunks whose
+    concatenation is byte-identical to `rec_compact(...)`."""
+    parts = [b"Z", struct.pack("<qQ", table_id, fold_ts),
+             struct.pack("<I", len(spans))]
+    for s, e in spans:
+        parts.append(struct.pack("<I", len(s)))
+        parts.append(s)
+        parts.append(struct.pack("<I", len(e)))
+        parts.append(e)
+    parts.append(struct.pack("<I", len(retire)))
+    for kind, aux, cts in retire:
+        parts.append(struct.pack("<BqQ", kind, aux, cts))
+    parts.append(struct.pack("<I", len(runs)))
+    yield b"".join(parts)
+    for r in runs:
+        s = r.to_wal_record()
+        yield struct.pack("<Q", len(s))
+        yield s
+
+
+class GroupAssembler:
+    """Join frame-group chunks back into logical records.
+
+    `feed(payload)` returns the complete logical records the frame
+    finished: a non-group frame passes straight through, group frames
+    buffer until the closing b'F' joins them. Malformed sequences (a
+    group tag outside a group, a non-chunk frame inside one) raise
+    ValueError — the writer holds the append lock across a group, so
+    they are unreachable from an honest log."""
+
+    def __init__(self):
+        self._chunks: list[bytes] | None = None
+
+    @property
+    def open(self) -> bool:
+        return self._chunks is not None
+
+    def feed(self, payload: bytes) -> list[bytes]:
+        tag = payload[:1]
+        if self._chunks is None:
+            if tag == b"G":
+                _need(len(payload) == 1, "G frame not bare")
+                self._chunks = []
+                return []
+            _need(tag not in (b"g", b"F"), f"group frame {tag!r} outside a group")
+            return [payload]
+        if tag == b"g":
+            self._chunks.append(payload[1:])
+            return []
+        if tag == b"F":
+            _need(len(payload) == 1, "F frame not bare")
+            rec = b"".join(self._chunks)
+            self._chunks = None
+            _need(len(rec) >= 1, "empty frame group")
+            return [rec]
+        raise ValueError(f"malformed WAL record: frame {tag!r} inside an open group")
 
 
 def _apply_crun(payload: bytes):

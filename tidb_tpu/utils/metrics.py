@@ -501,6 +501,46 @@ STANDBY_APPLIED_TS = REGISTRY.gauge(
     "tidb_standby_applied_ts",
     "newest commit_ts the standby store has replayed from shipped frames",
 )
+# replica fleet (PR 17): per-link horizons, quorum commit outcomes,
+# lag-bounded follower-read routing, socket resync, and rejoin healing
+REPLICA_DURABLE_FRAMES = REGISTRY.gauge(
+    "tidb_replica_durable_frames",
+    "shipped frames acked durable by one replica link (label replica)",
+)
+REPLICA_APPLIED_TS = REGISTRY.gauge(
+    "tidb_replica_applied_ts",
+    "newest commit_ts one replica link has applied (label replica)",
+)
+# outcome=acked: the median per-replica durable horizon covered the
+# commit (a majority of links acked); outcome=unreachable: too many
+# links broken for the quorum to ever form — the wait raised the typed
+# indeterminate shape (8150) instead of blocking forever
+REPLICA_QUORUM = REGISTRY.counter(
+    "tidb_replica_quorum_commits_total",
+    "semi-sync QUORUM commit waits by outcome (acked | unreachable)",
+)
+# outcome=follower: a lag-eligible replica served the read;
+# fallback_stale: replicas exist but every one was too stale/ineligible;
+# fallback_none: no in-process replica links at all — both fallbacks
+# route the statement to the primary
+REPLICA_READS = REGISTRY.counter(
+    "tidb_replica_read_total",
+    "read-only statement routing by outcome (follower | fallback_stale | "
+    "fallback_none)",
+)
+REPLICA_REJOINS = REGISTRY.counter(
+    "tidb_replica_rejoin_total",
+    "ADMIN REJOIN attempts rebuilding a fenced old primary as a standby "
+    "(ok | failed)",
+)
+# a socket ship link reconnecting after a dropped connection (the
+# standby refuses wire-corrupted frames by dropping the connection, so
+# reason=peer_closed covers CRC refusals; reason=io_error is a local
+# socket fault) — bounded retries, then the link breaks for good
+SHIP_RECONNECTS = REGISTRY.counter(
+    "tidb_ship_reconnects_total",
+    "ship-link reconnect-with-resync attempts by reason (peer_closed | io_error)",
+)
 # online WAL media failover: on an IO failure a store with
 # tidb_wal_spare_dirs checkpoints onto a spare and resumes writes
 # (outcome=ok); a spare that fails the attempt counts outcome=failed and

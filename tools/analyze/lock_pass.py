@@ -26,7 +26,7 @@ from __future__ import annotations
 import ast
 import os
 
-from . import Finding, Module, Pass, dotted, load_toml
+from . import REPO, Finding, Module, Pass, dotted, load_toml
 
 _COMPOUND = (ast.If, ast.For, ast.While, ast.Try, ast.AsyncFor, ast.AsyncWith)
 
@@ -72,6 +72,7 @@ class LockDisciplinePass(Pass):
     def __init__(self, root: str | None = None, config: dict | None = None):
         if config is None:
             config = load_toml(os.path.join(os.path.dirname(__file__), "lock_order.toml"))
+        self.root = root or REPO
         self.locks = [_LockDecl(d) for d in config.get("lock", ())]
         self.guards = [_GuardDecl(d) for d in config.get("guarded", ())]
 
@@ -160,6 +161,55 @@ class LockDisciplinePass(Pass):
 
             visit(fn.body)
         return findings
+
+    # --- repo-level check: instrumented locks must carry a rank -------------
+
+    def finish(self, modules):
+        """Every lock the runtime detector wraps — the `_targets()`
+        tuples and retro-`_rewrap` calls in tools/analyze/lockwatch.py —
+        must have a declared rank in lock_order.toml. A wrapped-but-
+        undeclared name records edges the hierarchy says nothing about:
+        the static pass skips it entirely and the one source of truth
+        quietly stops being one (PR 17)."""
+        path = os.path.join(self.root, "tools", "analyze", "lockwatch.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except OSError:
+            return ()
+        wrapped: dict[str, int] = {}  # lock name → first line seen
+
+        def _const_str(node):
+            return node.value if (isinstance(node, ast.Constant)
+                                  and isinstance(node.value, str)) else None
+
+        for node in ast.walk(tree):
+            # (_Class, "attr", "name", is_cond) tuples inside _targets()
+            if isinstance(node, ast.Tuple) and len(node.elts) == 4:
+                name = _const_str(node.elts[2])
+                if name is not None and _const_str(node.elts[1]) is not None:
+                    wrapped.setdefault(name, node.lineno)
+            # inst._rewrap(obj, "attr", "name"[, is_cond]) retro-wraps
+            elif (isinstance(node, ast.Call)
+                  and getattr(node.func, "attr", "") == "_rewrap"
+                  and len(node.args) >= 3):
+                name = _const_str(node.args[2])
+                if name is not None:
+                    wrapped.setdefault(name, node.lineno)
+        declared = {l.name for l in self.locks}
+        rel = "tools/analyze/lockwatch.py"
+        return [
+            Finding(
+                self.name, rel, line,
+                f"lock `{name}` is wrapped by instrument_locks() but has "
+                f"no declared rank in lock_order.toml — the runtime "
+                f"detector records its edges while the static hierarchy "
+                f"ignores it; declare a [[lock]] entry (or stop wrapping)",
+                key=("<lockwatch>", name),
+            )
+            for name, line in sorted(wrapped.items())
+            if name not in declared
+        ]
 
     def _check_order(self, findings, mod, qual, st, decl, held):
         for h in held:

@@ -281,7 +281,12 @@ def _targets():
         (_wal.Wal, "_lock", "wal", False),
         (_wal.Wal, "_gc_cond", "wal.group", True),
         # PR 14: warm-standby shipping + online WAL failover
+        # (PR 17: WalShipper is ReplicaSet — same class object, one entry)
         (_ship.WalShipper, "_cond", "wal.ship", True),
+        # PR 17: follower-read router choose-and-bump lock (leaf-like:
+        # route() snapshots link state under wal.ship FIRST, releases,
+        # then scores under this lock — never nested)
+        (_ship.ReplicaRouter, "_lock", "replica.route", False),
         (_txn.Storage, "_standby_lock", "standby", False),
         (_txn.Storage, "_failover_lock", "storage.failover", False),
         # PR 16: delta-main compactor stats lock (leaf-like, rank 29)

@@ -45,6 +45,9 @@ _SCOPED_SYSVARS = {
     "tidb_timeline_ring_capacity", "tidb_backoff_budget_ms",
     "tidb_wal_recovery_mode", "tidb_wal_group_commit",
     "tidb_wal_semi_sync", "tidb_wal_spare_dirs",
+    # PR 17: follower reads (tidb_replica_read IS a reference sysvar, but
+    # this reproduction made it consumed — the routing contract needs docs)
+    "tidb_replica_read", "tidb_replica_read_max_lag_ms",
 }
 
 _UPDATE_METHODS = {"inc", "observe", "set", "add"}

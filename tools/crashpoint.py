@@ -107,6 +107,17 @@ CRASHPOINTS = {
     # row present with its latest value, no deleted row resurrected, no
     # GC'd version visible
     "compact/after-artifact-before-publish": 3,
+    # PR 17: die inside the QUORUM commit wait while only a MINORITY of
+    # the 3-standby fleet has the commit durable (acked==1 < need==2) —
+    # the client was never acked, so post-crash the commit may exist or
+    # not, but every commit that WAS acked must be durable on >= need
+    # standbys (losing any minority of the fleet loses no acked history)
+    "ship/quorum-partial-ack": 3,
+    # PR 17: die inside ADMIN REJOIN with the new primary's bumped-epoch
+    # snapshot durable in the old dir but the old divergent logs NOT yet
+    # unlinked — recovery of the old dir must boot from the NEW snapshot,
+    # ignore the stale epoch's logs, and come up as a consistent standby
+    "standby/rejoin-mid-truncate": 1,
 }
 
 ING_GROUP_ROWS = 5  # rows per bulk-ingest group (the ingest atomicity unit)
@@ -116,6 +127,14 @@ ING_GROUP_ROWS = 5  # rows per bulk-ingest group (the ingest atomicity unit)
 # and which get a spare WAL dir + an injected EIO to trigger rotation
 NEEDS_STANDBY = {"wal/ship-mid-frame"}
 NEEDS_SPARE = {"wal/rotate-after-checkpoint"}
+# PR 17: the quorum site runs THREE in-process standbys with
+# tidb_wal_semi_sync=QUORUM (need = majority = 2 of 3); the rejoin site
+# runs one standby semi-sync ON plus a child-side driver thread that
+# fences the primary, promotes the standby, and calls rejoin — the armed
+# site then kills the process inside the truncate window
+NEEDS_QUORUM = {"ship/quorum-partial-ack"}
+NEEDS_REJOIN = {"standby/rejoin-mid-truncate"}
+QUORUM_STANDBYS = 3
 # EIO trigger for the rotation site: fail the nth wal fsync
 ROTATE_EIO_NTH = 25
 
@@ -166,18 +185,27 @@ def _child_main(args) -> None:
         boot.execute(f"INSERT INTO t_idx VALUES {vals}")
     store.wal_sync()
 
+    standbys = []
+    ship = None
     if args.standby_dir:
-        # warm standby (PR 14): bootstrap from a snapshot of the running
-        # primary (subscribe-after-checkpoint), attach the in-process
-        # ship loop, and — for the acked⇒on-standby invariant — flip
-        # semi-sync so every printed ack means durable on BOTH dirs
+        # warm standby fleet (PR 14/17): bootstrap each dir from a
+        # snapshot of the running primary (subscribe-after-checkpoint),
+        # attach the in-process ship links, then flip the ack contract —
+        # ON (one standby must hold the commit durable before the ack)
+        # or QUORUM (a majority of the N links must)
         from tidb_tpu.storage.ship import WalShipper
 
         ship = WalShipper(store)
-        ship.bootstrap(args.standby_dir)
-        standby = Storage(data_dir=args.standby_dir, standby=True)
-        ship.attach(standby)
-        if args.semi_sync:
+        dirs = [args.standby_dir]
+        dirs += [d for d in (args.quorum_dirs or "").split(",") if d]
+        for d in dirs:
+            ship.bootstrap(d)
+            sb = Storage(data_dir=d, standby=True)
+            ship.attach(sb)
+            standbys.append(sb)
+        if args.quorum_dirs:
+            store.global_vars["tidb_wal_semi_sync"] = "QUORUM"
+        elif args.semi_sync:
             store.global_vars["tidb_wal_semi_sync"] = "ON"
     say("READY")
 
@@ -316,10 +344,33 @@ def _child_main(args) -> None:
                 k += 1  # never reuse ids of a maybe-half-committed round
                 time.sleep(0.02)
 
+    def rejoin_loop() -> None:
+        """Failover driver (PR 17, rejoin site only): after acks have
+        accumulated, fence the primary the way a real media degrade
+        would (writes stop acking), promote the standby, then pull the
+        fenced store back in as a standby — the armed
+        standby/rejoin-mid-truncate site fires inside
+        ReplicaSet.rejoin's truncate window and kills the process with
+        the new-epoch snapshot durable but the old logs still on disk."""
+        time.sleep(1.5)
+        try:
+            with store._failover_lock:
+                store._io_degraded = True
+                store._failover_disabled = True
+            ship.stop()
+            standbys[0].promote()
+            store.rejoin(standbys[0])
+            say("REJOINED")
+        except TiDBError as e:
+            say(f"ERR rejoin {type(e).__name__}")
+
+    workers = [dml_loop, txn_loop, ddl_loop, ckpt_loop, ingest_loop,
+               compact_loop]
+    if args.rejoin:
+        workers.append(rejoin_loop)
     threads = [
         threading.Thread(target=f, daemon=True, name=f.__name__)
-        for f in (dml_loop, txn_loop, ddl_loop, ckpt_loop, ingest_loop,
-                  compact_loop)
+        for f in workers
     ]
     for t in threads:
         t.start()
@@ -712,6 +763,214 @@ def _verify_spare_snapshot(spare_dir: str, acks: dict) -> None:
     store.wal.close()
 
 
+def _verify_quorum(standby_dirs: list[str], primary: dict, acks: dict,
+                   need: int) -> None:
+    """QUORUM-fleet check after the quorum-partial-ack crash: the child
+    died while some commit was durable on a MINORITY of links with the
+    client still unacked. Prove (a) every standby dir recovers and
+    promotes, (b) no standby is AHEAD of the primary's durable state,
+    and (c) every commit that WAS acked is fully visible on at least
+    `need` standbys — an ack sent on minority durability fails (c)."""
+    from tidb_tpu.errors import TiDBError, WalCorruptionError
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+
+    dml_cover = {i: 0 for i in acks["dml"]}
+    txn_cover = {g: 0 for g in acks["txn"]}
+    for d in standby_dirs:
+        try:
+            store = Storage(data_dir=d, standby=True)
+        except WalCorruptionError as e:
+            raise Violation(
+                f"standby {d} crash produced non-torn-tail damage: {e}"
+            ) from e
+        try:
+            store.promote()
+        except TiDBError as e:
+            raise Violation(f"standby {d} promotion failed: {e}") from e
+        s = Session(store)
+        try:
+            dml = {int(r[0]): int(r[1])
+                   for r in s.must_query("SELECT id, v FROM t_dml")}
+            txn_rows = s.must_query("SELECT id, g, total FROM t_txn")
+        except TiDBError as e:
+            raise Violation(f"standby {d} post-promote read failed: {e}") from e
+        groups: dict[int, int] = {}
+        for _id, g, total in txn_rows:
+            g = int(g)
+            if int(total) != TXN_GROUP_ROWS:
+                raise Violation(f"standby {d} txn group {g} row carries total={total}")
+            groups[g] = groups.get(g, 0) + 1
+        for i, v in sorted(dml.items()):
+            if primary["dml"].get(i) != v:
+                raise Violation(
+                    f"standby {d} AHEAD of primary durable state: t_dml row "
+                    f"{i}={v} has no identical durable row on the primary"
+                )
+        for g, cnt in sorted(groups.items()):
+            if cnt != TXN_GROUP_ROWS:
+                raise Violation(
+                    f"standby {d} txn group {g} is PARTIAL after promote "
+                    f"({cnt}/{TXN_GROUP_ROWS} rows)"
+                )
+            if primary["txn_groups"].get(g) != TXN_GROUP_ROWS:
+                raise Violation(
+                    f"standby {d} AHEAD of primary durable state: txn "
+                    f"group {g} is not durable on the primary"
+                )
+        for i in dml_cover:
+            if dml.get(i) == i * 3:
+                dml_cover[i] += 1
+        for g in txn_cover:
+            if groups.get(g) == TXN_GROUP_ROWS:
+                txn_cover[g] += 1
+        store.wal.close()
+    for i, c in sorted(dml_cover.items()):
+        if c < need:
+            raise Violation(
+                f"QUORUM-acked DML row {i} durable on only {c} of "
+                f"{len(standby_dirs)} standbys (need {need}) — the ack went "
+                f"out on minority durability"
+            )
+    for g, c in sorted(txn_cover.items()):
+        if c < need:
+            raise Violation(
+                f"QUORUM-acked txn group {g} durable on only {c} of "
+                f"{len(standby_dirs)} standbys (need {need}) — the ack went "
+                f"out on minority durability"
+            )
+
+
+def _verify_rejoin_truncate(data_dir: str, standby_dir: str, acks: dict) -> None:
+    """The rejoin-mid-truncate crash fires with the NEW primary's
+    bumped-epoch snapshot durable in the old dir but the old divergent
+    logs still on disk (the unlink never ran). Prove:
+
+      * the new primary's dir (the promoted standby) recovers, promotes
+        again, holds every acked commit (semi-sync ON: every ack meant
+        durable there), and accepts writes — the failover lost nothing;
+      * the OLD dir recovers from the NEW snapshot — the stale epoch's
+        logs must be ignored, not replayed over it — comes up as a
+        read-only standby, and already holds every acked commit (the
+        snapshot was cut from the new primary AFTER the failover)."""
+    from tidb_tpu.errors import StandbyReadOnly, TiDBError, WalCorruptionError
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.txn import Storage
+
+    def check_acked(store, who: str) -> None:
+        s = Session(store)
+        try:
+            dml = {int(r[0]): int(r[1])
+                   for r in s.must_query("SELECT id, v FROM t_dml")}
+            txn_rows = s.must_query("SELECT id, g, total FROM t_txn")
+        except TiDBError as e:
+            raise Violation(f"{who}: post-recovery read failed: {e}") from e
+        groups: dict[int, int] = {}
+        for _id, g, _t in txn_rows:
+            groups[int(g)] = groups.get(int(g), 0) + 1
+        for i in sorted(acks["dml"]):
+            if dml.get(i) != i * 3:
+                raise Violation(
+                    f"{who}: acked DML row {i} lost across the "
+                    f"promote→rejoin crash"
+                )
+        for g in sorted(acks["txn"]):
+            if groups.get(g) != TXN_GROUP_ROWS:
+                raise Violation(f"{who}: acked txn group {g} not fully visible")
+
+    try:
+        new_primary = Storage(data_dir=standby_dir, standby=True)
+    except WalCorruptionError as e:
+        raise Violation(f"new-primary dir damage is not a torn tail: {e}") from e
+    try:
+        new_primary.promote()
+    except TiDBError as e:
+        raise Violation(f"new-primary re-promotion failed: {e}") from e
+    check_acked(new_primary, "new primary")
+    t = new_primary.begin()
+    t.put(b"zz-rejoin-probe", b"1")
+    t.commit()
+
+    try:
+        old = Storage(data_dir=data_dir, standby=True)
+    except (WalCorruptionError, TiDBError) as e:
+        raise Violation(
+            f"old dir does not recover after rejoin-mid-truncate (the stale "
+            f"epoch's logs must be ignored under the new snapshot): {e}"
+        ) from e
+    check_acked(old, "rejoined old dir")
+    try:
+        t = old.begin()
+        t.put(b"zz-must-not-land", b"1")
+        t.commit()
+    except StandbyReadOnly:
+        pass
+    else:
+        raise Violation("rejoined old dir accepted a write while a standby")
+    if old.wal is not None:
+        old.wal.close()
+    new_primary.wal.close()
+
+
+def run_rejoin_soak(rounds: int, seed: int) -> tuple[bool, str]:
+    """Promote→rejoin→promote-again ping-pong in ONE process: two dirs
+    trade the primary role every round. Each round commits a batch of
+    semi-sync-acked inserts on the current primary, fences it (the way
+    a media degrade would), promotes the standby, rejoins the fenced
+    store as the new standby, and proves every acked row of EVERY past
+    round still reads back on the new primary. → (ok, detail)."""
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.ship import ReplicaSet
+    from tidb_tpu.storage.txn import Storage
+
+    workdir = tempfile.mkdtemp(prefix="rejoin-soak-")
+    primary = Storage(data_dir=os.path.join(workdir, "a"))
+    s = Session(primary)
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    ship = ReplicaSet(primary)
+    ship.bootstrap(os.path.join(workdir, "b"))
+    standby = Storage(data_dir=os.path.join(workdir, "b"), standby=True)
+    ship.attach(standby)
+    primary.global_vars["tidb_wal_semi_sync"] = "ON"
+    acked: dict[int, int] = {}
+    nid = 0
+    try:
+        for r in range(rounds):
+            s = Session(primary)
+            for _ in range(10):
+                s.execute(f"INSERT INTO t VALUES ({nid}, {nid * 3})")
+                acked[nid] = nid * 3  # semi-sync: ack ⇒ durable on standby
+                nid += 1
+            # fence → promote → heal: the fenced old primary re-enters
+            # the fleet as the standby of the store it used to feed
+            with primary._failover_lock:
+                primary._io_degraded = True
+                primary._failover_disabled = True
+            primary._shipper.stop()
+            standby.promote()
+            primary.rejoin(standby)
+            primary, standby = standby, primary
+            primary.global_vars["tidb_wal_semi_sync"] = "ON"
+            rows = {int(x[0]): int(x[1])
+                    for x in Session(primary).must_query("SELECT id, v FROM t")}
+            for i, v in sorted(acked.items()):
+                if rows.get(i) != v:
+                    return False, (
+                        f"round {r}: acked row {i} lost after promote/rejoin "
+                        f"[survivor dir kept: {workdir}]"
+                    )
+    except Exception as e:  # noqa: BLE001 — soak failure, not a crash
+        return False, (
+            f"soak error: {type(e).__name__}: {e} [survivor dir kept: {workdir}]"
+        )
+    finally:
+        sh = primary._shipper
+        if sh is not None:
+            sh.stop()
+    shutil.rmtree(workdir, ignore_errors=True)
+    return True, f"{rounds} promote→rejoin→promote rounds, {nid} acked rows, none lost"
+
+
 def run_round(
     crashpoint: str | None,
     seed: int,
@@ -728,10 +987,16 @@ def run_round(
     workdir = tempfile.mkdtemp(prefix="crashpoint-")
     data_dir = os.path.join(workdir, "data")
     cdc_path = os.path.join(workdir, "cdc.jsonl")
-    standby = standby or crashpoint in NEEDS_STANDBY
-    semi_sync = semi_sync or crashpoint in NEEDS_STANDBY
+    rejoin = crashpoint in NEEDS_REJOIN
+    quorum = crashpoint in NEEDS_QUORUM
+    standby = standby or crashpoint in NEEDS_STANDBY or quorum or rejoin
+    semi_sync = semi_sync or crashpoint in NEEDS_STANDBY or rejoin
     spare_dir = os.path.join(workdir, "spare") if crashpoint in NEEDS_SPARE else None
     standby_dir = os.path.join(workdir, "standby") if standby else None
+    quorum_dirs = [
+        os.path.join(workdir, f"standby{i}")
+        for i in range(2, QUORUM_STANDBYS + 1)
+    ] if quorum else []
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
         "--data-dir", data_dir, "--cdc", cdc_path,
@@ -741,6 +1006,10 @@ def run_round(
         cmd += ["--standby-dir", standby_dir]
         if semi_sync:
             cmd += ["--semi-sync"]
+    if quorum_dirs:
+        cmd += ["--quorum-dirs", ",".join(quorum_dirs)]
+    if rejoin:
+        cmd += ["--rejoin"]
     if spare_dir:
         cmd += ["--spare-dir", spare_dir]
     if crashpoint:
@@ -804,12 +1073,28 @@ def run_round(
             proc.kill()
 
     acks = _collect_acks(lines)
+    marker = ""
     try:
-        primary_state = _verify(data_dir, cdc_path, acks)
-        if standby_dir:
-            _verify_standby(standby_dir, primary_state, acks, semi_sync)
+        if rejoin:
+            # the old dir's state is the NEW primary's cut, not the old
+            # primary's own history — the full _verify battery (CDC,
+            # unacked-tail checks) doesn't apply; the dedicated checker
+            # proves both dirs across the failover instead
+            _verify_rejoin_truncate(data_dir, standby_dir, acks)
+            marker = " [rejoin truncate verified: both dirs]"
+        else:
+            primary_state = _verify(data_dir, cdc_path, acks)
+            if quorum_dirs:
+                dirs = [standby_dir] + quorum_dirs
+                _verify_quorum(dirs, primary_state, acks,
+                               need=(len(dirs) + 1) // 2)
+                marker = f" [quorum fleet verified: {len(dirs)} standbys]"
+            elif standby_dir:
+                _verify_standby(standby_dir, primary_state, acks, semi_sync)
+                marker = " [standby promoted+verified]"
         if spare_dir:
             _verify_spare_snapshot(spare_dir, acks)
+            marker += " [spare snapshot verified]"
     except Violation as e:
         # keep the survivor dir: it IS the evidence
         return False, f"INVARIANT VIOLATION: {e} [survivor dir kept: {workdir}]"
@@ -820,9 +1105,7 @@ def run_round(
     detail = (
         f"acks: dml={len(acks['dml'])} txn={len(acks['txn'])} "
         f"ddl={len(acks['ddl'])} ckpt={acks['ckpt']} ing={len(acks['ing'])} "
-        f"cmp={len(acks['cmp'])}"
-        + (" [standby promoted+verified]" if standby_dir else "")
-        + (" [spare snapshot verified]" if spare_dir else "")
+        f"cmp={len(acks['cmp'])}" + marker
     )
     return True, detail
 
@@ -836,6 +1119,11 @@ def main() -> int:
                     help="(child) run an in-process warm standby over this dir")
     ap.add_argument("--semi-sync", action="store_true",
                     help="(child) tidb_wal_semi_sync=ON: acks mean durable on both dirs")
+    ap.add_argument("--quorum-dirs", default=None,
+                    help="(child) extra standby dirs, comma-separated: the "
+                         "fleet runs tidb_wal_semi_sync=QUORUM")
+    ap.add_argument("--rejoin", action="store_true",
+                    help="(child) run the fence→promote→rejoin driver thread")
     ap.add_argument("--spare-dir", default=None,
                     help="(child) tidb_wal_spare_dirs for online WAL failover")
     ap.add_argument("--crashpoint", choices=sorted(CRASHPOINTS), default=None)
@@ -846,6 +1134,9 @@ def main() -> int:
     ap.add_argument("--failover-rounds", type=int, default=0,
                     help="random kill-primary→promote→verify rounds "
                          "(in-process standby, semi-sync ON)")
+    ap.add_argument("--rejoin-rounds", type=int, default=0,
+                    help="promote→rejoin→promote-again ping-pong rounds "
+                         "(single process, two dirs trading the primary role)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--keep", action="store_true", help="keep survivor dirs")
     ap.add_argument("--max-seconds", type=float, default=45.0)
@@ -867,9 +1158,9 @@ def main() -> int:
         plan.append((None, seed + 1000 + i, False))
     for i in range(args.failover_rounds):
         plan.append((None, seed + 2000 + i, True))
-    if not plan:
-        ap.error("nothing to do: pass --matrix, --crashpoint, --rounds N "
-                 "and/or --failover-rounds N")
+    if not plan and not args.rejoin_rounds:
+        ap.error("nothing to do: pass --matrix, --crashpoint, --rounds N, "
+                 "--failover-rounds N and/or --rejoin-rounds N")
 
     failures = 0
     t0 = time.time()
@@ -883,6 +1174,13 @@ def main() -> int:
         print(f"  [{i + 1}/{len(plan)}] {label}: {status} — {detail}", flush=True)
         if not ok:
             failures += 1
+    if args.rejoin_rounds:
+        ok, detail = run_rejoin_soak(args.rejoin_rounds, seed)
+        print(f"  rejoin-soak[{args.rejoin_rounds}]: "
+              f"{'ok' if ok else 'FAIL'} — {detail}", flush=True)
+        if not ok:
+            failures += 1
+        plan.append((None, seed, False))  # count it in the round total
     dt = time.time() - t0
     verdict = "green" if failures == 0 else f"{failures} FAILURE(S)"
     print(f"crash matrix: {verdict} ({len(plan)} round(s), {dt:.0f}s, seed={seed})")
