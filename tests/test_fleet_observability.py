@@ -1,0 +1,260 @@
+"""Fleet observability plane (PR 18): cross-node trace propagation
+(replica cop spans adopt into the primary statement trace over the real
+socket transport), the wal.fsync vs quorum.wait commit decomposition,
+the CLUSTER_* memtables (topology from link_states, bounded status-RPC
+fan-out with partial rows), the lag monitor's histograms, and the
+replication INSPECTION_RESULT rules."""
+
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.storage.ship import ReplicaSet, StandbyServer
+from tidb_tpu.storage.txn import Storage
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.failpoint import FP
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    FP.disable_all()
+
+
+def _mk_primary(tmp_path, name="primary"):
+    store = Storage(data_dir=str(tmp_path / name))
+    s = Session(store)
+    s.execute("SET tidb_enable_auto_analyze = OFF")
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    return store, s
+
+
+def _mk_fleet(tmp_path, n=2):
+    store, s = _mk_primary(tmp_path)
+    ship = ReplicaSet(store)
+    standbys = []
+    for i in range(n):
+        d = str(tmp_path / f"standby{i}")
+        ship.bootstrap(d)
+        sb = Storage(data_dir=d, standby=True)
+        ship.attach(sb)
+        standbys.append(sb)
+    return store, s, ship, standbys
+
+
+def _mk_socket_fleet(tmp_path):
+    """Primary + one standby wired over the REAL socket transport, with
+    the standby handed to the router (embedded socket fleet)."""
+    store, s = _mk_primary(tmp_path)
+    ship = ReplicaSet(store)
+    d = str(tmp_path / "standby0")
+    ship.bootstrap(d)
+    standby = Storage(data_dir=d, standby=True)
+    srv = StandbyServer(standby)
+    ship.attach_socket("127.0.0.1", srv.port, standby=standby)
+    return store, s, ship, standby, srv
+
+
+def _trace_rows(s):
+    return s.must_query(
+        "SELECT trace_id, operation, tags FROM information_schema.tidb_trace")
+
+
+class TestTracePropagation:
+    def test_replica_cop_spans_join_the_primary_trace(self, tmp_path):
+        store, s, ship, standby, srv = _mk_socket_fleet(tmp_path)
+        try:
+            s.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+            assert ship.wait_caught_up(10)
+            s.execute("SET tidb_replica_read = 'follower'")
+            s.execute("SET tidb_enable_trace = 'ON'")
+            served = M.REPLICA_READS.value_matching(outcome="follower")
+            assert s.must_query("SELECT COUNT(*) FROM t") == [("3",)]
+            s.execute("SET tidb_enable_trace = 'OFF'")
+            assert M.REPLICA_READS.value_matching(outcome="follower") > served
+            rows = _trace_rows(s)
+            # the replica-side cop span carries the serving replica's
+            # name AND the primary statement's trace id — one trace,
+            # two nodes
+            cop = [(tid, tags) for tid, op, tags in rows
+                   if op == "cop.task" and "replica=127.0.0.1:" in tags]
+            assert cop, rows
+            roots = {tid for tid, op, _ in rows if op == "session.execute"}
+            assert cop[0][0] in roots
+            # the routing decision itself is a span: outcome + replica
+            route = [tags for tid, op, tags in rows
+                     if op == "replica.route" and tid == cop[0][0]]
+            assert route and "outcome=follower" in route[0], rows
+        finally:
+            ship.stop()
+            srv.close()
+
+    def test_propagation_off_keeps_spans_untagged(self, tmp_path):
+        store, s, ship, standby, srv = _mk_socket_fleet(tmp_path)
+        try:
+            s.execute("INSERT INTO t VALUES (1, 10)")
+            assert ship.wait_caught_up(10)
+            s.execute("SET tidb_replica_read = 'follower'")
+            s.execute("SET tidb_enable_trace_propagation = 'OFF'")
+            s.execute("SET tidb_enable_trace = 'ON'")
+            served = M.REPLICA_READS.value_matching(outcome="follower")
+            s.must_query("SELECT COUNT(*) FROM t")
+            s.execute("SET tidb_enable_trace = 'OFF'")
+            # the read still routes to the follower; only the trace
+            # adoption is off
+            assert M.REPLICA_READS.value_matching(outcome="follower") > served
+            assert not any("replica=" in tags for _, op, tags in _trace_rows(s)
+                           if op == "cop.task")
+        finally:
+            ship.stop()
+            srv.close()
+
+    def test_in_txn_reads_fall_back_with_reason(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=1)
+        try:
+            s.execute("INSERT INTO t VALUES (1, 10)")
+            assert ship.wait_caught_up(10)
+            s.execute("SET tidb_replica_read = 'follower'")
+            before = M.REPLICA_READS.value(outcome="fallback_stale",
+                                           reason="in_txn")
+            s.execute("BEGIN")
+            s.must_query("SELECT COUNT(*) FROM t")
+            s.execute("COMMIT")
+            assert M.REPLICA_READS.value(
+                outcome="fallback_stale", reason="in_txn") > before
+        finally:
+            ship.stop()
+
+
+class TestQuorumDecomposition:
+    def test_commit_splits_into_fsync_and_quorum_wait(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=3)
+        try:
+            s.execute("SET GLOBAL tidb_wal_semi_sync = 'QUORUM'")
+            s.execute("SET tidb_enable_trace = 'ON'")
+            s.execute("SET tidb_slow_log_threshold = 0")
+            s.execute("INSERT INTO t VALUES (1, 10)")
+            s.execute("SET tidb_enable_trace = 'OFF'")
+            s.execute("SET tidb_slow_log_threshold = 300")
+            rows = _trace_rows(s)
+            ops = {op for _, op, _ in rows}
+            assert "wal.fsync" in ops and "quorum.wait" in ops, ops
+            qtags = next(tags for _, op, tags in rows if op == "quorum.wait")
+            # per-link ack offsets ride the span: name:+N.Nms (or :pre)
+            assert "mode=QUORUM" in qtags and "acks=" in qtags, qtags
+            # the same decomposition lands in the slow log + summary
+            slow = s.must_query(
+                "SELECT QUORUM_WAIT_MS FROM information_schema.slow_query "
+                "WHERE QUERY LIKE 'INSERT INTO t VALUES (1, 10)%'")
+            assert slow and float(slow[0][0]) >= 0.0
+            summ = s.must_query(
+                "SELECT SUM_QUORUM_WAIT_MS FROM "
+                "information_schema.statements_summary "
+                "WHERE DIGEST_TEXT LIKE 'INSERT INTO%'")
+            assert summ
+        finally:
+            ship.stop()
+
+
+class TestClusterMemtables:
+    def test_cluster_replication_tracks_a_kill(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=3)
+        try:
+            s.execute("INSERT INTO t VALUES (1, 10)")
+            assert ship.wait_caught_up(10)
+            rows = s.must_query(
+                "SELECT NODE, ROLE, STATE, BROKEN_REASON "
+                "FROM information_schema.cluster_replication")
+            assert len(rows) == 4  # self + 3 links
+            assert rows[0][:3] == ("self", "primary", "live")
+            assert all(st == "live" for _, _, st, _ in rows[1:])
+            ship._break_link(ship._links[1], RuntimeError("standby killed"))
+            ship.monitor_tick()  # one tick is enough — no sleep needed
+            rows = s.must_query(
+                "SELECT NODE, STATE, BROKEN_REASON "
+                "FROM information_schema.cluster_replication "
+                "WHERE STATE = 'broken'")
+            assert len(rows) == 1
+            assert "standby killed" in rows[0][2]
+        finally:
+            ship.stop()
+
+    def test_fanout_returns_partial_rows_for_a_dead_member(self, tmp_path):
+        store, s, ship, standby, srv = _mk_socket_fleet(tmp_path)
+        try:
+            # second member lives in-process and stays healthy
+            d = str(tmp_path / "standby1")
+            ship.bootstrap(d)
+            ship.attach(Storage(data_dir=d, standby=True))
+            s.execute("INSERT INTO t VALUES (1, 10)")
+            assert ship.wait_caught_up(10)
+            # kill the socket member's server: its status RPC now fails
+            # fast, the healthy members still answer (partial rows)
+            srv.close()
+            # route_standby must not mask the death of the far side
+            with ship._cond:
+                ship._links[0].route_standby = None
+            t0 = time.perf_counter()
+            rows = s.must_query(
+                "SELECT DISTINCT NODE, ERROR "
+                "FROM information_schema.cluster_metrics")
+            elapsed = time.perf_counter() - t0
+            assert elapsed < ship.STATUS_TIMEOUT_S + 4.0
+            by_node = {}
+            for node, err in rows:
+                by_node.setdefault(node, set()).add(err)
+            assert "primary" in by_node and "standby1" in by_node
+            dead = by_node[f"127.0.0.1:{srv.port}"]
+            assert any(e for e in dead), rows  # the error column names it
+            assert "" in by_node["primary"]
+            stmts = s.must_query(
+                "SELECT DISTINCT NODE FROM "
+                "information_schema.cluster_statements_summary")
+            assert ("primary",) in stmts
+        finally:
+            ship.stop()
+            srv.close()
+
+
+class TestLagMonitorAndInspection:
+    def test_monitor_tick_feeds_the_lag_histogram(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=2)
+        try:
+            s.execute("INSERT INTO t VALUES (1, 10)")
+            assert ship.wait_caught_up(10)
+            ship.monitor_tick()
+            rows = {(n, lbl): v for n, lbl, v in M.REGISTRY.rows()}
+            counts = [(n, lbl) for (n, lbl) in rows
+                      if n == "tidb_replica_lag_seconds_count" and lbl]
+            assert len(counts) >= 2, sorted(rows)
+            # the ack-latency histogram fills from the ship loop itself
+            assert any(n == "tidb_replica_ack_seconds_count" and v > 0
+                       for (n, _), v in rows.items())
+        finally:
+            ship.stop()
+
+    def test_inspection_rules_fire_on_break_lag_and_quorum_risk(self, tmp_path):
+        store, s, ship, standbys = _mk_fleet(tmp_path, n=3)
+        try:
+            s.execute("INSERT INTO t VALUES (1, 10)")
+            assert ship.wait_caught_up(10)
+            rules = s.must_query(
+                "SELECT RULE, ITEM FROM information_schema.inspection_result "
+                "WHERE RULE = 'replication'")
+            assert rules == []  # healthy fleet: no replication findings
+            ship._break_link(ship._links[0], RuntimeError("standby killed"))
+            with ship._cond:  # pin one survivor far behind the high-water
+                ship._links[1].applied_ts = 1
+            rows = s.must_query(
+                "SELECT ITEM, SEVERITY FROM "
+                "information_schema.inspection_result "
+                "WHERE RULE = 'replication'")
+            items = {it: sev for it, sev in rows}
+            assert any(k.startswith("broken-link:") for k in items)
+            assert any(k.startswith("lagging-replica:") for k in items)
+            # 2 of 3 live == ceil(3/2): the quorum holds by exactly one
+            assert items.get("quorum-at-risk") == "warning"
+            assert all(sev in ("critical", "warning") for sev in items.values())
+        finally:
+            ship.stop()

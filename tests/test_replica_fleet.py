@@ -190,14 +190,14 @@ class TestStalenessBounds:
             cuts.append(_dt(time.time()))
             time.sleep(0.005)
         assert ship.wait_caught_up(10)
-        served = M.REPLICA_READS.value(outcome="follower")
+        served = M.REPLICA_READS.value_matching(outcome="follower")
         for rep in range(2):  # second pass re-reads through warm caches
             for i, cut in enumerate(cuts):
                 ids = [int(r[0]) for r in s.must_query(
                     f"SELECT id FROM t AS OF TIMESTAMP '{cut}' ORDER BY id")]
                 assert ids == list(range(i + 1)), (rep, i, cut, ids)
         # the battery must actually exercise followers, not fall back
-        assert M.REPLICA_READS.value(outcome="follower") > served
+        assert M.REPLICA_READS.value_matching(outcome="follower") > served
         ship.stop()
 
     def test_as_of_beyond_watermark_falls_back_to_primary(self, tmp_path):
@@ -208,11 +208,11 @@ class TestStalenessBounds:
         # them could miss acked commits <= t, so the primary serves
         cut = _dt(time.time() + 0.05)
         time.sleep(0.06)
-        before = M.REPLICA_READS.value(outcome="fallback_stale")
+        before = M.REPLICA_READS.value_matching(outcome="fallback_stale")
         ids = [int(r[0]) for r in s.must_query(
             f"SELECT id FROM t AS OF TIMESTAMP '{cut}' ORDER BY id")]
         assert ids == [1]
-        assert M.REPLICA_READS.value(outcome="fallback_stale") > before
+        assert M.REPLICA_READS.value_matching(outcome="fallback_stale") > before
         ship.stop()
 
     def test_over_lagged_replica_skipped(self, tmp_path):
@@ -222,13 +222,13 @@ class TestStalenessBounds:
         s.execute("SET tidb_replica_read = 'follower'")
         s.execute("SET tidb_replica_read_max_lag_ms = 50")
         time.sleep(0.2)  # idle: applied-ts lag grows past the bound
-        stale = M.REPLICA_READS.value(outcome="fallback_stale")
+        stale = M.REPLICA_READS.value_matching(outcome="fallback_stale")
         assert _ids(s) == [1]  # primary fallback, results exact
-        assert M.REPLICA_READS.value(outcome="fallback_stale") > stale
+        assert M.REPLICA_READS.value_matching(outcome="fallback_stale") > stale
         s.execute("SET tidb_replica_read_max_lag_ms = 600000")
-        served = M.REPLICA_READS.value(outcome="follower")
+        served = M.REPLICA_READS.value_matching(outcome="follower")
         assert _ids(s) == [1]
-        assert M.REPLICA_READS.value(outcome="follower") > served
+        assert M.REPLICA_READS.value_matching(outcome="follower") > served
         ship.stop()
 
     def test_kill_replica_chaos_mid_read(self, tmp_path):
@@ -361,13 +361,13 @@ class TestRouterSQL:
         s.execute("INSERT INTO t VALUES (1, 10)")
         assert ship.wait_caught_up(10)
         s.execute("SET tidb_replica_read = 'follower'")
-        served = M.REPLICA_READS.value(outcome="follower")
+        served = M.REPLICA_READS.value_matching(outcome="follower")
         assert _ids(s) == [1]
-        assert M.REPLICA_READS.value(outcome="follower") > served
+        assert M.REPLICA_READS.value_matching(outcome="follower") > served
         s.execute("SET tidb_replica_read = 'leader'")
-        served = M.REPLICA_READS.value(outcome="follower")
+        served = M.REPLICA_READS.value_matching(outcome="follower")
         assert _ids(s) == [1]
-        assert M.REPLICA_READS.value(outcome="follower") == served
+        assert M.REPLICA_READS.value_matching(outcome="follower") == served
         ship.stop()
 
     def test_in_txn_reads_pin_to_the_primary(self, tmp_path):
@@ -376,8 +376,8 @@ class TestRouterSQL:
         assert ship.wait_caught_up(10)
         s.execute("SET tidb_replica_read = 'follower'")
         s.execute("BEGIN")
-        served = M.REPLICA_READS.value(outcome="follower")
+        served = M.REPLICA_READS.value_matching(outcome="follower")
         assert _ids(s) == [1]
-        assert M.REPLICA_READS.value(outcome="follower") == served
+        assert M.REPLICA_READS.value_matching(outcome="follower") == served
         s.execute("COMMIT")
         ship.stop()

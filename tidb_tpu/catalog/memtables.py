@@ -24,20 +24,26 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         ["TIME", "USER", "DB", "QUERY_TIME", "DIGEST", "SUCC", "QUERY",
          # cop-path exec details (PR 3): admission wait, launch batching,
          # retries/backoff, device compile + host<->device transfer;
-         # (PR 4): peak tracked statement memory
+         # (PR 4): peak tracked statement memory; (PR 18): the serving
+         # replica of a follower-routed read and the commit's
+         # replication-wait share (wal.fsync vs quorum.wait split)
          "SCHED_WAIT", "BATCH_OCCUPANCY", "RETRIES", "BACKOFF_MS",
-         "COMPILE_MS", "TRANSFER_BYTES", "MEM_MAX"],
+         "COMPILE_MS", "TRANSFER_BYTES", "MEM_MAX", "REPLICA",
+         "QUORUM_WAIT_MS"],
         [ft_varchar(32), ft_varchar(32), ft_varchar(64), ft_double(), ft_varchar(32), ft_longlong(), ft_varchar(512),
          ft_double(), ft_longlong(), ft_longlong(), ft_double(),
-         ft_double(), ft_longlong(), ft_longlong()],
+         ft_double(), ft_longlong(), ft_longlong(), ft_varchar(64),
+         ft_double()],
     ),
     "statements_summary": (
         ["DIGEST", "EXEC_COUNT", "SUM_LATENCY", "MAX_LATENCY", "AVG_LATENCY", "ERRORS", "DIGEST_TEXT",
          "SUM_SCHED_WAIT", "MAX_BATCH_OCCUPANCY", "SUM_RETRIES",
-         "SUM_BACKOFF_MS", "SUM_COMPILE_MS", "SUM_TRANSFER_BYTES", "MAX_MEM"],
+         "SUM_BACKOFF_MS", "SUM_COMPILE_MS", "SUM_TRANSFER_BYTES", "MAX_MEM",
+         "SUM_QUORUM_WAIT_MS", "REPLICA_READS"],
         [ft_varchar(32), ft_longlong(), ft_double(), ft_double(), ft_double(), ft_longlong(), ft_varchar(256),
          ft_double(), ft_longlong(), ft_longlong(),
-         ft_double(), ft_double(), ft_longlong(), ft_longlong()],
+         ft_double(), ft_double(), ft_longlong(), ft_longlong(),
+         ft_double(), ft_longlong()],
     ),
     # --- PR 4: runaway control + server memory arbitration ----------------
     "runaway_watches": (
@@ -115,6 +121,36 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         [ft_varchar(16), ft_varchar(64), ft_varchar(32), ft_varchar(40),
          ft_varchar(32), ft_varchar(32)],
     ),
+    # --- PR 18: fleet observability plane ---------------------------------
+    "cluster_replication": (
+        # one row for this store plus one per replication link
+        # (ReplicaSet.link_states): transport, durable/applied horizons,
+        # apply staleness (wall clock minus the applied watermark — the
+        # router's follower-eligibility measure), reconnect count, and
+        # the typed broken reason
+        ["NODE", "ROLE", "TRANSPORT", "EPOCH", "DURABLE_FRAMES",
+         "APPLIED_TS", "LAG_MS", "RECONNECTS", "STATE", "BROKEN_REASON"],
+        [ft_varchar(64), ft_varchar(16), ft_varchar(16), ft_longlong(),
+         ft_longlong(), ft_longlong(), ft_double(), ft_longlong(),
+         ft_varchar(16), ft_varchar(256)],
+    ),
+    "cluster_metrics": (
+        # the METRICS memtable federated over every fleet member via the
+        # ship status RPC; a dead member contributes one ERROR row
+        # (partial results inside the timeout bound, never a hang)
+        ["NODE", "NAME", "LABELS", "VALUE", "ERROR"],
+        [ft_varchar(64), ft_varchar(64), ft_varchar(128), ft_double(),
+         ft_varchar(256)],
+    ),
+    "cluster_statements_summary": (
+        # STATEMENTS_SUMMARY federated the same way (per-node digests:
+        # follower-served statements execute — and are recorded — on the
+        # replica, so fleet-wide analysis needs the fan-out)
+        ["NODE", "DIGEST", "EXEC_COUNT", "SUM_LATENCY", "ERRORS",
+         "SAMPLE_SQL", "ERROR"],
+        [ft_varchar(64), ft_varchar(32), ft_longlong(), ft_double(),
+         ft_longlong(), ft_varchar(256), ft_varchar(256)],
+    ),
     "views": (
         ["TABLE_SCHEMA", "TABLE_NAME", "VIEW_DEFINITION"],
         [ft_varchar(64), ft_varchar(64), ft_varchar(1024)],
@@ -191,6 +227,8 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.f(e.get("compile_ms", 0.0)),
                 Datum.i(int(e.get("transfer_bytes", 0))),
                 Datum.i(int(e.get("mem_bytes", 0))),
+                Datum.s(e.get("replica", "")),
+                Datum.f(e.get("quorum_wait_ms", 0.0)),
             ])
         return out
     if name == "statements_summary":
@@ -211,6 +249,8 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 Datum.f(st.get("sum_compile_ms", 0.0)),
                 Datum.i(int(st.get("sum_transfer_bytes", 0))),
                 Datum.i(int(st.get("max_mem_bytes", 0))),
+                Datum.f(st.get("sum_quorum_wait_ms", 0.0)),
+                Datum.i(int(st.get("replica_reads", 0))),
             ])
         return out
     if name == "tidb_trace":
@@ -382,6 +422,12 @@ def rows_for(session, name: str) -> list[list[Datum]]:
         return _cpu_profile_rows(session)
     if name == "inspection_result":
         return _inspection_rows(session)
+    if name == "cluster_replication":
+        return _cluster_replication_rows(session)
+    if name == "cluster_metrics":
+        return _cluster_fanout_rows(session, "metrics")
+    if name == "cluster_statements_summary":
+        return _cluster_fanout_rows(session, "statements")
     if name == "cluster_info":
         import time as _time
 
@@ -404,6 +450,80 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 ])
         return out
     raise KeyError(name)
+
+
+def _cluster_replication_rows(session) -> list:
+    """One row for this store plus one per ship link — the fleet
+    topology as SQL (ref: the reference's TIKV_STORE_STATUS /
+    cluster-memtable shape over PD state; here the ReplicaSet IS the
+    topology authority)."""
+    store = session.store
+    out = [[
+        Datum.s("self"),
+        Datum.s("standby" if store.standby else "primary"),
+        Datum.s("-"),
+        Datum.i(int(getattr(store, "_wal_epoch", 0) or 0)),
+        Datum.i(int(getattr(store, "_applied_frames", 0))),
+        Datum.i(int(store.applied_ts)),
+        Datum.f(0.0), Datum.i(0), Datum.s("live"), Datum.s(""),
+    ]]
+    sh = getattr(store, "_shipper", None)
+    if sh is not None:
+        for s in sh.link_states():
+            out.append([
+                Datum.s(s["name"]), Datum.s("standby"),
+                Datum.s(s.get("transport", "?")),
+                Datum.i(-1),  # a link doesn't know the far side's epoch
+                Datum.i(int(s["durable_gseq"] - s["base_gseq"])),
+                Datum.i(int(s["applied_ts"])),
+                Datum.f(float(s.get("lag_ms", 0.0))),
+                Datum.i(int(s["reconnects"])),
+                Datum.s("broken" if s["broken"] else "live"),
+                Datum.s(s.get("reason", "")[:256]),
+            ])
+    return out
+
+
+def _cluster_fanout_rows(session, kind: str) -> list:
+    """CLUSTER_METRICS / CLUSTER_STATEMENTS_SUMMARY federation: the
+    primary answers directly, in-process members are read directly,
+    socket members over the ship status RPC — each bounded by the
+    per-member timeout, so a dead node yields one row with ERROR set
+    (partial results, never a hang)."""
+    sh = getattr(session.store, "_shipper", None)
+    if sh is None:
+        from ..storage.ship import node_status
+
+        statuses = [node_status(session.store, name="primary")]
+    else:
+        statuses = sh.fleet_statuses()
+    rows: list = []
+    for st in statuses:
+        node = str(st.get("name", "?"))
+        err = str(st.get("error", ""))
+        if err:
+            if kind == "metrics":
+                rows.append([Datum.s(node), Datum.null(), Datum.null(),
+                             Datum.null(), Datum.s(err[:256])])
+            else:
+                rows.append([Datum.s(node), Datum.null(), Datum.null(),
+                             Datum.null(), Datum.null(), Datum.null(),
+                             Datum.s(err[:256])])
+            continue
+        if kind == "metrics":
+            for n, lbl, v in st.get("metrics", []):
+                rows.append([Datum.s(node), Datum.s(n), Datum.s(lbl),
+                             Datum.f(float(v)), Datum.s("")])
+        else:
+            for e in st.get("statements", []):
+                rows.append([
+                    Datum.s(node), Datum.s(str(e["digest"])),
+                    Datum.i(int(e["exec_count"])),
+                    Datum.f(float(e["sum_latency_s"])),
+                    Datum.i(int(e["errors"])),
+                    Datum.s(str(e["sample_sql"])), Datum.s(""),
+                ])
+    return rows
 
 
 def _inspection_rows(session) -> list:
@@ -454,6 +574,38 @@ def _inspection_rows(session) -> list:
             "tables past the modify ratio: " + ",".join(sorted(pending)[:8]))
     nregions = len(session.store.regions.regions)
     add("region", "count", nregions, "-", "info", "regions in the keyspace map")
+    # --- fleet SLO rules (PR 18): read the lag monitor's inputs ------------
+    sh = getattr(session.store, "_shipper", None)
+    if sh is not None:
+        states = sh.link_states()
+        max_lag = float(
+            session.store.global_vars.get("tidb_replica_read_max_lag_ms", 5000)
+            or 0
+        )
+        live = 0
+        for s in states:
+            if s["broken"]:
+                add("replication", f"broken-link:{s['name']}", "broken",
+                    "live", "critical",
+                    f"ship link is down ({s.get('reason', '')[:180]}); "
+                    f"reconnects={s['reconnects']}")
+                continue
+            live += 1
+            if s.get("lag_ms", 0.0) > max_lag:
+                add("replication", f"lagging-replica:{s['name']}",
+                    f"{s['lag_ms']:.0f}ms", f"<={max_lag:.0f}ms", "warning",
+                    "apply lag exceeds tidb_replica_read_max_lag_ms — "
+                    "follower reads fall back to the primary "
+                    "(tidb_replica_lag_seconds)")
+        n = len(states)
+        need = (n + 1) // 2
+        if n and live == need:
+            # one more loss and QUORUM commits raise 8150: surface the
+            # at-risk state BEFORE it becomes an outage
+            add("replication", "quorum-at-risk", f"{live}/{n} live",
+                f">{need} live", "warning",
+                "live links equal the quorum minimum ceil(N/2) — a single "
+                "further loss makes semi-sync QUORUM commits unreachable")
     return rows
 
 

@@ -128,6 +128,12 @@ class CopClient:
         self._pool = None
         self._lock = Lock()  # guards lazy singletons + stats counters
         self._ndv_cache: dict = {}  # (dag digest, batch version) → (est,)
+        # cross-node trace propagation (PR 18): when the session routed
+        # a statement to this replica-side cop, its cop.task spans carry
+        # the serving replica's name so they adopt into the PRIMARY
+        # statement trace attributed (set per statement by the router
+        # gate, None on the primary's own cop)
+        self.replica_name: str | None = None
         self.stats = {
             "tasks": 0,
             "tpu_tasks": 0,
@@ -420,8 +426,14 @@ class CopClient:
             bo.abort = abort
         trace = getattr(sctx, "trace", None) if sctx is not None else None
         mem = getattr(sctx, "mem", None) if sctx is not None else None
+        # replica-tagged span: a follower-routed statement's cop tasks
+        # (and their device-phase children) adopt into the primary trace
+        # attributed to the serving node
+        tags = {"region": t.region_id}
+        if self.replica_name:
+            tags["replica"] = self.replica_name
         with tracing.activate(trace), memory.bind(mem), (
-            trace.span("cop.task", region=t.region_id) if trace is not None else tracing._NOOP
+            trace.span("cop.task", **tags) if trace is not None else tracing._NOOP
         ):
             return self._run_task_traced(table, dag, t, read_ts, engine, bo, cache, sctx, st)
 

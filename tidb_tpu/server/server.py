@@ -399,6 +399,19 @@ class Server:
                     # (ui.perfetto.dev) or chrome://tracing
                     body = json.dumps(server.storage.timeline.chrome_trace()).encode()
                     ctype = "application/json"
+                elif self.path == "/debug/fleet" or self.path.startswith("/debug/fleet?"):
+                    # replica-fleet topology: per-link ship state plus the
+                    # bounded status fan-out (detail=False — the bulky
+                    # metrics/statements payloads stay on the CLUSTER_*
+                    # memtables; dead members show as {"name", "error"})
+                    sh = getattr(server.storage, "_shipper", None)
+                    body = json.dumps({
+                        "role": "standby" if server.storage.standby else "primary",
+                        "links": sh.link_states() if sh is not None else [],
+                        "members": (sh.fleet_statuses(detail=False)
+                                    if sh is not None else []),
+                    }).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/stats/dump/"):
                     # /stats/dump/{db}/{table} (ref: statistics_handler.go)
                     parts = self.path.split("/")

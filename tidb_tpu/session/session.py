@@ -373,6 +373,26 @@ class Session:
             self._replica_cops[id(store)] = c
         return c
 
+    def _note_route(self, decision: dict) -> bool:
+        """Stamp one follower-routing decision onto the statement: the
+        serving replica's name feeds the slow-log REPLICA column and the
+        EXPLAIN ANALYZE `replica:` line, and (when span recording is on)
+        the outcome/reason pair lands in the trace so every routing
+        decision is explainable per statement. Returns whether replica
+        span propagation is enabled (tidb_enable_trace_propagation)."""
+        prop = self.vars.get("tidb_enable_trace_propagation", "ON") == "ON"
+        self._route_replica = decision.get("replica") or None
+        tracer = self._tracer
+        if tracer is not None and prop:
+            tracer.closed_span(
+                "replica.route", 0.0,
+                outcome=decision.get("outcome", ""),
+                reason=decision.get("reason", ""),
+                replica=decision.get("replica", "") or "-",
+                lag_ms=decision.get("lag_ms", 0.0),
+            )
+        return prop
+
     # ---------------------------------------------------------------- execute
 
     def execute(self, sql: str) -> ResultSet:
@@ -482,6 +502,8 @@ class Session:
         self._stmt_vars = {}
         prev_runaway = getattr(self, "_runaway", None)
         self._runaway = None
+        prev_route = getattr(self, "_route_replica", None)
+        self._route_replica = None  # serving replica (slow-log REPLICA col)
         if not self._in_bootstrap:
             from ..utils.tracing import StatementTrace
 
@@ -601,6 +623,8 @@ class Session:
             self._tracer = prev_tracer
             self._stmt_vars = prev_stmt_vars
             self._runaway = prev_runaway
+            route_replica = getattr(self, "_route_replica", None)
+            self._route_replica = prev_route
             if not self._in_bootstrap:
                 self.store.clear_process(self.conn_id)
                 self.store.plugins.fire("on_query", self.user, self.current_db, sql, ok, dur)
@@ -633,6 +657,8 @@ class Session:
                         tracer.set_max("mem_bytes", float(tracker.max_consumed))
                     tracer.finish(ok=ok)
                     details = tracer.details()
+                    if route_replica:
+                        details["replica"] = route_replica
                     if tracer.recording:
                         if isinstance(stmt, (ast.CreateUser, ast.Grant, ast.SetStmt)):
                             tracer.sql = log_sql
@@ -1844,17 +1870,36 @@ class Session:
         cop = self.cop
         route_store = None
         router = None
-        if top_level and self.txn is None and not self.store.standby:
+        if top_level and not self.store.standby:
             sh = getattr(self.store, "_shipper", None)
             rr = str(exec_vars.get("tidb_replica_read", "leader")).lower()
-            if sh is not None and (
+            wants_follower = sh is not None and (
                 as_of is not None or rr in ("follower", "leader-and-follower")
-            ):
+            )
+            if wants_follower and self.txn is not None:
+                # follower read requested inside an open txn: routing
+                # would miss the txn's own uncommitted writes, so the
+                # primary serves — counted with its reason like every
+                # other fallback (the PR 8 taxonomy)
+                from ..utils import metrics as M
+
+                M.REPLICA_READS.inc(outcome="fallback_stale", reason="in_txn")
+                self._note_route({"outcome": "fallback_stale",
+                                  "reason": "in_txn", "replica": "",
+                                  "lag_ms": 0.0})
+            elif wants_follower:
                 max_lag = int(exec_vars.get("tidb_replica_read_max_lag_ms", 5000) or 0)
                 router = sh.router
-                route_store = router.route(as_of_ts=read_ts, max_lag_ms=max_lag)
+                decision: dict = {}
+                route_store = router.route(as_of_ts=read_ts, max_lag_ms=max_lag,
+                                           decision=decision)
+                prop = self._note_route(decision)
                 if route_store is not None:
                     cop = self._replica_cop(route_store)
+                    # cross-node trace propagation: the replica-side cop
+                    # tags its spans with the serving replica so they
+                    # adopt into THIS statement's trace attributed
+                    cop.replica_name = decision.get("replica") if prop else None
                     if read_ts is None:
                         # bounded-staleness read at the replica's applied
                         # watermark: everything the replica has is visible,
@@ -4010,22 +4055,47 @@ class Session:
         (ref: executor/explain.go EXPLAIN ANALYZE; util/execdetails)."""
         from ..executor.runtime_stats import attach_runtime_stats, render_tree
 
+        # follower routing applies exactly as the bare statement's gate
+        # would route it, so the `replica:` line reports the serving
+        # node the real execution would use
+        cop = self.cop
+        route_store = router = None
+        decision: dict | None = None
+        read_ts = self.read_ts()
+        sh = getattr(self.store, "_shipper", None)
+        rr = str(self.vars.get("tidb_replica_read", "leader")).lower()
+        if (self.txn is None and not self.store.standby and sh is not None
+                and rr in ("follower", "leader-and-follower")):
+            decision = {}
+            router = sh.router
+            max_lag = int(self.vars.get("tidb_replica_read_max_lag_ms", 5000) or 0)
+            route_store = router.route(as_of_ts=None, max_lag_ms=max_lag,
+                                       decision=decision)
+            prop = self._note_route(decision)
+            if route_store is not None:
+                cop = self._replica_cop(route_store)
+                cop.replica_name = decision.get("replica") if prop else None
+                read_ts = route_store.applied_ts
         ctx = ExecContext(
-            self.cop,
-            self.read_ts(),
+            cop,
+            read_ts,
             engine=self.vars.get("tidb_cop_engine", "auto"),
             vars=self.vars,
             txn=self.txn,
         )
-        before = dict(self.cop.stats)
-        tpu0 = (self.cop.tpu.compile_count, self.cop.tpu.fallbacks) if self.cop._tpu else (0, 0)
+        before = dict(cop.stats)
+        tpu0 = (cop.tpu.compile_count, cop.tpu.fallbacks) if cop._tpu else (0, 0)
         ex = build_executor(plan, ctx)
         stats = attach_runtime_stats(ex)
         t0 = time.perf_counter_ns()
-        drain(ex)
+        try:
+            drain(ex)
+        finally:
+            if route_store is not None:
+                router.release(route_store)
         wall_ms = (time.perf_counter_ns() - t0) / 1e6
         lines = render_tree(ex, stats)
-        d = {k: self.cop.stats[k] - before.get(k, 0) for k in self.cop.stats}
+        d = {k: cop.stats[k] - before.get(k, 0) for k in cop.stats}
         lines.append(
             f"cop: tasks:{d['tasks']} tpu:{d['tpu_tasks']} host:{d['host_tasks']} "
             f"region_errors:{d['region_errors']} fallback_errors:{d['fallback_errors']}"
@@ -4054,8 +4124,8 @@ class Session:
             mline = (
                 f"mpp: dispatches:{d['mpp_tasks']} fallbacks:{d['mpp_fallbacks']}"
             )
-            reason = getattr(self.cop.mpp, "last_fallback_reason", "") \
-                if getattr(self.cop, "_mpp", None) is not None else ""
+            reason = getattr(cop.mpp, "last_fallback_reason", "") \
+                if getattr(cop, "_mpp", None) is not None else ""
             if d.get("mpp_fallbacks") and reason:
                 mline += f" reason:[{reason}]"
             lines.append(mline)
@@ -4082,15 +4152,15 @@ class Session:
                 f"wire_bytes:{int(d.get('wire_bytes', 0))} "
                 f"cache_ref:{int(d.get('cache_ref_bytes', 0))} "
                 f"shared_h2d:{int(d.get('shared_h2d_bytes', 0))} "
-                f"lanes:{len(self.cop.tpu.lanes) if self.cop._tpu else 1} "
+                f"lanes:{len(cop.tpu.lanes) if cop._tpu else 1} "
                 f"reroutes:{int(d.get('lane_reroutes', 0))} "
                 f"spills:{int(d.get('lane_spills', 0))}"
             )
-        if self.cop._tpu:
+        if cop._tpu:
             # per-device breakers (PR 6): one state per runner lane; the
             # aggregate reads `open` when every lane is open (= cop path
             # fully drained to host), `open(k/n)` for a partial outage
-            lanes = self.cop.tpu.lanes
+            lanes = cop.tpu.lanes
             n_open = sum(1 for l in lanes if l.breaker.state == "open")
             n_half = sum(1 for l in lanes if l.breaker.state == "half-open")
             if n_open == len(lanes):
@@ -4102,10 +4172,22 @@ class Session:
             else:
                 agg = "closed"
             lines.append(
-                f"tpu: compiles:{self.cop.tpu.compile_count - tpu0[0]} "
-                f"fallbacks:{self.cop.tpu.fallbacks - tpu0[1]} "
+                f"tpu: compiles:{cop.tpu.compile_count - tpu0[0]} "
+                f"fallbacks:{cop.tpu.fallbacks - tpu0[1]} "
                 f"breaker:{agg} trips:{sum(l.breaker.trips for l in lanes)}"
             )
+        if decision is not None:
+            # routing line: the node a follower-read statement was (or
+            # would be) served by, or the typed fallback reason
+            if decision.get("outcome") == "follower":
+                lines.append(
+                    f"replica: name:{decision.get('replica')} "
+                    f"lag_ms:{decision.get('lag_ms', 0.0):.1f}"
+                )
+            else:
+                lines.append(
+                    f"replica: fallback reason:{decision.get('reason', '')}"
+                )
         lines.append(f"total: {wall_ms:.3f}ms")
         chk = Chunk.from_datum_rows([ft_varchar()], [[Datum.s(l)] for l in lines])
         return ResultSet(["plan"], chk)

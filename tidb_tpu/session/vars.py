@@ -205,6 +205,14 @@ _sv("tidb_replica_read", "leader", kind="enum",
 # is skipped
 _sv("tidb_replica_read_max_lag_ms", "5000", kind="int", lo=0, hi=3600000,
     consumed=True)
+# cross-node trace propagation (PR 18): ON (default) lets a
+# follower-routed statement's replica-side spans (cop.task + its
+# device-phase children) adopt into the PRIMARY statement trace tagged
+# with the serving replica's name, and stamps the routing decision
+# (outcome/reason) as a replica.route span. OFF reverts to untagged
+# per-process spans — the A/B knob for the paired overhead gate
+# (tools/bench_trace_propagation.py, standing ≤5% rule).
+_sv("tidb_enable_trace_propagation", "ON", kind="bool", consumed=True)
 # comma-separated spare WAL directories: on a WAL IO failure the store
 # checkpoints onto the first healthy spare (fresh log, writes resume,
 # zero acks lost) instead of degrading read-only forever; failed media

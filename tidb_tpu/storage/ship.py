@@ -172,6 +172,7 @@ class _Link:
     __slots__ = (
         "name", "standby", "sender", "base_gseq", "sent_gseq",
         "durable_gseq", "applied_ts", "error", "thread", "reconnects",
+        "route_standby", "ack_wall",
     )
 
     def __init__(self, name: str, base_gseq: int, standby=None, sender=None):
@@ -185,6 +186,11 @@ class _Link:
         self.error: Exception | None = None
         self.thread: threading.Thread | None = None
         self.reconnects = 0  # consecutive failures (resets on a good ack)
+        # a socket link whose standby ALSO lives in this process (the
+        # embedded-fleet topology: WAL frames over real TCP, follower
+        # reads served directly) — routing-only, never a promote target
+        self.route_standby = None
+        self.ack_wall = 0.0  # wall time of the link's newest durable ack
 
 
 class ReplicaSet:
@@ -197,11 +203,23 @@ class ReplicaSet:
     DRAIN_DEADLINE_S = 5.0  # auto-promote: max wait for durable frames to drain
     RECONNECT_MAX = 5  # consecutive socket failures before the link breaks
     RECONNECT_BACKOFF_S = 0.05  # doubles per consecutive failure, capped
+    MONITOR_INTERVAL_S = 0.5  # lag-monitor sampling tick
+    STATUS_TIMEOUT_S = 1.0  # per-member bound on the status-RPC fan-out
 
     def __init__(self, store, auto_promote: bool = False):
         self.store = store
         self.auto_promote = auto_promote
         self._cond = threading.Condition()
+        # lag monitor (PR 18): samples per-replica staleness into
+        # tidb_replica_lag_seconds on a fixed tick; _mon_lock guards the
+        # thread handle + last-tick snapshot only (sampling itself walks
+        # link_states() with no monitor state held)
+        self._mon_lock = threading.Lock()
+        self._mon_thread: threading.Thread | None = None
+        self._mon_wake = threading.Event()
+        self._mon_last = 0.0
+        # status-RPC fan-out result slots (one writer thread per member)
+        self._status_lock = threading.Lock()
         # FIFO of (wal, local_seq, payload, gseq, enqueue_wall): append
         # order IS ship order; a frame ships only once `local_seq <=
         # wal.durable_seq()`, and FIFO means an undurable frame holds
@@ -301,17 +319,22 @@ class ReplicaSet:
         self._add_link(link)
 
     def attach_socket(self, host: str, port: int, connect_timeout: float = 5.0,
-                      standby_dir: str | None = None) -> None:
+                      standby_dir: str | None = None, standby=None) -> None:
         """Socket transport to a StandbyServer: WAL-shaped frames out,
         cumulative (count, applied_ts) ack back after each batch fsync.
         The HELLO handshake learns the standby's instance token and
-        already-acked frame count, which seeds the resync point."""
+        already-acked frame count, which seeds the resync point.
+        `standby` optionally names the far side's Storage when it lives
+        in THIS process (embedded socket fleet): the follower-read
+        router may then serve from it directly while the WAL stream
+        still exercises the real wire — it is never a promote target."""
         _key, cut = self._take_cut(standby_dir)
         sender = _SocketSender(host, port, connect_timeout)
         count, applied = sender.connect()
         link = _Link(f"{host}:{port}", cut, sender=sender)
         link.sent_gseq = link.durable_gseq = cut + count
         link.applied_ts = applied
+        link.route_standby = standby
         self._add_link(link)
 
     def _add_link(self, link: _Link) -> None:
@@ -325,14 +348,49 @@ class ReplicaSet:
             name=f"wal-ship:{link.name}", daemon=True,
         )
         link.thread.start()
+        self._start_monitor()
+
+    def _start_monitor(self) -> None:
+        with self._mon_lock:
+            if self._mon_thread is not None:
+                return
+            self._mon_thread = threading.Thread(
+                target=self._monitor_run, name="fleet-lag-monitor", daemon=True,
+            )
+            self._mon_thread.start()
+
+    def _monitor_run(self) -> None:
+        while True:
+            self._mon_wake.wait(self.MONITOR_INTERVAL_S)
+            with self._cond:
+                if self._stopped:
+                    return
+            self.monitor_tick()
+
+    def monitor_tick(self) -> None:
+        """One lag-monitor sample: each live link's apply staleness
+        (wall clock minus its applied watermark, the same measure the
+        follower router gates on) lands in the tidb_replica_lag_seconds
+        histogram — the SLO signal the lagging-replica inspection rule
+        reads. Public so tests can force a tick instead of sleeping."""
+        from ..utils import metrics as M
+
+        for s in self.link_states():
+            if not s["broken"]:
+                M.REPLICA_LAG_SECONDS.observe(s["lag_ms"] / 1e3, replica=s["name"])
+        with self._mon_lock:
+            self._mon_last = time.time()
 
     def stop(self) -> None:
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
             threads = [l.thread for l in self._links]
+        self._mon_wake.set()
+        with self._mon_lock:
+            mon = self._mon_thread
         me = threading.current_thread()
-        for t in threads:
+        for t in [*threads, mon]:
             if t is not None and t is not me:
                 t.join(timeout=5.0)
 
@@ -347,16 +405,87 @@ class ReplicaSet:
             return self._broken
 
     def link_states(self) -> list[dict]:
-        """Ops/test introspection: one dict per link."""
+        """Ops/test introspection: one dict per link, including the
+        CLUSTER_REPLICATION fields — transport kind, apply staleness and
+        the broken reason. Lag is the router's own eligibility measure:
+        wall clock minus the link's applied watermark (ts = physical ms
+        << 18), NOT `mvcc.high_water_ts()` — the high-water read scans
+        both CFs under the kv lock, which a periodic monitor tick must
+        never do to a serving primary."""
+        now_ms = time.time() * 1000
         with self._cond:
             return [
                 {
                     "name": l.name, "base_gseq": l.base_gseq,
                     "durable_gseq": l.durable_gseq, "applied_ts": l.applied_ts,
                     "broken": l.error is not None, "reconnects": l.reconnects,
+                    "transport": "inproc" if l.standby is not None else "socket",
+                    # applied_ts == 0 means nothing shipped since the
+                    # bootstrap snapshot (which is complete by the cut):
+                    # not lag, just an idle link
+                    "lag_ms": (round(max(0.0, now_ms - (l.applied_ts >> 18)), 3)
+                               if l.applied_ts else 0.0),
+                    "reason": (f"{type(l.error).__name__}: {l.error}"
+                               if l.error is not None else ""),
+                    "ack_wall": l.ack_wall,
                 }
                 for l in self._links
             ]
+
+    def fleet_statuses(self, timeout_s: float | None = None,
+                       detail: bool = True) -> list[dict]:
+        """Fleet-wide status fan-out for the CLUSTER_* memtables and
+        /debug/fleet: the primary answers directly, in-process members
+        are read directly, socket members go over the status RPC — each
+        on its own thread with a bounded per-member timeout, so a dead
+        or hung node contributes one `{"name", "error"}` entry (partial
+        rows) instead of hanging the query. `detail=False` strips the
+        bulky metrics/statements payloads (the /debug/fleet shape)."""
+        timeout_s = self.STATUS_TIMEOUT_S if timeout_s is None else timeout_s
+        with self._cond:
+            members = [
+                (l.name,
+                 l.standby if l.standby is not None else l.route_standby,
+                 l.sender)
+                for l in self._links
+            ]
+        out = [node_status(self.store, name="primary")]
+        results: list = [None] * len(members)
+
+        def fetch(i: int, name: str, standby, sender) -> None:
+            try:
+                if standby is not None:
+                    st = node_status(standby, name=name)
+                else:
+                    st = fetch_status(sender.host, sender.port, timeout_s)
+                    st["name"] = name
+            except Exception as e:  # noqa: BLE001 — partial rows, never a hang
+                st = {"name": name, "error": f"{type(e).__name__}: {e}"}
+            with self._status_lock:
+                results[i] = st
+
+        threads = []
+        for i, (name, standby, sender) in enumerate(members):
+            t = threading.Thread(
+                target=fetch, args=(i, name, standby, sender),
+                name=f"fleet-status:{name}", daemon=True,
+            )
+            threads.append(t)
+            t.start()
+        deadline = time.time() + timeout_s + 0.5
+        for t in threads:
+            t.join(max(0.0, deadline - time.time()))
+        with self._status_lock:
+            snap = list(results)
+        for i, st in enumerate(snap):
+            if st is None:  # the fetch thread outlived the deadline
+                st = {"name": members[i][0],
+                      "error": f"status timeout after {timeout_s}s"}
+            out.append(st)
+        if not detail:
+            out = [{k: v for k, v in st.items()
+                    if k not in ("metrics", "statements")} for st in out]
+        return out
 
     # ----------------------------------------------------------- ship loop
 
@@ -379,7 +508,7 @@ class ReplicaSet:
                     d = horizon[id(wal)] = wal.durable_seq()
                 if seq > d:
                     break  # FIFO: order on the standby mirrors the log
-                batch.append((gseq, payload))
+                batch.append((gseq, payload, t_enq))
             if not batch:
                 with self._cond:
                     if self._stopped:
@@ -388,7 +517,7 @@ class ReplicaSet:
                 self._update_lag()
                 continue
             try:
-                count, applied = self._deliver(link, [p for _, p in batch])
+                count, applied = self._deliver(link, [p for _, p, _ in batch])
                 if link.base_gseq + count < batch[-1][0]:
                     raise ConnectionError(
                         f"standby acked {count} frames < shipped through "
@@ -404,15 +533,22 @@ class ReplicaSet:
                 return
             from ..utils import metrics as M
 
+            acked_wall = time.time()
             with self._cond:
                 link.reconnects = 0
                 link.sent_gseq = max(link.sent_gseq, batch[-1][0])
                 link.durable_gseq = link.base_gseq + count
                 link.applied_ts = max(link.applied_ts, applied)
+                link.ack_wall = acked_wall
                 self._prune_locked()
                 self._cond.notify_all()
             M.REPLICA_DURABLE_FRAMES.set(float(count), replica=link.name)
             M.REPLICA_APPLIED_TS.set(float(link.applied_ts), replica=link.name)
+            # enqueue→durable-ack latency of the batch's newest frame:
+            # the per-link half of the quorum-wait decomposition
+            M.REPLICA_ACK_SECONDS.observe(
+                max(0.0, acked_wall - batch[-1][2]), replica=link.name
+            )
             self._update_lag()
 
     def _deliver(self, link: _Link, payloads: list[bytes]) -> tuple[int, int]:
@@ -542,6 +678,9 @@ class ReplicaSet:
         appears — exactly the single-standby behavior."""
         from ..utils import metrics as M
 
+        tracer = getattr(session, "_tracer", None) if session is not None else None
+        t0_wall = time.time()
+        t0_perf = time.perf_counter()
         target = self._durable_target()
         with self._cond:
             while True:
@@ -560,6 +699,9 @@ class ReplicaSet:
                 if links and acked >= need:
                     if mode == "QUORUM":
                         M.REPLICA_QUORUM.inc(outcome="acked")
+                    self._note_quorum_wait(
+                        tracer, t0_wall, t0_perf, mode, target, links
+                    )
                     return
                 if self._stopped or self._broken is not None:
                     raise CommitIndeterminateError(
@@ -590,6 +732,30 @@ class ReplicaSet:
                     from ..sched.scheduler import raise_if_interrupted
 
                     raise_if_interrupted(session, deadline)
+
+    def _note_quorum_wait(self, tracer, t0_wall: float, t0_perf: float,
+                          mode: str, target: int, links) -> None:
+        """Decompose the commit's replication wait into the statement
+        trace: a closed `quorum.wait` span whose tags carry the per-link
+        ack timeline (`name:+12.3ms` relative to the wait's start, `pre`
+        when the link had already acked before the wait began), plus the
+        quorum_wait_ms counter that feeds the slow log /
+        STATEMENTS_SUMMARY columns. Called under `_cond` (link fields)
+        on the acked path only — the trace lock ranks above wal.ship."""
+        if tracer is None:
+            return
+        dur_s = time.perf_counter() - t0_perf
+        tracer.add("quorum_wait_ms", dur_s * 1e3)
+        acks = []
+        for l in links:
+            if l.durable_gseq >= target:
+                if l.ack_wall >= t0_wall:
+                    acks.append(f"{l.name}:+{(l.ack_wall - t0_wall) * 1e3:.1f}ms")
+                else:
+                    acks.append(f"{l.name}:pre")
+        tracer.closed_span(
+            "quorum.wait", dur_s, mode=mode, acks=",".join(acks) or "-"
+        )
 
     def wait_caught_up(self, timeout: float = 10.0) -> bool:
         """Test/ops helper: True once every currently-durable frame is
@@ -757,7 +923,8 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._inflight: dict[int, int] = {}  # id(store) → live statements
 
-    def route(self, as_of_ts: int | None = None, max_lag_ms: int = 5000):
+    def route(self, as_of_ts: int | None = None, max_lag_ms: int = 5000,
+              decision: dict | None = None):
         """Pick a replica for one read-only statement. For `AS OF
         TIMESTAMP t` reads a replica is eligible iff its applied
         watermark has REACHED t (it then serves the exact same snapshot
@@ -765,40 +932,59 @@ class ReplicaRouter:
         or below it). For plain follower reads eligibility is bounded
         staleness: applied-ts lag within `max_lag_ms`. Returns the
         chosen standby Storage (inflight-bumped: pair with `release`),
-        or None for primary fallback."""
+        or None for primary fallback. `decision`, when given, is filled
+        with the outcome/reason/replica/lag_ms quad so the caller can
+        stamp the routing decision onto the statement trace."""
         from ..utils import metrics as M
 
         with self._rs._cond:
-            links = [l for l in self._rs._links
-                     if l.standby is not None and l.error is None]
+            links = [
+                l for l in self._rs._links
+                if (l.standby is not None or l.route_standby is not None)
+                and l.error is None
+            ]
         now_ms = int(time.time() * 1000)
         cands = []
+        skip_over_lag = skip_watermark = 0
         for l in links:
-            st = l.standby
+            st = l.standby if l.standby is not None else l.route_standby
             if not st.standby:
                 continue  # promoted away: it is a primary now
             ats = st.applied_ts
             if as_of_ts is not None:
                 if ats < as_of_ts:
-                    continue  # hasn't caught up to t: would miss commits <= t
+                    # hasn't caught up to t: would miss commits <= t
+                    skip_watermark += 1
+                    continue
                 lag_ms = 0.0
             else:
                 lag_ms = max(0.0, now_ms - (ats >> 18))
                 if lag_ms > max_lag_ms:
+                    skip_over_lag += 1
                     continue
-            cands.append((st, lag_ms))
+            cands.append((st, lag_ms, l.name))
         if not cands:
-            M.REPLICA_READS.inc(outcome="fallback_stale" if links else "fallback_none")
+            outcome = "fallback_stale" if links else "fallback_none"
+            reason = ("over_lag" if skip_over_lag
+                      else "beyond_watermark" if skip_watermark
+                      else "no_replica")
+            M.REPLICA_READS.inc(outcome=outcome, reason=reason)
+            if decision is not None:
+                decision.update(outcome=outcome, reason=reason,
+                                replica="", lag_ms=0.0)
             return None
         with self._lock:
             best = min(
                 cands,
                 key=lambda c: self._inflight.get(id(c[0]), 0)
                 + c[1] / max(1.0, float(max_lag_ms)),
-            )[0]
-            self._inflight[id(best)] = self._inflight.get(id(best), 0) + 1
-        M.REPLICA_READS.inc(outcome="follower")
-        return best
+            )
+            self._inflight[id(best[0])] = self._inflight.get(id(best[0]), 0) + 1
+        M.REPLICA_READS.inc(outcome="follower", reason="-")
+        if decision is not None:
+            decision.update(outcome="follower", reason="-",
+                            replica=best[2], lag_ms=round(best[1], 3))
+        return best[0]
 
     def release(self, store) -> None:
         with self._lock:
@@ -815,6 +1001,7 @@ _FRAME_HDR = struct.Struct("<BII")  # tag, len, crc32
 _TAG_FRAME = 0x46  # 'F'
 _TAG_SYNC = 0x53  # 'S'
 _TAG_HELLO = 0x48  # 'H' — sender-initiated handshake/resync probe
+_TAG_STATUS = 0x51  # 'Q' — fleet status RPC (CLUSTER_* memtable fan-out)
 _ACK = struct.Struct("<QQ")  # cumulative durable frame count, applied_ts
 _HELLO = struct.Struct("<16sQQ")  # instance token, acked count, applied_ts
 
@@ -827,6 +1014,55 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("ship peer closed")
         buf += got
     return buf
+
+
+def node_status(store, name: str = "") -> dict:
+    """The status-RPC payload for one store: identity/role, replication
+    watermarks, the full metrics registry, and a statements-summary
+    snapshot — exactly what the federated CLUSTER_METRICS /
+    CLUSTER_STATEMENTS_SUMMARY memtables read, whether the store is
+    queried in-process or over the wire."""
+    from ..utils.metrics import REGISTRY
+
+    ss = store.stmt_stats
+    with ss._lock:
+        stmts = [
+            {
+                "digest": st["digest"], "exec_count": st["exec_count"],
+                "sum_latency_s": st["sum_latency_s"], "errors": st["errors"],
+                "sample_sql": st["sample_sql"][:256],
+            }
+            for st in ss.summary.values()
+        ]
+    return {
+        "name": name or os.path.basename(store.data_dir or "") or "memory",
+        "role": "standby" if store.standby else "primary",
+        "applied_ts": int(store.applied_ts),
+        "applied_frames": int(getattr(store, "_applied_frames", 0)),
+        "metrics": [[n, lbl, v] for n, lbl, v in REGISTRY.rows()],
+        "statements": stmts,
+    }
+
+
+def fetch_status(host: str, port: int, timeout_s: float = 1.0) -> dict:
+    """One bounded status-RPC round trip on a FRESH connection — the
+    ship link's socket stays dedicated to frames/acks, and a dead or
+    hung member costs exactly `timeout_s`, never a blocked query."""
+    import json
+
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    try:
+        sock.settimeout(timeout_s)
+        sock.sendall(_FRAME_HDR.pack(_TAG_STATUS, 0, 0))
+        tag, ln, crc = _FRAME_HDR.unpack(_recv_exact(sock, _FRAME_HDR.size))
+        if tag != _TAG_STATUS:
+            raise ConnectionError(f"unexpected status reply tag {tag:#x}")
+        body = _recv_exact(sock, ln)
+        if zlib.crc32(body) != crc:
+            raise ConnectionError("status reply failed CRC check")
+        return json.loads(body)
+    finally:
+        sock.close()
 
 
 class _SocketSender:
@@ -909,20 +1145,29 @@ class StandbyServer:
         self._thread.start()
 
     def _accept_loop(self) -> None:
+        # one thread per connection: the ship link's connection lives for
+        # the fleet's lifetime, so a serial accept loop would starve the
+        # short status-RPC connections behind it forever
         while not self._closing:
             try:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="standby-server-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            self._serve(conn)
+        except (ConnectionError, OSError, TiDBError) as e:
+            log.warning("standby server connection ended: %s", e)
+        finally:
             try:
-                self._serve(conn)
-            except (ConnectionError, OSError, TiDBError) as e:
-                log.warning("standby server connection ended: %s", e)
-            finally:
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                conn.close()
+            except OSError:
+                pass
 
     def _serve(self, conn: socket.socket) -> None:
         batch: list[bytes] = []
@@ -947,6 +1192,14 @@ class StandbyServer:
                     self.token, self.standby._applied_frames,
                     self.standby.applied_ts,
                 ))
+            elif tag == _TAG_STATUS:
+                import json
+
+                body = json.dumps(node_status(self.standby)).encode()
+                conn.sendall(
+                    _FRAME_HDR.pack(_TAG_STATUS, len(body), zlib.crc32(body))
+                    + body
+                )
             else:
                 raise ConnectionError(f"unknown ship tag {tag:#x}")
 

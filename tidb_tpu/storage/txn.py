@@ -1109,6 +1109,11 @@ class Storage:
 
             session = _ACTIVE_SESSION.get()
             deadline = getattr(session, "_deadline", None) if session is not None else None
+        # decompose the durability point for the statement trace: the
+        # local fsync (wal.fsync) vs the replication wait (quorum.wait,
+        # emitted inside wait_durable with per-link ack offsets)
+        tracer = getattr(session, "_tracer", None) if session is not None else None
+        t0 = time.perf_counter()
         if self.global_vars.get("tidb_wal_group_commit", "ON") != "ON":
             from ..utils import metrics as M
 
@@ -1116,6 +1121,8 @@ class Storage:
             M.WAL_GROUP_COMMIT.inc(outcome="off")
         else:
             wal.sync_group(session=session, deadline=deadline)
+        if tracer is not None:
+            tracer.closed_span("wal.fsync", time.perf_counter() - t0)
         if semi:
             sh.wait_durable(session=session, deadline=deadline, mode=semi_mode)
 

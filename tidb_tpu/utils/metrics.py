@@ -46,6 +46,14 @@ class Counter:
         with self._lock:
             return sum(self._v.values())
 
+    def value_matching(self, **labels) -> float:
+        """Sum over every label set CONTAINING the given pairs — the
+        partial-match read for counters that carry extra dimensions
+        (e.g. value_matching(outcome="follower") sums across reasons)."""
+        want = set(labels.items())
+        with self._lock:
+            return sum(v for key, v in self._v.items() if want.issubset(key))
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:  # a concurrent inc() may insert a new label set
@@ -520,13 +528,34 @@ REPLICA_QUORUM = REGISTRY.counter(
     "semi-sync QUORUM commit waits by outcome (acked | unreachable)",
 )
 # outcome=follower: a lag-eligible replica served the read;
-# fallback_stale: replicas exist but every one was too stale/ineligible;
-# fallback_none: no in-process replica links at all — both fallbacks
-# route the statement to the primary
+# fallback_stale: replicas exist but none could serve THIS statement;
+# fallback_none: no replica links at all — both fallbacks route the
+# statement to the primary. The reason dimension (PR 18, mirroring the
+# PR 8 fallback taxonomy) says WHY: over_lag (every candidate past
+# tidb_replica_read_max_lag_ms), beyond_watermark (AS OF ts above every
+# applied watermark), in_txn (follower read requested inside an open
+# txn — routing would miss its uncommitted writes), no_replica (no
+# eligible link); served reads carry reason="-"
 REPLICA_READS = REGISTRY.counter(
     "tidb_replica_read_total",
     "read-only statement routing by outcome (follower | fallback_stale | "
-    "fallback_none)",
+    "fallback_none) and reason (- | over_lag | beyond_watermark | in_txn "
+    "| no_replica)",
+)
+# fleet SLO profiling (PR 18): the ReplicaSet lag monitor samples each
+# live link's staleness vs the primary's commit high-water every tick;
+# ack seconds measure enqueue→durable-ack latency per shipped batch —
+# together the inputs for the lagging-replica / quorum-at-risk
+# inspection rules and feedback-driven routing
+REPLICA_LAG_SECONDS = REGISTRY.histogram(
+    "tidb_replica_lag_seconds",
+    "sampled per-replica apply staleness vs the primary (label replica)",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0),
+)
+REPLICA_ACK_SECONDS = REGISTRY.histogram(
+    "tidb_replica_ack_seconds",
+    "per-link WAL batch enqueue-to-durable-ack latency (label replica)",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
 )
 REPLICA_REJOINS = REGISTRY.counter(
     "tidb_replica_rejoin_total",

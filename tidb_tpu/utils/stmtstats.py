@@ -65,7 +65,7 @@ class StmtStats:
     # verbatim on each slow-log entry (ref: util/execdetails fields of
     # LogSlowQuery / stmtsummary)
     DETAIL_KEYS = ("sched_wait_ms", "retries", "backoff_ms", "compile_ms",
-                   "transfer_bytes", "mem_degraded_tasks")
+                   "transfer_bytes", "mem_degraded_tasks", "quorum_wait_ms")
 
     def record(
         self, sql: str, dur_s: float, user: str, db: str, ok: bool,
@@ -120,6 +120,12 @@ class StmtStats:
                 st["max_mem_bytes"] = max(
                     st.get("max_mem_bytes", 0), int(d.get("mem_bytes", 0))
                 )
+                # how many executions of this digest a follower actually
+                # served (the replica name itself is per-execution: slow
+                # log carries it verbatim)
+                st["replica_reads"] = st.get("replica_reads", 0) + (
+                    1 if d.get("replica") else 0
+                )
             if slow_log_on and dur_s >= slow_threshold_s:
                 entry = {
                     "time": now,
@@ -131,6 +137,7 @@ class StmtStats:
                     "succ": ok,
                     "batch_occupancy": int(d.get("batch_occupancy", 0)),
                     "mem_bytes": int(d.get("mem_bytes", 0)),
+                    "replica": str(d.get("replica", "") or ""),
                 }
                 for k in self.DETAIL_KEYS:
                     entry[k] = d.get(k, 0.0)

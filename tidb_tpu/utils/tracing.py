@@ -175,6 +175,7 @@ class StatementTrace:
             "transfer_bytes": int(c.get("transfer_bytes", 0)),
             "mem_bytes": int(c.get("mem_bytes", 0)),
             "mem_degraded_tasks": int(c.get("mem_degraded_tasks", 0)),
+            "quorum_wait_ms": c.get("quorum_wait_ms", 0.0),
         }
 
     # --- spans (recording only) --------------------------------------------

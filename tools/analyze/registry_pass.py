@@ -18,7 +18,12 @@ the docs. Checks:
   * sysvars THIS reproduction added beyond the reference's list (the
     `tidb_tpu_*` family + the tracing/timeline/backoff knobs) must
     appear in the docs, and every doc-mentioned `tidb_tpu_*` knob must
-    exist in the registry.
+    exist in the registry;
+  * every memtable in the catalog registry (catalog/memtables.py
+    SCHEMAS) must be mentioned in the docs as
+    `information_schema.<name>`, and every such doc mention must be a
+    registered memtable — the system-table surface is discovered by
+    reading the docs, so both directions drift silently otherwise.
 """
 
 from __future__ import annotations
@@ -48,7 +53,10 @@ _SCOPED_SYSVARS = {
     # PR 17: follower reads (tidb_replica_read IS a reference sysvar, but
     # this reproduction made it consumed — the routing contract needs docs)
     "tidb_replica_read", "tidb_replica_read_max_lag_ms",
+    # PR 18: replica spans adopt into the primary statement trace
+    "tidb_enable_trace_propagation",
 }
+_MEMTABLES_MODULE = "tidb_tpu/catalog/memtables.py"
 
 _UPDATE_METHODS = {"inc", "observe", "set", "add"}
 
@@ -213,6 +221,50 @@ class RegistryConsistencyPass(Pass):
                     f"docs mention `{tok}` which is neither a registered "
                     f"sysvar nor a metric — stale docs or a typo",
                     key=("doc-stale-sysvar", tok),
+                ))
+
+        # --- memtables ↔ docs ----------------------------------------------
+        # the SCHEMAS registry is the single source of truth for the
+        # information_schema surface; `SCHEMAS: dict[...] = {...}` is an
+        # AnnAssign, plain `SCHEMAS = {...}` an Assign — handle both
+        memtables: dict[str, int] = {}
+        for mod in modules:
+            if mod.rel != _MEMTABLES_MODULE:
+                continue
+            for node in ast.walk(mod.tree):
+                target = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target = node.targets[0]
+                elif isinstance(node, ast.AnnAssign):
+                    target = node.target
+                if not (isinstance(target, ast.Name) and target.id == "SCHEMAS"):
+                    continue
+                if isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            memtables[k.value] = k.lineno
+        doc_tables = {
+            t.lower() for t in re.findall(
+                r"\binformation_schema\.([A-Za-z0-9_]+)\b", docs,
+                re.IGNORECASE)
+        }
+        for tbl in sorted(memtables):
+            if tbl not in doc_tables:
+                findings.append(Finding(
+                    self.name, _MEMTABLES_MODULE, memtables[tbl],
+                    f"memtable `information_schema.{tbl}` is registered "
+                    f"but neither README.md nor COVERAGE.md mentions it — "
+                    f"document the table (columns, what it answers)",
+                    key=("doc-memtable", tbl),
+                ))
+        for tok in sorted(doc_tables):
+            if tok not in memtables:
+                findings.append(Finding(
+                    self.name, "README.md/COVERAGE.md", 0,
+                    f"docs mention `information_schema.{tok}` which is not "
+                    f"in the memtable registry (catalog/memtables.py "
+                    f"SCHEMAS) — stale docs or a typo",
+                    key=("doc-stale-memtable", tok),
                 ))
         return findings
 

@@ -740,8 +740,49 @@ def run_replica_fleet(clients_n: int, secs: float, host: str) -> dict:
                 f"{N_REPLICAS + 1} cores"
             )
 
-        # --- phase B: kill-a-replica + promote-under-load
+        # --- phase A.5: quorum/lag SLO profile (PR 18) — client-observed
+        # semi-sync QUORUM commit latency distribution, plus the lag
+        # monitor's per-replica histograms read back off the fleet's own
+        # metrics memtable (the observability the INSPECTION_RESULT
+        # rules alert on). Recorded, not gated: the paired ≤5% gate for
+        # the new plumbing is tools/bench_trace_propagation.py.
         admin.query("CREATE TABLE killtest (id BIGINT PRIMARY KEY, v INT)")
+        admin.query("SET GLOBAL tidb_wal_semi_sync = 'QUORUM'")
+        qins = admin.prepare("INSERT INTO killtest VALUES (?, ?)")[0]
+        qlat: list[float] = []
+        for i in range(200):
+            t0 = time.perf_counter()
+            admin.execute(qins, [(1 << 40) + i, 0])
+            qlat.append(time.perf_counter() - t0)
+        qlat.sort()
+        time.sleep(0.7)  # one lag-monitor tick (MONITOR_INTERVAL_S=0.5)
+
+        def _metric_rows(series: str) -> list[dict]:
+            def col(c: str, suf: str) -> list[str]:
+                return admin.query_col(
+                    f"SELECT {c} FROM information_schema.metrics "
+                    f"WHERE NAME = '{series}_{suf}'")
+
+            labels = col("LABELS", "count")
+            counts = col("VALUE", "count")
+            sums = col("VALUE", "sum")
+            return [
+                {"labels": lb, "count": int(float(c)),
+                 "mean_s": round(float(sm) / float(c), 6) if float(c) else 0.0}
+                for lb, c, sm in zip(labels, counts, sums)
+            ]
+
+        out["slo_profile"] = {
+            "quorum_wait_ms": {
+                "n": len(qlat),
+                "p50": round(qlat[len(qlat) // 2] * 1e3, 3),
+                "p99": round(qlat[int(len(qlat) * 0.99)] * 1e3, 3),
+            },
+            "replica_lag_seconds": _metric_rows("tidb_replica_lag_seconds"),
+            "replica_ack_seconds": _metric_rows("tidb_replica_ack_seconds"),
+        }
+
+        # --- phase B: kill-a-replica + promote-under-load
         admin.query("SET GLOBAL tidb_wal_semi_sync = ON")
         writers = conns[: max(4, clients_n // 4)]
         for c in writers:

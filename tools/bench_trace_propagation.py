@@ -1,0 +1,86 @@
+"""Trace-propagation gate (ISSUE 18 acceptance): the paired off/on
+statement bench (tools/paired_bench.py) over FOLLOWER-ROUTED reads —
+tidb_enable_trace_propagation=OFF (replica spans stay local) vs ON
+(replica-side cop spans adopt into the primary statement trace, tagged
+with the serving replica). Statement tracing itself is ON in both modes
+so the delta isolates the propagation plumbing, not span recording.
+FAILS LOUDLY (non-zero exit) past GATE_PCT p50 and writes
+BENCH_trace_propagation_pr18.json at the repo root. Standalone:
+`python tools/bench_trace_propagation.py`.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.paired_bench import (  # noqa: E402
+    N_TASKS,
+    REPS,
+    ROWS_PER_TASK,
+    bench_main,
+    run_paired_bench,
+)
+
+
+def make_fleet_session(n_tasks: int, rows_per_task: int, tmp: str):
+    """A durable-primary Session with the pt point-agg table loaded and
+    one in-process replica attached and caught up, follower routing on —
+    every bench statement takes the replica-read path the propagation
+    flag instruments (make_pt_session is memory-backed, which cannot
+    ship WAL)."""
+    from tidb_tpu.session import Session
+    from tidb_tpu.storage.ship import ReplicaSet
+    from tidb_tpu.storage.txn import Storage
+
+    store = Storage(data_dir=os.path.join(tmp, "primary"))
+    s = Session(store)
+    s.execute("SET tidb_enable_auto_analyze = OFF")
+    s.execute("CREATE TABLE pt (id INT PRIMARY KEY, v INT, w INT)")
+    total = n_tasks * rows_per_task
+    for lo in range(0, total, 8192):
+        s.execute(
+            "INSERT INTO pt VALUES "
+            + ",".join(f"({i}, {i % 997}, {(i * 7) % 131})" for i in range(lo, lo + 8192))
+        )
+    ship = ReplicaSet(store)
+    d = os.path.join(tmp, "standby0")
+    ship.bootstrap(d)
+    ship.attach(Storage(data_dir=d, standby=True))
+    if not ship.wait_caught_up(30):
+        raise RuntimeError("replica never caught up; bench setup broken")
+    s.vars["tidb_enable_cop_result_cache"] = "OFF"
+    s.vars["tidb_cop_engine"] = "tpu"
+    s.vars["tidb_enable_trace"] = "ON"
+    s.vars["tidb_replica_read"] = "follower"
+    return s, ship
+
+
+def _set_mode(s, mode: str) -> None:
+    s.vars["tidb_enable_trace_propagation"] = "ON" if mode == "on" else "OFF"
+
+
+def run_trace_propagation_bench(n_tasks: int = N_TASKS,
+                                rows_per_task: int = ROWS_PER_TASK,
+                                reps: int = REPS) -> dict:
+    with tempfile.TemporaryDirectory(prefix="bench_prop_") as tmp:
+        s, ship = make_fleet_session(n_tasks, rows_per_task, tmp)
+        try:
+            out = run_paired_bench(
+                s, _set_mode,
+                "follower-routed point-agg statements, trace propagation off vs on",
+                n_tasks=n_tasks, rows_per_task=rows_per_task, reps=reps,
+            )
+        finally:
+            ship.stop()
+    return out
+
+
+def main() -> int:
+    return bench_main(run_trace_propagation_bench,
+                      "BENCH_trace_propagation_pr18.json", "trace-propagation")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
