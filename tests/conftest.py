@@ -47,6 +47,9 @@ _LOCK_HUNT_MODULES = {
     # PR 16: folds racing live commits — the compactor's stats lock vs
     # the kv/wal chain
     "test_compact",
+    # PR 19: chaos proxies + heartbeat/quorum-timeout paths — the
+    # netchaos leaves vs the wal.ship/standby/failpoint chain
+    "test_net_chaos",
 }
 
 

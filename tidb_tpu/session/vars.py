@@ -213,6 +213,26 @@ _sv("tidb_replica_read_max_lag_ms", "5000", kind="int", lo=0, hi=3600000,
 # per-process spans — the A/B knob for the paired overhead gate
 # (tools/bench_trace_propagation.py, standing ≤5% rule).
 _sv("tidb_enable_trace_propagation", "ON", kind="bool", consumed=True)
+# --- partition hardening (PR 19) --------------------------------------------
+# link heartbeat cadence: an idle socket ship link pings the standby (a
+# bare sync marker, acked like a batch) every this-many ms, so a
+# black-holed link — a peer that accepts but never answers — is DETECTED
+# instead of silently pinning the quorum until some later commit stalls
+# on it. GLOBAL-only: link-health policy is fleet-wide.
+_sv("tidb_replica_heartbeat_ms", "1000", scope="global", kind="int",
+    lo=10, hi=3600000, consumed=True)
+# per-IO deadline on ship-link sockets (replaces the old hard 30s): any
+# frame/ack round trip exceeding it breaks the link TYPED
+# (reason=timeout, no reconnect ladder — reconnecting to a black hole is
+# futile), releasing quorum waiters to count the link against potential
+_sv("tidb_replica_heartbeat_timeout_ms", "3000", scope="global", kind="int",
+    lo=10, hi=3600000, consumed=True)
+# bounded quorum wait: a semi-sync ON/QUORUM commit that cannot confirm
+# within this many ms raises the typed indeterminate shape (8150) —
+# durable locally, UNCONFIRMED on the fleet — instead of blocking until
+# KILL/deadline. 0 disables the bound (the pre-PR-19 behavior).
+_sv("tidb_replica_quorum_timeout_ms", "10000", scope="global", kind="int",
+    lo=0, hi=3600000, consumed=True)
 # comma-separated spare WAL directories: on a WAL IO failure the store
 # checkpoints onto the first healthy spare (fresh log, writes resume,
 # zero acks lost) instead of degrading read-only forever; failed media

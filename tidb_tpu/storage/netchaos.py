@@ -1,0 +1,390 @@
+"""Network fault injection for the replica fleet (PR 19) — the chaos
+half of partition hardening.
+
+A `NetChaos` manager wraps each fleet socket endpoint (`_SocketSender`
+→ `StandbyServer`, including the status RPC that shares the port) in a
+frame-aware TCP proxy. The sender attaches to the proxy's address, so
+every byte of the ship wire — frames, acks, HELLO, heartbeats, status
+round trips — can be dropped, delayed, duplicated, black-holed or cut
+per link without touching either endpoint. That is exactly the fault
+surface TiDB's reference deployment delegates to Raft leases and store
+heartbeats, and that log-replica designs (Taurus, arXiv:2506.20010)
+treat as the primary constraint on the quorum ack path.
+
+Every decision routes through the existing failpoint machinery: a rule
+is an armed failpoint named `netchaos/<link>/<kind>`, so chaos runs are
+seedable (`FP.seed`), composable with every other armed site, and
+`tools/crashpoint.py` can hang a ("crash",) action on a chaos site —
+the proxy fires non-decision actions it finds armed there (that is how
+"partition + kill" composes into one round).
+
+Rule kinds, per link:
+
+  * `drop-conn`   — per-c2s-frame decision: cut the connection (flaky
+                    wire; the sender answers with reconnect-resync)
+  * `refuse`      — while armed, new connections are accepted and
+                    immediately closed (the flapper's down phase —
+                    distinct from black-hole: the sender SEES the
+                    failure instantly)
+  * `blackhole-c2s` / `blackhole-s2c` — while armed, that direction is
+                    read and DISCARDED. The TCP connection stays open
+                    and writable: the far side observes silence, not an
+                    error — the failure class the 30s socket timeout
+                    used to hide, and what link heartbeats now break
+                    typed (`reason=timeout`) in ~hundreds of ms
+  * `delay-c2s` / `delay-s2c` — hold the direction's next unit for
+                    `spec` seconds: a float, or `(fixed, jitter)` with
+                    the jittered part drawn from the seeded chaos RNG
+  * `dup-frame`   — per-ship-frame decision: forward the frame twice
+                    (the seq-based idempotent receive must apply once)
+  * `drop-frame`  — per-ship-frame decision: swallow the frame (the
+                    standby sees a seq gap / short ack and the sender
+                    resyncs)
+
+Named partition groups build on the rules: `partition(group, links,
+direction=...)` black-holes every listed link, `direction="c2s"` /
+`"s2c"` makes the partition ASYMMETRIC (frames arrive but acks vanish,
+or vice versa — the split-brain battery's favorite), `heal(group)`
+lifts it. `flap(link, up_s, down_s)` cycles a link through
+refuse+disconnect phases on its own thread.
+
+Lock order (tools/analyze/lock_order.toml): `netchaos.mgr` (56) >
+`netchaos` (61) — both are leaves by design: every failpoint (65)
+arm/decide and every socket op on a snapshotted conn list happens with
+the lock RELEASED, and nothing is ever acquired under either.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+
+from ..utils.failpoint import FP, Failpoints
+
+log = logging.getLogger(__name__)
+
+_FRAME_HDR = struct.Struct("<BII")  # tag, len, crc32 (the ship wire shape)
+# ship frames eligible for dup/drop rules: data frames only — duplicating
+# a SYNC would elicit a second ack and desync the request/response rhythm
+_DATA_TAGS = (0x46, 0x66)  # _TAG_FRAME 'F', _TAG_FRAME_SEQ 'f'
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise ConnectionError("chaos peer closed")
+        buf += got
+    return buf
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """shutdown() BEFORE close(): a plain close() while the peer pump
+    thread is blocked in recv() on the same socket keeps the file alive
+    (the blocked syscall holds its reference), so the FIN never goes out
+    and the far endpoint hangs until its IO deadline instead of seeing
+    the teardown. shutdown() sends the FIN immediately and wakes the
+    blocked recv with EOF."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class ChaosEndpoint:
+    """One frame-aware TCP proxy in front of one StandbyServer port.
+
+    client (c2s) direction is parsed at ship-frame granularity so the
+    frame-level rules (dup/drop/delay per frame) can fire; the server
+    (s2c) direction — acks, HELLO and status replies — pumps opaque
+    chunks (duplicating an ack would desync the sender) and supports
+    the direction rules only (black-hole, delay)."""
+
+    def __init__(self, name: str, upstream_host: str, upstream_port: int,
+                 fp: Failpoints = FP, host: str = "127.0.0.1"):
+        self.name = name
+        self.upstream = (upstream_host, upstream_port)
+        self._fpreg = fp
+        self._lock = threading.Lock()  # "netchaos" (rank 61): conn registry
+        self._conns: list[socket.socket] = []
+        self._closing = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._sock.listen(8)
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"netchaos:{name}", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- rules
+
+    def _site(self, kind: str) -> str:
+        return f"netchaos/{self.name}/{kind}"
+
+    def _armed(self, kind: str) -> bool:
+        return self._fpreg.armed(self._site(kind))
+
+    def _decide(self, kind: str):
+        """Resolve one rule hit. Decision rules (True / ("prob", p) /
+        ("nth", n)) return True when they fire; a composed NON-decision
+        action armed at the site (("crash",), an exception, a callable)
+        fires right here — the chaos site doubles as a failpoint site,
+        which is what lets the crash harness kill the process exactly
+        at a chaos event."""
+        act = self._fpreg.decide(self._site(kind))
+        if act is None or act is True:
+            return act
+        if isinstance(act, (int, float)) and not isinstance(act, bool):
+            return act  # a delay spec
+        if isinstance(act, tuple) and act and act[0] not in ("crash", "sleep"):
+            return act  # (fixed, jitter) delay spec
+        Failpoints._fire(act)
+        return True
+
+    def _delay(self, kind: str) -> None:
+        spec = self._decide(kind)
+        if not spec or spec is True:
+            return
+        if isinstance(spec, tuple):
+            fixed, jitter = float(spec[0]), float(spec[1])
+        else:
+            fixed, jitter = float(spec), 0.0
+        import time
+
+        time.sleep(fixed + jitter * self._fpreg.rand())
+
+    # ------------------------------------------------------------- pumps
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            if self._armed("refuse") or self._closing:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=5.0)
+            except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            with self._lock:
+                self._conns += [conn, up]
+            threading.Thread(target=self._pump_c2s, args=(conn, up),
+                             name=f"netchaos-c2s:{self.name}",
+                             daemon=True).start()
+            threading.Thread(target=self._pump_s2c, args=(conn, up),
+                             name=f"netchaos-s2c:{self.name}",
+                             daemon=True).start()
+
+    def _drop_pair(self, conn: socket.socket, up: socket.socket) -> None:
+        with self._lock:
+            for s in (conn, up):
+                if s in self._conns:
+                    self._conns.remove(s)
+        for s in (conn, up):
+            _hard_close(s)
+
+    def _pump_c2s(self, conn: socket.socket, up: socket.socket) -> None:
+        """Client→server at ship-frame granularity: header + payload are
+        read as a unit so per-frame rules can drop/dup/delay exactly one
+        frame without corrupting the stream for the next."""
+        try:
+            while not self._closing:
+                hdr = _recv_exact(conn, _FRAME_HDR.size)
+                tag, ln, _crc = _FRAME_HDR.unpack(hdr)
+                frame = hdr + (_recv_exact(conn, ln) if ln else b"")
+                if self._decide("drop-conn"):
+                    break
+                self._delay("delay-c2s")
+                if self._armed("blackhole-c2s"):
+                    continue  # read and discarded: silence, not an error
+                if tag in _DATA_TAGS and self._decide("drop-frame"):
+                    continue
+                up.sendall(frame)
+                if tag in _DATA_TAGS and self._decide("dup-frame"):
+                    up.sendall(frame)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._drop_pair(conn, up)
+
+    def _pump_s2c(self, conn: socket.socket, up: socket.socket) -> None:
+        try:
+            while not self._closing:
+                data = up.recv(65536)
+                if not data:
+                    break
+                self._delay("delay-s2c")
+                if self._armed("blackhole-s2c"):
+                    continue
+                conn.sendall(data)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._drop_pair(conn, up)
+
+    # -------------------------------------------------------------- ops
+
+    def kill_connections(self) -> None:
+        """Cut every live connection through this proxy right now (the
+        flapper's disconnect edge; the listener stays up)."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            _hard_close(s)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self.kill_connections()
+
+
+class NetChaos:
+    """Fleet-level chaos: named proxies, per-link rules, named partition
+    groups (incl. asymmetric one-way partitions) and flappers. One
+    instance per test/harness; `close()` disarms every rule it armed."""
+
+    _KINDS = ("drop-conn", "refuse", "blackhole-c2s", "blackhole-s2c",
+              "delay-c2s", "delay-s2c", "dup-frame", "drop-frame")
+
+    def __init__(self, fp: Failpoints = FP):
+        self._fpreg = fp
+        self._mu = threading.Lock()  # "netchaos.mgr" (rank 56)
+        self._proxies: dict[str, ChaosEndpoint] = {}
+        self._groups: dict[str, tuple[tuple[str, ...], str]] = {}
+        self._flappers: dict[str, tuple[threading.Thread, threading.Event]] = {}
+
+    # ------------------------------------------------------------ wiring
+
+    def wrap(self, name: str, host: str, port: int) -> tuple[str, int]:
+        """Put a chaos proxy in front of `host:port` and return the
+        address to attach the ship link (and status RPC) to. With no
+        rules armed the proxy is a transparent relay."""
+        ep = ChaosEndpoint(name, host, port, fp=self._fpreg)
+        with self._mu:
+            if name in self._proxies:
+                ep.close()
+                raise ValueError(f"chaos link {name!r} already wrapped")
+            self._proxies[name] = ep
+        return ep.host, ep.port
+
+    def endpoint(self, name: str) -> ChaosEndpoint:
+        with self._mu:
+            return self._proxies[name]
+
+    # ------------------------------------------------------------- rules
+
+    def rule(self, name: str, kind: str, action=True) -> None:
+        """Arm one rule: `action` is any failpoint action shape — True
+        (always fire), ("prob", p), ("nth", n), a float/(fixed, jitter)
+        delay spec for delay-* kinds, or a composed ("crash",)."""
+        if kind not in self._KINDS:
+            raise ValueError(f"unknown chaos rule kind {kind!r}")
+        self._fpreg.enable(f"netchaos/{name}/{kind}", action)
+
+    def clear(self, name: str, kind: str | None = None) -> None:
+        for k in (self._KINDS if kind is None else (kind,)):
+            self._fpreg.disable(f"netchaos/{name}/{k}")
+
+    # -------------------------------------------------------- partitions
+
+    def partition(self, group: str, links: list[str],
+                  direction: str = "both") -> None:
+        """Named partition: black-hole the listed links. `direction`
+        picks the asymmetric variants — "c2s" (frames/heartbeats never
+        arrive; the far side still answers whoever reaches it), "s2c"
+        (frames ARE delivered and applied but acks vanish: the primary
+        sees a dead link while the standby keeps catching up — the
+        nastiest split-brain precursor), or "both"."""
+        if direction not in ("both", "c2s", "s2c"):
+            raise ValueError(f"bad partition direction {direction!r}")
+        kinds = {"both": ("blackhole-c2s", "blackhole-s2c"),
+                 "c2s": ("blackhole-c2s",), "s2c": ("blackhole-s2c",)}[direction]
+        with self._mu:
+            self._groups[group] = (tuple(links), direction)
+        for l in links:
+            for k in kinds:
+                self._fpreg.enable(f"netchaos/{l}/{k}", True)
+
+    def heal(self, group: str) -> None:
+        """Lift a named partition (black-holed bytes were consumed, not
+        buffered — the link resumes from silence, which is exactly what
+        heartbeat-resync must cope with)."""
+        with self._mu:
+            links, _direction = self._groups.pop(group, ((), "both"))
+        for l in links:
+            self._fpreg.disable(f"netchaos/{l}/blackhole-c2s")
+            self._fpreg.disable(f"netchaos/{l}/blackhole-s2c")
+
+    # ---------------------------------------------------------- flapping
+
+    def flap(self, name: str, up_s: float, down_s: float) -> None:
+        """Cycle one link: up for `up_s`, then refuse + cut connections
+        for `down_s`, repeat until `unflap`/`close`. A flap period below
+        the reconnect budget exercises reconnect-resync without breaking
+        the link; one above the heartbeat deadline breaks it typed."""
+        stop = threading.Event()
+
+        def run() -> None:
+            ep = self.endpoint(name)
+            while not stop.wait(up_s):
+                self._fpreg.enable(f"netchaos/{name}/refuse", True)
+                ep.kill_connections()
+                if stop.wait(down_s):
+                    break
+                self._fpreg.disable(f"netchaos/{name}/refuse")
+            self._fpreg.disable(f"netchaos/{name}/refuse")
+
+        t = threading.Thread(target=run, name=f"netchaos-flap:{name}",
+                             daemon=True)
+        with self._mu:
+            if name in self._flappers:
+                raise ValueError(f"link {name!r} is already flapping")
+            self._flappers[name] = (t, stop)
+        t.start()
+
+    def unflap(self, name: str) -> None:
+        with self._mu:
+            t, stop = self._flappers.pop(name, (None, None))
+        if t is not None:
+            stop.set()
+            t.join(timeout=5.0)
+
+    # ------------------------------------------------------------- close
+
+    def kill_connections(self, name: str) -> None:
+        self.endpoint(name).kill_connections()
+
+    def close(self) -> None:
+        with self._mu:
+            flappers = list(self._flappers)
+            proxies = list(self._proxies.items())
+            groups = list(self._groups)
+        for n in flappers:
+            self.unflap(n)
+        for g in groups:
+            self.heal(g)
+        for name, ep in proxies:
+            self.clear(name)
+            ep.close()
+        with self._mu:
+            self._proxies.clear()

@@ -172,7 +172,7 @@ class _Link:
     __slots__ = (
         "name", "standby", "sender", "base_gseq", "sent_gseq",
         "durable_gseq", "applied_ts", "error", "thread", "reconnects",
-        "route_standby", "ack_wall",
+        "route_standby", "ack_wall", "reason", "hb_wall",
     )
 
     def __init__(self, name: str, base_gseq: int, standby=None, sender=None):
@@ -191,6 +191,10 @@ class _Link:
         # reads served directly) — routing-only, never a promote target
         self.route_standby = None
         self.ack_wall = 0.0  # wall time of the link's newest durable ack
+        # typed break taxonomy (PR 19): peer_closed | io_error | timeout
+        # | partitioned | refused — "" while the link is live
+        self.reason = ""
+        self.hb_wall = 0.0  # wall time of the newest successful wire round trip
 
 
 class ReplicaSet:
@@ -205,11 +209,17 @@ class ReplicaSet:
     RECONNECT_BACKOFF_S = 0.05  # doubles per consecutive failure, capped
     MONITOR_INTERVAL_S = 0.5  # lag-monitor sampling tick
     STATUS_TIMEOUT_S = 1.0  # per-member bound on the status-RPC fan-out
+    HEARTBEAT_MS = 1000  # default tidb_replica_heartbeat_ms (idle-link ping)
+    HEARTBEAT_TIMEOUT_MS = 3000  # default tidb_replica_heartbeat_timeout_ms
+    QUORUM_TIMEOUT_MS = 10000  # default tidb_replica_quorum_timeout_ms
 
     def __init__(self, store, auto_promote: bool = False):
         self.store = store
         self.auto_promote = auto_promote
         self._cond = threading.Condition()
+        # stop() sets this so reconnect-backoff / drain sleeps wake
+        # immediately instead of waiting out the ladder (PR 19)
+        self._stop_event = threading.Event()
         # lag monitor (PR 18): samples per-replica staleness into
         # tidb_replica_lag_seconds on a fixed tick; _mon_lock guards the
         # thread handle + last-tick snapshot only (sampling itself walks
@@ -330,6 +340,7 @@ class ReplicaSet:
         still exercises the real wire — it is never a promote target."""
         _key, cut = self._take_cut(standby_dir)
         sender = _SocketSender(host, port, connect_timeout)
+        sender.io_timeout = self._hb_conf()[1]
         count, applied = sender.connect()
         link = _Link(f"{host}:{port}", cut, sender=sender)
         link.sent_gseq = link.durable_gseq = cut + count
@@ -381,11 +392,27 @@ class ReplicaSet:
         with self._mon_lock:
             self._mon_last = time.time()
 
+    def _hb_conf(self) -> tuple[float, float]:
+        """(heartbeat interval, heartbeat deadline) in seconds, read live
+        from the store's globals so tests/ops can retune a running fleet.
+        The deadline doubles as the socket IO timeout: a black-holed
+        link — open, accepting, never answering — surfaces as a typed
+        `timeout` break within it instead of a 30s stall."""
+        gv = self.store.global_vars
+        try:
+            hb = int(gv.get("tidb_replica_heartbeat_ms", self.HEARTBEAT_MS))
+            tmo = int(gv.get("tidb_replica_heartbeat_timeout_ms",
+                             self.HEARTBEAT_TIMEOUT_MS))
+        except (TypeError, ValueError):
+            hb, tmo = self.HEARTBEAT_MS, self.HEARTBEAT_TIMEOUT_MS
+        return max(hb, 10) / 1e3, max(tmo, 10) / 1e3
+
     def stop(self) -> None:
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
             threads = [l.thread for l in self._links]
+        self._stop_event.set()
         self._mon_wake.set()
         with self._mon_lock:
             mon = self._mon_thread
@@ -425,7 +452,12 @@ class ReplicaSet:
                     # not lag, just an idle link
                     "lag_ms": (round(max(0.0, now_ms - (l.applied_ts >> 18)), 3)
                                if l.applied_ts else 0.0),
-                    "reason": (f"{type(l.error).__name__}: {l.error}"
+                    # typed taxonomy first (peer_closed | io_error |
+                    # timeout | partitioned | refused), detail after —
+                    # CLUSTER_REPLICATION's BROKEN_REASON and the
+                    # broken-link inspection rule render this verbatim
+                    "reason": (f"{l.reason or 'error'}: "
+                               f"{type(l.error).__name__}: {l.error}"
                                if l.error is not None else ""),
                     "ack_wall": l.ack_wall,
                 }
@@ -491,10 +523,15 @@ class ReplicaSet:
 
     def _link_run(self, link: _Link) -> None:
         while True:
+            hb_s, tmo_s = self._hb_conf()
+            if link.sender is not None:
+                link.sender.set_timeout(tmo_s)
             with self._cond:
                 while (not self._stopped and link.error is None
-                       and not (self._queue and self._queue[-1][3] > link.sent_gseq)):
-                    self._cond.wait(self.POLL_S * 4)
+                       and not (self._queue and self._queue[-1][3] > link.sent_gseq)
+                       and not (link.sender is not None
+                                and time.time() - link.hb_wall >= hb_s)):
+                    self._cond.wait(min(self.POLL_S * 4, hb_s / 2))
                 if self._stopped or link.error is not None:
                     return
                 pending = [f for f in self._queue if f[3] > link.sent_gseq]
@@ -510,6 +547,10 @@ class ReplicaSet:
                     break  # FIFO: order on the standby mirrors the log
                 batch.append((gseq, payload, t_enq))
             if not batch:
+                if (link.sender is not None
+                        and time.time() - link.hb_wall >= hb_s
+                        and not self._heartbeat(link)):
+                    return
                 with self._cond:
                     if self._stopped:
                         return
@@ -517,19 +558,32 @@ class ReplicaSet:
                 self._update_lag()
                 continue
             try:
-                count, applied = self._deliver(link, [p for _, p, _ in batch])
+                count, applied = self._deliver(link, batch)
                 if link.base_gseq + count < batch[-1][0]:
                     raise ConnectionError(
                         f"standby acked {count} frames < shipped through "
                         f"gseq {batch[-1][0]} (base {link.base_gseq})"
                     )
+            except TimeoutError as e:
+                # socket.timeout ⊂ OSError, so this arm must come FIRST.
+                # A peer that accepted the frames but never answers (a
+                # black-holed link) is not worth reconnecting to: break
+                # typed within the heartbeat deadline so the link stops
+                # pinning quorum waits — the reconnect ladder is for
+                # peers that FAIL, not peers that stall
+                self._break_link(link, e, reason="timeout")
+                return
             except (ConnectionError, OSError) as e:
-                if link.sender is not None and self._reconnect(link, e):
-                    continue  # resynced: re-walk the queue from the ack point
+                if link.sender is not None:
+                    r = self._reconnect(link, e)
+                    if r is True:
+                        continue  # resynced: re-walk the queue from the ack point
+                    self._break_link(link, e, reason=r)
+                    return
                 self._break_link(link, e)
                 return
             except Exception as e:  # noqa: BLE001 — standby verdict (refusal)
-                self._break_link(link, e)
+                self._break_link(link, e, reason="refused")
                 return
             from ..utils import metrics as M
 
@@ -540,6 +594,7 @@ class ReplicaSet:
                 link.durable_gseq = link.base_gseq + count
                 link.applied_ts = max(link.applied_ts, applied)
                 link.ack_wall = acked_wall
+                link.hb_wall = acked_wall
                 self._prune_locked()
                 self._cond.notify_all()
             M.REPLICA_DURABLE_FRAMES.set(float(count), replica=link.name)
@@ -551,39 +606,91 @@ class ReplicaSet:
             )
             self._update_lag()
 
-    def _deliver(self, link: _Link, payloads: list[bytes]) -> tuple[int, int]:
+    def _deliver(self, link: _Link,
+                 batch: list[tuple[int, bytes, float]]) -> tuple[int, int]:
+        # link-relative frame seqs (gseq − base) ride with the payloads
+        # so the standby's receive is idempotent: a duplicated or
+        # re-shipped frame can neither double-apply nor double-count the
+        # durable horizon (PR 19)
+        payloads = [p for _, p, _ in batch]
+        seqs = [g - link.base_gseq for g, _, _ in batch]
         if link.standby is not None:
-            total = link.standby.receive_frames(payloads)
+            total = link.standby.receive_frames(payloads, seqs=seqs)
             return total, link.standby.applied_ts
-        return link.sender.send_batch(payloads)
+        return link.sender.send_batch(payloads, seqs=seqs)
 
-    def _reconnect(self, link: _Link, cause: Exception) -> bool:
+    def _heartbeat(self, link: _Link) -> bool:
+        """Idle-link liveness probe (PR 19): an empty batch is a bare
+        SYNC marker the standby acks like any other — no protocol change
+        — so a silently dead link breaks typed within the heartbeat
+        deadline instead of lurking until the next real frame stalls a
+        quorum wait. The ack also refreshes the link's applied watermark
+        (follower-read staleness stays honest on an idle fleet). Returns
+        False when the link broke (the ship thread exits)."""
+        try:
+            count, applied = link.sender.send_batch([])
+        except TimeoutError as e:
+            self._break_link(link, e, reason="timeout")
+            return False
+        except (ConnectionError, OSError) as e:
+            r = self._reconnect(link, e)
+            if r is True:
+                return True
+            self._break_link(link, e, reason=r)
+            return False
+        except Exception as e:  # noqa: BLE001 — standby verdict (refusal)
+            self._break_link(link, e, reason="refused")
+            return False
+        from ..utils import metrics as M
+
+        now = time.time()
+        with self._cond:
+            link.reconnects = 0
+            link.hb_wall = now
+            new = link.base_gseq + count
+            if new > link.durable_gseq:
+                link.durable_gseq = new
+                link.ack_wall = now
+            link.applied_ts = max(link.applied_ts, applied)
+            self._prune_locked()
+            self._cond.notify_all()
+        M.REPLICA_APPLIED_TS.set(float(link.applied_ts), replica=link.name)
+        return True
+
+    def _reconnect(self, link: _Link, cause: Exception):
         """Bounded reconnect-with-resync for a socket link: a transient
         wire fault (bit-flip → standby CRC refusal → dropped connection,
         or a plain broken pipe) must not silently degrade semi-sync to
         local-only. Resync restarts from the standby's acked count — the
-        frames it never acked simply re-ship. Returns False once the
-        budget is exhausted (the link then breaks for good)."""
+        frames it never acked simply re-ship. Returns True on a resync;
+        otherwise the typed break reason the caller hands _break_link —
+        "partitioned" once the budget is exhausted without ever reaching
+        the peer, "refused" on a token mismatch (a DIFFERENT standby
+        instance answered), or the cause's own class."""
         from ..utils import metrics as M
 
         reason = "peer_closed" if isinstance(cause, ConnectionError) else "io_error"
         while True:
             with self._cond:
                 if self._stopped or link.error is not None:
-                    return False
+                    return reason
                 link.reconnects += 1
                 attempt = link.reconnects
             if attempt > self.RECONNECT_MAX:
-                return False
+                return "partitioned"
             M.SHIP_RECONNECTS.inc(reason=reason)
-            time.sleep(min(1.0, self.RECONNECT_BACKOFF_S * (2 ** (attempt - 1))))
+            # stop-event-aware backoff (PR 19): fleet shutdown must not
+            # wait out the ladder
+            if self._stop_event.wait(
+                    min(1.0, self.RECONNECT_BACKOFF_S * (2 ** (attempt - 1)))):
+                return reason
             try:
                 link.sender.close()
                 count, applied = link.sender.connect()
             except (ConnectionError, OSError):
                 continue  # counted; try again until the budget runs out
             except TiDBError:
-                return False  # token mismatch: a DIFFERENT standby instance
+                return "refused"  # token mismatch: a DIFFERENT standby instance
             with self._cond:
                 # resync point: everything past the standby's acked count
                 # re-ships (it journals/acks strictly in order, so the
@@ -597,13 +704,26 @@ class ReplicaSet:
             )
             return True
 
-    def _break_link(self, link: _Link, e: Exception) -> None:
+    def _break_link(self, link: _Link, e: Exception,
+                    reason: str | None = None) -> None:
+        from ..utils import metrics as M
+
+        if reason is None:
+            reason = ("timeout" if isinstance(e, TimeoutError)
+                      else "peer_closed" if isinstance(e, ConnectionError)
+                      else "io_error" if isinstance(e, OSError)
+                      else "refused")
         with self._cond:
             link.error = e
+            link.reason = reason
             self._prune_locked()  # a broken link no longer pins the queue
             self._cond.notify_all()
             all_broken = all(l.error is not None for l in self._links)
-        log.warning("WAL shipping to %s stopped: %s", link.name, e)
+        if link.sender is not None and reason in ("timeout", "partitioned"):
+            # terminal typed breaks share the reconnect counter's reason
+            # dimension so dashboards see the new failure classes
+            M.SHIP_RECONNECTS.inc(reason=reason)
+        log.warning("WAL shipping to %s stopped (%s): %s", link.name, reason, e)
         if all_broken:
             log.warning("ALL replica links are broken: semi-sync acks will "
                         "fail until a standby is re-attached")
@@ -681,6 +801,15 @@ class ReplicaSet:
         tracer = getattr(session, "_tracer", None) if session is not None else None
         t0_wall = time.time()
         t0_perf = time.perf_counter()
+        # bounded wait (PR 19): a stalled-but-open majority — every link
+        # live, none acking — must convert into the typed indeterminate
+        # shape instead of pinning the committer until the links break.
+        # 0 disables the bound (the pre-PR-19 wait-forever behavior).
+        try:
+            quorum_timeout_ms = int(self.store.global_vars.get(
+                "tidb_replica_quorum_timeout_ms", self.QUORUM_TIMEOUT_MS))
+        except (TypeError, ValueError):
+            quorum_timeout_ms = self.QUORUM_TIMEOUT_MS
         target = self._durable_target()
         with self._cond:
             while True:
@@ -726,6 +855,16 @@ class ReplicaSet:
                         f"ack(s) required, only {potential} link(s) can "
                         f"still provide one; the commit is durable locally "
                         f"but UNCONFIRMED on the fleet"
+                    )
+                if (quorum_timeout_ms > 0
+                        and (time.time() - t0_wall) * 1e3 >= quorum_timeout_ms):
+                    if mode == "QUORUM":
+                        M.REPLICA_QUORUM.inc(outcome="timeout")
+                    raise CommitIndeterminateError(
+                        f"semi-sync {mode}: no quorum within "
+                        f"tidb_replica_quorum_timeout_ms={quorum_timeout_ms} "
+                        f"({acked} of {need} ack(s)); the commit is durable "
+                        f"locally but UNCONFIRMED on the fleet"
                     )
                 self._cond.wait(self.POLL_S)
                 if session is not None or deadline is not None:
@@ -999,11 +1138,18 @@ class ReplicaRouter:
 
 _FRAME_HDR = struct.Struct("<BII")  # tag, len, crc32
 _TAG_FRAME = 0x46  # 'F'
+# 'f' (PR 19): seq-tagged frame — payload is an 8-byte link-relative
+# frame seq (gseq − base, 1-based) followed by the WAL record, CRC over
+# the whole payload. The seq makes the standby's receive idempotent:
+# chaos-duplicated frames and resync re-ship overlap apply exactly once
+# and never double-count the durable ack. Legacy _TAG_FRAME still works.
+_TAG_FRAME_SEQ = 0x66
 _TAG_SYNC = 0x53  # 'S'
 _TAG_HELLO = 0x48  # 'H' — sender-initiated handshake/resync probe
 _TAG_STATUS = 0x51  # 'Q' — fleet status RPC (CLUSTER_* memtable fan-out)
 _ACK = struct.Struct("<QQ")  # cumulative durable frame count, applied_ts
 _HELLO = struct.Struct("<16sQQ")  # instance token, acked count, applied_ts
+_SEQ = struct.Struct("<Q")
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -1074,8 +1220,23 @@ class _SocketSender:
         self.host = host
         self.port = port
         self.connect_timeout = connect_timeout
+        # per-IO deadline, retuned live from tidb_replica_heartbeat_timeout_ms
+        # by the ship loop: a peer that accepts but never answers (a
+        # black-holed link) surfaces as socket.timeout (TimeoutError)
+        # within it — typed `reason=timeout` — instead of a 30s stall
+        self.io_timeout = ReplicaSet.HEARTBEAT_TIMEOUT_MS / 1e3
         self.token: bytes | None = None
         self.sock: socket.socket | None = None
+
+    def set_timeout(self, seconds: float) -> None:
+        if seconds == self.io_timeout and self.sock is not None:
+            return
+        self.io_timeout = seconds
+        if self.sock is not None:
+            try:
+                self.sock.settimeout(seconds)
+            except OSError:
+                pass
 
     def connect(self) -> tuple[int, int]:
         """(Re)establish the connection and handshake. Returns the
@@ -1086,7 +1247,7 @@ class _SocketSender:
         self.sock = socket.create_connection(
             (self.host, self.port), timeout=self.connect_timeout
         )
-        self.sock.settimeout(30.0)
+        self.sock.settimeout(self.io_timeout)
         self.sock.sendall(_FRAME_HDR.pack(_TAG_HELLO, 0, 0))
         token, count, applied = _HELLO.unpack(_recv_exact(self.sock, _HELLO.size))
         if self.token is None:
@@ -1099,11 +1260,21 @@ class _SocketSender:
             )
         return int(count), int(applied)
 
-    def send_batch(self, payloads: list[bytes]) -> tuple[int, int]:
+    def send_batch(self, payloads: list[bytes],
+                   seqs: list[int] | None = None) -> tuple[int, int]:
         out = bytearray()
-        for p in payloads:
-            out += _FRAME_HDR.pack(_TAG_FRAME, len(p), zlib.crc32(p))
-            out += p
+        if seqs is not None:
+            # seq'd frames: the standby can discard duplicates (resync
+            # overlap, chaos-duplicated frames) instead of re-applying
+            for sq, p in zip(seqs, payloads):
+                body = _SEQ.pack(sq) + p
+                out += _FRAME_HDR.pack(_TAG_FRAME_SEQ, len(body),
+                                       zlib.crc32(body))
+                out += body
+        else:
+            for p in payloads:
+                out += _FRAME_HDR.pack(_TAG_FRAME, len(p), zlib.crc32(p))
+                out += p
         out += _FRAME_HDR.pack(_TAG_SYNC, 0, 0)
         self.sock.sendall(bytes(out))
         count, applied = _ACK.unpack(_recv_exact(self.sock, _ACK.size))
@@ -1171,6 +1342,7 @@ class StandbyServer:
 
     def _serve(self, conn: socket.socket) -> None:
         batch: list[bytes] = []
+        seqs: list[int] = []
         total = self.standby._applied_frames
         while not self._closing:
             tag, ln, crc = _FRAME_HDR.unpack(_recv_exact(conn, _FRAME_HDR.size))
@@ -1182,10 +1354,19 @@ class StandbyServer:
                     # from the last acked count (bounded retries)
                     raise ConnectionError("shipped frame failed CRC check")
                 batch.append(payload)
+            elif tag == _TAG_FRAME_SEQ:
+                payload = _recv_exact(conn, ln)
+                if zlib.crc32(payload) != crc:
+                    raise ConnectionError("shipped frame failed CRC check")
+                seqs.append(_SEQ.unpack_from(payload)[0])
+                batch.append(payload[_SEQ.size:])
             elif tag == _TAG_SYNC:
                 if batch:
-                    total = self.standby.receive_frames(batch)
+                    total = self.standby.receive_frames(
+                        batch, seqs=seqs if seqs else None
+                    )
                     batch = []
+                    seqs = []
                 conn.sendall(_ACK.pack(total, self.standby.applied_ts))
             elif tag == _TAG_HELLO:
                 conn.sendall(_HELLO.pack(

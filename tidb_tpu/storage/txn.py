@@ -1236,13 +1236,22 @@ class Storage:
 
     # --- warm standby: shipped-frame ingest + promotion (PR 14) ------------
 
-    def receive_frames(self, payloads: list[bytes]) -> int:
+    def receive_frames(self, payloads: list[bytes],
+                       seqs: list[int] | None = None) -> int:
         """Standby ingest path (called by the WalShipper / StandbyServer):
         journal every shipped frame into OUR wal (re-framed by the native
         appender — fresh CRC chain, so a reopened standby replay-verifies
         the shipped bytes for free), fsync ONCE per batch (the standby's
         group commit), then replay into memory and advance the applied
         watermark. Returns the total frames applied so far.
+
+        `seqs` carries each frame's 1-based link-relative sequence number
+        (gseq − link base). With it the receive is IDEMPOTENT: frames at
+        or below the applied count (a resync re-ship after reconnect) and
+        adjacent duplicates (a chaos-duplicated wire frame) are discarded
+        before journaling — they can neither double-apply nor advance the
+        durable-ack count twice. A GAP (a dropped seq'd frame) raises, so
+        the connection drops and the sender resyncs from the acked count.
 
         Order matters for the never-ahead invariant: the shipper only
         hands us frames DURABLE on the primary, and we only ack (return)
@@ -1258,6 +1267,22 @@ class Storage:
                 raise TiDBError(
                     "shipped frames refused: store is not (or no longer) a standby"
                 )
+            if seqs is not None:
+                fresh: list[bytes] = []
+                last = self._applied_frames
+                for sq, p in zip(seqs, payloads):
+                    if sq <= last:
+                        continue  # resync overlap or duplicated frame
+                    if sq != last + 1:
+                        raise TiDBError(
+                            f"shipped frame gap: expected seq {last + 1}, "
+                            f"got {sq} — dropping the connection to resync"
+                        )
+                    last = sq
+                    fresh.append(p)
+                if not fresh:
+                    return self._applied_frames
+                payloads = fresh
             wal = self.wal
             for p in payloads:
                 wal.append(p)

@@ -59,6 +59,46 @@ class Failpoints:
         with self._lock:
             return self._hits.get(name, 0)
 
+    def armed(self, name: str) -> bool:
+        """Is the site armed at all? The cheap state gate for rules that
+        model a continuous condition (a black-holed link is black-holed
+        for every byte while armed) rather than a per-hit decision."""
+        with self._lock:
+            return name in self._active
+
+    def decide(self, name: str):
+        """Resolve an armed site WITHOUT firing: returns the resolved
+        action value, or None when the site is disarmed (or this hit's
+        prob/nth decision says no). Hit counting and the conditional
+        decision happen under the same lock as inject(). A bare
+        ("prob", p) / ("nth", n) tuple resolves to True — the
+        decision-rule shape netchaos arms (`should this frame drop?`);
+        a carried action resolves to the action itself so the caller
+        can _fire() it (crashpoint composing a ("crash",) at a chaos
+        site)."""
+        with self._lock:
+            action = self._active.get(name)
+            if action is None:
+                return None
+            hits = self._hits.get(name, 0) + 1
+            self._hits[name] = hits
+            if isinstance(action, tuple) and action:
+                if action[0] == "prob":
+                    if self._rng.random() >= action[1]:
+                        return None
+                    return action[2] if len(action) > 2 else True
+                if action[0] == "nth":
+                    if hits % action[1] != 0:
+                        return None
+                    return action[2] if len(action) > 2 else True
+            return action
+
+    def rand(self) -> float:
+        """One draw from the seeded chaos RNG (jittered delays stay
+        reproducible under FP.seed)."""
+        with self._lock:
+            return self._rng.random()
+
     def inject(self, name: str) -> None:
         """The site call: no-op unless armed. The action lookup, hit-count
         bump and conditional-firing decision happen under ONE lock hold —

@@ -522,10 +522,14 @@ REPLICA_APPLIED_TS = REGISTRY.gauge(
 # outcome=acked: the median per-replica durable horizon covered the
 # commit (a majority of links acked); outcome=unreachable: too many
 # links broken for the quorum to ever form — the wait raised the typed
-# indeterminate shape (8150) instead of blocking forever
+# indeterminate shape (8150) instead of blocking forever;
+# outcome=timeout (PR 19): enough links were nominally alive but the
+# quorum did not form within tidb_replica_quorum_timeout_ms — a stalled
+# majority (black-holed / partitioned peers) raised the same 8150 shape
+# within the bound instead of pinning the commit
 REPLICA_QUORUM = REGISTRY.counter(
     "tidb_replica_quorum_commits_total",
-    "semi-sync QUORUM commit waits by outcome (acked | unreachable)",
+    "semi-sync QUORUM commit waits by outcome (acked | unreachable | timeout)",
 )
 # outcome=follower: a lag-eligible replica served the read;
 # fallback_stale: replicas exist but none could serve THIS statement;
@@ -565,10 +569,15 @@ REPLICA_REJOINS = REGISTRY.counter(
 # a socket ship link reconnecting after a dropped connection (the
 # standby refuses wire-corrupted frames by dropping the connection, so
 # reason=peer_closed covers CRC refusals; reason=io_error is a local
-# socket fault) — bounded retries, then the link breaks for good
+# socket fault) — bounded retries, then the link breaks for good.
+# PR 19 adds the terminal typed breaks: reason=timeout (a frame/ack
+# round trip blew the tidb_replica_heartbeat_timeout_ms deadline — a
+# black-holed peer; no reconnect ladder) and reason=partitioned (the
+# reconnect budget ran dry against an unreachable peer)
 SHIP_RECONNECTS = REGISTRY.counter(
     "tidb_ship_reconnects_total",
-    "ship-link reconnect-with-resync attempts by reason (peer_closed | io_error)",
+    "ship-link reconnect-with-resync attempts by reason (peer_closed | "
+    "io_error | timeout | partitioned)",
 )
 # online WAL media failover: on an IO failure a store with
 # tidb_wal_spare_dirs checkpoints onto a spare and resumes writes

@@ -240,6 +240,7 @@ def _targets():
     from tidb_tpu.storage import compact as _compact
     from tidb_tpu.storage import detector as _detector
     from tidb_tpu.storage import memkv as _memkv
+    from tidb_tpu.storage import netchaos as _netchaos
     from tidb_tpu.storage import regions as _regions
     from tidb_tpu.storage import ship as _ship
     from tidb_tpu.storage import tso as _tso
@@ -294,6 +295,9 @@ def _targets():
         (_regions.RegionMap, "_lock", "regions", False),
         (_tso.TSO, "_lock", "tso", False),
         (_detector.DeadlockDetector, "_lock", "detector", False),
+        # PR 19: network chaos layer (both leaves by design)
+        (_netchaos.NetChaos, "_mu", "netchaos.mgr", False),
+        (_netchaos.ChaosEndpoint, "_lock", "netchaos", False),
     ]
 
 

@@ -55,6 +55,9 @@ _SCOPED_SYSVARS = {
     "tidb_replica_read", "tidb_replica_read_max_lag_ms",
     # PR 18: replica spans adopt into the primary statement trace
     "tidb_enable_trace_propagation",
+    # PR 19: partition hardening — link heartbeats + bounded quorum waits
+    "tidb_replica_heartbeat_ms", "tidb_replica_heartbeat_timeout_ms",
+    "tidb_replica_quorum_timeout_ms",
 }
 _MEMTABLES_MODULE = "tidb_tpu/catalog/memtables.py"
 

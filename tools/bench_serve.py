@@ -652,6 +652,7 @@ def run_replica_fleet(clients_n: int, secs: float, host: str) -> dict:
         text=True, cwd=REPO, env=env,
     )
     replicas = []
+    chaos = None
     out: dict = {"replicas": N_REPLICAS, "secs_per_slice": secs}
     try:
         _read_marker(primary, "BOOTSTRAPPED")
@@ -666,7 +667,17 @@ def run_replica_fleet(clients_n: int, secs: float, host: str) -> dict:
             replicas.append(rp)
             wports.append(int(_read_marker(rp, "WPORT ")))
             rports.append(int(_read_marker(rp, "PORT ")))
-        primary.stdin.write("ATTACH " + " ".join(map(str, wports)) + "\n")
+        # chaos proxy (PR 19) on replica1's WAL wire — the replica phase
+        # B SIGKILLs, NOT the promote target, so any chaos residue on
+        # this wire can never touch the survivor's no-lost-acked gates
+        # (after the kill, acks require the OTHER link durable).
+        # Transparent relay until rules are armed.
+        from tidb_tpu.storage.netchaos import NetChaos
+
+        chaos = NetChaos()
+        _chost, cport = chaos.wrap("replica-chaos", host, wports[0])
+        primary.stdin.write(
+            "ATTACH " + " ".join(map(str, [cport] + wports[1:])) + "\n")
         primary.stdin.flush()
         pport = int(_read_marker(primary, "PORT "))
 
@@ -782,6 +793,75 @@ def run_replica_fleet(clients_n: int, secs: float, host: str) -> dict:
             "replica_ack_seconds": _metric_rows("tidb_replica_ack_seconds"),
         }
 
+        # --- phase A.75: chaos slice (PR 19) — 5% frame drop + 0–20ms
+        # jitter on replica1's WAL wire while semi-sync point-INSERTs and
+        # the select pool run. Dropped seq'd frames force reconnect-
+        # resync cycles; the gates prove (a) every acked insert reads
+        # back on the chaos'd replica once the wire heals (zero lost
+        # acked commits through drop/dup/resync churn) and (b) the
+        # primary's select p99 doesn't collapse — one flaky replica
+        # wire must stay that replica's problem.
+        admin.query("SET GLOBAL tidb_wal_semi_sync = ON")
+        # the 0–20ms per-frame jitter serializes the chaos wire to ~100
+        # frames/s — an UNTHROTTLED writer would pile a backlog whose
+        # delivery blows the heartbeat deadline and (correctly) breaks
+        # the link terminally. The slice measures fault tolerance, not
+        # overload collapse: pace the writer under the wire's capacity
+        # and widen the deadline to absorb resync re-ship bursts.
+        admin.query("SET GLOBAL tidb_replica_heartbeat_timeout_ms = 10000")
+        chaos.rule("replica-chaos", "drop-frame", ("prob", 0.05))
+        chaos.rule("replica-chaos", "delay-c2s", (0.0, 0.02))
+        chaos_secs = min(4.0, secs)
+        cins = admin.prepare("INSERT INTO killtest VALUES (?, ?)")[0]
+        chaos_acked: list[int] = []
+        cdone = [False]
+
+        def chaos_writer() -> None:
+            i = 0
+            while not cdone[0]:
+                rid = (1 << 50) + i
+                i += 1
+                try:
+                    admin.execute(cins, [rid, 7])
+                except (RuntimeError, ConnectionError, OSError):
+                    continue
+                chaos_acked.append(rid)
+                time.sleep(0.02)
+
+        cw = threading.Thread(target=chaos_writer)
+        cw.start()
+        chaos_sel = _drive(conns, "select", chaos_secs).summary(chaos_secs)
+        cdone[0] = True
+        cw.join()
+        chaos.clear("replica-chaos")
+        admin.query("SET GLOBAL tidb_replica_heartbeat_timeout_ms = 3000")
+        creplica = MiniClient(host, rports[0])
+        want_ids = set(chaos_acked)
+        heal_deadline = time.time() + 30.0
+        missing = want_ids
+        while time.time() < heal_deadline:
+            present = {int(x) for x in creplica.query_col(
+                f"SELECT id FROM killtest WHERE id >= {1 << 50}")}
+            missing = want_ids - present
+            if not missing:
+                break
+            time.sleep(0.25)
+        creplica.close()
+        out["chaos"] = {
+            "acked_inserts": len(chaos_acked),
+            "lost_acked_after_heal": sorted(missing)[:20],
+            "select_under_chaos": chaos_sel,
+            "baseline_p99_ms": baseline["p99_ms"],
+            "gate_chaos_no_lost_acked": not missing,
+            # a flaky replica wire must not collapse the primary: the
+            # same 3x no-collapse bound every timeshared phase uses
+            "gate_chaos_primary_p99_no_collapse": (
+                chaos_sel["p99_ms"] is not None
+                and baseline["p99_ms"] is not None
+                and chaos_sel["p99_ms"] <= baseline["p99_ms"] * 3.0
+            ),
+        }
+
         # --- phase B: kill-a-replica + promote-under-load
         admin.query("SET GLOBAL tidb_wal_semi_sync = ON")
         writers = conns[: max(4, clients_n // 4)]
@@ -850,11 +930,15 @@ def run_replica_fleet(clients_n: int, secs: float, host: str) -> dict:
         out["pass"] = bool(
             out["follower_read"]["gate_scale"]
             and out["follower_read"]["gate_primary_p99_no_worse"]
+            and out["chaos"]["gate_chaos_no_lost_acked"]
+            and out["chaos"]["gate_chaos_primary_p99_no_collapse"]
             and out["failover_under_load"]["gate_no_lost_acked_commit"]
             and out["failover_under_load"]["gate_acks_continue_after_kill"]
         )
         return out
     finally:
+        if chaos is not None:
+            chaos.close()
         for p in [primary] + replicas:
             if p.poll() is None:
                 try:

@@ -198,11 +198,45 @@ def _child_main(args) -> None:
         ship = WalShipper(store)
         dirs = [args.standby_dir]
         dirs += [d for d in (args.quorum_dirs or "").split(",") if d]
-        for d in dirs:
-            ship.bootstrap(d)
-            sb = Storage(data_dir=d, standby=True)
-            ship.attach(sb)
-            standbys.append(sb)
+        if args.netchaos:
+            # partition+kill composition (PR 19): the fleet runs over
+            # REAL sockets behind chaos proxies, heartbeats tuned fast
+            # so a black-holed link breaks typed well inside the round;
+            # a driver thread arms an asymmetric partition on the last
+            # link mid-workload, then the parent's SIGKILL lands while
+            # the partition is live — recovery must still hold every
+            # quorum invariant
+            from tidb_tpu.storage.netchaos import NetChaos
+            from tidb_tpu.storage.ship import StandbyServer
+
+            store.global_vars["tidb_replica_heartbeat_ms"] = "100"
+            store.global_vars["tidb_replica_heartbeat_timeout_ms"] = "400"
+            store.global_vars["tidb_replica_quorum_timeout_ms"] = "5000"
+            chaos = NetChaos()
+            for i, d in enumerate(dirs):
+                ship.bootstrap(d)
+                sb = Storage(data_dir=d, standby=True)
+                srv = StandbyServer(sb)
+                host, port = chaos.wrap(f"sb{i}", "127.0.0.1", srv.port)
+                ship.attach_socket(host, port, standby_dir=d, standby=sb)
+                standbys.append(sb)
+
+            def partition_driver() -> None:
+                # acks vanish on ONE link (frames still arrive): the
+                # nastiest split-brain precursor — quorum stays 2 of 3
+                time.sleep(1.2)
+                chaos.partition("crash-round", [f"sb{len(dirs) - 1}"],
+                                direction="s2c")
+                say("PARTITIONED")
+
+            threading.Thread(target=partition_driver, daemon=True,
+                             name="partition-driver").start()
+        else:
+            for d in dirs:
+                ship.bootstrap(d)
+                sb = Storage(data_dir=d, standby=True)
+                ship.attach(sb)
+                standbys.append(sb)
         if args.quorum_dirs:
             store.global_vars["tidb_wal_semi_sync"] = "QUORUM"
         elif args.semi_sync:
@@ -979,18 +1013,25 @@ def run_round(
     kill_after: float | None = None,
     standby: bool = False,
     semi_sync: bool = False,
+    partition: bool = False,
 ) -> tuple[bool, str]:
     """One spawn→kill→verify cycle. → (ok, detail). `standby=True` runs
     the child with an in-process warm standby (kill-primary→promote
-    verification); named sites pull their topology from NEEDS_*."""
+    verification); named sites pull their topology from NEEDS_*.
+    `partition=True` (PR 19) runs the QUORUM fleet over sockets behind
+    chaos proxies, arms an asymmetric partition mid-workload, and the
+    random SIGKILL lands while the partition is live."""
     rng = random.Random(seed)
     workdir = tempfile.mkdtemp(prefix="crashpoint-")
     data_dir = os.path.join(workdir, "data")
     cdc_path = os.path.join(workdir, "cdc.jsonl")
     rejoin = crashpoint in NEEDS_REJOIN
-    quorum = crashpoint in NEEDS_QUORUM
+    quorum = crashpoint in NEEDS_QUORUM or partition
     standby = standby or crashpoint in NEEDS_STANDBY or quorum or rejoin
     semi_sync = semi_sync or crashpoint in NEEDS_STANDBY or rejoin
+    if partition and kill_after is None:
+        # the partition driver arms at ~1.2s; the kill must land after
+        kill_after = rng.uniform(1.6, 3.0)
     spare_dir = os.path.join(workdir, "spare") if crashpoint in NEEDS_SPARE else None
     standby_dir = os.path.join(workdir, "standby") if standby else None
     quorum_dirs = [
@@ -1008,6 +1049,8 @@ def run_round(
             cmd += ["--semi-sync"]
     if quorum_dirs:
         cmd += ["--quorum-dirs", ",".join(quorum_dirs)]
+    if partition:
+        cmd += ["--netchaos"]
     if rejoin:
         cmd += ["--rejoin"]
     if spare_dir:
@@ -1089,6 +1132,11 @@ def run_round(
                 _verify_quorum(dirs, primary_state, acks,
                                need=(len(dirs) + 1) // 2)
                 marker = f" [quorum fleet verified: {len(dirs)} standbys]"
+                if partition:
+                    marker += (" [partition was live]"
+                               if any(l.startswith("PARTITIONED")
+                                      for l in lines)
+                               else " [kill landed pre-partition]")
             elif standby_dir:
                 _verify_standby(standby_dir, primary_state, acks, semi_sync)
                 marker = " [standby promoted+verified]"
@@ -1122,6 +1170,9 @@ def main() -> int:
     ap.add_argument("--quorum-dirs", default=None,
                     help="(child) extra standby dirs, comma-separated: the "
                          "fleet runs tidb_wal_semi_sync=QUORUM")
+    ap.add_argument("--netchaos", action="store_true",
+                    help="(child) attach the quorum fleet over sockets behind "
+                         "chaos proxies and arm a mid-workload partition")
     ap.add_argument("--rejoin", action="store_true",
                     help="(child) run the fence→promote→rejoin driver thread")
     ap.add_argument("--spare-dir", default=None,
@@ -1137,6 +1188,10 @@ def main() -> int:
     ap.add_argument("--rejoin-rounds", type=int, default=0,
                     help="promote→rejoin→promote-again ping-pong rounds "
                          "(single process, two dirs trading the primary role)")
+    ap.add_argument("--partition-rounds", type=int, default=0,
+                    help="random partition+SIGKILL rounds (socket QUORUM "
+                         "fleet behind chaos proxies, asymmetric partition "
+                         "armed mid-workload)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--keep", action="store_true", help="keep survivor dirs")
     ap.add_argument("--max-seconds", type=float, default=45.0)
@@ -1149,27 +1204,32 @@ def main() -> int:
     seed = args.seed if args.seed is not None else random.SystemRandom().randrange(1 << 30)
     print(f"crashpoint harness: seed={seed} (replay with --seed {seed})", flush=True)
 
-    plan: list[tuple[str | None, int, bool]] = []
+    plan: list[tuple[str | None, int, bool, bool]] = []
     if args.matrix:
-        plan += [(cp, seed + i, False) for i, cp in enumerate(sorted(CRASHPOINTS))]
+        plan += [(cp, seed + i, False, False)
+                 for i, cp in enumerate(sorted(CRASHPOINTS))]
     if args.crashpoint:
-        plan.append((args.crashpoint, seed, False))
+        plan.append((args.crashpoint, seed, False, False))
     for i in range(args.rounds):
-        plan.append((None, seed + 1000 + i, False))
+        plan.append((None, seed + 1000 + i, False, False))
     for i in range(args.failover_rounds):
-        plan.append((None, seed + 2000 + i, True))
+        plan.append((None, seed + 2000 + i, True, False))
+    for i in range(args.partition_rounds):
+        plan.append((None, seed + 3000 + i, False, True))
     if not plan and not args.rejoin_rounds:
         ap.error("nothing to do: pass --matrix, --crashpoint, --rounds N, "
-                 "--failover-rounds N and/or --rejoin-rounds N")
+                 "--failover-rounds N, --partition-rounds N and/or "
+                 "--rejoin-rounds N")
 
     failures = 0
     t0 = time.time()
-    for i, (cp, round_seed, fo) in enumerate(plan):
+    for i, (cp, round_seed, fo, part) in enumerate(plan):
         label = cp or (f"kill-primary-promote[{round_seed}]" if fo
+                       else f"partition+kill[{round_seed}]" if part
                        else f"random-kill[{round_seed}]")
         ok, detail = run_round(cp, round_seed, keep=args.keep,
                                max_seconds=args.max_seconds,
-                               standby=fo, semi_sync=fo)
+                               standby=fo, semi_sync=fo, partition=part)
         status = "ok" if ok else "FAIL"
         print(f"  [{i + 1}/{len(plan)}] {label}: {status} — {detail}", flush=True)
         if not ok:
@@ -1180,7 +1240,7 @@ def main() -> int:
               f"{'ok' if ok else 'FAIL'} — {detail}", flush=True)
         if not ok:
             failures += 1
-        plan.append((None, seed, False))  # count it in the round total
+        plan.append((None, seed, False, False))  # count it in the round total
     dt = time.time() - t0
     verdict = "green" if failures == 0 else f"{failures} FAILURE(S)"
     print(f"crash matrix: {verdict} ({len(plan)} round(s), {dt:.0f}s, seed={seed})")
