@@ -50,6 +50,9 @@ _LOCK_HUNT_MODULES = {
     # PR 19: chaos proxies + heartbeat/quorum-timeout paths — the
     # netchaos leaves vs the wal.ship/standby/failpoint chain
     "test_net_chaos",
+    # PR 20: the workload-profile leaf vs the cop client's route path
+    # (engine placement lock, tile-cache invalidation cascade)
+    "test_workload_route",
 }
 
 

@@ -237,7 +237,15 @@ class TestBreakerProof:
         """Acceptance: under persistent device faults `auto` keeps
         answering from the host after <= threshold (+ in-flight window)
         faults — no per-query exception cost thereafter — and the TPU
-        path comes back after the cooldown once the failpoint disarms."""
+        path comes back after the cooldown once the failpoint disarms.
+
+        Feedback routing (PR 20) is switched OFF here: this test pins
+        the BREAKER's economics (trip cap, freeze, probe recovery),
+        which requires `auto` to keep attempting the device; with the
+        workload profile armed, the baseline pass would teach the
+        router the host walls and it would stop touching the breaker
+        at all (its own suite covers that interplay)."""
+        s.execute("SET GLOBAL tidb_tpu_feedback_route = 'OFF'")
         base = _baseline(s)
         eng = s.cop.tpu
         # pin the mesh to ONE lane: this test proves the single-breaker

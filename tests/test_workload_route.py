@@ -1,0 +1,393 @@
+"""Workload-history plane (ISSUE 20 acceptance): (a) per-(digest, row
+bucket) profiles feed the auto-engine router — first sight explores via
+the static heuristic, repeats exploit the measured walls; (b) overrides
+(mem degrade, runaway quarantine) beat any history; (c) a digest whose
+device attempts are all typed lowering declines routes straight to host;
+(d) profiles invalidate on schema AND data version bumps; (e) either
+route returns bit-identical rows, and SET GLOBAL
+tidb_tpu_feedback_route=OFF recovers the static heuristics live; plus
+the BURSTABLE headroom-borrow semantics and the resident-bytes ledger
+rows this PR adds."""
+
+import threading
+
+import pytest
+
+from tidb_tpu.sched import AdmissionScheduler, SchedCtx, ru_cost
+from tidb_tpu.sched.resource_group import TokenBucket
+from tidb_tpu.session import Session
+from tidb_tpu.utils import metrics as M
+from tidb_tpu.utils.workload import (
+    REEXPLORE_EVERY,
+    WorkloadProfile,
+    bucket_rows,
+)
+
+# one digest, literals masked: every span of t below shares this profile
+Q = "SELECT COUNT(*), SUM(v) FROM t WHERE id >= {lo} AND id < {hi}"
+
+
+@pytest.fixture()
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    sess.execute(
+        "INSERT INTO t VALUES " + ",".join(f"({i}, {i % 7})" for i in range(4096))
+    )
+    sess.vars["tidb_enable_cop_result_cache"] = "OFF"
+    sess.store.workload.clear()
+    return sess
+
+
+def _route_delta(sess, sql):
+    before = dict(sess.cop.stats)
+    rs = sess.execute(sql)
+    d = {k: sess.cop.stats[k] - before.get(k, 0) for k in sess.cop.stats}
+    return rs, d
+
+
+class TestProfilePlane:
+    def test_observe_builds_entry_and_memtable_row(self, s):
+        q = Q.format(lo=0, hi=2048)
+        s.execute(q)
+        snap = s.store.workload.snapshot()
+        assert len(snap) == 1
+        e = snap[0]
+        assert e["bucket"] == 2048
+        assert e["execs"] == 1
+        assert e["device_runs"] == 1 and e["device_task_ms"] > 0.0
+        assert e["tables"], "invalidation index must know the scanned table"
+        rows = s.execute(
+            "SELECT KIND, DIGEST, ROW_BUCKET, EXECS FROM "
+            "information_schema.tidb_workload_profile WHERE KIND = 'profile'"
+        ).rows()
+        assert rows == [("profile", e["digest"], "2048", "1")]
+
+    def test_explore_then_exploit_flip(self, s):
+        """First sight explores (static arm → device for a 2048-row agg
+        span); once the profile holds BOTH walls the router exploits the
+        cheaper engine. Host evidence is implanted by running the same
+        digest under the forced host engine — the device EWMA includes
+        real compile+dispatch wall, so host wins the comparison
+        deterministically on a cold store."""
+        q = Q.format(lo=0, hi=2048)
+        _, d = _route_delta(s, q)
+        assert d["route_decisions"] == 1 and d["route_explore"] == 1
+        assert d["tpu_tasks"] == 1  # static arm sent the span to device
+        assert s.cop.last_route["reason"] == "explore"
+        s.execute("SET tidb_cop_engine = 'host'")
+        for _ in range(3):
+            s.execute(q)
+        s.execute("SET tidb_cop_engine = 'auto'")
+        _, d = _route_delta(s, q)
+        assert d["route_decisions"] == 1 and d["route_history"] == 1
+        assert d["host_tasks"] == 1 and d["tpu_tasks"] == 0
+        assert s.cop.last_route["reason"] == "history_host"
+        assert "vs host" in s.cop.last_route["evidence"]
+
+    def test_reexplore_returns_none_periodically(self):
+        wl = WorkloadProfile()
+        c_dev = {"tasks": 1, "processed_rows": 2048, "tpu_tasks": 1,
+                 "device_task_ms": 5.0}
+        c_host = {"tasks": 1, "processed_rows": 2048, "host_tasks": 1,
+                  "host_ms": 1.0}
+        wl.observe("d1", c_dev)
+        wl.observe("d1", c_host)
+        verdicts = [wl.decide("d1", 2048) for _ in range(REEXPLORE_EVERY)]
+        assert verdicts[-1] is None, "every Nth decision re-runs the static arm"
+        assert all(v == ("host", "history_host", v[2]) for v in verdicts[:-1])
+
+    def test_sibling_bucket_borrow(self):
+        """A one-sided bucket borrows the missing engine's RAW per-task
+        wall from the nearest sibling within two octaves; farther
+        siblings are no evidence (explore)."""
+        wl = WorkloadProfile()
+        wl.observe("d1", {"tasks": 1, "processed_rows": 1024, "host_tasks": 1,
+                          "host_ms": 1.0})
+        wl.observe("d1", {"tasks": 1, "processed_rows": 2048, "tpu_tasks": 1,
+                          "device_task_ms": 9.0})
+        side, reason, ev = wl.decide("d1", 2048)
+        assert side == "host" and reason == "history_host"
+        assert "sibling b1024" in ev
+        wl2 = WorkloadProfile()
+        wl2.observe("d2", {"tasks": 1, "processed_rows": 256, "host_tasks": 1,
+                           "host_ms": 1.0})
+        wl2.observe("d2", {"tasks": 1, "processed_rows": 8192, "tpu_tasks": 1,
+                           "device_task_ms": 9.0})
+        assert wl2.decide("d2", 8192) is None  # >2 octaves: explore
+
+    def test_lru_capacity_bounded(self):
+        wl = WorkloadProfile(capacity=4)
+        for i in range(10):
+            wl.observe(f"d{i}", {"tasks": 1, "processed_rows": 512,
+                                 "host_tasks": 1, "host_ms": 1.0})
+        assert len(wl) == 4
+        assert wl.decide("d0", 512) is None  # evicted
+        snap = wl.snapshot()
+        assert [e["digest"] for e in snap] == ["d9", "d8", "d7", "d6"]
+
+
+class TestOverridesAndDeclines:
+    def test_mem_degrade_overrides_history(self, s):
+        """Learned device preference must not survive the server soft
+        memory limit: degraded stores route auto tasks host-side with the
+        typed reason, history or not."""
+        q = Q.format(lo=0, hi=2048)
+        s.execute(q)  # seed history (device evidence)
+        s.store.mem.degraded = True
+        try:
+            _, d = _route_delta(s, q)
+        finally:
+            s.store.mem.degraded = False
+        assert d["mem_degraded_tasks"] == 1 and d["host_tasks"] == 1
+        assert s.cop.last_route == {
+            "decision": "host", "reason": "mem_degrade",
+            "evidence": "server over soft memory limit",
+        }
+
+    def test_quarantine_overrides_history(self, s):
+        """A COOLDOWN-demoted statement routes host even when its digest
+        carries excellent device history (the watch demotion is the
+        runaway plane's verdict; routing must not ride around it)."""
+        routes0 = M.TPU_ROUTE.value(decision="host", reason="quarantine")
+        rc = type("RC", (), {"demoted": True})()
+        sctx = SchedCtx(digest="deadbeef", feedback=True, runaway=rc)
+        st = s.cop._stats_fn(None)
+        eng = s.cop._route_auto(None, None, sctx, st, None)
+        assert eng == "host"
+        assert s.cop.last_route["reason"] == "quarantine"
+        assert M.TPU_ROUTE.value(
+            decision="host", reason="quarantine") == routes0 + 1
+
+    def test_learned_decline_goes_straight_to_host(self, s):
+        """CAST-to-string predicates take the device path and come back
+        as typed lowering declines; after one observed exec the digest
+        routes straight to host — no further plan-for round-trips."""
+        q = "SELECT COUNT(*) FROM t WHERE CAST(v AS CHAR) = '1' AND id < 4096"
+        _, d = _route_delta(s, q)
+        assert d["tpu_tasks"] == 1 and d["lowering_declines"] == 1
+        _, d = _route_delta(s, q)
+        assert d["tpu_tasks"] == 0 and d["host_tasks"] == 1
+        assert s.cop.last_route["reason"] == "learned_decline"
+        snap = [e for e in s.store.workload.snapshot() if e["declines"]]
+        assert snap and snap[0]["device_runs"] == 0
+
+    def test_decline_learning_unit(self):
+        wl = WorkloadProfile()
+        wl.observe("d1", {"tasks": 2, "processed_rows": 8192, "tpu_tasks": 2,
+                          "lowering_declines": 2, "device_task_ms": 3.0})
+        side, reason, ev = wl.decide("d1", 4096)
+        assert (side, reason) == ("host", "learned_decline")
+        assert "declines:2/attempts:2" in ev
+        # one real device run anywhere in the digest clears the verdict
+        wl.observe("d1", {"tasks": 1, "processed_rows": 8192, "tpu_tasks": 1,
+                          "device_task_ms": 3.0})
+        assert wl.decide("d1", 4096) != ("host", "learned_decline", ev)
+
+
+class TestInvalidation:
+    def test_schema_version_bump_invalidates(self, s):
+        q = Q.format(lo=0, hi=2048)
+        s.execute(q)
+        assert len(s.store.workload) == 1
+        s.execute("ALTER TABLE t ADD COLUMN w INT")
+        assert len(s.store.workload) == 0
+        assert s.store.workload.invalidations >= 1
+
+    def test_data_version_bump_invalidates(self, s):
+        q = Q.format(lo=0, hi=2048)
+        s.execute(q)
+        assert len(s.store.workload) == 1
+        s.execute("INSERT INTO t VALUES (90001, 1)")
+        assert len(s.store.workload) == 0, \
+            "a committed write moves the table's data version; measured " \
+            "walls for it are stale and must drop"
+
+    def test_unrelated_table_survives(self, s):
+        s.execute("CREATE TABLE u (id INT PRIMARY KEY, v INT)")
+        s.execute("INSERT INTO u VALUES " + ",".join(
+            f"({i}, {i})" for i in range(2048)))
+        s.store.workload.clear()
+        s.execute(Q.format(lo=0, hi=2048))
+        n0 = len(s.store.workload)
+        assert n0 >= 1
+        s.execute("INSERT INTO u VALUES (90001, 1)")  # bump OTHER table
+        assert len(s.store.workload) == n0
+
+    def test_concurrent_observe_decide_invalidate(self, s):
+        """The profile leaf lock under fire from all three paths at once
+        (also the ANALYZE_LOCKS hunt target for this module)."""
+        wl = s.store.workload
+        stop = threading.Event()
+        errors = []
+
+        def feeder():
+            i = 0
+            while not stop.is_set():
+                try:
+                    wl.observe(f"d{i % 8}", {
+                        "tasks": 1, "processed_rows": 1024 << (i % 3),
+                        "tpu_tasks": 1, "device_task_ms": 2.0,
+                    }, tables=(7, 9))
+                    wl.decide(f"d{i % 8}", 2048)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+                i += 1
+
+        def invalidator():
+            while not stop.is_set():
+                try:
+                    wl.invalidate_table(7)
+                    wl.invalidate_prefixes([b"t" + b"\x00" * 8])
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=feeder) for _ in range(3)]
+        threads += [threading.Thread(target=invalidator)]
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        wl.clear()
+
+
+class TestRecoveryAndIdentity:
+    def test_bit_identical_either_route(self, s):
+        q = Q.format(lo=0, hi=2048)
+        s.execute("SET tidb_cop_engine = 'tpu'")
+        dev = s.execute(q).rows()
+        s.execute("SET tidb_cop_engine = 'host'")
+        host = s.execute(q).rows()
+        s.execute("SET tidb_cop_engine = 'auto'")
+        auto = s.execute(q).rows()
+        assert dev == host == auto
+
+    def test_feedback_off_recovers_static_live(self, s):
+        """SET GLOBAL tidb_tpu_feedback_route=OFF mid-flight: routing
+        accounting stops dead, results stay identical, the profile stops
+        growing, and the static min-rows arm resumes verbatim (a 512-row
+        span routes host again even though history said device)."""
+        q = Q.format(lo=0, hi=2048)
+        on_rows, d = _route_delta(s, q)
+        assert d["route_decisions"] == 1
+        s.execute("SET GLOBAL tidb_tpu_feedback_route = 'OFF'")
+        try:
+            n0 = len(s.store.workload)
+            off_rows, d = _route_delta(s, q)
+            assert d["route_decisions"] == 0 and d["route_explore"] == 0
+            assert off_rows.rows() == on_rows.rows()
+            assert len(s.store.workload) == n0, "OFF must not feed profiles"
+            _, d = _route_delta(s, Q.format(lo=0, hi=512))
+            assert d["host_tasks"] == 1 and d["tpu_tasks"] == 0
+        finally:
+            s.execute("SET GLOBAL tidb_tpu_feedback_route = 'ON'")
+        _, d = _route_delta(s, q)
+        assert d["route_decisions"] == 1  # live again, no restart
+
+    def test_explain_analyze_route_line(self, s):
+        q = Q.format(lo=0, hi=2048)
+        s.execute(q)
+        lines = [r[0] for r in s.execute("EXPLAIN ANALYZE " + q).rows()]
+        route = [l for l in lines if l.startswith("route:")]
+        assert len(route) == 1
+        assert "decisions:1" in route[0]
+        assert "reason:" in route[0] and "evidence:[" in route[0]
+
+    def test_route_decide_span_recorded(self, s):
+        q = Q.format(lo=0, hi=2048)
+        s.execute("SET tidb_enable_trace = 'ON'")
+        s.execute(q)
+        ops = [r[0] for r in s.execute(
+            "SELECT OPERATION FROM information_schema.tidb_trace"
+        ).rows()]
+        assert "route.decide" in ops
+
+
+class TestBurstable:
+    def test_bucket_headroom_borrow_semantics(self):
+        b = TokenBucket(10.0, burstable=True)
+        nb = TokenBucket(10.0, burstable=False)
+        for x in (b, nb):
+            x.debit(100.0)  # deep debt
+            assert x.available() <= 0.0
+        assert b.admissible(headroom=True), \
+            "burstable + measured headroom borrows through debt"
+        assert not b.admissible(headroom=False), \
+            "no headroom: burstable throttles at its reserved rate"
+        assert not nb.admissible(headroom=True), \
+            "non-burstable never borrows"
+        free = TokenBucket(0.0)
+        assert free.admissible(headroom=False)  # rate 0 stays unlimited
+
+    def test_scheduler_reports_headroom(self):
+        class _Store:
+            class groups:
+                @staticmethod
+                def get(name):
+                    from tidb_tpu.sched.resource_group import ResourceGroup
+                    return ResourceGroup("default", 0, "MEDIUM", True)
+
+        sched = AdmissionScheduler(_Store(), max_concurrency=4)
+        with sched._cond:
+            assert sched._headroom_locked()  # idle store: below 75%
+            sched._running = 3
+            assert not sched._headroom_locked()  # 3/4 = at the borrow line
+            sched._running = 0
+
+    def test_burstable_group_borrows_idle_store(self, s):
+        """RU_PER_SEC=1 BURSTABLE on an idle store: repeated statements
+        keep being admitted by borrowing headroom (a non-burstable bucket
+        at that rate would owe seconds of refill between them)."""
+        s.execute("CREATE RESOURCE GROUP rb RU_PER_SEC = 1 BURSTABLE = TRUE")
+        s.execute("SET tidb_resource_group = 'rb'")
+        try:
+            q = Q.format(lo=0, hi=1024)
+            for _ in range(4):
+                rs = s.execute(q)
+            assert rs.rows()
+            g = s.store.sched.groups.get("rb")
+            assert g.bucket.burstable
+            assert g.bucket.available() < 0.0, \
+                "debt accrued — borrowing is charged, not free"
+        finally:
+            s.execute("SET tidb_resource_group = 'default'")
+
+    def test_ru_cpu_term(self):
+        assert ru_cost(0) == 1.0
+        assert ru_cost(0, 0.0, 3.0) == 2.0  # 3ms host CPU = 1 RU
+        assert ru_cost(1024, 65536.0, 6.0) == 5.0
+
+    def test_host_path_charges_cpu_ru(self, s):
+        """The same span costs MORE RU via the host engine than the
+        device engine: only the host path has a measured host-engine
+        wall to charge (the reference's CPUMsCost term)."""
+        q = Q.format(lo=0, hi=2048)
+        s.execute("SET tidb_cop_engine = 'host'")
+        _, dh = _route_delta(s, q)
+        s.execute("SET tidb_cop_engine = 'tpu'")
+        s.execute(q)  # warm compile so the device run's RU settles clean
+        _, dd = _route_delta(s, q)
+        assert dh["ru"] > dd["ru"], \
+            f"host ru {dh['ru']} must include the CPU term (device {dd['ru']})"
+
+
+class TestResidentBytes:
+    def test_gauges_and_memtable_rows(self, s):
+        s.execute(Q.format(lo=0, hi=4096))  # populate tile + mirror
+        rows = s.execute(
+            "SELECT DIGEST, BYTES FROM information_schema.tidb_workload_profile "
+            "WHERE KIND = 'resident'"
+        ).rows()
+        by_kind = {k: int(v) for k, v in rows}
+        assert set(by_kind) == {"tile", "build", "batch"}
+        assert by_kind["tile"] > 0, "a scanned span leaves a cached tile"
+        assert by_kind["batch"] > 0, "a device run leaves a wire mirror"
+        for kind, v in by_kind.items():
+            assert M.TPU_RESIDENT_BYTES.value(kind=kind) == float(v), \
+                "the memtable read IS the gauge refresh point"

@@ -176,6 +176,24 @@ SCHEMAS: dict[str, tuple[list[str], list]] = {
         ["FUNCTION", "PERCENT_ABS", "PERCENT_PARENT", "SAMPLES", "DEPTH"],
         [ft_varchar(512), ft_double(), ft_double(), ft_longlong(), ft_longlong()],
     ),
+    # --- PR 20: workload-history plane -------------------------------------
+    "tidb_workload_profile": (
+        # KIND=profile: one row per (statement digest, row-count bucket)
+        # the workload profile observed (utils/workload.py) — the exact
+        # evidence the auto-engine router cites: EWMA per-task walls for
+        # both engines, compile/wire/wait costs, typed declines, and how
+        # many routing decisions exploited this entry. KIND=resident: one
+        # row per device-path cache pool (tile | build | batch) with its
+        # live byte footprint (the same figures the
+        # tidb_tpu_resident_bytes gauge exports); profile columns read 0.
+        ["KIND", "DIGEST", "ROW_BUCKET", "EXECS", "DEVICE_RUNS", "HOST_RUNS",
+         "DEVICE_TASK_MS", "HOST_TASK_MS", "COMPILE_MS", "WIRE_BYTES",
+         "SCHED_WAIT_MS", "DECLINES", "DECISIONS", "BYTES", "TABLES"],
+        [ft_varchar(16), ft_varchar(32), ft_longlong(), ft_longlong(),
+         ft_longlong(), ft_longlong(), ft_double(), ft_double(), ft_double(),
+         ft_longlong(), ft_double(), ft_longlong(), ft_longlong(),
+         ft_longlong(), ft_varchar(64)],
+    ),
 }
 
 
@@ -420,6 +438,8 @@ def rows_for(session, name: str) -> list[list[Datum]]:
         return [[Datum.i(int(v)) for v in row] for row in compaction_rows(session)]
     if name == "tidb_profile_cpu":
         return _cpu_profile_rows(session)
+    if name == "tidb_workload_profile":
+        return _workload_profile_rows(session)
     if name == "inspection_result":
         return _inspection_rows(session)
     if name == "cluster_replication":
@@ -450,6 +470,56 @@ def rows_for(session, name: str) -> list[list[Datum]]:
                 ])
         return out
     raise KeyError(name)
+
+
+def _workload_profile_rows(session) -> list:
+    """Profile rows (MRU first) from the store's workload-history plane,
+    then one residency row per device-path cache pool. Reading the table
+    is also the `tidb_tpu_resident_bytes` gauge's refresh point: byte
+    ledgers live inside cache locks, so the gauge samples on pull (a
+    metrics scrape after a memtable read sees the same figures the SQL
+    row reported) rather than on every cache mutation."""
+    from ..utils import metrics as M
+    from ..copr.tilecache import batch_nbytes
+
+    store = session.store
+    out = []
+    for e in store.workload.snapshot():
+        out.append([
+            Datum.s("profile"), Datum.s(e["digest"]), Datum.i(e["bucket"]),
+            Datum.i(e["execs"]), Datum.i(e["device_runs"]),
+            Datum.i(e["host_runs"]), Datum.f(e["device_task_ms"]),
+            Datum.f(e["host_task_ms"]), Datum.f(e["compile_ms"]),
+            Datum.i(int(e["wire_bytes"])), Datum.f(e["sched_wait_ms"]),
+            Datum.i(e["declines"]), Datum.i(e["decisions"]), Datum.i(0),
+            Datum.s(",".join(str(t) for t in sorted(e["tables"]))),
+        ])
+    # residency: tile = host-lane bytes of cached column batches, batch =
+    # the real (compressed) wire bytes of their device mirrors, build =
+    # the build-side cache's byte ledger (getattr — reading a memtable
+    # must not instantiate a cache the workload never touched)
+    tiles = session.cop.tiles
+    tile_b = 0.0
+    batch_b = 0.0
+    with tiles._lock:
+        for b in tiles._cache.values():
+            tile_b += batch_nbytes(b)
+            mirrors = getattr(b, "_mirrors", None)
+            if mirrors is not None:
+                batch_b += sum(
+                    float(getattr(m, "wire_nbytes", 0)) for m in mirrors.values()
+                )
+    bc = getattr(store, "_build_cache", None)
+    build_b = float(bc.nbytes) if bc is not None else 0.0
+    for kind, nbytes in (("tile", tile_b), ("build", build_b), ("batch", batch_b)):
+        M.TPU_RESIDENT_BYTES.set(nbytes, kind=kind)
+        out.append([
+            Datum.s("resident"), Datum.s(kind), Datum.i(0), Datum.i(0),
+            Datum.i(0), Datum.i(0), Datum.f(0.0), Datum.f(0.0), Datum.f(0.0),
+            Datum.i(0), Datum.f(0.0), Datum.i(0), Datum.i(0),
+            Datum.i(int(nbytes)), Datum.s(""),
+        ])
+    return out
 
 
 def _cluster_replication_rows(session) -> list:

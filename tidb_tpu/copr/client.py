@@ -182,7 +182,22 @@ class CopClient:
             "mpp_fallbacks": 0,
             "window_device_tasks": 0,
             "window_fallbacks": 0,
+            # workload-history feedback routing (PR 20): `auto` decisions
+            # answered (and whether history or the static explore arm
+            # answered them), typed lowering declines the device path
+            # returned per statement, and the measured wall each
+            # device-path task spent place-to-result (the fair
+            # counterpart of host_ms — the profile compares the two)
+            "route_decisions": 0,
+            "route_explore": 0,
+            "route_history": 0,
+            "lowering_declines": 0,
+            "device_task_ms": 0,
         }
+        # last feedback-routing decision (EXPLAIN ANALYZE `route:` line
+        # cites its evidence); benign last-writer-wins like mpp's
+        # last_fallback_reason
+        self.last_route: dict | None = None
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._lock:
@@ -264,6 +279,12 @@ class CopClient:
             backoff_budget_ms=budget,
             runaway=getattr(sess, "_runaway", None),
             mem=_ACTIVE_TRACKER.get(None),
+            # feedback routing (PR 20): GLOBAL-only like resource control —
+            # SET GLOBAL tidb_tpu_feedback_route=OFF must recover the
+            # static heuristics live for every session
+            digest=getattr(sess, "_stmt_digest", None),
+            feedback=sess.store.global_vars.get(
+                "tidb_tpu_feedback_route", "ON") == "ON",
         )
 
     @property
@@ -547,35 +568,33 @@ class CopClient:
         self._ndv_cache[ck] = (est,)
         return est
 
-    def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str,
-                     sctx: SchedCtx | None = None, dedup=None,
-                     bo: Backoffer | None = None) -> Chunk:
-        st = self._stats_fn(sctx)
-        trace = getattr(sctx, "trace", None) if sctx is not None else None
-        st("tasks")
-        st("processed_rows", batch.n_rows)
-        if engine == "auto" and batch.n_rows < self.AUTO_MIN_ROWS:
-            engine = "host"
-        if engine == "auto" and self.storage.mem.degraded:
+    def _route_static(self, dag, batch, st, trace) -> str:
+        """The pre-feedback static heuristics, verbatim — the whole policy
+        while tidb_tpu_feedback_route=OFF (bit-exact legacy behavior) and
+        the EXPLORE arm when the workload profile has no verdict. Returns
+        "host" or "auto" ("auto" = try the device path, allowed to fall)."""
+        if batch.n_rows < self.AUTO_MIN_ROWS:
+            return "host"
+        if self.storage.mem.degraded:
             # server soft memory limit crossed: auto traffic degrades to
             # the host engine — a device round-trip means fresh h2d
             # uploads exactly when the store is trying to shed memory.
             # Forced 'tpu' stays forced (the explicit-engine contract)
-            engine = "host"
             st("mem_degraded_tasks")
             M.TPU_FALLBACK.inc(path="cop", reason="mem_degrade")
             if trace is not None and trace.recording:
                 trace.closed_span("mem.degrade", 0.0,
                                   consumed=self.storage.mem.consumed,
                                   limit=self.storage.mem.limit)
-        if (engine == "auto" and dag.agg is None and dag.topn is None
+            return "host"
+        if (dag.agg is None and dag.topn is None
                 and dag.limit is None and dag.selection is None):
             # bare scan: the lanes already live host-side in the tile
             # cache — a device round-trip (upload + full-row fetch over a
             # possibly remote link) computes nothing and costs everything.
             # 'tpu' stays forced (tests/EXPLAIN rely on that contract).
-            engine = "host"
-        if engine == "auto" and dag.agg is not None and dag.agg.group_by:
+            return "host"
+        if dag.agg is not None and dag.agg.group_by:
             # NDV routing: beyond the direct-addressing domain the device
             # takes the sort-based path whose XLA compile scales badly
             # with group capacity, while the vectorized host final-merge
@@ -583,7 +602,70 @@ class CopClient:
             # reference's engine cost choice, tidb_isolation_read_engines)
             est = self._estimate_groups(dag, batch)
             if est is not None and est > self.AUTO_GROUP_MAX:
-                engine = "host"
+                return "host"
+        return "auto"
+
+    def _route_auto(self, dag, batch, sctx, st, trace) -> str:
+        """Engine choice for one `auto` cop task (PR 20): consult the
+        store's workload-history profile per (statement digest, row
+        bucket); no verdict → explore via the static heuristics. The
+        overrides — mem degrade, runaway watch quarantine — win over any
+        history (open breakers stay structural: the placement loop below
+        already drains to host when every lane refuses, history or not).
+        With tidb_tpu_feedback_route=OFF this is the static path alone:
+        no profile reads, no route accounting, bit-exact legacy routing."""
+        if (sctx is None or not getattr(sctx, "feedback", False)
+                or not getattr(sctx, "digest", None)):
+            return self._route_static(dag, batch, st, trace)
+
+        def note(engine, reason, evidence, exploited):
+            decision = "host" if engine == "host" else "device"
+            M.TPU_ROUTE.inc(decision=decision, reason=reason)
+            st("route_decisions")
+            st("route_history" if exploited else "route_explore")
+            self.last_route = {"decision": decision, "reason": reason,
+                               "evidence": evidence}
+            if trace is not None and trace.recording:
+                trace.closed_span("route.decide", 0.0, decision=decision,
+                                  reason=reason, evidence=evidence)
+            return engine
+
+        if self.storage.mem.degraded:
+            st("mem_degraded_tasks")
+            M.TPU_FALLBACK.inc(path="cop", reason="mem_degrade")
+            if trace is not None and trace.recording:
+                trace.closed_span("mem.degrade", 0.0,
+                                  consumed=self.storage.mem.consumed,
+                                  limit=self.storage.mem.limit)
+            return note("host", "mem_degrade", "server over soft memory limit",
+                        False)
+        rc = getattr(sctx, "runaway", None)
+        if rc is not None and getattr(rc, "demoted", False):
+            # a COOLDOWN-quarantined digest must not ride its (possibly
+            # excellent) device history back onto the mesh
+            return note("host", "quarantine", "runaway watch demotion", False)
+        verdict = self.storage.workload.decide(sctx.digest, batch.n_rows)
+        if verdict is None:
+            eng = self._route_static(dag, batch, st, trace)
+            return note(eng, "explore",
+                        "no (digest,bucket) history - static heuristic", False)
+        side, reason, evidence = verdict
+        return note("host" if side == "host" else "auto", reason, evidence,
+                    True)
+
+    def _run_engines(self, dag: DAGRequest, batch: ColumnBatch, engine: str,
+                     sctx: SchedCtx | None = None, dedup=None,
+                     bo: Backoffer | None = None) -> Chunk:
+        st = self._stats_fn(sctx)
+        trace = getattr(sctx, "trace", None) if sctx is not None else None
+        st("tasks")
+        st("processed_rows", batch.n_rows)
+        if trace is not None:
+            tid = getattr(getattr(batch, "table", None), "id", None)
+            if tid is not None:
+                trace.tables.add(tid)  # workload-profile invalidation index
+        if engine == "auto":
+            engine = self._route_auto(dag, batch, sctx, st, trace)
         # resource control: every engine run passes the store-wide
         # admission gate (the unified-read-pool seam); the ticket holds a
         # device slot + the group's RU estimate until release settles the
@@ -591,6 +673,10 @@ class CopClient:
         ctl = self.ctl if (sctx is None or sctx.enabled) else None
         if bo is None:
             bo = Backoffer.for_ctx(sctx, stats=st)
+        # feedback plane armed: weighted lane placement + per-task wall
+        # observation ride the same GLOBAL switch as the router
+        fb = sctx is not None and getattr(sctx, "feedback", False)
+        host_cpu_ms = 0.0  # measured host-engine wall → the RU CPU term
         # device timeline: bind the store ring + this statement's resource
         # group to the engine-call thread — the engine boundary hooks and
         # the launch batcher's lifecycle events read it from TLS
@@ -629,8 +715,10 @@ class CopClient:
                         # only falls to host when EVERY lane refuses.
                         # Breaker outcomes are recorded on the lane that
                         # actually ran the task.
+                        t_dev = time.perf_counter()
                         lane = self.tpu.place(
-                            batch, sched=ctl, gate_breakers=True, stats=st
+                            batch, sched=ctl, gate_breakers=True, stats=st,
+                            weighted=fb,
                         )
                         if lane is None:
                             # every device lane's breaker is open: 'auto'
@@ -667,6 +755,15 @@ class CopClient:
                                     breaker.record_aborted()
                                     raise
                                 tripped = breaker.record_failure(exc)
+                                # lane-health observation (PR 20): the
+                                # fault penalizes the lane's believed cost
+                                # so weighted placement prefers a healthy
+                                # sibling while the breaker makes up its
+                                # mind
+                                self.tpu.note_lane(
+                                    lane, (time.perf_counter() - t_dev) * 1000.0,
+                                    ok=False,
+                                )
                                 if isinstance(err, DeviceTransientError) and not tripped:
                                     # release the device slot while sleeping so
                                     # backoff never holds admission capacity,
@@ -703,6 +800,19 @@ class CopClient:
                                 breaker.record_success()
                                 st("tpu_tasks")
                                 M.COP_TASKS.inc(engine="tpu")
+                                # per-task device wall, place → result: the
+                                # apples-to-apples counterpart of host_ms
+                                # the workload profile compares (device_ms
+                                # alone is kernel time and hides dispatch)
+                                dev_ms = (time.perf_counter() - t_dev) * 1000.0
+                                st("device_task_ms", dev_ms)
+                                self.tpu.note_lane(lane, dev_ms, ok=True)
+                                if not getattr(chunk, "_device", False):
+                                    # the engine's typed not_lowerable
+                                    # decline: it scanned host lanes
+                                    # internally — per-statement evidence
+                                    # for the learned-decline route
+                                    st("lowering_declines")
                                 self._note_device_phases(ph, st, trace)
                                 # only chunks a device program PRODUCED
                                 # charge the compressed mirror; the
@@ -720,6 +830,7 @@ class CopClient:
                     t0 = time.perf_counter()
                     chunk = execute_dag_host(dag, batch)
                     host_s = time.perf_counter() - t0
+                    host_cpu_ms = host_s * 1000.0
                     st("host_tasks")
                     M.COP_TASKS.inc(engine="host")
                     st("host_ms", host_s * 1000.0)
@@ -734,7 +845,10 @@ class CopClient:
                         # lane fiction; host-path tasks keep charging the
                         # host lanes they scanned
                         nb = wire if wire is not None else batch_nbytes(batch)
-                        ru = ru_cost(batch.n_rows, nb)
+                        # RU CPU term (PR 20): a host-path task charges the
+                        # host-engine wall it actually measured; device
+                        # tasks charge 0 here (their cost is the byte term)
+                        ru = ru_cost(batch.n_rows, nb, cpu_ms=host_cpu_ms)
                         ctl.scheduler.release(ticket, ru)
                         st("ru", ru)
 

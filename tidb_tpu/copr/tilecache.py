@@ -628,6 +628,12 @@ class TileCache:
         bc = getattr(self.storage, "_build_cache", None)
         if bc is not None:
             bc.invalidate_table(table_id)
+        # the workload-history plane learned its walls against the OLD
+        # tiles: schema-level invalidation drops its routing entries the
+        # same lazy way (PR 20)
+        wl = getattr(self.storage, "_workload", None)
+        if wl is not None:
+            wl.invalidate_table(table_id)
 
     def evict_all(self) -> float:
         """Server soft-memory-limit action (utils/memory ServerMemTracker):

@@ -456,7 +456,7 @@ class DeviceLane:
     lanes or a concurrent burst all picks the same idle lane."""
 
     __slots__ = ("idx", "device", "name", "breaker", "lock", "occupancy",
-                 "launches")
+                 "launches", "ewma_ms", "faults")
 
     def __init__(self, idx: int, device, breaker):
         self.idx = idx
@@ -467,6 +467,13 @@ class DeviceLane:
         self.lock = RLock()
         self.occupancy = 0  # placed-but-unfinished tasks (queued + running)
         self.launches = 0
+        # observed per-task health (PR 20, guarded by the engine's
+        # placement lock like occupancy): EWMA of the wall each placed
+        # task spent on this lane, fault-penalized — the weighted
+        # placement order reads these instead of treating lanes as
+        # equal-cost
+        self.ewma_ms = 0.0  # 0 = no observation yet
+        self.faults = 0
 
 
 class _lane_guard:
@@ -588,7 +595,7 @@ class TPUEngine:
         self.set_active_lanes(min(max(1, n), len(self.lanes)))
 
     def place(self, batch: ColumnBatch, sched=None, gate_breakers: bool = False,
-              stats=None) -> DeviceLane | None:
+              stats=None, weighted: bool = False) -> DeviceLane | None:
         """Choose the runner lane for one cop task and bump its occupancy
         (caller MUST `release_lane` when the task leaves the lane).
 
@@ -604,17 +611,33 @@ class TPUEngine:
             whose breaker rejects are skipped, so an open breaker drains
             only its own lane and `auto` traffic reroutes to siblings;
             None only when EVERY lane refuses (then: host / raise).
+
+        `weighted` (PR 20, the feedback-routing path): lanes order by
+        (occupancy+1) x their observed per-task EWMA wall instead of
+        occupancy alone — a lane that has been running slow (or was
+        fault-penalized by `note_lane`) yields to a healthy sibling even
+        at equal queue depth. Lanes without observations cost the mesh
+        median, so a cold mesh reproduces the unweighted order exactly.
         """
         lanes = self.lanes
         mirrors = getattr(batch, "_mirrors", None) or {}
         rkey = self._residency_key(batch)
         with self._place_lock:
+            if weighted:
+                seen = sorted(l.ewma_ms for l in lanes if l.ewma_ms > 0.0)
+                base = seen[len(seen) // 2] if seen else 1.0
+                cost = lambda l: (  # noqa: E731 — placement-local key
+                    (l.occupancy + 1) * (l.ewma_ms if l.ewma_ms > 0.0 else base),
+                    l.occupancy, l.idx,
+                )
+            else:
+                cost = lambda l: (l.occupancy, l.idx)  # noqa: E731
             res_idx = set(mirrors) | (self._residency.get(rkey) or set())
             order: list[DeviceLane] = []
             resident = [l for l in lanes if l.idx in res_idx]
             spilled = False
             if resident:
-                r = min(resident, key=lambda l: l.occupancy)
+                r = min(resident, key=cost)
                 load = 0
                 if sched is not None:
                     sc = getattr(sched, "scheduler", None)
@@ -630,7 +653,7 @@ class TPUEngine:
             chosen_first = order[0] if order else None
             order += sorted(
                 (l for l in lanes if l is not chosen_first),
-                key=lambda l: (l.occupancy, l.idx),
+                key=cost,
             )
             rerouted = False
             for lane in order:
@@ -651,6 +674,22 @@ class TPUEngine:
         with self._place_lock:
             lane.occupancy -= 1
             M.TPU_LANE_OCCUPANCY.set(lane.occupancy, device=lane.name)
+
+    def note_lane(self, lane: DeviceLane, wall_ms: float, ok: bool = True) -> None:
+        """Observed per-task lane health (PR 20): the cop client reports
+        each placed task's wall (place → result) here. Success folds into
+        the lane's EWMA; a device fault doubles the believed cost instead
+        — the next weighted placement prefers a healthy sibling while the
+        breaker decides whether to open."""
+        with self._place_lock:
+            if ok:
+                if lane.ewma_ms <= 0.0:
+                    lane.ewma_ms = wall_ms
+                else:
+                    lane.ewma_ms = 0.7 * lane.ewma_ms + 0.3 * wall_ms
+            else:
+                lane.faults += 1
+                lane.ewma_ms = max(lane.ewma_ms, wall_ms, 0.001) * 2.0
 
     def breakers_describe(self) -> str:
         return ", ".join(f"{l.name}:{l.breaker.state}" for l in self.lanes)

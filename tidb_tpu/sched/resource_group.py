@@ -32,10 +32,19 @@ class TokenBucket:
     """RU bucket with post-hoc debits: admission charges an estimate, the
     task settles the true cost after running, so tokens may go negative
     (debt). A group is admissible while it holds no debt; refill pays debt
-    down at `rate` RU/s. rate <= 0 means unlimited (the default group)."""
+    down at `rate` RU/s. rate <= 0 means unlimited (the default group).
 
-    def __init__(self, rate: float, burst: float | None = None):
+    `burstable` buckets (PR 20) borrow from MEASURED headroom instead of
+    being unlimited: while in debt they stay admissible only when the
+    caller reports the store has free capacity (`admissible(headroom=...)`
+    — AdmissionScheduler passes its slot utilization under BORROW_HEADROOM).
+    Debt still accrues on every run and is repaid at the reserved rate, so
+    a saturated store throttles a burstable group at its ru_per_sec."""
+
+    def __init__(self, rate: float, burst: float | None = None,
+                 burstable: bool = False):
         self.rate = float(rate)
+        self.burstable = burstable
         self.capacity = float(burst) if burst else max(self.rate, 1.0)
         self.tokens = self.capacity
         self._t = time.monotonic()
@@ -52,10 +61,12 @@ class TokenBucket:
             self._refill_locked(time.monotonic() if now is None else now)
             return self.tokens
 
-    def admissible(self, now: float | None = None) -> bool:
+    def admissible(self, now: float | None = None, headroom: bool = False) -> bool:
         if self.rate <= 0:
             return True
-        return self.available(now) > 0.0
+        if self.available(now) > 0.0:
+            return True
+        return self.burstable and headroom
 
     def debit(self, n: float) -> None:
         if self.rate <= 0:
@@ -85,10 +96,11 @@ class ResourceGroup:
 
     def __post_init__(self):
         if self.bucket is None:
-            # burstable groups may borrow beyond their rate while the
-            # store has headroom — modeled as an unlimited bucket (the
-            # rate still drives RU metrics / SHOW output)
-            self.bucket = TokenBucket(0 if self.burstable else self.ru_per_sec)
+            # burstable groups borrow beyond their rate only while the
+            # admission scheduler measures free device slots (the bucket's
+            # burstable flag + the scheduler's headroom report, PR 20);
+            # ru_per_sec = 0 stays a genuinely unlimited bucket either way
+            self.bucket = TokenBucket(self.ru_per_sec, burstable=self.burstable)
         self._ql_parsed = False
         self._ql = None
 
@@ -212,8 +224,9 @@ class ResourceGroupManager:
             if kind == "alter":
                 # the default group is synthetic: retune it in memory.
                 # Naming RU_PER_SEC without BURSTABLE turns bursting off —
-                # otherwise the burstable=unlimited modeling would leave
-                # the new limit silently unenforced
+                # otherwise the headroom borrow would keep the new limit
+                # soft whenever the store is idle, which is rarely what
+                # an ALTER that names a rate intends
                 d = self.default
                 d.ru_per_sec = int(opts.get("ru_per_sec", d.ru_per_sec))
                 d.priority = opts.get("priority", d.priority)
@@ -225,7 +238,7 @@ class ResourceGroupManager:
                     # {} is the parsed QUERY_LIMIT=NULL (clear) sentinel
                     d.query_limit = opts["query_limit"] or None
                     d._ql_parsed = False
-                d.bucket = TokenBucket(0 if d.burstable else d.ru_per_sec)
+                d.bucket = TokenBucket(d.ru_per_sec, burstable=d.burstable)
                 self.bump()
                 return
             if kind == "create":

@@ -44,6 +44,11 @@ class SchedCtx:
     backoff_budget_ms: float | None = None  # tidb_backoff_budget_ms (None = default)
     runaway: object = None  # RunawayChecker: QUERY_LIMIT watchdog + watch list
     mem: object = None  # statement MemTracker: device transfers consume here
+    # workload-history feedback routing (PR 20): the statement's digest
+    # keys the store's WorkloadProfile; `feedback` mirrors the live
+    # GLOBAL tidb_tpu_feedback_route (OFF = static heuristics, bit-exact)
+    digest: str | None = None
+    feedback: bool = False
 
 
 @dataclass
@@ -61,13 +66,17 @@ class _Waiter:
     granted: bool = False
 
 
-def ru_cost(rows: int, nbytes: float = 0.0) -> float:
+def ru_cost(rows: int, nbytes: float = 0.0, cpu_ms: float = 0.0) -> float:
     """RU model: one base unit per cop task plus one per KiRow scanned
     plus one per 64KiB of batch data touched (the read-request +
     read-byte split of the reference's RU formula — the byte term makes
     wide-row scans cost what they move, not just what they count; 64KiB
-    per RU mirrors the reference's ReadBytesCost)."""
-    return 1.0 + rows / 1024.0 + nbytes / 65536.0
+    per RU mirrors the reference's ReadBytesCost) plus one per 3ms of
+    MEASURED host-engine CPU wall (the reference's CPUMsCost — the term
+    this model was missing until the workload-history plane started
+    measuring host walls per task, PR 20; device-path tasks charge 0
+    here, their cost lives in the byte term)."""
+    return 1.0 + rows / 1024.0 + nbytes / 65536.0 + cpu_ms / 3.0
 
 
 def raise_if_interrupted(session=None, deadline=None) -> None:
@@ -143,6 +152,11 @@ class AdmissionScheduler:
     MAX_QUEUE = 256  # waiters beyond this hard-fail (backpressure edge)
     EST_RU = 1.0  # debited at admission, settled at release
     _TICK_S = 0.05  # poll cadence for bucket refills / kill marks
+    # BURSTABLE borrow gate (PR 20): a burstable group in RU debt may
+    # still admit while the store runs below this fraction of its device
+    # slots — measured headroom, not an unlimited bucket. At/above it
+    # the group throttles at its reserved ru_per_sec like any other.
+    BORROW_HEADROOM = 0.75
 
     def __init__(self, groups: ResourceGroupManager, max_concurrency: int = 32):
         self.groups = groups
@@ -162,6 +176,12 @@ class AdmissionScheduler:
         with self._cond:
             return self._running
 
+    def _headroom_locked(self) -> bool:
+        """Measured store headroom for BURSTABLE borrowing: true while
+        running work occupies less than BORROW_HEADROOM of the device
+        slots (caller holds self._cond)."""
+        return self._running < max(1, int(self.max_concurrency * self.BORROW_HEADROOM))
+
     # --- admission ----------------------------------------------------------
 
     def acquire(self, ctx: SchedCtx, stop=None) -> Ticket:
@@ -178,7 +198,8 @@ class AdmissionScheduler:
             rc.on_admission()
         t0 = time.monotonic()
         with self._cond:
-            if not self._waiting and self._running < self.max_concurrency and g.bucket.admissible():
+            if not self._waiting and self._running < self.max_concurrency \
+                    and g.bucket.admissible(headroom=self._headroom_locked()):
                 self._running += 1
                 g.bucket.debit(self.EST_RU)
                 M.SCHED_TASKS.inc(group=g.name, outcome="admitted")
@@ -251,8 +272,9 @@ class AdmissionScheduler:
         granted_any = False
         while self._running < self.max_concurrency and self._waiting:
             chosen = None
+            hr = self._headroom_locked()  # re-read per grant: each fills a slot
             for w in sorted(self._waiting, key=lambda x: (-x.priority, x.seq)):
-                if w.group.bucket.admissible():
+                if w.group.bucket.admissible(headroom=hr):
                     chosen = w
                     break
             if chosen is None:

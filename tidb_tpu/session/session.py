@@ -130,6 +130,7 @@ class Session:
         self.user = "root"
         self._session_bindings: dict[str, list] = {}  # digest → hints
         self._tracer = None  # per-statement StatementTrace (utils/tracing)
+        self._stmt_digest = None  # per-statement digest (workload history key)
         # txn-level trace linkage: minted at BEGIN, stamped on every
         # statement trace until COMMIT/ROLLBACK (TIDB_TRACE TXN_TRACE_ID)
         self._txn_trace_id: str | None = None
@@ -504,9 +505,16 @@ class Session:
         self._runaway = None
         prev_route = getattr(self, "_route_replica", None)
         self._route_replica = None  # serving replica (slow-log REPLICA col)
+        prev_digest = getattr(self, "_stmt_digest", None)
+        self._stmt_digest = None  # cop client keys workload history by this
         if not self._in_bootstrap:
+            from ..utils.stmtstats import sql_digest
             from ..utils.tracing import StatementTrace
 
+            # statement digest (normalized-SQL hash, lru-cached): the
+            # workload-history plane keys per-statement profiles by it,
+            # and the cop client stamps it into SchedCtx for routing
+            self._stmt_digest = sql_digest(log_sql)
             tracer = StatementTrace(
                 sql=log_sql, session_id=self.conn_id,
                 recording=self.vars.get("tidb_enable_trace", "OFF") == "ON",
@@ -625,6 +633,8 @@ class Session:
             self._runaway = prev_runaway
             route_replica = getattr(self, "_route_replica", None)
             self._route_replica = prev_route
+            stmt_digest = getattr(self, "_stmt_digest", None)
+            self._stmt_digest = prev_digest
             if not self._in_bootstrap:
                 self.store.clear_process(self.conn_id)
                 self.store.plugins.fire("on_query", self.user, self.current_db, sql, ok, dur)
@@ -675,6 +685,21 @@ class Session:
                     redact=self.vars.get("tidb_redact_log", "OFF") == "ON",
                     details=details,
                 )
+                # workload-history feed (PR 20): statements that ran cop
+                # tasks deposit their observed profile — per-engine walls,
+                # compile hits, wire bytes, declines — under (digest,
+                # row-bucket); the cop client's auto-router reads it back.
+                # Gated on the same switch the router consumes so OFF
+                # leaves zero residue (and recovers static behavior live)
+                if (
+                    tracer is not None and stmt_digest
+                    and tracer.counters.get("tasks")
+                    and self.store.global_vars.get(
+                        "tidb_tpu_feedback_route", "ON") == "ON"
+                ):
+                    self.store.workload.observe(
+                        stmt_digest, tracer.counters, tables=tracer.tables,
+                    )
                 # AFTER the counters above so a snapshot sees this stmt
                 # (statement completion drives metrics_summary windows even
                 # under pure-SQL workloads; min-interval guard in tick())
@@ -4176,6 +4201,24 @@ class Session:
                 f"fallbacks:{cop.tpu.fallbacks - tpu0[1]} "
                 f"breaker:{agg} trips:{sum(l.breaker.trips for l in lanes)}"
             )
+        if d.get("route_decisions"):
+            # feedback-routing line (PR 20): how many auto-engine
+            # decisions this statement took, how many exploited learned
+            # history vs explored the static heuristic, and the LAST
+            # decision's verdict with the evidence the router cited
+            rline = (
+                f"route: decisions:{int(d['route_decisions'])} "
+                f"history:{int(d.get('route_history', 0))} "
+                f"explore:{int(d.get('route_explore', 0))}"
+            )
+            last = cop.last_route
+            if last is not None:
+                rline += (
+                    f" last:{last.get('decision')}"
+                    f" reason:{last.get('reason')}"
+                    f" evidence:[{last.get('evidence', '')}]"
+                )
+            lines.append(rline)
         if decision is not None:
             # routing line: the node a follower-read statement was (or
             # would be) served by, or the typed fallback reason

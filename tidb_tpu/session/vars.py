@@ -268,6 +268,18 @@ _sv("tidb_tpu_tile_compression", "ON", scope="global", kind="bool", consumed=Tru
 # value overrides every session's dispatch (incident semantics).
 _sv("tidb_tpu_mpp_fused", "ON", scope="global", kind="bool", consumed=True)
 
+# --- workload-history feedback routing (PR 20) -------------------------------
+# ON (default): the `auto` engine routes per (statement digest, row
+# bucket) from the store's observed WorkloadProfile (utils/workload.py)
+# — first sight explores via the static heuristics, repeats exploit the
+# measured per-task walls; the profile also arms at statement
+# completion. OFF recovers the pre-feedback static heuristics exactly
+# (no profile reads, no feeds, no route metrics) — the A/B baseline and
+# the live incident fallback, mirroring tidb_tpu_tile_compression.
+# GLOBAL-only: the history is store-wide and the routing contract must
+# flip for every session at once.
+_sv("tidb_tpu_feedback_route", "ON", scope="global", kind="bool", consumed=True)
+
 # --- Lightning-style bulk ingest (PR 15: br/ingest.BulkIngest) --------------
 # ON (default): LOAD DATA and models bulk_load build sorted columnar KV
 # artifacts and publish them atomically under ONE WAL ingest record

@@ -862,6 +862,13 @@ class Storage:
         for p in prefixes:
             ver, _ = self._versions.get(p, (0, 0))
             self._versions[p] = (ver + 1, ts)
+        # workload-history plane (PR 20): measured walls for a table whose
+        # data version moved are stale — drop its routing entries. Guarded
+        # on the lazy singleton so pure-OLTP commit paths that never armed
+        # a profile pay one attribute read
+        wl = getattr(self, "_workload", None)
+        if wl is not None and len(wl):
+            wl.invalidate_prefixes(prefixes)
 
     def data_version(self, table_prefix: bytes) -> tuple[int, int]:
         """→ (version counter, last-commit ts) for the table key space."""
@@ -1514,6 +1521,22 @@ class Storage:
                     self.mem.register_cache(bc)
                     self._build_cache = bc
         return self._build_cache
+
+    @property
+    def workload(self):
+        """Per-store workload-history plane (utils/workload.WorkloadProfile):
+        observed per-(digest, row bucket) execution profiles fed at
+        statement completion and consulted by the cop client's `auto`
+        routing (SET GLOBAL tidb_tpu_feedback_route). Double-checked init
+        like the timeline ring — first touch can come from parallel cop
+        workers consulting the router mid-statement."""
+        if getattr(self, "_workload", None) is None:
+            from ..utils.workload import WorkloadProfile
+
+            with Storage._timeline_init_lock:
+                if getattr(self, "_workload", None) is None:
+                    self._workload = WorkloadProfile()
+        return self._workload
 
     # --- active-txn registry (GC safepoint clamp) --------------------------
 

@@ -623,3 +623,27 @@ COMPACT_BYTES = REGISTRY.counter(
     "tidb_compact_bytes_total",
     "bytes of compaction WAL records (Z frames) published",
 )
+# workload-history routing (PR 20): every `auto` engine decision the
+# feedback router made, labeled by where the task went (device | host)
+# and why — explore (no history: static heuristic answered),
+# history_device / history_host (exploited measured per-task walls),
+# learned_decline (digest's device attempts were ALL typed lowering
+# declines — straight to host), mem_degrade / quarantine (overrides
+# that win over any history). Absent entirely while
+# tidb_tpu_feedback_route=OFF (the incident fallback is bit-silent).
+TPU_ROUTE = REGISTRY.counter(
+    "tidb_tpu_route_total",
+    "auto-engine feedback routing decisions (decision=device|host, "
+    "reason=explore|history_device|history_host|learned_decline|"
+    "mem_degrade|quarantine)",
+)
+# resident-set observability (PR 20): bytes currently pinned by the three
+# device-path residency pools — host-side cached column tiles
+# (kind=tile, TileCache), device-resident MPP join structures
+# (kind=build, BuildSideCache.nbytes) and per-device compressed batch
+# mirrors (kind=batch, DeviceBatch wire bytes). Sampled on read
+# (information_schema.tidb_workload_profile residency rows / /metrics).
+TPU_RESIDENT_BYTES = REGISTRY.gauge(
+    "tidb_tpu_resident_bytes",
+    "bytes resident in device-path caches (kind=tile|build|batch)",
+)

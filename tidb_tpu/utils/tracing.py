@@ -148,6 +148,9 @@ class StatementTrace:
         self.ok = True
         self.root_id = _next_id()
         self.counters: dict[str, float] = {}
+        # table ids this statement's cop tasks scanned (set adds are
+        # GIL-atomic) — the workload profile's invalidation index
+        self.tables: set = set()
         self.spans: list[Span] = []
         self._lock = threading.Lock()
         self._local = threading.local() if recording else None

@@ -1,0 +1,308 @@
+"""Workload-history plane: per-digest observed execution profiles.
+
+The trace/stats seams (PRs 3/5/8) already measure everything a learned
+router needs — device vs host task walls, compile hits, wire/logical
+bytes, sched wait, the typed fallback taxonomy. This module closes the
+loop: `WorkloadProfile` aggregates those counters at statement
+completion, keyed (statement digest, power-of-two row bucket), and
+`decide()` turns the history into an engine verdict the cop client's
+`auto` routing consults before falling back to the static heuristics
+("Tailwind: A Practical Framework for Query Accelerators",
+arXiv 2604.28079 — route by observed cost, explore when blind; the cost
+asymmetries are those of "Query Processing on Tensor Computation
+Runtimes", arXiv 2203.01877).
+
+Policy, in order:
+
+  * a digest whose device attempts are ALL typed lowering declines goes
+    straight to host — zero further plan-for/decline round-trips;
+  * an exact (digest, bucket) entry with measured per-task walls on both
+    engines routes to the cheaper one;
+  * a one-sided entry borrows the missing engine's cost from the nearest
+    sibling bucket of the SAME digest, at most ``SIBLING_MAX_OCTAVES``
+    away — task cost at these sizes is fixed-overhead dominated, so the
+    nearest bucket's RAW per-task wall beats a per-row extrapolation
+    (which would scale a fixed dispatch cost linearly and misroute);
+    farther siblings are treated as no evidence;
+  * anything else returns None: the caller explores via the static
+    heuristic, and every ``REEXPLORE_EVERY``-th repeat of a learned key
+    also returns None so drift (schema growth, lane health) re-measures
+    the static arm instead of exploiting a stale verdict forever.
+
+Entries are a bounded LRU; per-table invalidation rides the existing
+version seams (TileCache.invalidate_table for DDL/TRUNCATE/RESTORE,
+Storage.bump_version for data-version bumps) — a table whose content
+changed invalidates every entry that touched it, so stale walls never
+steer routing. The profile lock is a leaf (rank `workload` in
+tools/analyze/lock_order.toml): nothing else is ever acquired under it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+BUCKET_MIN = 256  # smallest row bucket (matches the device tile floor)
+CAPACITY = 512  # (digest, bucket) entries before LRU eviction
+EWMA_ALPHA = 0.3  # per-task wall smoothing (recent executions dominate)
+FAULT_PENALTY = 2.0  # a device fault doubles the lane's believed cost
+REEXPLORE_EVERY = 16  # every Nth decision re-runs the static arm
+SIBLING_MAX_OCTAVES = 2  # how far a borrowed sibling cost may reach
+
+
+def bucket_rows(n: int) -> int:
+    """Power-of-two row bucket, floored at BUCKET_MIN — the same bucketing
+    the device tile layout pads to, so one bucket sees one compiled
+    program shape."""
+    return max(BUCKET_MIN, 1 << max(0, int(max(n, 1) - 1).bit_length()))
+
+
+class _Entry:
+    """Observed history for one (digest, row bucket)."""
+
+    __slots__ = (
+        "digest", "bucket", "execs", "device_attempts", "device_runs",
+        "host_runs", "device_task_ms", "host_task_ms", "compile_ms",
+        "wire_bytes", "logical_bytes", "sched_wait_ms", "declines",
+        "fallback_errors", "breaker_skips", "tables", "decisions",
+    )
+
+    def __init__(self, digest: str, bucket: int):
+        self.digest = digest
+        self.bucket = bucket
+        self.execs = 0  # statements observed
+        self.device_attempts = 0  # tasks sent down the device path
+        self.device_runs = 0  # ... that a device program actually produced
+        self.host_runs = 0  # tasks the host engine ran
+        self.device_task_ms = 0.0  # EWMA wall per device-path task
+        self.host_task_ms = 0.0  # EWMA wall per host-path task
+        self.compile_ms = 0.0  # total XLA compile wall attributed
+        self.wire_bytes = 0.0
+        self.logical_bytes = 0.0
+        self.sched_wait_ms = 0.0
+        self.declines = 0  # typed not_lowerable declines
+        self.fallback_errors = 0  # device faults that fell to host
+        self.breaker_skips = 0
+        self.tables: set[int] = set()
+        self.decisions = 0  # decide() consultations answered from here
+
+
+def _ewma(old: float, sample: float) -> float:
+    if old <= 0.0:
+        return sample
+    return (1.0 - EWMA_ALPHA) * old + EWMA_ALPHA * sample
+
+
+class WorkloadProfile:
+    """Bounded per-store history of observed statement execution profiles,
+    fed at statement completion from the per-statement trace counters and
+    consulted per cop task by the `auto` engine router."""
+
+    def __init__(self, capacity: int = CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()  # leaf lock (lock_order: workload)
+        self._entries: OrderedDict[tuple[str, int], _Entry] = OrderedDict()
+        self._digests: dict[str, dict[int, _Entry]] = {}
+        self._by_table: dict[int, set[tuple[str, int]]] = {}
+        self.invalidations = 0  # entries dropped by version bumps
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._digests.clear()
+            self._by_table.clear()
+
+    # --- feed (statement completion) ---------------------------------------
+
+    def observe(self, digest: str, counters: dict, tables=()) -> None:
+        """Fold one finished statement's trace counters into the history.
+
+        Called from the session's statement-completion seam with the same
+        counter dict the slow log / STATEMENTS_SUMMARY read — tasks,
+        processed_rows, tpu/host task counts, the measured per-path walls
+        and the typed decline/fault counters all arrive through the one
+        `st()` both-sink the cop client already feeds."""
+        tasks = int(counters.get("tasks", 0))
+        if not digest or tasks <= 0:
+            return
+        rows = counters.get("processed_rows", 0.0)
+        bucket = bucket_rows(int(rows / tasks))
+        dev_attempts = int(counters.get("tpu_tasks", 0))
+        declines = int(counters.get("lowering_declines", 0))
+        host_runs = int(counters.get("host_tasks", 0))
+        dev_ms = counters.get("device_task_ms", 0.0)
+        host_ms = counters.get("host_ms", 0.0)
+        key = (digest, bucket)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = _Entry(digest, bucket)
+                self._entries[key] = e
+                self._digests.setdefault(digest, {})[bucket] = e
+                while len(self._entries) > self.capacity:
+                    _, old = self._entries.popitem(last=False)
+                    self._unlink_locked(old)
+            else:
+                self._entries.move_to_end(key)
+            e.execs += 1
+            e.device_attempts += dev_attempts
+            e.device_runs += max(dev_attempts - declines, 0)
+            e.host_runs += host_runs
+            e.declines += declines
+            e.fallback_errors += int(counters.get("fallback_errors", 0))
+            e.breaker_skips += int(counters.get("breaker_skips", 0))
+            e.compile_ms += counters.get("compile_ms", 0.0)
+            e.wire_bytes += counters.get("wire_bytes", 0.0)
+            e.logical_bytes += counters.get("logical_bytes", 0.0)
+            e.sched_wait_ms += counters.get("sched_wait_ms", 0.0)
+            if dev_attempts > 0 and dev_ms > 0.0:
+                e.device_task_ms = _ewma(e.device_task_ms, dev_ms / dev_attempts)
+            if host_runs > 0 and host_ms > 0.0:
+                e.host_task_ms = _ewma(e.host_task_ms, host_ms / host_runs)
+            for t in tables:
+                if t not in e.tables:
+                    e.tables.add(t)
+                    self._by_table.setdefault(t, set()).add(key)
+
+    def _unlink_locked(self, e: _Entry) -> None:
+        buckets = self._digests.get(e.digest)
+        if buckets is not None:
+            buckets.pop(e.bucket, None)
+            if not buckets:
+                self._digests.pop(e.digest, None)
+        for t in e.tables:
+            keys = self._by_table.get(t)
+            if keys is not None:
+                keys.discard((e.digest, e.bucket))
+                if not keys:
+                    self._by_table.pop(t, None)
+
+    # --- consult (per cop task) ---------------------------------------------
+
+    def decide(self, digest: str, n_rows: int):
+        """→ ("device"|"host", reason, evidence) or None (= explore via the
+        static heuristic). Overrides (open breakers, mem degrade, watch
+        quarantine) are the CALLER's job — they must win even over fresh
+        history, so they sit above this call, not inside it."""
+        if not digest:
+            return None
+        bucket = bucket_rows(n_rows)
+        with self._lock:
+            buckets = self._digests.get(digest)
+            if not buckets:
+                return None
+            attempts = sum(e.device_attempts for e in buckets.values())
+            runs = sum(e.device_runs for e in buckets.values())
+            declines = sum(e.declines for e in buckets.values())
+            if declines > 0 and attempts > 0 and runs == 0:
+                # every device attempt this digest ever made was a typed
+                # lowering decline: the engine would scan host lanes anyway
+                # — skip the plan-for round-trip entirely
+                return ("host", "learned_decline",
+                        f"declines:{declines}/attempts:{attempts}")
+            e = buckets.get(bucket)
+            if e is None:
+                return None  # first sight of this bucket: explore
+            e.decisions += 1
+            if e.decisions % REEXPLORE_EVERY == 0:
+                return None  # periodic re-measure of the static arm
+            dcost, dsrc = self._cost_locked(buckets, bucket, device=True)
+            hcost, hsrc = self._cost_locked(buckets, bucket, device=False)
+            if dcost is None or hcost is None:
+                return None  # one-sided with no usable sibling: explore
+            ev = (f"device {dcost:.3f}ms/task ({dsrc}) vs "
+                  f"host {hcost:.3f}ms/task ({hsrc}), execs:{e.execs}")
+            if dcost <= hcost:
+                return ("device", "history_device", ev)
+            return ("host", "history_host", ev)
+
+    @staticmethod
+    def _cost_locked(buckets: dict, bucket: int, device: bool):
+        """Per-task cost for one engine at `bucket`: the exact entry when
+        it has evidence, else the nearest sibling bucket within
+        SIBLING_MAX_OCTAVES (raw, not per-row-scaled — see module doc)."""
+        e = buckets.get(bucket)
+        if e is not None:
+            c = e.device_task_ms if device else e.host_task_ms
+            if c > 0.0:
+                return c, f"b{bucket}"
+        target = bucket.bit_length()
+        best = None
+        for b, s in buckets.items():
+            if b == bucket:
+                continue
+            c = s.device_task_ms if device else s.host_task_ms
+            if c <= 0.0:
+                continue
+            dist = abs(b.bit_length() - target)
+            if dist > SIBLING_MAX_OCTAVES:
+                continue
+            if best is None or dist < best[0]:
+                best = (dist, c, b)
+        if best is None:
+            return None, ""
+        return best[1], f"sibling b{best[2]}"
+
+    # --- invalidation (schema / data version bumps) --------------------------
+
+    def invalidate_table(self, table_id: int) -> None:
+        """Drop every entry whose statement touched `table_id` — chained
+        from TileCache.invalidate_table (DDL, TRUNCATE, RESTORE, ingest)."""
+        with self._lock:
+            keys = self._by_table.pop(table_id, None)
+            if not keys:
+                return
+            for key in keys:
+                e = self._entries.pop(key, None)
+                if e is None:
+                    continue
+                self.invalidations += 1
+                e.tables.discard(table_id)
+                self._unlink_locked(e)
+
+    def invalidate_prefixes(self, prefixes) -> None:
+        """Data-version seam (Storage.bump_version): every committed write
+        bumps its table prefixes; measured walls for a changed table are
+        stale (row counts moved) and must not steer routing."""
+        from ..codec.tablecodec import decode_table_id
+
+        for p in prefixes:
+            if len(p) >= 9 and p[:1] == b"t":
+                try:
+                    tid = decode_table_id(p)
+                except Exception:  # noqa: BLE001 — foreign keyspace prefix
+                    continue
+                self.invalidate_table(tid)
+
+    # --- introspection (memtable / EXPLAIN evidence) --------------------------
+
+    def snapshot(self) -> list[dict]:
+        """Point-in-time rows for information_schema.tidb_workload_profile,
+        most-recently-used first."""
+        with self._lock:
+            out = []
+            for e in reversed(self._entries.values()):
+                out.append({
+                    "digest": e.digest,
+                    "bucket": e.bucket,
+                    "execs": e.execs,
+                    "device_attempts": e.device_attempts,
+                    "device_runs": e.device_runs,
+                    "host_runs": e.host_runs,
+                    "device_task_ms": e.device_task_ms,
+                    "host_task_ms": e.host_task_ms,
+                    "compile_ms": e.compile_ms,
+                    "wire_bytes": e.wire_bytes,
+                    "logical_bytes": e.logical_bytes,
+                    "sched_wait_ms": e.sched_wait_ms,
+                    "declines": e.declines,
+                    "fallback_errors": e.fallback_errors,
+                    "breaker_skips": e.breaker_skips,
+                    "decisions": e.decisions,
+                    "tables": sorted(e.tables),
+                })
+            return out

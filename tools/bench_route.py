@@ -1,0 +1,184 @@
+"""Feedback-routing gate (PR 20): does observed workload history beat
+the static engine heuristic, and does an ARMED-but-cold profile cost
+nothing?
+
+Two paired measurements on one pt store (tools/paired_bench methodology —
+modes interleaved per statement, median PAIRED delta/ratio, machine
+drift cancels):
+
+  speedup   mixed workload of mid-band TopN spans (2048 rows — the
+            static heuristic's blind spot: big enough for the device
+            arm, but the device sort path loses badly to the host TopN
+            on this box), point spans (1024 rows, host either way) and a
+            whole-table agg scan (device either way).
+            Mode `static` = tidb_tpu_feedback_route OFF (legacy
+            heuristics verbatim); mode `history` = ON with the profile
+            WARMED through the explore phase first. All spans share one
+            statement digest (literals are masked), so the router's
+            sibling-bucket inference carries host evidence from the
+            point bucket into the mid-band before exact host walls
+            arrive. Gate: paired per-statement p50 speedup >= 1.3x, and
+            both modes return bit-identical rows.
+
+  overhead  the standard point-agg workload under engine=auto with the
+            profile armed but CLEARED before every ON sample (every
+            decision explores: digest plumbing + decide() miss + route
+            accounting + the completion-time observe() feed — the whole
+            cost of carrying the plane without history to exploit).
+            Gate: median paired p50 delta <= 5%.
+
+Writes BENCH_route_pr20.json; non-zero exit on any gate failure.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.paired_bench import bench_main, make_pt_session, run_paired_bench
+
+N_TASKS = 32
+ROWS_PER_TASK = 4096
+REPS = 13  # per mode; rep 0 is warmup
+SPEEDUP_GATE = 1.3
+OVERHEAD_GATE_PCT = 5.0
+
+# mid-band spans dominate the sample set (6 of 9 statements) so the
+# per-statement p50 IS the misrouted band's latency; offsets all differ
+# (the tile cache keys (table, start) — two spans sharing a start would
+# thrash one slot) and none start at row 0 (the whole-table scan's key)
+SPAN_ROWS = 2048
+SPANS = [2048 + i * SPAN_ROWS for i in range(6)]
+POINTS = [65536, 65536 + 1024]  # 1024-row spans: host under both modes
+
+
+def mixed_queries() -> list[str]:
+    # the span and point statements share ONE digest (only literals
+    # differ): the 1024-row points route host under the static heuristic
+    # either way, so their measured host walls give the router a sibling
+    # bucket to borrow from when it first reconsiders the 2048-row band.
+    # ORDER BY v DESC, id keeps the TopN result deterministic (unique
+    # tiebreak) — bit-identical rows whichever engine serves it
+    qs = [
+        f"SELECT id, v FROM pt WHERE id >= {lo} AND id < {lo + SPAN_ROWS}"
+        f" ORDER BY v DESC, id LIMIT 10"
+        for lo in SPANS
+    ]
+    qs += [
+        f"SELECT id, v FROM pt WHERE id >= {lo} AND id < {lo + 1024}"
+        f" ORDER BY v DESC, id LIMIT 10"
+        for lo in POINTS
+    ]
+    qs.append("SELECT COUNT(*), SUM(v), MIN(v), MAX(w) FROM pt")
+    return qs
+
+
+def _set_route(session, mode: str) -> None:
+    session.execute(
+        "SET GLOBAL tidb_tpu_feedback_route = '%s'"
+        % ("ON" if mode == "on" else "OFF")
+    )
+
+
+def bench_speedup(session) -> dict:
+    session.vars["tidb_cop_engine"] = "auto"  # the routed engine under test
+    queries = mixed_queries()
+    # warm tiles + compiled programs with routing OFF (both modes reuse
+    # them), then walk the ON mode through its explore phase so the
+    # measured `history` samples exploit a warmed profile
+    _set_route(session, "off")
+    for _ in range(2):
+        for q in queries:
+            session.must_query(q)
+    session.store.workload.clear()
+    _set_route(session, "on")
+    for _ in range(3):
+        for q in queries:
+            session.must_query(q)
+
+    # bit-identical both routes, checked on the queries the modes route
+    # differently (the mid-band spans) plus the rest for completeness
+    ident = []
+    for mode in ("off", "on"):
+        _set_route(session, mode)
+        ident.append([session.must_query(q) for q in queries])
+    identical = ident[0] == ident[1]
+
+    lat: dict[str, list[float]] = {"off": [], "on": []}
+    ratios: list[float] = []
+
+    def timed(mode: str, q: str) -> float:
+        _set_route(session, mode)
+        t0 = time.perf_counter()
+        session.must_query(q)
+        return time.perf_counter() - t0
+
+    for rep in range(REPS):
+        for qi, q in enumerate(queries):
+            order = ("off", "on") if (rep + qi) % 2 == 0 else ("on", "off")
+            pair = {m: timed(m, q) for m in order}
+            if rep:  # rep 0 re-warms both arms after the identity pass
+                lat["off"].append(pair["off"])
+                lat["on"].append(pair["on"])
+                ratios.append(pair["off"] / pair["on"])
+    _set_route(session, "on")
+
+    p50_static = statistics.median(lat["off"])
+    p50_history = statistics.median(lat["on"])
+    speedup = p50_static / p50_history if p50_history else 0.0
+    return {
+        "workload": "mixed span+point+scan, per-statement paired",
+        "span_rows": SPAN_ROWS,
+        "statements": len(queries),
+        "samples_per_mode": len(lat["off"]),
+        "p50_static_ms": round(p50_static * 1e3, 3),
+        "p50_history_ms": round(p50_history * 1e3, 3),
+        "speedup_p50": round(speedup, 3),
+        "paired_ratio_p50": round(statistics.median(ratios), 3),
+        "bit_identical": identical,
+        "gate_speedup": SPEEDUP_GATE,
+        "pass": identical and speedup >= SPEEDUP_GATE,
+    }
+
+
+def bench_overhead(session) -> dict:
+    # engine=auto so every statement walks the route path; clearing the
+    # profile inside set_mode keeps each ON sample's decision cold (the
+    # clear itself stays off the clock — timing starts after set_mode)
+    session.vars["tidb_cop_engine"] = "auto"
+
+    def set_mode(sess, mode):
+        _set_route(sess, mode)
+        if mode == "on":
+            sess.store.workload.clear()
+
+    out = run_paired_bench(
+        session, set_mode, "point-agg under auto, profile armed but cold",
+        n_tasks=N_TASKS, rows_per_task=ROWS_PER_TASK,
+        reps=REPS, gate_pct=OVERHEAD_GATE_PCT,
+    )
+    session.vars["tidb_cop_engine"] = "tpu"
+    return out
+
+
+def run_bench() -> dict:
+    session = make_pt_session(N_TASKS, ROWS_PER_TASK)
+    speedup = bench_speedup(session)
+    overhead = bench_overhead(session)
+    return {
+        "speedup": speedup,
+        "overhead_armed_cold": overhead,
+        "pass": bool(speedup["pass"] and overhead["pass"]),
+        # bench_main's failure banner reads these two:
+        "overhead_pct": overhead["overhead_pct"],
+        "gate_pct": overhead["gate_pct"],
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(bench_main(run_bench, "BENCH_route_pr20.json",
+                        "feedback routing (speedup or armed-cold overhead)"))

@@ -19,6 +19,10 @@
 #   tools/bench_ingest.py            -> BENCH_ingest_pr15.json
 #   tools/bench_compact.py           -> BENCH_compact_pr16.json
 #   tools/bench_trace_propagation.py -> BENCH_trace_propagation_pr18.json
+#   tools/bench_route.py             -> BENCH_route_pr20.json
+# (bench_route: paired static-vs-history engine routing on a mixed
+# TopN+point+scan workload; gates history p50 speedup >= 1.3x with
+# bit-identical rows, and armed-but-cold profile overhead <= 5%)
 # (bench_ingest: paired legacy-vs-bulk load; gates bulk_load >= 5x and
 # LOAD DATA >= 3x with bit-identical query results)
 # (bench_compact: cold Q1 on an INSERT-built store after the delta-main
@@ -53,7 +57,7 @@ python -m tools.analyze $ANALYZE_ARGS || exit 1
 # `pytest -m slow` / crashpoint.py --rounds/--failover-rounds
 env JAX_PLATFORMS=cpu python tools/crashpoint.py --matrix --failover-rounds 1 --seed 7 || exit 1
 if [ "$RUN_BENCH" = "1" ]; then
-  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp bench_serve bench_ingest bench_compact bench_trace_propagation; do
+  for b in bench_trace_overhead bench_watchdog_overhead bench_timeline_overhead bench_tiles bench_mpp bench_serve bench_ingest bench_compact bench_trace_propagation bench_route; do
     env JAX_PLATFORMS=cpu python "tools/$b.py" || exit 1
   done
 fi
